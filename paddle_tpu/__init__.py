@@ -1,0 +1,57 @@
+"""paddle_tpu: a TPU-native deep learning framework.
+
+A ground-up JAX/XLA/pjit/Pallas rebuild of fluid-era PaddlePaddle's
+capabilities: eager (dygraph) module/autograd system and a traced/static
+program path sharing one functional op set; optimizers/AMP/data pipeline;
+Fleet-style hybrid-parallel distributed training over TPU meshes; and an
+AOT inference predictor. See SURVEY.md at the repo root for the reference
+structural map this build follows.
+"""
+
+__version__ = "0.1.0"
+
+import sys as _sys
+
+from . import core
+from .core import (get_flags, set_flags, set_device, get_device,
+                   set_default_dtype, seed)
+from .core.dtype import (bfloat16, bool_, complex64, float16, float32,
+                         float64, int16, int32, int64, int8, uint8)
+from .core.place import CPUPlace, CUDAPlace, GPUPlace, Place, TPUPlace
+from .tensor import Parameter, Tensor, to_tensor
+from .autograd.engine import enable_grad, grad, is_grad_enabled, no_grad
+from . import dispatch as _dispatch
+
+# Publish every wrapped op at top level (paddle.add, paddle.reshape, ...).
+_mod = _sys.modules[__name__]
+for _name, _fn in _dispatch.wrapped_ops.items():
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _fn)
+del _mod, _name, _fn
+
+# Creation aliases matching the public reference API
+rand = _dispatch.wrapped_ops["rand"]
+randn = _dispatch.wrapped_ops["randn"]
+randint = _dispatch.wrapped_ops["randint"]
+uniform = _dispatch.wrapped_ops["uniform"]
+normal = _dispatch.wrapped_ops["normal"]
+
+
+def __getattr__(name):
+    # Lazy subpackage access: paddle_tpu.nn, paddle_tpu.optimizer, ...
+    import importlib
+    if name in ("nn", "optimizer", "amp", "io", "static", "jit",
+                "distributed", "metric", "vision", "models", "hapi",
+                "framework", "inference", "autograd", "ops", "profiler"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def save(obj, path, **kwargs):
+    from .framework.io import save as _save
+    return _save(obj, path, **kwargs)
+
+
+def load(path, **kwargs):
+    from .framework.io import load as _load
+    return _load(path, **kwargs)

@@ -1,0 +1,4 @@
+"""Autograd: eager tape engine + PyLayer custom-function escape hatch."""
+
+from .engine import (GradNode, backward, enable_grad, grad, is_grad_enabled,
+                     no_grad, set_grad_enabled)

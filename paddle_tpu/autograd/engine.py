@@ -1,0 +1,223 @@
+"""Eager autograd engine (the dygraph tape).
+
+TPU-native equivalent of the reference's imperative autograd
+(reference: paddle/fluid/imperative/basic_engine.cc:39 Init, :305 Execute;
+gradient accumulation gradient_accumulator.cc; tracer.cc:207
+CreateGradOpNode). Each eager op records a GradNode holding the jax.vjp
+pullback of its pure-functional kernel; ``backward`` walks the node graph in
+reverse topological order, accumulating cotangents and depositing leaf
+gradients into Tensor.grad.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+
+
+_tls = _TLS()
+
+
+def is_grad_enabled() -> bool:
+    return _tls.grad_enabled
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _tls.grad_enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = _tls.grad_enabled
+    _tls.grad_enabled = False
+    try:
+        yield
+    finally:
+        _tls.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _tls.grad_enabled
+    _tls.grad_enabled = True
+    try:
+        yield
+    finally:
+        _tls.grad_enabled = prev
+
+
+class GradNode:
+    """One recorded op: pullback + wiring to input tensors."""
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_tree",
+                 "out_tensors", "_cotangents")
+
+    def __init__(self, name: str, vjp_fn: Callable,
+                 inputs: Sequence["Any"], out_avals: List[Any],
+                 out_tree=None):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)  # Tensors corresponding to vjp args
+        self.out_avals = out_avals  # jax.ShapeDtypeStruct per output leaf
+        self.out_tree = out_tree    # treedef of the kernel's output
+        self.out_tensors: List[Any] = []  # weak-ish refs for hooks
+        self._cotangents: Optional[List[Any]] = None
+
+    def add_cotangent(self, index: int, value) -> None:
+        if self._cotangents is None:
+            self._cotangents = [None] * len(self.out_avals)
+        cur = self._cotangents[index]
+        self._cotangents[index] = value if cur is None else cur + value
+
+    def materialize_cotangents(self) -> List[Any]:
+        cots = self._cotangents or [None] * len(self.out_avals)
+        out = []
+        for aval, c in zip(self.out_avals, cots):
+            if c is not None:
+                out.append(c)
+            elif jax.dtypes.issubdtype(aval.dtype, np.inexact):
+                out.append(jax.numpy.zeros(aval.shape, aval.dtype))
+            else:
+                out.append(np.zeros(aval.shape, jax.dtypes.float0))
+        return out
+
+
+def _toposort(roots: List[GradNode]) -> List[GradNode]:
+    order: List[GradNode] = []
+    visited = set()
+    stack = [(n, False) for n in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t is not None and t.grad_node is not None:
+                stack.append((t.grad_node, False))
+    return order  # reverse-topological (outputs last -> we walk reversed)
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False,
+             grad_sink: Optional[Dict[int, Any]] = None) -> None:
+    """Run reverse-mode accumulation from ``tensors``.
+
+    Matches reference semantics: Tensor.backward() seeds with ones for
+    scalar outputs (python/paddle/fluid/dygraph/varbase_patch_methods.py:169).
+    """
+    from ..tensor import Tensor  # local import to avoid cycle
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor) or not isinstance(
+            grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    def _deposit(t, g):
+        if grad_sink is not None:
+            cur = grad_sink.get(id(t))
+            grad_sink[id(t)] = g if cur is None else cur + g
+        else:
+            t._accumulate_grad(g)
+
+    roots: List[GradNode] = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.grad_node is None:
+            # Leaf with requires-grad: d t/d t = seed directly.
+            if not t.stop_gradient:
+                seed = _seed_for(t, g)
+                _deposit(t, seed)
+            continue
+        seed = _seed_for(t, g)
+        t.grad_node.add_cotangent(t._out_index, seed)
+        roots.append(t.grad_node)
+
+    order = _toposort(roots)
+    for node in reversed(order):
+        cots = node.materialize_cotangents()
+        if node.out_tree is not None:
+            arg = jax.tree_util.tree_unflatten(node.out_tree, cots)
+        else:
+            arg = cots[0] if len(cots) == 1 else tuple(cots)
+        in_grads = node.vjp_fn(arg)
+        for t, g in zip(node.inputs, in_grads):
+            if t is None or g is None:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            for hook in t._grad_hooks:
+                res = hook(g)
+                if res is not None:
+                    g = res
+            if t.grad_node is not None and not t.is_leaf:
+                t.grad_node.add_cotangent(t._out_index, g)
+                if t._retain_grads:
+                    _deposit(t, g)
+            elif not t.stop_gradient:
+                _deposit(t, g)
+        node._cotangents = None
+        if not retain_graph:
+            node.vjp_fn = _used_up
+            node.inputs = []
+
+
+def _used_up(*_a, **_k):
+    raise RuntimeError(
+        "Trying to backward through the graph a second time; "
+        "pass retain_graph=True if needed.")
+
+
+def _seed_for(t, g):
+    import jax.numpy as jnp
+    if g is None:
+        return jnp.ones(t.shape, dtype=t.dtype)
+    from ..tensor import Tensor
+    return g.value if isinstance(g, Tensor) else jax.numpy.asarray(g)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, allow_unused=False):
+    """Functional-style paddle.grad over the eager tape (reference:
+    imperative/partial_grad_engine.cc). Returns grads w.r.t. ``inputs``
+    without touching .grad fields."""
+    from ..tensor import Tensor
+
+    single = isinstance(inputs, Tensor)
+    inputs_list = [inputs] if single else list(inputs)
+    saved = [(t._retain_grads, t.stop_gradient) for t in inputs_list]
+    for t in inputs_list:
+        t._retain_grads = True
+        t.stop_gradient = False
+    sink: Dict[int, Any] = {}
+    try:
+        backward(outputs, grad_outputs, retain_graph=retain_graph,
+                 grad_sink=sink)
+        results = []
+        for t in inputs_list:
+            g = sink.get(id(t))
+            if g is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        f"Input tensor {t.name or t} was not used in graph")
+                results.append(None)
+            else:
+                results.append(Tensor(g, stop_gradient=True))
+    finally:
+        for t, (r, sg) in zip(inputs_list, saved):
+            t._retain_grads = r
+            t.stop_gradient = sg
+    return results[0] if single else results

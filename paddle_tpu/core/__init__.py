@@ -1,0 +1,20 @@
+"""Core runtime: flags, errors, places, dtypes, RNG, profiling, stats."""
+
+from . import dtype as dtypes
+from .dtype import (bfloat16, bool_, complex64, convert_dtype, default_dtype,
+                    finfo, float16, float32, float64, iinfo, int16, int32,
+                    int64, int8, set_default_dtype, uint8)
+from .enforce import (AlreadyExistsError, EnforceNotMet, InvalidArgumentError,
+                      NotFoundError, OutOfRangeError, PreconditionNotMetError,
+                      UnavailableError, UnimplementedError, enforce,
+                      enforce_eq, enforce_ge, enforce_gt, enforce_in,
+                      enforce_shape_match)
+from .flags import define_flag, get_flag, get_flags, set_flags
+from .monitor import GLOBAL_STATS, stat
+from .place import (CPUPlace, CUDAPlace, GPUPlace, Place, TPUPlace,
+                    device_count, expected_place, get_device,
+                    is_compiled_with_tpu, set_device)
+from .profiler import (RecordEvent, disable_profiler, enable_profiler,
+                       export_chrome_trace, profiler_guard)
+from .rng import (Generator, RNGStatesTracker, default_generator,
+                  get_rng_state_tracker, next_key, seed)

@@ -1,0 +1,102 @@
+"""Dtype system — bf16 first-class.
+
+TPU-native equivalent of the reference's VarType dtype enum
+(reference: paddle/fluid/framework/framework.proto VarType, and
+python/paddle/fluid/data_feeder.py convert_dtype). Canonical dtypes are
+numpy/jax dtypes; strings and numpy types normalize through
+:func:`convert_dtype`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from .flags import get_flag
+
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+
+_ALIASES = {
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "half": "float16",
+    "fp32": "float32",
+    "float": "float32",
+    "fp64": "float64",
+    "double": "float64",
+    "bool": "bool_",
+    "int": "int32",
+    "long": "int64",
+}
+
+_NAME_TO_DTYPE = {
+    "bfloat16": bfloat16, "float16": float16, "float32": float32,
+    "float64": float64, "int8": int8, "int16": int16, "int32": int32,
+    "int64": int64, "uint8": uint8, "uint16": uint16, "uint32": uint32,
+    "bool_": bool_, "complex64": complex64,
+}
+
+DTypeLike = Union[str, np.dtype, type, Any]
+
+
+_64BIT_CANON = {"int64": "int32", "uint64": "uint32", "float64": "float32",
+                "complex128": "complex64"}
+
+
+def convert_dtype(dtype: DTypeLike):
+    """Normalize any dtype spelling to a jax/numpy dtype object.
+
+    TPU-native canonicalization: in x32 mode (the default; 64-bit types are
+    not TPU-performant) 64-bit dtypes map to their 32-bit counterparts, so
+    reference-API calls asking for int64 indices run natively."""
+    if dtype is None:
+        return default_dtype()
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        d = jnp.dtype(_NAME_TO_DTYPE[name]) if name in _NAME_TO_DTYPE \
+            else jnp.dtype(name)
+    else:
+        d = jnp.dtype(dtype)
+    import jax
+    if not jax.config.jax_enable_x64 and d.name in _64BIT_CANON:
+        d = jnp.dtype(_64BIT_CANON[d.name])
+    return d
+
+
+def default_dtype():
+    return jnp.dtype(convert_dtype(get_flag("default_dtype")))
+
+
+def set_default_dtype(dtype: DTypeLike) -> None:
+    from .flags import set_flags
+    set_flags({"default_dtype": str(jnp.dtype(convert_dtype(dtype)))})
+
+
+def is_floating(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.integer)
+
+
+def finfo(dtype: DTypeLike):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype: DTypeLike):
+    return jnp.iinfo(convert_dtype(dtype))

@@ -1,0 +1,113 @@
+"""Global flags registry.
+
+TPU-native equivalent of the reference's gflags runtime-knob system
+(reference: paddle/fluid/platform/flags.cc:33-603, exposed to Python via
+paddle/fluid/pybind/global_value_getter_setter.cc). Flags are plain Python
+values with env-var overrides (``PT_FLAGS_<name>`` or legacy
+``FLAGS_<name>``), settable at runtime via :func:`set_flags`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _FlagInfo:
+    name: str
+    default: Any
+    value: Any
+    doc: str
+    parser: Callable[[str], Any]
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+class FlagRegistry:
+    """Thread-safe named-flag registry with env overrides."""
+
+    def __init__(self) -> None:
+        self._flags: Dict[str, _FlagInfo] = {}
+        self._lock = threading.RLock()
+
+    def define(self, name: str, default: Any, doc: str = "") -> None:
+        ty = type(default)
+        if ty is bool:
+            parser: Callable[[str], Any] = _parse_bool
+        elif ty is int:
+            parser = int
+        elif ty is float:
+            parser = float
+        else:
+            parser = str
+        value = default
+        for env_key in (f"PT_FLAGS_{name}", f"FLAGS_{name}"):
+            if env_key in os.environ:
+                value = parser(os.environ[env_key])
+                break
+        with self._lock:
+            self._flags[name] = _FlagInfo(name, default, value, doc, parser)
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            try:
+                return self._flags[name].value
+            except KeyError:
+                raise KeyError(f"Unknown flag {name!r}") from None
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            if name not in self._flags:
+                raise KeyError(f"Unknown flag {name!r}")
+            info = self._flags[name]
+            if isinstance(value, str) and not isinstance(info.default, str):
+                value = info.parser(value)
+            info.value = value
+
+    def reset(self, name: Optional[str] = None) -> None:
+        with self._lock:
+            names = [name] if name else list(self._flags)
+            for n in names:
+                self._flags[n].value = self._flags[n].default
+
+    def all(self) -> Dict[str, Any]:
+        with self._lock:
+            return {k: v.value for k, v in self._flags.items()}
+
+
+GLOBAL_FLAGS = FlagRegistry()
+
+
+def define_flag(name: str, default: Any, doc: str = "") -> None:
+    GLOBAL_FLAGS.define(name, default, doc)
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    return {n: GLOBAL_FLAGS.get(n) for n in names}
+
+
+def get_flag(name: str) -> Any:
+    return GLOBAL_FLAGS.get(name)
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for k, v in flags.items():
+        GLOBAL_FLAGS.set(k, v)
+
+
+# Core runtime knobs (analogs of the reference's most-used FLAGS_*).
+define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf after each eager op.")
+define_flag("benchmark", False, "Block-until-ready and time each eager op.")
+define_flag("eager_jit_cache", True, "Cache jitted computations for eager op dispatch.")
+define_flag("default_dtype", "float32", "Default floating dtype for new tensors.")
+define_flag("amp_dtype", "bfloat16", "Autocast low-precision dtype (bf16 first-class on TPU).")
+define_flag("profiler_enabled", False, "Collect RecordEvent host events.")
+define_flag("log_level", 0, "Verbose log level (higher = chattier).")
+define_flag("seed", 0, "Global RNG seed when not set explicitly.")

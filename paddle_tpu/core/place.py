@@ -1,0 +1,106 @@
+"""Device/place model over the PJRT runtime.
+
+TPU-native equivalent of the reference's Place variants + DeviceContextPool
+(reference: paddle/fluid/platform/place.h:26-75,
+platform/device_context.h). On the XLA stack a "place" maps to a
+``jax.Device``; streams/contexts are owned by the runtime, so this layer is a
+thin, cached facade used by tensor factories and the data loader.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Union
+
+import jax
+
+
+class Place:
+    """A logical device slot: backend platform + device index."""
+
+    platform: str = "cpu"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Place) and self.platform == other.platform
+                and self.device_id == other.device_id)
+
+    def __hash__(self) -> int:
+        return hash((self.platform, self.device_id))
+
+    def __repr__(self) -> str:
+        return f"Place({self.platform}:{self.device_id})"
+
+    @property
+    def jax_device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if d.platform == self.platform]
+        if not devs:  # fall back: requested platform absent (e.g. TPU on CI)
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    platform = "cpu"
+
+
+class TPUPlace(Place):
+    platform = "tpu"
+
+
+class GPUPlace(Place):
+    platform = "gpu"
+
+
+# Alias matching the reference's naming for CUDA places.
+CUDAPlace = GPUPlace
+
+
+@functools.lru_cache(maxsize=None)
+def _default_place() -> Place:
+    plat = jax.default_backend()
+    if plat == "tpu":
+        return TPUPlace(0)
+    if plat == "gpu":
+        return GPUPlace(0)
+    return CPUPlace(0)
+
+
+_expected_place: Optional[Place] = None
+
+
+def set_device(device: Union[str, Place]) -> Place:
+    """Set the global expected place, e.g. ``set_device('tpu:0')``."""
+    global _expected_place
+    if isinstance(device, Place):
+        _expected_place = device
+        return device
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    cls = {"cpu": CPUPlace, "tpu": TPUPlace, "gpu": GPUPlace,
+           "cuda": GPUPlace}.get(name.lower())
+    if cls is None:
+        from .enforce import InvalidArgumentError
+        raise InvalidArgumentError(f"Unknown device {device!r}")
+    _expected_place = cls(idx)
+    return _expected_place
+
+
+def get_device() -> str:
+    p = expected_place()
+    return f"{p.platform}:{p.device_id}"
+
+
+def expected_place() -> Place:
+    return _expected_place if _expected_place is not None else _default_place()
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def device_count(platform: Optional[str] = None) -> int:
+    if platform is None:
+        platform = expected_place().platform
+    return len([d for d in jax.devices() if d.platform == platform]) or 1

@@ -1,0 +1,116 @@
+"""Profiling: RecordEvent markers + jax.profiler integration.
+
+TPU-native equivalent of the reference's profiler
+(reference: paddle/fluid/platform/profiler.h:127 RecordEvent,
+:213 EnableProfiler; device events via CUPTI device_tracer.h:43). Host
+events are collected in-process; device-side tracing delegates to
+``jax.profiler`` (XLA/TPU trace → TensorBoard), and every RecordEvent also
+opens a ``jax.named_scope`` so markers show up inside XLA traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+
+from .flags import get_flag, set_flags
+
+
+@dataclass
+class _Event:
+    name: str
+    start_us: float
+    end_us: float
+    thread_id: int
+    annotation: Optional[str] = None
+
+
+@dataclass
+class _ProfilerState:
+    enabled: bool = False
+    events: List[_Event] = field(default_factory=list)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+_STATE = _ProfilerState()
+
+
+class RecordEvent:
+    """RAII host-event marker; nests a jax.named_scope for device traces."""
+
+    def __init__(self, name: str, annotation: Optional[str] = None):
+        self.name = name
+        self.annotation = annotation
+        self._scope = None
+        self._start = 0.0
+
+    def __enter__(self):
+        self._start = time.perf_counter() * 1e6
+        self._scope = jax.named_scope(self.name)
+        self._scope.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._scope.__exit__(*exc)
+        if _STATE.enabled or get_flag("profiler_enabled"):
+            evt = _Event(self.name, self._start, time.perf_counter() * 1e6,
+                         threading.get_ident(), self.annotation)
+            with _STATE.lock:
+                _STATE.events.append(evt)
+        return False
+
+
+def enable_profiler() -> None:
+    set_flags({"profiler_enabled": True})
+    _STATE.enabled = True
+    with _STATE.lock:
+        _STATE.events.clear()
+
+
+def disable_profiler() -> None:
+    set_flags({"profiler_enabled": False})
+    _STATE.enabled = False
+
+
+def reset_profiler() -> None:
+    with _STATE.lock:
+        _STATE.events.clear()
+
+
+def profiler_events() -> List[_Event]:
+    with _STATE.lock:
+        return list(_STATE.events)
+
+
+def export_chrome_trace(path: str) -> None:
+    """Write collected host events as a chrome://tracing JSON file."""
+    with _STATE.lock:
+        events = list(_STATE.events)
+    trace = {"traceEvents": [
+        {"name": e.name, "ph": "X", "ts": e.start_us,
+         "dur": max(e.end_us - e.start_us, 0.01), "pid": 0,
+         "tid": e.thread_id % 1_000_000,
+         "args": ({"annotation": e.annotation} if e.annotation else {})}
+        for e in events]}
+    with open(path, "w") as f:
+        json.dump(trace, f)
+
+
+@contextlib.contextmanager
+def profiler_guard(trace_dir: Optional[str] = None):
+    """Context manager enabling host events and optional XLA device trace."""
+    enable_profiler()
+    if trace_dir is not None:
+        jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        if trace_dir is not None:
+            jax.profiler.stop_trace()
+        disable_profiler()

@@ -1,0 +1,110 @@
+"""RNG state management.
+
+TPU-native equivalent of the reference's global Generator plus the
+hybrid-parallel RNG tracker (reference:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py:24
+RNGStatesTracker; python/paddle/framework/random.py seed handling). Eager
+mode holds a mutable key that is split per draw; named states give
+per-mesh-axis streams (e.g. identical dropout inside a TP group, distinct
+across DP ranks).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict
+
+import jax
+
+from .enforce import AlreadyExistsError, NotFoundError
+from .flags import get_flag
+
+
+class Generator:
+    """A mutable PRNG stream over a functional jax key."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+        self._seed = seed
+        self._lock = threading.Lock()
+
+    def seed(self, seed: int) -> None:
+        with self._lock:
+            self._key = jax.random.key(seed)
+            self._seed = seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        with self._lock:
+            return self._key
+
+    def set_state(self, key) -> None:
+        with self._lock:
+            self._key = key
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+
+_DEFAULT = Generator(0)
+_seeded = False
+
+
+def default_generator() -> Generator:
+    global _seeded
+    if not _seeded:
+        _DEFAULT.seed(int(get_flag("seed")))
+        _seeded = True
+    return _DEFAULT
+
+
+def seed(s: int) -> Generator:
+    global _seeded
+    _seeded = True
+    _DEFAULT.seed(int(s))
+    return _DEFAULT
+
+
+def next_key():
+    return default_generator().next_key()
+
+
+class RNGStatesTracker:
+    """Named independent RNG streams for hybrid parallelism."""
+
+    def __init__(self) -> None:
+        self._states: Dict[str, Generator] = {}
+
+    def add(self, name: str, seed: int) -> None:
+        if name in self._states:
+            raise AlreadyExistsError(f"RNG state {name!r} already exists")
+        self._states[name] = Generator(seed)
+
+    def reset(self) -> None:
+        self._states.clear()
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str):
+        """Temporarily make the named stream the default generator."""
+        if name not in self._states:
+            raise NotFoundError(f"RNG state {name!r} not registered")
+        global _DEFAULT
+        prev = _DEFAULT
+        _DEFAULT = self._states[name]
+        try:
+            yield
+        finally:
+            _DEFAULT = prev
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER

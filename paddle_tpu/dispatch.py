@@ -1,0 +1,254 @@
+"""Eager op dispatch: Tensor unwrap → pure kernel → tape record → rewrap.
+
+TPU-native equivalent of the reference's dygraph fast-op path + tracer
+(reference: paddle/fluid/pybind/op_function_generator.cc:518 generated
+core.ops.* entries; paddle/fluid/imperative/tracer.cc:133 TraceOp which runs
+the shared kernel then CreateGradOpNode at tracer.cc:207). Here the shared
+kernel is a pure jax function from paddle_tpu.ops; grad recording uses the
+kernel's own jax.vjp pullback, so every op in the library is differentiable
+for free — no hand-written grad ops.
+
+The same wrapped entry points work inside jit traces: a Tensor may hold a
+tracer, and with autograd disabled (functional capture) dispatch reduces to
+unwrap→call→rewrap.
+"""
+
+from __future__ import annotations
+
+import inspect
+import time
+from functools import partial
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd.engine import GradNode, is_grad_enabled
+from .core.flags import get_flag
+from .core.monitor import stat
+from .ops.registry import all_ops, get_op
+from .tensor import Tensor
+
+_is_tensor = lambda x: isinstance(x, Tensor)  # noqa: E731
+
+
+def _flatten(args, kwargs):
+    return jax.tree_util.tree_flatten((args, kwargs))
+
+
+def _is_diff_dtype(v) -> bool:
+    try:
+        return jnp.issubdtype(v.dtype, jnp.inexact)
+    except Exception:
+        return False
+
+
+def call_fn(fn: Callable, name: str, differentiable: bool, args, kwargs):
+    leaves, treedef = _flatten(args, kwargs)
+    tensor_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    if not tensor_idx:
+        return fn(*args, **kwargs)
+
+    raw_leaves = [l.value if isinstance(l, Tensor) else l for l in leaves]
+    record = (differentiable and is_grad_enabled() and
+              any(not leaves[i].stop_gradient and
+                  _is_diff_dtype(leaves[i]) for i in tensor_idx))
+
+    bench = get_flag("benchmark")
+    t0 = time.perf_counter() if bench else 0.0
+
+    if not record:
+        a, kw = jax.tree_util.tree_unflatten(treedef, raw_leaves)
+        out_raw = fn(*a, **kw)
+        out = _wrap_outputs(out_raw, None, name)
+    else:
+        diff_idx = [i for i in tensor_idx
+                    if not leaves[i].stop_gradient and
+                    _is_diff_dtype(leaves[i])]
+
+        def closed(*dvals):
+            rl = list(raw_leaves)
+            for i, v in zip(diff_idx, dvals):
+                rl[i] = v
+            a, kw = jax.tree_util.tree_unflatten(treedef, rl)
+            return fn(*a, **kw)
+
+        primals = [raw_leaves[i] for i in diff_idx]
+        out_raw, vjp_fn = jax.vjp(closed, *primals)
+        out_leaves, out_tree = jax.tree_util.tree_flatten(out_raw)
+        avals = [jax.ShapeDtypeStruct(jnp.shape(o), jnp.result_type(o))
+                 for o in out_leaves]
+        node = GradNode(name, vjp_fn, [leaves[i] for i in diff_idx], avals,
+                        out_tree)
+        out = _wrap_outputs(out_raw, node, name)
+
+    if get_flag("check_nan_inf"):
+        _check_nan_inf(out, name)
+    if bench:
+        jax.block_until_ready(jax.tree_util.tree_leaves(
+            out, is_leaf=_is_tensor))
+        stat(f"op_us/{name}").add(int((time.perf_counter() - t0) * 1e6))
+    stat("eager_op_calls").add(1)
+    return out
+
+
+def _wrap_outputs(out_raw, node, name):
+    out_leaves, out_tree = jax.tree_util.tree_flatten(out_raw)
+    wrapped = []
+    for i, o in enumerate(out_leaves):
+        if isinstance(o, (jax.Array, np.ndarray)) or hasattr(o, "dtype"):
+            t = Tensor(o, stop_gradient=(node is None or
+                                         not _is_diff_dtype(o)))
+            if node is not None:
+                t.grad_node = node
+                t._out_index = i
+                node.out_tensors.append(t)
+            wrapped.append(t)
+        else:
+            wrapped.append(o)
+    return jax.tree_util.tree_unflatten(out_tree, wrapped)
+
+
+def _check_nan_inf(out, name):
+    for t in jax.tree_util.tree_leaves(out, is_leaf=_is_tensor):
+        if isinstance(t, Tensor) and _is_diff_dtype(t):
+            if bool(jnp.any(~jnp.isfinite(t.value))):
+                from .core.enforce import EnforceNotMet
+                raise EnforceNotMet(
+                    f"Operator {name} output contains NaN/Inf "
+                    f"(FLAGS_check_nan_inf is on)")
+
+
+def apply(name: str, *args, **kwargs):
+    opdef = get_op(name)
+    return call_fn(opdef.fn, name, opdef.differentiable, args, kwargs)
+
+
+def wrap_op(name: str) -> Callable:
+    opdef = get_op(name)
+
+    def wrapped(*args, **kwargs):
+        return call_fn(opdef.fn, name, opdef.differentiable, args, kwargs)
+
+    wrapped.__name__ = name
+    wrapped.__qualname__ = name
+    wrapped.__doc__ = opdef.fn.__doc__
+    wrapped.__wrapped__ = opdef.fn
+    try:
+        wrapped.__signature__ = inspect.signature(opdef.fn)
+    except (TypeError, ValueError):
+        pass
+    return wrapped
+
+
+# -- indexing ----------------------------------------------------------------
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx.value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [_unwrap_index(i) for i in idx]
+    if isinstance(idx, slice):
+        return slice(_unwrap_index(idx.start), _unwrap_index(idx.stop),
+                     _unwrap_index(idx.step))
+    return idx
+
+
+def getitem(t: Tensor, idx):
+    idx_raw = _unwrap_index(idx)
+    return call_fn(lambda x: x[idx_raw], "getitem", True, (t,), {})
+
+
+def setitem(t: Tensor, idx, value):
+    idx_raw = _unwrap_index(idx)
+    if isinstance(value, Tensor):
+        new = call_fn(lambda x, v: x.at[idx_raw].set(v.astype(x.dtype)),
+                      "setitem", True, (t, value), {})
+    else:
+        v = jnp.asarray(value)
+        new = call_fn(lambda x: x.at[idx_raw].set(v.astype(x.dtype)),
+                      "setitem", True, (t,), {})
+    t._inplace_assign(new)
+    return t
+
+
+# -- public wrapped namespace ------------------------------------------------
+
+wrapped_ops: Dict[str, Callable] = {}
+
+
+def _build_namespace():
+    for name in all_ops():
+        wrapped_ops[name] = wrap_op(name)
+
+
+_build_namespace()
+
+
+# -- Tensor monkey-patching (reference: varbase_patch_methods.py) -----------
+
+_BINARY_DUNDERS = {
+    "__add__": "add", "__radd__": ("add", True),
+    "__sub__": "subtract", "__rsub__": ("subtract", True),
+    "__mul__": "multiply", "__rmul__": ("multiply", True),
+    "__truediv__": "divide", "__rtruediv__": ("divide", True),
+    "__floordiv__": "floor_divide", "__rfloordiv__": ("floor_divide", True),
+    "__mod__": "mod", "__rmod__": ("mod", True),
+    "__pow__": "pow", "__rpow__": ("pow", True),
+    "__matmul__": "matmul", "__rmatmul__": ("matmul", True),
+    "__eq__": "equal", "__ne__": "not_equal",
+    "__lt__": "less_than", "__le__": "less_equal",
+    "__gt__": "greater_than", "__ge__": "greater_equal",
+    "__and__": "logical_and", "__or__": "logical_or",
+    "__xor__": "logical_xor",
+}
+
+_UNARY_DUNDERS = {"__neg__": "neg", "__abs__": "abs",
+                  "__invert__": "logical_not"}
+
+
+def _make_binary(opname, reflected=False):
+    fn = wrapped_ops[opname]
+    if reflected:
+        def dunder(self, other):
+            return fn(other, self)
+    else:
+        def dunder(self, other):
+            return fn(self, other)
+    return dunder
+
+
+def monkey_patch_tensor():
+    for dunder, spec in _BINARY_DUNDERS.items():
+        if isinstance(spec, tuple):
+            setattr(Tensor, dunder, _make_binary(spec[0], True))
+        else:
+            setattr(Tensor, dunder, _make_binary(spec))
+    for dunder, opname in _UNARY_DUNDERS.items():
+        fn = wrapped_ops[opname]
+        setattr(Tensor, dunder, lambda self, _f=fn: _f(self))
+
+    # Attach every op whose leading parameter is a tensor as a method.
+    for name, w in wrapped_ops.items():
+        if hasattr(Tensor, name):
+            continue
+        try:
+            params = list(inspect.signature(w).parameters)
+        except (TypeError, ValueError):
+            continue
+        if params and params[0] in ("x", "input", "logits", "logit"):
+            setattr(Tensor, name, _method_from(w))
+
+
+def _method_from(w):
+    def method(self, *args, **kwargs):
+        return w(self, *args, **kwargs)
+    method.__name__ = w.__name__
+    method.__doc__ = w.__doc__
+    return method
+
+
+monkey_patch_tensor()

@@ -1,0 +1,42 @@
+"""Pure functional op library (jax-native kernels).
+
+The modules here are raw jax functions — safe inside jit/pjit/grad. The
+eager Tensor-wrapping dispatch layer is paddle_tpu.dispatch. Every public
+function is auto-registered in the op registry so the OpTest harness and
+eager dispatcher can enumerate them.
+"""
+
+import inspect as _inspect
+
+from . import creation, linalg, manipulation, math, nn_functional, random, \
+    search
+from .registry import OpDef, all_ops, get_op, has_op, register_op
+
+_DYNAMIC_SHAPE_OPS = {
+    "nonzero", "masked_select", "unique", "unique_consecutive", "where",
+}
+_NON_DIFF_OPS = {
+    "argmax", "argmin", "argsort", "randint", "randperm", "one_hot",
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "isnan",
+    "isinf", "isfinite", "shape", "numel", "count_nonzero",
+}
+
+
+def _auto_register():
+    for mod in (creation, math, manipulation, search, linalg, random,
+                nn_functional):
+        short = mod.__name__.rsplit(".", 1)[-1]
+        for name, fn in vars(mod).items():
+            if name.startswith("_") or not callable(fn):
+                continue
+            if not _inspect.isfunction(fn) or fn.__module__ != mod.__name__:
+                continue
+            if not has_op(name):
+                register_op(name, fn, module=short,
+                            differentiable=name not in _NON_DIFF_OPS,
+                            dynamic_shape=name in _DYNAMIC_SHAPE_OPS)
+
+
+_auto_register()

@@ -1,0 +1,144 @@
+"""Tensor creation ops (pure functional, jax-native).
+
+Reference parity: python/paddle/tensor/creation.py (to_tensor, zeros, ones,
+full, arange, linspace, eye, tril/triu, meshgrid, diag, assign).
+These are raw jax functions — the eager Tensor-wrapping layer lives in
+paddle_tpu.dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, default_dtype
+
+
+def _dt(dtype, like=None):
+    if dtype is not None:
+        return convert_dtype(dtype)
+    if like is not None:
+        return None  # let jnp infer
+    return default_dtype()
+
+
+def to_array(data, dtype=None):
+    if dtype is not None:
+        return jnp.asarray(data, dtype=convert_dtype(dtype))
+    arr = jnp.asarray(data)
+    # Python floats default to the framework default dtype, matching the
+    # reference's to_tensor behavior (float64 literals land as float32).
+    if isinstance(data, (float, list, tuple, np.ndarray)) and \
+            jnp.issubdtype(arr.dtype, jnp.floating) and \
+            arr.dtype == jnp.float64:
+        arr = arr.astype(default_dtype())
+    return arr
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, dtype=_dt(dtype))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(shape, dtype=_dt(dtype))
+
+
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, dtype=_dt(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt(dtype, like=x))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=_dt(dtype, like=x))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_dt(dtype, like=x))
+
+
+def empty(shape, dtype=None):
+    return jnp.empty(shape, dtype=_dt(dtype))
+
+
+def empty_like(x, dtype=None):
+    return jnp.empty_like(x, dtype=_dt(dtype, like=x))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = default_dtype()
+        else:
+            dtype = jnp.int32
+    return jnp.arange(start, end, step, dtype=convert_dtype(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_dt(dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_dt(dtype))
+
+
+def diag(x, offset=0, padding_value=0):
+    x = jnp.asarray(x)
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, dtype=x.dtype)
+        return base + jnp.diag(x - 0, offset) - jnp.diag(
+            jnp.full_like(x, padding_value), offset)
+    return jnp.diag(x, offset)
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, offset)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, diagonal)
+
+
+def meshgrid(*arrays, indexing="ij"):
+    arrays = arrays[0] if len(arrays) == 1 and isinstance(
+        arrays[0], (list, tuple)) else arrays
+    return list(jnp.meshgrid(*arrays, indexing=indexing))
+
+
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+def clone(x):
+    return jnp.array(x, copy=True)
+
+
+def tril_indices(row, col=None, offset=0):
+    col = row if col is None else col
+    r, c = jnp.tril_indices(row, offset, col)
+    return jnp.stack([r, c])
+
+
+def triu_indices(row, col=None, offset=0):
+    col = row if col is None else col
+    r, c = jnp.triu_indices(row, offset, col)
+    return jnp.stack([r, c])
+
+
+def complex_(real, imag):
+    return jnp.asarray(real) + 1j * jnp.asarray(imag)

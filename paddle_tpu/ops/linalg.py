@@ -1,0 +1,158 @@
+"""Linear algebra ops (pure functional).
+
+Reference parity: python/paddle/tensor/linalg.py (norm, cholesky, svd, qr,
+inv, solve, eigh, matrix_power, pinv, lstsq, triangular_solve, einsum).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord="fro" if isinstance(axis, (list, tuple))
+                               else None, axis=tuple(axis) if isinstance(
+                                   axis, (list, tuple)) else axis,
+                               keepdims=keepdim)
+    if p == "nuc":
+        return jnp.linalg.norm(x, ord="nuc", axis=tuple(axis),
+                               keepdims=keepdim)
+    if axis is None:
+        return jnp.linalg.norm(x.ravel(), ord=p, keepdims=keepdim)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False):
+    return jnp.linalg.vector_norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.matrix_norm(x, ord=p, keepdims=keepdim)
+
+
+def dist(x, y, p=2):
+    return jnp.linalg.norm((x - y).ravel(), ord=p)
+
+
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def lu(x):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_mat, piv.astype(jnp.int32) + 1  # reference uses 1-based pivots
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol).astype(jnp.int32)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def householder_product(x, tau):
+    *batch, m, n = x.shape
+
+    def single(xm, tv):
+        H = jnp.eye(m, dtype=x.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, x.dtype), jnp.ones(1, x.dtype),
+                                 xm[i + 1:, i]])
+            H = H @ (jnp.eye(m, dtype=x.dtype) -
+                     tv[i] * jnp.outer(v, v))
+        return H[:, :n]
+
+    if batch:
+        flat_x = x.reshape((-1, m, n))
+        flat_t = tau.reshape((-1, tau.shape[-1]))
+        out = jax.vmap(single)(flat_x, flat_t)
+        return out.reshape(*batch, m, n)
+    return single(x, tau)

@@ -1,0 +1,334 @@
+"""Shape/layout manipulation ops (pure functional).
+
+Reference parity: python/paddle/tensor/manipulation.py (reshape, transpose,
+concat, split, gather, scatter, squeeze, expand, tile, flip, roll, pad...).
+Static shapes only where XLA requires them; the few inherently dynamic ops
+(masked_select, nonzero) are provided with an eager escape hatch.
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_slice = builtins.slice
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, tuple(shape))
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def t(x):
+    return jnp.transpose(x)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def concat(xs, axis=0):
+    return jnp.concatenate(list(xs), axis=axis)
+
+
+def stack(xs, axis=0):
+    return jnp.stack(list(xs), axis=axis)
+
+
+def unstack(x, axis=0, num=None):
+    n = x.shape[axis] if num is None else num
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]
+
+
+unbind = unstack
+
+
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    # Resolve a single -1 entry like the reference's split op.
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = x.shape[axis] - known
+    offsets = np.cumsum(sections)[:-1]
+    return jnp.split(x, offsets.tolist(), axis=axis)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, axis)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    start = start_axis % nd if nd else 0
+    stop = stop_axis % nd if nd else 0
+    shape = (x.shape[:start] + (-1,) + x.shape[stop + 1:]) if nd else (-1,)
+    return jnp.reshape(x, shape)
+
+
+def ravel(x):
+    return jnp.ravel(x)
+
+
+def expand(x, shape):
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s == -1 else s
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def broadcast_tensors(inputs):
+    return list(jnp.broadcast_arrays(*inputs))
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):  # noqa: A002
+    """N-d pad. ``pad`` is a flat [before0, after0, before1, after1, ...]
+    list over trailing dims (reference pad_op semantics when len==2*ndim,
+    otherwise pads the spatial dims of an NCHW/NHWC feature map)."""
+    pad = list(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # Spatial-only pad (e.g. [left,right,top,bottom] on NCHW).
+        nsp = len(pad) // 2
+        width = [(0, 0)] * nd
+        # pad is given innermost-last like the reference's functional.pad.
+        spatial = list(range(nd - nsp, nd)) if data_format.startswith("NC") \
+            else list(range(1, 1 + nsp))
+        for i, dim in enumerate(spatial):
+            width[dim] = (pad[2 * i], pad[2 * i + 1])
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "replicate": "edge", "circular": "wrap"}
+    kwargs = {"constant_values": value} if mode == "constant" else {}
+    return jnp.pad(x, width, mode=mode_map[mode], **kwargs)
+
+
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):  # noqa: A002
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis,
+                                  inplace=False)
+    dnums = {"add": "add", "multiply": "multiply", "mul": "multiply"}[reduce]
+    y = jnp.zeros_like(x) if dnums == "add" else jnp.ones_like(x)
+    y = jnp.put_along_axis(y, indices, values, axis=axis, inplace=False)
+    return x + y if dnums == "add" else x * y
+
+
+def gather_nd(x, index):
+    """Gather slices by an index tensor whose last dim indexes leading dims
+    of x (reference: paddle/fluid/operators/gather_nd_op.cc)."""
+    index = jnp.asarray(index)
+    idx_depth = index.shape[-1]
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx] if idx_depth <= x.ndim else None
+
+
+def scatter(x, index, updates, overwrite=True):
+    """Row scatter (reference scatter_op: index selects rows of x)."""
+    index = jnp.asarray(index)
+    if index.ndim == 2:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    # accumulate mode: zero out target rows then add
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(jnp.asarray(index), -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    return scatter_nd_add(jnp.zeros(shape, dtype=updates.dtype), index,
+                          updates)
+
+
+def index_add(x, index, axis, value):
+    x_moved = jnp.moveaxis(x, axis, 0)
+    v_moved = jnp.moveaxis(jnp.asarray(value), axis, 0)
+    out = x_moved.at[index].add(v_moved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    """Static slice (reference slice_op)."""
+    idx = [_slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = _slice(s, e)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [_slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = _slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    """Dynamic-shape op: eager-only (sizes depend on data). Inside jit use
+    jnp.nonzero with a size= hint instead."""
+    res = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.stack([jnp.asarray(r) for r in res], axis=1)
+
+
+def masked_select(x, mask):
+    """Dynamic-shape op: eager-only."""
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    """Dynamic-shape op: eager-only."""
+    res = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    """Dynamic-shape op: eager-only (flattens unless axis given)."""
+    arr = np.asarray(x)
+    if axis is not None:
+        moved = np.moveaxis(arr, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        keep = np.concatenate([[True], (flat[1:] != flat[:-1]).any(axis=1)])
+        return jnp.asarray(np.moveaxis(moved[keep], 0, axis))
+    arr = arr.ravel()
+    keep = np.concatenate([[True], arr[1:] != arr[:-1]]) if arr.size else \
+        np.zeros(0, dtype=bool)
+    rets = [jnp.asarray(arr[keep])]
+    if return_inverse:
+        rets.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        rets.append(jnp.asarray(np.diff(np.append(idx, arr.size))))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def cast(x, dtype):
+    from ..core.dtype import convert_dtype
+    return jnp.asarray(x).astype(convert_dtype(dtype))
+
+
+def numel(x):
+    return jnp.asarray(x.size, dtype=jnp.int32)
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    """Map global ids to shard-local ids (reference shard_index_op, used by
+    sharded embedding)."""
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_complex(x):
+    return x[..., 0] + 1j * x[..., 1]
+
+
+def view(x, shape):
+    return jnp.reshape(x, tuple(shape))
+
+
+def crop(x, shape, offsets=None):
+    offsets = offsets or [0] * x.ndim
+    idx = tuple(_slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]

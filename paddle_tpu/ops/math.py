@@ -1,0 +1,564 @@
+"""Elementwise / reduction / comparison math ops (pure functional).
+
+Reference parity: python/paddle/tensor/math.py and
+paddle/fluid/operators/elementwise/, reduce_ops/ kernel families. Pure jax
+functions usable both inside jit and from the eager dispatch layer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# --- binary elementwise -----------------------------------------------------
+
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+def pow(x, y):  # noqa: A001 - mirrors the public API name
+    return jnp.power(x, y)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+# --- unary elementwise ------------------------------------------------------
+
+def abs(x):  # noqa: A001
+    return jnp.abs(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def round(x):  # noqa: A001
+    return jnp.round(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def sgn(x):
+    return jnp.sign(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def clip(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def exponent(x):
+    return jnp.frexp(x)[1]
+
+
+# --- nan handling -----------------------------------------------------------
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def nansum(x, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+# --- reductions -------------------------------------------------------------
+
+def sum(x, axis=None, keepdim=False, dtype=None):  # noqa: A001
+    return jnp.sum(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+# --- cumulative -------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = jnp.ravel(x)
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+def cummax(x, axis=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    inds = jnp.argmax(
+        jnp.where(x == vals, jnp.arange(x.shape[axis]).reshape(
+            [-1 if i == axis % x.ndim else 1 for i in range(x.ndim)]), -1),
+        axis=axis)
+    return vals, inds
+
+
+def cummin(x, axis=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+    inds = jnp.argmax(
+        jnp.where(x == vals, jnp.arange(x.shape[axis]).reshape(
+            [-1 if i == axis % x.ndim else 1 for i in range(x.ndim)]), -1),
+        axis=axis)
+    return vals, inds
+
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+# --- comparison -------------------------------------------------------------
+
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+# --- logical / bitwise ------------------------------------------------------
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def left_shift(x, y):
+    return jnp.left_shift(x, y)
+
+
+def right_shift(x, y):
+    return jnp.right_shift(x, y)
+
+
+# --- matmul family (MXU path) ----------------------------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if jnp.ndim(x) > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if jnp.ndim(y) > 1 else y
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    # Reference dot_op semantics: 1-D -> scalar-per-batch inner product.
+    if jnp.ndim(x) == 1:
+        return jnp.sum(x * y)
+    return jnp.sum(x * y, axis=-1)
+
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):  # noqa: A002
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def multiply_sum(x, y, axis=None):
+    return jnp.sum(x * y, axis=axis)
+
+
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def histogram(x, bins=100, min=0, max=0):  # noqa: A002
+    if min == 0 and max == 0:
+        rng = None
+    else:
+        rng = (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=rng)
+    return hist

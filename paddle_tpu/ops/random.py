@@ -1,0 +1,104 @@
+"""Random ops (pure functional — explicit key in, plus eager wrappers that
+draw from the global Generator in paddle_tpu.core.rng).
+
+Reference parity: python/paddle/tensor/random.py (uniform, normal, randn,
+randint, randperm, bernoulli, multinomial, poisson, exponential).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, default_dtype
+from ..core.rng import next_key
+
+
+def _key(key):
+    return key if key is not None else next_key()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, key=None):  # noqa: A002
+    dtype = convert_dtype(dtype) if dtype else default_dtype()
+    return jax.random.uniform(_key(key), tuple(shape), dtype=dtype,
+                              minval=min, maxval=max)
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=None, key=None):
+    dtype = convert_dtype(dtype) if dtype else default_dtype()
+    return mean + std * jax.random.normal(_key(key), tuple(shape or ()),
+                                          dtype=dtype)
+
+
+def randn(shape, dtype=None, key=None):
+    return normal(0.0, 1.0, shape, dtype, key)
+
+
+def rand(shape, dtype=None, key=None):
+    return uniform(shape, dtype, 0.0, 1.0, key)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", key=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(key), tuple(shape), low, high,
+                              dtype=convert_dtype(dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, key=None):
+    return randint(low, high, x.shape, dtype or x.dtype, key)
+
+
+def randperm(n, dtype="int64", key=None):
+    return jax.random.permutation(_key(key), n).astype(convert_dtype(dtype))
+
+
+def shuffle(x, axis=0, key=None):
+    return jax.random.permutation(_key(key), x, axis=axis,
+                                  independent=False)
+
+
+def bernoulli(x, key=None):
+    return jax.random.bernoulli(_key(key), x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False, key=None):
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(
+            _key(key), logits, axis=-1,
+            shape=(*x.shape[:-1], num_samples)).astype(jnp.int32)
+    # Without replacement: Gumbel top-k trick.
+    g = jax.random.gumbel(_key(key), x.shape, dtype=logits.dtype)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int32)
+
+
+def poisson(x, key=None):
+    return jax.random.poisson(_key(key), x).astype(x.dtype)
+
+
+def exponential(x, lam=1.0, key=None):
+    return (jax.random.exponential(_key(key), x.shape, dtype=x.dtype) /
+            lam)
+
+
+def standard_gamma(alpha, key=None):
+    return jax.random.gamma(_key(key), alpha)
+
+
+def normal_like(x, mean=0.0, std=1.0, key=None):
+    return normal(mean, std, x.shape, x.dtype, key)
+
+
+def uniform_like(x, min=-1.0, max=1.0, key=None):  # noqa: A002
+    return uniform(x.shape, x.dtype, min, max, key)
+
+
+def rand_like(x, key=None):
+    return rand(x.shape, x.dtype, key)
+
+
+def gumbel(shape, dtype=None, key=None):
+    dtype = convert_dtype(dtype) if dtype else default_dtype()
+    return jax.random.gumbel(_key(key), tuple(shape), dtype=dtype)

@@ -1,0 +1,55 @@
+"""Operator registry.
+
+TPU-native equivalent of the reference's op registry/metadata system
+(reference: paddle/fluid/framework/op_registry.h:278 REGISTER_OPERATOR,
+op_info.h). Both execution paths share one kernel set the way the
+reference's dygraph and static modes share OperatorWithKernel::AllOpKernels
+(paddle/fluid/imperative/prepared_operator.cc:147): here the "kernel" is a
+pure jax function; the eager path wraps it with Tensor unwrap + autograd
+tape, the traced path calls it raw under jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class OpDef:
+    name: str
+    fn: Callable  # pure jax function
+    module: str = ""
+    differentiable: bool = True
+    dynamic_shape: bool = False  # eager-only ops (nonzero, unique, ...)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, fn: Optional[Callable] = None, *,
+                differentiable: bool = True, dynamic_shape: bool = False,
+                module: str = "") -> Callable:
+    def deco(f: Callable) -> Callable:
+        _REGISTRY[name] = OpDef(name, f, module or f.__module__,
+                                differentiable, dynamic_shape)
+        return f
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    from ..core.enforce import NotFoundError
+    if name not in _REGISTRY:
+        raise NotFoundError(f"Op {name!r} is not registered")
+    return _REGISTRY[name]
+
+
+def has_op(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def all_ops() -> Dict[str, OpDef]:
+    return dict(_REGISTRY)

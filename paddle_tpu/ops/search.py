@@ -1,0 +1,90 @@
+"""Search/sort ops (pure functional).
+
+Reference parity: python/paddle/tensor/search.py (argmax, argsort, topk,
+sort, index_sample, kthvalue, mode, searchsorted, bucketize).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None
+                     else False)
+    return out.astype(jnp.dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None
+                     else False)
+    return out.astype(jnp.dtype(dtype))
+
+
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+def sort(x, axis=-1, descending=False, stable=True):
+    out = jnp.sort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    if axis is None:
+        axis = -1
+    x_moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, inds = jax.lax.top_k(x_moved, k)
+    else:
+        vals, inds = jax.lax.top_k(-x_moved, k)
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(inds, -1, axis).astype(jnp.int32))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    x_moved = jnp.moveaxis(x, axis, -1)
+    vals = jnp.sort(x_moved, axis=-1)[..., k - 1]
+    inds = jnp.argsort(x_moved, axis=-1, stable=True)[..., k - 1]
+    if keepdim:
+        vals = jnp.expand_dims(jnp.moveaxis(vals, -1, -1), axis)
+        inds = jnp.expand_dims(inds, axis)
+        return vals, inds.astype(jnp.int32)
+    return vals, inds.astype(jnp.int32)
+
+
+def mode(x, axis=-1, keepdim=False):
+    # counts by pairwise equality (static-shape friendly)
+    xm = jnp.moveaxis(x, axis, -1)
+    eq = (xm[..., :, None] == xm[..., None, :]).sum(-1)
+    idx = jnp.argmax(eq, axis=-1)
+    vals = jnp.take_along_axis(xm, idx[..., None], axis=-1)[..., 0]
+    if keepdim:
+        return jnp.expand_dims(vals, axis), jnp.expand_dims(
+            idx, axis).astype(jnp.int32)
+    return vals, idx.astype(jnp.int32)
+
+
+def index_sample(x, index):
+    """Per-row gather (reference index_sample_op): out[i,j] = x[i, index[i,j]]."""
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def searchsorted(sorted_sequence, values, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        return jnp.searchsorted(sorted_sequence, values, side=side).astype(
+            jnp.int32)
+    fn = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))
+    flat_seq = sorted_sequence.reshape(-1, sorted_sequence.shape[-1])
+    flat_val = values.reshape(-1, values.shape[-1])
+    return fn(flat_seq, flat_val).reshape(values.shape).astype(jnp.int32)
+
+
+def bucketize(x, sorted_sequence, right=False):
+    return jnp.searchsorted(sorted_sequence, x,
+                            side="right" if right else "left").astype(
+                                jnp.int32)

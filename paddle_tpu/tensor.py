@@ -1,0 +1,264 @@
+"""Eager Tensor: a jax.Array wrapper carrying autograd/tape state.
+
+TPU-native equivalent of the reference's VarBase/VariableWrapper
+(reference: paddle/fluid/imperative/layer.h VarBase,
+imperative/variable_wrapper.h; Python-side patching
+python/paddle/fluid/dygraph/varbase_patch_methods.py). The wrapped value may
+be a concrete device array (eager) or a jax tracer (inside functional
+capture) — ops unwrap either transparently.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dtype import convert_dtype
+
+
+class Tensor:
+    __slots__ = ("value", "stop_gradient", "grad", "grad_node", "_out_index",
+                 "name", "persistable", "_retain_grads", "_grad_hooks",
+                 "__weakref__")
+
+    def __init__(self, value, stop_gradient: bool = True,
+                 name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value.value
+        self.value = value
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self.grad_node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+        self._retain_grads = False
+        self._grad_hooks: List[Any] = []
+
+    # -- array protocol ------------------------------------------------------
+
+    def __jax_array__(self):
+        return self.value
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self.value)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self.value.shape)) if self.value.shape else 1
+
+    @property
+    def T(self):
+        from . import dispatch
+        return dispatch.apply("t", self)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.grad_node is None
+
+    @property
+    def place(self):
+        from .core.place import expected_place
+        devs = getattr(self.value, "devices", None)
+        return expected_place()
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.value)
+
+    def item(self):
+        return np.asarray(self.value).item()
+
+    def tolist(self):
+        return np.asarray(self.value).tolist()
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self.value, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self) -> "Tensor":
+        from . import dispatch
+        return dispatch.apply("clone", self)
+
+    def numel(self) -> int:
+        return self.size
+
+    def element_size(self) -> int:
+        return jnp.dtype(self.dtype).itemsize
+
+    def astype(self, dtype) -> "Tensor":
+        from . import dispatch
+        return dispatch.apply("cast", self, dtype)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_put(
+            self.value, jax.devices("cpu")[0]), self.stop_gradient)
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        if args and isinstance(args[0], (str, np.dtype)) and str(
+                args[0]).lower() not in ("cpu", "tpu", "gpu"):
+            return self.astype(args[0])
+        return self
+
+    def block_until_ready(self) -> "Tensor":
+        if hasattr(self.value, "block_until_ready"):
+            self.value.block_until_ready()
+        return self
+
+    # -- autograd ------------------------------------------------------------
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False) -> None:
+        from .autograd.engine import backward as _backward
+        _backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def retain_grads(self) -> None:
+        self._retain_grads = True
+
+    def register_hook(self, hook) -> None:
+        self._grad_hooks.append(hook)
+
+    def clear_grad(self) -> None:
+        self.grad = None
+
+    def clear_gradient(self) -> None:
+        self.grad = None
+
+    def _accumulate_grad(self, g) -> None:
+        g = g.value if isinstance(g, Tensor) else g
+        if self.grad is None:
+            self.grad = Tensor(jnp.asarray(g), stop_gradient=True,
+                               name=(self.name or "") + "@GRAD")
+        else:
+            self.grad = Tensor(self.grad.value + g, stop_gradient=True,
+                               name=self.grad.name)
+
+    # -- in-place-style helpers (functional under the hood) -------------------
+
+    def _inplace_assign(self, new: "Tensor") -> "Tensor":
+        self.value = new.value if isinstance(new, Tensor) else new
+        if isinstance(new, Tensor):
+            self.grad_node = new.grad_node
+            self._out_index = new._out_index
+        return self
+
+    def set_value(self, value) -> None:
+        value = value.value if isinstance(value, Tensor) else jnp.asarray(
+            value)
+        self.value = value.astype(self.dtype) if value.dtype != self.dtype \
+            else value
+
+    def fill_(self, v) -> "Tensor":
+        self.value = jnp.full_like(self.value, v)
+        return self
+
+    def zero_(self) -> "Tensor":
+        self.value = jnp.zeros_like(self.value)
+        return self
+
+    def scale_(self, v) -> "Tensor":
+        self.value = self.value * v
+        return self
+
+    def add_(self, other) -> "Tensor":
+        other = other.value if isinstance(other, Tensor) else other
+        self.value = self.value + other
+        return self
+
+    def subtract_(self, other) -> "Tensor":
+        other = other.value if isinstance(other, Tensor) else other
+        self.value = self.value - other
+        return self
+
+    # -- python protocol ------------------------------------------------------
+
+    def __len__(self) -> int:
+        if not self.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __bool__(self) -> bool:
+        return bool(np.asarray(self.value))
+
+    def __int__(self) -> int:
+        return int(np.asarray(self.value))
+
+    def __float__(self) -> float:
+        return float(np.asarray(self.value))
+
+    def __index__(self) -> int:
+        return int(np.asarray(self.value))
+
+    def __repr__(self) -> str:
+        sg = self.stop_gradient
+        return (f"Tensor(shape={list(self.shape)}, dtype={self.dtype}, "
+                f"stop_gradient={sg},\n{np.asarray(self.value)})")
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __getitem__(self, idx):
+        from . import dispatch
+        return dispatch.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from . import dispatch
+        dispatch.setitem(self, idx, value)
+
+    def __hash__(self):
+        return id(self)
+
+    # arithmetic dunders are attached by paddle_tpu.dispatch.monkey_patch()
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: framework.py Parameter; dygraph params
+    default to stop_gradient=False)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer",
+                 "do_model_average", "need_clip", "is_distributed")
+
+    def __init__(self, value, name: Optional[str] = None,
+                 trainable: bool = True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    @property
+    def requires_grad(self) -> bool:
+        return not self.stop_gradient
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True
+              ) -> Tensor:
+    """paddle.to_tensor equivalent."""
+    if isinstance(data, Tensor):
+        val = data.value
+        if dtype is not None:
+            val = val.astype(convert_dtype(dtype))
+        return Tensor(val, stop_gradient=stop_gradient)
+    from .ops.creation import to_array
+    return Tensor(to_array(data, dtype), stop_gradient=stop_gradient)

@@ -1,0 +1,28 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Distributed/sharding tests validate multi-chip semantics on fake CPU
+devices (the driver's dryrun_multichip does the same); bench.py runs on the
+real TPU chip with the default environment.
+"""
+
+import os
+
+# Must be set before the first backend initialization.
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " +
+                               _flag).strip()
+
+import jax  # noqa: E402
+
+# The container's sitecustomize registers the axon TPU plugin and pins
+# JAX_PLATFORMS=axon at interpreter start; tests must run on host CPU.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    import numpy as np
+    return np.random.default_rng(0)

@@ -1,0 +1,157 @@
+"""Eager autograd (dygraph tape) tests.
+
+Mirrors the reference's imperative-engine tests
+(python/paddle/fluid/tests/unittests/test_imperative_basic.py,
+test_imperative_auto_prune.py, test_inplace.py hook/retain tests).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_backward_chain():
+    x = pt.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2.0
+    z = y + 1.0
+    w = (z * z).sum()
+    w.backward()
+    # dw/dx = 2*z*2 = 4*(2x+1)
+    np.testing.assert_allclose(x.grad.numpy(), 4 * (2 * np.array(
+        [1.0, 2.0, 3.0]) + 1))
+
+
+def test_grad_accumulation():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_prunes():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = pt.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_no_grad_context():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    with pt.no_grad():
+        y = x * 2
+    assert y.grad_node is None
+    assert y.stop_gradient
+
+
+def test_detach():
+    x = pt.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    z = (d * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_retain_graph():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 8.0])
+
+
+def test_double_backward_raises():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_grad_api():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = pt.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), 3 * np.array([1.0, 2.0]) ** 2)
+    # grad() must not pollute .grad
+    assert x.grad is None
+
+
+def test_grad_api_unused():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    u = pt.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    with pytest.raises(RuntimeError):
+        pt.grad(y, [u])
+    (g,) = pt.grad((x * 2).sum(), [u], allow_unused=True)
+    assert g is None
+
+
+def test_hooks():
+    x = pt.to_tensor([1.0, 1.0], stop_gradient=False)
+    seen = {}
+
+    def hook(g):
+        seen["g"] = np.asarray(g)
+        return g * 10
+
+    x.register_hook(hook)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(seen["g"], [2.0, 2.0])
+    np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0])
+
+
+def test_retain_grads_intermediate():
+    x = pt.to_tensor([2.0], stop_gradient=False)
+    y = x * 3
+    y.retain_grads()
+    (y * y).sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [12.0])
+
+
+def test_multi_output_op_grad():
+    x = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                     stop_gradient=False)
+    vals, idx = pt.topk(x, 2)
+    vals.sum().backward()
+    expect = np.zeros((2, 3), np.float32)
+    expect[0, 2] = expect[0, 1] = 1
+    expect[1, 2] = expect[1, 1] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expect)
+
+
+def test_backward_through_getitem_setitem():
+    x = pt.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x[1:]
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 1.0])
+
+
+def test_branching_graph():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    (a + b).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_nonscalar_backward_seed():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.backward(pt.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+
+def test_deep_chain_no_recursion():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = x
+    for _ in range(300):
+        y = y + 0.001
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0])

@@ -1,0 +1,178 @@
+"""Math/elementwise/reduction op tests vs NumPy references.
+
+Mirrors the reference's per-op unit tests (e.g.
+python/paddle/fluid/tests/unittests/test_elementwise_add_op.py,
+test_reduce_op.py) through the declarative OpTest harness.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import check_forward, check_grad
+
+RNG = np.random.default_rng(42)
+
+
+def _f32(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+BINARY_CASES = [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("maximum", np.maximum), ("minimum", np.minimum),
+    ("atan2", np.arctan2), ("logaddexp", np.logaddexp),
+    ("fmax", np.fmax), ("fmin", np.fmin),
+]
+
+
+@pytest.mark.parametrize("name,ref", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_binary_elementwise(name, ref):
+    x, y = _f32(3, 4), _f32(3, 4)
+    check_forward(name, ref, x, y)
+    check_grad(name, x, y, arg_idx=(0, 1))
+
+
+def test_divide():
+    x, y = _f32(3, 4), np.abs(_f32(3, 4)) + 0.5
+    check_forward("divide", np.divide, x, y)
+    check_grad("divide", x, y, arg_idx=(0, 1))
+
+
+def test_broadcasting_binary():
+    x, y = _f32(3, 1, 4), _f32(2, 1)
+    check_forward("add", np.add, x, y)
+    check_grad("multiply", x, y, arg_idx=(0, 1))
+
+
+UNARY_CASES = [
+    ("exp", np.exp), ("log", None), ("sqrt", None), ("abs", np.abs),
+    ("neg", np.negative), ("sin", np.sin), ("cos", np.cos),
+    ("tanh", np.tanh), ("floor", np.floor), ("ceil", np.ceil),
+    ("square", np.square), ("sigmoid", None), ("expm1", np.expm1),
+    ("log1p", None), ("sinh", np.sinh), ("cosh", np.cosh),
+    ("asinh", np.arcsinh), ("atan", np.arctan), ("erf", None),
+    ("trunc", np.trunc), ("sign", np.sign), ("rsqrt", None),
+    ("reciprocal", None),
+]
+
+
+@pytest.mark.parametrize("name,ref", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary(name, ref):
+    if name in ("log", "sqrt", "log1p", "rsqrt", "reciprocal"):
+        x = np.abs(_f32(3, 4)) + 0.1
+        ref = {"log": np.log, "sqrt": np.sqrt, "log1p": np.log1p,
+               "rsqrt": lambda v: 1.0 / np.sqrt(v),
+               "reciprocal": lambda v: 1.0 / v}[name]
+    elif name == "sigmoid":
+        x = _f32(3, 4)
+        ref = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    elif name == "erf":
+        from scipy.special import erf as sp_erf  # type: ignore
+        x = _f32(3, 4)
+        ref = sp_erf
+    else:
+        x = _f32(3, 4)
+    check_forward(name, ref, x, rtol=1e-4, atol=1e-5)
+    if name not in ("floor", "ceil", "trunc", "sign", "abs"):
+        check_grad(name, x)
+
+
+REDUCE_CASES = [
+    ("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min),
+    ("prod", np.prod),
+]
+
+
+@pytest.mark.parametrize("name,ref", REDUCE_CASES,
+                         ids=[c[0] for c in REDUCE_CASES])
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False),
+                                          (1, True), ((0, 2), False)])
+def test_reduce(name, ref, axis, keepdim):
+    x = _f32(2, 3, 4)
+    check_forward(name, lambda v, axis=None, keepdim=False:
+                  ref(v, axis=axis, keepdims=keepdim),
+                  x, axis=axis, keepdim=keepdim, rtol=1e-4)
+    check_grad(name, x, axis=axis, keepdim=keepdim)
+
+
+def test_std_var_median():
+    x = _f32(4, 5)
+    check_forward("std", lambda v: np.std(v, ddof=1), x, rtol=1e-4)
+    check_forward("var", lambda v: np.var(v, ddof=1), x, rtol=1e-4)
+    check_forward("median", np.median, x)
+
+
+def test_logsumexp():
+    from scipy.special import logsumexp as sp_lse
+    x = _f32(3, 4)
+    check_forward("logsumexp", lambda v, axis=None: sp_lse(v, axis=axis),
+                  x, axis=1, rtol=1e-5)
+    check_grad("logsumexp", x, axis=1)
+
+
+def test_cumsum_cumprod():
+    x = _f32(3, 4)
+    check_forward("cumsum", lambda v, axis=None: np.cumsum(v, axis=axis),
+                  x, axis=1)
+    check_grad("cumsum", x, axis=1)
+    check_forward("cumprod", lambda v, dim=None: np.cumprod(v, axis=dim),
+                  x, dim=1, rtol=1e-4)
+
+
+def test_matmul():
+    x, y = _f32(3, 4), _f32(4, 5)
+    check_forward("matmul", lambda a, b: a @ b, x, y, rtol=1e-4)
+    check_grad("matmul", x, y, arg_idx=(0, 1), numeric=True)
+    # batched + transpose flags
+    a, b = _f32(2, 3, 4), _f32(2, 5, 4)
+    check_forward("matmul",
+                  lambda u, v, transpose_y=False: u @ v.swapaxes(-1, -2),
+                  a, b, transpose_y=True, rtol=1e-4)
+
+
+def test_comparisons():
+    x, y = _f32(3, 4), _f32(3, 4)
+    check_forward("equal", np.equal, x, x)
+    check_forward("greater_than", np.greater, x, y)
+    check_forward("less_equal", np.less_equal, x, y)
+
+
+def test_logical():
+    a = RNG.integers(0, 2, (3, 4)).astype(bool)
+    b = RNG.integers(0, 2, (3, 4)).astype(bool)
+    check_forward("logical_and", np.logical_and, a, b)
+    check_forward("logical_not", np.logical_not, a)
+
+
+def test_clip_scale():
+    x = _f32(3, 4)
+    check_forward("clip", lambda v, min=None, max=None:
+                  np.clip(v, min, max), x, min=-0.5, max=0.5)
+    check_grad("clip", x, min=-0.5, max=0.5)
+    check_forward("scale", lambda v, scale=1.0, bias=0.0: v * scale + bias,
+                  x, scale=2.0, bias=1.0)
+
+
+def test_pow():
+    x = np.abs(_f32(3, 4)) + 0.5
+    check_forward("pow", np.power, x, 2.0)
+    check_grad("pow", x, 2.0)
+
+
+def test_trace_diag():
+    x = _f32(4, 4)
+    check_forward("trace", lambda v: np.trace(v), x)
+    check_forward("diag", lambda v: np.diag(v), x)
+    check_forward("tril", lambda v: np.tril(v), x)
+    check_forward("triu", lambda v: np.triu(v), x)
+
+
+def test_isnan_isinf():
+    x = np.array([1.0, np.nan, np.inf, -np.inf], dtype=np.float32)
+    check_forward("isnan", np.isnan, x)
+    check_forward("isinf", np.isinf, x)
+    check_forward("isfinite", np.isfinite, x)
+    check_forward("nan_to_num", lambda v: np.nan_to_num(v), x,
+                  rtol=0, atol=0)
