@@ -108,3 +108,23 @@ _TRACKER = RNGStatesTracker()
 
 def get_rng_state_tracker() -> RNGStatesTracker:
     return _TRACKER
+
+
+@contextlib.contextmanager
+def key_scope(key):
+    """Route next_key() draws through ``key`` (may be a tracer) — used by
+    functional capture so dropout keys are jit arguments, not baked-in
+    constants."""
+    global _DEFAULT, _seeded
+    prev, prev_seeded = _DEFAULT, _seeded
+    gen = Generator.__new__(Generator)
+    gen._key = key
+    gen._seed = -1
+    import threading as _t
+    gen._lock = _t.Lock()
+    _DEFAULT = gen
+    _seeded = True
+    try:
+        yield gen
+    finally:
+        _DEFAULT, _seeded = prev, prev_seeded

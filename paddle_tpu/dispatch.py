@@ -44,11 +44,19 @@ def _is_diff_dtype(v) -> bool:
         return False
 
 
+from jax._src import core as _jax_core
+
+
 def call_fn(fn: Callable, name: str, differentiable: bool, args, kwargs):
     leaves, treedef = _flatten(args, kwargs)
     tensor_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
     if not tensor_idx:
-        return fn(*args, **kwargs)
+        out = fn(*args, **kwargs)
+        # Eager creation ops (no tensor inputs) still produce Tensors;
+        # inside a jit trace raw tracers pass through untouched.
+        if _jax_core.trace_state_clean():
+            return _wrap_outputs(out, None, name)
+        return out
 
     raw_leaves = [l.value if isinstance(l, Tensor) else l for l in leaves]
     record = (differentiable and is_grad_enabled() and

@@ -1,0 +1,196 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+
+from __future__ import annotations
+
+from .. import dispatch
+from .layer import Layer
+
+F = dispatch.wrapped_ops
+
+
+class _Act(Layer):
+    _op = ""
+    _kwargs: dict = {}
+
+    def __init__(self, name=None, **kwargs):
+        super().__init__()
+        self._extra = {**self._kwargs, **kwargs}
+
+    def forward(self, x):
+        return F[self._op](x, **self._extra)
+
+    def extra_repr(self):
+        return ", ".join(f"{k}={v}" for k, v in self._extra.items())
+
+
+class ReLU(_Act):
+    _op = "relu"
+
+
+class ReLU6(_Act):
+    _op = "relu6"
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F["leaky_relu"](x, self.negative_slope)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 name=None, data_format="NCHW"):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=lambda s, d: __import__(
+                "jax.numpy", fromlist=["full"]).full(s, init, d))
+
+    def forward(self, x):
+        return F["prelu"](x, self.weight)
+
+
+class ELU(Layer):
+    def __init__(self, alpha=1.0, name=None):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        return F["elu"](x, self.alpha)
+
+
+class CELU(Layer):
+    def __init__(self, alpha=1.0, name=None):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        return F["celu"](x, self.alpha)
+
+
+class SELU(_Act):
+    _op = "selu"
+
+
+class GELU(Layer):
+    def __init__(self, approximate=False, name=None):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return F["gelu"](x, self.approximate)
+
+
+class Silu(_Act):
+    _op = "silu"
+
+
+class Swish(_Act):
+    _op = "swish"
+
+
+class Mish(_Act):
+    _op = "mish"
+
+
+class Sigmoid(_Act):
+    _op = "sigmoid"
+
+
+class LogSigmoid(_Act):
+    _op = "log_sigmoid"
+
+
+class Hardsigmoid(_Act):
+    _op = "hardsigmoid"
+
+
+class Hardswish(_Act):
+    _op = "hardswish"
+
+
+class Hardtanh(Layer):
+    def __init__(self, min=-1.0, max=1.0, name=None):  # noqa: A002
+        super().__init__()
+        self.min, self.max = min, max
+
+    def forward(self, x):
+        return F["hardtanh"](x, self.min, self.max)
+
+
+class Hardshrink(Layer):
+    def __init__(self, threshold=0.5, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F["hardshrink"](x, self.threshold)
+
+
+class Softshrink(Layer):
+    def __init__(self, threshold=0.5, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F["softshrink"](x, self.threshold)
+
+
+class Tanhshrink(_Act):
+    _op = "tanhshrink"
+
+
+class Softplus(Layer):
+    def __init__(self, beta=1.0, threshold=20.0, name=None):
+        super().__init__()
+        self.beta, self.threshold = beta, threshold
+
+    def forward(self, x):
+        return F["softplus"](x, self.beta, self.threshold)
+
+
+class Softsign(_Act):
+    _op = "softsign"
+
+
+class Tanh(_Act):
+    _op = "tanh"
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F["softmax"](x, self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F["log_softmax"](x, self.axis)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return F["maxout"](x, self.groups, self.axis)
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F["glu"](x, self.axis)

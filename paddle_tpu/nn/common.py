@@ -1,0 +1,194 @@
+"""Common layers: Linear, Embedding, Dropout, padding, upsampling.
+
+Reference parity: python/paddle/nn/layer/common.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import dispatch
+from ..tensor import Tensor
+from .initializer import get_initializer
+from .layer import Layer
+
+F = dispatch.wrapped_ops
+
+
+class Linear(Layer):
+    """y = x @ W + b, W: [in_features, out_features]
+    (reference: nn/layer/common.py Linear)."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=None if weight_attr is None else None)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((out_features,), is_bias=True,
+                                              attr=bias_attr)
+
+    def forward(self, x):
+        return F["linear"](x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in={self.in_features}, out={self.out_features}"
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Embedding(Layer):
+    """Lookup table (reference: nn/layer/common.py Embedding)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 padding_idx: Optional[int] = None, sparse: bool = False,
+                 weight_attr=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._padding_idx = padding_idx
+        init = None
+        if weight_attr is None:
+            init = get_initializer("normal")
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=init)
+
+    def forward(self, x):
+        return F["embedding"](x, self.weight, padding_idx=self._padding_idx)
+
+    def extra_repr(self):
+        return f"{self._num_embeddings}, {self._embedding_dim}"
+
+
+class Dropout(Layer):
+    def __init__(self, p: float = 0.5, axis=None,
+                 mode: str = "upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+
+    def forward(self, x):
+        return F["dropout"](x, p=self.p, training=self.training,
+                            mode=self.mode, axis=self.axis)
+
+    def extra_repr(self):
+        return f"p={self.p}"
+
+
+class Dropout2D(Layer):
+    def __init__(self, p: float = 0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F["dropout2d"](x, p=self.p, training=self.training)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p: float = 0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F["alpha_dropout"](x, p=self.p, training=self.training)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis: int = 1, stop_axis: int = -1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        return F["flatten"](x, self.start_axis, self.stop_axis)
+
+
+class Pad1D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL"):
+        super().__init__()
+        self._pad = padding if isinstance(padding, (list, tuple)) else \
+            [padding, padding]
+        self._mode, self._value, self._fmt = mode, value, data_format
+
+    def forward(self, x):
+        return F["pad"](x, self._pad, mode=self._mode, value=self._value,
+                        data_format=self._fmt)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self._pad = padding if isinstance(padding, (list, tuple)) else \
+            [padding] * 4
+        self._mode, self._value, self._fmt = mode, value, data_format
+
+    def forward(self, x):
+        return F["pad"](x, self._pad, mode=self._mode, value=self._value,
+                        data_format=self._fmt)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.mode, self.align_corners = mode, align_corners
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F["interpolate"](x, self.size, self.scale_factor, self.mode,
+                                self.align_corners, self.data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__(size, scale_factor, "nearest", False, data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__(size, scale_factor, "bilinear", True, data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor: int, data_format="NCHW"):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F["pixel_shuffle"](x, self.upscale_factor, self.data_format)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features), attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_features,), is_bias=True, attr=bias_attr)
+
+    def forward(self, x1, x2):
+        return F["bilinear"](x1, x2, self.weight, self.bias)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F["cosine_similarity"](x1, x2, axis=self.axis, eps=self.eps)

@@ -1,0 +1,144 @@
+"""Layer containers (reference: python/paddle/fluid/dygraph/container.py —
+Sequential, LayerList, ParameterList; layers.py LayerDict)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Iterable
+
+from ..tensor import Parameter
+from .layer import Layer
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], OrderedDict):
+            for name, layer in layers[0].items():
+                self.add_sublayer(name, layer)
+        else:
+            for i, item in enumerate(layers):
+                if isinstance(item, (list, tuple)) and len(item) == 2:
+                    self.add_sublayer(item[0], item[1])
+                else:
+                    self.add_sublayer(str(i), item)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        keys = list(self._sub_layers.keys())
+        return self._sub_layers[keys[idx]]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers: Iterable[Layer] = ()):
+        super().__init__()
+        for i, layer in enumerate(sublayers):
+            self.add_sublayer(str(i), layer)
+
+    def append(self, layer: Layer) -> "LayerList":
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def extend(self, layers) -> "LayerList":
+        for l in layers:
+            self.append(l)
+        return self
+
+    def insert(self, index: int, layer: Layer) -> None:
+        existing = list(self._sub_layers.values())
+        existing.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(existing):
+            self._sub_layers[str(i)] = l
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        n = len(self._sub_layers)
+        if idx < 0:
+            idx += n
+        return self._sub_layers[str(idx)]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters: Iterable[Parameter] = ()):
+        super().__init__()
+        for i, p in enumerate(parameters):
+            self.add_parameter(str(i), p)
+
+    def append(self, parameter: Parameter) -> "ParameterList":
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def __getitem__(self, idx):
+        n = len(self._parameters)
+        if idx < 0:
+            idx += n
+        return self._parameters[str(idx)]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def update(self, sublayers) -> None:
+        items = sublayers.items() if isinstance(sublayers, dict) else \
+            sublayers
+        for name, layer in items:
+            self.add_sublayer(name, layer)
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers

@@ -1,0 +1,44 @@
+"""paddle_tpu.nn.functional — eager-wrapped functional API.
+
+Reference parity: python/paddle/nn/functional/. Every function here is the
+autograd-aware wrapped version of the pure kernel in paddle_tpu.ops.
+"""
+
+from .. import dispatch as _dispatch
+
+_NN_OPS = [
+    # activations
+    "relu", "relu6", "leaky_relu", "prelu", "rrelu", "elu", "selu", "celu",
+    "gelu", "silu", "swish", "mish", "sigmoid", "log_sigmoid", "hardsigmoid",
+    "hardswish", "hardtanh", "hardshrink", "softshrink", "tanhshrink",
+    "softplus", "softsign", "tanh", "softmax", "log_softmax",
+    "gumbel_softmax", "maxout", "glu",
+    # linear/embedding/common
+    "linear", "embedding", "one_hot", "bilinear", "dropout", "dropout2d",
+    "dropout3d", "alpha_dropout", "label_smooth", "cosine_similarity",
+    "normalize", "sequence_mask", "pad", "interpolate", "upsample",
+    "pixel_shuffle", "pixel_unshuffle", "unfold", "grid_sample",
+    "affine_grid", "temporal_shift", "channel_shuffle",
+    # conv
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    # pooling
+    "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
+    "avg_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    # norm
+    "layer_norm", "rms_norm", "batch_norm", "instance_norm", "group_norm",
+    "local_response_norm",
+    # attention
+    "scaled_dot_product_attention",
+    # losses
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "smooth_l1_loss", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
+    "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
+    "square_error_cost", "log_loss", "sigmoid_focal_loss",
+]
+
+for _name in _NN_OPS:
+    globals()[_name] = _dispatch.wrapped_ops[_name]
+
+del _name

@@ -1,0 +1,210 @@
+"""Weight initializers.
+
+Reference parity: python/paddle/nn/initializer/ (Constant, Normal,
+TruncatedNormal, Uniform, XavierNormal/Uniform, KaimingNormal/Uniform,
+Assign) backed by fluid/initializer.py.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rng import next_key
+
+
+def _fans(shape: Tuple[int, ...]):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out, in, *spatial] (reference fan computation)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        arr = jnp.asarray(np.asarray(self.value), dtype=dtype)
+        return arr.reshape(shape)
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        sample_dtype = dtype if jnp.issubdtype(dtype, jnp.floating) else \
+            jnp.float32
+        return (self.mean + self.std * jax.random.normal(
+            next_key(), shape, dtype=jnp.float32)).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        out = jax.random.truncated_normal(next_key(), -2.0, 2.0, shape,
+                                          dtype=jnp.float32)
+        return (self.mean + self.std * out).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        return jax.random.uniform(next_key(), shape, dtype=jnp.float32,
+                                  minval=self.low,
+                                  maxval=self.high).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in: Optional[int] = None,
+                 fan_out: Optional[int] = None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(tuple(shape))
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(next_key(), shape, dtype=jnp.float32,
+                                  minval=-limit, maxval=limit).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in: Optional[int] = None,
+                 fan_out: Optional[int] = None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(tuple(shape))
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (std * jax.random.normal(next_key(), shape,
+                                        dtype=jnp.float32)).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in: Optional[int] = None,
+                 negative_slope: float = 0.0, nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _gain(self):
+        if self.nonlinearity == "leaky_relu":
+            return math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        return math.sqrt(2.0)
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(tuple(shape))
+        fi = self.fan_in if self.fan_in is not None else fi
+        limit = self._gain() * math.sqrt(3.0 / fi)
+        return jax.random.uniform(next_key(), shape, dtype=jnp.float32,
+                                  minval=-limit, maxval=limit).astype(dtype)
+
+
+class KaimingNormal(KaimingUniform):
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(tuple(shape))
+        fi = self.fan_in if self.fan_in is not None else fi
+        std = self._gain() / math.sqrt(fi)
+        return (std * jax.random.normal(next_key(), shape,
+                                        dtype=jnp.float32)).astype(dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        return (self.gain * jax.random.orthogonal(
+            next_key(), shape[0], shape=(),
+        )).astype(dtype) if len(shape) == 1 else (
+            self.gain * jax.nn.initializers.orthogonal()(
+                next_key(), shape, jnp.float32)).astype(dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups: int = 1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        out = np.zeros(shape, dtype=np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(min(oc // self.groups, ic)):
+                idx = (g * (oc // self.groups) + i, i, *centers)
+                out[idx] = 1.0
+        return jnp.asarray(out, dtype=dtype)
+
+
+_REGISTRY = {
+    "zeros": lambda: Constant(0.0),
+    "ones": lambda: Constant(1.0),
+    "constant": Constant,
+    "normal": Normal,
+    "truncated_normal": TruncatedNormal,
+    "uniform": Uniform,
+    "xavier_uniform": XavierUniform,
+    "xavier_normal": XavierNormal,
+    "kaiming_uniform": KaimingUniform,
+    "kaiming_normal": KaimingNormal,
+    "orthogonal": Orthogonal,
+}
+
+
+def get_initializer(spec) -> Initializer:
+    if isinstance(spec, Initializer):
+        return spec
+    if callable(spec):
+        return spec
+    if isinstance(spec, (int, float)):
+        return Constant(float(spec))
+    if isinstance(spec, str) and spec in _REGISTRY:
+        return _REGISTRY[spec]()
+    from ..core.enforce import InvalidArgumentError
+    raise InvalidArgumentError(f"Unknown initializer {spec!r}")
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference: fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = (get_initializer(initializer)
+                            if initializer is not None else None)
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip

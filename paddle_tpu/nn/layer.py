@@ -1,0 +1,400 @@
+"""Layer: the eager module system.
+
+TPU-native equivalent of the reference's dygraph Layer
+(reference: python/paddle/fluid/dygraph/layers.py:81 Layer — parameters,
+sublayers, buffers, forward pre/post hooks, state_dict/set_state_dict,
+train/eval, apply). Plus the TPU-specific extra: ``functional_state`` /
+``bind_state`` lift a stateful Layer into a pure function over a params
+pytree so the same eager-defined model runs under jit/pjit/grad — the
+equivalent of how the reference shares one kernel registry between dygraph
+and static modes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.enforce import InvalidArgumentError
+from ..tensor import Parameter, Tensor
+from .initializer import Initializer, get_initializer
+
+
+class HookRemoveHelper:
+    _next_id = 0
+
+    def __init__(self, hooks: Dict[int, Callable]):
+        self._hooks = hooks
+        self._id = HookRemoveHelper._next_id
+        HookRemoveHelper._next_id += 1
+
+    def remove(self) -> None:
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self._dtype = convert_dtype(dtype)
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._non_persistable_buffer_names: set = set()
+        self._forward_pre_hooks: Dict[int, Callable] = OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = OrderedDict()
+        self.training = True
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- construction helpers -------------------------------------------------
+
+    def create_parameter(self, shape, dtype=None, default_initializer=None,
+                         is_bias: bool = False, attr=None) -> Parameter:
+        dtype = convert_dtype(dtype or self._dtype)
+        init = default_initializer
+        if attr is not None and getattr(attr, "initializer", None) is not None:
+            init = attr.initializer
+        if init is None:
+            init = get_initializer("zeros" if is_bias else "xavier_uniform")
+        elif not isinstance(init, Initializer) and not callable(init):
+            init = get_initializer(init)
+        value = init(tuple(shape), dtype)
+        name = getattr(attr, "name", None) if attr is not None else None
+        p = Parameter(value, name=name)
+        if attr is not None and getattr(attr, "trainable", True) is False:
+            p.trainable = False
+            p.stop_gradient = True
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- attribute plumbing ---------------------------------------------------
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise InvalidArgumentError(
+                    "call Layer.__init__ before assigning parameters")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise InvalidArgumentError(
+                    "call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params[name] = None
+                    return
+                raise InvalidArgumentError(
+                    f"cannot overwrite parameter {name!r} with non-Parameter")
+            if buffers is not None and name in buffers:
+                buffers[name] = value if (value is None or isinstance(
+                    value, Tensor)) else Tensor(jnp.asarray(value))
+                return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"{self.__class__.__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name: str) -> None:
+        if name in self._parameters:
+            del self._parameters[name]
+        elif name in self._sub_layers:
+            del self._sub_layers[name]
+        elif name in self._buffers:
+            del self._buffers[name]
+            self._non_persistable_buffer_names.discard(name)
+        else:
+            object.__delattr__(self, name)
+
+    # -- call + hooks ---------------------------------------------------------
+
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        helper = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[helper._id] = hook
+        return helper
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        helper = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[helper._id] = hook
+        return helper
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError(
+            f"{self.__class__.__name__} must implement forward()")
+
+    # -- traversal ------------------------------------------------------------
+
+    def named_parameters(self, prefix: str = "", include_sublayers=True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else
+                       f"{prefix}.{name}"), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for item in layer.named_parameters(sub_prefix):
+                    yield item
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "") -> Iterator[Tuple[str, Tensor]]:
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        for lname, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{lname}" if prefix else lname
+            for item in layer.named_buffers(sub_prefix):
+                yield item
+
+    def buffers(self) -> List[Tensor]:
+        return [b for _, b in self.named_buffers()]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            for item in layer.named_sublayers(sub_prefix):
+                yield item
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self._sub_layers.items():
+            if l is not None:
+                yield l
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.children():
+            layer.apply(fn)
+        fn(self)
+        return self
+
+    # -- train/eval -----------------------------------------------------------
+
+    def train(self) -> "Layer":
+        self.training = True
+        for layer in self.sublayers():
+            layer.training = True
+        return self
+
+    def eval(self) -> "Layer":
+        self.training = False
+        for layer in self.sublayers():
+            layer.training = False
+        return self
+
+    # -- state dict -----------------------------------------------------------
+
+    def state_dict(self, include_sublayers=True, structured_name_prefix="",
+                   include_non_persistable_buffer=False
+                   ) -> "OrderedDict[str, Tensor]":
+        out: "OrderedDict[str, Tensor]" = OrderedDict()
+        for name, p in self.named_parameters():
+            out[structured_name_prefix + name] = p
+        for name, b in self.named_buffers():
+            leaf = name.rsplit(".", 1)[-1]
+            owner = self._locate_owner(name)
+            if (not include_non_persistable_buffer and owner is not None and
+                    leaf in owner._non_persistable_buffer_names):
+                continue
+            out[structured_name_prefix + name] = b
+        return out
+
+    def _locate_owner(self, dotted: str) -> Optional["Layer"]:
+        parts = dotted.split(".")[:-1]
+        layer: Layer = self
+        for p in parts:
+            nxt = layer._sub_layers.get(p)
+            if nxt is None:
+                return None
+            layer = nxt
+        return layer
+
+    def set_state_dict(self, state_dict, use_structured_name=True) -> None:
+        own = self.state_dict(include_non_persistable_buffer=True)
+        missing = []
+        for name, target in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            value = state_dict[name]
+            arr = value.value if isinstance(value, Tensor) else jnp.asarray(
+                np.asarray(value))
+            if tuple(arr.shape) != tuple(target.shape):
+                raise InvalidArgumentError(
+                    f"shape mismatch for {name}: {tuple(arr.shape)} vs "
+                    f"{tuple(target.shape)}")
+            target.value = arr.astype(target.dtype)
+        return missing
+
+    load_dict = set_state_dict
+
+    # -- dtype/device movement ------------------------------------------------
+
+    def to(self, device=None, dtype=None) -> "Layer":
+        if dtype is not None:
+            dtype = convert_dtype(dtype)
+            for p in self.parameters():
+                if jnp.issubdtype(p.dtype, jnp.floating):
+                    p.value = p.value.astype(dtype)
+            for b in self.buffers():
+                if b is not None and jnp.issubdtype(b.dtype, jnp.floating):
+                    b.value = b.value.astype(dtype)
+        return self
+
+    def astype(self, dtype) -> "Layer":
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # -- misc -----------------------------------------------------------------
+
+    def clear_gradients(self) -> None:
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self) -> str:
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self) -> str:
+        lines = []
+        extra = self.extra_repr()
+        for name, layer in self._sub_layers.items():
+            body = repr(layer).split("\n")
+            head = f"({name}): {body[0]}"
+            lines.append("  " + head)
+            lines.extend("  " + b for b in body[1:])
+        main = f"{self.__class__.__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+
+# -- functional capture -------------------------------------------------------
+
+def functional_state(layer: Layer, trainable_only: bool = False
+                     ) -> Dict[str, Any]:
+    """Extract raw-array state: {'params': {...}, 'buffers': {...}}."""
+    params = {n: p.value for n, p in layer.named_parameters()
+              if p is not None and (not trainable_only or p.trainable)}
+    buffers = {n: b.value for n, b in layer.named_buffers() if b is not None}
+    return {"params": params, "buffers": buffers}
+
+
+@contextlib.contextmanager
+def bind_state(layer: Layer, state: Dict[str, Any]):
+    """Temporarily substitute raw values (possibly tracers) into the layer's
+    Parameters/buffers; restore on exit. The layer's forward then computes
+    on the substituted values, making it a pure function of ``state``."""
+    named_p = dict(layer.named_parameters())
+    named_b = dict(layer.named_buffers())
+    saved_p = {n: p.value for n, p in named_p.items()}
+    saved_b = {n: b.value for n, b in named_b.items()}
+    try:
+        for n, v in state.get("params", {}).items():
+            if n in named_p:
+                named_p[n].value = v
+        for n, v in state.get("buffers", {}).items():
+            if n in named_b:
+                named_b[n].value = v
+        yield layer
+    finally:
+        for n, p in named_p.items():
+            p.value = saved_p[n]
+        for n, b in named_b.items():
+            b.value = saved_b[n]
+
+
+def functional_call(layer: Layer, state: Dict[str, Any], *args,
+                    training: Optional[bool] = None, rng_key=None,
+                    mutable_buffers: bool = False, **kwargs):
+    """Run layer.forward as a pure function of (state, *args).
+
+    Returns output raw arrays, or (output, new_buffers) if
+    ``mutable_buffers`` (for BatchNorm-style running stats under jit).
+    """
+    from ..autograd.engine import no_grad
+    from ..core import rng as rng_mod
+
+    prev_training = layer.training
+    if training is not None:
+        (layer.train() if training else layer.eval())
+    try:
+        with bind_state(layer, state), no_grad():
+            with rng_mod.key_scope(rng_key) if rng_key is not None else \
+                    contextlib.nullcontext():
+                out = layer(*args, **kwargs)
+            out_raw = jax.tree_util.tree_map(
+                lambda t: t.value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+            if mutable_buffers:
+                new_buffers = {n: b.value for n, b in layer.named_buffers()
+                               if b is not None}
+                return out_raw, new_buffers
+            return out_raw
+    finally:
+        if training is not None:
+            (layer.train() if prev_training else layer.eval())

@@ -1,0 +1,206 @@
+"""Normalization layers (reference: python/paddle/nn/layer/norm.py —
+BatchNorm1D/2D/3D, LayerNorm, GroupNorm, InstanceNorm, SyncBatchNorm,
+SpectralNorm; plus RMSNorm which the TPU build adds for LLMs)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import dispatch
+from ..tensor import Tensor
+from .layer import Layer
+
+F = dispatch.wrapped_ops
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), attr=weight_attr,
+                default_initializer=lambda s, d: jnp.ones(s, d))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((num_features,), is_bias=True,
+                                              attr=bias_attr)
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,))))
+
+    def forward(self, x):
+        training = self.training and not (self._use_global_stats is True)
+        out, new_m, new_v = F["batch_norm"](
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format)
+        if training:
+            self._mean.set_value(new_m.detach() if isinstance(
+                new_m, Tensor) else new_m)
+            self._variance.set_value(new_v.detach() if isinstance(
+                new_v, Tensor) else new_v)
+        return out
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BN. Under pjit/shard_map the batch axis is sharded and
+    XLA computes global statistics automatically when the reduction spans the
+    mesh; for eager DDP use, stats sync happens via the collective API
+    (reference: nn/layer/norm.py SyncBatchNorm over c_sync_* ops)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer: Layer) -> Layer:
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        if isinstance(layer, _BatchNormBase) and not isinstance(
+                layer, SyncBatchNorm):
+            new = SyncBatchNorm(layer._num_features, layer._momentum,
+                                layer._epsilon,
+                                data_format=layer._data_format)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            new._mean = layer._mean
+            new._variance = layer._variance
+            return new
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self._normalized_shape = tuple(normalized_shape)
+        self._epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                self._normalized_shape, attr=weight_attr,
+                default_initializer=lambda s, d: jnp.ones(s, d))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(self._normalized_shape,
+                                              is_bias=True, attr=bias_attr)
+
+    def forward(self, x):
+        return F["layer_norm"](x, self._normalized_shape, self.weight,
+                               self.bias, self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class RMSNorm(Layer):
+    """Root-mean-square norm (beyond-reference: standard for LLM blocks)."""
+
+    def __init__(self, hidden_size, epsilon=1e-6):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            (hidden_size,), default_initializer=lambda s, d: jnp.ones(s, d))
+
+    def forward(self, x):
+        return F["rms_norm"](x, self.weight, self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                (num_channels,), attr=weight_attr,
+                default_initializer=lambda s, d: jnp.ones(s, d))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((num_channels,), is_bias=True,
+                                              attr=bias_attr)
+
+    def forward(self, x):
+        return F["group_norm"](x, self._num_groups, self.weight, self.bias,
+                               self._epsilon, self._data_format)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        if weight_attr is False:
+            self.weight, self.bias = None, None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), attr=weight_attr,
+                default_initializer=lambda s, d: jnp.ones(s, d))
+            self.bias = self.create_parameter((num_features,), is_bias=True,
+                                              attr=bias_attr)
+
+    def forward(self, x):
+        return F["instance_norm"](x, self.weight, self.bias, self._epsilon)
+
+
+class InstanceNorm2D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr, data_format, name)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr, data_format, name)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F["local_response_norm"](x, self.size, self.alpha, self.beta,
+                                        self.k, self._data_format)

@@ -1,0 +1,188 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py —
+SimpleRNN/LSTM/GRU + cells)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dispatch
+from ..tensor import Tensor
+from .initializer import Uniform
+from .layer import Layer
+
+F = dispatch.wrapped_ops
+
+_GATES = {"SimpleRNN": 1, "LSTM": 4, "GRU": 3}
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.num_directions = 2 if direction in ("bidirect",
+                                                 "bidirectional") else 1
+        g = _GATES[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._weight_names = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_size = input_size if layer == 0 else \
+                    hidden_size * self.num_directions
+                suffix = f"l{layer}" + ("_reverse" if d == 1 else "")
+                names = [f"weight_ih_{suffix}", f"weight_hh_{suffix}",
+                         f"bias_ih_{suffix}", f"bias_hh_{suffix}"]
+                shapes = [(g * hidden_size, in_size),
+                          (g * hidden_size, hidden_size),
+                          (g * hidden_size,), (g * hidden_size,)]
+                for n, s in zip(names, shapes):
+                    self.add_parameter(n, self.create_parameter(
+                        s, default_initializer=init))
+                self._weight_names.extend(names)
+
+    def _weights(self):
+        return [self._parameters[n] for n in self._weight_names]
+
+    def forward(self, inputs, initial_states=None):
+        batch_axis = 1 if self.time_major else 0
+        b = inputs.shape[batch_axis]
+        n = self.num_layers * self.num_directions
+        if initial_states is None:
+            zero = F["zeros"]((n, b, self.hidden_size),
+                              dtype=str(inputs.dtype))
+            initial_states = (zero, zero) if self.mode == "LSTM" else zero
+        out, states = F["rnn"](inputs, initial_states, self._weights(),
+                               mode=self.mode, num_layers=self.num_layers,
+                               direction=self.direction,
+                               activation=self.activation,
+                               time_major=self.time_major)
+        return out, states
+
+    def extra_repr(self):
+        return (f"{self.input_size}, {self.hidden_size}, "
+                f"num_layers={self.num_layers}")
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("SimpleRNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class _CellBase(Layer):
+    def __init__(self, mode, input_size, hidden_size):
+        super().__init__()
+        g = _GATES[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.weight_ih = self.create_parameter((g * hidden_size, input_size),
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter((g * hidden_size, hidden_size),
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter((g * hidden_size,), is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter((g * hidden_size,), is_bias=True,
+                                             default_initializer=init)
+
+
+class SimpleRNNCell(_CellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kwargs):
+        super().__init__("SimpleRNN", input_size, hidden_size)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = F["zeros"]((inputs.shape[0], self.hidden_size),
+                                dtype=str(inputs.dtype))
+        h = F["simple_rnn_cell"](inputs, states, self.weight_ih,
+                                 self.weight_hh, self.bias_ih, self.bias_hh,
+                                 activation=self.activation)
+        return h, h
+
+
+class LSTMCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            z = F["zeros"]((inputs.shape[0], self.hidden_size),
+                           dtype=str(inputs.dtype))
+            states = (z, z)
+        h, c = states
+        h_new, c_new = F["lstm_cell"](inputs, h, c, self.weight_ih,
+                                      self.weight_hh, self.bias_ih,
+                                      self.bias_hh)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__("GRU", input_size, hidden_size)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = F["zeros"]((inputs.shape[0], self.hidden_size),
+                                dtype=str(inputs.dtype))
+        h = F["gru_cell"](inputs, states, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Wrap a cell into a recurrence over time (reference: nn/layer/rnn.py
+    RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None):
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        outs = []
+        for t in order:
+            xt = inputs[:, t] if time_axis == 1 else inputs[t]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        stacked = F["stack"](outs, axis=time_axis)
+        return stacked, states

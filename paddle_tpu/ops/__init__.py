@@ -9,7 +9,7 @@ eager dispatcher can enumerate them.
 import inspect as _inspect
 
 from . import creation, linalg, manipulation, math, nn_functional, random, \
-    search
+    rnn, search
 from .registry import OpDef, all_ops, get_op, has_op, register_op
 
 _DYNAMIC_SHAPE_OPS = {
@@ -26,7 +26,7 @@ _NON_DIFF_OPS = {
 
 def _auto_register():
     for mod in (creation, math, manipulation, search, linalg, random,
-                nn_functional):
+                nn_functional, rnn):
         short = mod.__name__.rsplit(".", 1)[-1]
         for name, fn in vars(mod).items():
             if name.startswith("_") or not callable(fn):

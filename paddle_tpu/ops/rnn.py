@@ -1,0 +1,122 @@
+"""Recurrent ops via lax.scan (pure functional).
+
+Reference parity: python/paddle/nn/layer/rnn.py RNN/LSTM/GRU semantics
+(operators/rnn_op + cudnn_lstm in the reference — here a single scan that
+XLA unrolls/pipelines on TPU; gate order i,f,g,o like the reference's LSTM).
+
+Weights per (layer, direction): [w_ih, w_hh, b_ih, b_hh] with
+w_ih: [G*H, in], w_hh: [G*H, H] (G=1 simple, 3 gru, 4 lstm).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _cell_simple(x, h, w_ih, w_hh, b_ih, b_hh, activation):
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    return act(x @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+
+
+def _cell_lstm(x, hc, w_ih, w_hh, b_ih, b_hh):
+    h, c = hc
+    gates = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _cell_gru(x, h, w_ih, w_hh, b_ih, b_hh):
+    # gate order r, z, n (reference GRUCell)
+    gi = x @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    return (1.0 - z) * n + z * h
+
+
+def _scan_direction(x_tbi, h0, weights, mode, activation, reverse=False):
+    w_ih, w_hh, b_ih, b_hh = weights
+
+    if mode == "LSTM":
+        def step(carry, xt):
+            new = _cell_lstm(xt, carry, w_ih, w_hh, b_ih, b_hh)
+            return new, new[0]
+    elif mode == "GRU":
+        def step(carry, xt):
+            new = _cell_gru(xt, carry, w_ih, w_hh, b_ih, b_hh)
+            return new, new
+    else:
+        def step(carry, xt):
+            new = _cell_simple(xt, carry, w_ih, w_hh, b_ih, b_hh, activation)
+            return new, new
+
+    final, outs = jax.lax.scan(step, h0, x_tbi, reverse=reverse)
+    return final, outs
+
+
+def rnn(x, initial_states, weights: Sequence, mode: str = "LSTM",
+        num_layers: int = 1, direction: str = "forward",
+        activation: str = "tanh", time_major: bool = False):
+    """Multi-layer (bi)directional recurrence.
+
+    x: [B, T, I] (or [T, B, I] when time_major). weights: flat list of
+    4 arrays per (layer, direction). Returns (outputs, final_states):
+    final_states shaped [num_layers*num_dirs, B, H] (tuple of h, c for
+    LSTM), matching the reference RNN API.
+    """
+    bidirect = direction in ("bidirect", "bidirectional")
+    num_dirs = 2 if bidirect else 1
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)  # [T, B, I]
+    b = x.shape[1]
+
+    h0c0 = initial_states
+    finals_h: List = []
+    finals_c: List = []
+    layer_in = x
+    for layer in range(num_layers):
+        outs_dirs = []
+        for d in range(num_dirs):
+            idx = layer * num_dirs + d
+            w = weights[idx * 4:(idx + 1) * 4]
+            if mode == "LSTM":
+                h_init = (h0c0[0][idx], h0c0[1][idx])
+            else:
+                h_init = h0c0[idx]
+            final, outs = _scan_direction(layer_in, h_init, w, mode,
+                                          activation, reverse=(d == 1))
+            if mode == "LSTM":
+                finals_h.append(final[0])
+                finals_c.append(final[1])
+            else:
+                finals_h.append(final)
+            outs_dirs.append(outs)
+        layer_in = outs_dirs[0] if num_dirs == 1 else jnp.concatenate(
+            outs_dirs, axis=-1)
+    outputs = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+    h_stack = jnp.stack(finals_h, axis=0)
+    if mode == "LSTM":
+        return outputs, (h_stack, jnp.stack(finals_c, axis=0))
+    return outputs, h_stack
+
+
+def simple_rnn_cell(x, h, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    return _cell_simple(x, h, w_ih, w_hh, b_ih, b_hh, activation)
+
+
+def lstm_cell(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    return _cell_lstm(x, (h, c), w_ih, w_hh, b_ih, b_hh)
+
+
+def gru_cell(x, h, w_ih, w_hh, b_ih, b_hh):
+    return _cell_gru(x, h, w_ih, w_hh, b_ih, b_hh)

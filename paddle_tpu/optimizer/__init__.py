@@ -1,0 +1,6 @@
+"""paddle_tpu.optimizer (reference parity: python/paddle/optimizer/)."""
+
+from . import lr
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
+                        LarsMomentum, Momentum, Optimizer, RMSProp)

@@ -1,0 +1,62 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class GradClipBase:
+    def apply(self, grads: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+    __call__ = apply
+
+
+class ClipGradByValue(GradClipBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def apply(self, grads):
+        return {k: jnp.clip(g, self.min, self.max)
+                for k, g in grads.items()}
+
+
+class ClipGradByNorm(GradClipBase):
+    """Per-tensor L2 norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, grads):
+        out = {}
+        for k, g in grads.items():
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out[k] = (g.astype(jnp.float32) * scale).astype(g.dtype)
+        return out
+
+
+class ClipGradByGlobalNorm(GradClipBase):
+    """Global L2 norm clip across all grads (the hybrid-parallel-aware
+    variant lives in distributed.fleet — it psums the squared norm over the
+    model-parallel mesh axes first)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def global_norm(self, grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in grads.values())
+        return jnp.sqrt(sq)
+
+    def apply(self, grads):
+        gnorm = self.global_norm(grads)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        return {k: (g.astype(jnp.float32) * scale).astype(g.dtype)
+                for k, g in grads.items()}

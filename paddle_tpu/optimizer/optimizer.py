@@ -1,0 +1,439 @@
+"""Optimizers.
+
+Reference parity: python/paddle/optimizer/ (Optimizer base, SGD, Momentum,
+Adagrad, Adadelta, RMSProp, Adam, AdamW, Adamax, Lamb) and
+operators/optimizers/ kernels (sgd_op, momentum_op, adam_op, lamb_op,
+lars_momentum_op).
+
+Design: each optimizer's update rule is a PURE function over
+(param, grad, state, lr) so one implementation serves both the eager
+``step()`` path (paddle-style: reads Parameter.grad, mutates values) and the
+functional ``apply_gradients`` path used inside jitted/pjit-sharded train
+steps — the same way the reference shares optimizer op kernels between
+dygraph and static modes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import InvalidArgumentError
+from ..tensor import Parameter, Tensor
+from .clip import GradClipBase
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip: Optional[GradClipBase] = None,
+                 name=None, multi_precision: bool = False):
+        if parameters is not None and isinstance(parameters, Parameter):
+            raise InvalidArgumentError("parameters must be a list")
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None \
+            else None
+        self._weight_decay = self._parse_wd(weight_decay)
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # per-param slot state, keyed by parameter name/index
+        self._state: Dict[str, Dict[str, Any]] = {}
+        self._global_step = 0
+        self._param_names: Dict[int, str] = {}
+
+    # -- helpers --------------------------------------------------------------
+
+    @staticmethod
+    def _parse_wd(weight_decay):
+        if weight_decay is None:
+            return 0.0
+        if isinstance(weight_decay, (int, float)):
+            return float(weight_decay)
+        # L2Decay-style object with a coeff attribute
+        return float(getattr(weight_decay, "_coeff",
+                             getattr(weight_decay, "coeff", 0.0)))
+
+    def _param_name(self, p: Parameter, idx: int) -> str:
+        if id(p) not in self._param_names:
+            self._param_names[id(p)] = p.name or f"param_{idx}"
+        return self._param_names[id(p)]
+
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float) -> None:
+        if isinstance(self._learning_rate, LRScheduler):
+            raise InvalidArgumentError(
+                "cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(
+            self._learning_rate, LRScheduler) else None
+
+    # -- pure update rule (override in subclasses) ----------------------------
+
+    def _init_state(self, value: jax.Array) -> Dict[str, jax.Array]:
+        return {}
+
+    def _update(self, value, grad, state, lr, step):
+        """Return (new_value, new_state). Must be pure/jit-safe."""
+        raise NotImplementedError
+
+    # -- eager path -----------------------------------------------------------
+
+    def step(self) -> None:
+        params = self._parameter_list
+        if params is None:
+            raise InvalidArgumentError(
+                "Optimizer constructed without parameters; pass parameters= "
+                "or use apply_gradients for the functional path")
+        self._global_step += 1
+        named = [(self._param_name(p, i), p) for i, p in enumerate(params)
+                 if p is not None and p.trainable]
+        grads = {n: p.grad.value for n, p in named if p.grad is not None}
+        if self._grad_clip is not None:
+            grads = self._grad_clip.apply(grads)
+        lr = self.get_lr()
+        for n, p in named:
+            if n not in grads:
+                continue
+            g = grads[n]
+            if n not in self._state:
+                self._state[n] = self._init_state(p.value)
+            if self._weight_decay and self._decoupled_wd is False:
+                g = g + self._weight_decay * p.value
+            new_v, new_s = self._update(p.value, g, self._state[n], lr,
+                                        self._global_step)
+            if self._weight_decay and self._decoupled_wd:
+                new_v = new_v - lr * self._weight_decay * p.value
+            p.value = new_v
+            self._state[n] = new_s
+
+    _decoupled_wd = False  # AdamW overrides
+
+    def clear_grad(self) -> None:
+        if self._parameter_list:
+            for p in self._parameter_list:
+                if p is not None:
+                    p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None) -> None:
+        """Eager convenience: backward + step (reference
+        Optimizer.minimize)."""
+        loss.backward()
+        self.step()
+
+    # -- functional path (jit/pjit) -------------------------------------------
+
+    def init(self, params: Dict[str, jax.Array]) -> Dict[str, Any]:
+        """Build the optimizer-state pytree for a params pytree."""
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        states = [self._init_state(v) for v in flat]
+        return {"slots": jax.tree_util.tree_unflatten(treedef, states),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, params, grads, opt_state,
+                        lr: Optional[Any] = None):
+        """Pure update: (params, grads, state) -> (new_params, new_state)."""
+        lr = self.get_lr() if lr is None else lr
+        step = opt_state["step"] + 1
+        if self._grad_clip is not None:
+            flat_g, gdef = jax.tree_util.tree_flatten(grads)
+            named = {str(i): g for i, g in enumerate(flat_g)}
+            named = self._grad_clip.apply(named)
+            flat_g = [named[str(i)] for i in range(len(flat_g))]
+            grads = jax.tree_util.tree_unflatten(gdef, flat_g)
+
+        flat_p, pdef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_s = pdef.flatten_up_to(opt_state["slots"])
+        new_p, new_s = [], []
+        for v, g, s in zip(flat_p, flat_g, flat_s):
+            if g is None:
+                new_p.append(v)
+                new_s.append(s)
+                continue
+            if self._weight_decay and not self._decoupled_wd:
+                g = g + self._weight_decay * v
+            nv, ns = self._update(v, g, s, lr, step)
+            if self._weight_decay and self._decoupled_wd:
+                nv = nv - lr * self._weight_decay * v
+            new_p.append(nv)
+            new_s.append(ns)
+        return (jax.tree_util.tree_unflatten(pdef, new_p),
+                {"slots": jax.tree_util.tree_unflatten(pdef, new_s),
+                 "step": step})
+
+    # -- state dict -----------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"global_step": self._global_step}
+        for pname, slots in self._state.items():
+            for sname, v in slots.items():
+                out[f"{pname}.{sname}"] = Tensor(v)
+        if self._lr_scheduler is not None:
+            out["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any]) -> None:
+        self._global_step = int(state.get("global_step", 0))
+        if "LR_Scheduler" in state and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state["LR_Scheduler"])
+        for key, v in state.items():
+            if key in ("global_step", "LR_Scheduler"):
+                continue
+            pname, _, sname = key.rpartition(".")
+            arr = v.value if isinstance(v, Tensor) else jnp.asarray(v)
+            self._state.setdefault(pname, {})[sname] = arr
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def _update(self, value, grad, state, lr, step):
+        return value - lr * grad.astype(value.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, value):
+        return {"velocity": jnp.zeros_like(value)}
+
+    def _update(self, value, grad, state, lr, step):
+        g = grad.astype(value.dtype)
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            new_value = value - lr * (g + self._momentum * v)
+        else:
+            new_value = value - lr * v
+        return new_value, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, value):
+        return {"moment": jnp.full_like(value, self._init_acc)}
+
+    def _update(self, value, grad, state, lr, step):
+        g = grad.astype(value.dtype)
+        m = state["moment"] + g * g
+        new_value = value - lr * g / (jnp.sqrt(m) + self._epsilon)
+        return new_value, {"moment": m}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_state(self, value):
+        return {"avg_squared_grad": jnp.zeros_like(value),
+                "avg_squared_update": jnp.zeros_like(value)}
+
+    def _update(self, value, grad, state, lr, step):
+        g = grad.astype(value.dtype)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
+        update = g * jnp.sqrt(state["avg_squared_update"] + self._epsilon) \
+            / jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * state["avg_squared_update"] + \
+            (1 - self._rho) * update * update
+        return value - lr * update, {"avg_squared_grad": asg,
+                                     "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_state(self, value):
+        s = {"mean_square": jnp.zeros_like(value),
+             "momentum": jnp.zeros_like(value)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(value)
+        return s
+
+    def _update(self, value, grad, state, lr, step):
+        g = grad.astype(value.dtype)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new_state = {"mean_square": ms, "momentum": mom}
+        if mg is not None:
+            new_state["mean_grad"] = mg
+        return value - mom, new_state
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, value):
+        acc_dtype = jnp.float32 if self._multi_precision else value.dtype
+        return {"moment1": jnp.zeros(value.shape, acc_dtype),
+                "moment2": jnp.zeros(value.shape, acc_dtype)}
+
+    def _update(self, value, grad, state, lr, step):
+        acc_dtype = state["moment1"].dtype
+        g = grad.astype(acc_dtype)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        step_f = jnp.asarray(step, jnp.float32)
+        bc1 = 1.0 - self._beta1 ** step_f
+        bc2 = 1.0 - self._beta2 ** step_f
+        m_hat = m / bc1
+        v_hat = v / bc2
+        upd = lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        new_value = (value.astype(acc_dtype) - upd).astype(value.dtype)
+        return new_value, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: optimizer/adamw.py)."""
+
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 apply_decay_param_fun=None, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, value):
+        return {"moment": jnp.zeros_like(value),
+                "inf_norm": jnp.zeros_like(value)}
+
+    def _update(self, value, grad, state, lr, step):
+        g = grad.astype(value.dtype)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        step_f = jnp.asarray(step, jnp.float32)
+        lr_t = lr / (1.0 - self._beta1 ** step_f)
+        new_value = value - lr_t * m / (u + self._epsilon)
+        return new_value, {"moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments for large-batch training
+    (reference: optimizer/lamb.py, operators/optimizers/lamb_op)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lamb_wd = lamb_weight_decay
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, value):
+        return {"moment1": jnp.zeros_like(value, jnp.float32),
+                "moment2": jnp.zeros_like(value, jnp.float32)}
+
+    def _update(self, value, grad, state, lr, step):
+        g = grad.astype(jnp.float32)
+        v32 = value.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        step_f = jnp.asarray(step, jnp.float32)
+        m_hat = m / (1.0 - self._beta1 ** step_f)
+        v_hat = v / (1.0 - self._beta2 ** step_f)
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + self._lamb_wd * v32
+        w_norm = jnp.linalg.norm(v32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_value = (v32 - lr * trust * r).astype(value.dtype)
+        return new_value, {"moment1": m, "moment2": v}
+
+
+class LarsMomentum(Optimizer):
+    """LARS (reference: fluid/optimizer.py LarsMomentumOptimizer,
+    operators/optimizers/lars_momentum_op.cu)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,
+                 grad_clip=None, epsilon=1e-9, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _init_state(self, value):
+        return {"velocity": jnp.zeros_like(value)}
+
+    def _update(self, value, grad, state, lr, step):
+        g = grad.astype(value.dtype)
+        w_norm = jnp.linalg.norm(value.astype(jnp.float32))
+        g_norm = jnp.linalg.norm(g.astype(jnp.float32))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm /
+            (g_norm + self._lars_wd * w_norm + self._epsilon), 1.0)
+        v = self._momentum * state["velocity"] + lr * local_lr * (
+            g + self._lars_wd * value)
+        return value - v, {"velocity": v}

@@ -1,0 +1,266 @@
+"""Layer system + core layer tests.
+
+Mirrors reference tests: test_layers.py, test_imperative_layers.py,
+test_transformer_api.py, test_rnn_nets.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+def test_linear_forward_shape_and_grad():
+    layer = nn.Linear(4, 3)
+    x = pt.randn((2, 4))
+    y = layer(x)
+    assert y.shape == (2, 3)
+    loss = y.sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+    assert layer.weight.grad.shape == (4, 3)
+    assert layer.bias.grad.shape == (3,)
+
+
+def test_layer_parameter_registration():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.act = nn.ReLU()
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    y = net(pt.randn((3, 4)))
+    assert y.shape == (3, 2)
+    y.sum().backward()
+    assert all(p.grad is not None for p in net.parameters())
+
+
+def test_state_dict_roundtrip():
+    net = nn.Linear(3, 3)
+    sd = net.state_dict()
+    net2 = nn.Linear(3, 3)
+    net2.set_state_dict(sd)
+    x = pt.randn((2, 3))
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_train_eval_dropout():
+    d = nn.Dropout(0.5)
+    x = pt.ones((100,))
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), np.ones(100))
+    d.train()
+    out = d(x).numpy()
+    assert (out == 0).any() and (out != 0).any()
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    y = seq(pt.randn((2, 4)))
+    assert y.shape == (2, 2)
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
+
+
+def test_forward_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h1 = layer.register_forward_pre_hook(
+        lambda l, inp: calls.append("pre"))
+    h2 = layer.register_forward_post_hook(
+        lambda l, inp, out: calls.append("post"))
+    layer(pt.randn((1, 2)))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    layer(pt.randn((1, 2)))
+    assert calls == ["pre", "post"]
+
+
+def test_conv2d_matches_manual():
+    conv = nn.Conv2D(1, 1, 3, bias_attr=False)
+    x = pt.ones((1, 1, 5, 5))
+    y = conv(x)
+    assert y.shape == (1, 1, 3, 3)
+    expect = float(np.asarray(conv.weight.numpy()).sum())
+    np.testing.assert_allclose(y.numpy()[0, 0, 1, 1], expect, rtol=1e-5)
+
+
+def test_conv2d_grad():
+    conv = nn.Conv2D(2, 4, 3, padding=1)
+    x = pt.randn((2, 2, 8, 8))
+    y = conv(x)
+    assert y.shape == (2, 4, 8, 8)
+    y.sum().backward()
+    assert conv.weight.grad.shape == conv.weight.shape
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm2D(3)
+    x = pt.to_tensor(np.random.default_rng(0).normal(
+        2.0, 3.0, (4, 3, 5, 5)).astype(np.float32))
+    bn.train()
+    bn(x)
+    # running mean moved toward 2.0
+    assert abs(float(bn._mean.numpy().mean()) - 0.2) < 0.1
+    bn.eval()
+    out = bn(x)
+    assert out.shape == (4, 3, 5, 5)
+
+
+def test_layernorm_normalizes():
+    ln = nn.LayerNorm(16)
+    x = pt.randn((4, 16)) * 5 + 3
+    out = ln(x).numpy()
+    np.testing.assert_allclose(out.mean(-1), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), np.ones(4), atol=1e-2)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = pt.to_tensor(np.array([[1, 2, 0]]))
+    out = emb(idx)
+    assert out.shape == (1, 3, 4)
+    np.testing.assert_allclose(out.numpy()[0, 2], np.zeros(4))
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_pools():
+    x = pt.randn((1, 2, 8, 8))
+    assert nn.MaxPool2D(2)(x).shape == (1, 2, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (1, 2, 4, 4)
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == (1, 2, 1, 1)
+    x1 = pt.ones((1, 2, 4, 4))
+    np.testing.assert_allclose(nn.AvgPool2D(2)(x1).numpy(),
+                               np.ones((1, 2, 2, 2)), rtol=1e-6)
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = pt.randn((2, 5, 16))
+    out = mha(q, q, q)
+    assert out.shape == (2, 5, 16)
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    enc_layer = nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    src = pt.randn((2, 6, 16))
+    out = enc(src)
+    assert out.shape == (2, 6, 16)
+    # stacked layers must not share parameters
+    p0 = enc.layers[0].linear1.weight.numpy()
+    p1 = enc.layers[1].linear1.weight.numpy()
+    assert not np.allclose(p0, p1)
+
+
+def test_transformer_full():
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32,
+                           dropout=0.0)
+    src = pt.randn((2, 4, 16))
+    tgt = pt.randn((2, 3, 16))
+    out = model(src, tgt)
+    assert out.shape == (2, 3, 16)
+
+
+def test_lstm():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = pt.randn((3, 5, 4))
+    out, (h, c) = lstm(x)
+    assert out.shape == (3, 5, 8)
+    assert h.shape == (2, 3, 8)
+    assert c.shape == (2, 3, 8)
+    out.sum().backward()
+    assert lstm._parameters["weight_ih_l0"].grad is not None
+
+
+def test_gru_bidirectional():
+    gru = nn.GRU(4, 8, direction="bidirect")
+    x = pt.randn((2, 5, 4))
+    out, h = gru(x)
+    assert out.shape == (2, 5, 16)
+    assert h.shape == (2, 2, 8)
+
+
+def test_lstm_cell():
+    cell = nn.LSTMCell(4, 8)
+    x = pt.randn((2, 4))
+    h, (h2, c2) = cell(x)
+    assert h.shape == (2, 8)
+    assert c2.shape == (2, 8)
+
+
+def test_loss_layers():
+    ce = nn.CrossEntropyLoss()
+    logits = pt.randn((4, 10), dtype="float32")
+    logits.stop_gradient = False
+    labels = pt.to_tensor(np.array([1, 2, 3, 4]))
+    loss = ce(logits, labels)
+    assert loss.shape == ()
+    loss.backward()
+    assert logits.grad is not None
+    # cross-check vs manual log-softmax
+    lp = np.asarray(pt.log_softmax(logits.detach(), axis=-1).numpy())
+    expect = -lp[np.arange(4), [1, 2, 3, 4]].mean()
+    np.testing.assert_allclose(float(loss.numpy()), expect, rtol=1e-5)
+
+    mse = nn.MSELoss()
+    a, b = pt.randn((3, 3)), pt.randn((3, 3))
+    np.testing.assert_allclose(
+        float(mse(a, b).numpy()),
+        np.mean((a.numpy() - b.numpy()) ** 2), rtol=1e-5)
+
+
+def test_functional_call_pure():
+    from paddle_tpu.nn import functional_call, functional_state
+    import jax
+
+    net = nn.Linear(4, 2)
+    state = functional_state(net)
+    x = np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32)
+
+    def loss_fn(params):
+        out = functional_call(net, {"params": params, "buffers": {}},
+                              pt.to_tensor(x))
+        return out.sum()
+
+    grads = jax.grad(loss_fn)(state["params"])
+    # compare against the eager tape
+    y = net(pt.to_tensor(x))
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(grads["weight"]),
+                               net.weight.grad.numpy(), rtol=1e-5)
+
+
+def test_functional_call_jit_consistency():
+    import jax
+
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    from paddle_tpu.nn import functional_call, functional_state
+    state = functional_state(net)
+    x = np.random.default_rng(1).standard_normal((3, 4)).astype(np.float32)
+
+    @jax.jit
+    def fwd(params, xv):
+        return functional_call(net, {"params": params, "buffers": {}},
+                               pt.Tensor(xv))
+
+    out_jit = fwd(state["params"], x)
+    out_eager = net(pt.to_tensor(x)).numpy()
+    np.testing.assert_allclose(np.asarray(out_jit), out_eager, rtol=1e-5,
+                               atol=1e-6)

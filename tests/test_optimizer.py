@@ -1,0 +1,185 @@
+"""Optimizer tests (reference: test_sgd_op.py, test_adam_op.py,
+test_momentum_op.py, lr scheduler tests test_lr_scheduler.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer as opt
+
+
+def _quadratic_step(optimizer_ctor, steps=60, **kw):
+    w = pt.Parameter(np.array([5.0, -3.0], dtype=np.float32))
+    o = optimizer_ctor(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    return np.abs(w.numpy()).max()
+
+
+@pytest.mark.parametrize("ctor,kw", [
+    (opt.SGD, dict(learning_rate=0.1)),
+    (opt.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (opt.Adam, dict(learning_rate=0.3)),
+    (opt.AdamW, dict(learning_rate=0.3, weight_decay=0.01)),
+    (opt.RMSProp, dict(learning_rate=0.1)),
+    (opt.Adagrad, dict(learning_rate=1.0)),
+    (opt.Adamax, dict(learning_rate=0.3)),
+    (opt.Lamb, dict(learning_rate=0.1)),
+    (opt.Adadelta, dict(learning_rate=10.0, steps=400)),
+    (opt.LarsMomentum, dict(learning_rate=0.5, lars_coeff=0.5)),
+], ids=lambda v: getattr(v, "__name__", ""))
+def test_optimizers_converge_quadratic(ctor, kw):
+    final = _quadratic_step(ctor, **kw)
+    assert final < 0.5, f"{ctor.__name__} failed to descend: {final}"
+
+
+def test_sgd_exact_update():
+    w = pt.Parameter(np.array([1.0], dtype=np.float32))
+    o = opt.SGD(learning_rate=0.1, parameters=[w])
+    (w * 3.0).sum().backward()
+    o.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 3.0], rtol=1e-6)
+
+
+def test_adam_matches_manual():
+    w0 = np.array([2.0], dtype=np.float32)
+    g = np.array([0.5], dtype=np.float32)
+    w = pt.Parameter(w0)
+    o = opt.Adam(learning_rate=0.1, parameters=[w])
+    loss = (w * 0.5).sum()
+    loss.backward()
+    o.step()
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mh = m / (1 - 0.9)
+    vh = v / (1 - 0.999)
+    expect = w0 - 0.1 * mh / (np.sqrt(vh) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), expect, rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    w1 = pt.Parameter(np.array([3.0], dtype=np.float32))
+    w2 = pt.Parameter(np.array([4.0], dtype=np.float32))
+    clip = opt.ClipGradByGlobalNorm(1.0)
+    o = opt.SGD(learning_rate=1.0, parameters=[w1, w2], grad_clip=clip)
+    ((w1 * 3.0) + (w2 * 4.0)).sum().backward()
+    o.step()
+    # grads (3,4): global norm 5 -> scaled to (0.6, 0.8)
+    np.testing.assert_allclose(w1.numpy(), [3.0 - 0.6], rtol=1e-5)
+    np.testing.assert_allclose(w2.numpy(), [4.0 - 0.8], rtol=1e-5)
+
+
+def test_weight_decay_l2():
+    w = pt.Parameter(np.array([1.0], dtype=np.float32))
+    o = opt.SGD(learning_rate=0.1, parameters=[w], weight_decay=0.1)
+    (w * 0.0).sum().backward()
+    o.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 0.1], rtol=1e-6)
+
+
+def test_functional_apply_gradients_jit():
+    import jax
+    import jax.numpy as jnp
+
+    o = opt.Adam(learning_rate=0.1)
+    params = {"w": jnp.array([5.0, -3.0]), "b": jnp.array([1.0])}
+    state = o.init(params)
+
+    @jax.jit
+    def train_step(params, state):
+        grads = jax.grad(lambda p: jnp.sum(p["w"] ** 2 + p["b"] ** 2))(
+            params)
+        return o.apply_gradients(params, grads, state)
+
+    for _ in range(80):
+        params, state = train_step(params, state)
+    assert float(jnp.abs(params["w"]).max()) < 0.3
+    assert int(state["step"]) == 80
+
+
+def test_eager_vs_functional_parity():
+    import jax.numpy as jnp
+    w0 = np.random.default_rng(0).standard_normal(4).astype(np.float32)
+    # eager
+    w = pt.Parameter(w0.copy())
+    o1 = opt.Adam(learning_rate=0.01, parameters=[w])
+    for _ in range(5):
+        (w * w).sum().backward()
+        o1.step()
+        o1.clear_grad()
+    # functional
+    o2 = opt.Adam(learning_rate=0.01)
+    params = {"w": jnp.asarray(w0)}
+    st = o2.init(params)
+    for _ in range(5):
+        grads = {"w": 2 * params["w"]}
+        params, st = o2.apply_gradients(params, grads, st)
+    np.testing.assert_allclose(w.numpy(), np.asarray(params["w"]),
+                               rtol=1e-5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = pt.Parameter(np.array([1.0, 2.0], dtype=np.float32), name="w")
+    o = opt.Adam(learning_rate=0.1, parameters=[w])
+    (w * w).sum().backward()
+    o.step()
+    sd = o.state_dict()
+    o2 = opt.Adam(learning_rate=0.1, parameters=[w])
+    o2.set_state_dict(sd)
+    assert o2._global_step == 1
+    np.testing.assert_allclose(np.asarray(o2._state["w"]["moment1"]),
+                               np.asarray(o._state["w"]["moment1"]))
+
+
+def test_lr_schedulers():
+    s = opt.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(6):
+        lrs.append(s())
+        s.step()
+    np.testing.assert_allclose(lrs, [1.0, 1.0, 0.5, 0.5, 0.25, 0.25])
+
+    cos = opt.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    assert abs(cos() - 1.0) < 1e-6
+    for _ in range(10):
+        cos.step()
+    assert cos() < 1e-6
+
+    warm = opt.lr.LinearWarmup(learning_rate=1.0, warmup_steps=4,
+                               start_lr=0.0, end_lr=1.0)
+    vals = []
+    for _ in range(5):
+        vals.append(warm())
+        warm.step()
+    np.testing.assert_allclose(vals, [0.0, 0.25, 0.5, 0.75, 1.0])
+
+    noam = opt.lr.NoamDecay(d_model=64, warmup_steps=10, learning_rate=1.0)
+    prev = 0
+    for i in range(10):
+        assert noam() >= prev or i == 0
+        prev = noam()
+        noam.step()
+
+
+def test_scheduler_with_optimizer():
+    w = pt.Parameter(np.array([1.0], dtype=np.float32))
+    sched = opt.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.1)
+    o = opt.SGD(learning_rate=sched, parameters=[w])
+    (w * 1.0).sum().backward()
+    o.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.1], rtol=1e-6)
+    sched.step()
+    o.clear_grad()
+    (w * 1.0).sum().backward()
+    o.step()
+    np.testing.assert_allclose(w.numpy(), [0.9 - 0.01], rtol=1e-5)
+
+
+def test_reduce_on_plateau():
+    s = opt.lr.ReduceOnPlateau(learning_rate=1.0, patience=1, factor=0.5)
+    for loss in [1.0, 1.0, 1.0, 1.0]:
+        s.step(loss)
+    assert s() == 0.5
