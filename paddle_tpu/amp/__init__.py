@@ -1,0 +1,13 @@
+"""Automatic mixed precision — bf16-first.
+
+Reference parity: python/paddle/amp/ (auto_cast over
+fluid/dygraph/amp/auto_cast.py:93 amp_guard white/black op lists;
+GradScaler over amp/loss_scaler.py:27 AmpScaler;
+static fp16 transform contrib/mixed_precision/fp16_utils.py). On TPU the
+low-precision dtype is bfloat16, which needs no loss scaling — GradScaler
+degrades to a transparent pass-through unless fp16 is forced.
+"""
+
+from .auto_cast import (amp_state, auto_cast, black_list as AMP_BLACK_LIST,
+                        decorate, white_list as AMP_WHITE_LIST)
+from .grad_scaler import AmpScaler, GradScaler

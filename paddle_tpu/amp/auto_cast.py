@@ -1,0 +1,101 @@
+"""auto_cast: eager autocast context.
+
+Reference parity: fluid/dygraph/amp/auto_cast.py:93 amp_guard +
+imperative/amp_auto_cast.cc:27-55 white/black lists. The dispatch layer
+consults amp_state() per op: white-list ops (MXU-bound matmul/conv) cast
+floating inputs down to the amp dtype; black-list ops (numerically
+sensitive) cast up to float32.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+
+# reference white list (imperative/amp_auto_cast.cc): matmul/conv-class ops
+white_list: Set[str] = {
+    "matmul", "mm", "bmm", "dot", "addmm", "linear", "conv1d", "conv2d",
+    "conv3d", "conv1d_transpose", "conv2d_transpose", "einsum",
+    "scaled_dot_product_attention", "flash_attention",
+}
+
+# reference black list: numerically-sensitive ops stay fp32
+black_list: Set[str] = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "mean", "sum",
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "layer_norm", "rms_norm", "batch_norm", "instance_norm", "group_norm",
+    "local_response_norm", "nll_loss", "binary_cross_entropy", "kl_div",
+    "binary_cross_entropy_with_logits", "mse_loss", "cosine_similarity",
+    "norm", "var", "std", "logcumsumexp", "erf", "erfinv", "pow",
+}
+
+
+class _AmpTLS(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white: Set[str] = set()
+        self.custom_black: Set[str] = set()
+
+
+_tls = _AmpTLS()
+
+
+def amp_state() -> Optional[_AmpTLS]:
+    return _tls if _tls.enabled else None
+
+
+def effective_lists():
+    return (white_list | _tls.custom_white) - _tls.custom_black, \
+        (black_list | _tls.custom_black) - _tls.custom_white
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1",
+              dtype: str = "bfloat16"):
+    """Enable autocast for the enclosed eager region
+    (reference: paddle.amp.auto_cast)."""
+    prev = (_tls.enabled, _tls.dtype, _tls.level, _tls.custom_white,
+            _tls.custom_black)
+    _tls.enabled = enable
+    _tls.dtype = convert_dtype(dtype)
+    _tls.level = level
+    _tls.custom_white = set(custom_white_list or ())
+    _tls.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_tls.enabled, _tls.dtype, _tls.level, _tls.custom_white,
+         _tls.custom_black) = prev
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the amp dtype
+    (reference: paddle.amp.decorate). Returns (models, optimizers)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+def amp_target_dtype(name: str):
+    """Dispatch hook: dtype this op's float inputs should be cast to under
+    the active autocast scope, or None to run as-is."""
+    wl, bl = effective_lists()
+    if name in wl:
+        return _tls.dtype
+    if name in bl and _tls.level == "O1":
+        return jnp.float32
+    return None

@@ -63,6 +63,12 @@ def call_fn(fn: Callable, name: str, differentiable: bool, args, kwargs):
               any(not leaves[i].stop_gradient and
                   _is_diff_dtype(leaves[i]) for i in tensor_idx))
 
+    from .amp.auto_cast import amp_state, amp_target_dtype
+    if amp_state() is not None:
+        target = amp_target_dtype(name)
+        if target is not None:
+            fn = _amp_wrap(fn, target)
+
     bench = get_flag("benchmark")
     t0 = time.perf_counter() if bench else 0.0
 
@@ -99,6 +105,23 @@ def call_fn(fn: Callable, name: str, differentiable: bool, args, kwargs):
         stat(f"op_us/{name}").add(int((time.perf_counter() - t0) * 1e6))
     stat("eager_op_calls").add(1)
     return out
+
+
+def _amp_wrap(fn: Callable, target) -> Callable:
+    """Cast floating array inputs to ``target`` inside the kernel, so the
+    cast participates in the vjp (grads flow back in the original dtype)."""
+
+    def casted(*args, **kwargs):
+        def c(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(
+                    jnp.result_type(x), jnp.floating) and x.dtype != target:
+                return jnp.asarray(x).astype(target)
+            return x
+        args = jax.tree_util.tree_map(c, args)
+        kwargs = jax.tree_util.tree_map(c, kwargs)
+        return fn(*args, **kwargs)
+
+    return casted
 
 
 def _wrap_outputs(out_raw, node, name):

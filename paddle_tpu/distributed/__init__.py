@@ -1,0 +1,7 @@
+"""paddle_tpu.distributed — collective API, mesh topology, fleet.
+
+Reference parity: python/paddle/distributed/.
+"""
+
+from .env import (ParallelEnv, device_count, get_rank, get_world_size,
+                  init_parallel_env, local_device_count)

@@ -1,0 +1,231 @@
+"""Collective communication API.
+
+TPU-native equivalent of the reference's collective layer
+(reference: python/paddle/distributed/collective.py:205 all_reduce etc.;
+C++ kernels operators/collective/c_allreduce_op.h and friends; ring
+management platform/collective_helper.h:68). The reference's ring_id
+becomes a named mesh axis; inside a jitted/shard_mapped computation these
+lower to XLA collectives over ICI/DCN (psum/all_gather/ppermute/
+all_to_all) and XLA overlaps them with compute — no manual
+calc/comm-stream sync ops needed (the reference's c_sync_*_stream ops have
+no equivalent because the compiler schedules).
+
+Outside a trace (eager, single-process SPMD) arrays are global: group-wide
+reductions are identities w.r.t. the data the process already holds, and
+multi-host eager transfers go through multihost_utils.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax._src import core as _jax_core
+
+from ..tensor import Tensor
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _in_trace() -> bool:
+    return not _jax_core.trace_state_clean()
+
+
+def _unwrap(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _rewrap(x, out):
+    return Tensor(out, stop_gradient=True) if isinstance(x, Tensor) else out
+
+
+def _axis(group):
+    """Resolve a 'group' to a mesh axis name (reference ring_id -> axis)."""
+    if group is None:
+        return "dp"
+    if isinstance(group, str):
+        return group
+    return getattr(group, "axis_name", "dp")
+
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group=None,
+               sync_op: bool = True):
+    """In-trace: psum/pmax/pmin over the group axis. Eager single-process:
+    identity (the process holds the global array)."""
+    x = _unwrap(tensor)
+    if _in_trace():
+        axis = _axis(group)
+        fn = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+              ReduceOp.MIN: jax.lax.pmin,
+              ReduceOp.AVG: jax.lax.pmean}.get(op)
+        if fn is None:  # PROD via exp/log-free fallback
+            out = jax.lax.all_gather(x, axis)
+            out = jnp.prod(out, axis=0)
+        else:
+            out = fn(x, axis)
+        return _rewrap(tensor, out)
+    if isinstance(tensor, Tensor):
+        return tensor
+    return x
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
+               axis: int = 0):
+    """In-trace gather along the group axis. Reference signature
+    all_gather(tensor_list, tensor) appends per-rank shards to the list;
+    the jax-native form returns the concatenated array."""
+    if tensor is None:
+        x = _unwrap(tensor_or_list)
+        if _in_trace():
+            out = jax.lax.all_gather(x, _axis(group), axis=axis,
+                                     tiled=True)
+            return _rewrap(tensor_or_list, out)
+        return tensor_or_list
+    # reference-style (list, tensor) call
+    x = _unwrap(tensor)
+    if _in_trace():
+        out = jax.lax.all_gather(x, _axis(group))
+        n = out.shape[0]
+        tensor_or_list.extend(_rewrap(tensor, out[i]) for i in range(n))
+    else:
+        tensor_or_list.append(tensor)
+    return tensor_or_list
+
+
+def reduce_scatter(tensor, op: str = ReduceOp.SUM, group=None,
+                   axis: int = 0):
+    x = _unwrap(tensor)
+    if _in_trace():
+        out = jax.lax.psum_scatter(x, _axis(group), scatter_dimension=axis,
+                                   tiled=True)
+        return _rewrap(tensor, out)
+    return tensor
+
+
+def broadcast(tensor, src: int = 0, group=None, sync_op=True):
+    x = _unwrap(tensor)
+    if _in_trace():
+        axis = _axis(group)
+        # select src's value on every member of the group
+        gathered = jax.lax.all_gather(x, axis)
+        return _rewrap(tensor, gathered[src])
+    return tensor
+
+
+def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None):
+    # SPMD collectives are symmetric; reduce == all_reduce w.r.t. content
+    return all_reduce(tensor, op, group)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None):
+    if _in_trace():
+        axis = _axis(group)
+        idx = jax.lax.axis_index(axis)
+        stacked = jnp.stack([_unwrap(t) for t in tensor_list]) \
+            if tensor_list else _unwrap(tensor)
+        picked = jax.lax.dynamic_index_in_dim(stacked, idx, keepdims=False)
+        return _rewrap(tensor, picked)
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None,
+             split_axis: int = 0, concat_axis: int = 0):
+    """In-trace all_to_all (the exchange primitive behind expert and
+    Ulysses sequence parallelism; reference only ships the raw op
+    operators/collective/alltoall_op.cc)."""
+    x = _unwrap(in_tensor_list) if not isinstance(in_tensor_list, list) \
+        else jnp.concatenate([_unwrap(t) for t in in_tensor_list],
+                             axis=split_axis)
+    if _in_trace():
+        out = jax.lax.all_to_all(x, _axis(group), split_axis=split_axis,
+                                 concat_axis=concat_axis, tiled=True)
+        return Tensor(out) if isinstance(in_tensor_list, Tensor) else out
+    return in_tensor_list
+
+
+def send(tensor, dst: int, group=None):
+    """P2P along the pipeline axis via ppermute (reference send_v2)."""
+    x = _unwrap(tensor)
+    if _in_trace():
+        axis = _axis(group or "pp")
+        n = jax.lax.axis_size(axis)
+        out = jax.lax.ppermute(x, axis,
+                               [(i, (i + 1) % n) for i in range(n)])
+        return _rewrap(tensor, out)
+    return tensor
+
+
+def recv(tensor, src: int, group=None):
+    return send(tensor, src, group)
+
+
+def p2p_shift(x, axis_name: str = "pp", shift: int = 1):
+    """Shift values along a mesh axis (the pipeline hop primitive)."""
+    if not _in_trace():
+        return x
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(_unwrap(x), axis_name, perm)
+
+
+def barrier(group=None):
+    """Host-level sync point (reference barrier_op). In SPMD jit programs
+    barriers are implicit in data dependencies; eager multi-host uses the
+    coordination service."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def get_group(axis_name: str = "dp"):
+    class _Group:
+        def __init__(self, name):
+            self.axis_name = name
+            self.nranks = -1
+    return _Group(axis_name)
+
+
+# -- TP helper collectives (reference: collective.py:747-919 c_identity /
+#    c_concat / c_split / mp_allreduce) -------------------------------------
+
+def _c_identity(x, group=None):
+    """Forward identity, backward all-reduce (column-parallel input)."""
+    axis = _axis(group or "mp")
+
+    @jax.custom_vjp
+    def ident(v):
+        return v
+
+    def fwd(v):
+        return v, None
+
+    def bwd(_, g):
+        return (jax.lax.psum(g, axis) if _in_trace() else g,)
+
+    ident.defvjp(fwd, bwd)
+    return ident(x)
+
+
+def _mp_allreduce(x, group=None):
+    """Forward all-reduce, backward identity (row-parallel output)."""
+    axis = _axis(group or "mp")
+
+    @jax.custom_vjp
+    def ar(v):
+        return jax.lax.psum(v, axis) if _in_trace() else v
+
+    def fwd(v):
+        return ar(v), None
+
+    def bwd(_, g):
+        return (g,)
+
+    ar.defvjp(fwd, bwd)
+    return ar(x)

@@ -1,0 +1,112 @@
+"""DistributedStrategy — the single config object for all distributed /
+optimization features.
+
+Reference parity: python/paddle/distributed/fleet/base/
+distributed_strategy.py:104 (protobuf-backed facade; properties amp:341,
+recompute:428, sharding:740, pipeline:902, tensor_parallel:966,
+hybrid_configs:1021, gradient_merge:1257, localsgd:1055, lamb/lars,
+a_sync:258). Here a plain attribute bag with the same property surface;
+the"meta-optimizer" program rewrites become sharding/remat choices inside
+the fused train step.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+
+_DEFAULTS: Dict[str, Any] = {
+    # feature switches
+    "amp": False,
+    "recompute": False,
+    "sharding": False,
+    "pipeline": False,
+    "tensor_parallel": False,
+    "sep_parallel": False,
+    "gradient_merge": False,
+    "lamb": False,
+    "lars": False,
+    "localsgd": False,
+    "adaptive_localsgd": False,
+    "dgc": False,
+    "fp16_allreduce": False,
+    "a_sync": False,
+    "heter_ccl_mode": False,
+    "find_unused_parameters": False,
+    "fuse_all_reduce_ops": True,
+    "without_graph_optimization": False,
+}
+
+_DEFAULT_CONFIGS: Dict[str, Dict[str, Any]] = {
+    "amp_configs": {
+        "init_loss_scaling": 32768.0, "incr_every_n_steps": 1000,
+        "decr_every_n_nan_or_inf": 2, "incr_ratio": 2.0, "decr_ratio": 0.5,
+        "use_dynamic_loss_scaling": True, "custom_white_list": [],
+        "custom_black_list": [], "use_pure_fp16": False,
+        "use_bf16": True, "use_fp16_guard": True,
+    },
+    "recompute_configs": {"checkpoints": [], "enable_offload": False},
+    "sharding_configs": {
+        "sharding_degree": 8, "stage": 1, "mp_degree": 1,
+        "sharding_segment_strategy": "segment_broadcast_MB",
+        "segment_broadcast_MB": 32.0, "gradient_merge_acc_step": 1,
+        "optimize_offload": False,
+    },
+    "pipeline_configs": {
+        "micro_batch_size": 1, "accumulate_steps": 1,
+        "schedule_mode": "1F1B", "p2p_cache_shape": True,
+    },
+    "tensor_parallel_configs": {
+        "tensor_parallel_degree": 1, "tensor_init_seed": -1,
+    },
+    "hybrid_configs": {
+        "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+        "sharding_degree": 1, "sep_degree": 1,
+    },
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "lamb_configs": {"lamb_weight_decay": 0.01,
+                     "exclude_from_weight_decay": []},
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                     "epsilon": 0.0, "exclude_from_weight_decay": []},
+    "a_sync_configs": {"k_steps": -1, "max_merge_var_num": 1,
+                       "send_queue_size": 16,
+                       "independent_recv_thread": False,
+                       "thread_pool_size": 1, "send_wait_times": 1,
+                       "runtime_split_send_recv": False},
+    "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
+                    "sparsity": [0.999]},
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.__dict__["_flags"] = dict(_DEFAULTS)
+        self.__dict__["_configs"] = copy.deepcopy(_DEFAULT_CONFIGS)
+
+    def __getattr__(self, name):
+        if name in self._flags:
+            return self._flags[name]
+        if name in self._configs:
+            return self._configs[name]
+        raise AttributeError(f"DistributedStrategy has no field {name!r}")
+
+    def __setattr__(self, name, value):
+        if name in self._flags:
+            self._flags[name] = bool(value)
+        elif name in self._configs:
+            cfg = self._configs[name]
+            unknown = set(value) - set(cfg)
+            cfg.update({k: v for k, v in value.items() if k in cfg})
+            cfg.update({k: v for k, v in value.items() if k in unknown})
+        else:
+            object.__setattr__(self, name, value)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"flags": dict(self._flags),
+                "configs": copy.deepcopy(self._configs)}
+
+    def __repr__(self):
+        on = [k for k, v in self._flags.items() if v]
+        return f"DistributedStrategy(enabled={on})"

@@ -1,0 +1,3 @@
+"""Framework utilities: save/load, seeding."""
+
+from .io import load, save

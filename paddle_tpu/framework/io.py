@@ -1,0 +1,70 @@
+"""Checkpoint save/load.
+
+Reference parity: python/paddle/framework/io.py (save:565 / load:781 —
+pickled nested state_dicts of params + optimizer state). Arrays are stored
+as numpy inside the pickle; an orbax-backed sharded async checkpoint path
+for large distributed models lives in paddle_tpu.distributed.checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..tensor import Parameter, Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__pt_tensor__": True, "data": np.asarray(obj.value),
+                "name": obj.name,
+                "is_parameter": isinstance(obj, Parameter),
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, jax.Array):
+        return {"__pt_tensor__": True, "data": np.asarray(obj),
+                "name": None, "is_parameter": False, "stop_gradient": True}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__pt_tensor__"):
+            if return_numpy:
+                return obj["data"]
+            cls = Parameter if obj.get("is_parameter") else Tensor
+            if cls is Parameter:
+                t = Parameter(jax.numpy.asarray(obj["data"]),
+                              name=obj.get("name"))
+            else:
+                t = Tensor(jax.numpy.asarray(obj["data"]),
+                           stop_gradient=obj.get("stop_gradient", True),
+                           name=obj.get("name"))
+            return t
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4) -> None:
+    """paddle.save equivalent: pickle state_dict-like nests."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **kwargs) -> Any:
+    with open(path, "rb") as f:
+        raw = pickle.load(f)
+    return _from_saveable(raw, return_numpy)

@@ -1,0 +1,9 @@
+"""paddle_tpu.io — datasets, samplers, DataLoader
+(reference parity: python/paddle/io/)."""
+
+from .collate import default_collate_fn
+from .dataloader import DataLoader
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
+                      IterableDataset, Subset, TensorDataset, random_split)
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
+                      Sampler, SequenceSampler, WeightedRandomSampler)

@@ -1,0 +1,208 @@
+"""paddle_tpu.jit — traced execution.
+
+TPU-native replacement for the reference's two static paths:
+- ``to_static`` / ``TrainStep``: capture eager-style Layer code into ONE
+  jitted XLA computation (replaces ProgramDesc+Executor op-loop,
+  reference: python/paddle/fluid/dygraph/dygraph_to_static/
+  program_translator.py:232 StaticFunction). Autodiff happens inside the
+  trace via jax.grad — the analog of append_backward's program transform.
+- ``save``/``load``: serialize a traced function + params
+  (reference: fluid/dygraph/jit.py:515 save / :851 load).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import no_grad
+from ..core import rng as rng_mod
+from ..nn.layer import Layer, bind_state, functional_state
+from ..tensor import Tensor
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t.value if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v) if isinstance(v, jax.Array) else v, tree)
+
+
+class TrainStep:
+    """One fused, jitted train step over an eager-style step function.
+
+    ``train_fn(model, batch) -> loss`` is ordinary eager Layer code; it is
+    traced once into an XLA computation containing forward, backward
+    (jax.grad) and the optimizer update — the op-by-op interpreter loop the
+    reference executes per step collapses into a single device launch.
+    """
+
+    def __init__(self, model: Layer, optimizer, train_fn: Callable,
+                 donate: bool = True, seed: int = 0):
+        self.model = model
+        self.optimizer = optimizer
+        self.train_fn = train_fn
+        state = functional_state(model)
+        self.params = state["params"]
+        self.buffers = state["buffers"]
+        self.opt_state = optimizer.init(self.params)
+        self._key = jax.random.key(seed)
+        self._step = self._build(donate)
+
+    def _build(self, donate: bool):
+        model, optimizer, train_fn = self.model, self.optimizer, \
+            self.train_fn
+
+        def step_impl(params, buffers, opt_state, key, lr, batch):
+            def loss_of(p):
+                model.train()
+                with bind_state(model, {"params": p, "buffers": buffers}), \
+                        no_grad(), rng_mod.key_scope(key):
+                    loss = train_fn(model, _wrap_tree(batch))
+                    new_buf = {n: b.value for n, b in model.named_buffers()
+                               if b is not None}
+                loss_raw = loss.value if isinstance(loss, Tensor) else loss
+                return loss_raw, new_buf
+
+            (loss, new_buf), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            new_params, new_opt = optimizer.apply_gradients(
+                params, grads, opt_state, lr=lr)
+            return new_params, new_buf, new_opt, loss
+
+        kwargs = {"donate_argnums": (0, 1, 2)} if donate else {}
+        return jax.jit(step_impl, **kwargs)
+
+    def __call__(self, batch) -> jax.Array:
+        batch_raw = _unwrap_tree(batch)
+        self._key, sub = jax.random.split(self._key)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        self.params, self.buffers, self.opt_state, loss = self._step(
+            self.params, self.buffers, self.opt_state, sub, lr, batch_raw)
+        sched = self.optimizer._lr_scheduler
+        if sched is not None:
+            pass  # stepping the scheduler is the caller's choice (per epoch)
+        return loss
+
+    def sync_to_model(self) -> None:
+        """Write the jitted state back into the eager Layer's parameters."""
+        named_p = dict(self.model.named_parameters())
+        for n, v in self.params.items():
+            if n in named_p:
+                named_p[n].value = v
+        named_b = dict(self.model.named_buffers())
+        for n, v in self.buffers.items():
+            if n in named_b:
+                named_b[n].value = v
+
+
+class EvalStep:
+    """Jitted inference step: out = model(*inputs) with frozen state."""
+
+    def __init__(self, model: Layer, seed: int = 0):
+        self.model = model
+        state = functional_state(model)
+        self.params = state["params"]
+        self.buffers = state["buffers"]
+
+        def fwd(params, buffers, key, args, kwargs):
+            model.eval()
+            with bind_state(model, {"params": params, "buffers": buffers}), \
+                    no_grad(), rng_mod.key_scope(key):
+                out = model(*_wrap_tree(args), **_wrap_tree(kwargs))
+            return _unwrap_tree(out)
+
+        self._fwd = jax.jit(fwd)
+        self._key = jax.random.key(seed)
+
+    def __call__(self, *args, **kwargs):
+        self._key, sub = jax.random.split(self._key)
+        return self._fwd(self.params, self.buffers, sub,
+                         _unwrap_tree(args), _unwrap_tree(kwargs))
+
+
+class StaticFunction:
+    """to_static-decorated function: cached jit over Layer state
+    (reference: program_translator.py StaticFunction)."""
+
+    def __init__(self, fn: Callable, model: Optional[Layer] = None):
+        self.fn = fn
+        self.model = model
+        self._jitted = None
+
+    def _resolve_model(self, args):
+        if self.model is not None:
+            return self.model
+        if args and isinstance(args[0], Layer):
+            return args[0]
+        return None
+
+    def __call__(self, *args, **kwargs):
+        model = self._resolve_model(args)
+        if model is None:
+            if self._jitted is None:
+                raw_fn = self.fn
+                self._jitted = jax.jit(lambda a, k: _unwrap_tree(
+                    raw_fn(*_wrap_tree(a), **_wrap_tree(k))))
+            return _wrap_tree(self._jitted(_unwrap_tree(args),
+                                           _unwrap_tree(kwargs)))
+        rest = args[1:] if args and args[0] is model else args
+        if self._jitted is None:
+            fn = self.fn
+
+            def traced(params, buffers, a, k):
+                with bind_state(model, {"params": params,
+                                        "buffers": buffers}), no_grad():
+                    out = fn(model, *_wrap_tree(a), **_wrap_tree(k)) \
+                        if args and args[0] is model else \
+                        fn(*_wrap_tree(a), **_wrap_tree(k))
+                return _unwrap_tree(out)
+
+            self._jitted = jax.jit(traced)
+        state = functional_state(model)
+        out = self._jitted(state["params"], state["buffers"],
+                           _unwrap_tree(rest), _unwrap_tree(kwargs))
+        return _wrap_tree(out)
+
+
+def to_static(function=None, input_spec=None, **kwargs):
+    """Decorator: trace an eager function/Layer into a cached jitted
+    computation (reference: paddle.jit.to_static)."""
+    def deco(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(type(layer).forward, model=layer)
+            layer._static_forward = sf
+            layer.forward = functools.partial(_call_static, layer)
+            return layer
+        return functools.wraps(fn)(StaticFunction(fn))
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def _call_static(layer, *args, **kwargs):
+    return layer._static_forward(layer, *args, **kwargs)
+
+
+def save(layer, path: str, input_spec=None) -> None:
+    """Serialize layer state + config for later load
+    (reference: paddle.jit.save). The exported artifact stores the
+    state_dict; the program artifact (StableHLO export) is produced by
+    paddle_tpu.static.export when shapes are pinned."""
+    from ..framework.io import save as fsave
+    fsave({"state_dict": layer.state_dict(),
+           "class": f"{type(layer).__module__}.{type(layer).__qualname__}"},
+          path + ".pdparams")
+
+
+def load(path: str):
+    from ..framework.io import load as fload
+    return fload(path + ".pdparams")

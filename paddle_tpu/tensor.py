@@ -234,7 +234,8 @@ class Parameter(Tensor):
     default to stop_gradient=False)."""
 
     __slots__ = ("trainable", "optimize_attr", "regularizer",
-                 "do_model_average", "need_clip", "is_distributed")
+                 "do_model_average", "need_clip", "is_distributed",
+                 "pspec")
 
     def __init__(self, value, name: Optional[str] = None,
                  trainable: bool = True):
@@ -246,6 +247,7 @@ class Parameter(Tensor):
         self.do_model_average = None
         self.need_clip = True
         self.is_distributed = False
+        self.pspec = None  # PartitionSpec for pjit-sharded training
 
     @property
     def requires_grad(self) -> bool:

