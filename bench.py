@@ -1,0 +1,114 @@
+"""Benchmark: GPT-class LM training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no absolute numbers (BASELINE.md), so
+``vs_baseline`` is MFU / 0.45 — the north-star target from BASELINE.json
+(ERNIE-3.0-10B hybrid at >=45% MFU); >1.0 means the per-chip efficiency
+target is met on this config.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Peak bf16 TFLOP/s per chip by TPU generation (public figures).
+_PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+
+
+def _detect_peak() -> float:
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
+    for k, v in _PEAK_TFLOPS.items():
+        if gen.startswith(k):
+            return v
+    return 197.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    backend = None
+    try:
+        devs = jax.devices()
+        backend = devs[0].platform
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        backend = "cpu"
+
+    on_tpu = backend not in ("cpu",)
+
+    import paddle_tpu as pt
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu import nn
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    # Single-chip config: GPT ~125M-class in bf16 when on TPU.
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024, dropout=0.0,
+                        attn_dropout=0.0, dtype="bfloat16")
+        batch, seq, steps, warmup = 8, 1024, 20, 3
+    else:  # CI smoke fallback
+        from paddle_tpu.models import gpt_tiny
+        cfg = gpt_tiny()
+        batch, seq, steps, warmup = 2, 64, 3, 1
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+        # keep layernorm params fp32 for stability
+        for name, p in model.named_parameters():
+            if "ln_" in name or "norm" in name:
+                p.value = p.value.astype(jnp.float32)
+
+    opt = optim.AdamW(learning_rate=1e-4, multi_precision=True)
+    step = TrainStep(model, opt, lambda m, b: m(b[0], labels=b[1]))
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    batch_data = (ids, ids)
+
+    for _ in range(warmup):
+        loss = step(batch_data)
+    jax.block_until_ready(step.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(batch_data)
+    jax.block_until_ready(loss if hasattr(loss, "block_until_ready")
+                          else step.params)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * steps / dt
+
+    # 6ND model FLOPs + attention term, x3 for fwd+bwd via 6N
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * \
+        cfg.hidden_size * seq
+    model_flops = tok_s * flops_per_token
+    peak = _detect_peak() * 1e12
+    mfu = model_flops / peak if on_tpu else 0.0
+
+    result = {
+        "metric": "gpt125m_train_tokens_per_sec_chip" if on_tpu else
+                  "gpt_tiny_train_tokens_per_sec_cpu_smoke",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4) if on_tpu else 0.0,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

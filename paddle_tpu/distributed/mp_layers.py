@@ -30,6 +30,16 @@ from .topology import get_hybrid_communicate_group
 
 F = dispatch.wrapped_ops
 
+# Canonical activation layout over the hybrid mesh: batch over dp+sharding,
+# sequence over sep, hidden replicated (or mp for the parallel interior).
+def _act_spec(ndim, hidden_axis=None):
+    if ndim == 3:
+        return (("dp", "sharding"), "sep", hidden_axis)
+    if ndim == 2:
+        return (("dp", "sharding"), hidden_axis)
+    return tuple([("dp", "sharding")] + [None] * (ndim - 2) +
+                 [hidden_axis])
+
 
 def _constrain(x, *spec):
     """Apply a sharding constraint when a mesh is active (inside pjit)."""
@@ -62,7 +72,7 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         out = F["embedding"](x, self.weight)
-        return _constrain(out, None, None, None)
+        return _constrain(out, *_act_spec(out.ndim))
 
 
 class ColumnParallelLinear(Layer):
@@ -89,11 +99,9 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         out = F["linear"](x, self.weight, self.bias)
         if self.gather_output:
-            return _constrain(out, None)
+            return _constrain(out, *_act_spec(out.ndim))
         # keep the hidden dim sharded on mp
-        nd = out.ndim
-        spec = [None] * (nd - 1) + ["mp"]
-        return _constrain(out, *spec)
+        return _constrain(out, *_act_spec(out.ndim, "mp"))
 
 
 class RowParallelLinear(Layer):
@@ -118,11 +126,10 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         if self.input_is_parallel:
-            nd = x.ndim
-            spec = [None] * (nd - 1) + ["mp"]
-            x = _constrain(x, *spec)
+            x = _constrain(x, *_act_spec(x.ndim, "mp"))
         out = F["linear"](x, self.weight, None)
-        out = _constrain(out, None)  # forces the psum over mp
+        # forces the psum over mp while keeping batch/seq sharding
+        out = _constrain(out, *_act_spec(out.ndim))
         if self.bias is not None:
             out = out + self.bias
         return out

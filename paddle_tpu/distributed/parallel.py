@@ -1,0 +1,84 @@
+"""Eager data-parallel wrapper + recompute.
+
+Reference parity:
+- paddle.DataParallel (python/paddle/fluid/dygraph/parallel.py:380) whose
+  C++ Reducer buckets grads and overlaps NCCL allreduce with backward
+  (imperative/reducer.cc:624,798). On TPU the SPMD path
+  (fleet.distributed_jit) makes the grad psum part of the compiled step —
+  XLA fuses/overlaps it, so DataParallel is a thin eager-compat shim that
+  averages grads across processes after backward when world_size > 1.
+- recompute (python/paddle/distributed/fleet/utils/recompute.py:171):
+  jax.checkpoint in traced mode; pass-through in eager mode (the eager
+  tape stores residuals anyway).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+from .env import get_world_size
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self) -> None:
+        """Average grads across processes (multi-host eager DDP). With one
+        process this is a no-op; the perf path is fleet.distributed_jit."""
+        if get_world_size() <= 1:
+            return
+        from jax.experimental import multihost_utils
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                g = multihost_utils.process_allgather(p.grad.value)
+                p.grad.value = jnp.mean(g, axis=0)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+
+def recompute(function: Callable, *args, use_reentrant=True, **kwargs):
+    """Activation checkpointing (reference: fleet/utils/recompute.py:63
+    RecomputeFunction — a PyLayer stashing RNG state and re-running forward
+    in backward). Traced mode: jax.checkpoint (XLA rematerializes,
+    trading FLOPs for HBM). Eager mode: direct call."""
+    from jax._src import core as _jax_core
+
+    if _jax_core.trace_state_clean():
+        return function(*args, **kwargs)
+
+    def raw_fn(*raw_args):
+        wrapped = [Tensor(a) if isinstance(a, jax.Array) else a
+                   for a in raw_args]
+        out = function(*wrapped, **kwargs)
+        return jax.tree_util.tree_map(
+            lambda t: t.value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    raw_args = [a.value if isinstance(a, Tensor) else a for a in args]
+    out = jax.checkpoint(raw_fn)(*raw_args)
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v) if isinstance(v, jax.Array) else v, out)

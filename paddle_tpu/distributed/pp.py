@@ -1,0 +1,156 @@
+"""Pipeline parallelism.
+
+Reference parity: python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py (LayerDesc:44, SharedLayerDesc:62,
+PipelineLayer:76) + pipeline_parallel.py train_batch micro-batch loop and
+the C++ SectionWorker F-then-B / 1F1B schedules
+(paddle/fluid/framework/section_worker.cc:130-180).
+
+TPU-native design: a pipeline stage is a position along the "pp" mesh
+axis. Inside ONE jitted SPMD program, ``spmd_pipeline`` runs the classic
+collective-permute microbatch loop: every device applies ITS stage's
+params each step and ppermutes activations to the next stage. jax.grad
+through the loop reverses the permutes, yielding the F-then-B schedule;
+XLA overlaps the permute hop with the next microbatch's compute. The
+reference's send_v2/recv_v2 + per-microbatch scopes collapse into this
+scan. (1F1B's memory profile comes from jax.checkpoint on the stage fn —
+set remat=True.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..nn.container import LayerList
+
+
+class LayerDesc:
+    """Deferred layer construction (reference: pp_layers.py:44)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer shared between stages, e.g. tied embeddings
+    (reference: pp_layers.py:62; weight sync pp_layers.py:180-188)."""
+
+    def __init__(self, key, layer_cls, forward_func=None,
+                 shared_weight_attr="weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Segments a LayerDesc list across pipeline stages
+    (reference: pp_layers.py:76 PipelineLayer).
+
+    Eager/forward semantics run the full stack (correct on any device
+    count); the SPMD pipelined execution is built by ``spmd_pipeline``
+    over the uniform block segment. ``seg_method="layer:<ClassName>"``
+    marks which class forms the uniform pipelined body, as in the
+    reference's "layer:TransformerBlock" convention.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval: int = 0, num_virtual_pipeline_stages=1):
+        super().__init__()
+        self.descs = list(layers)
+        self.loss_fn = loss_fn
+        self.num_stages = num_stages or 1
+        self.seg_method = seg_method
+        self.recompute_interval = recompute_interval
+        self.shared_layers = {}
+        built: List[Layer] = []
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self.shared_layers:
+                    built.append(self.shared_layers[d.layer_name])
+                else:
+                    layer = d.build_layer()
+                    self.shared_layers[d.layer_name] = layer
+                    built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            else:  # bare callable (e.g. lambda reshape)
+                built.append(d)
+        self.run_function = built
+        self._layers = LayerList([b for b in built if isinstance(b, Layer)])
+
+    def forward(self, x, **kwargs):
+        for fn in self.run_function:
+            x = fn(x)
+        return x
+
+    def get_stage_layers(self, stage: int, num_stages: Optional[int] = None
+                         ) -> List:
+        n = num_stages or self.num_stages
+        per = (len(self.run_function) + n - 1) // n
+        return self.run_function[stage * per:(stage + 1) * per]
+
+
+def spmd_pipeline(stage_fn: Callable, stage_params: Any, x_micro,
+                  axis_name: str = "pp", remat: bool = False):
+    """Collective-permute pipeline over the pp mesh axis (call inside
+    shard_map).
+
+    stage_fn(params, x) -> y with matching x/y shapes; ``stage_params``
+    are THIS device's stage weights (callers shard a stacked
+    [n_stages, ...] pytree over the pp axis). x_micro: [n_micro, mb, ...]
+    microbatched input (meaningful on stage 0; replicated elsewhere).
+    Returns [n_micro, mb, ...] outputs valid on the LAST stage (zeros
+    elsewhere); reduce with a pp-psum or mask as needed.
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    total_steps = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def body(carry, t):
+        recv_buf, outputs = carry
+        mb_idx = jnp.clip(t - stage, 0, n_micro - 1)
+        first_in = jax.lax.dynamic_index_in_dim(x_micro, jnp.clip(
+            t, 0, n_micro - 1), keepdims=False)
+        inp = jnp.where(stage == 0, first_in, recv_buf)
+        out = fn(stage_params, inp)
+        active = (t >= stage) & (t - stage < n_micro)
+        out = jnp.where(active, out, jnp.zeros_like(out))
+        # collect on the last stage
+        is_last = stage == n_stages - 1
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(active & is_last, out,
+                      jax.lax.dynamic_index_in_dim(outputs, mb_idx,
+                                                   keepdims=False)),
+            mb_idx, axis=0)
+        nxt = jax.lax.ppermute(out, axis_name, perm)
+        return (nxt, outputs), None
+
+    recv0 = jnp.zeros_like(x_micro[0])
+    outs0 = jnp.zeros_like(x_micro)
+    (recv, outputs), _ = jax.lax.scan(body, (recv0, outs0),
+                                      jnp.arange(total_steps))
+    return outputs
+
+
+def pipeline_last_stage_value(x, axis_name: str = "pp"):
+    """Broadcast the last stage's value to all pp ranks (sum works because
+    other stages contribute zeros)."""
+    return jax.lax.psum(x, axis_name)

@@ -1,0 +1,132 @@
+"""Sequence/context parallelism: ring attention + Ulysses.
+
+BEYOND-REFERENCE capability (SURVEY §5: the reference has no sequence
+parallelism — only the raw alltoall op, operators/collective/
+alltoall_op.cc). Long-context training shards the sequence axis over a
+mesh axis ("sep"):
+
+- ring_attention: K/V blocks rotate around the ring via
+  lax.ppermute while each device holds its Q shard; online-softmax
+  (flash-style) accumulation keeps memory O(seq/N). Causal masking skips
+  no work but stays correct across blocks.
+- ulysses_attention: all_to_all exchanges seq-shards for head-shards so
+  each device runs full-sequence attention on a head subset, then
+  exchanges back (DeepSpeed-Ulysses pattern on the alltoall primitive).
+
+Both are written for shard_map over the hybrid mesh's "sep" axis and are
+used by models.gpt when sep_degree > 1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+def _block_attn(q, k, v, scale, causal_mask=None):
+    """One block's contribution: returns (unnormalized out, row-max,
+    row-sumexp) in fp32 for online-softmax accumulation.
+    q: [B,Sq,H,D], k/v: [B,Sk,H,D]."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal_mask is not None:
+        logits = jnp.where(causal_mask, logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1)  # [B,H,Sq]
+    # guard fully-masked rows
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(logits), p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B,H,Sq]
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out.astype(jnp.float32), m_safe, l
+
+
+def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Blockwise ring attention inside shard_map.
+
+    q,k,v: [B, S_local, H, D] — the local sequence shard. Rotates K/V
+    around ``axis_name`` with ppermute; one hop per step overlaps with the
+    block matmuls (XLA schedules the permute concurrently).
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = idx * s_loc + jnp.arange(s_loc)  # global positions of q rows
+
+    def causal_mask_for(kv_index):
+        k_pos = kv_index * s_loc + jnp.arange(s_loc)
+        return (q_pos[:, None] >= k_pos[None, :])[None, None]  # [1,1,Sq,Sk]
+
+    def body(carry, _):
+        k_cur, v_cur, kv_idx, acc, m_run, l_run = carry
+        mask = causal_mask_for(kv_idx) if causal else None
+        out_b, m_b, l_b = _block_attn(q, k_cur, v_cur, scale, mask)
+        # online softmax merge
+        m_new = jnp.maximum(m_run, m_b)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(m_b - m_new)
+        l_new = l_run * alpha + l_b * beta
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + \
+            out_b * beta.transpose(0, 2, 1)[..., None]
+        # rotate kv to the next device
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        kv_nxt = (kv_idx - 1) % n
+        return (k_nxt, v_nxt, kv_nxt, acc, m_new, l_new), None
+
+    acc0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    carry0 = (k, v, idx, acc0, m0, l0)
+    (kf, vf, _, acc, m_run, l_run), _ = jax.lax.scan(
+        body, carry0, None, length=n)
+    denom = jnp.maximum(l_run, 1e-20).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sep",
+                      causal: bool = False,
+                      scale: Optional[float] = None,
+                      attn_fn=None):
+    """Ulysses: alltoall seq<->head re-shard inside shard_map.
+
+    q,k,v: [B, S_local, H, D] with H divisible by the axis size. After the
+    exchange each device holds [B, S_full, H/N, D] and runs ordinary
+    (flash) attention, then exchanges back.
+    """
+    n = jax.lax.axis_size(axis_name)
+
+    def seq_to_head(x):
+        # [B, S/N, H, D] -> [B, S, H/N, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def head_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qf, kf, vf = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    if attn_fn is None:
+        from ..ops.nn_functional import scaled_dot_product_attention
+        out = scaled_dot_product_attention(qf, kf, vf, is_causal=causal,
+                                           scale=scale, dropout_p=0.0)
+    else:
+        out = attn_fn(qf, kf, vf)
+    return head_to_seq(out)
+
+
+def sequence_parallel_attention(q, k, v, mode: str = "ring",
+                                axis_name: str = "sep",
+                                causal: bool = False):
+    if mode == "ring":
+        return ring_attention(q, k, v, axis_name, causal)
+    return ulysses_attention(q, k, v, axis_name, causal)

@@ -1,0 +1,4 @@
+"""Flagship model families (GPT/ERNIE-class LLMs, BERT)."""
+
+from .gpt import (GPTAttention, GPTBlock, GPTConfig, GPTForCausalLM, GPTMLP,
+                  GPTModel, ernie_10b, gpt_125m, gpt_1p3b, gpt_tiny)

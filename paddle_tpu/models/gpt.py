@@ -1,0 +1,279 @@
+"""GPT/ERNIE-class decoder-only transformer — the flagship model family.
+
+Reference parity: the fleet-era GPT implementations the reference's hybrid
+parallelism was built to train (Megatron-style TP layers
+distributed/fleet/meta_parallel/parallel_layers/mp_layers.py + PP segments
+pp_layers.py + sharding). Architecture choices follow the GPT-3/ERNIE 3.0
+configs in BASELINE.md.
+
+TPU-first: bf16 compute with fp32 layernorm/softmax, attention through
+scaled_dot_product_attention (Pallas flash kernel on TPU), uniform blocks
+so pipeline stages stack into a scanned [n_layer, ...] pytree, and every
+parameter annotated with its hybrid-mesh PartitionSpec (dp×mp×pp×sp).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import dispatch
+from ..nn import functional as NF
+from ..nn.common import Dropout, Embedding, Linear
+from ..nn.container import LayerList
+from ..nn.initializer import Normal
+from ..nn.layer import Layer
+from ..nn.norm import LayerNorm
+from ..distributed.mp_layers import (ColumnParallelLinear,
+                                     ParallelCrossEntropy,
+                                     RowParallelLinear,
+                                     VocabParallelEmbedding, _constrain)
+
+F = dispatch.wrapped_ops
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    ffn_hidden_mult: int = 4
+    dropout: float = 0.1
+    attn_dropout: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+    use_flash_attention: bool = True
+    seq_parallel_mode: Optional[str] = None  # None | "ring" | "ulysses"
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+# staged baseline configs (BASELINE.md: GPT-3 1.3B, ERNIE-3.0 10B)
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                     num_heads=4, max_seq_len=128, dropout=0.0,
+                     attn_dropout=0.0, **kw)
+
+
+def gpt_125m(**kw):
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt_1p3b(**kw):
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_seq_len=2048, **kw)
+
+
+def ernie_10b(**kw):
+    return GPTConfig(hidden_size=4096, num_layers=48, num_heads=64,
+                     max_seq_len=4096, **kw)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_heads
+        self.head_dim = c.head_dim
+        self.seq_mode = c.seq_parallel_mode
+        init = Normal(std=c.initializer_range)
+        self.qkv_proj = ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, gather_output=False)
+        self.out_proj = RowParallelLinear(
+            c.hidden_size, c.hidden_size, input_is_parallel=True)
+        self.attn_dropout_p = c.attn_dropout
+        self.use_flash = c.use_flash_attention
+
+    def forward(self, x, cache=None, use_cache=False):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)  # [b, s, 3h] sharded over mp on last dim
+        qkv = F["reshape"](qkv, (b, s, 3, self.num_heads, self.head_dim))
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        new_cache = None
+        if use_cache:
+            if cache is not None:
+                k = F["concat"]([cache[0], k], axis=1)
+                v = F["concat"]([cache[1], v], axis=1)
+            new_cache = (k, v)
+        if self.seq_mode in ("ring", "ulysses") and not use_cache:
+            from ..distributed.sp import sequence_parallel_attention
+            out = dispatch.call_fn(
+                lambda qq, kk, vv: sequence_parallel_attention(
+                    qq, kk, vv, mode=self.seq_mode, causal=True),
+                "seq_parallel_attention", True, (q, k, v), {})
+        else:
+            out = F["scaled_dot_product_attention"](
+                q, k, v, is_causal=True, dropout_p=self.attn_dropout_p,
+                training=self.training)
+        out = F["reshape"](out, (b, s, self.num_heads * self.head_dim))
+        out = self.out_proj(out)
+        if use_cache:
+            return out, new_cache
+        return out
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        inner = c.ffn_hidden_mult * c.hidden_size
+        self.fc_in = ColumnParallelLinear(c.hidden_size, inner,
+                                          gather_output=False)
+        self.fc_out = RowParallelLinear(inner, c.hidden_size,
+                                        input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc_out(F["gelu"](self.fc_in(x), True))
+
+
+class GPTBlock(Layer):
+    """Pre-norm transformer block; uniform across the stack so pipeline
+    stages can scan a stacked params pytree."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, x, cache=None, use_cache=False):
+        if use_cache:
+            a, new_cache = self.attn(self.ln_1(x), cache, use_cache=True)
+            x = x + self.dropout(a)
+            x = x + self.dropout(self.mlp(self.ln_2(x)))
+            return x, new_cache
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        init = Normal(std=c.initializer_range)
+        self.wte = VocabParallelEmbedding(c.vocab_size, c.hidden_size)
+        self.wpe = Embedding(c.max_seq_len, c.hidden_size)
+        self.wpe.weight.pspec = P()
+        self.drop = Dropout(c.dropout)
+        self.h = LayerList([GPTBlock(c) for _ in range(c.num_layers)])
+        self.ln_f = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None, caches=None,
+                use_cache=False):
+        use_cache = use_cache or caches is not None
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = F["arange"](s, dtype="int32")
+            offset = 0
+            if caches is not None and caches[0] is not None:
+                offset = caches[0][0].shape[1]
+                position_ids = position_ids + offset
+            position_ids = F["expand"](
+                F["unsqueeze"](position_ids, 0), (b, s))
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        # shard activations: batch over dp(+sharding), seq over sep
+        x = _constrain(x, ("dp", "sharding"), "sep", None)
+        x = self.drop(x)
+        if caches is None and use_cache:
+            caches = [None] * len(self.h)
+        new_caches = [] if use_cache else None
+        for i, block in enumerate(self.h):
+            if use_cache:
+                x, nc = block(x, caches[i], use_cache=True)
+                new_caches.append(nc)
+            else:
+                x = block(x)
+        x = self.ln_f(x)
+        if use_cache:
+            return x, new_caches
+        return x
+
+
+class GPTForCausalLM(Layer):
+    """GPT with a (vocab-sharded) LM head + parallel CE loss."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=False)
+        self.loss_fn = ParallelCrossEntropy()
+
+    def logits(self, hidden):
+        if self.lm_head is not None:
+            return self.lm_head(hidden)
+        return F["matmul"](hidden, self.gpt.wte.weight, transpose_y=True)
+
+    def forward(self, input_ids, labels=None, position_ids=None,
+                caches=None):
+        if caches is not None:
+            hidden, new_caches = self.gpt(input_ids, position_ids, caches)
+            return self.logits(hidden), new_caches
+        hidden = self.gpt(input_ids, position_ids)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        # next-token LM loss
+        shift_logits = logits[:, :-1]
+        shift_labels = labels[:, 1:]
+        loss = self.loss_fn(shift_logits, shift_labels)
+        return F["mean"](loss)
+
+    def generate(self, input_ids, max_new_tokens: int = 20,
+                 temperature: float = 1.0, top_k: Optional[int] = None,
+                 key=None):
+        """Greedy/top-k sampling with kv cache (eager decode loop)."""
+        import jax
+        from ..core.rng import next_key
+        from ..tensor import Tensor
+
+        self.eval()
+        caches = [None] * self.config.num_layers
+        ids = input_ids
+        logits, caches = self.forward(ids, caches=caches)
+        out_ids = [ids]
+        cur = logits[:, -1]
+        for _ in range(max_new_tokens):
+            if temperature == 0.0:
+                nxt = F["argmax"](cur, axis=-1, keepdim=True)
+            else:
+                scaled = cur / temperature
+                if top_k is not None:
+                    vals, _ = F["topk"](scaled, top_k)
+                    kth = vals[:, -1:]
+                    scaled = F["where"](scaled < kth,
+                                        F["full_like"](scaled, -1e10),
+                                        scaled)
+                k = key if key is not None else next_key()
+                key = jax.random.split(k)[0]
+                raw = jax.random.categorical(
+                    k, scaled.value if isinstance(scaled, Tensor)
+                    else scaled, axis=-1)
+                nxt = Tensor(raw[:, None].astype(jnp.int32))
+            out_ids.append(nxt)
+            logits, caches = self.forward(nxt, caches=caches)
+            cur = logits[:, -1]
+        return F["concat"](out_ids, axis=1)
