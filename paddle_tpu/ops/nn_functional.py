@@ -702,9 +702,16 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
 
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, scale=None,
-                                 key=None):
+                                 key=None, use_flash=True):
     """q,k,v: [batch, seq, heads, head_dim] (reference layout). Computes in
-    fp32 accumulation, returns q.dtype."""
+    fp32 accumulation, returns q.dtype. Routes to the Pallas flash kernel
+    on TPU when the config allows (no mask/dropout, tile-aligned)."""
+    if (use_flash and attn_mask is None and
+            (dropout_p == 0.0 or not training)):
+        from .pallas.flash_attention import (flash_attention,
+                                             flash_attention_supported)
+        if flash_attention_supported(q.shape, k.shape):
+            return flash_attention(q, k, v, causal=is_causal, scale=scale)
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
