@@ -1,0 +1,6 @@
+"""High-level API (reference parity: python/paddle/hapi/)."""
+
+from . import callbacks
+from .callbacks import (Callback, EarlyStopping, LRScheduler,
+                        ModelCheckpoint, ProgBarLogger, ReduceLROnPlateau)
+from .model import Model

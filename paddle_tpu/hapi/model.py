@@ -1,0 +1,264 @@
+"""High-level Model API: fit/evaluate/predict.
+
+Reference parity: python/paddle/hapi/model.py:883 Model (prepare, fit,
+evaluate, predict, save/load, summary) + model_summary.py. The training
+loop drives the fused jit TrainStep, so Model.fit gets single-launch steps
+for free.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import dispatch
+from ..framework.io import load as fload, save as fsave
+from ..io.dataloader import DataLoader
+from ..io.dataset import Dataset
+from ..metric import Metric
+from ..nn.layer import Layer
+from ..tensor import Tensor
+from .callbacks import CallbackList, ModelCheckpoint, ProgBarLogger
+
+F = dispatch.wrapped_ops
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+        self._train_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+
+    # -- core loops -----------------------------------------------------------
+
+    def _build_train_step(self):
+        from ..jit import TrainStep
+
+        loss_fn = self._loss
+
+        def step_fn(model, batch):
+            *xs, y = batch if isinstance(batch, (list, tuple)) else (batch,)
+            out = model(*xs)
+            return loss_fn(out, y)
+
+        return TrainStep(self.network, self._optimizer, step_fn)
+
+    def train_batch(self, inputs, labels=None):
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        if labels is not None and not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        batch = tuple(inputs) + tuple(labels or ())
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        loss = self._train_step(batch)
+        return [float(np.asarray(loss))]
+
+    def eval_batch(self, inputs, labels=None):
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self.network.eval()
+        out = self.network(*[_as_tensor(i) for i in inputs])
+        losses = []
+        if self._loss is not None and labels is not None:
+            label = labels[0] if isinstance(labels, (list, tuple)) else \
+                labels
+            losses = [float(np.asarray(
+                (self._loss(out, _as_tensor(label))).numpy()))]
+        self.network.train()
+        return losses, out
+
+    def predict_batch(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self.network.eval()
+        out = self.network(*[_as_tensor(i) for i in inputs])
+        self.network.train()
+        return [np.asarray(o.numpy() if isinstance(o, Tensor) else o)
+                for o in _leaves(out)]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        train_loader = _as_loader(train_data, batch_size, shuffle,
+                                  drop_last, num_workers)
+        eval_loader = _as_loader(eval_data, batch_size, False, False,
+                                 num_workers) if eval_data is not None \
+            else None
+
+        cbks = list(callbacks or [])
+        cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+        if save_dir:
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cbk = CallbackList(cbks)
+        cbk.set_model(self)
+        steps = None
+        try:
+            steps = len(train_loader)
+        except Exception:
+            pass
+        cbk.set_params({"epochs": epochs, "steps": steps,
+                        "verbose": verbose, "metrics": ["loss"] + [
+                            m.name() for m in self._metrics]})
+
+        cbk.on_train_begin()
+        self.stop_training = False
+        for epoch in range(epochs):
+            cbk.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbk.on_train_batch_begin(step)
+                xs, y = _split_batch(batch)
+                losses = self.train_batch(xs, y)
+                logs = {"loss": losses[0]}
+                for m in self._metrics:
+                    if self._train_step is not None:
+                        pass  # metric update on eval path
+                cbk.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate_loop(eval_loader, cbk)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbk.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        # sync jitted weights back into the eager network
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        cbk.on_train_end(logs if "logs" in dir() else None)
+
+    def evaluate_loop(self, loader, cbk=None):
+        if cbk is None:
+            cbk = CallbackList([])
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        cbk.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbk.on_eval_batch_begin(step)
+            xs, y = _split_batch(batch)
+            batch_losses, out = self.eval_batch(xs, y)
+            losses.extend(batch_losses)
+            for m in self._metrics:
+                label = y[0] if isinstance(y, (list, tuple)) else y
+                res = m.compute(out, label)
+                m.update(res)
+            cbk.on_eval_batch_end(step)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            acc = m.accumulate()
+            accs = acc if isinstance(acc, (list, tuple)) else [acc]
+            logs.update(dict(zip(names, accs)))
+        cbk.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = _as_loader(eval_data, batch_size, False, False,
+                            num_workers)
+        return self.evaluate_loop(loader)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None):
+        loader = _as_loader(test_data, batch_size, False, False,
+                            num_workers)
+        outputs = []
+        for batch in loader:
+            # datasets that yield (x, label) pairs: feed x only, matching
+            # fit/evaluate's split
+            xs, _ = _split_batch(batch)
+            outputs.append(self.predict_batch(xs))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence ----------------------------------------------------------
+
+    def save(self, path: str, training: bool = True) -> None:
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch=False, reset_optimizer=False
+             ) -> None:
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None and
+                os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(fload(opt_path))
+        self._train_step = None  # rebuild against loaded weights
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        total = sum(int(np.prod(p.shape)) for p in self.network.parameters())
+        trainable = sum(int(np.prod(p.shape))
+                        for p in self.network.parameters() if p.trainable)
+        lines = [f"{'Layer':<40}{'Params':>12}"]
+        for name, layer in self.network.named_sublayers():
+            n = sum(int(np.prod(p.shape))
+                    for p in layer._parameters.values() if p is not None)
+            if n:
+                lines.append(f"{name:<40}{n:>12,}")
+        lines.append(f"Total params: {total:,}")
+        lines.append(f"Trainable params: {trainable:,}")
+        print("\n".join(lines))
+        return {"total_params": total, "trainable_params": trainable}
+
+
+def _as_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    from ..tensor import to_tensor
+    return to_tensor(np.asarray(x))
+
+
+def _leaves(out):
+    import jax
+    return jax.tree_util.tree_leaves(
+        out, is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+    if data is None or isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+    return data
+
+
+def _split_batch(batch, labeled=True):
+    if isinstance(batch, (list, tuple)):
+        if labeled and len(batch) >= 2:
+            return list(batch[:-1]), batch[-1]
+        return list(batch), None
+    return [batch], None

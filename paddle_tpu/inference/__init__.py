@@ -1,0 +1,13 @@
+"""paddle_tpu.inference — AOT-compiled serving predictor.
+
+Reference parity: paddle/fluid/inference/ AnalysisPredictor
+(api/analysis_predictor.cc Init:145, PrepareExecutor:312, ZeroCopyRun:889)
++ AnalysisConfig (api/paddle_analysis_config.h) + python/paddle/inference.
+
+TPU-native: "analysis passes + TensorRT subgraphs" collapse into XLA's
+AOT compile of the exported program; precision switching is a dtype cast
+at load; zero-copy handles are device arrays.
+"""
+
+from .predictor import Config, PrecisionType, Predictor, Tensor as \
+    InferTensor, create_predictor

@@ -1,0 +1,136 @@
+"""AOT inference predictor."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "float16"
+    Int8 = "int8"
+
+
+class Config:
+    """reference: AnalysisConfig (inference/api/paddle_analysis_config.h).
+    Holds model path + device/precision knobs; graph optimization choices
+    map to XLA options."""
+
+    def __init__(self, model_path_prefix: Optional[str] = None):
+        self.model_path_prefix = model_path_prefix
+        self._device = "auto"
+        self._precision = PrecisionType.Float32
+        self._enable_profile = False
+        self._memory_optim = True
+
+    def set_model(self, path_prefix: str) -> None:
+        self.model_path_prefix = path_prefix
+
+    def enable_tpu(self) -> None:
+        self._device = "tpu"
+
+    def disable_gpu(self) -> None:
+        self._device = "cpu"
+
+    def set_cpu_math_library_num_threads(self, n: int) -> None:
+        pass
+
+    def enable_memory_optim(self, flag: bool = True) -> None:
+        self._memory_optim = flag
+
+    def enable_profile(self) -> None:
+        self._enable_profile = True
+
+    def set_precision(self, precision: str) -> None:
+        self._precision = precision
+
+    # reference naming: enable_tensorrt_engine configures the fused
+    # low-precision path; here it just selects precision.
+    def enable_tensorrt_engine(self, workspace_size=0, max_batch_size=1,
+                               min_subgraph_size=3,
+                               precision_mode=PrecisionType.Float32,
+                               use_static=False, use_calib_mode=False):
+        self._precision = precision_mode
+
+
+class Tensor:
+    """Zero-copy handle (reference: paddle_tensor.h ZeroCopyTensor)."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def reshape(self, shape) -> None:
+        pass  # shapes are taken from the bound array
+
+    def copy_from_cpu(self, arr: np.ndarray) -> None:
+        self._owner._inputs[self.name] = jnp.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._owner._outputs[self.name])
+
+    def share_external_data(self, arr) -> None:
+        self._owner._inputs[self.name] = arr
+
+
+class Predictor:
+    """reference: AnalysisPredictor. Loads the exported StableHLO program
+    and AOT-compiles it once; run() is a single device launch."""
+
+    def __init__(self, config: Config):
+        from ..static.program import LoadedProgram
+
+        self.config = config
+        self._program = LoadedProgram(config.model_path_prefix)
+        if config._precision in (PrecisionType.Bfloat16,
+                                 PrecisionType.Half):
+            dt = jnp.bfloat16 if config._precision == \
+                PrecisionType.Bfloat16 else jnp.float16
+            self._program.params = {
+                k: (v.astype(dt) if jnp.issubdtype(v.dtype, jnp.floating)
+                    else v)
+                for k, v in self._program.params.items()}
+        self._input_names = [
+            s.name or f"x{i}"
+            for i, s in enumerate(self._program.input_specs)]
+        self._inputs: Dict[str, Any] = {}
+        self._outputs: Dict[str, Any] = {}
+        self._output_names: List[str] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, True)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names) or ["out0"]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, False)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n] = jnp.asarray(a)
+        args = [self._inputs[n] for n in self._input_names]
+        out = self._program.run(*args)
+        leaves = jax.tree_util.tree_leaves(out)
+        self._output_names = [f"out{i}" for i in range(len(leaves))]
+        self._outputs = dict(zip(self._output_names, leaves))
+        if inputs is not None:
+            return [np.asarray(l) for l in leaves]
+        return None
+
+    def try_shrink_memory(self) -> None:
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,18 @@
+"""paddle_tpu.static — the traced/static program path.
+
+TPU-native equivalent of the reference's static-graph mode
+(reference: ProgramDesc protobuf IR framework/framework.proto:202 + Python
+Program/Block/Operator fluid/framework.py:3979 + Executor
+fluid/executor.py:475 + save/load_inference_model fluid/io.py:1246,1459).
+
+Design: a Program is a traced, lowered XLA computation. Building it is
+jax.jit tracing (one compiled program replaces the op-by-op interpreter
+loop); the serialized artifact is StableHLO via jax.export — the save
+format replacing ProgramDesc. Autodiff on programs is jax.grad at trace
+time (replacing append_backward's program-to-program transform).
+"""
+
+from .program import (CompiledProgram, Executor, InputSpec, Program,
+                      build_program, data, default_main_program,
+                      load_inference_model, program_guard,
+                      save_inference_model)

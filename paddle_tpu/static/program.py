@@ -1,0 +1,229 @@
+"""Program/Executor facade over traced XLA computations."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..nn.layer import Layer, functional_state, functional_call
+from ..tensor import Tensor
+
+
+class InputSpec:
+    """Symbolic input description (reference: paddle.static.InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name: Optional[str] = None):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    def to_sds(self) -> jax.ShapeDtypeStruct:
+        shape = tuple(1 if (s is None or s == -1) else s
+                      for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, " \
+               f"name={self.name!r})"
+
+    @classmethod
+    def from_tensor(cls, t, name=None):
+        return cls(t.shape, t.dtype, name)
+
+
+class Program:
+    """A traced computation + its parameter state.
+
+    Reference analog: ProgramDesc (the serialized program) + its scope of
+    persistable variables. ``fn(params, *inputs) -> outputs`` is pure; the
+    serialized form is a StableHLO artifact from jax.export.
+    """
+
+    def __init__(self, fn: Callable, input_specs: Sequence[InputSpec],
+                 params: Optional[Dict[str, Any]] = None,
+                 name: str = "main"):
+        self.fn = fn
+        self.input_specs = list(input_specs)
+        self.params = dict(params or {})
+        self.name = name
+        self._jitted = jax.jit(fn)
+        self._exported = None
+
+    # -- execution ------------------------------------------------------------
+
+    def run(self, *inputs):
+        raw = [i.value if isinstance(i, Tensor) else jnp.asarray(i)
+               for i in inputs]
+        return self._jitted(self.params, *raw)
+
+    # -- introspection (Program surface) -------------------------------------
+
+    def lowered_text(self) -> str:
+        args = [s.to_sds() for s in self.input_specs]
+        return jax.jit(self.fn).lower(self.params, *args).as_text()
+
+    def num_ops(self) -> int:
+        txt = self.lowered_text()
+        return sum(1 for line in txt.splitlines()
+                   if "=" in line and "func.func" not in line)
+
+    def __str__(self):
+        return self.lowered_text()
+
+    # -- serialization --------------------------------------------------------
+
+    def export(self) -> bytes:
+        from jax import export as jexport
+        args = [s.to_sds() for s in self.input_specs]
+        exp = jexport.export(jax.jit(self.fn))(self.params, *args)
+        return exp.serialize()
+
+    def save(self, path_prefix: str) -> None:
+        d = os.path.dirname(path_prefix)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path_prefix + ".pdmodel", "wb") as f:
+            f.write(self.export())
+        with open(path_prefix + ".pdiparams", "wb") as f:
+            pickle.dump({k: np.asarray(v) for k, v in self.params.items()},
+                        f, protocol=4)
+        with open(path_prefix + ".pdmeta", "wb") as f:
+            pickle.dump({"input_specs": [(s.shape, str(s.dtype), s.name)
+                                         for s in self.input_specs],
+                         "name": self.name}, f)
+
+
+class LoadedProgram:
+    """Program deserialized from a .pdmodel StableHLO artifact."""
+
+    def __init__(self, path_prefix: str):
+        from jax import export as jexport
+        with open(path_prefix + ".pdmodel", "rb") as f:
+            self.exported = jexport.deserialize(f.read())
+        with open(path_prefix + ".pdiparams", "rb") as f:
+            self.params = {k: jnp.asarray(v)
+                           for k, v in pickle.load(f).items()}
+        with open(path_prefix + ".pdmeta", "rb") as f:
+            meta = pickle.load(f)
+        self.input_specs = [InputSpec(s, d, n)
+                            for s, d, n in meta["input_specs"]]
+        self.name = meta.get("name", "main")
+        self._call = jax.jit(self.exported.call)
+
+    def run(self, *inputs):
+        raw = [i.value if isinstance(i, Tensor) else jnp.asarray(i)
+               for i in inputs]
+        return self._call(self.params, *raw)
+
+
+def build_program(layer_or_fn, input_specs: Sequence[InputSpec],
+                  training: bool = False) -> Program:
+    """Capture a Layer or function into a Program (the analog of building
+    a ProgramDesc under program_guard + save_inference_model pruning)."""
+    specs = [s if isinstance(s, InputSpec) else InputSpec(*s)
+             for s in input_specs]
+    if isinstance(layer_or_fn, Layer):
+        layer = layer_or_fn
+        layer.eval() if not training else layer.train()
+        state = functional_state(layer)
+
+        def fn(params, *inputs):
+            return functional_call(
+                layer, {"params": params, "buffers": state["buffers"]},
+                *[Tensor(i) for i in inputs])
+
+        return Program(fn, specs, params=state["params"],
+                       name=type(layer).__name__)
+
+    def fn(params, *inputs):
+        out = layer_or_fn(*[Tensor(i) for i in inputs])
+        return jax.tree_util.tree_map(
+            lambda t: t.value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    return Program(fn, specs, params={})
+
+
+# -- reference-compatible module-level API -----------------------------------
+
+_default_program: Optional[Program] = None
+
+
+def default_main_program():
+    return _default_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program=None, startup_program=None):
+    """Compatibility shim: the traced path has no global graph under
+    construction; yields the program for API parity."""
+    global _default_program
+    prev = _default_program
+    _default_program = main_program
+    try:
+        yield main_program
+    finally:
+        _default_program = prev
+
+
+def data(name: str, shape, dtype="float32"):
+    """Symbolic placeholder (reference: paddle.static.data) — returns an
+    InputSpec consumed by build_program."""
+    return InputSpec(shape, dtype, name)
+
+
+class CompiledProgram:
+    """Reference-API shim: compilation happens at Program build."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+class Executor:
+    """Runs Programs (reference: fluid/executor.py:475 Executor.run with
+    feed/fetch). Feed keys map to input_spec names positionally when
+    unnamed."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Program = None, feed: Optional[Dict] = None,
+            fetch_list=None, return_numpy: bool = True):
+        program = program or _default_program
+        feed = feed or {}
+        inputs = []
+        for i, spec in enumerate(program.input_specs):
+            key = spec.name or f"x{i}"
+            if key in feed:
+                inputs.append(feed[key])
+            else:
+                vals = list(feed.values())
+                inputs.append(vals[i] if i < len(vals) else None)
+        out = program.run(*inputs)
+        leaves = jax.tree_util.tree_leaves(out)
+        if return_numpy:
+            leaves = [np.asarray(l) for l in leaves]
+        return leaves
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars=None,
+                         executor=None, program=None, layer=None) -> None:
+    """reference: paddle.static.save_inference_model / fluid/io.py:1246.
+    Accepts either a prebuilt Program or (layer, input_specs)."""
+    if program is None:
+        specs = [v if isinstance(v, InputSpec) else InputSpec.from_tensor(v)
+                 for v in feed_vars]
+        program = build_program(layer, specs)
+    program.save(path_prefix)
+
+
+def load_inference_model(path_prefix: str, executor=None) -> LoadedProgram:
+    """reference: paddle.static.load_inference_model / fluid/io.py:1459."""
+    return LoadedProgram(path_prefix)

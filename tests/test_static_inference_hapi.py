@@ -1,0 +1,192 @@
+"""Static program path, inference predictor, hapi Model, metrics.
+
+Mirrors reference tests: test_static_save_load, inference api tests,
+test_model.py (hapi), test_metrics.py.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer as optim
+from paddle_tpu.static import (Executor, InputSpec, build_program,
+                               load_inference_model, save_inference_model)
+
+
+def test_build_program_and_run():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    prog = build_program(net, [InputSpec((-1, 4), "float32", "x")])
+    x = np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32)
+    out = prog.run(x)
+    net.eval()
+    np.testing.assert_allclose(np.asarray(out), net(pt.to_tensor(x)).numpy(),
+                               rtol=1e-5)
+    # lowered program text is inspectable (ProgramDesc analog)
+    assert "stablehlo" in prog.lowered_text() or "func" in \
+        prog.lowered_text()
+
+
+def test_executor_feed_fetch():
+    net = nn.Linear(4, 2)
+    prog = build_program(net, [InputSpec((-1, 4), "float32", "x")])
+    exe = Executor()
+    x = np.ones((2, 4), np.float32)
+    outs = exe.run(prog, feed={"x": x}, fetch_list=None)
+    assert outs[0].shape == (2, 2)
+
+
+def test_save_load_inference_model_roundtrip():
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    x = np.random.default_rng(1).standard_normal((5, 4)).astype(np.float32)
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "model")
+        save_inference_model(prefix, [InputSpec((5, 4), "float32", "x")],
+                             layer=net)
+        loaded = load_inference_model(prefix)
+        net.eval()
+        np.testing.assert_allclose(np.asarray(loaded.run(x)),
+                                   net(pt.to_tensor(x)).numpy(), rtol=1e-5)
+
+
+def test_predictor_api():
+    from paddle_tpu.inference import Config, create_predictor
+
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    x = np.random.default_rng(2).standard_normal((2, 4)).astype(np.float32)
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "serve")
+        save_inference_model(prefix, [InputSpec((2, 4), "float32", "x")],
+                             layer=net)
+        cfg = Config(prefix)
+        pred = create_predictor(cfg)
+        assert pred.get_input_names() == ["x"]
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0])
+        got = out.copy_to_cpu()
+        net.eval()
+        np.testing.assert_allclose(got, net(pt.to_tensor(x)).numpy(),
+                                   rtol=1e-5)
+
+
+def test_to_static_decorator():
+    from paddle_tpu.jit import to_static
+
+    calls = {"n": 0}
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            calls["n"] += 1
+            return self.fc(x)
+
+    net = Net()
+    eager_out = net(pt.randn((2, 4)))
+    net2 = to_static(net)
+    x = pt.randn((2, 4))
+    o1 = net2(x)
+    o2 = net2(x)
+    assert o1.shape == (2, 2)
+    np.testing.assert_allclose(o1.numpy(), o2.numpy())
+
+
+def test_hapi_model_fit_evaluate_predict():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.metric import Accuracy
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((128, 8)).astype(np.float32)
+    W = rng.standard_normal((8, 3)).astype(np.float32)
+    y = (X @ W).argmax(-1).astype(np.int64)
+    ds = TensorDataset([X, y])
+
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 3))
+    model = Model(net)
+    model.prepare(optimizer=optim.Adam(learning_rate=0.01),
+                  loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    model.fit(ds, epochs=8, batch_size=32, verbose=0)
+    logs = model.evaluate(ds, batch_size=32, verbose=0)
+    assert logs["acc"] > 0.7, logs
+    preds = model.predict(ds, batch_size=32, stack_outputs=True)
+    assert preds[0].shape == (128, 3)
+
+
+def test_hapi_early_stopping_and_checkpoint():
+    from paddle_tpu.hapi import EarlyStopping, Model
+    from paddle_tpu.io import TensorDataset
+
+    X = np.random.default_rng(3).standard_normal((32, 4)).astype(np.float32)
+    y = np.zeros((32, 1), np.float32)
+    ds = TensorDataset([X, y])
+    net = nn.Linear(4, 1)
+    model = Model(net)
+    model.prepare(optimizer=optim.SGD(learning_rate=0.0),
+                  loss=nn.MSELoss())
+    es = EarlyStopping(monitor="loss", patience=1, verbose=0)
+    with tempfile.TemporaryDirectory() as d:
+        model.fit(ds, eval_data=ds, epochs=10, batch_size=16, verbose=0,
+                  callbacks=[es], save_dir=d)
+        assert model.stop_training
+        assert os.path.exists(os.path.join(d, "final.pdparams"))
+
+
+def test_model_save_load_roundtrip():
+    from paddle_tpu.hapi import Model
+    net = nn.Linear(4, 2)
+    model = Model(net)
+    model.prepare(optimizer=optim.Adam(learning_rate=0.01,
+                                       parameters=net.parameters()),
+                  loss=nn.MSELoss())
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "ckpt")
+        model.save(p)
+        net2 = nn.Linear(4, 2)
+        model2 = Model(net2)
+        model2.prepare(optimizer=optim.Adam(
+            learning_rate=0.01, parameters=net2.parameters()),
+            loss=nn.MSELoss())
+        model2.load(p)
+        x = pt.randn((2, 4))
+        net.eval()
+        net2.eval()
+        np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(),
+                                   rtol=1e-6)
+
+
+def test_metrics():
+    from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+    acc = Accuracy()
+    pred = pt.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    label = pt.to_tensor(np.array([1, 0]))
+    correct = acc.compute(pred, label)
+    acc.update(correct)
+    assert acc.accumulate() == 1.0
+
+    p = Precision()
+    p.update(np.array([0.9, 0.8, 0.1]), np.array([1, 0, 1]))
+    assert abs(p.accumulate() - 0.5) < 1e-6
+
+    r = Recall()
+    r.update(np.array([0.9, 0.8, 0.1]), np.array([1, 0, 1]))
+    assert abs(r.accumulate() - 0.5) < 1e-6
+
+    auc = Auc()
+    auc.update(np.array([0.9, 0.8, 0.3, 0.1]), np.array([1, 1, 0, 0]))
+    assert auc.accumulate() == 1.0
+
+
+def test_functional_accuracy():
+    from paddle_tpu.metric import accuracy
+    pred = pt.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    label = pt.to_tensor(np.array([[1], [1]]))
+    a = accuracy(pred, label, k=1)
+    assert abs(float(a.numpy()) - 0.5) < 1e-6
