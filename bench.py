@@ -30,19 +30,33 @@ def _detect_peak() -> float:
     return 197.0
 
 
+def _probe_backend(timeout_s: float) -> bool:
+    """Check TPU liveness in a SUBPROCESS so a hung runtime bring-up can't
+    wedge the benchmark (the axon tunnel can take minutes or stall)."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "import sys; sys.exit(0 if d else 1)"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
 def main() -> None:
+    timeout_s = float(os.environ.get("PT_BENCH_TPU_TIMEOUT", "600"))
+    want_tpu = os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu")
+    use_tpu = want_tpu and _probe_backend(timeout_s)
+
     import jax
+    if not use_tpu:
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
-    backend = None
-    try:
-        devs = jax.devices()
-        backend = devs[0].platform
-    except Exception:
-        jax.config.update("jax_platforms", "cpu")
-        devs = jax.devices()
-        backend = "cpu"
-
+    devs = jax.devices()
+    backend = devs[0].platform
     on_tpu = backend not in ("cpu",)
 
     import paddle_tpu as pt

@@ -1,0 +1,238 @@
+// ptnative: native runtime support library.
+//
+// TPU-native equivalents of the reference's C++ data-plumbing layer:
+//  - ShmQueue: lock-free-ish shared-memory ring buffer for multiprocess
+//    DataLoader batch transport (reference: the C++ BlockingQueue behind
+//    pybind/reader_py.cc + operators/reader/buffered_reader.cc). Workers
+//    write raw batch bytes into POSIX shared memory; the trainer process
+//    maps the same segment and hands pointers straight to the device
+//    transfer — no pickling through pipes.
+//  - crc32c: checkpoint integrity checksums (reference:
+//    framework/io/crypto + save_load_util integrity paths).
+//  - u8_to_f32_norm: fused uint8->float32 normalize for image pipelines
+//    (reference: the C++ side of data_feed.cc's slot conversion) —
+//    autovectorized hot loop.
+//
+// C ABI so Python binds with ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <semaphore.h>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// ShmQueue
+// ---------------------------------------------------------------------------
+
+struct QueueHeader {
+  uint64_t slot_size;
+  uint64_t n_slots;
+  std::atomic<uint64_t> head;  // next slot to write
+  std::atomic<uint64_t> tail;  // next slot to read
+  std::atomic<int32_t> closed;
+  char pad[64];
+};
+
+struct SlotHeader {
+  uint64_t payload_size;
+};
+
+struct ShmQueue {
+  QueueHeader* hdr;
+  uint8_t* slots;
+  sem_t* sem_items;   // count of filled slots
+  sem_t* sem_spaces;  // count of free slots
+  size_t total_bytes;
+  std::string name;
+  int owner;
+};
+
+static size_t queue_bytes(uint64_t slot_size, uint64_t n_slots) {
+  return sizeof(QueueHeader) + n_slots * (sizeof(SlotHeader) + slot_size);
+}
+
+ShmQueue* ptq_create(const char* name, uint64_t slot_size,
+                     uint64_t n_slots) {
+  std::string shm_name = std::string("/ptq_") + name;
+  size_t total = queue_bytes(slot_size, n_slots);
+  int fd = shm_open(shm_name.c_str(), O_CREAT | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd,
+                   0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+
+  auto* q = new ShmQueue();
+  q->hdr = (QueueHeader*)mem;
+  q->slots = (uint8_t*)mem + sizeof(QueueHeader);
+  q->total_bytes = total;
+  q->name = shm_name;
+  q->owner = 1;
+  q->hdr->slot_size = slot_size;
+  q->hdr->n_slots = n_slots;
+  q->hdr->head.store(0);
+  q->hdr->tail.store(0);
+  q->hdr->closed.store(0);
+
+  std::string s_items = shm_name + "_i";
+  std::string s_spaces = shm_name + "_s";
+  sem_unlink(s_items.c_str());
+  sem_unlink(s_spaces.c_str());
+  q->sem_items = sem_open(s_items.c_str(), O_CREAT, 0600, 0);
+  q->sem_spaces = sem_open(s_spaces.c_str(), O_CREAT, 0600,
+                           (unsigned)n_slots);
+  if (q->sem_items == SEM_FAILED || q->sem_spaces == SEM_FAILED) {
+    delete q;
+    return nullptr;
+  }
+  return q;
+}
+
+ShmQueue* ptq_open(const char* name) {
+  std::string shm_name = std::string("/ptq_") + name;
+  int fd = shm_open(shm_name.c_str(), O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* q = new ShmQueue();
+  q->hdr = (QueueHeader*)mem;
+  q->slots = (uint8_t*)mem + sizeof(QueueHeader);
+  q->total_bytes = (size_t)st.st_size;
+  q->name = shm_name;
+  q->owner = 0;
+  q->sem_items = sem_open((shm_name + "_i").c_str(), 0);
+  q->sem_spaces = sem_open((shm_name + "_s").c_str(), 0);
+  if (q->sem_items == SEM_FAILED || q->sem_spaces == SEM_FAILED) {
+    delete q;
+    return nullptr;
+  }
+  return q;
+}
+
+// Blocking push; returns 0 ok, -1 closed, -2 too large.
+int ptq_push(ShmQueue* q, const uint8_t* data, uint64_t size) {
+  if (size > q->hdr->slot_size) return -2;
+  while (sem_wait(q->sem_spaces) != 0) {}
+  if (q->hdr->closed.load()) {
+    sem_post(q->sem_spaces);
+    return -1;
+  }
+  uint64_t slot = q->hdr->head.fetch_add(1) % q->hdr->n_slots;
+  uint8_t* base =
+      q->slots + slot * (sizeof(SlotHeader) + q->hdr->slot_size);
+  ((SlotHeader*)base)->payload_size = size;
+  std::memcpy(base + sizeof(SlotHeader), data, size);
+  sem_post(q->sem_items);
+  return 0;
+}
+
+// Blocking pop into out (cap bytes). Returns payload size, -1 if closed
+// and drained, -2 if cap too small.
+int64_t ptq_pop(ShmQueue* q, uint8_t* out, uint64_t cap) {
+  while (sem_wait(q->sem_items) != 0) {}
+  uint64_t tail = q->hdr->tail.load();
+  if (q->hdr->closed.load() && tail == q->hdr->head.load()) {
+    sem_post(q->sem_items);  // let other readers see the close
+    return -1;
+  }
+  uint64_t slot = q->hdr->tail.fetch_add(1) % q->hdr->n_slots;
+  uint8_t* base =
+      q->slots + slot * (sizeof(SlotHeader) + q->hdr->slot_size);
+  uint64_t size = ((SlotHeader*)base)->payload_size;
+  if (size > cap) {
+    sem_post(q->sem_items);
+    return -2;
+  }
+  std::memcpy(out, base + sizeof(SlotHeader), size);
+  sem_post(q->sem_spaces);
+  return (int64_t)size;
+}
+
+int ptq_size(ShmQueue* q) {
+  int v = 0;
+  sem_getvalue(q->sem_items, &v);
+  return v;
+}
+
+void ptq_close(ShmQueue* q) {
+  q->hdr->closed.store(1);
+  // wake blocked readers
+  for (uint64_t i = 0; i < q->hdr->n_slots; ++i) sem_post(q->sem_items);
+}
+
+void ptq_destroy(ShmQueue* q) {
+  if (!q) return;
+  std::string name = q->name;
+  int owner = q->owner;
+  sem_close(q->sem_items);
+  sem_close(q->sem_spaces);
+  munmap((void*)q->hdr, q->total_bytes);
+  if (owner) {
+    shm_unlink(name.c_str());
+    sem_unlink((name + "_i").c_str());
+    sem_unlink((name + "_s").c_str());
+  }
+  delete q;
+}
+
+// ---------------------------------------------------------------------------
+// crc32c (Castagnoli, software table-driven)
+// ---------------------------------------------------------------------------
+
+static uint32_t crc32c_table[256];
+static bool crc32c_init_done = false;
+
+static void crc32c_init() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k)
+      c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+    crc32c_table[i] = c;
+  }
+  crc32c_init_done = true;
+}
+
+uint32_t pt_crc32c(const uint8_t* data, uint64_t len, uint32_t seed) {
+  if (!crc32c_init_done) crc32c_init();
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (uint64_t i = 0; i < len; ++i)
+    c = crc32c_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// fused u8 -> f32 normalize: out = (x/255 - mean[c]) / std[c], CHW layout
+// ---------------------------------------------------------------------------
+
+void pt_u8_to_f32_norm(const uint8_t* in, float* out, int64_t channels,
+                       int64_t hw, const float* mean, const float* stddev) {
+  for (int64_t c = 0; c < channels; ++c) {
+    const float m = mean[c];
+    const float inv = 1.0f / stddev[c];
+    const uint8_t* src = in + c * hw;
+    float* dst = out + c * hw;
+    for (int64_t i = 0; i < hw; ++i) {
+      dst[i] = (src[i] * (1.0f / 255.0f) - m) * inv;
+    }
+  }
+}
+
+}  // extern "C"

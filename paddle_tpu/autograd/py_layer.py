@@ -1,0 +1,130 @@
+"""PyLayer: user-defined forward/backward.
+
+Reference parity: python/paddle/autograd/py_layer.py:192 PyLayer (used by
+fleet recompute and custom ops). Static-mode analog is jax.custom_vjp; the
+eager tape records the user's backward directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import GradNode, is_grad_enabled
+from ..tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: Tuple = ()
+        self.extras: dict = {}
+
+    def save_for_backward(self, *tensors) -> None:
+        self._saved = tensors
+
+    def saved_tensor(self) -> Tuple:
+        return self._saved
+
+    saved_tensors = property(lambda self: self._saved)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Subclass with @staticmethod forward(ctx, *args) and
+    backward(ctx, *grads); call via .apply(*args)."""
+
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        record = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_args)
+        out = cls.forward(ctx, *args, **kwargs)
+        is_tuple = isinstance(out, (tuple, list))
+        out_list = list(out) if is_tuple else [out]
+        out_list = [o if isinstance(o, Tensor) else Tensor(jnp.asarray(o))
+                    for o in out_list]
+        if record:
+            diff_inputs = [t for t in tensor_args if not t.stop_gradient]
+            avals = [jax.ShapeDtypeStruct(o.shape, o.dtype)
+                     for o in out_list]
+
+            def vjp_fn(cotangents):
+                cots = cotangents if isinstance(cotangents, tuple) else \
+                    (cotangents,)
+                cots_t = [Tensor(c) for c in cots]
+                grads = cls.backward(ctx, *cots_t)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                raw = [g.value if isinstance(g, Tensor) else g
+                       for g in grads]
+                # align with diff inputs (paddle: one grad per fwd input)
+                if len(raw) > len(diff_inputs):
+                    pos = [i for i, a in enumerate(args)
+                           if isinstance(a, Tensor) and
+                           not a.stop_gradient]
+                    tensor_pos = [i for i, a in enumerate(args)
+                                  if isinstance(a, Tensor)]
+                    raw = [raw[tensor_pos.index(i)] if i in tensor_pos
+                           else None for i in pos]
+                return raw[:len(diff_inputs)]
+
+            node = GradNode(cls.__name__, vjp_fn, diff_inputs, avals,
+                            out_tree=None)
+            # out_tree None -> engine passes tuple(cots) for multi-output
+            for i, o in enumerate(out_list):
+                o.stop_gradient = False
+                o.grad_node = node
+                o._out_index = i
+                node.out_tensors.append(o)
+        return tuple(out_list) if is_tuple else out_list[0]
+
+
+def custom_vjp_from_pylayer(cls):
+    """Convert a PyLayer into a jax.custom_vjp function usable in traced
+    code."""
+
+    @jax.custom_vjp
+    def fn(*args):
+        ctx = PyLayerContext()
+        out = cls.forward(ctx, *[Tensor(a) for a in args])
+        return jax.tree_util.tree_map(
+            lambda t: t.value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    def fwd(*args):
+        ctx = PyLayerContext()
+        out = cls.forward(ctx, *[Tensor(a) for a in args])
+        raw = jax.tree_util.tree_map(
+            lambda t: t.value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        saved = tuple(t.value if isinstance(t, Tensor) else t
+                      for t in ctx.saved_tensor())
+        return raw, saved
+
+    def bwd(saved, g):
+        ctx = PyLayerContext()
+        ctx.save_for_backward(*[Tensor(s) for s in saved])
+        gs = g if isinstance(g, tuple) else (g,)
+        grads = cls.backward(ctx, *[Tensor(x) for x in gs])
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        return tuple(x.value if isinstance(x, Tensor) else x
+                     for x in grads)
+
+    fn.defvjp(fwd, bwd)
+    return fn

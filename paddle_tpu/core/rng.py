@@ -22,10 +22,12 @@ from .flags import get_flag
 
 
 class Generator:
-    """A mutable PRNG stream over a functional jax key."""
+    """A mutable PRNG stream over a functional jax key. Key creation is
+    lazy so importing the framework never forces backend initialization
+    (TPU runtime bring-up can be slow)."""
 
     def __init__(self, seed: int = 0):
-        self._key = jax.random.key(seed)
+        self._key = None
         self._seed = seed
         self._lock = threading.Lock()
 
@@ -36,11 +38,15 @@ class Generator:
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             return self._key
 
     def set_state(self, key) -> None:

@@ -1,0 +1,135 @@
+"""Elastic membership / fault tolerance.
+
+Reference parity: python/paddle/distributed/fleet/elastic.py
+(ElasticManager:87 — etcd-registered ranks, membership watch, launcher
+restart on scale events, ELASTIC_EXIT_CODE=101 contract:25; recovery is
+checkpoint-based). This environment ships no etcd, so the registry is
+pluggable: a file-based store (shared filesystem — the common TPU-pod
+setup) with the same watch/restart semantics; an etcd store can be
+registered when the client library is present.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+ELASTIC_EXIT_CODE = 101
+
+
+class MembershipStore:
+    """Abstract registry of live ranks."""
+
+    def register(self, job_id: str, rank: int, meta: Dict) -> None:
+        raise NotImplementedError
+
+    def deregister(self, job_id: str, rank: int) -> None:
+        raise NotImplementedError
+
+    def members(self, job_id: str) -> Dict[int, Dict]:
+        raise NotImplementedError
+
+    def heartbeat(self, job_id: str, rank: int) -> None:
+        raise NotImplementedError
+
+
+class FileMembershipStore(MembershipStore):
+    """Registry on a shared filesystem (GCS-fuse/NFS on TPU pods)."""
+
+    def __init__(self, root: str, ttl_s: float = 30.0):
+        self.root = root
+        self.ttl_s = ttl_s
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, job_id: str, rank: int) -> str:
+        d = os.path.join(self.root, job_id)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"rank_{rank}.json")
+
+    def register(self, job_id: str, rank: int, meta: Dict) -> None:
+        meta = dict(meta, ts=time.time(), host=socket.gethostname())
+        with open(self._path(job_id, rank), "w") as f:
+            json.dump(meta, f)
+
+    def heartbeat(self, job_id: str, rank: int) -> None:
+        p = self._path(job_id, rank)
+        if os.path.exists(p):
+            with open(p) as f:
+                meta = json.load(f)
+            meta["ts"] = time.time()
+            with open(p, "w") as f:
+                json.dump(meta, f)
+
+    def deregister(self, job_id: str, rank: int) -> None:
+        try:
+            os.remove(self._path(job_id, rank))
+        except FileNotFoundError:
+            pass
+
+    def members(self, job_id: str) -> Dict[int, Dict]:
+        d = os.path.join(self.root, job_id)
+        out: Dict[int, Dict] = {}
+        if not os.path.isdir(d):
+            return out
+        now = time.time()
+        for fn in os.listdir(d):
+            if not fn.startswith("rank_"):
+                continue
+            try:
+                with open(os.path.join(d, fn)) as f:
+                    meta = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                continue
+            if now - meta.get("ts", 0) <= self.ttl_s:
+                out[int(fn[5:-5])] = meta
+        return out
+
+
+class ElasticManager:
+    """Watches membership; triggers the restart callback when the member
+    set changes (scale up/down or failure), mirroring ElasticManager's
+    watch loop (reference: fleet/elastic.py:87)."""
+
+    def __init__(self, job_id: str, rank: int, np: int,
+                 store: MembershipStore,
+                 on_change: Optional[Callable[[Dict[int, Dict]], None]]
+                 = None, heartbeat_s: float = 5.0):
+        self.job_id = job_id
+        self.rank = rank
+        self.np = np
+        self.store = store
+        self.on_change = on_change
+        self.heartbeat_s = heartbeat_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_members: Optional[List[int]] = None
+
+    def start(self) -> None:
+        self.store.register(self.job_id, self.rank, {"np": self.np})
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.store.deregister(self.job_id, self.rank)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.store.heartbeat(self.job_id, self.rank)
+            members = sorted(self.store.members(self.job_id))
+            if self._last_members is None:
+                self._last_members = members
+            elif members != self._last_members:
+                self._last_members = members
+                if self.on_change:
+                    self.on_change(self.store.members(self.job_id))
+            self._stop.wait(self.heartbeat_s)
+
+    def healthy(self) -> bool:
+        return len(self.store.members(self.job_id)) >= self.np

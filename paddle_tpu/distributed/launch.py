@@ -1,0 +1,128 @@
+"""Distributed launcher CLI (``python -m paddle_tpu.distributed.launch``).
+
+Reference parity: fleetrun (python/paddle/distributed/fleet/launch.py:94
+parse args, :243 launch_collective, :309 spawn+tail; env contract
+PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS from launch_utils.py). TPU
+version: one process per HOST (devices within a host are driven by SPMD),
+env contract PT_PROCESS_ID / PT_NUM_PROCESSES / PT_COORDINATOR_ADDRESS
+consumed by distributed.env.init_parallel_env -> jax.distributed
+(coordination service replaces the reference's TCP ncclUniqueId
+broadcast).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+class ProcInfo:
+    def __init__(self, proc: subprocess.Popen, rank: int, log_path: str):
+        self.proc = proc
+        self.rank = rank
+        self.log_path = log_path
+
+
+def _build_env(rank: int, nproc: int, coordinator: str,
+               base_env: Dict[str, str]) -> Dict[str, str]:
+    env = dict(base_env)
+    env.update({
+        "PT_PROCESS_ID": str(rank),
+        "PT_NUM_PROCESSES": str(nproc),
+        "PT_COORDINATOR_ADDRESS": coordinator,
+        # reference-compatible aliases
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nproc),
+    })
+    return env
+
+
+def launch_procs(entry: List[str], nproc: int, coordinator: str,
+                 log_dir: str = "log") -> List[ProcInfo]:
+    os.makedirs(log_dir, exist_ok=True)
+    procs = []
+    for rank in range(nproc):
+        env = _build_env(rank, nproc, coordinator, dict(os.environ))
+        log_path = os.path.join(log_dir, f"workerlog.{rank}")
+        log_f = open(log_path, "w")
+        cmd = [sys.executable] + entry
+        p = subprocess.Popen(cmd, env=env, stdout=log_f,
+                             stderr=subprocess.STDOUT)
+        procs.append(ProcInfo(p, rank, log_path))
+    return procs
+
+
+def watch_procs(procs: List[ProcInfo], poll_s: float = 1.0) -> int:
+    """Reference behavior (fleet/elastic.py:36 LauncherInterface
+    _check_procs): any rank failing tears the job down; returns the exit
+    code."""
+    try:
+        while True:
+            alive = 0
+            for info in procs:
+                ret = info.proc.poll()
+                if ret is None:
+                    alive += 1
+                elif ret != 0:
+                    print(f"rank {info.rank} FAILED with code {ret}; "
+                          f"log: {info.log_path}", file=sys.stderr)
+                    terminate_procs(procs)
+                    return ret
+            if alive == 0:
+                return 0
+            time.sleep(poll_s)
+    except KeyboardInterrupt:
+        terminate_procs(procs)
+        return 130
+
+
+def terminate_procs(procs: List[ProcInfo]) -> None:
+    for info in procs:
+        if info.proc.poll() is None:
+            info.proc.terminate()
+    deadline = time.time() + 10
+    for info in procs:
+        try:
+            info.proc.wait(max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            info.proc.kill()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch multi-process (multi-host) training")
+    parser.add_argument("--nproc", "--nnodes", type=int, default=1,
+                        help="number of processes (hosts)")
+    parser.add_argument("--coordinator", type=str,
+                        default="127.0.0.1:12355",
+                        help="coordination service address")
+    parser.add_argument("--log_dir", type=str, default="log")
+    parser.add_argument("--elastic", action="store_true",
+                        help="restart failed jobs from checkpoints")
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    entry = [args.training_script] + args.training_script_args
+    restarts = 0
+    while True:
+        procs = launch_procs(entry, args.nproc, args.coordinator,
+                             args.log_dir)
+        code = watch_procs(procs)
+        if code == 0 or not args.elastic or restarts >= args.max_restarts:
+            return code
+        restarts += 1
+        print(f"elastic: restarting job (attempt {restarts}/"
+              f"{args.max_restarts})", file=sys.stderr)
+        time.sleep(2.0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

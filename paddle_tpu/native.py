@@ -1,0 +1,176 @@
+"""ctypes bindings for the native runtime library (native/ptnative.cc).
+
+Builds the shared library on first use with g++ (pybind11 is not in this
+image; the C ABI + ctypes replaces the reference's pybind layer for these
+components). All entry points degrade gracefully to Python fallbacks when
+the toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import zlib
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO_ROOT, "native", "ptnative.cc")
+_LIB_PATH = os.path.join(_REPO_ROOT, "native", "libptnative.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           _SRC, "-o", _LIB_PATH, "-lpthread", "-lrt"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _LIB_PATH
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired):
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        path = _LIB_PATH
+        if not os.path.exists(path) or (
+                os.path.exists(_SRC) and
+                os.path.getmtime(_SRC) > os.path.getmtime(path)):
+            path = _build()
+        if path is None or not os.path.exists(path):
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.ptq_create.restype = ctypes.c_void_p
+        lib.ptq_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                   ctypes.c_uint64]
+        lib.ptq_open.restype = ctypes.c_void_p
+        lib.ptq_open.argtypes = [ctypes.c_char_p]
+        lib.ptq_push.restype = ctypes.c_int
+        lib.ptq_push.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_uint8),
+                                 ctypes.c_uint64]
+        lib.ptq_pop.restype = ctypes.c_int64
+        lib.ptq_pop.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_uint8),
+                                ctypes.c_uint64]
+        lib.ptq_size.restype = ctypes.c_int
+        lib.ptq_size.argtypes = [ctypes.c_void_p]
+        lib.ptq_close.argtypes = [ctypes.c_void_p]
+        lib.ptq_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_crc32c.restype = ctypes.c_uint32
+        lib.pt_crc32c.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                                  ctypes.c_uint64, ctypes.c_uint32]
+        lib.pt_u8_to_f32_norm.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+class ShmQueue:
+    """Shared-memory ring buffer for raw byte payloads (multiprocess
+    DataLoader transport)."""
+
+    def __init__(self, name: str, slot_size: int = 1 << 22,
+                 n_slots: int = 8, create: bool = True):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("ptnative library unavailable")
+        self._lib = lib
+        self.name = name
+        if create:
+            self._h = lib.ptq_create(name.encode(), slot_size, n_slots)
+        else:
+            self._h = lib.ptq_open(name.encode())
+        if not self._h:
+            raise RuntimeError(f"failed to init ShmQueue {name!r}")
+        self.slot_size = slot_size
+        self._owner = create
+
+    def push(self, payload: bytes) -> None:
+        arr = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        rc = self._lib.ptq_push(self._h, arr, len(payload))
+        if rc == -1:
+            raise RuntimeError("queue closed")
+        if rc == -2:
+            raise ValueError(f"payload {len(payload)} exceeds slot size")
+
+    def push_array(self, arr: np.ndarray) -> None:
+        self.push(arr.tobytes())
+
+    def pop(self, cap: Optional[int] = None) -> Optional[bytes]:
+        cap = cap or self.slot_size
+        buf = (ctypes.c_uint8 * cap)()
+        n = self._lib.ptq_pop(self._h, buf, cap)
+        if n == -1:
+            return None  # closed + drained
+        if n == -2:
+            raise ValueError("pop buffer too small")
+        return bytes(bytearray(buf[:n]))
+
+    def qsize(self) -> int:
+        return self._lib.ptq_size(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ptq_close(self._h)
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.ptq_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+def crc32c(data: bytes, seed: int = 0) -> int:
+    lib = get_lib()
+    if lib is None:  # fall back to zlib crc32 (different poly, still a
+        return zlib.crc32(data, seed) & 0xFFFFFFFF  # valid checksum)
+    arr = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    return int(lib.pt_crc32c(arr, len(data), seed))
+
+
+def u8_to_f32_norm(img: np.ndarray, mean, std) -> np.ndarray:
+    """CHW uint8 image -> normalized float32 (native fused loop)."""
+    lib = get_lib()
+    img = np.ascontiguousarray(img, dtype=np.uint8)
+    c = img.shape[0]
+    hw = int(np.prod(img.shape[1:]))
+    mean = np.asarray(mean, np.float32).ravel()
+    std = np.asarray(std, np.float32).ravel()
+    if mean.size == 1:
+        mean = np.repeat(mean, c)
+    if std.size == 1:
+        std = np.repeat(std, c)
+    if lib is None:
+        return ((img.astype(np.float32) / 255.0 -
+                 mean.reshape(-1, *([1] * (img.ndim - 1)))) /
+                std.reshape(-1, *([1] * (img.ndim - 1))))
+    out = np.empty(img.shape, np.float32)
+    lib.pt_u8_to_f32_norm(
+        img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        c, hw, mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out

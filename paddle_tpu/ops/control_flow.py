@@ -1,0 +1,128 @@
+"""Control-flow ops for traced mode.
+
+Reference parity: paddle/fluid/operators/controlflow/ (while_op.cc,
+conditional_block_op.cc) + python layers/control_flow.py (While, cond,
+case, switch_case). TPU-native: jax.lax primitives — compiler-friendly
+control flow that stays inside one XLA program instead of the reference's
+sub-block interpretation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+def _unwrap(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t.value if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _wrap(tree):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v) if isinstance(v, jax.Array) else v, tree)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
+    """reference: paddle.static.nn.cond / conditional_block_op."""
+    raw_pred = pred.value if isinstance(pred, Tensor) else pred
+    raw_ops = _unwrap(operands)
+
+    def tf(ops):
+        return _unwrap(true_fn(*_wrap(ops)))
+
+    def ff(ops):
+        return _unwrap(false_fn(*_wrap(ops)))
+
+    out = jax.lax.cond(raw_pred, tf, ff, raw_ops)
+    return _wrap(out)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars):
+    """reference: paddle.static.nn.while_loop / while_op.cc."""
+    raw = _unwrap(loop_vars)
+
+    def c(vs):
+        out = cond_fn(*_wrap(vs))
+        return out.value if isinstance(out, Tensor) else out
+
+    def b(vs):
+        return _unwrap(body_fn(*_wrap(vs)))
+
+    out = jax.lax.while_loop(c, b, raw)
+    return _wrap(out)
+
+
+def fori_loop(lower, upper, body_fn: Callable, init):
+    raw = _unwrap(init)
+
+    def b(i, vs):
+        return _unwrap(body_fn(i, _wrap(vs)))
+
+    return _wrap(jax.lax.fori_loop(lower, upper, b, raw))
+
+
+def scan(f: Callable, init, xs, length=None, reverse=False):
+    """Structured loop with stacked outputs — the TPU-friendly replacement
+    for unrolled RNN-style while loops."""
+    raw_init = _unwrap(init)
+    raw_xs = _unwrap(xs)
+
+    def step(carry, x):
+        c, y = f(_wrap(carry), _wrap(x))
+        return _unwrap(c), _unwrap(y)
+
+    carry, ys = jax.lax.scan(step, raw_init, raw_xs, length=length,
+                             reverse=reverse)
+    return _wrap(carry), _wrap(ys)
+
+
+def case(pred_fn_pairs: Sequence, default: Callable = None):
+    """reference: layers/control_flow.py case — first true pred wins."""
+    preds = [p.value if isinstance(p, Tensor) else p
+             for p, _ in pred_fn_pairs]
+    fns = [f for _, f in pred_fn_pairs]
+    if default is None:
+        default = fns[-1]
+
+    idx = jnp.argmax(jnp.stack([jnp.asarray(p, bool) for p in preds]))
+    any_true = jnp.any(jnp.stack([jnp.asarray(p, bool) for p in preds]))
+    branch = jnp.where(any_true, idx, len(fns))
+
+    def mk(fn):
+        return lambda _: _unwrap(fn())
+
+    out = jax.lax.switch(branch, [mk(f) for f in fns] + [mk(default)],
+                         None)
+    return _wrap(out)
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None):
+    """reference: layers/control_flow.py switch_case."""
+    raw_idx = branch_index.value if isinstance(branch_index, Tensor) else \
+        jnp.asarray(branch_index)
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        # map arbitrary keys to dense branch ids
+        table = jnp.asarray(keys)
+        dense = jnp.argmax(table == raw_idx)
+        in_table = jnp.any(table == raw_idx)
+    else:
+        fns = list(branch_fns)
+        dense = raw_idx
+        in_table = (raw_idx >= 0) & (raw_idx < len(fns))
+    if default is None:
+        default = fns[-1]
+
+    def mk(fn):
+        return lambda _: _unwrap(fn())
+
+    branch = jnp.where(in_table, dense, len(fns))
+    out = jax.lax.switch(branch, [mk(f) for f in fns] + [mk(default)], None)
+    return _wrap(out)
