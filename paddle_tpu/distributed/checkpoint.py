@@ -1,0 +1,125 @@
+"""Distributed / async checkpointing over orbax.
+
+Reference parity: the sharding-aware checkpoint paths
+(unittests/dist_sharding_save.py; python/paddle/framework/io.py per-rank
+state_dicts; hapi auto-checkpoint callback). TPU-native: orbax writes
+sharded arrays directly from device (each host saves its shards),
+optionally asynchronously — replacing the reference's per-rank pickles +
+manual re-merge.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _get_checkpointer(use_async: bool = False):
+    import orbax.checkpoint as ocp
+    if use_async:
+        return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return ocp.Checkpointer(ocp.StandardCheckpointHandler())
+
+
+def save_sharded(state: Dict[str, Any], path: str,
+                 use_async: bool = False) -> Optional[object]:
+    """Save a pytree of (possibly sharded) jax arrays. Returns the async
+    handle when use_async (call .wait_until_finished())."""
+    path = os.path.abspath(path)
+    ckptr = _get_checkpointer(use_async)
+    ckptr.save(path, state, force=True)
+    if use_async:
+        return ckptr
+    return None
+
+
+def load_sharded(path: str, target: Optional[Dict[str, Any]] = None,
+                 shardings: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """Restore a pytree; with ``target``/``shardings`` given, arrays are
+    restored directly into those shardings (resharding on read — the
+    capability the reference lacks and recovers via re-merge scripts)."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = _get_checkpointer(False)
+    if target is not None:
+        abstract = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(
+                v.shape, v.dtype,
+                sharding=getattr(v, "sharding", None)), target)
+        return ckptr.restore(path, target=abstract)
+    return ckptr.restore(path)
+
+
+class CheckpointManager:
+    """Rolling checkpoint manager (keep-N, step-indexed, optional async)
+    — the auto-checkpoint/resume loop (reference: hapi/callbacks.py
+    ModelCheckpoint + fleet elastic checkpoint-based recovery)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 use_async: bool = True):
+        import orbax.checkpoint as ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                               enable_async_checkpointing=
+                                               use_async)
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Dict[str, Any]) -> None:
+        import orbax.checkpoint as ocp
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def restore(self, step: Optional[int] = None,
+                target: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        import orbax.checkpoint as ocp
+        step = step if step is not None else self._mgr.latest_step()
+        if target is not None:
+            abstract = jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(
+                    v.shape, v.dtype,
+                    sharding=getattr(v, "sharding", None)), target)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        return self._mgr.restore(step)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return self._mgr.all_steps()
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def save_train_state(step_obj, path: str, step: int,
+                     manager: Optional[CheckpointManager] = None) -> None:
+    """Checkpoint a TrainStep/ShardedTrainStep's full state (params,
+    buffers, optimizer slots) preserving shardings."""
+    state = {"params": step_obj.params, "buffers": step_obj.buffers,
+             "opt_state": step_obj.opt_state}
+    if manager is not None:
+        manager.save(step, state)
+    else:
+        save_sharded(state, path)
+
+
+def restore_train_state(step_obj, path: str = None,
+                        manager: Optional[CheckpointManager] = None,
+                        step: Optional[int] = None) -> None:
+    target = {"params": step_obj.params, "buffers": step_obj.buffers,
+              "opt_state": step_obj.opt_state}
+    if manager is not None:
+        state = manager.restore(step, target=target)
+    else:
+        state = load_sharded(path, target=target)
+    step_obj.params = state["params"]
+    step_obj.buffers = state["buffers"]
+    step_obj.opt_state = state["opt_state"]

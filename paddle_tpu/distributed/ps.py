@@ -1,0 +1,364 @@
+"""Parameter-server training mode.
+
+Reference parity: paddle/fluid/distributed/ (brpc PS:
+service/brpc_ps_server.cc, brpc_ps_client.cc; tables
+table/common_dense_table.cc, common_sparse_table.cc; async grad
+Communicator service/communicator.cc; Python runtime
+fleet/runtime/the_one_ps.py:434).
+
+This build: the same wire protocol shape (push/pull dense + sparse,
+sync/async/geo modes, id-sharded tables across servers) over a
+length-prefixed socket RPC. The transport is Python; the table math is
+numpy — PS mode is a CPU-side capability (huge sparse embeddings), the
+TPU-native mainline is the collective path. Protocol constants mirror
+distributed/ps.proto.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# message kinds (mirrors the PsCmdID idea in distributed/ps.proto)
+PULL_DENSE = "pull_dense"
+PUSH_DENSE = "push_dense"
+PULL_SPARSE = "pull_sparse"
+PUSH_SPARSE = "push_sparse"
+BARRIER = "barrier"
+STOP = "stop"
+STAT = "stat"
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class DenseTable:
+    """reference: table/common_dense_table.cc — full replica on its
+    server, SGD/Adam applied server-side on push_grad."""
+
+    def __init__(self, shape, optimizer: str = "sgd", lr: float = 0.01,
+                 beta1=0.9, beta2=0.999, eps=1e-8):
+        self.value = np.zeros(shape, np.float32)
+        self.optimizer = optimizer
+        self.lr = lr
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self._m = np.zeros(shape, np.float32)
+        self._v = np.zeros(shape, np.float32)
+        self._t = 0
+        self._lock = threading.Lock()
+
+    def init(self, value: np.ndarray) -> None:
+        with self._lock:
+            self.value = np.asarray(value, np.float32).copy()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.value.copy()
+
+    def push_grad(self, grad: np.ndarray) -> None:
+        with self._lock:
+            g = np.asarray(grad, np.float32)
+            if self.optimizer == "adam":
+                self._t += 1
+                self._m = self.beta1 * self._m + (1 - self.beta1) * g
+                self._v = self.beta2 * self._v + (1 - self.beta2) * g * g
+                mh = self._m / (1 - self.beta1 ** self._t)
+                vh = self._v / (1 - self.beta2 ** self._t)
+                self.value -= self.lr * mh / (np.sqrt(vh) + self.eps)
+            else:
+                self.value -= self.lr * g
+
+
+class SparseTable:
+    """reference: table/common_sparse_table.cc — rows created on first
+    access (the trillion-parameter embedding pattern), per-row adagrad."""
+
+    def __init__(self, emb_dim: int, lr: float = 0.01,
+                 initializer_std: float = 0.01, optimizer: str = "adagrad"):
+        self.emb_dim = emb_dim
+        self.lr = lr
+        self.std = initializer_std
+        self.optimizer = optimizer
+        self.rows: Dict[int, np.ndarray] = {}
+        self.accum: Dict[int, np.ndarray] = {}
+        self._lock = threading.Lock()
+        self._rng = np.random.default_rng(0)
+
+    def _row(self, key: int) -> np.ndarray:
+        r = self.rows.get(key)
+        if r is None:
+            r = (self._rng.standard_normal(self.emb_dim) *
+                 self.std).astype(np.float32)
+            self.rows[key] = r
+            self.accum[key] = np.zeros(self.emb_dim, np.float32)
+        return r
+
+    def pull(self, keys: Sequence[int]) -> np.ndarray:
+        with self._lock:
+            return np.stack([self._row(int(k)) for k in keys])
+
+    def push_grad(self, keys: Sequence[int], grads: np.ndarray) -> None:
+        with self._lock:
+            for k, g in zip(keys, np.asarray(grads, np.float32)):
+                k = int(k)
+                self._row(k)
+                if self.optimizer == "adagrad":
+                    self.accum[k] += g * g
+                    self.rows[k] -= self.lr * g / (
+                        np.sqrt(self.accum[k]) + 1e-6)
+                else:
+                    self.rows[k] -= self.lr * g
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self.rows)
+
+
+class PSServer:
+    """reference: service/brpc_ps_server.cc — hosts tables, serves
+    push/pull RPCs on a thread-per-connection server."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.dense: Dict[str, DenseTable] = {}
+        self.sparse: Dict[str, SparseTable] = {}
+        self._barrier_count = 0
+        self._barrier_lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        resp = outer._dispatch(msg)
+                        _send_msg(self.request, resp)
+                        if msg.get("cmd") == STOP:
+                            break
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def add_dense_table(self, name: str, shape, **kw) -> DenseTable:
+        t = DenseTable(shape, **kw)
+        self.dense[name] = t
+        return t
+
+    def add_sparse_table(self, name: str, emb_dim: int, **kw) -> SparseTable:
+        t = SparseTable(emb_dim, **kw)
+        self.sparse[name] = t
+        return t
+
+    def _dispatch(self, msg: Dict) -> Dict:
+        cmd = msg.get("cmd")
+        try:
+            if cmd == PULL_DENSE:
+                return {"ok": True,
+                        "value": self.dense[msg["table"]].pull()}
+            if cmd == PUSH_DENSE:
+                if msg.get("init"):
+                    self.dense[msg["table"]].init(msg["grad"])
+                else:
+                    self.dense[msg["table"]].push_grad(msg["grad"])
+                return {"ok": True}
+            if cmd == PULL_SPARSE:
+                return {"ok": True,
+                        "value": self.sparse[msg["table"]].pull(
+                            msg["keys"])}
+            if cmd == PUSH_SPARSE:
+                self.sparse[msg["table"]].push_grad(msg["keys"],
+                                                    msg["grad"])
+                return {"ok": True}
+            if cmd == STAT:
+                return {"ok": True,
+                        "dense": list(self.dense),
+                        "sparse": {k: v.size()
+                                   for k, v in self.sparse.items()}}
+            if cmd == BARRIER:
+                with self._barrier_lock:
+                    self._barrier_count += 1
+                    n = self._barrier_count
+                return {"ok": True, "count": n}
+            if cmd == STOP:
+                return {"ok": True}
+        except KeyError as e:
+            return {"ok": False, "error": f"unknown table {e}"}
+        return {"ok": False, "error": f"unknown cmd {cmd!r}"}
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+class PSClient:
+    """reference: service/brpc_ps_client.cc — connects to all servers;
+    sparse keys shard by key %% n_servers, dense tables live on
+    table-hash-selected servers."""
+
+    def __init__(self, endpoints: Sequence[str]):
+        self.endpoints = list(endpoints)
+        self._socks: List[socket.socket] = []
+        self._locks: List[threading.Lock] = []
+        for ep in self.endpoints:
+            host, _, port = ep.partition(":")
+            s = socket.create_connection((host, int(port)), timeout=30)
+            self._socks.append(s)
+            self._locks.append(threading.Lock())
+
+    def _call(self, server: int, msg: Dict) -> Dict:
+        with self._locks[server]:
+            _send_msg(self._socks[server], msg)
+            resp = _recv_msg(self._socks[server])
+        if not resp.get("ok"):
+            raise RuntimeError(resp.get("error"))
+        return resp
+
+    def _dense_server(self, table: str) -> int:
+        return hash(table) % len(self.endpoints)
+
+    def push_dense_init(self, table: str, value: np.ndarray) -> None:
+        self._call(self._dense_server(table),
+                   {"cmd": PUSH_DENSE, "table": table, "grad": value,
+                    "init": True})
+
+    def pull_dense(self, table: str) -> np.ndarray:
+        return self._call(self._dense_server(table),
+                          {"cmd": PULL_DENSE, "table": table})["value"]
+
+    def push_dense_grad(self, table: str, grad: np.ndarray) -> None:
+        self._call(self._dense_server(table),
+                   {"cmd": PUSH_DENSE, "table": table, "grad": grad})
+
+    def pull_sparse(self, table: str, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, np.int64).ravel()
+        n = len(self.endpoints)
+        out = np.zeros((keys.size, 0), np.float32)
+        results: Dict[int, np.ndarray] = {}
+        for srv in range(n):
+            mask = (keys % n) == srv
+            if not mask.any():
+                continue
+            vals = self._call(srv, {"cmd": PULL_SPARSE, "table": table,
+                                    "keys": keys[mask].tolist()})["value"]
+            results[srv] = vals
+        dim = next(iter(results.values())).shape[1]
+        full = np.zeros((keys.size, dim), np.float32)
+        for srv, vals in results.items():
+            full[(keys % n) == srv] = vals
+        return full
+
+    def push_sparse_grad(self, table: str, keys: np.ndarray,
+                         grads: np.ndarray) -> None:
+        keys = np.asarray(keys, np.int64).ravel()
+        grads = np.asarray(grads, np.float32)
+        n = len(self.endpoints)
+        for srv in range(n):
+            mask = (keys % n) == srv
+            if not mask.any():
+                continue
+            self._call(srv, {"cmd": PUSH_SPARSE, "table": table,
+                             "keys": keys[mask].tolist(),
+                             "grad": grads[mask]})
+
+    def barrier(self) -> None:
+        for srv in range(len(self.endpoints)):
+            self._call(srv, {"cmd": BARRIER})
+
+    def stop(self) -> None:
+        for srv in range(len(self.endpoints)):
+            try:
+                self._call(srv, {"cmd": STOP})
+            except Exception:
+                pass
+        for s in self._socks:
+            s.close()
+
+
+class AsyncCommunicator:
+    """reference: service/communicator.cc — background thread draining a
+    send queue of dense grads (async SGD mode; a_sync_configs)."""
+
+    def __init__(self, client: PSClient, send_wait_s: float = 0.01,
+                 max_queue: int = 64):
+        self.client = client
+        self._queue: List[Tuple[str, np.ndarray]] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wait = send_wait_s
+        self._max = max_queue
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def push(self, table: str, grad: np.ndarray) -> None:
+        with self._lock:
+            if len(self._queue) >= self._max:
+                # merge oldest grads per table (max_merge_var_num analog)
+                self._flush_locked()
+            self._queue.append((table, np.asarray(grad)))
+
+    def _flush_locked(self) -> None:
+        merged: Dict[str, np.ndarray] = {}
+        for t, g in self._queue:
+            merged[t] = merged.get(t, 0) + g
+        self._queue.clear()
+        for t, g in merged.items():
+            self.client.push_dense_grad(t, g)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.flush()
+            self._stop.wait(self._wait)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.flush()

@@ -1,0 +1,152 @@
+"""BERT encoder family (masked-LM + classification heads).
+
+Reference parity: the BERT configs driven by the reference's static-graph
+pretrain benchmarks (BASELINE.md config #2) and its dygraph_to_static
+test_bert.py model. Built on the shared TransformerEncoder stack so it
+exercises the same attention/layernorm kernels as GPT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .. import dispatch
+from ..nn.common import Dropout, Embedding, Linear
+from ..nn.layer import Layer
+from ..nn.norm import LayerNorm
+from ..nn.transformer import TransformerEncoder, TransformerEncoderLayer
+
+F = dispatch.wrapped_ops
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+
+def bert_tiny(**kw):
+    return BertConfig(vocab_size=1024, hidden_size=128,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      intermediate_size=256, max_position_embeddings=128,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0, **kw)
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_large(**kw):
+    return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                      num_attention_heads=16, intermediate_size=4096, **kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size)
+        self.position_embeddings = Embedding(c.max_position_embeddings,
+                                             c.hidden_size)
+        self.token_type_embeddings = Embedding(c.type_vocab_size,
+                                               c.hidden_size)
+        self.layer_norm = LayerNorm(c.hidden_size,
+                                    epsilon=c.layer_norm_eps)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = F["expand"](F["unsqueeze"](
+                F["arange"](s, dtype="int32"), 0), (b, s))
+        if token_type_ids is None:
+            token_type_ids = F["zeros"]((b, s), dtype="int32")
+        emb = (self.word_embeddings(input_ids) +
+               self.position_embeddings(position_ids) +
+               self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        self.embeddings = BertEmbeddings(c)
+        enc_layer = TransformerEncoderLayer(
+            c.hidden_size, c.num_attention_heads, c.intermediate_size,
+            dropout=c.hidden_dropout_prob, activation=c.hidden_act,
+            attn_dropout=c.attention_probs_dropout_prob)
+        self.encoder = TransformerEncoder(enc_layer, c.num_hidden_layers)
+        self.pooler = Linear(c.hidden_size, c.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, S] padding mask -> additive [B, 1, 1, S]
+            m = F["unsqueeze"](F["unsqueeze"](attention_mask, 1), 1)
+            attention_mask = (1.0 - F["cast"](m, "float32")) * -1e9
+        seq_out = self.encoder(x, src_mask=attention_mask)
+        pooled = F["tanh"](self.pooler(seq_out[:, 0]))
+        return seq_out, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (the reference pretrain benchmark config)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.bert = BertModel(c)
+        self.mlm_transform = Linear(c.hidden_size, c.hidden_size)
+        self.mlm_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.nsp_head = Linear(c.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, masked_positions=None,
+                labels=None, next_sentence_labels=None,
+                attention_mask=None):
+        seq_out, pooled = self.bert(input_ids, token_type_ids,
+                                    attention_mask=attention_mask)
+        h = self.mlm_norm(F["gelu"](self.mlm_transform(seq_out)))
+        mlm_logits = F["matmul"](
+            h, self.bert.embeddings.word_embeddings.weight,
+            transpose_y=True)
+        nsp_logits = self.nsp_head(pooled)
+        if labels is None:
+            return mlm_logits, nsp_logits
+        mlm_loss = F["cross_entropy"](mlm_logits, labels,
+                                      ignore_index=-100)
+        loss = mlm_loss
+        if next_sentence_labels is not None:
+            loss = loss + F["cross_entropy"](nsp_logits,
+                                             next_sentence_labels)
+        return loss
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return F["cross_entropy"](logits, labels)

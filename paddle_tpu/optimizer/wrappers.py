@@ -1,0 +1,155 @@
+"""Optimizer wrappers: EMA, ModelAverage, Lookahead.
+
+Reference parity: python/paddle/fluid/optimizer.py
+(ExponentialMovingAverage:3882, ModelAverage:3573, LookaheadOptimizer:5969).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Parameter, Tensor
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters; apply()/restore() swaps them in for eval
+    (reference: fluid/optimizer.py:3882)."""
+
+    def __init__(self, parameters_or_layer, decay: float = 0.999,
+                 thres_steps=None):
+        if hasattr(parameters_or_layer, "parameters"):
+            self._params = list(parameters_or_layer.parameters())
+        else:
+            self._params = list(parameters_or_layer)
+        self._decay = decay
+        self._shadow: Dict[int, jnp.ndarray] = {
+            id(p): jnp.array(p.value, copy=True) for p in self._params}
+        self._backup: Dict[int, jnp.ndarray] = {}
+        self._step = 0
+
+    def update(self) -> None:
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1.0 - d) * p.value
+
+    def apply(self, restore: bool = True) -> None:
+        for p in self._params:
+            self._backup[id(p)] = p.value
+            p.value = self._shadow[id(p)].astype(p.dtype)
+
+    def restore(self) -> None:
+        for p in self._params:
+            if id(p) in self._backup:
+                p.value = self._backup.pop(id(p))
+
+    @contextlib.contextmanager
+    def apply_guard(self):
+        self.apply()
+        try:
+            yield
+        finally:
+            self.restore()
+
+    def state_dict(self):
+        return {f"shadow_{i}": Tensor(self._shadow[id(p)])
+                for i, p in enumerate(self._params)} | {
+                    "step": self._step}
+
+    def set_state_dict(self, state):
+        self._step = int(state.get("step", 0))
+        for i, p in enumerate(self._params):
+            v = state.get(f"shadow_{i}")
+            if v is not None:
+                self._shadow[id(p)] = jnp.asarray(
+                    v.value if isinstance(v, Tensor) else v)
+
+
+class ModelAverage:
+    """Sliding-window parameter average
+    (reference: fluid/optimizer.py:3573)."""
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 parameters: Optional[List[Parameter]] = None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000):
+        self._params = list(parameters or [])
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._sum = {id(p): jnp.zeros_like(p.value) for p in self._params}
+        self._count = 0
+        self._backup: Dict[int, jnp.ndarray] = {}
+
+    def step(self) -> None:
+        self._count += 1
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p.value
+        window = max(self._min_w, min(self._max_w,
+                                      int(self._count * self._rate) or 1))
+        if self._count > window:
+            # decay old contributions geometrically
+            scale = window / self._count
+            for p in self._params:
+                self._sum[id(p)] = self._sum[id(p)] * scale
+            self._count = window
+
+    def apply(self) -> None:
+        for p in self._params:
+            self._backup[id(p)] = p.value
+            p.value = (self._sum[id(p)] / max(self._count, 1)).astype(
+                p.dtype)
+
+    def restore(self) -> None:
+        for p in self._params:
+            if id(p) in self._backup:
+                p.value = self._backup.pop(id(p))
+
+    @contextlib.contextmanager
+    def apply_guard(self):
+        self.apply()
+        try:
+            yield
+        finally:
+            self.restore()
+
+
+class Lookahead:
+    """Lookahead wrapper: slow weights track fast weights every k steps
+    (reference: fluid/optimizer.py:5969 LookaheadOptimizer)."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow: Optional[Dict[int, jnp.ndarray]] = None
+        self._steps = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def step(self) -> None:
+        params = self.inner._parameter_list or []
+        if self._slow is None:
+            self._slow = {id(p): jnp.array(p.value, copy=True)
+                          for p in params}
+        self.inner.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for p in params:
+                slow = self._slow[id(p)] + self.alpha * (
+                    p.value - self._slow[id(p)])
+                self._slow[id(p)] = slow
+                p.value = slow.astype(p.dtype)
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()

@@ -1,0 +1,227 @@
+"""PS mode, distributed checkpoint, optimizer wrappers, BERT tests."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer as optim
+
+
+# -- parameter server ---------------------------------------------------------
+
+def test_ps_dense_pull_push():
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+
+    srv = PSServer()
+    srv.add_dense_table("w", (4,), optimizer="sgd", lr=0.1)
+    srv.start()
+    try:
+        client = PSClient([srv.endpoint])
+        client.push_dense_init("w", np.ones(4, np.float32))
+        np.testing.assert_allclose(client.pull_dense("w"), np.ones(4))
+        client.push_dense_grad("w", np.full(4, 2.0, np.float32))
+        np.testing.assert_allclose(client.pull_dense("w"),
+                                   np.full(4, 0.8), rtol=1e-6)
+        client.stop()
+    finally:
+        srv.stop()
+
+
+def test_ps_sparse_sharded_across_servers():
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+
+    servers = [PSServer(), PSServer()]
+    for s in servers:
+        s.add_sparse_table("emb", emb_dim=8, lr=0.5, optimizer="sgd")
+        s.start()
+    try:
+        client = PSClient([s.endpoint for s in servers])
+        keys = np.array([0, 1, 2, 3, 10, 11])
+        rows = client.pull_sparse("emb", keys)
+        assert rows.shape == (6, 8)
+        # push grads and verify rows move
+        grads = np.ones((6, 8), np.float32)
+        client.push_sparse_grad("emb", keys, grads)
+        rows2 = client.pull_sparse("emb", keys)
+        np.testing.assert_allclose(rows2, rows - 0.5, rtol=1e-5)
+        # rows landed on both servers
+        assert servers[0].sparse["emb"].size() > 0
+        assert servers[1].sparse["emb"].size() > 0
+        client.stop()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_ps_async_communicator():
+    from paddle_tpu.distributed.ps import (AsyncCommunicator, PSClient,
+                                           PSServer)
+
+    srv = PSServer()
+    srv.add_dense_table("w", (2,), lr=1.0)
+    srv.start()
+    try:
+        client = PSClient([srv.endpoint])
+        client.push_dense_init("w", np.zeros(2, np.float32))
+        comm = AsyncCommunicator(client, send_wait_s=0.01)
+        comm.start()
+        for _ in range(5):
+            comm.push("w", np.ones(2, np.float32))
+        comm.stop()
+        np.testing.assert_allclose(client.pull_dense("w"),
+                                   np.full(2, -5.0), rtol=1e-6)
+        client.stop()
+    finally:
+        srv.stop()
+
+
+def test_ps_end_to_end_training():
+    """Sparse embedding regression trained via PS pull/push converges."""
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+
+    srv = PSServer()
+    srv.add_sparse_table("emb", emb_dim=4, lr=0.3, optimizer="sgd",
+                         initializer_std=0.1)
+    srv.start()
+    try:
+        client = PSClient([srv.endpoint])
+        rng = np.random.default_rng(0)
+        target = rng.standard_normal((8, 4)).astype(np.float32)
+        for _ in range(60):
+            keys = rng.integers(0, 8, 16)
+            rows = client.pull_sparse("emb", keys)
+            grad = 2 * (rows - target[keys])  # d/dr ||r - t||^2
+            client.push_sparse_grad("emb", keys, grad)
+        final = client.pull_sparse("emb", np.arange(8))
+        assert np.abs(final - target).mean() < 0.1
+        client.stop()
+    finally:
+        srv.stop()
+
+
+# -- distributed checkpoint ---------------------------------------------------
+
+def test_orbax_checkpoint_roundtrip():
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.checkpoint import (load_sharded,
+                                                   save_sharded)
+
+    state = {"w": jnp.arange(8.0), "nested": {"b": jnp.ones((2, 2))}}
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ckpt")
+        save_sharded(state, path)
+        restored = load_sharded(path)
+        np.testing.assert_allclose(np.asarray(restored["w"]),
+                                   np.arange(8.0))
+        np.testing.assert_allclose(np.asarray(restored["nested"]["b"]),
+                                   np.ones((2, 2)))
+
+
+def test_checkpoint_manager_trainstep_resume():
+    from paddle_tpu.distributed.checkpoint import (CheckpointManager,
+                                                   restore_train_state,
+                                                   save_train_state)
+    from paddle_tpu.jit import TrainStep
+
+    X = np.random.default_rng(0).standard_normal((16, 4)).astype(np.float32)
+    Y = np.random.default_rng(1).standard_normal((16, 1)).astype(np.float32)
+    mse = nn.MSELoss()
+
+    pt.seed(0)
+    net = nn.Linear(4, 1)
+    step = TrainStep(net, optim.Adam(learning_rate=0.05),
+                     lambda m, b: mse(m(b[0]), b[1]))
+    for _ in range(3):
+        step((X, Y))
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d, max_to_keep=2, use_async=False)
+        save_train_state(step, None, step=3, manager=mgr)
+        mgr.wait_until_finished()
+        # train further, then restore back to step 3
+        loss_at_3 = float(step((X, Y)))
+        restore_train_state(step, manager=mgr, step=3)
+        loss_resumed = float(step((X, Y)))
+        np.testing.assert_allclose(loss_resumed, loss_at_3, rtol=1e-5)
+        assert mgr.latest_step() == 3
+        mgr.close()
+
+
+# -- optimizer wrappers -------------------------------------------------------
+
+def test_ema():
+    from paddle_tpu.optimizer.wrappers import ExponentialMovingAverage
+
+    p = pt.Parameter(np.array([0.0], np.float32))
+    ema = ExponentialMovingAverage([p], decay=0.5)
+    p.value = p.value + 1.0
+    ema.update()
+    p.value = p.value + 1.0
+    ema.update()
+    with ema.apply_guard():
+        shadowed = float(p.numpy()[0])
+    assert 0.0 < shadowed < 2.0
+    assert float(p.numpy()[0]) == 2.0  # restored
+
+
+def test_lookahead():
+    from paddle_tpu.optimizer.wrappers import Lookahead
+
+    w = pt.Parameter(np.array([4.0], np.float32))
+    inner = optim.SGD(learning_rate=0.1, parameters=[w])
+    look = Lookahead(inner, alpha=0.5, k=2)
+    for _ in range(4):
+        (w * w).sum().backward()
+        look.step()
+        look.clear_grad()
+    assert abs(float(w.numpy()[0])) < 4.0
+
+
+def test_model_average():
+    from paddle_tpu.optimizer.wrappers import ModelAverage
+
+    p = pt.Parameter(np.array([0.0], np.float32))
+    ma = ModelAverage(parameters=[p], min_average_window=10,
+                      max_average_window=100)
+    for v in [1.0, 2.0, 3.0]:
+        p.value = np.array([v], np.float32)
+        ma.step()
+    with ma.apply_guard():
+        np.testing.assert_allclose(p.numpy(), [2.0], rtol=1e-6)
+
+
+# -- BERT ---------------------------------------------------------------------
+
+def test_bert_forward_and_loss():
+    from paddle_tpu.models.bert import (BertForPretraining,
+                                        BertForSequenceClassification,
+                                        bert_tiny)
+
+    cfg = bert_tiny()
+    ids = pt.to_tensor((np.arange(2 * 16) % 100).reshape(2, 16))
+    model = BertForPretraining(cfg)
+    labels = pt.to_tensor((np.arange(2 * 16) % 100).reshape(2, 16))
+    nsp = pt.to_tensor(np.array([0, 1]))
+    loss = model(ids, labels=labels, next_sentence_labels=nsp)
+    assert np.isfinite(float(loss.numpy()))
+    loss.backward()
+    assert model.bert.embeddings.word_embeddings.weight.grad is not None
+
+    clf = BertForSequenceClassification(cfg, num_classes=3)
+    logits = clf(ids)
+    assert logits.shape == (2, 3)
+
+
+def test_bert_attention_mask():
+    from paddle_tpu.models.bert import BertModel, bert_tiny
+
+    cfg = bert_tiny()
+    model = BertModel(cfg)
+    model.eval()
+    ids = pt.to_tensor((np.arange(2 * 8) % 100).reshape(2, 8))
+    mask = pt.to_tensor(np.array([[1] * 8, [1] * 4 + [0] * 4]))
+    seq, pooled = model(ids, attention_mask=mask)
+    assert seq.shape == (2, 8, cfg.hidden_size)
+    assert pooled.shape == (2, cfg.hidden_size)
