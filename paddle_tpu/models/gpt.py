@@ -52,6 +52,11 @@ class GPTConfig:
     use_flash_attention: bool = True
     seq_parallel_mode: Optional[str] = None  # None | "ring" | "ulysses"
     dtype: str = "float32"
+    # MoE (beyond-reference): every `moe_every`-th block uses an
+    # expert-parallel MoE FFN when moe_experts > 0
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
 
     @property
     def head_dim(self):
@@ -142,14 +147,22 @@ class GPTBlock(Layer):
     """Pre-norm transformer block; uniform across the stack so pipeline
     stages can scan a stacked params pytree."""
 
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
         super().__init__()
         self.ln_1 = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_epsilon)
         self.attn = GPTAttention(config)
         self.ln_2 = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_epsilon)
-        self.mlp = GPTMLP(config)
+        if (config.moe_experts > 0 and
+                layer_idx % config.moe_every == config.moe_every - 1):
+            from ..distributed.moe import MoELayer
+            self.mlp = MoELayer(
+                config.hidden_size,
+                config.ffn_hidden_mult * config.hidden_size,
+                num_experts=config.moe_experts, top_k=config.moe_top_k)
+        else:
+            self.mlp = GPTMLP(config)
         self.dropout = Dropout(config.dropout)
 
     def forward(self, x, cache=None, use_cache=False):
@@ -173,7 +186,8 @@ class GPTModel(Layer):
         self.wpe = Embedding(c.max_seq_len, c.hidden_size)
         self.wpe.weight.pspec = P()
         self.drop = Dropout(c.dropout)
-        self.h = LayerList([GPTBlock(c) for _ in range(c.num_layers)])
+        self.h = LayerList([GPTBlock(c, i)
+                            for i in range(c.num_layers)])
         self.ln_f = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None, caches=None,
@@ -239,8 +253,15 @@ class GPTForCausalLM(Layer):
         # next-token LM loss
         shift_logits = logits[:, :-1]
         shift_labels = labels[:, 1:]
-        loss = self.loss_fn(shift_logits, shift_labels)
-        return F["mean"](loss)
+        loss = F["mean"](self.loss_fn(shift_logits, shift_labels))
+        # MoE load-balancing aux losses, if any blocks are MoE
+        for block in self.gpt.h:
+            aux = getattr(block.mlp, "aux_loss", None)
+            if aux is not None:
+                a = block.mlp.aux_loss()
+                if a is not None:
+                    loss = loss + a
+        return loss
 
     def generate(self, input_ids, max_new_tokens: int = 20,
                  temperature: float = 1.0, top_k: Optional[int] = None,

@@ -1,0 +1,198 @@
+"""Pipeline-parallel GPT training step.
+
+The reference trains GPT-class models with static pipeline parallelism
+(PipelineOptimizer fluid/optimizer.py:4134 splitting the program into
+per-stage sections + SectionWorker microbatch schedules
+section_worker.cc:130-180). TPU-native: GPT blocks are uniform, so the
+whole stack is ONE stacked [n_layers, ...] params pytree sharded over the
+"pp" mesh axis; inside shard_map each device scans its local blocks and
+spmd_pipeline rotates microbatch activations around the pp ring. jax.grad
+through the loop reverses the permutes (F-then-B); remat on the stage fn
+gives the 1F1B-like memory profile.
+
+Embedding/head run replicated on every stage (cheap vs the blocks), which
+also implements the reference's tied-embedding weight sync
+(pp_layers.py:180-188) for free: there is only one copy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..autograd.engine import no_grad
+from ..nn.layer import bind_state, functional_state
+from ..tensor import Tensor
+from ..distributed.pp import spmd_pipeline
+from .gpt import GPTConfig, GPTForCausalLM
+
+
+def _split_block_params(params: Dict[str, jax.Array], num_layers: int
+                        ) -> Tuple[Dict[str, jax.Array],
+                                   Dict[str, jax.Array]]:
+    """Separate per-block params (stacked over a leading layer dim) from
+    the shared embedding/head/final-norm params."""
+    block_suffixes = sorted({k.split(".", 3)[3]
+                             for k in params if k.startswith("gpt.h.")})
+    stacked = {}
+    for suffix in block_suffixes:
+        stacked[suffix] = jnp.stack(
+            [params[f"gpt.h.{i}.{suffix}"] for i in range(num_layers)])
+    shared = {k: v for k, v in params.items() if not k.startswith("gpt.h.")}
+    return stacked, shared
+
+
+def _merge_block_params(stacked: Dict[str, jax.Array],
+                        shared: Dict[str, jax.Array], num_layers: int
+                        ) -> Dict[str, jax.Array]:
+    out = dict(shared)
+    for suffix, v in stacked.items():
+        for i in range(num_layers):
+            out[f"gpt.h.{i}.{suffix}"] = v[i]
+    return out
+
+
+class GPTPipelineTrainStep:
+    """shard_map(pp × dp) train step for GPTForCausalLM."""
+
+    def __init__(self, config: GPTConfig, optimizer, pp: int, dp: int = 1,
+                 n_micro: int = 2, devices=None, remat: bool = False,
+                 seed: int = 0):
+        assert config.num_layers % pp == 0, "layers must divide pp"
+        assert config.dropout == 0.0 and config.attn_dropout == 0.0, \
+            "pipeline step requires dropout=0 (rng is not plumbed per-stage)"
+        self.config = config
+        self.optimizer = optimizer
+        self.n_micro = n_micro
+        import paddle_tpu as pt
+        pt.seed(seed)
+        self.model = GPTForCausalLM(config)
+        self.model.eval()  # dropout off; training math identical
+        devices = list(devices if devices is not None else jax.devices())
+        dev = np.asarray(devices[:pp * dp]).reshape(pp, dp)
+        self.mesh = Mesh(dev, ("pp", "dp"))
+        state = functional_state(self.model)
+        stacked, shared = _split_block_params(state["params"],
+                                              config.num_layers)
+        self.stacked = jax.device_put(
+            stacked, NamedSharding(self.mesh, P("pp")))
+        self.shared = jax.device_put(
+            shared, NamedSharding(self.mesh, P()))
+        params = {"stacked": self.stacked, "shared": self.shared}
+        self.opt_state = jax.device_put(
+            optimizer.init(params),
+            NamedSharding(self.mesh, P()))
+        # keep slot shardings aligned with params (stacked slots on pp)
+        self.opt_state = optimizer.init(params)
+
+        self._step = self._build(remat)
+
+    # -- functional pieces ----------------------------------------------------
+
+    def _embed(self, shared, ids):
+        model = self.model
+        with bind_state(model, {"params": shared, "buffers": {}}), \
+                no_grad():
+            b, s = ids.shape
+            import paddle_tpu.dispatch as dispatch
+            F = dispatch.wrapped_ops
+            pos = F["arange"](s, dtype="int32")
+            pos = F["expand"](F["unsqueeze"](pos, 0), (b, s))
+            x = model.gpt.wte(Tensor(ids)) + model.gpt.wpe(pos)
+            return x.value
+
+    def _head_loss(self, shared, hidden, labels):
+        model = self.model
+        with bind_state(model, {"params": shared, "buffers": {}}), \
+                no_grad():
+            h = model.gpt.ln_f(Tensor(hidden))
+            logits = model.logits(h)
+            import paddle_tpu.dispatch as dispatch
+            F = dispatch.wrapped_ops
+            loss = F["mean"](model.loss_fn(logits[:, :-1],
+                                           Tensor(labels)[:, 1:]))
+            return loss.value
+
+    def _block_apply(self, blk_params, x):
+        """Apply ONE block given its unstacked param dict."""
+        block = self.model.gpt.h[0]
+        named = {k: v for k, v in blk_params.items()}
+        with bind_state(block, {"params": named, "buffers": {}}), \
+                no_grad():
+            return block(Tensor(x)).value
+
+    def _build(self, remat: bool):
+        n_micro = self.n_micro
+        layers_per_stage = self.config.num_layers // self.mesh.shape["pp"]
+        block_apply = self._block_apply
+        embed = self._embed
+        head_loss = self._head_loss
+        optimizer = self.optimizer
+        mesh = self.mesh
+
+        def stage_fn(blocks_local, x):
+            # blocks_local: dict of [L/pp, ...]; scan across local layers
+            def body(h, blk):
+                return block_apply(blk, h), None
+            h, _ = jax.lax.scan(body, x, blocks_local)
+            return h
+
+        sfn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+        def loss_fn(stacked, shared, ids, labels):
+            def inner(stacked_l, shared_l, ids_l, labels_l):
+                # stacked_l: [L/pp, ...] local blocks; ids_l: dp-local batch
+                x = embed(shared_l, ids_l)  # [mb*nm, s, h]
+                b = x.shape[0]
+                mb = b // n_micro
+                x_micro = x.reshape(n_micro, mb, *x.shape[1:])
+                outs = spmd_pipeline(lambda bp, xm: sfn(bp, xm),
+                                     stacked_l, x_micro, axis_name="pp")
+                hidden = outs.reshape(b, *x.shape[1:])
+                loss = head_loss(shared_l, hidden, labels_l)
+                # only the last stage's loss is real; psum broadcasts it
+                n_stages = jax.lax.axis_size("pp")
+                stage = jax.lax.axis_index("pp")
+                loss = jnp.where(stage == n_stages - 1, loss, 0.0)
+                loss = jax.lax.psum(loss, "pp")
+                loss = jax.lax.pmean(loss, "dp")
+                return loss
+
+            smapped = shard_map(
+                inner, mesh=mesh,
+                in_specs=(P("pp"), P(), P("dp"), P("dp")),
+                out_specs=P(), check_vma=False)
+            return smapped(stacked, shared, ids, labels)
+
+        def step_impl(params, opt_state, lr, ids, labels):
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(p["stacked"], p["shared"], ids, labels))(
+                    params)
+            # check_vma=False skips the automatic replication-sum for
+            # grads of replicated/pp-sharded inputs; psums were made
+            # explicit in loss_fn, and GSPMD resolves grad shardings here.
+            new_params, new_opt = optimizer.apply_gradients(
+                params, grads, opt_state, lr=lr)
+            return new_params, new_opt, loss
+
+        return jax.jit(step_impl, donate_argnums=(0, 1))
+
+    def __call__(self, ids, labels) -> jax.Array:
+        params = {"stacked": self.stacked, "shared": self.shared}
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        params, self.opt_state, loss = self._step(
+            params, self.opt_state, lr, jnp.asarray(ids),
+            jnp.asarray(labels))
+        self.stacked = params["stacked"]
+        self.shared = params["shared"]
+        return loss
+
+    def merged_params(self) -> Dict[str, jax.Array]:
+        return _merge_block_params(self.stacked, self.shared,
+                                   self.config.num_layers)
