@@ -41,11 +41,30 @@ def _act_spec(ndim, hidden_axis=None):
                  [hidden_axis])
 
 
+import contextlib as _contextlib
+
+_constraints_disabled = False
+
+
+@_contextlib.contextmanager
+def no_sharding_constraints():
+    """Disable activation constraints (for computations running on a mesh
+    other than the global hybrid mesh, e.g. the pipeline pp x dp mesh)."""
+    global _constraints_disabled
+    prev = _constraints_disabled
+    _constraints_disabled = True
+    try:
+        yield
+    finally:
+        _constraints_disabled = prev
+
+
 def _constrain(x, *spec):
     """Apply a sharding constraint when a mesh is active (inside pjit)."""
     hcg = get_hybrid_communicate_group()
     from jax._src import core as _jax_core
-    if hcg is None or _jax_core.trace_state_clean():
+    if hcg is None or _constraints_disabled or \
+            _jax_core.trace_state_clean():
         return x
     raw = x.value if isinstance(x, Tensor) else x
     out = jax.lax.with_sharding_constraint(

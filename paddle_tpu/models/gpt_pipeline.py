@@ -85,10 +85,7 @@ class GPTPipelineTrainStep:
         self.shared = jax.device_put(
             shared, NamedSharding(self.mesh, P()))
         params = {"stacked": self.stacked, "shared": self.shared}
-        self.opt_state = jax.device_put(
-            optimizer.init(params),
-            NamedSharding(self.mesh, P()))
-        # keep slot shardings aligned with params (stacked slots on pp)
+        # slots inherit their param's sharding (stacked slots ride pp)
         self.opt_state = optimizer.init(params)
 
         self._step = self._build(remat)
@@ -171,9 +168,11 @@ class GPTPipelineTrainStep:
             return smapped(stacked, shared, ids, labels)
 
         def step_impl(params, opt_state, lr, ids, labels):
-            loss, grads = jax.value_and_grad(
-                lambda p: loss_fn(p["stacked"], p["shared"], ids, labels))(
-                    params)
+            from ..distributed.mp_layers import no_sharding_constraints
+            with no_sharding_constraints():
+                loss, grads = jax.value_and_grad(
+                    lambda p: loss_fn(p["stacked"], p["shared"], ids,
+                                      labels))(params)
             # check_vma=False skips the automatic replication-sum for
             # grads of replicated/pp-sharded inputs; psums were made
             # explicit in loss_fn, and GSPMD resolves grad shardings here.
