@@ -42,9 +42,30 @@ def __getattr__(name):
     import importlib
     if name in ("nn", "optimizer", "amp", "io", "static", "jit",
                 "distributed", "metric", "vision", "models", "hapi",
-                "framework", "inference", "autograd", "ops", "profiler"):
+                "framework", "inference", "autograd", "ops", "profiler",
+                "quantization", "sparsity", "text", "native"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | {
+        "nn", "optimizer", "amp", "io", "static", "jit", "distributed",
+        "metric", "vision", "models", "hapi", "framework", "inference",
+        "autograd", "ops", "quantization", "sparsity", "text", "native"})
+
+
+def Model(*args, **kwargs):
+    from .hapi import Model as _M
+    return _M(*args, **kwargs)
+
+
+def DataParallel(*args, **kwargs):
+    from .distributed.parallel import DataParallel as _DP
+    return _DP(*args, **kwargs)
+
+
+from .autograd.engine import set_grad_enabled  # noqa: E402
 
 
 def save(obj, path, **kwargs):

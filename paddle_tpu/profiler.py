@@ -1,0 +1,57 @@
+"""paddle_tpu.profiler — profiling facade.
+
+Reference parity: python/paddle/utils/profiler.py + fluid/profiler.py
+context managers over the C++ event collector (platform/profiler.h). Host
+events come from core.profiler; device traces delegate to jax.profiler
+(XLA/TPU trace -> TensorBoard / Perfetto).
+"""
+
+import contextlib
+
+import jax
+
+from .core.profiler import (RecordEvent, disable_profiler, enable_profiler,
+                            export_chrome_trace, profiler_guard,
+                            profiler_events, reset_profiler)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None,
+             trace_dir=None):
+    """reference: fluid.profiler.profiler context manager."""
+    with profiler_guard(trace_dir=trace_dir):
+        yield
+    if profile_path:
+        export_chrome_trace(profile_path)
+
+
+def start_profiler(state="All", trace_dir=None):
+    enable_profiler()
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None, trace_dir=False):
+    if trace_dir:
+        jax.profiler.stop_trace()
+    disable_profiler()
+    if profile_path:
+        export_chrome_trace(profile_path)
+
+
+def summary(top_k=20):
+    """Aggregate host events by name: count/total/mean microseconds."""
+    events = profiler_events()
+    agg = {}
+    for e in events:
+        dur = e.end_us - e.start_us
+        cnt, tot = agg.get(e.name, (0, 0.0))
+        agg[e.name] = (cnt + 1, tot + dur)
+    rows = sorted(((name, c, t, t / c) for name, (c, t) in agg.items()),
+                  key=lambda r: -r[2])[:top_k]
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(us)':>14}{'Avg(us)':>12}"]
+    for name, c, t, avg in rows:
+        lines.append(f"{name:<40}{c:>8}{t:>14.1f}{avg:>12.1f}")
+    out = "\n".join(lines)
+    print(out)
+    return rows

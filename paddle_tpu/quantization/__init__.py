@@ -1,0 +1,15 @@
+"""Quantization: QAT (fake-quant) + PTQ (calibration).
+
+Reference parity: python/paddle/fluid/contrib/slim/quantization/
+(quantization_pass.py program rewrite, imperative QAT
+imperative/qat.py ImperativeQuantAware, PTQ calibration). TPU-native:
+instead of a graph-rewrite pass, QAT swaps Linear/Conv2D layers for
+quant-aware wrappers (straight-through fake-quant in the eager/jit graph);
+PTQ observes activation ranges on calibration batches and produces int8
+weights + scales for the serving path (int8 matmuls hit the MXU via
+XLA's native int8 dot support).
+"""
+
+from .quant import (FakeQuantLayer, ImperativeQuantAware, PTQ,
+                    QuantConfig, QuantizedConv2D, QuantizedLinear,
+                    fake_quant, quant_dequant)

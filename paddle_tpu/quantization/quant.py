@@ -1,0 +1,205 @@
+"""Quantization primitives and QAT/PTQ drivers."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dispatch
+from ..nn.common import Linear
+from ..nn.conv import Conv2D
+from ..nn.layer import Layer
+from ..tensor import Tensor
+
+F = dispatch.wrapped_ops
+
+
+@dataclasses.dataclass
+class QuantConfig:
+    weight_bits: int = 8
+    activation_bits: int = 8
+    weight_quantize_type: str = "channel_wise_abs_max"
+    activation_quantize_type: str = "moving_average_abs_max"
+    moving_rate: float = 0.9
+    quantizable_layer_type: tuple = ("Linear", "Conv2D")
+
+
+def fake_quant(x, scale, bits: int = 8):
+    """Symmetric fake-quant with straight-through estimator
+    (reference: fake_quantize_op kernels). Dispatched through the op layer
+    so the eager tape records the STE gradient."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def _fq(v, s):
+        s = jnp.maximum(s, 1e-8)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
+        fq = q * s / qmax
+        return v + jax.lax.stop_gradient(fq - v)
+
+    return dispatch.call_fn(_fq, "fake_quant", True, (x, scale), {})
+
+
+def quant_dequant(x, bits: int = 8, axis: Optional[int] = None):
+    """Quantize to int8 + dequant scales (the PTQ conversion step)."""
+    raw = np.asarray(x.value if isinstance(x, Tensor) else x)
+    qmax = float(2 ** (bits - 1) - 1)
+    if axis is None:
+        scale = np.abs(raw).max()
+        q = np.clip(np.round(raw / max(scale, 1e-8) * qmax), -qmax,
+                    qmax).astype(np.int8)
+        return q, np.float32(scale)
+    mv = np.moveaxis(raw, axis, 0)
+    scale = np.abs(mv.reshape(mv.shape[0], -1)).max(axis=1)
+    q = np.clip(np.round(mv / np.maximum(scale, 1e-8)[
+        (slice(None),) + (None,) * (mv.ndim - 1)] * qmax), -qmax,
+        qmax).astype(np.int8)
+    return np.moveaxis(q, 0, axis), scale.astype(np.float32)
+
+
+class FakeQuantLayer(Layer):
+    """Observes activation abs-max (moving average) and fake-quants."""
+
+    def __init__(self, bits: int = 8, moving_rate: float = 0.9):
+        super().__init__()
+        self.bits = bits
+        self.moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(jnp.ones(())))
+        self._initialized = False
+
+    def forward(self, x):
+        if self.training:
+            cur = F["max"](F["abs"](x.detach() if isinstance(x, Tensor)
+                                    else x))
+            cur_v = cur.value if isinstance(cur, Tensor) else cur
+            if not self._initialized:
+                self.scale.set_value(cur_v)
+                self._initialized = True
+            else:
+                self.scale.set_value(self.moving_rate * self.scale.value +
+                                     (1 - self.moving_rate) * cur_v)
+        return fake_quant(x, self.scale, self.bits)
+
+
+class QuantizedLinear(Layer):
+    """Linear with fake-quant on weight (per-channel) + activation."""
+
+    def __init__(self, inner: Linear, config: QuantConfig):
+        super().__init__()
+        self.inner = inner
+        self.act_quant = FakeQuantLayer(config.activation_bits,
+                                        config.moving_rate)
+        self.w_bits = config.weight_bits
+        self.per_channel = "channel" in config.weight_quantize_type
+
+    def _w_scale(self):
+        w = self.inner.weight
+        if self.per_channel:
+            s = F["max"](F["abs"](w.detach()), axis=0, keepdim=True)
+        else:
+            s = F["max"](F["abs"](w.detach()))
+        return s
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        wq = fake_quant(self.inner.weight, self._w_scale(), self.w_bits)
+        return F["linear"](x, wq, self.inner.bias)
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, inner: Conv2D, config: QuantConfig):
+        super().__init__()
+        self.inner = inner
+        self.act_quant = FakeQuantLayer(config.activation_bits,
+                                        config.moving_rate)
+        self.w_bits = config.weight_bits
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        w = self.inner.weight
+        s = F["max"](F["abs"](w.detach()))
+        wq = fake_quant(w, s, self.w_bits)
+        return F["conv2d"](x, wq, self.inner.bias, self.inner._stride,
+                           self.inner._padding, self.inner._dilation,
+                           self.inner._groups, self.inner._data_format)
+
+
+class ImperativeQuantAware:
+    """QAT driver (reference: slim ImperativeQuantAware.quantize — swaps
+    quantizable layers for quant-aware versions in place)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None, **kw):
+        self.config = config or QuantConfig(**kw)
+
+    def quantize(self, model: Layer) -> Layer:
+        self._convert(model)
+        return model
+
+    def _convert(self, layer: Layer) -> None:
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, Linear):
+                layer._sub_layers[name] = QuantizedLinear(sub, self.config)
+            elif isinstance(sub, Conv2D) and type(sub) is Conv2D:
+                layer._sub_layers[name] = QuantizedConv2D(sub, self.config)
+            else:
+                self._convert(sub)
+
+    def save_quantized_model(self, model: Layer, path: str,
+                             input_spec=None) -> None:
+        from ..static.program import build_program
+        model.eval()
+        prog = build_program(model, input_spec)
+        prog.save(path)
+
+
+class PTQ:
+    """Post-training quantization: run calibration batches through
+    observers, then export int8 weights + scales
+    (reference: slim PostTrainingQuantization)."""
+
+    def __init__(self, bits: int = 8):
+        self.bits = bits
+        self.act_ranges: Dict[str, float] = {}
+        self._hooks = []
+
+    def _observer(self, name):
+        def hook(layer, inputs, outputs):
+            x = inputs[0]
+            v = float(np.abs(np.asarray(
+                x.value if isinstance(x, Tensor) else x)).max())
+            self.act_ranges[name] = max(self.act_ranges.get(name, 0.0), v)
+        return hook
+
+    def calibrate(self, model: Layer, data_iter, num_batches: int = 8
+                  ) -> None:
+        model.eval()
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, (Linear, Conv2D)):
+                self._hooks.append(
+                    sub.register_forward_post_hook(self._observer(name)))
+        for i, batch in enumerate(data_iter):
+            if i >= num_batches:
+                break
+            xs = batch[0] if isinstance(batch, (list, tuple)) else batch
+            model(xs if isinstance(xs, Tensor) else Tensor(
+                jnp.asarray(np.asarray(xs))))
+        for h in self._hooks:
+            h.remove()
+        self._hooks.clear()
+
+    def quantize_weights(self, model: Layer) -> Dict[str, dict]:
+        """Return {layer_name: {weight_int8, weight_scale, act_scale}}."""
+        out = {}
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, Linear):
+                q, s = quant_dequant(sub.weight, self.bits, axis=1)
+                out[name] = {"weight_int8": q, "weight_scale": s,
+                             "act_scale": self.act_ranges.get(name)}
+            elif isinstance(sub, Conv2D):
+                q, s = quant_dequant(sub.weight, self.bits, axis=0)
+                out[name] = {"weight_int8": q, "weight_scale": s,
+                             "act_scale": self.act_ranges.get(name)}
+        return out

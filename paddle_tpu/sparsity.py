@@ -1,0 +1,85 @@
+"""Structured sparsity (2:4 ASP).
+
+Reference parity: python/paddle/fluid/contrib/sparsity/ (asp.py —
+prune_model with 2:4 masks, decorate() masking optimizer updates,
+check_sparsity). TPU note: the MXU has no sparse-math unit, so 2:4 here
+is a *model-compression* capability (mask-enforced training, smaller
+checkpoints), matching the reference's functional behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .nn.layer import Layer
+from .tensor import Parameter
+
+_MASKS: Dict[int, jnp.ndarray] = {}
+
+
+def compute_mask_2d_best(weight: np.ndarray, n: int = 2, m: int = 4
+                         ) -> np.ndarray:
+    """n:m sparsity along the last axis: keep the n largest of every m."""
+    w = np.asarray(weight)
+    flat = np.abs(w.reshape(-1, w.shape[-1]))
+    mask = np.zeros_like(flat, dtype=bool)
+    cols = flat.shape[1]
+    usable = cols - cols % m
+    for r in range(flat.shape[0]):
+        row = flat[r, :usable].reshape(-1, m)
+        keep = np.argsort(-row, axis=1)[:, :n]
+        for g in range(row.shape[0]):
+            mask[r, g * m + keep[g]] = True
+        mask[r, usable:] = True
+    return mask.reshape(w.shape)
+
+
+def check_sparsity(weight, n: int = 2, m: int = 4) -> bool:
+    w = np.asarray(weight)
+    flat = (w.reshape(-1, w.shape[-1]) != 0)
+    cols = flat.shape[1]
+    usable = cols - cols % m
+    groups = flat[:, :usable].reshape(-1, m)
+    return bool((groups.sum(axis=1) <= n).all())
+
+
+def _prunable(name: str, p: Parameter) -> bool:
+    return (p is not None and p.ndim == 2 and p.shape[-1] % 4 == 0 and
+            "weight" in name)
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d") -> Dict[str, np.ndarray]:
+    """Apply n:m masks to prunable weights; masks are remembered so
+    decorated optimizers re-apply them after each step."""
+    masks = {}
+    for name, p in model.named_parameters():
+        if _prunable(name, p):
+            mask = compute_mask_2d_best(np.asarray(p.value), n, m)
+            p.value = p.value * jnp.asarray(mask, dtype=p.dtype)
+            _MASKS[id(p)] = jnp.asarray(mask, dtype=p.dtype)
+            masks[name] = mask
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-mask pruned weights after the update
+    (reference: sparsity.decorate -> OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        for p in optimizer._parameter_list or []:
+            mask = _MASKS.get(id(p))
+            if mask is not None:
+                p.value = p.value * mask
+
+    optimizer.step = step
+    return optimizer
+
+
+def reset_masks() -> None:
+    _MASKS.clear()

@@ -1,0 +1,161 @@
+"""Text datasets + utilities (reference parity: python/paddle/text/ —
+Imdb/WMT-style datasets + a simple vocab/tokenizer; zero-egress builds use
+local files or deterministic synthetic corpora)."""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class Vocab:
+    def __init__(self, counter: Counter, max_size: Optional[int] = None,
+                 min_freq: int = 1,
+                 specials=("<pad>", "<unk>", "<bos>", "<eos>")):
+        self.itos: List[str] = list(specials)
+        for tok, freq in counter.most_common(max_size):
+            if freq < min_freq:
+                break
+            if tok not in self.itos:
+                self.itos.append(tok)
+        self.stoi: Dict[str, int] = {t: i for i, t in enumerate(self.itos)}
+        self.pad_id = 0
+        self.unk_id = 1
+        self.bos_id = 2
+        self.eos_id = 3
+
+    def __len__(self):
+        return len(self.itos)
+
+    def encode(self, tokens: List[str]) -> List[int]:
+        return [self.stoi.get(t, self.unk_id) for t in tokens]
+
+    def decode(self, ids: List[int]) -> List[str]:
+        return [self.itos[i] if 0 <= i < len(self.itos) else "<unk>"
+                for i in ids]
+
+    @classmethod
+    def build_from_texts(cls, texts, tokenizer=None, **kw):
+        tokenizer = tokenizer or (lambda s: s.lower().split())
+        counter = Counter()
+        for t in texts:
+            counter.update(tokenizer(t))
+        return cls(counter, **kw)
+
+
+_SYNTH_POS = ["great wonderful amazing film loved it",
+              "brilliant acting and a moving story",
+              "best movie of the year truly superb"]
+_SYNTH_NEG = ["terrible boring waste of time",
+              "awful script and wooden acting",
+              "worst film i have ever seen"]
+
+
+class Imdb(Dataset):
+    """Sentiment dataset (reference: paddle.text.Imdb). Reads an
+    aclImdb-layout directory when given, else a deterministic synthetic
+    corpus with the same interface."""
+
+    def __init__(self, data_dir: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, seq_len: int = 32,
+                 synthetic_size: int = 200):
+        texts, labels = [], []
+        if data_dir and os.path.isdir(data_dir):
+            for label, sub in ((1, "pos"), (0, "neg")):
+                droot = os.path.join(data_dir, mode, sub)
+                for fn in sorted(os.listdir(droot)):
+                    with open(os.path.join(droot, fn),
+                              encoding="utf-8") as f:
+                        texts.append(f.read())
+                    labels.append(label)
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            for i in range(synthetic_size):
+                if i % 2 == 0:
+                    base = _SYNTH_POS[int(rng.integers(len(_SYNTH_POS)))]
+                    labels.append(1)
+                else:
+                    base = _SYNTH_NEG[int(rng.integers(len(_SYNTH_NEG)))]
+                    labels.append(0)
+                texts.append(base)
+        self.vocab = Vocab.build_from_texts(texts)
+        self.seq_len = seq_len
+        self.samples = []
+        for t, l in zip(texts, labels):
+            ids = self.vocab.encode(t.lower().split())[:seq_len]
+            ids = ids + [self.vocab.pad_id] * (seq_len - len(ids))
+            self.samples.append((np.asarray(ids, np.int64), np.int64(l)))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class SyntheticLMDataset(Dataset):
+    """Token-stream LM dataset for GPT training/benchmarks (markov-ish
+    synthetic stream so models can actually reduce loss)."""
+
+    def __init__(self, vocab_size: int = 1024, seq_len: int = 128,
+                 size: int = 512, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.size = size
+        # deterministic transition table gives learnable structure
+        self._next = rng.integers(0, vocab_size, vocab_size)
+        self._seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self._seed + idx)
+        seq = np.empty(self.seq_len + 1, np.int64)
+        seq[0] = rng.integers(self.vocab_size)
+        for i in range(1, self.seq_len + 1):
+            if rng.random() < 0.8:
+                seq[i] = self._next[seq[i - 1]]
+            else:
+                seq[i] = rng.integers(self.vocab_size)
+        return seq[:-1], seq[1:]
+
+    def __len__(self):
+        return self.size
+
+
+def viterbi_decode(potentials, transitions):
+    """Sequence-tagging decode (reference: paddle.text.viterbi_decode).
+    potentials: [B, T, N]; transitions: [N, N]. Returns (scores, paths)."""
+    import jax
+    import jax.numpy as jnp
+
+    pot = jnp.asarray(potentials)
+    trans = jnp.asarray(transitions)
+    b, t, n = pot.shape
+
+    def step(carry, emit):
+        score = carry  # [B, N]
+        cand = score[:, :, None] + trans[None] + emit[:, None, :]
+        best = jnp.max(cand, axis=1)
+        back = jnp.argmax(cand, axis=1)
+        return best, back
+
+    init = pot[:, 0]
+    scores, backs = jax.lax.scan(step, init,
+                                 jnp.moveaxis(pot[:, 1:], 1, 0))
+    final_scores = jnp.max(scores, axis=-1)
+    last = jnp.argmax(scores, axis=-1)
+
+    def backtrack(carry, back):
+        idx = carry
+        prev = jnp.take_along_axis(back, idx[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, path_rev = jax.lax.scan(backtrack, last, backs, reverse=True)
+    paths = jnp.concatenate([jnp.moveaxis(path_rev, 0, 1),
+                             last[:, None]], axis=1)
+    return final_scores, paths

@@ -1,0 +1,152 @@
+"""Quantization (QAT/PTQ), 2:4 sparsity, text datasets."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer as optim
+
+
+def test_fake_quant_ste_grads():
+    from paddle_tpu.quantization import fake_quant
+
+    x = pt.to_tensor(np.linspace(-1, 1, 16, dtype=np.float32),
+                     stop_gradient=False)
+    y = fake_quant(x, pt.to_tensor(np.float32(1.0)))
+    # quantized values are on the int8 grid
+    q = y.numpy() * 127
+    np.testing.assert_allclose(q, np.round(q), atol=1e-4)
+    # straight-through: grad is identity
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(16), rtol=1e-6)
+
+
+def test_qat_quantize_and_train():
+    from paddle_tpu.quantization import ImperativeQuantAware, QuantizedLinear
+
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    qat = ImperativeQuantAware()
+    qat.quantize(net)
+    assert isinstance(net._sub_layers["0"], QuantizedLinear)
+    opt = optim.Adam(learning_rate=0.01, parameters=net.parameters())
+    x = pt.randn((4, 8))
+    y = pt.randn((4, 4))
+    mse = nn.MSELoss()
+    losses = []
+    for _ in range(10):
+        loss = mse(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_ptq_calibration_and_export():
+    from paddle_tpu.quantization import PTQ
+
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    ptq = PTQ()
+    data = [(pt.randn((4, 8)),) for _ in range(4)]
+    ptq.calibrate(net, data, num_batches=4)
+    qw = ptq.quantize_weights(net)
+    assert len(qw) == 2
+    for name, rec in qw.items():
+        assert rec["weight_int8"].dtype == np.int8
+        assert rec["act_scale"] is not None and rec["act_scale"] > 0
+        # dequantized weight approximates the original
+        w = dict(net.named_parameters())[name + ".weight"].numpy()
+        scale = rec["weight_scale"]
+        deq = rec["weight_int8"].astype(np.float32) / 127.0
+        if scale.ndim:  # per-channel on some axis
+            if rec["weight_int8"].shape[0] == scale.shape[0]:
+                deq = deq * scale[:, None]
+            else:
+                deq = deq * scale[None, :]
+        else:
+            deq = deq * scale
+        assert np.abs(deq - w).max() < np.abs(w).max() * 0.05 + 1e-3
+
+
+def test_sparsity_2_4():
+    from paddle_tpu import sparsity
+
+    net = nn.Linear(16, 8)
+    masks = sparsity.prune_model(net)
+    assert "weight" in masks
+    assert sparsity.check_sparsity(net.weight.numpy())
+    # decorated optimizer keeps the mask after updates
+    opt = sparsity.decorate(optim.SGD(learning_rate=0.1,
+                                      parameters=net.parameters()))
+    x = pt.randn((4, 16))
+    net(x).sum().backward()
+    opt.step()
+    assert sparsity.check_sparsity(net.weight.numpy())
+    sparsity.reset_masks()
+
+
+def test_text_vocab_and_imdb():
+    from paddle_tpu.text import Imdb, Vocab
+
+    ds = Imdb(mode="train", synthetic_size=64)
+    ids, label = ds[0]
+    assert ids.shape == (32,)
+    assert label in (0, 1)
+    v = ds.vocab
+    enc = v.encode(["great", "zzzunknown"])
+    assert enc[1] == v.unk_id
+    assert v.decode(enc)[0] == "great"
+
+
+def test_text_classifier_trains():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.text import Imdb
+
+    pt.seed(123)
+    ds = Imdb(mode="train", synthetic_size=128)
+    loader = DataLoader(ds, batch_size=32, shuffle=True)
+
+    class Clf(nn.Layer):
+        def __init__(self, vocab):
+            super().__init__()
+            self.emb = nn.Embedding(vocab, 16)
+            self.fc = nn.Linear(16, 2)
+
+        def forward(self, ids):
+            return self.fc(pt.mean(self.emb(ids), axis=1))
+
+    model = Clf(len(ds.vocab))
+    opt = optim.Adam(learning_rate=0.01, parameters=model.parameters())
+    ce = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(8):
+        for ids, label in loader:
+            loss = ce(model(ids), label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+    assert np.mean(losses[-4:]) < np.mean(losses[:4]) * 0.9, (
+        losses[:4], losses[-4:])
+
+
+def test_synthetic_lm_dataset():
+    from paddle_tpu.text import SyntheticLMDataset
+
+    ds = SyntheticLMDataset(vocab_size=64, seq_len=16, size=8)
+    x, y = ds[0]
+    assert x.shape == (16,) and y.shape == (16,)
+    np.testing.assert_array_equal(x[1:], y[:-1])
+    x2, _ = ds[0]
+    np.testing.assert_array_equal(x, x2)  # deterministic
+
+
+def test_viterbi_decode():
+    from paddle_tpu.text import viterbi_decode
+
+    # 2 states, clear best path
+    pot = np.array([[[2.0, 0.0], [0.0, 2.0], [2.0, 0.0]]], np.float32)
+    trans = np.zeros((2, 2), np.float32)
+    scores, paths = viterbi_decode(pot, trans)
+    np.testing.assert_array_equal(np.asarray(paths)[0], [0, 1, 0])
+    np.testing.assert_allclose(np.asarray(scores)[0], 6.0)
