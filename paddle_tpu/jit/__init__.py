@@ -129,13 +129,34 @@ class EvalStep:
 
 
 class StaticFunction:
-    """to_static-decorated function: cached jit over Layer state
+    """to_static-decorated function: cached jit over Layer state; the
+    dy2static AST pass first rewrites tensor-dependent Python control
+    flow into lax control flow so it survives tracing
     (reference: program_translator.py StaticFunction)."""
 
     def __init__(self, fn: Callable, model: Optional[Layer] = None):
-        self.fn = fn
+        self._orig_fn = fn
+        self._converted_fn = None
         self.model = model
-        self._jitted = None
+        self._jitted_by_mode: Dict[bool, Any] = {}
+
+    @property
+    def fn(self) -> Callable:
+        """Resolve per call so enable_to_static() toggles take effect
+        after decoration (reference: ProgramTranslator.enable)."""
+        if not ProgramTranslator.enabled:
+            return self._orig_fn
+        if self._converted_fn is None:
+            self._converted_fn = convert_to_static(self._orig_fn)
+        return self._converted_fn
+
+    @property
+    def _jitted(self):
+        return self._jitted_by_mode.get(ProgramTranslator.enabled)
+
+    @_jitted.setter
+    def _jitted(self, value):
+        self._jitted_by_mode[ProgramTranslator.enabled] = value
 
     def _resolve_model(self, args):
         if self.model is not None:
@@ -206,3 +227,7 @@ def save(layer, path: str, input_spec=None) -> None:
 def load(path: str):
     from ..framework.io import load as fload
     return fload(path + ".pdparams")
+
+
+from .dy2static import (ProgramTranslator, convert_to_static,  # noqa: E402
+                        enable_to_static)

@@ -1,0 +1,238 @@
+"""Runtime dispatch helpers for dy2static-converted code.
+
+The AST transformer (``paddle_tpu.jit.dy2static``) rewrites Python
+control flow into calls to these helpers. Each helper dispatches at run
+time: concrete (Python) conditions keep ordinary Python semantics;
+traced (jax tracer) conditions lower to ``lax.cond`` /
+``lax.while_loop`` so the converted function stays fully jittable.
+
+Reference analog: python/paddle/fluid/dygraph/dygraph_to_static/
+convert_operators.py (convert_ifelse, convert_while_loop,
+convert_logical_and/or/not) — rebuilt on lax control-flow primitives
+instead of Paddle's cond/while ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class _Undefined:
+    """Sentinel for names that may be unbound on one control path
+    (reference: dygraph_to_static/utils.py UndefinedVar)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<pt undefined>"
+
+    def __bool__(self):
+        raise NameError(
+            "variable is undefined on this control path (dy2static)")
+
+
+UNDEF = _Undefined()
+
+
+def _raw(x):
+    """Unwrap paddle_tpu.Tensor to its jax value."""
+    from ..tensor import Tensor
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _to_pred(cond):
+    cond = _raw(cond)
+    if isinstance(cond, (jax.Array,)) or _is_traced(cond):
+        if getattr(cond, "ndim", 0) != 0:
+            # Match Python/JAX semantics: a multi-element condition is a
+            # user bug, not something to silently reduce.
+            raise ValueError(
+                "dy2static: the truth value of a condition with "
+                f"shape {jnp.shape(cond)} is ambiguous; reduce it with "
+                ".all()/.any() first")
+        return cond.astype(jnp.bool_) if cond.dtype != jnp.bool_ else cond
+    return cond
+
+
+def _unify_one(a, b):
+    """Unify one output pair across branches. UNDEF on one side is
+    filled with zeros_like of the other — that value is only observable
+    if user code reads a variable that was never assigned on the taken
+    path, which plain Python would reject with NameError."""
+    ra, rb = _raw(a), _raw(b)
+    a_undef = ra is UNDEF
+    b_undef = rb is UNDEF
+    if a_undef and b_undef:
+        return UNDEF, UNDEF, True
+    if a_undef:
+        if isinstance(rb, (jax.Array,)) or _is_traced(rb):
+            return jnp.zeros(jnp.shape(rb), rb.dtype), rb, False
+        return rb, rb, False
+    if b_undef:
+        if isinstance(ra, (jax.Array,)) or _is_traced(ra):
+            return ra, jnp.zeros(jnp.shape(ra), ra.dtype), False
+        return ra, ra, False
+    return ra, rb, False
+
+
+def convert_ifelse(pred, true_fn: Callable[[], Tuple],
+                   false_fn: Callable[[], Tuple]):
+    """``if pred: ... else: ...`` with branch bodies extracted into
+    functions returning the tuple of assigned names."""
+    pred = _to_pred(pred)
+    if not (_is_traced(pred)):
+        # Concrete: run only the selected branch (ordinary Python).
+        return true_fn() if bool(pred) else false_fn()
+
+    # Traced: probe both branches once to unify output structure; the
+    # probe traces are unreachable from any output, so they never enter
+    # the final jaxpr. Real branch execution happens inside lax.cond.
+    outs_t = true_fn()
+    outs_f = false_fn()
+    if len(outs_t) != len(outs_f):
+        raise TypeError(
+            "dy2static: if/else branches produced different numbers of "
+            f"outputs ({len(outs_t)} vs {len(outs_f)})")
+
+    def _is_static_slot(a, b):
+        a, b = _raw(a), _raw(b)
+        if a is UNDEF and b is UNDEF:
+            return True
+        if a is None and b is None:
+            return True
+        return False
+
+    static_mask = [_is_static_slot(a, b) for a, b in zip(outs_t, outs_f)]
+    static_vals = [_raw(a) for a, s in zip(outs_t, static_mask) if s]
+
+    def _wrap(fn, other):
+        def branch():
+            outs = fn()
+            res = []
+            for v, o, s in zip(outs, other, static_mask):
+                if s:
+                    continue
+                rv, ro = _raw(v), _raw(o)
+                if rv is UNDEF:
+                    rv = jnp.zeros(jnp.shape(ro), jnp.result_type(ro))
+                res.append(jnp.asarray(rv))
+            return tuple(res)
+        return branch
+
+    picked = lax.cond(pred, _wrap(true_fn, outs_f),
+                      _wrap(false_fn, outs_t))
+    it_dyn = iter(picked)
+    it_static = iter(static_vals)
+    return tuple(next(it_static) if s else next(it_dyn)
+                 for s in static_mask)
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable,
+                  init_vars: Tuple):
+    """``while cond: body`` with loop-carried names passed explicitly.
+    A concrete condition runs as an ordinary Python loop; if the
+    condition becomes traced (possibly mid-loop, e.g. a break flag
+    turning into a tracer), the remaining iterations lower to
+    lax.while_loop from the current state."""
+    vars_ = tuple(init_vars)
+    while True:
+        c = _to_pred(cond_fn(*vars_))
+        if _is_traced(c):
+            return _traced_while(cond_fn, body_fn, vars_)
+        if not bool(c):
+            return vars_
+        vars_ = tuple(body_fn(*vars_))
+
+
+def _traced_while(cond_fn, body_fn, init_vars):
+    # Run the body once eagerly to learn output structure and fill
+    # UNDEF slots in the carry; the probe trace is dead code.
+    probe = tuple(body_fn(*init_vars))
+    init = []
+    for a, b in zip(init_vars, probe):
+        ua, _, is_static = _unify_one(a, b)
+        init.append(UNDEF if is_static else ua)
+    static_mask = [v is UNDEF for v in init]
+    statics = [v for v in init if v is UNDEF]
+
+    def pack(full):
+        return tuple(v for v, s in zip(full, static_mask) if not s)
+
+    def unpack(dyn):
+        it = iter(dyn)
+        return tuple(UNDEF if s else next(it) for s in static_mask)
+
+    def cond_w(carry):
+        return _to_pred(cond_fn(*unpack(carry)))
+
+    def body_w(carry):
+        out = body_fn(*unpack(carry))
+        return pack(tuple(_raw(v) for v in out))
+
+    final = lax.while_loop(cond_w, body_w,
+                           pack(tuple(_raw(v) for v in init)))
+    return unpack(final)
+
+
+def convert_logical_and(lhs_fn: Callable, rhs_fn: Callable):
+    """``a and b`` preserving short-circuit for concrete lhs."""
+    lhs = lhs_fn()
+    raw = _raw(lhs)
+    if _is_traced(raw) or isinstance(raw, jax.Array):
+        return jnp.logical_and(_to_pred(lhs), _to_pred(rhs_fn()))
+    return lhs and rhs_fn()
+
+
+def convert_logical_or(lhs_fn: Callable, rhs_fn: Callable):
+    lhs = lhs_fn()
+    raw = _raw(lhs)
+    if _is_traced(raw) or isinstance(raw, jax.Array):
+        return jnp.logical_or(_to_pred(lhs), _to_pred(rhs_fn()))
+    return lhs or rhs_fn()
+
+
+def convert_logical_not(x):
+    raw = _raw(x)
+    if _is_traced(raw) or isinstance(raw, jax.Array):
+        return jnp.logical_not(_to_pred(raw))
+    return not x
+
+
+def convert_assert(test, msg_fn=None):
+    """Traced assertions are skipped (XLA has no host assert); concrete
+    ones keep Python semantics. ``msg_fn`` is lazy — the message
+    expression only evaluates on failure, as in plain ``assert``."""
+    raw = _raw(test)
+    if _is_traced(raw) or isinstance(raw, jax.Array):
+        return
+    if not test:
+        raise AssertionError(msg_fn() if msg_fn is not None else "")
+
+
+def finalize_ret(v):
+    """A function that falls off the end without returning yields None."""
+    return None if _raw(v) is UNDEF else v
+
+
+def range_continue(i, stop, step):
+    """Continuation predicate of a lowered ``for i in range(...)``."""
+    ri, rstop, rstep = _raw(i), _raw(stop), _raw(step)
+    if any(_is_traced(v) or isinstance(v, jax.Array)
+           for v in (ri, rstop, rstep)):
+        return jnp.where(jnp.asarray(rstep) > 0,
+                         jnp.asarray(ri) < jnp.asarray(rstop),
+                         jnp.asarray(ri) > jnp.asarray(rstop))
+    return ri < rstop if rstep > 0 else ri > rstop

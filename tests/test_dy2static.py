@@ -1,0 +1,383 @@
+"""Dy2Static AST conversion: tensor-dependent Python control flow
+lowered to lax.cond/while_loop, concrete control flow keeps Python
+semantics. Reference analog: fluid/tests/unittests/dygraph_to_static/
+(test_ifelse.py, test_loop.py, test_break_continue.py,
+test_return.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.jit.dy2static import convert_to_static
+
+
+def conv(fn):
+    return convert_to_static(fn, raise_on_error=True)
+
+
+def both(fn, *args):
+    """Run converted fn eagerly and under jit; assert they agree and
+    return the jitted result."""
+    cfn = conv(fn)
+    eager = cfn(*args)
+    jitted = jax.jit(cfn)(*args)
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted),
+                               rtol=1e-6)
+    return jitted
+
+
+# ------------------------------------------------------------------ if/else
+
+def test_if_tensor_cond_jittable():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y
+
+    x = jnp.asarray([1.0, 2.0])
+    np.testing.assert_allclose(both(f, x), [2.0, 4.0])
+    np.testing.assert_allclose(both(f, -x), [-2.0, -3.0])
+
+
+def test_if_python_semantics_preserved():
+    def f(flag, x):
+        if flag:  # plain Python bool — must not be traced
+            out = x + 1
+        else:
+            out = x - 1
+        return out
+
+    x = jnp.asarray(3.0)
+    assert float(conv(f)(True, x)) == 4.0
+    assert float(conv(f)(False, x)) == 2.0
+
+
+def test_if_no_else_with_prior_value():
+    def f(x):
+        y = x * 0
+        if x.max() > 1:
+            y = x + 10
+        return y
+
+    np.testing.assert_allclose(both(f, jnp.asarray([2.0])), [12.0])
+    np.testing.assert_allclose(both(f, jnp.asarray([0.5])), [0.0])
+
+
+def test_elif_chain():
+    def f(x):
+        s = x.sum()
+        if s > 10:
+            r = x * 0 + 1
+        elif s > 0:
+            r = x * 0 + 2
+        else:
+            r = x * 0 + 3
+        return r
+
+    np.testing.assert_allclose(both(f, jnp.asarray([20.0])), [1.0])
+    np.testing.assert_allclose(both(f, jnp.asarray([5.0])), [2.0])
+    np.testing.assert_allclose(both(f, jnp.asarray([-5.0])), [3.0])
+
+
+def test_return_in_both_branches():
+    def f(x):
+        if x.sum() > 0:
+            return x * 2
+        else:
+            return x - 1
+
+    np.testing.assert_allclose(both(f, jnp.asarray([3.0])), [6.0])
+    np.testing.assert_allclose(both(f, jnp.asarray([-3.0])), [-4.0])
+
+
+def test_early_return_with_tail():
+    def f(x):
+        if x.sum() > 0:
+            return x * 2
+        return x - 1
+
+    np.testing.assert_allclose(both(f, jnp.asarray([3.0])), [6.0])
+    np.testing.assert_allclose(both(f, jnp.asarray([-3.0])), [-4.0])
+
+
+# -------------------------------------------------------------------- loops
+
+def test_while_tensor_cond():
+    def f(x):
+        s = x * 0
+        while s.sum() < 10:
+            s = s + x
+        return s
+
+    out = both(f, jnp.asarray([3.0]))
+    np.testing.assert_allclose(out, [12.0])
+
+
+def test_while_python_cond_unrolled():
+    def f(x):
+        i = 0
+        while i < 3:  # concrete — unrolls at trace time
+            x = x * 2
+            i += 1
+        return x
+
+    np.testing.assert_allclose(both(f, jnp.asarray(1.0)), 8.0)
+
+
+def test_for_range_concrete():
+    def f(x):
+        acc = x * 0
+        for i in range(4):
+            acc = acc + x * i
+        return acc
+
+    np.testing.assert_allclose(both(f, jnp.asarray(2.0)), 12.0)
+
+
+def test_for_range_traced_bound():
+    def f(x, n):
+        acc = x * 0
+        for _ in range(n):
+            acc = acc + x
+        return acc
+
+    cfn = conv(f)
+    out = jax.jit(cfn)(jnp.asarray(5.0), jnp.asarray(3))
+    assert float(out) == 15.0
+    out = jax.jit(cfn)(jnp.asarray(5.0), jnp.asarray(0))
+    assert float(out) == 0.0
+
+
+def test_break_concrete_and_traced():
+    def f(x, limit):
+        acc = x * 0
+        for i in range(10):
+            if acc.sum() > limit:
+                break
+            acc = acc + x
+        return acc
+
+    # concrete path
+    assert float(conv(f)(jnp.asarray(1.0), 3.5)) == 4.0
+    # traced path (limit traced → break cond traced)
+    out = jax.jit(conv(f))(jnp.asarray(1.0), jnp.asarray(3.5))
+    assert float(out) == 4.0
+
+
+def test_continue():
+    def f(x):
+        acc = x * 0
+        for i in range(6):
+            if i % 2 == 1:
+                continue
+            acc = acc + i
+        return acc
+
+    assert float(both(f, jnp.asarray(0.0))) == 0 + 2 + 4
+
+
+def test_nested_loop_break_ownership():
+    def f(x):
+        total = x * 0
+        for i in range(3):
+            for j in range(5):
+                if j >= 2:
+                    break
+                total = total + 1
+        return total
+
+    assert float(both(f, jnp.asarray(0.0))) == 6.0
+
+
+def test_for_else():
+    def f(x, thresh):
+        for i in range(3):
+            if float(x) > thresh:
+                break
+        else:
+            x = x + 100
+        return x
+
+    assert float(conv(f)(jnp.asarray(1.0), 50.0)) == 101.0
+    assert float(conv(f)(jnp.asarray(1.0), 0.5)) == 1.0
+
+
+# ---------------------------------------------------------- logic / assert
+
+def test_logical_and_or_not():
+    def f(x):
+        if (x.sum() > 0) and (x.max() < 10):
+            r = x + 1
+        else:
+            r = x - 1
+        return r
+
+    np.testing.assert_allclose(both(f, jnp.asarray([2.0])), [3.0])
+    np.testing.assert_allclose(both(f, jnp.asarray([20.0])), [19.0])
+
+    def g(flag, x):
+        # short-circuit on concrete lhs must be preserved
+        if flag and x.undefined_attr:  # never evaluated when flag False
+            return x
+        return x + 1
+
+    assert float(conv(g)(False, jnp.asarray(1.0))) == 2.0
+
+
+def test_assert_traced_skipped():
+    def f(x):
+        assert x.sum() > -1e9  # traced → skipped
+        return x * 2
+
+    np.testing.assert_allclose(both(f, jnp.asarray([1.0])), [2.0])
+
+    def g(n):
+        assert n > 0, "need positive"
+        return n
+
+    with pytest.raises(AssertionError):
+        conv(g)(0)
+
+
+# ------------------------------------------------------------- integration
+
+def test_to_static_uses_dy2static():
+    import paddle_tpu as pt
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def step(x):
+        if x.sum() > 0:
+            return x * 2
+        return x - 1
+
+    out = step(pt.Tensor(jnp.asarray([4.0])))
+    np.testing.assert_allclose(np.asarray(out.value), [8.0])
+    out = step(pt.Tensor(jnp.asarray([-4.0])))
+    np.testing.assert_allclose(np.asarray(out.value), [-5.0])
+
+
+def test_grad_through_converted_cond():
+    def f(x):
+        if x.sum() > 0:
+            y = x * x
+        else:
+            y = x * 3.0
+        return y.sum()
+
+    g = jax.grad(conv(f))
+    np.testing.assert_allclose(g(jnp.asarray([2.0])), [4.0])
+    np.testing.assert_allclose(g(jnp.asarray([-2.0])), [3.0])
+
+
+def test_closure_preserved():
+    scale = 7.0
+
+    def f(x):
+        if x.sum() > 0:
+            y = x * scale
+        else:
+            y = x
+        return y
+
+    np.testing.assert_allclose(both(f, jnp.asarray([1.0])), [7.0])
+
+
+def test_fallback_on_unsupported_source():
+    # builtins have no retrievable source → returned unchanged
+    assert convert_to_static(len) is len
+
+
+def test_return_inside_except_handler():
+    def f(x):
+        for i in range(3):
+            try:
+                if i == 1:
+                    raise ValueError()
+            except ValueError:
+                return x * 100
+        return x + 1
+
+    assert float(conv(f)(jnp.asarray(2.0))) == 200.0
+
+
+def test_closure_sees_live_rebinding():
+    scale = 1.0
+
+    def f(x):
+        if x.sum() > 0:
+            y = x * scale
+        else:
+            y = x
+        return y
+
+    cf = conv(f)
+    scale = 10.0  # rebinding after conversion must be visible
+    np.testing.assert_allclose(np.asarray(cf(jnp.asarray([1.0]))), [10.0])
+
+
+_gscale = 1.0
+
+
+def _uses_global(x):
+    if x.sum() > 0:
+        return x * _gscale
+    return x
+
+
+def test_module_global_sees_live_rebinding():
+    global _gscale
+    _gscale = 1.0
+    cf = conv(_uses_global)
+    _gscale = 5.0
+    assert float(cf(jnp.asarray(2.0))) == 10.0
+
+
+def test_enable_toggle_after_decoration():
+    from paddle_tpu.jit import to_static, enable_to_static
+
+    @to_static
+    def f(x):
+        if x.sum() > 0:
+            return x * 2
+        return x - 1
+
+    x = jnp.asarray([1.0])
+    np.testing.assert_allclose(np.asarray(f(x)), [2.0])
+    enable_to_static(False)
+    try:
+        with pytest.raises(Exception):
+            f(x)  # plain tracing cannot handle the tensor-dependent if
+    finally:
+        enable_to_static(True)
+    np.testing.assert_allclose(np.asarray(f(x)), [2.0])
+
+
+def test_multi_element_condition_raises():
+    def f(x):
+        if x > 0:  # elementwise condition — a user bug, must not be
+            y = x + 1  # silently reduced
+        else:
+            y = x - 1
+        return y
+
+    with pytest.raises(ValueError, match="ambiguous"):
+        jax.jit(conv(f))(jnp.asarray([1.0, -1.0]))
+
+
+def test_assert_message_lazy():
+    evaluated = []
+
+    def f(n):
+        assert n > 0, evaluated.append("boom") or "msg"
+        return n
+
+    cf = conv(f)
+    assert cf(5) == 5
+    assert evaluated == []  # message must not evaluate on success
+    with pytest.raises(AssertionError):
+        cf(0)
+    assert evaluated == ["boom"]
