@@ -16,3 +16,4 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        create_hybrid_communicate_group,
                        get_hybrid_communicate_group, make_mesh)
 from . import fleet, mp_layers, pp, sp
+from .localsgd import LocalSGDTrainStep

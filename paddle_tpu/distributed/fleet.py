@@ -112,13 +112,49 @@ class _DistributedOptimizer:
 
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy]
                           = None):
-    return _DistributedOptimizer(optimizer, strategy or _strategy or
-                                 DistributedStrategy())
+    strategy = strategy or _strategy or DistributedStrategy()
+    if strategy.dgc:
+        # reference: DGCOptimizer meta-optimizer swaps Momentum for
+        # DGCMomentum (meta_optimizers/dgc_optimizer.py)
+        from ..optimizer import DGCMomentum, Momentum
+        if isinstance(optimizer, Momentum) and \
+                not isinstance(optimizer, DGCMomentum):
+            cfg = strategy.dgc_configs
+            optimizer = DGCMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                parameters=optimizer._parameter_list,
+                rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                rampup_step=cfg.get("rampup_step", 1),
+                sparsity=cfg.get("sparsity", [0.999]),
+                use_nesterov=optimizer._nesterov,
+                weight_decay=optimizer._weight_decay,
+                grad_clip=optimizer._grad_clip)
+    return _DistributedOptimizer(optimizer, strategy)
 
 
 # ---------------------------------------------------------------------------
 # The sharded train step — where all meta-optimizer features land
 # ---------------------------------------------------------------------------
+
+def make_functional_loss(model: Layer, train_fn: Callable) -> Callable:
+    """Adapt eager-style ``train_fn(model, batch) -> loss`` into the pure
+    ``loss_of(params, buffers, key, batch) -> (loss, new_buffers)`` form
+    every train step differentiates."""
+
+    def loss_of(p, buffers, key, batch):
+        model.train()
+        with bind_state(model, {"params": p, "buffers": buffers}), \
+                no_grad(), rng_mod.key_scope(key):
+            loss = train_fn(model, jax.tree_util.tree_map(
+                lambda v: Tensor(v) if isinstance(v, jax.Array) else v,
+                batch))
+            new_buf = {n: b.value for n, b in model.named_buffers()
+                       if b is not None}
+        raw = loss.value if isinstance(loss, Tensor) else loss
+        return raw, new_buf
+
+    return loss_of
 
 def _param_sharding(mesh: Mesh, name: str, value, pspec,
                     zero_axis: Optional[str]) -> NamedSharding:
@@ -224,6 +260,16 @@ class ShardedTrainStep:
                 "k_steps", 1))
         self._gm_steps = max(1, gm_steps)
 
+        self._compress_grads = bool(self.strategy.fp16_allreduce)
+        if self._compress_grads:
+            for ax in ("mp", "pp", "sep"):
+                if self.hcg.dims.get(ax, 1) > 1:
+                    raise ValueError(
+                        "fp16_allreduce compresses the data-parallel "
+                        f"gradient exchange; {ax} degree must be 1 "
+                        "(matches the reference meta-optimizer's "
+                        "conflict rules)")
+
         self._step = self._build(donate)
 
     def _batch_sharding(self, batch_raw):
@@ -241,17 +287,61 @@ class ShardedTrainStep:
             self.train_fn
         gm = self._gm_steps
 
-        def loss_of(p, buffers, key, batch):
-            model.train()
-            with bind_state(model, {"params": p, "buffers": buffers}), \
-                    no_grad(), rng_mod.key_scope(key):
-                loss = train_fn(model, jax.tree_util.tree_map(
-                    lambda v: Tensor(v) if isinstance(v, jax.Array) else v,
-                    batch))
-                new_buf = {n: b.value for n, b in model.named_buffers()
-                           if b is not None}
-            raw = loss.value if isinstance(loss, Tensor) else loss
-            return raw, new_buf
+        loss_of = make_functional_loss(model, train_fn)
+
+        mesh, bspec = self.mesh, self.batch_spec
+        data_axes: list = []
+        for e in bspec:
+            if e is None:
+                continue
+            data_axes.extend(e if isinstance(e, (tuple, list)) else [e])
+        data_axes = tuple(data_axes)
+        nrep = int(np.prod([mesh.shape[a] for a in data_axes])) or 1
+
+        if self._compress_grads:
+            # bf16-compressed dp gradient exchange: grads computed
+            # per-shard under shard_map and psum'd in bf16 (reference:
+            # fp16_allreduce_optimizer.py casts before c_allreduce; bf16
+            # is the TPU-native low-precision reduction format).
+            # DDP convention: global grad = MEAN of per-shard grads, so
+            # train_fn must return a batch-mean loss; a sum-reduced loss
+            # comes out scaled by 1/dp relative to the exact path.
+            from jax import shard_map as _shard_map
+            from .mp_layers import no_sharding_constraints
+
+            def vag(params, buffers, key, batch):
+                def per_shard(p, b, k, local_batch):
+                    idx = jnp.zeros((), jnp.int32)
+                    for ax in data_axes:
+                        idx = idx * mesh.shape[ax] + \
+                            jax.lax.axis_index(ax)
+                    k = jax.random.fold_in(k, idx)
+                    with no_sharding_constraints():
+                        (loss, nb), g = jax.value_and_grad(
+                            loss_of, has_aux=True)(p, b, k, local_batch)
+                    g = jax.tree_util.tree_map(
+                        lambda x: jax.lax.psum(
+                            x.astype(jnp.bfloat16),
+                            data_axes).astype(x.dtype) / nrep, g)
+                    loss = jax.lax.pmean(loss, data_axes)
+                    nb = jax.tree_util.tree_map(
+                        lambda x: jax.lax.pmean(x, data_axes)
+                        if jnp.issubdtype(x.dtype, jnp.inexact)
+                        else jax.lax.pmax(x, data_axes), nb)
+                    return (loss, nb), g
+
+                batch_specs = jax.tree_util.tree_map(
+                    lambda v: P(*tuple(bspec))
+                    if getattr(v, "ndim", 0) >= 1 else P(), batch)
+                sm = _shard_map(per_shard, mesh=mesh,
+                                in_specs=(P(), P(), P(), batch_specs),
+                                out_specs=((P(), P()), P()),
+                                check_vma=False)
+                return sm(params, buffers, key, batch)
+        else:
+            def vag(params, buffers, key, batch):
+                return jax.value_and_grad(loss_of, has_aux=True)(
+                    params, buffers, key, batch)
 
         def step_impl(params, buffers, opt_state, key, lr, batch):
             if gm > 1:
@@ -264,8 +354,7 @@ class ShardedTrainStep:
                         lambda v: jnp.reshape(
                             v, (gm, v.shape[0] // gm) + v.shape[1:])[i]
                         if hasattr(v, "ndim") and v.ndim >= 1 else v, batch)
-                    (loss, nb), g = jax.value_and_grad(
-                        loss_of, has_aux=True)(params, buf, sub, mb)
+                    (loss, nb), g = vag(params, buf, sub, mb)
                     acc = jax.tree_util.tree_map(jnp.add, acc, g)
                     return (acc, nb, k)
 
@@ -275,8 +364,7 @@ class ShardedTrainStep:
                 grads = jax.tree_util.tree_map(lambda g: g / gm, grads)
                 loss = jnp.zeros((), jnp.float32)
             else:
-                (loss, new_buf), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(params, buffers, key, batch)
+                (loss, new_buf), grads = vag(params, buffers, key, batch)
             new_params, new_opt = optimizer.apply_gradients(
                 params, grads, opt_state, lr=lr)
             return new_params, new_buf, new_opt, loss
@@ -320,6 +408,22 @@ class ShardedTrainStep:
 
 
 def distributed_jit(model: Layer, optimizer, train_fn: Callable,
-                    **kwargs) -> ShardedTrainStep:
-    """Build the hybrid-parallel train step for the current fleet mesh."""
+                    **kwargs):
+    """Build the train step for the current fleet mesh. When the
+    strategy enables localsgd, this returns a LocalSGDTrainStep (the
+    reference's LocalSGD meta-optimizer path); otherwise the SPMD
+    ShardedTrainStep."""
+    strategy = kwargs.get("strategy") or _strategy
+    if strategy is not None and (strategy.localsgd or
+                                 strategy.adaptive_localsgd):
+        from .localsgd import LocalSGDTrainStep
+        if isinstance(optimizer, _DistributedOptimizer):
+            optimizer = optimizer._inner
+        cfg = strategy.localsgd_configs
+        return LocalSGDTrainStep(
+            model, optimizer, train_fn,
+            k_steps=cfg.get("k_steps", 1),
+            begin_step=cfg.get("begin_step", 1),
+            adaptive=bool(strategy.adaptive_localsgd),
+            hcg=kwargs.get("hcg"), seed=kwargs.get("seed", 0))
     return ShardedTrainStep(model, optimizer, train_fn, **kwargs)

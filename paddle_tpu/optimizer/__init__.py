@@ -2,5 +2,6 @@
 
 from . import lr
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from .dgc import DGCMomentum
 from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
                         LarsMomentum, Momentum, Optimizer, RMSProp)

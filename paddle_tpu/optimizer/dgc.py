@@ -1,0 +1,113 @@
+"""Deep Gradient Compression momentum optimizer.
+
+Reference parity: operators/optimizers/dgc_momentum_op.cc +
+meta_optimizers/dgc_optimizer.py (+ the external dgc lib). Algorithm
+(Lin et al., "Deep Gradient Compression"): momentum correction + local
+gradient accumulation + top-k sparsification with error feedback, with
+a warmup of vanilla momentum and a sparsity ramp-up schedule.
+
+TPU-native notes: on GPU clusters DGC's payoff is ethernet bandwidth;
+sparse allreduce does not map onto ICI collectives, so the compressed
+gradient is exchanged as a masked dense tensor — full algorithmic
+semantics (the part that changes convergence), with the ICI fabric
+covering bandwidth. The top-k threshold is estimated from a strided
+sample like the reference's sampling estimator, so the update stays
+jit-safe (no data-dependent k).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .optimizer import Momentum
+
+_SAMPLE_CAP = 4096
+
+
+def _threshold(v_abs: jax.Array, sparsity: jax.Array) -> jax.Array:
+    """Estimate the |v| threshold keeping ~(1-sparsity) of entries,
+    from a strided sample (reference: dgc lib sampling estimator)."""
+    flat = v_abs.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    stride = max(1, n // _SAMPLE_CAP)
+    sample = flat[::stride]
+    return jnp.quantile(sample, jnp.clip(sparsity, 0.0, 1.0))
+
+
+class DGCMomentum(Momentum):
+    """Momentum with deep-gradient-compression semantics.
+
+    Before ``rampup_begin_step`` it is exactly ``Momentum``; after, each
+    step accumulates a velocity ``u`` and an error-feedback buffer ``v``
+    and applies only the top-magnitude fraction of ``v`` (per the
+    ramped ``sparsity`` schedule), keeping the rest for later steps.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 rampup_begin_step: int = 0, rampup_step: int = 1,
+                 sparsity: Sequence[float] = (0.999,),
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, momentum, parameters,
+                         use_nesterov, weight_decay, grad_clip, name, **kw)
+        self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
+        self._sparsity = tuple(float(s) for s in sparsity)
+
+    def _init_state(self, value):
+        return {"velocity": jnp.zeros_like(value),
+                "u": jnp.zeros_like(value),
+                "v": jnp.zeros_like(value)}
+
+    def sparsity_at(self, step) -> jax.Array:
+        """Ramp through the sparsity list over rampup_step steps
+        (reference: dgc_configs sparsity ramp)."""
+        levels = jnp.asarray(self._sparsity, jnp.float32)
+        if len(self._sparsity) == 1:
+            return levels[0]
+        pos = (jnp.asarray(step, jnp.float32) - self._rampup_begin) \
+            / self._rampup_step * (len(self._sparsity) - 1)
+        return jnp.interp(jnp.clip(pos, 0.0, len(self._sparsity) - 1),
+                          jnp.arange(len(self._sparsity),
+                                     dtype=jnp.float32), levels)
+
+    def _update(self, value, grad, state, lr, step):
+        g = grad.astype(value.dtype)
+        m = jnp.asarray(self._momentum, value.dtype)
+
+        def dense(_):
+            vel = m * state["velocity"] + g
+            if self._nesterov:
+                nv = value - lr * (g + m * vel)
+            else:
+                nv = value - lr * vel
+            return nv, vel, state["u"], state["v"]
+
+        def compressed(_):
+            # momentum correction: velocity accumulates locally…
+            u = m * state["u"] + g
+            v = state["v"] + u
+            # …and only the top-magnitude slice is applied this step.
+            sp = self.sparsity_at(step).astype(jnp.float32)
+            thr = _threshold(jnp.abs(v), sp).astype(value.dtype)
+            # >= so uniform-magnitude tensors (thr == max|v|) still
+            # apply instead of starving while v grows unboundedly
+            mask = jnp.abs(v) >= thr
+            applied = jnp.where(mask, v, jnp.zeros_like(v))
+            new_v = jnp.where(mask, jnp.zeros_like(v), v)
+            new_u = jnp.where(mask, jnp.zeros_like(u), u)
+            nv = value - lr * applied
+            return nv, state["velocity"], new_u, new_v
+
+        if value.ndim == 0 or value.size <= 1:
+            # scalars are never worth sparsifying
+            nv, vel, u, v = dense(None)
+        else:
+            nv, vel, u, v = lax.cond(
+                jnp.asarray(step) <= self._rampup_begin, dense,
+                compressed, None)
+        return nv, {"velocity": vel, "u": u, "v": v}

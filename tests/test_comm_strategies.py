@@ -1,0 +1,219 @@
+"""Communication-optimization strategies: DGC momentum, bf16-compressed
+grad allreduce (fp16_allreduce), LocalSGD. Reference analogs:
+meta_optimizers/{dgc,fp16_allreduce,localsgd}_optimizer.py."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.optimizer as optim
+from paddle_tpu import nn
+from paddle_tpu.distributed import (DistributedStrategy, fleet,
+                                    LocalSGDTrainStep)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def dp_env():
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 8}
+    fleet.init(strategy=s)
+    yield
+
+
+class TinyMLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 1)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _batch(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    w = rng.randn(8, 1).astype(np.float32)
+    y = x @ w + 0.1 * rng.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+def _mse(model, batch):
+    x, y = batch
+    pred = model(x)
+    return ((pred - y) ** 2).mean()
+
+
+# ------------------------------------------------------------------- DGC
+
+def test_dgc_matches_momentum_during_warmup():
+    pt.seed(0)
+    params = {"w": jnp.asarray(np.random.RandomState(0)
+                               .randn(4, 4).astype(np.float32))}
+    grads = {"w": jnp.ones((4, 4), jnp.float32)}
+    m = optim.Momentum(learning_rate=0.1, momentum=0.9)
+    d = optim.DGCMomentum(learning_rate=0.1, momentum=0.9,
+                          rampup_begin_step=100)
+    ps_m, st_m = m.apply_gradients(params, grads, m.init(params))
+    ps_d, st_d = d.apply_gradients(params, grads, d.init(params))
+    np.testing.assert_allclose(ps_m["w"], ps_d["w"], rtol=1e-6)
+
+
+def test_dgc_sparsifies_and_keeps_error_feedback():
+    d = optim.DGCMomentum(learning_rate=0.1, momentum=0.9,
+                          rampup_begin_step=0, sparsity=[0.75])
+    params = {"w": jnp.zeros((64,), jnp.float32)}
+    signs = jnp.where(jnp.arange(64) % 2 == 0, 1.0, -1.0)
+    g = (jnp.arange(64, dtype=jnp.float32) + 1.0) * signs
+    st = d.init(params)
+    new_p, new_st = d.apply_gradients(params, {"w": g}, st)
+    applied = (new_p["w"] != 0).sum()
+    # ~25% of entries applied; the rest accumulated in v
+    assert 4 <= int(applied) <= 32
+    v = new_st["slots"]["w"]["v"]
+    assert int((v != 0).sum()) == 64 - int(applied)
+    # masked-out entries are preserved, not lost
+    np.testing.assert_allclose(np.asarray(v[v != 0]),
+                               np.asarray(g[np.asarray(new_p["w"]) == 0]),
+                               rtol=1e-6)
+
+
+def test_dgc_converges_on_quadratic():
+    d = optim.DGCMomentum(learning_rate=0.01, momentum=0.9,
+                          rampup_begin_step=0, sparsity=[0.9])
+    target = jnp.asarray(np.random.RandomState(1)
+                         .randn(32).astype(np.float32))
+    params = {"w": jnp.zeros((32,), jnp.float32)}
+    st = d.init(params)
+
+    @jax.jit
+    def step(p, s):
+        g = jax.grad(lambda q: ((q["w"] - target) ** 2).sum())(p)
+        return d.apply_gradients(p, g, s)
+
+    for _ in range(300):
+        params, st = step(params, st)
+    err = float(((params["w"] - target) ** 2).mean())
+    assert err < 1e-2, err
+
+
+def test_strategy_dgc_swaps_optimizer():
+    s = DistributedStrategy()
+    s.dgc = True
+    s.dgc_configs = {"rampup_begin_step": 5}
+    wrapped = fleet.distributed_optimizer(
+        optim.Momentum(learning_rate=0.1, momentum=0.9), s)
+    assert isinstance(wrapped._inner, optim.DGCMomentum)
+    assert wrapped._inner._rampup_begin == 5
+    # non-momentum optimizers pass through untouched
+    wrapped2 = fleet.distributed_optimizer(
+        optim.Adam(learning_rate=0.1), s)
+    assert isinstance(wrapped2._inner, optim.Adam)
+
+
+def test_dgc_uniform_magnitudes_still_update():
+    # ties at the quantile threshold must not starve the update
+    d = optim.DGCMomentum(learning_rate=0.1, momentum=0.9,
+                          rampup_begin_step=0, sparsity=[0.999])
+    params = {"b": jnp.zeros((4,), jnp.float32),
+              "s": jnp.zeros((1,), jnp.float32)}
+    g = {"b": jnp.ones((4,), jnp.float32),
+         "s": jnp.ones((1,), jnp.float32)}
+    st = d.init(params)
+    p, st = d.apply_gradients(params, g, st)
+    assert float(jnp.abs(p["b"]).max()) > 0, "uniform grads starved"
+    assert float(jnp.abs(p["s"]).max()) > 0, "size-1 tensor starved"
+
+
+def test_strategy_localsgd_routes_distributed_jit():
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 8}
+    s.localsgd = True
+    s.localsgd_configs = {"k_steps": 2}
+    fleet.init(strategy=s)
+    pt.seed(0)
+    step = fleet.distributed_jit(TinyMLP(), optim.SGD(learning_rate=0.05),
+                                 _mse, strategy=s)
+    assert isinstance(step, LocalSGDTrainStep)
+    assert step.k_steps == 2
+    x, y = _batch(64)
+    first = step((x, y))
+    for _ in range(10):
+        last = step((x, y))
+    assert last < first
+
+
+# --------------------------------------------------- bf16 grad allreduce
+
+def test_fp16_allreduce_step_matches_exact_path():
+    x, y = _batch()
+
+    def run(compress):
+        pt.seed(0)
+        model = TinyMLP()
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 8}
+        s.fp16_allreduce = compress
+        step = fleet.distributed_jit(
+            model, optim.SGD(learning_rate=0.1), _mse,
+            strategy=s, seed=0)
+        losses = [float(step((x, y))) for _ in range(5)]
+        return losses
+
+    exact = run(False)
+    comp = run(True)
+    assert comp[-1] < comp[0], comp
+    # bf16 mantissa (8 bits) → losses track within ~1%
+    np.testing.assert_allclose(comp, exact, rtol=2e-2)
+
+
+def test_fp16_allreduce_rejects_mp():
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    s.fp16_allreduce = True
+    fleet.init(strategy=s)
+    try:
+        with pytest.raises(ValueError, match="fp16_allreduce"):
+            fleet.distributed_jit(TinyMLP(), optim.SGD(0.1), _mse,
+                                  strategy=s)
+    finally:
+        s2 = DistributedStrategy()
+        s2.hybrid_configs = {"dp_degree": 8}
+        fleet.init(strategy=s2)
+
+
+# -------------------------------------------------------------- LocalSGD
+
+def test_localsgd_replicas_diverge_then_sync():
+    pt.seed(0)
+    model = TinyMLP()
+    step = LocalSGDTrainStep(model, optim.SGD(learning_rate=0.05),
+                             _mse, k_steps=4, begin_step=1, seed=0)
+    x, y = _batch(64)
+    losses = [step((x, y)) for _ in range(3)]  # 3 local steps, no sync yet
+    w = np.asarray(step.params["fc1.weight"])
+    spread = np.abs(w - w[0]).max()
+    assert spread > 0, "replicas should diverge between syncs"
+    step((x, y))  # 4th step triggers sync
+    w = np.asarray(step.params["fc1.weight"])
+    np.testing.assert_allclose(w, np.broadcast_to(w[0], w.shape),
+                               atol=1e-6)
+    assert losses[-1] < losses[0] * 1.5
+
+
+def test_localsgd_trains():
+    pt.seed(0)
+    model = TinyMLP()
+    step = LocalSGDTrainStep(model, optim.SGD(learning_rate=0.05),
+                             _mse, k_steps=2, seed=0)
+    x, y = _batch(64)
+    first = step((x, y))
+    for _ in range(30):
+        last = step((x, y))
+    assert last < first * 0.7, (first, last)
+    step.sync_to_model()  # writes averaged params back into the Layer
+    out = model(pt.Tensor(jnp.asarray(x)))
+    assert np.isfinite(np.asarray(out.value)).all()
