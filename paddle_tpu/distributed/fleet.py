@@ -262,7 +262,7 @@ class ShardedTrainStep:
 
         self._compress_grads = bool(self.strategy.fp16_allreduce)
         if self._compress_grads:
-            for ax in ("mp", "pp", "sep"):
+            for ax in ("mp", "pp", "sep", "sharding"):
                 if self.hcg.dims.get(ax, 1) > 1:
                     raise ValueError(
                         "fp16_allreduce compresses the data-parallel "
@@ -417,6 +417,10 @@ def distributed_jit(model: Layer, optimizer, train_fn: Callable,
     if strategy is not None and (strategy.localsgd or
                                  strategy.adaptive_localsgd):
         from .localsgd import LocalSGDTrainStep
+        if kwargs.get("batch_spec") is not None:
+            raise ValueError(
+                "batch_spec is not supported with localsgd (replica "
+                "batches shard over dp only)")
         if isinstance(optimizer, _DistributedOptimizer):
             optimizer = optimizer._inner
         cfg = strategy.localsgd_configs
@@ -425,5 +429,6 @@ def distributed_jit(model: Layer, optimizer, train_fn: Callable,
             k_steps=cfg.get("k_steps", 1),
             begin_step=cfg.get("begin_step", 1),
             adaptive=bool(strategy.adaptive_localsgd),
-            hcg=kwargs.get("hcg"), seed=kwargs.get("seed", 0))
+            hcg=kwargs.get("hcg"), seed=kwargs.get("seed", 0),
+            donate=kwargs.get("donate", True))
     return ShardedTrainStep(model, optimizer, train_fn, **kwargs)

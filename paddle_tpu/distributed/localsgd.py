@@ -39,7 +39,8 @@ class LocalSGDTrainStep:
 
     def __init__(self, model: Layer, optimizer, train_fn: Callable,
                  k_steps: int = 1, begin_step: int = 1,
-                 adaptive: bool = False, hcg=None, seed: int = 0):
+                 adaptive: bool = False, hcg=None, seed: int = 0,
+                 donate: bool = True):
         self.model = model
         self.optimizer = optimizer
         self.train_fn = train_fn
@@ -79,12 +80,13 @@ class LocalSGDTrainStep:
         self._t = 0
         self._loss0: Optional[float] = None
         self._since_sync = 0
-        self._step_fn = self._build_step()
+        self.donate = bool(donate)
+        self._step_cache: dict = {}
         self._sync_fn = self._build_sync()
 
     # ------------------------------------------------------------- build
 
-    def _build_step(self):
+    def _build_step(self, batch_specs):
         model, optimizer, train_fn = self.model, self.optimizer, \
             self.train_fn
         mesh = self.mesh
@@ -106,10 +108,11 @@ class LocalSGDTrainStep:
 
         smapped = shard_map(
             local_step, mesh=mesh,
-            in_specs=(P("dp"), P("dp"), P("dp"), P(), P(), P("dp")),
+            in_specs=(P("dp"), P("dp"), P("dp"), P(), P(), batch_specs),
             out_specs=(P("dp"), P("dp"), P("dp"), P("dp")),
             check_vma=False)
-        return jax.jit(smapped, donate_argnums=(0, 1, 2))
+        donate = (0, 1, 2) if self.donate else ()
+        return jax.jit(smapped, donate_argnums=donate)
 
     def _build_sync(self):
         mesh = self.mesh
@@ -130,24 +133,32 @@ class LocalSGDTrainStep:
         batch_raw = jax.tree_util.tree_map(
             lambda t: t.value if isinstance(t, Tensor) else t, batch,
             is_leaf=lambda t: isinstance(t, Tensor))
-        shardings = jax.tree_util.tree_map(
-            lambda v: NamedSharding(self.mesh, P("dp"))
-            if hasattr(v, "ndim") and np.ndim(v) >= 1
-            else NamedSharding(self.mesh, P()), batch_raw)
+        # scalar/0-d leaves are replicated; arrays shard over dp
+        specs = jax.tree_util.tree_map(
+            lambda v: P("dp") if np.ndim(v) >= 1 else P(), batch_raw)
         batch_raw = jax.tree_util.tree_map(
-            lambda v, s: jax.device_put(jnp.asarray(v), s), batch_raw,
-            shardings)
+            lambda v, sp: jax.device_put(
+                jnp.asarray(v), NamedSharding(self.mesh, sp)),
+            batch_raw, specs)
+        cache_key = (jax.tree_util.tree_structure(batch_raw),
+                     tuple(jax.tree_util.tree_leaves(specs)))
+        step_fn = self._step_cache.get(cache_key)
+        if step_fn is None:
+            step_fn = self._step_cache[cache_key] = self._build_step(specs)
         self._key, sub = jax.random.split(self._key)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        self.params, self.buffers, self.opt_state, losses = self._step_fn(
+        self.params, self.buffers, self.opt_state, losses = step_fn(
             self.params, self.buffers, self.opt_state, sub, lr, batch_raw)
         self._t += 1
         self._since_sync += 1
-        loss = float(jnp.mean(losses))
-        if self._t >= self.begin_step and self._since_sync >= self.k_steps:
+        loss = jnp.mean(losses)  # lazy: no host sync on local steps
+        # Before begin_step the reference trains fully synchronously
+        # (averaging every step); only afterwards does k-step local SGD
+        # kick in (localsgd_optimizer.py begin_step semantics).
+        if self._t < self.begin_step or self._since_sync >= self.k_steps:
             self.sync()
-            if self.adaptive:
-                self._adapt(loss)
+            if self.adaptive and self._t >= self.begin_step:
+                self._adapt(float(loss))
         return loss
 
     def sync(self) -> None:

@@ -1,0 +1,92 @@
+"""RaggedTensor: the TPU-native stand-in for the reference's LoDTensor.
+
+The reference carries ragged "level of detail" offsets on the tensor
+itself (paddle/fluid/framework/lod_tensor.h:109) and runs variable-length
+kernels over them. Under XLA, shapes must be static, so the design here is
+split in two:
+
+- **Host-side container** (this class): ``values`` + ``row_splits`` exactly
+  like a 1-level LoD, used in the data pipeline (datasets, feeds, PS slot
+  parsing). Conversion to/from the device representation is explicit.
+- **Device representation**: a dense padded array ``[batch, maxlen, ...]``
+  plus an int32 ``lengths [batch]`` vector. All sequence ops
+  (paddle_tpu.ops.sequence) consume this pair — masks instead of offsets,
+  so everything jits and tiles onto the MXU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["RaggedTensor"]
+
+
+class RaggedTensor:
+    """1-level ragged batch: ``values`` flattened along dim 0, row i owning
+    ``values[row_splits[i]:row_splits[i+1]]``."""
+
+    def __init__(self, values, row_splits):
+        self.values = np.asarray(values)
+        self.row_splits = np.asarray(row_splits, dtype=np.int64)
+        if self.row_splits.ndim != 1 or self.row_splits[0] != 0:
+            raise ValueError("row_splits must be 1-D starting at 0")
+        if int(self.row_splits[-1]) != self.values.shape[0]:
+            raise ValueError(
+                f"row_splits end {int(self.row_splits[-1])} != "
+                f"values rows {self.values.shape[0]}")
+
+    # -- constructors -------------------------------------------------
+    @staticmethod
+    def from_rows(rows):
+        rows = [np.asarray(r) for r in rows]
+        splits = np.zeros(len(rows) + 1, dtype=np.int64)
+        np.cumsum([r.shape[0] for r in rows], out=splits[1:])
+        values = (np.concatenate(rows, axis=0) if rows
+                  else np.zeros((0,), dtype=np.float32))
+        return RaggedTensor(values, splits)
+
+    @staticmethod
+    def from_padded(padded, lengths):
+        padded = np.asarray(padded)
+        lengths = np.asarray(lengths, dtype=np.int64)
+        return RaggedTensor.from_rows(
+            [padded[i, : int(n)] for i, n in enumerate(lengths)])
+
+    # -- views --------------------------------------------------------
+    @property
+    def lengths(self):
+        return np.diff(self.row_splits)
+
+    @property
+    def nrows(self):
+        return len(self.row_splits) - 1
+
+    def row(self, i):
+        return self.values[self.row_splits[i]:self.row_splits[i + 1]]
+
+    def rows(self):
+        return [self.row(i) for i in range(self.nrows)]
+
+    def __len__(self):
+        return self.nrows
+
+    def __repr__(self):
+        return (f"RaggedTensor(nrows={self.nrows}, "
+                f"values={self.values.shape}, dtype={self.values.dtype})")
+
+    # -- device bridge ------------------------------------------------
+    def to_padded(self, maxlen=None, pad_value=0):
+        """Return ``(padded [nrows, maxlen, ...], lengths [nrows])`` — the
+        static-shape device representation."""
+        lengths = self.lengths
+        m = int(maxlen) if maxlen is not None else int(lengths.max(initial=0))
+        tail = self.values.shape[1:]
+        out = np.full((self.nrows, m) + tail, pad_value,
+                      dtype=self.values.dtype)
+        for i in range(self.nrows):
+            n = min(int(lengths[i]), m)
+            out[i, :n] = self.row(i)[:n]
+        return out, np.minimum(lengths, m).astype(np.int32)
+
+    def concat(self, other):
+        return RaggedTensor.from_rows(self.rows() + other.rows())

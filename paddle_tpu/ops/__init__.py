@@ -9,11 +9,12 @@ eager dispatcher can enumerate them.
 import inspect as _inspect
 
 from . import creation, linalg, manipulation, math, nn_functional, random, \
-    rnn, search
+    rnn, search, sequence
 from .registry import OpDef, all_ops, get_op, has_op, register_op
 
 _DYNAMIC_SHAPE_OPS = {
     "nonzero", "masked_select", "unique", "unique_consecutive", "where",
+    "sequence_unpad",
 }
 _NON_DIFF_OPS = {
     "argmax", "argmin", "argsort", "randint", "randperm", "one_hot",
@@ -26,7 +27,7 @@ _NON_DIFF_OPS = {
 
 def _auto_register():
     for mod in (creation, math, manipulation, search, linalg, random,
-                nn_functional, rnn):
+                nn_functional, rnn, sequence):
         short = mod.__name__.rsplit(".", 1)[-1]
         for name, fn in vars(mod).items():
             if name.startswith("_") or not callable(fn):

@@ -1,0 +1,214 @@
+"""Sequence ops over the padded+lengths device representation.
+
+Reference parity: paddle/fluid/operators/sequence_ops/ (49 files —
+sequence_pool, sequence_softmax, sequence_expand, sequence_reverse,
+sequence_pad/unpad, sequence_slice, sequence_enumerate, sequence_conv...).
+The reference kernels walk LoD offsets; here every op takes a dense
+``x [batch, maxlen, ...]`` plus int ``lengths [batch]`` and works through
+masks so it stays jittable with static shapes (SURVEY §2.1 "Tensor & IR
+types" row). Host-side ragged data uses framework.ragged.RaggedTensor.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _valid_mask(lengths, maxlen):
+    return jnp.arange(maxlen)[None, :] < jnp.asarray(lengths)[:, None]
+
+
+def _expand_mask(mask, x):
+    return mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+
+
+def sequence_pad(x, lengths, pad_value=0.0):
+    """Force padding positions of an already-dense batch to ``pad_value``
+    (ref sequence_pad_op.cc semantics on the device representation)."""
+    mask = _expand_mask(_valid_mask(lengths, x.shape[1]), x)
+    return jnp.where(mask, x, jnp.asarray(pad_value, dtype=x.dtype))
+
+
+def sequence_pool(x, lengths, pool_type="sum"):
+    """Pool each sequence's valid prefix. pool_type: sum|mean|sqrt|max|min|
+    first|last (ref sequence_pool_op.h SequencePoolFunctor)."""
+    n, m = x.shape[0], x.shape[1]
+    mask = _expand_mask(_valid_mask(lengths, m), x)
+    lengths = jnp.asarray(lengths)
+    denom_shape = (n,) + (1,) * (x.ndim - 2)
+    len_b = jnp.maximum(lengths, 1).astype(x.dtype).reshape(denom_shape)
+    if pool_type == "sum":
+        return jnp.where(mask, x, 0).sum(axis=1)
+    if pool_type == "mean":
+        return jnp.where(mask, x, 0).sum(axis=1) / len_b
+    if pool_type == "sqrt":
+        return jnp.where(mask, x, 0).sum(axis=1) / jnp.sqrt(len_b)
+    if pool_type == "max":
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(
+            x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jnp.where(mask, x, neg).max(axis=1)
+    if pool_type == "min":
+        pos = jnp.finfo(x.dtype).max if jnp.issubdtype(
+            x.dtype, jnp.floating) else jnp.iinfo(x.dtype).max
+        return jnp.where(mask, x, pos).min(axis=1)
+    if pool_type == "first":
+        ok = (lengths > 0).reshape(denom_shape)
+        return jnp.where(ok, x[:, 0], jnp.zeros((), x.dtype))
+    if pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        last = jnp.take_along_axis(
+            x, idx.reshape((n, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+        ok = (lengths > 0).reshape(denom_shape)
+        return jnp.where(ok, last, jnp.zeros((), x.dtype))
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(x, lengths):
+    return sequence_pool(x, lengths, "first")
+
+
+def sequence_last_step(x, lengths):
+    return sequence_pool(x, lengths, "last")
+
+
+def sequence_softmax(x, lengths):
+    """Softmax over each sequence's valid prefix; padding gets 0
+    (ref sequence_softmax_op.cc)."""
+    mask = _expand_mask(_valid_mask(lengths, x.shape[1]), x)
+    neg = jnp.finfo(x.dtype).min
+    z = jnp.where(mask, x, neg)
+    z = z - z.max(axis=1, keepdims=True)
+    e = jnp.where(mask, jnp.exp(z), 0)
+    return e / jnp.maximum(e.sum(axis=1, keepdims=True), 1e-30)
+
+
+def sequence_reverse(x, lengths):
+    """Reverse the valid prefix of each row, keeping padding in place
+    (ref sequence_reverse_op.h)."""
+    m = x.shape[1]
+    lengths = jnp.asarray(lengths)
+    pos = jnp.arange(m)[None, :]
+    src = jnp.where(pos < lengths[:, None], lengths[:, None] - 1 - pos, pos)
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def sequence_slice(x, lengths, offset, length):
+    """Per-row slice [offset, offset+length) of the valid prefix; returns
+    (sliced [batch, length, ...], new_lengths) (ref sequence_slice_op.h).
+    ``length`` must be a static int (XLA shapes); offsets may be traced."""
+    length = int(length)
+    offset = jnp.asarray(offset)
+    if offset.ndim == 0:
+        offset = jnp.broadcast_to(offset, (x.shape[0],))
+    pos = jnp.arange(length)[None, :] + offset[:, None]
+    pos = jnp.clip(pos, 0, x.shape[1] - 1)
+    out = jnp.take_along_axis(
+        x, pos.reshape(pos.shape + (1,) * (x.ndim - 2)), axis=1)
+    new_len = jnp.clip(jnp.asarray(lengths) - offset, 0, length)
+    return out, new_len.astype(jnp.int32)
+
+
+def sequence_expand(x, ref_lengths, max_ref=None):
+    """Repeat each row x[i] into ``ref_lengths[i]`` timesteps of a padded
+    output [batch, max_ref, ...] (ref sequence_expand_op.h with y's lod as
+    the repeat counts); slots >= ref_lengths[i] are 0. ``max_ref`` is the
+    static output width — required when ref_lengths is traced."""
+    ref_lengths = jnp.asarray(ref_lengths)
+    if max_ref is None:
+        try:
+            max_ref = int(ref_lengths.max())
+        except jax.errors.ConcretizationTypeError:
+            raise ValueError(
+                "sequence_expand requires max_ref when ref_lengths is "
+                "traced (static output shape under XLA)") from None
+    reps = jnp.arange(int(max_ref))[None, :] < ref_lengths[:, None]
+    out = jnp.where(reps.reshape(reps.shape + (1,) * (x.ndim - 1)),
+                    x[:, None], 0)
+    return out, jnp.minimum(ref_lengths, max_ref).astype(jnp.int32)
+
+
+def sequence_expand_as(x, ref_lengths, max_ref=None):
+    """Alias of sequence_expand for 2-D x (ref sequence_expand_as_op.h)."""
+    return sequence_expand(x, ref_lengths, max_ref)
+
+
+def sequence_enumerate(x, lengths, win_size, pad_value=0):
+    """Sliding windows of size win_size per position:
+    out[b, t] = x[b, t:t+win] with positions beyond the valid length set
+    to pad_value (ref sequence_enumerate_op.h). x is [batch, maxlen] ints."""
+    m = x.shape[1]
+    lengths = jnp.asarray(lengths)
+    idx = jnp.arange(m)[:, None] + jnp.arange(win_size)[None, :]  # [m, win]
+    gather = jnp.take(x, jnp.clip(idx, 0, m - 1), axis=1)  # [b, m, win]
+    valid = idx[None, :, :] < lengths[:, None, None]
+    return jnp.where(valid, gather, pad_value)
+
+
+def sequence_erase(x, lengths, tokens):
+    """Remove every occurrence of ``tokens`` from each sequence, compacting
+    left and re-padding with 0; returns (out, new_lengths)
+    (ref sequence_erase_op.h). Shapes stay static: out has the same maxlen."""
+    tokens = jnp.asarray(tokens).reshape(-1)
+    m = x.shape[1]
+    valid = _valid_mask(lengths, m)
+    keep = valid & ~(x[..., None] == tokens[None, None, :]).any(-1)
+    # stable compaction: sort positions by (dropped, original index)
+    order = jnp.argsort(jnp.where(keep, 0, 1) * m + jnp.arange(m)[None, :],
+                        axis=1)
+    gathered = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    return jnp.where(_valid_mask(new_len, m), gathered, 0), new_len
+
+
+def sequence_concat(xs, lengths_list):
+    """Concatenate sequences row-wise: out row b = xs[0][b] ++ xs[1][b] ...
+    (ref sequence_concat_op.h). Static maxlen = sum of input maxlens."""
+    total = sum(x.shape[1] for x in xs)
+    batch = xs[0].shape[0]
+    tail = xs[0].shape[2:]
+    out = jnp.zeros((batch, total) + tail, dtype=xs[0].dtype)
+    pos = jnp.zeros((batch,), dtype=jnp.int32)
+    for x, ln in zip(xs, lengths_list):
+        ln = jnp.asarray(ln)
+        m = x.shape[1]
+        dest = pos[:, None] + jnp.arange(m)[None, :]
+        valid = _valid_mask(ln, m)
+        dest = jnp.where(valid, dest, total)  # out-of-range → dropped
+        b_idx = jnp.broadcast_to(jnp.arange(batch)[:, None], dest.shape)
+        out = out.at[b_idx, dest].set(x, mode="drop")
+        pos = pos + ln.astype(jnp.int32)
+    return out, pos
+
+
+def sequence_unpad(x, lengths):
+    """Padded → host RaggedTensor (eager only; dynamic result shape)."""
+    import numpy as np
+
+    from ..framework.ragged import RaggedTensor
+    return RaggedTensor.from_padded(np.asarray(x), np.asarray(lengths))
+
+
+def sequence_conv(x, lengths, weight, context_length, context_start=None):
+    """Context-window convolution over sequences (ref sequence_conv_op.h):
+    each timestep concatenates ``context_length`` neighbouring frames
+    (starting at ``context_start``, default -(ctx-1)//2) and matmuls with
+    ``weight [context_length*dim, out_dim]``. Padding frames are zeros."""
+    if context_start is None:
+        context_start = -((context_length - 1) // 2)
+    b, m, d = x.shape
+    valid = _valid_mask(lengths, m)
+    xz = jnp.where(valid[..., None], x, 0)
+    cols = []
+    for k in range(context_length):
+        shift = context_start + k
+        idx = jnp.arange(m) + shift
+        ok = (idx >= 0) & (idx < m)
+        col = jnp.take(xz, jnp.clip(idx, 0, m - 1), axis=1)
+        cols.append(jnp.where(ok[None, :, None], col, 0))
+    im2col = jnp.concatenate(cols, axis=-1)  # [b, m, ctx*d]
+    out = im2col.reshape(b * m, -1) @ weight
+    out = out.reshape(b, m, -1)
+    return jnp.where(valid[..., None], out, 0)

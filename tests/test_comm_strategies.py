@@ -133,17 +133,58 @@ def test_strategy_localsgd_routes_distributed_jit():
     s.hybrid_configs = {"dp_degree": 8}
     s.localsgd = True
     s.localsgd_configs = {"k_steps": 2}
-    fleet.init(strategy=s)
-    pt.seed(0)
-    step = fleet.distributed_jit(TinyMLP(), optim.SGD(learning_rate=0.05),
-                                 _mse, strategy=s)
-    assert isinstance(step, LocalSGDTrainStep)
-    assert step.k_steps == 2
-    x, y = _batch(64)
-    first = step((x, y))
-    for _ in range(10):
-        last = step((x, y))
-    assert last < first
+    try:
+        fleet.init(strategy=s)
+        pt.seed(0)
+        step = fleet.distributed_jit(
+            TinyMLP(), optim.SGD(learning_rate=0.05), _mse, strategy=s)
+        assert isinstance(step, LocalSGDTrainStep)
+        assert step.k_steps == 2
+        x, y = _batch(64)
+        first = float(step((x, y)))
+        for _ in range(10):
+            last = float(step((x, y)))
+        assert last < first
+    finally:
+        fleet.init(strategy=DistributedStrategy())
+
+
+def test_localsgd_warmup_syncs_every_step():
+    # before begin_step training is fully synchronous: replica params
+    # must stay identical even though k_steps would allow divergence
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 8}
+    try:
+        fleet.init(strategy=s)
+        pt.seed(0)
+        step = LocalSGDTrainStep(
+            TinyMLP(), optim.SGD(learning_rate=0.05), _mse,
+            k_steps=4, begin_step=100)
+        x, y = _batch(64)
+        step((x, y))
+        step((x, y))
+        for v in jax.tree_util.tree_leaves(step.params):
+            v = np.asarray(v)
+            assert np.allclose(v, v[:1]), "replicas diverged in warmup"
+    finally:
+        fleet.init(strategy=DistributedStrategy())
+
+
+def test_localsgd_scalar_batch_leaf():
+    # 0-d batch leaves must be replicated, not dp-sharded
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 8}
+    try:
+        fleet.init(strategy=s)
+        pt.seed(0)
+        step = LocalSGDTrainStep(
+            TinyMLP(), optim.SGD(learning_rate=0.05),
+            lambda m, b: _mse(m, (b[0], b[1])) * b[2], k_steps=2)
+        x, y = _batch(64)
+        loss = step((x, y, np.float32(0.5)))
+        assert np.isfinite(float(loss))
+    finally:
+        fleet.init(strategy=DistributedStrategy())
 
 
 # --------------------------------------------------- bf16 grad allreduce

@@ -1,0 +1,168 @@
+"""Sequence ops + RaggedTensor — parity with operators/sequence_ops/
+semantics on the padded+lengths representation (NumPy references)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.framework.ragged import RaggedTensor
+from paddle_tpu.ops import sequence as seq
+
+
+@pytest.fixture
+def batch(rng):
+    lengths = np.array([3, 5, 1, 4], dtype=np.int32)
+    x = rng.normal(size=(4, 5, 2)).astype(np.float32)
+    for i, n in enumerate(lengths):
+        x[i, n:] = 7.7  # garbage in padding: ops must mask it out
+    return x, lengths
+
+
+def test_ragged_roundtrip(rng):
+    rows = [rng.normal(size=(n, 3)).astype(np.float32) for n in (2, 0, 4)]
+    r = RaggedTensor.from_rows(rows)
+    assert r.nrows == 3 and list(r.lengths) == [2, 0, 4]
+    padded, lengths = r.to_padded()
+    assert padded.shape == (3, 4, 3)
+    r2 = RaggedTensor.from_padded(padded, lengths)
+    for a, b in zip(r.rows(), r2.rows()):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sequence_pad(batch):
+    x, lengths = batch
+    out = seq.sequence_pad(x, lengths, pad_value=-1.0)
+    out = np.asarray(out)
+    assert (out[0, 3:] == -1.0).all() and (out[2, 1:] == -1.0).all()
+    np.testing.assert_array_equal(out[1], x[1])
+
+
+@pytest.mark.parametrize("pool", ["sum", "mean", "sqrt", "max", "min",
+                                  "first", "last"])
+def test_sequence_pool(batch, pool):
+    x, lengths = batch
+    out = np.asarray(seq.sequence_pool(x, lengths, pool))
+    for i, n in enumerate(lengths):
+        v = x[i, :n]
+        ref = {"sum": v.sum(0), "mean": v.mean(0),
+               "sqrt": v.sum(0) / np.sqrt(n), "max": v.max(0),
+               "min": v.min(0), "first": v[0], "last": v[n - 1]}[pool]
+        np.testing.assert_allclose(out[i], ref, rtol=1e-5)
+
+
+def test_sequence_pool_zero_length_rows(rng):
+    x = np.full((2, 3, 2), 7.7, dtype=np.float32)  # row 0 empty
+    x[1, :2] = rng.normal(size=(2, 2))
+    lengths = np.array([0, 2], dtype=np.int32)
+    for pool in ("first", "last", "sum", "mean"):
+        out = np.asarray(seq.sequence_pool(x, lengths, pool))
+        assert (out[0] == 0).all(), f"{pool} leaked padding for n=0"
+    np.testing.assert_allclose(
+        np.asarray(seq.sequence_pool(x, lengths, "first"))[1], x[1, 0])
+    np.testing.assert_allclose(
+        np.asarray(seq.sequence_pool(x, lengths, "last"))[1], x[1, 1])
+
+
+def test_sequence_softmax(batch):
+    x, lengths = batch
+    x2 = x[..., 0]
+    out = np.asarray(seq.sequence_softmax(x2, lengths))
+    for i, n in enumerate(lengths):
+        e = np.exp(x2[i, :n] - x2[i, :n].max())
+        np.testing.assert_allclose(out[i, :n], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(out[i, n:], 0.0)
+
+
+def test_sequence_reverse(batch):
+    x, lengths = batch
+    out = np.asarray(seq.sequence_reverse(x, lengths))
+    for i, n in enumerate(lengths):
+        np.testing.assert_array_equal(out[i, :n], x[i, :n][::-1])
+        np.testing.assert_array_equal(out[i, n:], x[i, n:])
+
+
+def test_sequence_slice(batch):
+    x, lengths = batch
+    out, new_len = seq.sequence_slice(x, lengths, offset=1, length=2)
+    assert out.shape == (4, 2, 2)
+    np.testing.assert_array_equal(np.asarray(new_len), [2, 2, 0, 2])
+    np.testing.assert_array_equal(np.asarray(out)[0], x[0, 1:3])
+
+
+def test_sequence_expand():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+    out, new_len = seq.sequence_expand(x, np.array([2, 3]))
+    out = np.asarray(out)
+    assert out.shape == (2, 3, 2)
+    np.testing.assert_array_equal(out[0, :2], [[1, 2], [1, 2]])
+    np.testing.assert_array_equal(out[0, 2], [0, 0])
+    np.testing.assert_array_equal(out[1], [[3, 4]] * 3)
+    np.testing.assert_array_equal(np.asarray(new_len), [2, 3])
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3, 0], [4, 5, 0, 0]], dtype=np.int32)
+    lengths = np.array([3, 2], dtype=np.int32)
+    out = np.asarray(seq.sequence_enumerate(x, lengths, win_size=2,
+                                            pad_value=9))
+    np.testing.assert_array_equal(out[0, 0], [1, 2])
+    np.testing.assert_array_equal(out[0, 2], [3, 9])
+    np.testing.assert_array_equal(out[1, 1], [5, 9])
+
+
+def test_sequence_erase():
+    x = np.array([[1, 2, 1, 3, 0], [2, 2, 2, 0, 0]], dtype=np.int32)
+    lengths = np.array([4, 3], dtype=np.int32)
+    out, new_len = seq.sequence_erase(x, lengths, tokens=[1, 2])
+    np.testing.assert_array_equal(np.asarray(new_len), [1, 0])
+    np.testing.assert_array_equal(np.asarray(out)[0], [3, 0, 0, 0, 0])
+    np.testing.assert_array_equal(np.asarray(out)[1], 0)
+
+
+def test_sequence_concat():
+    a = np.array([[1, 2, 0], [3, 0, 0]], dtype=np.float32)
+    b = np.array([[5, 0], [6, 7]], dtype=np.float32)
+    out, new_len = seq.sequence_concat(
+        [a, b], [np.array([2, 1]), np.array([1, 2])])
+    np.testing.assert_array_equal(np.asarray(new_len), [3, 3])
+    np.testing.assert_array_equal(np.asarray(out)[0], [1, 2, 5, 0, 0])
+    np.testing.assert_array_equal(np.asarray(out)[1], [3, 6, 7, 0, 0])
+
+
+def test_sequence_conv(rng):
+    x = rng.normal(size=(2, 4, 3)).astype(np.float32)
+    lengths = np.array([4, 2], dtype=np.int32)
+    w = rng.normal(size=(9, 5)).astype(np.float32)  # ctx=3 * dim=3
+    out = np.asarray(seq.sequence_conv(x, lengths, w, context_length=3))
+    # reference: timestep t of row 0 = [x[t-1], x[t], x[t+1]] @ w
+    xz = x.copy()
+    xz[1, 2:] = 0
+    t = 1
+    ref = np.concatenate([xz[0, t - 1], xz[0, t], xz[0, t + 1]]) @ w
+    np.testing.assert_allclose(out[0, t], ref, rtol=1e-4)
+    assert (out[1, 2:] == 0).all()
+
+
+def test_sequence_ops_jit(batch):
+    x, lengths = batch
+    f = jax.jit(lambda a, n: seq.sequence_pool(
+        seq.sequence_softmax(a, n), n, "mean"))
+    out = f(jnp.asarray(x[..., 0]), jnp.asarray(lengths))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_grad_flows_through_pool(batch):
+    x, lengths = batch
+    g = jax.grad(lambda a: seq.sequence_pool(a, lengths, "mean").sum())(
+        jnp.asarray(x))
+    g = np.asarray(g)
+    assert (g[0, 3:] == 0).all()          # no grad into padding
+    assert (np.abs(g[0, :3]) > 0).all()
+
+
+def test_sequence_unpad(batch):
+    x, lengths = batch
+    r = seq.sequence_unpad(x, lengths)
+    assert isinstance(r, RaggedTensor)
+    np.testing.assert_array_equal(r.row(1), x[1, :5])
