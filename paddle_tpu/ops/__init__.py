@@ -8,8 +8,8 @@ eager dispatcher can enumerate them.
 
 import inspect as _inspect
 
-from . import creation, linalg, manipulation, math, nn_functional, random, \
-    rnn, search, sequence
+from . import creation, detection, linalg, manipulation, math, \
+    nn_functional, random, rnn, search, sequence
 from .registry import OpDef, all_ops, get_op, has_op, register_op
 
 _DYNAMIC_SHAPE_OPS = {
@@ -22,12 +22,13 @@ _NON_DIFF_OPS = {
     "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
     "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "isnan",
     "isinf", "isfinite", "shape", "numel", "count_nonzero",
+    "nms", "multiclass_nms", "bipartite_match",
 }
 
 
 def _auto_register():
     for mod in (creation, math, manipulation, search, linalg, random,
-                nn_functional, rnn, sequence):
+                nn_functional, rnn, sequence, detection):
         short = mod.__name__.rsplit(".", 1)[-1]
         for name, fn in vars(mod).items():
             if name.startswith("_") or not callable(fn):

@@ -1,0 +1,382 @@
+"""Detection op family (jax-native, static shapes).
+
+Reference parity: paddle/fluid/operators/detection/ (66 files). The
+kernels there walk dynamic box lists; here every op is fixed-size with
+validity masks so it jits and vmaps: NMS returns ``max_out`` slots plus a
+count, matchers return per-column indices. Boxes are ``[x1, y1, x2, y2]``
+unless noted.
+
+Implemented subset (the ops the reference's SSD/YOLO/R-CNN configs use):
+iou_similarity (iou_similarity_op.h), box_coder (box_coder_op.h),
+prior_box (prior_box_op.h), anchor_generator (anchor_generator_op.h),
+yolo_box (yolo_box_op.h), nms / multiclass_nms (multiclass_nms_op.cc),
+roi_align (roi_align_op.h), roi_pool (roi_pool_op.h), bipartite_match
+(bipartite_match_op.cc), box_clip (box_clip_op.h).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def box_area(boxes):
+    return jnp.maximum(boxes[..., 2] - boxes[..., 0], 0) * \
+        jnp.maximum(boxes[..., 3] - boxes[..., 1], 0)
+
+
+def iou_similarity(x, y):
+    """Pairwise IoU: x [N,4], y [M,4] → [N,M]."""
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(x)[:, None] + box_area(y)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def box_clip(boxes, im_shape):
+    """Clip boxes to [0, h-1] x [0, w-1]; im_shape = (h, w)."""
+    h, w = im_shape[0], im_shape[1]
+    x1 = jnp.clip(boxes[..., 0], 0, w - 1)
+    y1 = jnp.clip(boxes[..., 1], 0, h - 1)
+    x2 = jnp.clip(boxes[..., 2], 0, w - 1)
+    y2 = jnp.clip(boxes[..., 3], 0, h - 1)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode",
+              box_normalized=True):
+    """Encode targets against priors or decode deltas back to boxes
+    (ref box_coder_op.h EncodeCenterSize/DecodeCenterSize)."""
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + norm
+    ph = prior_box[:, 3] - prior_box[:, 1] + norm
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    if prior_box_var is None:
+        var = jnp.ones((1, 4), prior_box.dtype)
+    else:
+        var = jnp.asarray(prior_box_var).reshape(-1, 4)
+    if code_type == "encode":
+        tw = target_box[:, 2] - target_box[:, 0] + norm
+        th = target_box[:, 3] - target_box[:, 1] + norm
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :],
+            jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10)),
+            jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10)),
+        ], axis=-1)  # [T, P, 4]
+        return out / var[None]
+    # decode: target_box [P, 4] deltas (one per prior)
+    d = target_box * var
+    w = jnp.exp(d[:, 2]) * pw
+    h = jnp.exp(d[:, 3]) * ph
+    cx = d[:, 0] * pw + pcx
+    cy = d[:, 1] * ph + pcy
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+
+
+def prior_box(feature_h, feature_w, image_h, image_w, min_sizes,
+              max_sizes=(), aspect_ratios=(1.0,), flip=True, clip=False,
+              step_w=0.0, step_h=0.0, offset=0.5,
+              variances=(0.1, 0.1, 0.2, 0.2), min_max_aspect_ratios_order=False):
+    """SSD prior boxes (ref prior_box_op.h): returns
+    (boxes [fh, fw, num_priors, 4] normalized xyxy, variances same shape)."""
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    sw = step_w or image_w / feature_w
+    sh = step_h or image_h / feature_h
+    cx = (jnp.arange(feature_w) + offset) * sw
+    cy = (jnp.arange(feature_h) + offset) * sh
+    whs = []
+    for k, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if k < len(max_sizes):
+                s = np.sqrt(ms * max_sizes[k])
+                whs.append((s, s))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if k < len(max_sizes):
+                s = np.sqrt(ms * max_sizes[k])
+                whs.append((s, s))
+    wh = jnp.asarray(whs, jnp.float32)  # [np, 2]
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [fh, fw]
+    c = jnp.stack([cxg, cyg], -1)[:, :, None, :]  # [fh, fw, 1, 2]
+    half = wh[None, None] * 0.5
+    boxes = jnp.concatenate([c - half, c + half], axis=-1)
+    boxes = boxes / jnp.asarray([image_w, image_h, image_w, image_h],
+                                jnp.float32)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           boxes.shape)
+    return boxes, var
+
+
+def anchor_generator(feature_h, feature_w, anchor_sizes, aspect_ratios,
+                     stride, offset=0.5, variances=(0.1, 0.1, 0.2, 0.2)):
+    """RPN anchors (ref anchor_generator_op.h): returns
+    (anchors [fh, fw, na, 4] in input-image pixels, variances)."""
+    combos = list(itertools.product(aspect_ratios, anchor_sizes))
+    wh = []
+    for ar, sz in combos:
+        area = float(sz) * float(sz)
+        w = np.sqrt(area / ar)
+        wh.append((w, w * ar))
+    wh = jnp.asarray(wh, jnp.float32)
+    cx = (jnp.arange(feature_w) + offset) * stride[0]
+    cy = (jnp.arange(feature_h) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    c = jnp.stack([cxg, cyg], -1)[:, :, None, :]
+    half = wh[None, None] * 0.5
+    anchors = jnp.concatenate([c - half, c + half], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           anchors.shape)
+    return anchors, var
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0):
+    """Decode one YOLOv3 head (ref yolo_box_op.h).
+
+    x: [N, na*(5+classes), H, W]; img_size: [N, 2] (h, w).
+    Returns (boxes [N, na*H*W, 4] xyxy in image pixels,
+             scores [N, na*H*W, classes]); boxes with conf < thresh are 0.
+    """
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) * alpha + beta + grid_x) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) * alpha + beta + grid_y) / h
+    input_w = downsample_ratio * w
+    input_h = downsample_ratio * h
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]  # [n,na,C,h,w]
+    keep = conf >= conf_thresh
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw * 0.5) * img_w
+    y1 = (by - bh * 0.5) * img_h
+    x2 = (bx + bw * 0.5) * img_w
+    y2 = (by + bh * 0.5) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+    boxes = jnp.where(keep[..., None], boxes, 0.0)     # [n,na,h,w,4]
+    probs = jnp.where(keep[:, :, None], probs, 0.0)    # [n,na,C,h,w]
+    boxes = boxes.reshape(n, na * h * w, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, na * h * w,
+                                                    class_num)
+    return boxes, scores
+
+
+def nms(boxes, scores, iou_threshold=0.5, score_threshold=-jnp.inf,
+        max_out=None):
+    """Single-class NMS, fixed-size (jittable): returns
+    (indices [max_out] int32, valid [max_out] bool). Greedy suppression
+    via fori_loop over score-sorted candidates."""
+    n = boxes.shape[0]
+    max_out = n if max_out is None else int(max_out)
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    s = scores[order]
+    iou = iou_similarity(b, b)
+    alive0 = s > score_threshold
+
+    def body(i, alive):
+        # if candidate i is alive, kill every lower-scored box with
+        # IoU > threshold
+        kill = (iou[i] > iou_threshold) & (jnp.arange(n) > i) & alive[i]
+        return alive & ~kill
+
+    alive = jax.lax.fori_loop(0, n, body, alive0)
+    rank = jnp.cumsum(alive) - 1
+    slot = jnp.where(alive, rank, max_out)
+    idx_out = jnp.full((max_out,), -1, jnp.int32)
+    idx_out = idx_out.at[jnp.clip(slot, 0, max_out)].set(
+        order.astype(jnp.int32), mode="drop")
+    valid = jnp.arange(max_out) < alive.sum()
+    return idx_out, valid
+
+
+def multiclass_nms(boxes, scores, score_threshold=0.05, nms_top_k=64,
+                   keep_top_k=100, iou_threshold=0.5, background_label=-1):
+    """Per-class NMS + global keep_top_k (ref multiclass_nms_op.cc), one
+    image. boxes [N,4], scores [C,N]. Returns fixed-size
+    (out [keep_top_k, 6] rows = (class, score, x1, y1, x2, y2), count);
+    empty slots hold -1 class."""
+    num_classes, n = scores.shape
+    nms_top_k = min(int(nms_top_k), n)
+
+    def per_class(c, cls_scores):
+        top_s, top_i = jax.lax.top_k(cls_scores, nms_top_k)
+        idx, valid = nms(boxes[top_i], top_s, iou_threshold,
+                         score_threshold, max_out=nms_top_k)
+        sel = jnp.where(idx >= 0, top_i[jnp.clip(idx, 0)], 0)
+        return (jnp.full((nms_top_k,), c, jnp.float32),
+                jnp.where(valid, top_s[jnp.clip(idx, 0)], -1.0),
+                boxes[sel], valid)
+
+    cls_ids = jnp.arange(num_classes)
+    cls_out = jax.vmap(per_class)(cls_ids, scores)
+    cls_f, sc, bx, valid = (v.reshape(-1, *v.shape[2:]) for v in cls_out)
+    if background_label >= 0:
+        valid = valid & (cls_f != background_label)
+    sc = jnp.where(valid, sc, -jnp.inf)
+    k = min(int(keep_top_k), sc.shape[0])
+    top_s, top_i = jax.lax.top_k(sc, k)
+    count = (top_s > -jnp.inf).sum()
+    ok = top_s > -jnp.inf
+    out = jnp.concatenate([
+        jnp.where(ok, cls_f[top_i], -1.0)[:, None],
+        jnp.where(ok, top_s, 0.0)[:, None],
+        jnp.where(ok[:, None], bx[top_i], 0.0)], axis=1)
+    return out, count.astype(jnp.int32)
+
+
+def roi_align(x, rois, output_size, spatial_scale=1.0, sampling_ratio=-1,
+              aligned=False):
+    """ROIAlign (ref roi_align_op.h): x [C,H,W] single image,
+    rois [R,4] in input-image coords → [R, C, oh, ow].
+
+    Deviation from the reference: with sampling_ratio<=0 the reference
+    picks ceil(roi_size/output_size) samples per bin PER ROI — a dynamic
+    count XLA cannot express with static shapes — so here it defaults to
+    a fixed 2x2 grid (the detectron standard). Pass sampling_ratio
+    explicitly for exact parity on known ROI scales."""
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+    c, hh, ww = x.shape
+    off = 0.5 if aligned else 0.0
+    ratio = 2 if sampling_ratio <= 0 else int(sampling_ratio)
+
+    def one_roi(roi):
+        x1 = roi[0] * spatial_scale - off
+        y1 = roi[1] * spatial_scale - off
+        x2 = roi[2] * spatial_scale - off
+        y2 = roi[3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_w, bin_h = rw / ow, rh / oh
+        # ratio x ratio bilinear samples per bin, averaged
+        sy = (jnp.arange(oh)[:, None] * bin_h + y1 +
+              (jnp.arange(ratio)[None, :] + 0.5) * bin_h / ratio)
+        sx = (jnp.arange(ow)[:, None] * bin_w + x1 +
+              (jnp.arange(ratio)[None, :] + 0.5) * bin_w / ratio)
+
+        def bilinear(yy, xx):
+            # ref semantics: samples beyond [-1, H]/[-1, W] contribute 0;
+            # samples in [-1, 0) clamp to the border (roi_align_op.h)
+            outside = (yy < -1.0) | (yy > hh) | (xx < -1.0) | (xx > ww)
+            yy = jnp.clip(yy, 0.0, hh - 1)
+            xx = jnp.clip(xx, 0.0, ww - 1)
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            y1i = jnp.clip(y0 + 1, 0, hh - 1)
+            x1i = jnp.clip(x0 + 1, 0, ww - 1)
+            ly = yy - y0
+            lx = xx - x0
+            y0, x0, y1i, x1i = (v.astype(jnp.int32)
+                                for v in (y0, x0, y1i, x1i))
+            v00 = x[:, y0, x0]
+            v01 = x[:, y0, x1i]
+            v10 = x[:, y1i, x0]
+            v11 = x[:, y1i, x1i]
+            val = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+                   v10 * ly * (1 - lx) + v11 * ly * lx)
+            return jnp.where(outside, 0.0, val)
+
+        yy = sy.reshape(-1)  # [oh*ratio]
+        xx = sx.reshape(-1)  # [ow*ratio]
+        yg = jnp.repeat(yy, xx.shape[0])
+        xg = jnp.tile(xx, yy.shape[0])
+        vals = bilinear(yg, xg).reshape(c, oh, ratio, ow, ratio)
+        return vals.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+def roi_pool(x, rois, output_size, spatial_scale=1.0):
+    """ROI max-pool (ref roi_pool_op.h): x [C,H,W], rois [R,4] →
+    [R, C, oh, ow]."""
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+    c, hh, ww = x.shape
+    ygrid = jnp.arange(hh, dtype=jnp.float32)
+    xgrid = jnp.arange(ww, dtype=jnp.float32)
+
+    def one_roi(roi):
+        x1 = jnp.round(roi[0] * spatial_scale)
+        y1 = jnp.round(roi[1] * spatial_scale)
+        x2 = jnp.round(roi[2] * spatial_scale)
+        y2 = jnp.round(roi[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bh, bw = rh / oh, rw / ow
+        ys = jnp.floor(jnp.arange(oh) * bh + y1)
+        ye = jnp.ceil((jnp.arange(oh) + 1) * bh + y1)
+        xs = jnp.floor(jnp.arange(ow) * bw + x1)
+        xe = jnp.ceil((jnp.arange(ow) + 1) * bw + x1)
+        in_y = (ygrid[None, :] >= ys[:, None]) & (ygrid[None, :] <
+                                                  ye[:, None])
+        in_x = (xgrid[None, :] >= xs[:, None]) & (xgrid[None, :] <
+                                                  xe[:, None])
+        m = in_y[:, None, :, None] & in_x[None, :, None, :]  # [oh,ow,H,W]
+        masked = jnp.where(m[None], x[:, None, None], -jnp.inf)
+        out = masked.max(axis=(3, 4))
+        return jnp.where(jnp.isfinite(out), out, 0.0)  # empty bins → 0
+
+    return jax.vmap(one_roi)(rois)
+
+
+def bipartite_match(dist):
+    """Greedy bipartite matching (ref bipartite_match_op.cc with
+    match_type='bipartite'): dist [N, M] similarity. Returns
+    (match_indices [M] int32 row matched to each column, -1 if none,
+    match_dist [M])."""
+    n, m = dist.shape
+    steps = min(n, m)
+
+    def body(_, carry):
+        d, idx, val = carry
+        flat = jnp.argmax(d)
+        i, j = flat // m, flat % m
+        best = d[i, j]
+        found = best > -jnp.inf
+        idx = jnp.where(found, idx.at[j].set(i.astype(jnp.int32)), idx)
+        val = jnp.where(found, val.at[j].set(best), val)
+        d = jnp.where(found, d.at[i, :].set(-jnp.inf), d)
+        d = jnp.where(found, d.at[:, j].set(-jnp.inf), d)
+        return d, idx, val
+
+    idx0 = jnp.full((m,), -1, jnp.int32)
+    val0 = jnp.zeros((m,), dist.dtype)
+    _, idx, val = jax.lax.fori_loop(
+        0, steps, body, (dist.astype(jnp.float32), idx0, val0))
+    return idx, val

@@ -1,0 +1,231 @@
+"""Detection ops vs NumPy references (operators/detection/ parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import detection as det
+
+
+def _np_iou(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area = lambda x: np.clip(x[:, 2] - x[:, 0], 0, None) * \
+        np.clip(x[:, 3] - x[:, 1], 0, None)
+    return inter / np.maximum(area(a)[:, None] + area(b)[None] - inter,
+                              1e-10)
+
+
+@pytest.fixture
+def boxes(rng):
+    xy = rng.uniform(0, 80, size=(12, 2))
+    wh = rng.uniform(4, 20, size=(12, 2))
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+def test_iou_similarity(boxes, rng):
+    other = boxes[rng.permutation(12)[:5]] + 3.0
+    out = np.asarray(det.iou_similarity(boxes, other))
+    np.testing.assert_allclose(out, _np_iou(boxes, other), rtol=1e-5)
+
+
+def test_box_clip(boxes):
+    out = np.asarray(det.box_clip(boxes * 2.0, (64, 48)))
+    assert out[:, [0, 2]].max() <= 47 and out[:, [1, 3]].max() <= 63
+    assert out.min() >= 0
+
+
+def test_box_coder_roundtrip(boxes):
+    priors = boxes
+    targets = boxes + 2.5
+    var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    enc = np.asarray(det.box_coder(priors, var, targets, "encode"))
+    # decode the diagonal (each target against its own prior)
+    deltas = enc[np.arange(12), np.arange(12)]
+    dec = np.asarray(det.box_coder(priors, var, deltas, "decode"))
+    np.testing.assert_allclose(dec, targets, rtol=1e-4, atol=1e-3)
+
+
+def test_prior_box_shapes_and_range():
+    b, v = det.prior_box(4, 6, 128, 192, min_sizes=[32.0],
+                         max_sizes=[64.0], aspect_ratios=(2.0,),
+                         clip=True)
+    b, v = np.asarray(b), np.asarray(v)
+    # priors: ar 1, 2, 1/2 for min_size + 1 for sqrt(min*max)
+    assert b.shape == (4, 6, 4, 4) and v.shape == b.shape
+    assert 0 <= b.min() and b.max() <= 1.0
+    # first prior is the square min_size box centred in cell (0,0)
+    cx, cy = 0.5 * (192 / 6) / 192, 0.5 * (128 / 4) / 128
+    np.testing.assert_allclose(
+        b[0, 0, 0], [cx - 16 / 192, cy - 16 / 128,
+                     cx + 16 / 192, cy + 16 / 128], atol=1e-6)
+
+
+def test_anchor_generator():
+    a, v = det.anchor_generator(3, 3, anchor_sizes=[64.0],
+                                aspect_ratios=[0.5, 1.0, 2.0],
+                                stride=[16.0, 16.0])
+    a = np.asarray(a)
+    assert a.shape == (3, 3, 3, 4)
+    w = a[..., 2] - a[..., 0]
+    h = a[..., 3] - a[..., 1]
+    np.testing.assert_allclose((h / w)[0, 0], [0.5, 1.0, 2.0], rtol=1e-5)
+    np.testing.assert_allclose(np.sqrt(w * h)[0, 0], 64.0, rtol=1e-5)
+
+
+def test_nms_suppresses_overlaps():
+    b = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60],
+                  [0, 0, 9, 9]], np.float32)
+    s = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+    idx, valid = det.nms(jnp.asarray(b), jnp.asarray(s),
+                         iou_threshold=0.5, max_out=4)
+    kept = np.asarray(idx)[np.asarray(valid)]
+    np.testing.assert_array_equal(kept, [0, 2])
+
+
+def test_nms_jit_fixed_size():
+    f = jax.jit(lambda b, s: det.nms(b, s, 0.5, max_out=3))
+    b = np.array([[0, 0, 10, 10], [20, 0, 30, 10], [40, 0, 50, 10],
+                  [60, 0, 70, 10]], np.float32)
+    s = np.array([0.5, 0.6, 0.7, 0.8], np.float32)
+    idx, valid = f(jnp.asarray(b), jnp.asarray(s))
+    assert idx.shape == (3,) and bool(valid.all())
+    np.testing.assert_array_equal(np.asarray(idx), [3, 2, 1])
+
+
+def test_multiclass_nms():
+    b = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                 np.float32)
+    scores = np.array([[0.9, 0.85, 0.1],    # class 0
+                       [0.2, 0.3, 0.95]], np.float32)  # class 1
+    out, count = det.multiclass_nms(jnp.asarray(b), jnp.asarray(scores),
+                                    score_threshold=0.5, keep_top_k=5,
+                                    iou_threshold=0.5)
+    out = np.asarray(out)
+    assert int(count) == 2
+    # best: class 1 on box 2 (0.95), then class 0 on box 0 (0.9);
+    # box 1 suppressed by box 0 within class 0
+    assert out[0, 0] == 1.0 and abs(out[0, 1] - 0.95) < 1e-6
+    assert out[1, 0] == 0.0 and abs(out[1, 1] - 0.9) < 1e-6
+    np.testing.assert_allclose(out[1, 2:], b[0])
+    assert (out[2:, 0] == -1).all()
+
+
+def test_yolo_box_center_decode():
+    # one anchor, one class, 1x1 grid: zero logits put the box centre
+    # mid-cell with anchor-sized extent
+    x = np.zeros((1, 6, 1, 1), np.float32)
+    x[0, 4] = 10.0  # conf sigmoid ~1
+    img = np.array([[64, 64]], np.int32)
+    boxes, scores = det.yolo_box(jnp.asarray(x), jnp.asarray(img),
+                                 anchors=[16, 16], class_num=1,
+                                 conf_thresh=0.5, downsample_ratio=32)
+    bx = np.asarray(boxes)[0, 0]
+    assert boxes.shape == (1, 1, 4) and scores.shape == (1, 1, 1)
+    np.testing.assert_allclose(bx, [16, 16, 48, 48], atol=1e-3)
+
+
+def test_yolo_box_conf_threshold_zeroes():
+    x = np.zeros((1, 6, 1, 1), np.float32)
+    x[0, 4] = -10.0
+    img = np.array([[64, 64]], np.int32)
+    boxes, scores = det.yolo_box(jnp.asarray(x), jnp.asarray(img),
+                                 anchors=[16, 16], class_num=1,
+                                 conf_thresh=0.5, downsample_ratio=32)
+    assert np.asarray(boxes).sum() == 0 and np.asarray(scores).sum() == 0
+
+
+def test_yolo_box_multiclass_grid():
+    # 2 anchors, 3 classes, 2x4 grid — exercises the full reshape path
+    rng = np.random.default_rng(1)
+    na, nc, h, w = 2, 3, 2, 4
+    x = rng.normal(size=(1, na * (5 + nc), h, w)).astype(np.float32)
+    img = np.array([[128, 256]], np.int32)
+    boxes, scores = det.yolo_box(jnp.asarray(x), jnp.asarray(img),
+                                 anchors=[10, 14, 23, 27], class_num=nc,
+                                 conf_thresh=0.0, downsample_ratio=32,
+                                 clip_bbox=False)
+    assert boxes.shape == (1, na * h * w, 4)
+    assert scores.shape == (1, na * h * w, nc)
+    # spot-check anchor 1, cell (1, 2) against a scalar reference
+    xa = x[0].reshape(na, 5 + nc, h, w)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    bx = (sig(xa[1, 0, 1, 2]) + 2) / w * 256
+    bw = np.exp(xa[1, 2, 1, 2]) * 23 / (32 * w) * 256
+    flat = 1 * h * w + 1 * w + 2
+    np.testing.assert_allclose(np.asarray(boxes)[0, flat, 0],
+                               bx - bw / 2, rtol=1e-4)
+    ref_score = sig(xa[1, 4, 1, 2]) * sig(xa[1, 5 + 2, 1, 2])
+    np.testing.assert_allclose(np.asarray(scores)[0, flat, 2],
+                               ref_score, rtol=1e-4)
+
+
+def test_roi_pool_empty_bins_zero():
+    # roi wider than the feature map: right-hand bins match no pixels
+    x = np.ones((1, 8, 8), np.float32)
+    rois = np.array([[0, 0, 15, 7]], np.float32)
+    out = np.asarray(det.roi_pool(jnp.asarray(x), jnp.asarray(rois),
+                                  output_size=2))
+    assert out[0, 0, :, 0].min() == 1.0
+    assert (out[0, 0, :, 1] == 0.0).all(), "empty bins must be 0"
+
+
+def test_roi_align_outside_samples_zero():
+    # roi hanging past the image: samples beyond W contribute 0
+    x = np.ones((1, 4, 4), np.float32) * 2.0
+    rois = np.array([[2.0, 0.0, 9.0, 4.0]], np.float32)
+    out = np.asarray(det.roi_align(jnp.asarray(x), jnp.asarray(rois),
+                                   output_size=(1, 2), aligned=True))
+    # left bin: samples at x=2.375 (inside, 2.0) and x=4.125 (>W, 0)
+    # -> mean 1.0; right bin fully beyond W -> 0
+    assert abs(out[0, 0, 0, 0] - 1.0) < 1e-5
+    assert out[0, 0, 0, 1] == 0.0
+
+
+def test_roi_align_identity():
+    # roi covering exactly one pixel returns that pixel's value
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 4)
+    rois = np.array([[1.0, 1.0, 2.0, 2.0]], np.float32)
+    out = np.asarray(det.roi_align(jnp.asarray(x), jnp.asarray(rois),
+                                   output_size=1, aligned=True))
+    assert out.shape == (1, 1, 1, 1)
+    np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 1, 1], atol=1e-4)
+
+
+def test_roi_align_average():
+    x = np.ones((2, 8, 8), np.float32) * 3.0
+    rois = np.array([[0, 0, 4, 4], [2, 2, 6, 6]], np.float32)
+    out = np.asarray(det.roi_align(jnp.asarray(x), jnp.asarray(rois),
+                                   output_size=(2, 2)))
+    assert out.shape == (2, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.0, rtol=1e-5)
+
+
+def test_roi_pool_max():
+    x = np.zeros((1, 8, 8), np.float32)
+    x[0, 2, 3] = 5.0
+    rois = np.array([[0, 0, 7, 7]], np.float32)
+    out = np.asarray(det.roi_pool(jnp.asarray(x), jnp.asarray(rois),
+                                  output_size=2))
+    assert out.shape == (1, 1, 2, 2)
+    assert out[0, 0, 0, 0] == 5.0  # top-left quadrant holds the max
+    assert out[0, 0, 1, 1] == 0.0
+
+
+def test_bipartite_match():
+    dist = np.array([[0.9, 0.1, 0.3],
+                     [0.8, 0.7, 0.2]], np.float32)
+    idx, val = det.bipartite_match(jnp.asarray(dist))
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7; column 2 unmatched
+    np.testing.assert_array_equal(np.asarray(idx), [0, 1, -1])
+    np.testing.assert_allclose(np.asarray(val), [0.9, 0.7, 0.0])
+
+
+def test_detection_ops_grad_roi_align():
+    x = jnp.ones((1, 4, 4))
+    rois = jnp.asarray(np.array([[0, 0, 3, 3]], np.float32))
+    g = jax.grad(lambda a: det.roi_align(a, rois, 2).sum())(x)
+    assert np.isfinite(np.asarray(g)).all() and float(g.sum()) > 0
