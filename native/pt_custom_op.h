@@ -1,0 +1,91 @@
+/* pt_custom_op.h — stable C ABI for out-of-tree custom ops.
+ *
+ * Reference parity: paddle/fluid/extension/ext_op_meta_info.h
+ * (PD_BUILD_OP:502) + python/paddle/utils/cpp_extension. The reference
+ * adapts user kernels into its C++ op registry; here the framework's
+ * compute path is XLA, so custom C kernels run as HOST callbacks
+ * (jax.pure_callback) on buffers the framework allocates. The contract:
+ *
+ *   - forward:  int ptop_<name>_forward(const PTOpTensor* ins, int n_in,
+ *                                       PTOpTensor* outs, int n_out);
+ *     Input buffers are read-only; output buffers are pre-allocated to
+ *     the shapes the op's infer function (or Python shape_fn) declared.
+ *     Return 0 on success, nonzero on error.
+ *
+ *   - infer (optional): int ptop_<name>_infer(
+ *         const int64_t* in_dims, const int32_t* in_ndims,
+ *         const int32_t* in_dtypes, int n_in,
+ *         int64_t* out_dims, int32_t* out_ndims, int32_t* out_dtypes,
+ *         int n_out);
+ *     in_dims is the concatenation of every input's dims. out_dims has
+ *     room for PTOP_MAX_RANK entries per output. If absent, the Python
+ *     loader requires a shape_fn.
+ *
+ *   - backward (optional): same signature as forward, with
+ *     ins = [fwd inputs..., fwd outputs..., output grads...] and
+ *     outs = [input grads...] — the reference's grad-op convention
+ *     (ext_op_meta_info.h grad kernel Input(X/Out/GradOut)->GradX).
+ */
+
+#ifndef PT_CUSTOM_OP_H_
+#define PT_CUSTOM_OP_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PTOP_MAX_RANK 8
+
+/* dtype codes shared with the Python loader */
+enum PTOpDtype {
+  PTOP_F32 = 0,
+  PTOP_F64 = 1,
+  PTOP_I32 = 2,
+  PTOP_I64 = 3,
+  PTOP_U8 = 4,
+  PTOP_BOOL = 5,
+};
+
+typedef struct {
+  void* data;          /* contiguous row-major buffer */
+  int64_t dims[PTOP_MAX_RANK];
+  int32_t ndim;
+  int32_t dtype;       /* PTOpDtype */
+} PTOpTensor;
+
+static inline int64_t ptop_numel(const PTOpTensor* t) {
+  int64_t n = 1;
+  for (int32_t i = 0; i < t->ndim; ++i) n *= t->dims[i];
+  return n;
+}
+
+/* Convenience: define the exported symbols for op <name>; unmangled in
+ * C++ so the ctypes loader finds them by name. */
+#ifdef __cplusplus
+#define PTOP_EXPORT extern "C"
+#else
+#define PTOP_EXPORT
+#endif
+
+#define PT_BUILD_OP(name)                                            \
+  PTOP_EXPORT int ptop_##name##_forward(                             \
+      const PTOpTensor* ins, int n_in, PTOpTensor* outs, int n_out)
+
+#define PT_BUILD_GRAD_OP(name)                                       \
+  PTOP_EXPORT int ptop_##name##_backward(                            \
+      const PTOpTensor* ins, int n_in, PTOpTensor* outs, int n_out)
+
+#define PT_BUILD_INFER(name)                                         \
+  PTOP_EXPORT int ptop_##name##_infer(                               \
+      const int64_t* in_dims, const int32_t* in_ndims,               \
+      const int32_t* in_dtypes, int n_in,                            \
+      int64_t* out_dims, int32_t* out_ndims,                         \
+      int32_t* out_dtypes, int n_out)
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* PT_CUSTOM_OP_H_ */

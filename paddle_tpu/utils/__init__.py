@@ -1,0 +1,3 @@
+"""Utility subpackage (reference: python/paddle/utils/)."""
+
+from . import cpp_extension
