@@ -55,16 +55,35 @@ def _from_saveable(obj, return_numpy=False):
     return obj
 
 
-def save(obj: Any, path: str, protocol: int = 4) -> None:
-    """paddle.save equivalent: pickle state_dict-like nests."""
+def save(obj: Any, path: str, protocol: int = 4,
+         cipher_key: bytes = None) -> None:
+    """paddle.save equivalent: pickle state_dict-like nests. With
+    ``cipher_key``, the artifact is AES-128-CTR encrypted (reference:
+    encrypted model save via io/crypto CipherFactory)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
+    if cipher_key is None:  # stream — no full-blob copy in host RAM
+        with open(path, "wb") as f:
+            pickle.dump(_to_saveable(obj), f, protocol=protocol)
+        return
+    from .crypto import AESCipher
+    blob = AESCipher(cipher_key).encrypt(
+        pickle.dumps(_to_saveable(obj), protocol=protocol))
     with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+        f.write(blob)
 
 
-def load(path: str, return_numpy: bool = False, **kwargs) -> Any:
+def load(path: str, return_numpy: bool = False, cipher_key: bytes = None,
+         **kwargs) -> Any:
+    from .crypto import _MAGIC
     with open(path, "rb") as f:
-        raw = pickle.load(f)
+        blob = f.read()
+    if cipher_key is not None:
+        from .crypto import AESCipher
+        blob = AESCipher(cipher_key).decrypt(blob)
+    elif blob[:len(_MAGIC)] == _MAGIC:
+        raise ValueError(
+            f"{path!r} is an encrypted artifact; pass cipher_key=")
+    raw = pickle.loads(blob)
     return _from_saveable(raw, return_numpy)

@@ -12,7 +12,6 @@ import ctypes
 import os
 import subprocess
 import threading
-import zlib
 from typing import Optional
 
 import numpy as np
@@ -75,6 +74,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float)]
+        lib.pt_aes128_ctr.restype = ctypes.c_int
+        lib.pt_aes128_ctr.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64]
         _lib = lib
         return _lib
 
@@ -143,10 +147,31 @@ class ShmQueue:
             pass
 
 
+_CRC32C_TABLE = None
+
+
+def _crc32c_py(data: bytes, seed: int) -> int:
+    # Same Castagnoli polynomial as pt_crc32c — checksums must be
+    # machine-portable (they're embedded in encrypted artifacts)
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ (0x82F63B78 if c & 1 else 0)
+            table.append(c)
+        _CRC32C_TABLE = table
+    c = seed ^ 0xFFFFFFFF
+    for b in data:
+        c = _CRC32C_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
 def crc32c(data: bytes, seed: int = 0) -> int:
     lib = get_lib()
-    if lib is None:  # fall back to zlib crc32 (different poly, still a
-        return zlib.crc32(data, seed) & 0xFFFFFFFF  # valid checksum)
+    if lib is None:
+        return _crc32c_py(data, seed)
     arr = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
     return int(lib.pt_crc32c(arr, len(data), seed))
 
