@@ -31,6 +31,7 @@ PULL_DENSE = "pull_dense"
 PUSH_DENSE = "push_dense"
 PULL_SPARSE = "pull_sparse"
 PUSH_SPARSE = "push_sparse"
+PUSH_SPARSE_DELTA = "push_sparse_delta"  # geo-SGD delta apply
 BARRIER = "barrier"
 STOP = "stop"
 STAT = "stat"
@@ -139,6 +140,108 @@ class SparseTable:
         with self._lock:
             return len(self.rows)
 
+    def push_delta(self, keys: Sequence[int], deltas: np.ndarray) -> None:
+        """Geo-SGD apply: value += delta (ref table/sparse_geo_table.cc —
+        trainers train local replicas and ship parameter deltas, not
+        gradients)."""
+        with self._lock:
+            for k, d in zip(keys, np.asarray(deltas, np.float32)):
+                self._row(int(k))
+                self.rows[int(k)] += d
+
+
+class SSDSparseTable:
+    """Disk-backed sparse table: sqlite3 store + write-through LRU cache
+    (ref table/ssd_sparse_table.cc over RocksDB — embeddings larger than
+    host RAM). Same pull/push_grad/push_delta surface as SparseTable;
+    rows persist value||accum so adagrad state survives eviction."""
+
+    def __init__(self, emb_dim: int, lr: float = 0.01,
+                 initializer_std: float = 0.01, optimizer: str = "adagrad",
+                 path: str = ":memory:", cache_rows: int = 100_000):
+        import sqlite3
+        self.emb_dim = emb_dim
+        self.lr = lr
+        self.std = initializer_std
+        self.optimizer = optimizer
+        self.cache_rows = cache_rows
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS rows (k INTEGER PRIMARY KEY, "
+            "v BLOB)")
+        self._cache: Dict[int, np.ndarray] = {}  # insertion-ordered LRU
+        self._dirty: set = set()
+        self._lock = threading.Lock()
+        self._rng = np.random.default_rng(0)
+
+    def _load(self, key: int) -> np.ndarray:
+        """Return [2, emb_dim] (value row, adagrad accum row)."""
+        row = self._cache.pop(key, None)
+        if row is None:
+            cur = self._db.execute("SELECT v FROM rows WHERE k=?", (key,))
+            hit = cur.fetchone()
+            if hit is not None:
+                row = np.frombuffer(hit[0], np.float32).reshape(
+                    2, self.emb_dim).copy()
+            else:
+                row = np.stack([
+                    (self._rng.standard_normal(self.emb_dim) *
+                     self.std).astype(np.float32),
+                    np.zeros(self.emb_dim, np.float32)])
+                self._dirty.add(key)
+        self._cache[key] = row  # re-insert = most recently used
+        self._evict()
+        return row
+
+    def _evict(self) -> None:
+        while len(self._cache) > self.cache_rows:
+            k, row = next(iter(self._cache.items()))
+            del self._cache[k]
+            if k in self._dirty:
+                self._write(k, row)
+                self._dirty.discard(k)
+
+    def _write(self, key: int, row: np.ndarray) -> None:
+        self._db.execute(
+            "INSERT OR REPLACE INTO rows (k, v) VALUES (?, ?)",
+            (key, row.tobytes()))
+
+    def flush(self) -> None:
+        with self._lock:
+            for k in list(self._dirty):
+                self._write(k, self._cache[k])
+            self._dirty.clear()
+            self._db.commit()
+
+    def pull(self, keys: Sequence[int]) -> np.ndarray:
+        with self._lock:
+            return np.stack([self._load(int(k))[0] for k in keys])
+
+    def push_grad(self, keys: Sequence[int], grads: np.ndarray) -> None:
+        with self._lock:
+            for k, g in zip(keys, np.asarray(grads, np.float32)):
+                k = int(k)
+                row = self._load(k)
+                if self.optimizer == "adagrad":
+                    row[1] += g * g
+                    row[0] -= self.lr * g / (np.sqrt(row[1]) + 1e-6)
+                else:
+                    row[0] -= self.lr * g
+                self._dirty.add(k)
+
+    def push_delta(self, keys: Sequence[int], deltas: np.ndarray) -> None:
+        with self._lock:
+            for k, d in zip(keys, np.asarray(deltas, np.float32)):
+                k = int(k)
+                self._load(k)[0] += d
+                self._dirty.add(k)
+
+    def size(self) -> int:
+        self.flush()
+        with self._lock:
+            return self._db.execute(
+                "SELECT COUNT(*) FROM rows").fetchone()[0]
+
 
 class PSServer:
     """reference: service/brpc_ps_server.cc — hosts tables, serves
@@ -176,8 +279,12 @@ class PSServer:
         self.dense[name] = t
         return t
 
-    def add_sparse_table(self, name: str, emb_dim: int, **kw) -> SparseTable:
-        t = SparseTable(emb_dim, **kw)
+    def add_sparse_table(self, name: str, emb_dim: int,
+                         kind: str = "mem", **kw):
+        """kind: 'mem' (common_sparse_table) or 'ssd'
+        (ssd_sparse_table, disk-backed)."""
+        t = (SSDSparseTable(emb_dim, **kw) if kind == "ssd"
+             else SparseTable(emb_dim, **kw))
         self.sparse[name] = t
         return t
 
@@ -200,6 +307,10 @@ class PSServer:
             if cmd == PUSH_SPARSE:
                 self.sparse[msg["table"]].push_grad(msg["keys"],
                                                     msg["grad"])
+                return {"ok": True}
+            if cmd == PUSH_SPARSE_DELTA:
+                self.sparse[msg["table"]].push_delta(msg["keys"],
+                                                     msg["delta"])
                 return {"ok": True}
             if cmd == STAT:
                 return {"ok": True,
@@ -301,6 +412,19 @@ class PSClient:
                              "keys": keys[mask].tolist(),
                              "grad": grads[mask]})
 
+    def push_sparse_delta(self, table: str, keys: np.ndarray,
+                          deltas: np.ndarray) -> None:
+        keys = np.asarray(keys, np.int64).ravel()
+        deltas = np.asarray(deltas, np.float32)
+        n = len(self.endpoints)
+        for srv in range(n):
+            mask = (keys % n) == srv
+            if not mask.any():
+                continue
+            self._call(srv, {"cmd": PUSH_SPARSE_DELTA, "table": table,
+                             "keys": keys[mask].tolist(),
+                             "delta": deltas[mask]})
+
     def barrier(self) -> None:
         for srv in range(len(self.endpoints)):
             self._call(srv, {"cmd": BARRIER})
@@ -313,6 +437,65 @@ class PSClient:
                 pass
         for s in self._socks:
             s.close()
+
+
+class GeoCommunicator:
+    """Geo-SGD for sparse tables (reference: GeoCommunicator in
+    service/communicator.cc + sparse_geo_table.cc; strategy
+    a_sync_configs k_steps / geo mode). Each trainer trains a LOCAL
+    replica of touched embedding rows; every ``k_steps`` it ships the
+    accumulated parameter DELTAS (not grads) to the PS and refreshes its
+    replica — communication cost scales with touched rows, not steps."""
+
+    def __init__(self, client: PSClient, table: str, emb_dim: int,
+                 k_steps: int = 10, lr: float = 0.01):
+        self.client = client
+        self.table = table
+        self.emb_dim = emb_dim
+        self.k_steps = max(1, int(k_steps))
+        self.lr = lr
+        self.local: Dict[int, np.ndarray] = {}
+        self.base: Dict[int, np.ndarray] = {}
+        self._touched: set = set()
+        self._t = 0
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        """Fetch rows, serving locally-trained replicas when present."""
+        keys = np.asarray(keys, np.int64).ravel()
+        missing = [int(k) for k in keys if int(k) not in self.local]
+        if missing:
+            rows = self.client.pull_sparse(self.table,
+                                           np.asarray(missing, np.int64))
+            for k, r in zip(missing, rows):
+                self.local[k] = r.copy()
+                self.base[k] = r.copy()
+        return np.stack([self.local[int(k)] for k in keys])
+
+    def push_grad(self, keys: np.ndarray, grads: np.ndarray) -> None:
+        """Local SGD on the replica; periodic delta sync."""
+        keys = np.asarray(keys, np.int64).ravel()
+        self.pull(keys)  # one batched fetch of any missing rows
+        for k, g in zip(keys, np.asarray(grads, np.float32)):
+            k = int(k)
+            self.local[k] = self.local[k] - self.lr * g
+            self._touched.add(k)
+        self._t += 1
+        if self._t % self.k_steps == 0:
+            self.sync()
+
+    def sync(self) -> None:
+        if not self._touched:
+            return
+        keys = np.asarray(sorted(self._touched), np.int64)
+        deltas = np.stack([self.local[int(k)] - self.base[int(k)]
+                           for k in keys])
+        self.client.push_sparse_delta(self.table, keys, deltas)
+        # refresh replica with the server's merged view
+        rows = self.client.pull_sparse(self.table, keys)
+        for k, r in zip(keys, rows):
+            self.local[int(k)] = r.copy()
+            self.base[int(k)] = r.copy()
+        self._touched.clear()
 
 
 class AsyncCommunicator:

@@ -7,18 +7,19 @@ models and state dicts. The cipher core lives in native/ptnative.cc
 reference implementation the native kernel is tested against (the same
 ref-vs-optimized pattern as the Pallas kernels).
 
-Envelope format: b"PTENC1" || iv(16) || crc32c(plaintext, 4 LE) || body.
+Envelope format: b"PTENC2" || iv(16) || hmac_sha256(iv || body, 32) ||
+body. The MAC (not a CRC — CTR is bit-malleable and the plaintext feeds
+pickle, so integrity must be unforgeable) uses a key derived from the
+user key separately from the encryption key.
 """
 
 from __future__ import annotations
 
 import hashlib
+import hmac as _hmac
 import os
-import struct
 
-from .. import native
-
-_MAGIC = b"PTENC1"
+_MAGIC = b"PTENC2"
 
 _SBOX = None
 
@@ -112,6 +113,8 @@ def aes128_ctr(key16: bytes, iv16: bytes, data: bytes) -> bytes:
     import ctypes
 
     import numpy as np
+
+    from .. import native
     lib = native.get_lib()
     if lib is None:
         return aes128_ctr_py(key16, iv16, data)
@@ -133,27 +136,31 @@ class AESCipher:
     def __init__(self, key: bytes):
         if not isinstance(key, (bytes, bytearray)):
             raise TypeError("key must be bytes")
-        # accept any length: derive 16 bytes (reference uses keyfiles)
-        self.key = bytes(key) if len(key) == 16 else \
-            hashlib.sha256(bytes(key)).digest()[:16]
+        # derive independent encryption + MAC keys from the user key
+        # (reference uses keyfiles; any key length accepted)
+        self.key = hashlib.sha256(bytes(key) + b"|enc").digest()[:16]
+        self._mac_key = hashlib.sha256(bytes(key) + b"|mac").digest()
+
+    def _mac(self, iv: bytes, body: bytes) -> bytes:
+        return _hmac.new(self._mac_key, iv + body,
+                         hashlib.sha256).digest()
 
     def encrypt(self, plaintext: bytes) -> bytes:
         iv = os.urandom(16)
-        crc = native.crc32c(plaintext)
         body = aes128_ctr(self.key, iv, plaintext)
-        return _MAGIC + iv + struct.pack("<I", crc) + body
+        return _MAGIC + iv + self._mac(iv, body) + body
 
     def decrypt(self, blob: bytes) -> bytes:
         if blob[:len(_MAGIC)] != _MAGIC:
-            raise ValueError("not a PTENC1 encrypted blob")
+            raise ValueError("not a PTENC2 encrypted blob")
         off = len(_MAGIC)
         iv = blob[off:off + 16]
-        crc = struct.unpack("<I", blob[off + 16:off + 20])[0]
-        plain = aes128_ctr(self.key, iv, blob[off + 20:])
-        if native.crc32c(plain) != crc:
+        tag = blob[off + 16:off + 48]
+        body = blob[off + 48:]
+        if not _hmac.compare_digest(tag, self._mac(iv, body)):
             raise ValueError("decryption integrity check failed "
                              "(wrong key or corrupted file)")
-        return plain
+        return aes128_ctr(self.key, iv, body)
 
     def encrypt_to_file(self, plaintext: bytes, path: str) -> None:
         with open(path, "wb") as f:

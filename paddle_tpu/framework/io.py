@@ -78,12 +78,15 @@ def load(path: str, return_numpy: bool = False, cipher_key: bytes = None,
          **kwargs) -> Any:
     from .crypto import _MAGIC
     with open(path, "rb") as f:
-        blob = f.read()
-    if cipher_key is not None:
-        from .crypto import AESCipher
-        blob = AESCipher(cipher_key).decrypt(blob)
-    elif blob[:len(_MAGIC)] == _MAGIC:
-        raise ValueError(
-            f"{path!r} is an encrypted artifact; pass cipher_key=")
-    raw = pickle.loads(blob)
+        head = f.read(len(_MAGIC))
+        if cipher_key is not None:
+            from .crypto import AESCipher
+            raw = pickle.loads(
+                AESCipher(cipher_key).decrypt(head + f.read()))
+        elif head == _MAGIC:
+            raise ValueError(
+                f"{path!r} is an encrypted artifact; pass cipher_key=")
+        else:  # stream — no full-blob copy in host RAM
+            f.seek(0)
+            raw = pickle.load(f)
     return _from_saveable(raw, return_numpy)

@@ -1,0 +1,226 @@
+"""Heavy-IO datasets for PS workloads (InMemory/Queue).
+
+Reference parity: python/paddle/fluid/dataset.py (InMemoryDataset /
+QueueDataset facades) over C++ framework/data_set.cc (Dataset:43,
+LoadIntoMemory:200) and data_feed.cc slot parsing. The reference streams
+slot-formatted text through per-worker channels feeding DownpourWorkers;
+here the same capabilities — parallel file load, local/global shuffle,
+per-worker channel split, streaming queue mode — are host-side (this is
+CPU data plumbing; batches then feed the normal jitted train step or the
+PS trainer loop).
+
+Slot line format (data_feed.proto MultiSlotDataFeed):
+    "<slot>:<v1> <v2> ...;<slot2>:..."  — ints or floats per slot;
+    a custom ``parse_fn(line) -> sample`` can replace it.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import queue as _queue
+import random
+import threading
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+def parse_slot_line(line: str) -> Dict[str, np.ndarray]:
+    """Default slot parser: 'a:1 2;b:0.5' -> {'a': int64[2], 'b': f32[1]}."""
+    out: Dict[str, np.ndarray] = {}
+    for part in line.strip().split(";"):
+        if not part:
+            continue
+        slot, _, vals = part.partition(":")
+        toks = vals.split()
+        if toks and any("." in t or "e" in t or "E" in t for t in toks):
+            out[slot] = np.asarray([float(t) for t in toks], np.float32)
+        else:
+            out[slot] = np.asarray([int(t) for t in toks], np.int64)
+    return out
+
+
+def _sample_key(sample: Any) -> int:
+    """Stable shard key for global shuffle (ref: shuffle-by-line-hash).
+    Must be process-stable (every rank computes the same keys) and
+    well-spread even for low-cardinality slots — so a real hash, never
+    builtin hash() (salted per process) or raw slot values."""
+    if isinstance(sample, dict):
+        h = 0
+        for k in sorted(sample):  # every slot: one binary slot must not
+            h = zlib.crc32(np.asarray(sample[k]).tobytes(),  # collapse
+                           zlib.crc32(k.encode(), h))        # the shards
+        return h & 0x7FFFFFFF
+    return zlib.crc32(repr(sample).encode()) & 0x7FFFFFFF
+
+
+class DatasetBase:
+    """Shared facade config (ref fluid/dataset.py DatasetBase)."""
+
+    def __init__(self):
+        self.filelist: List[str] = []
+        self.parse_fn: Callable[[str], Any] = parse_slot_line
+        self.batch_size = 1
+        self.thread_num = 1
+        self.drop_last = False
+
+    def set_filelist(self, files: Sequence[str]) -> None:
+        out: List[str] = []
+        for f in files:
+            hits = sorted(_glob.glob(f))
+            out.extend(hits if hits else [f])
+        self.filelist = out
+
+    def set_parse_fn(self, fn: Callable[[str], Any]) -> None:
+        self.parse_fn = fn
+
+    def set_batch_size(self, bs: int) -> None:
+        self.batch_size = int(bs)
+
+    def set_thread(self, n: int) -> None:
+        self.thread_num = max(1, int(n))
+
+    def _batches(self, it: Iterator[Any]) -> Iterator[List[Any]]:
+        buf: List[Any] = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield buf
+                buf = []
+        if buf and not self.drop_last:
+            yield buf
+
+
+class InMemoryDataset(DatasetBase):
+    """Load all files into host memory; shuffle; serve per-worker
+    channels (ref InMemoryDataset.load_into_memory/local_shuffle/
+    global_shuffle, data_set.cc:200)."""
+
+    def __init__(self):
+        super().__init__()
+        self.samples: List[Any] = []
+        self._seed = 0
+
+    # ------------------------------------------------------------ load
+    def load_into_memory(self) -> None:
+        if not self.filelist:
+            raise ValueError("set_filelist first")
+
+        def load_one(path: str) -> List[Any]:
+            rows = []
+            with open(path, "r") as f:
+                for line in f:
+                    if line.strip():
+                        rows.append(self.parse_fn(line))
+            return rows
+
+        # executor propagates parse/IO errors to the caller — a bad line
+        # must fail loudly, not silently truncate the dataset
+        with ThreadPoolExecutor(max_workers=self.thread_num) as ex:
+            results = list(ex.map(load_one, self.filelist))
+        self.samples = [s for rows in results for s in rows]
+
+    def release_memory(self) -> None:
+        self.samples = []
+
+    def get_memory_data_size(self) -> int:
+        return len(self.samples)
+
+    # --------------------------------------------------------- shuffle
+    def set_shuffle_seed(self, seed: int) -> None:
+        self._seed = int(seed)
+
+    def local_shuffle(self) -> None:
+        rng = random.Random(self._seed)
+        rng.shuffle(self.samples)
+
+    def global_shuffle(self, rank: int = 0, world_size: int = 1) -> None:
+        """Deterministic hash repartition + local shuffle: every rank
+        loads the SAME filelist and keeps the rows hashing to it — the
+        coordination-free equivalent of the reference's shuffle through
+        fleet (data_set.cc GlobalShuffle)."""
+        if world_size > 1:
+            self.samples = [s for s in self.samples
+                            if _sample_key(s) % world_size == rank]
+        self.local_shuffle()
+
+    # ----------------------------------------------------------- serve
+    def channels(self, n: Optional[int] = None) -> List[List[Any]]:
+        """Split loaded samples into n worker channels (ref: per-thread
+        channels feeding DeviceWorkers)."""
+        n = n or self.thread_num
+        return [self.samples[i::n] for i in range(n)]
+
+    def __iter__(self) -> Iterator[List[Any]]:
+        return self._batches(iter(self.samples))
+
+
+class QueueDataset(DatasetBase):
+    """Streaming mode: reader threads parse files into a bounded queue;
+    the consumer iterates batches without materializing the dataset
+    (ref QueueDataset / MultiSlotDataFeed channel pipeline)."""
+
+    def __init__(self, capacity: int = 1024):
+        super().__init__()
+        self.capacity = int(capacity)
+
+    def __iter__(self) -> Iterator[List[Any]]:
+        if not self.filelist:
+            raise ValueError("set_filelist first")
+        q: _queue.Queue = _queue.Queue(maxsize=self.capacity)
+        n_readers = min(self.thread_num, len(self.filelist))
+        files = _queue.Queue()
+        for p in self.filelist:
+            files.put(p)
+        done = threading.Semaphore(0)
+        stop = threading.Event()  # set when the consumer abandons epoch
+        errors: List[BaseException] = []
+
+        def put(sample: Any) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(sample, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def reader() -> None:
+            try:
+                while not stop.is_set():
+                    try:
+                        path = files.get_nowait()
+                    except _queue.Empty:
+                        return
+                    with open(path, "r") as f:
+                        for line in f:
+                            if line.strip() and not put(
+                                    self.parse_fn(line)):
+                                return
+            except BaseException as e:  # surface in the consumer
+                errors.append(e)
+            finally:
+                done.release()
+
+        for _ in range(n_readers):
+            threading.Thread(target=reader, daemon=True).start()
+
+        def drain() -> Iterator[Any]:
+            finished = 0
+            try:
+                while True:
+                    try:
+                        yield q.get(timeout=0.05)
+                    except _queue.Empty:
+                        while done.acquire(blocking=False):
+                            finished += 1
+                        if errors:
+                            raise errors[0]
+                        if finished >= n_readers and q.empty():
+                            return
+            finally:
+                stop.set()  # unblock readers on early consumer exit
+
+        return self._batches(drain())

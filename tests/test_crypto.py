@@ -48,7 +48,7 @@ def test_ctr_roundtrip_odd_length():
 def test_cipher_envelope_roundtrip():
     c = CipherFactory.create_cipher(b"secret key")
     blob = c.encrypt(b"model bytes")
-    assert blob[:6] == b"PTENC1"
+    assert blob[:6] == b"PTENC2"
     assert c.decrypt(blob) == b"model bytes"
 
 
@@ -72,7 +72,7 @@ def test_encrypted_save_load(tmp_path, rng):
     path = str(tmp_path / "model.pdparams.enc")
     pt.save(sd, path, cipher_key=b"deploy-key")
     with open(path, "rb") as f:
-        assert f.read(6) == b"PTENC1"
+        assert f.read(6) == b"PTENC2"
     with pytest.raises(Exception):
         pt.load(path)  # without key: not a pickle
     out = pt.load(path, cipher_key=b"deploy-key")
