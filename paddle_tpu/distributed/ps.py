@@ -165,7 +165,10 @@ class SSDSparseTable:
         self.std = initializer_std
         self.optimizer = optimizer
         self.cache_rows = cache_rows
-        self._db = sqlite3.connect(path, check_same_thread=False)
+        # autocommit: evicted rows must survive a server crash/stop
+        # without an explicit flush
+        self._db = sqlite3.connect(path, check_same_thread=False,
+                                   isolation_level=None)
         self._db.execute(
             "CREATE TABLE IF NOT EXISTS rows (k INTEGER PRIMARY KEY, "
             "v BLOB)")
@@ -334,6 +337,9 @@ class PSServer:
         self._thread.start()
 
     def stop(self) -> None:
+        for t in self.sparse.values():  # persist dirty SSD-cached rows
+            if hasattr(t, "flush"):
+                t.flush()
         self._server.shutdown()
         self._server.server_close()
 
@@ -448,13 +454,15 @@ class GeoCommunicator:
     replica — communication cost scales with touched rows, not steps."""
 
     def __init__(self, client: PSClient, table: str, emb_dim: int,
-                 k_steps: int = 10, lr: float = 0.01):
+                 k_steps: int = 10, lr: float = 0.01,
+                 max_local_rows: int = 1_000_000):
         self.client = client
         self.table = table
         self.emb_dim = emb_dim
         self.k_steps = max(1, int(k_steps))
         self.lr = lr
-        self.local: Dict[int, np.ndarray] = {}
+        self.max_local_rows = int(max_local_rows)
+        self.local: Dict[int, np.ndarray] = {}  # insertion-ordered
         self.base: Dict[int, np.ndarray] = {}
         self._touched: set = set()
         self._t = 0
@@ -493,9 +501,17 @@ class GeoCommunicator:
         # refresh replica with the server's merged view
         rows = self.client.pull_sparse(self.table, keys)
         for k, r in zip(keys, rows):
-            self.local[int(k)] = r.copy()
-            self.base[int(k)] = r.copy()
+            k = int(k)
+            self.local.pop(k, None)  # re-insert = most recently used
+            self.local[k] = r.copy()
+            self.base[k] = r.copy()
         self._touched.clear()
+        # bound the replica: evict coldest rows (all deltas are synced,
+        # so eviction only costs a future re-pull)
+        while len(self.local) > self.max_local_rows:
+            cold = next(iter(self.local))
+            del self.local[cold]
+            self.base.pop(cold, None)
 
 
 class AsyncCommunicator:

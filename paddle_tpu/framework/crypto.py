@@ -130,8 +130,8 @@ def aes128_ctr(key16: bytes, iv16: bytes, data: bytes) -> bytes:
 
 
 class AESCipher:
-    """AES-128-CTR cipher with crc32c integrity (the reference's
-    AESCipher over cryptopp, io/crypto/aes_cipher.cc)."""
+    """AES-128-CTR cipher with HMAC-SHA256 integrity, encrypt-then-MAC
+    (the reference's AESCipher over cryptopp, io/crypto/aes_cipher.cc)."""
 
     def __init__(self, key: bytes):
         if not isinstance(key, (bytes, bytearray)):
@@ -152,6 +152,10 @@ class AESCipher:
 
     def decrypt(self, blob: bytes) -> bytes:
         if blob[:len(_MAGIC)] != _MAGIC:
+            if blob[:6] == b"PTENC1":
+                raise ValueError(
+                    "legacy PTENC1 artifact (pre-release CRC envelope); "
+                    "re-save it with this version")
             raise ValueError("not a PTENC2 encrypted blob")
         off = len(_MAGIC)
         iv = blob[off:off + 16]

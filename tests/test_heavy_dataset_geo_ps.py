@@ -206,6 +206,47 @@ def test_geo_sgd_end_to_end():
         srv.stop()
 
 
+def test_ssd_rows_survive_server_stop(tmp_path):
+    # dirty cached rows must be committed when the server stops
+    from paddle_tpu.distributed.ps import PSClient, PSServer, \
+        SSDSparseTable
+    path = str(tmp_path / "persist.db")
+    srv = PSServer()
+    srv.add_sparse_table("emb", emb_dim=2, kind="ssd", path=path,
+                         initializer_std=0.0)
+    srv.start()
+    c = PSClient([srv.endpoint])
+    c.push_sparse_grad("emb", np.array([7], np.int64),
+                       np.ones((1, 2), np.float32))
+    want = c.pull_sparse("emb", np.array([7], np.int64))
+    c.stop()
+    srv.stop()
+    reopened = SSDSparseTable(emb_dim=2, path=path)
+    np.testing.assert_allclose(
+        reopened.pull(np.array([7], np.int64)), want)
+
+
+def test_geo_replica_eviction():
+    from paddle_tpu.distributed.ps import (GeoCommunicator, PSClient,
+                                           PSServer)
+    srv = PSServer()
+    srv.add_sparse_table("emb", emb_dim=2, initializer_std=0.0)
+    srv.start()
+    try:
+        c = PSClient([srv.endpoint])
+        geo = GeoCommunicator(c, "emb", 2, k_steps=1, max_local_rows=3)
+        for k in range(10):
+            keys = np.array([k], np.int64)
+            geo.push_grad(keys, np.ones((1, 2), np.float32))
+        assert len(geo.local) <= 3 and len(geo.base) <= 3
+        # evicted rows re-pull the server view transparently
+        out = geo.pull(np.array([0], np.int64))
+        np.testing.assert_allclose(out, -0.01, rtol=1e-5)
+        c.stop()
+    finally:
+        srv.stop()
+
+
 def test_server_hosts_ssd_table():
     from paddle_tpu.distributed.ps import PSClient, PSServer
     srv = PSServer()
