@@ -211,10 +211,19 @@ class SSDSparseTable:
 
     def flush(self) -> None:
         with self._lock:
-            for k in list(self._dirty):
-                self._write(k, self._cache[k])
+            if not self._dirty:
+                return
+            # one transaction, not one fsync per row
+            self._db.execute("BEGIN")
+            try:
+                self._db.executemany(
+                    "INSERT OR REPLACE INTO rows (k, v) VALUES (?, ?)",
+                    [(k, self._cache[k].tobytes()) for k in self._dirty])
+                self._db.execute("COMMIT")
+            except BaseException:
+                self._db.execute("ROLLBACK")
+                raise
             self._dirty.clear()
-            self._db.commit()
 
     def pull(self, keys: Sequence[int]) -> np.ndarray:
         with self._lock:
@@ -337,11 +346,13 @@ class PSServer:
         self._thread.start()
 
     def stop(self) -> None:
-        for t in self.sparse.values():  # persist dirty SSD-cached rows
-            if hasattr(t, "flush"):
-                t.flush()
         self._server.shutdown()
         self._server.server_close()
+        # flush AFTER shutdown: a push acknowledged while stopping must
+        # not land behind the flush and get lost
+        for t in self.sparse.values():
+            if hasattr(t, "flush"):
+                t.flush()
 
     @property
     def endpoint(self) -> str:
@@ -477,7 +488,23 @@ class GeoCommunicator:
             for k, r in zip(missing, rows):
                 self.local[k] = r.copy()
                 self.base[k] = r.copy()
-        return np.stack([self.local[int(k)] for k in keys])
+        out = np.stack([self.local[int(k)] for k in keys])
+        self._evict(protect=set(int(k) for k in keys))
+        return out
+
+    def _evict(self, protect: Optional[set] = None) -> None:
+        """Bound the replica; never evict rows with unsynced deltas or
+        rows the current call is about to use."""
+        if len(self.local) <= self.max_local_rows:
+            return
+        keep = self._touched | (protect or set())
+        for k in list(self.local):
+            if len(self.local) <= self.max_local_rows:
+                break
+            if k in keep:
+                continue
+            del self.local[k]
+            self.base.pop(k, None)
 
     def push_grad(self, keys: np.ndarray, grads: np.ndarray) -> None:
         """Local SGD on the replica; periodic delta sync."""
@@ -506,12 +533,8 @@ class GeoCommunicator:
             self.local[k] = r.copy()
             self.base[k] = r.copy()
         self._touched.clear()
-        # bound the replica: evict coldest rows (all deltas are synced,
-        # so eviction only costs a future re-pull)
-        while len(self.local) > self.max_local_rows:
-            cold = next(iter(self.local))
-            del self.local[cold]
-            self.base.pop(cold, None)
+        # all deltas are synced now — eviction only costs a re-pull
+        self._evict()
 
 
 class AsyncCommunicator:

@@ -239,6 +239,10 @@ def test_geo_replica_eviction():
             keys = np.array([k], np.int64)
             geo.push_grad(keys, np.ones((1, 2), np.float32))
         assert len(geo.local) <= 3 and len(geo.base) <= 3
+        # pull-only traffic is bounded too (read-heavy eval loops)
+        for k in range(20, 40):
+            geo.pull(np.array([k], np.int64))
+        assert len(geo.local) <= 4  # cap + the protected current key
         # evicted rows re-pull the server view transparently
         out = geo.pull(np.array([0], np.int64))
         np.testing.assert_allclose(out, -0.01, rtol=1e-5)
