@@ -221,7 +221,10 @@ class SSDSparseTable:
                     [(k, self._cache[k].tobytes()) for k in self._dirty])
                 self._db.execute("COMMIT")
             except BaseException:
-                self._db.execute("ROLLBACK")
+                try:
+                    self._db.execute("ROLLBACK")
+                except Exception:
+                    pass  # keep the original write error, not the rollback's
                 raise
             self._dirty.clear()
 
@@ -264,6 +267,14 @@ class PSServer:
         self.sparse: Dict[str, SparseTable] = {}
         self._barrier_count = 0
         self._barrier_lock = threading.Lock()
+        # Handler threads are daemonic and may sit blocked in _recv_msg on
+        # idle connections, so stop() cannot join them. Instead dispatches
+        # are counted: stop() flips _stopping (new mutations get a NACK,
+        # never an ack that could be lost) and drains in-flight ones
+        # before flushing tables.
+        self._stopping = False
+        self._active = 0
+        self._active_cv = threading.Condition()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -271,7 +282,20 @@ class PSServer:
                 try:
                     while True:
                         msg = _recv_msg(self.request)
-                        resp = outer._dispatch(msg)
+                        with outer._active_cv:
+                            admitted = (not outer._stopping
+                                        or msg.get("cmd") == STOP)
+                            if admitted:
+                                outer._active += 1
+                        if admitted:
+                            try:
+                                resp = outer._dispatch(msg)
+                            finally:
+                                with outer._active_cv:
+                                    outer._active -= 1
+                                    outer._active_cv.notify_all()
+                        else:
+                            resp = {"ok": False, "error": "server stopping"}
                         _send_msg(self.request, resp)
                         if msg.get("cmd") == STOP:
                             break
@@ -346,10 +370,14 @@ class PSServer:
         self._thread.start()
 
     def stop(self) -> None:
+        # Order matters for durability: refuse new mutations, drain the
+        # in-flight ones, then flush — no acknowledged push can land
+        # behind the flush and get lost.
+        with self._active_cv:
+            self._stopping = True
+            self._active_cv.wait_for(lambda: self._active == 0, timeout=30)
         self._server.shutdown()
         self._server.server_close()
-        # flush AFTER shutdown: a push acknowledged while stopping must
-        # not land behind the flush and get lost
         for t in self.sparse.values():
             if hasattr(t, "flush"):
                 t.flush()
@@ -488,6 +516,9 @@ class GeoCommunicator:
             for k, r in zip(missing, rows):
                 self.local[k] = r.copy()
                 self.base[k] = r.copy()
+        for k in keys:  # re-insert = most recently used, so hot read
+            k = int(k)   # rows survive the insertion-ordered eviction
+            self.local[k] = self.local.pop(k)
         out = np.stack([self.local[int(k)] for k in keys])
         self._evict(protect=set(int(k) for k in keys))
         return out
