@@ -375,12 +375,29 @@ class PSServer:
         # behind the flush and get lost.
         with self._active_cv:
             self._stopping = True
-            self._active_cv.wait_for(lambda: self._active == 0, timeout=30)
+            drained = self._active_cv.wait_for(
+                lambda: self._active == 0, timeout=30)
+        if not drained:
+            import warnings
+            warnings.warn(
+                "PSServer.stop: in-flight requests did not drain within "
+                "30s; flushing anyway — a late mutation may complete "
+                "after the flush", RuntimeWarning)
         self._server.shutdown()
         self._server.server_close()
         for t in self.sparse.values():
             if hasattr(t, "flush"):
                 t.flush()
+        if not drained:
+            # second flush after shutdown closes the socket loop: any
+            # dispatch that slipped past the first flush has finished or
+            # been torn down by now, so this pass catches its writes.
+            with self._active_cv:
+                self._active_cv.wait_for(lambda: self._active == 0,
+                                         timeout=5)
+            for t in self.sparse.values():
+                if hasattr(t, "flush"):
+                    t.flush()
 
     @property
     def endpoint(self) -> str:

@@ -7,8 +7,9 @@ section_worker.cc:130-180). TPU-native: GPT blocks are uniform, so the
 whole stack is ONE stacked [n_layers, ...] params pytree sharded over the
 "pp" mesh axis; inside shard_map each device scans its local blocks and
 spmd_pipeline rotates microbatch activations around the pp ring. jax.grad
-through the loop reverses the permutes (F-then-B); remat on the stage fn
-gives the 1F1B-like memory profile.
+through the loop reverses the permutes (F-then-B). schedule="1f1b"
+selects the true 1F1B schedule (spmd_pipeline_1f1b): O(pp) in-flight
+activations independent of n_micro, matching section_worker.cc:144-180.
 
 Embedding/head run replicated on every stage (cheap vs the blocks), which
 also implements the reference's tied-embedding weight sync
@@ -63,7 +64,7 @@ class GPTPipelineTrainStep:
 
     def __init__(self, config: GPTConfig, optimizer, pp: int, dp: int = 1,
                  n_micro: int = 2, devices=None, remat: bool = False,
-                 seed: int = 0):
+                 seed: int = 0, schedule: str = "fthenb"):
         assert config.num_layers % pp == 0, "layers must divide pp"
         assert config.dropout == 0.0 and config.attn_dropout == 0.0, \
             "pipeline step requires dropout=0 (rng is not plumbed per-stage)"
@@ -88,7 +89,10 @@ class GPTPipelineTrainStep:
         # slots inherit their param's sharding (stacked slots ride pp)
         self.opt_state = optimizer.init(params)
 
-        self._step = self._build(remat)
+        assert schedule in ("fthenb", "1f1b"), schedule
+        self.schedule = schedule
+        self._step = (self._build(remat) if schedule == "fthenb"
+                      else self._build_1f1b(remat))
 
     # -- functional pieces ----------------------------------------------------
 
@@ -176,6 +180,67 @@ class GPTPipelineTrainStep:
             # check_vma=False skips the automatic replication-sum for
             # grads of replicated/pp-sharded inputs; psums were made
             # explicit in loss_fn, and GSPMD resolves grad shardings here.
+            new_params, new_opt = optimizer.apply_gradients(
+                params, grads, opt_state, lr=lr)
+            return new_params, new_opt, loss
+
+        return jax.jit(step_impl, donate_argnums=(0, 1))
+
+    def _build_1f1b(self, remat: bool):
+        """Memory-bounded 1F1B schedule with manual backward composition
+        (reference: section_worker.cc:144-180); activations in flight are
+        O(pp) instead of O(n_micro)."""
+        from ..distributed.pp import spmd_pipeline_1f1b
+
+        n_micro = self.n_micro
+        block_apply = self._block_apply
+        embed = self._embed
+        head_loss = self._head_loss
+        optimizer = self.optimizer
+        mesh = self.mesh
+
+        def stage_fn(blocks_local, x):
+            def body(h, blk):
+                return block_apply(blk, h), None
+            h, _ = jax.lax.scan(body, x, blocks_local)
+            return h
+
+        def inner(stacked_l, shared_l, ids_l, labels_l):
+            b, s = ids_l.shape
+            mb = b // n_micro
+            ids_m = ids_l.reshape(n_micro, mb, s)
+            labels_m = labels_l.reshape(n_micro, mb, s)
+
+            def first_fn(sh, mb_idx):
+                return embed(sh, jax.lax.dynamic_index_in_dim(
+                    ids_m, mb_idx, keepdims=False))
+
+            def last_fn(sh, y, mb_idx):
+                lbl = jax.lax.dynamic_index_in_dim(labels_m, mb_idx,
+                                                   keepdims=False)
+                return head_loss(sh, y, lbl) / n_micro
+
+            loss_sum, d_stacked, d_shared = spmd_pipeline_1f1b(
+                stage_fn, stacked_l, shared_l, first_fn, last_fn,
+                n_micro, axis_name="pp", remat=remat)
+            loss = jax.lax.pmean(jax.lax.psum(loss_sum, "pp"), "dp")
+            d_stacked = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, "dp"), d_stacked)
+            d_shared = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(jax.lax.psum(g, "pp"), "dp"),
+                d_shared)
+            return loss, d_stacked, d_shared
+
+        def step_impl(params, opt_state, lr, ids, labels):
+            from ..distributed.mp_layers import no_sharding_constraints
+            with no_sharding_constraints():
+                smapped = shard_map(
+                    inner, mesh=mesh,
+                    in_specs=(P("pp"), P(), P("dp"), P("dp")),
+                    out_specs=(P(), P("pp"), P()), check_vma=False)
+                loss, d_stacked, d_shared = smapped(
+                    params["stacked"], params["shared"], ids, labels)
+            grads = {"stacked": d_stacked, "shared": d_shared}
             new_params, new_opt = optimizer.apply_gradients(
                 params, grads, opt_state, lr=lr)
             return new_params, new_opt, loss
