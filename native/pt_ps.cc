@@ -1,0 +1,621 @@
+// Native parameter-server transport + table math.
+//
+// TPU-native equivalent of the reference's brpc PS core
+// (paddle/fluid/distributed/service/brpc_ps_server.cc,
+// brpc_ps_client.cc; table math common_dense_table.cc,
+// common_sparse_table.cc). The reference runs a brpc RPC service with
+// dense/sparse tables and server-side optimizers; here the same
+// capability is a dependency-free POSIX-socket service with a binary
+// length-prefixed protocol (no pickle on the hot path) and the table
+// updates (dense SGD/Adam, sparse SGD/Adagrad) applied in C++.
+// Python keeps orchestration: sharding keys across servers, geo/async
+// communicators, checkpoint plumbing (paddle_tpu/distributed/ps.py).
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this image).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <list>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---- wire protocol ---------------------------------------------------------
+// request : [u32 magic][u8 cmd][u16 name_len][name][u64 len][payload]
+// response: [u8 status][u64 len][payload]      status 0 = ok
+constexpr uint32_t kMagic = 0x50545053;  // "PTPS"
+
+enum Cmd : uint8_t {
+  kPullDense = 1,
+  kPushDense = 2,
+  kPushDenseInit = 3,
+  kPullSparse = 4,
+  kPushSparse = 5,
+  kPushSparseDelta = 6,
+  kBarrier = 7,
+  kStop = 8,
+  kSparseSize = 9,
+  kTableDim = 10,
+};
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// ---- tables ----------------------------------------------------------------
+
+struct DenseTable {
+  // reference: table/common_dense_table.cc (server-side optimizer)
+  std::vector<float> value, m, v;
+  int64_t t = 0;
+  int opt = 0;  // 0 sgd, 1 adam
+  float lr = 0.01f, beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
+  std::mutex mu;
+
+  bool push_grad(const float* g, size_t n) {
+    std::lock_guard<std::mutex> lk(mu);
+    if (n != value.size()) return false;
+    if (opt == 1) {
+      ++t;
+      const float c1 = 1.0f - std::pow(beta1, static_cast<float>(t));
+      const float c2 = 1.0f - std::pow(beta2, static_cast<float>(t));
+      for (size_t i = 0; i < n; ++i) {
+        m[i] = beta1 * m[i] + (1 - beta1) * g[i];
+        v[i] = beta2 * v[i] + (1 - beta2) * g[i] * g[i];
+        value[i] -= lr * (m[i] / c1) / (std::sqrt(v[i] / c2) + eps);
+      }
+    } else {
+      for (size_t i = 0; i < n; ++i) value[i] -= lr * g[i];
+    }
+    return true;
+  }
+};
+
+struct SparseTable {
+  // reference: table/common_sparse_table.cc — rows materialize on first
+  // access; layout per row: [value(dim) | adagrad accum(dim)]
+  int dim = 0;
+  int opt = 1;  // 0 sgd, 1 adagrad
+  float lr = 0.01f, init_std = 0.01f;
+  uint64_t seed = 0;
+  std::unordered_map<int64_t, std::vector<float>> rows;
+  std::mutex mu;
+
+  std::vector<float>& row(int64_t key) {
+    auto it = rows.find(key);
+    if (it != rows.end()) return it->second;
+    // deterministic per-key init: restart-stable and independent of
+    // access order (the Python table uses one shared rng stream)
+    std::mt19937_64 gen(seed ^ static_cast<uint64_t>(key) * 0x9E3779B97F4A7C15ull);
+    std::normal_distribution<float> nd(0.0f, init_std);
+    std::vector<float> r(2 * dim, 0.0f);
+    for (int i = 0; i < dim; ++i) r[i] = nd(gen);
+    return rows.emplace(key, std::move(r)).first->second;
+  }
+
+  void pull(const int64_t* keys, size_t nk, float* out) {
+    std::lock_guard<std::mutex> lk(mu);
+    for (size_t i = 0; i < nk; ++i)
+      std::memcpy(out + i * dim, row(keys[i]).data(), dim * sizeof(float));
+  }
+
+  void push(const int64_t* keys, size_t nk, const float* g, bool delta) {
+    std::lock_guard<std::mutex> lk(mu);
+    for (size_t i = 0; i < nk; ++i) {
+      std::vector<float>& r = row(keys[i]);
+      const float* gi = g + i * dim;
+      if (delta) {
+        for (int j = 0; j < dim; ++j) r[j] += gi[j];
+      } else if (opt == 1) {
+        for (int j = 0; j < dim; ++j) {
+          r[dim + j] += gi[j] * gi[j];
+          r[j] -= lr * gi[j] / (std::sqrt(r[dim + j]) + 1e-6f);
+        }
+      } else {
+        for (int j = 0; j < dim; ++j) r[j] -= lr * gi[j];
+      }
+    }
+  }
+};
+
+// ---- server ----------------------------------------------------------------
+
+struct Conn {
+  int fd = -1;
+  bool done = false;
+  std::thread th;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::list<Conn> conns;
+  std::mutex conn_mu;
+  std::atomic<bool> stopping{false};
+  bool stopped = false;  // stop() is idempotent; destroy calls it
+  // in-flight mutation drain (mirrors PSServer.stop ordering: refuse,
+  // drain, then the caller flushes/reads tables)
+  int active = 0;
+  std::mutex active_mu;
+  std::condition_variable active_cv;
+  int barrier_count = 0;
+  std::mutex barrier_mu;
+
+  std::unordered_map<std::string, DenseTable> dense;
+  std::unordered_map<std::string, SparseTable> sparse;
+  std::mutex tables_mu;  // guards map shape only (tables self-lock)
+
+  bool respond(int fd, uint8_t status, const void* payload, uint64_t n) {
+    char hdr[9];
+    hdr[0] = static_cast<char>(status);
+    std::memcpy(hdr + 1, &n, 8);
+    if (!send_all(fd, hdr, 9)) return false;
+    return n == 0 || send_all(fd, payload, n);
+  }
+
+  bool handle_one(int fd) {
+    uint32_t magic;
+    if (!recv_all(fd, &magic, 4) || magic != kMagic) return false;
+    uint8_t cmd;
+    uint16_t name_len;
+    if (!recv_all(fd, &cmd, 1) || !recv_all(fd, &name_len, 2)) return false;
+    std::string name(name_len, '\0');
+    if (name_len && !recv_all(fd, &name[0], name_len)) return false;
+    uint64_t plen;
+    if (!recv_all(fd, &plen, 8)) return false;
+    if (plen > (1ull << 31)) return false;  // wire-length sanity cap
+    std::vector<char> payload(plen);
+    if (plen && !recv_all(fd, payload.data(), plen)) return false;
+
+    const bool mutation = cmd == kPushDense || cmd == kPushDenseInit ||
+                          cmd == kPushSparse || cmd == kPushSparseDelta;
+    if (mutation) {
+      std::lock_guard<std::mutex> lk(active_mu);
+      if (stopping.load()) {
+        respond(fd, 2, nullptr, 0);  // NACK: server stopping
+        return true;
+      }
+      ++active;
+    }
+    bool keep = dispatch(fd, cmd, name, payload);
+    if (mutation) {
+      std::lock_guard<std::mutex> lk(active_mu);
+      --active;
+      active_cv.notify_all();
+    }
+    return keep;
+  }
+
+  bool dispatch(int fd, uint8_t cmd, const std::string& name,
+                std::vector<char>& payload) {
+    switch (cmd) {
+      case kPullDense: {
+        DenseTable* t = find_dense(name);
+        if (!t) return respond(fd, 1, nullptr, 0);
+        std::lock_guard<std::mutex> lk(t->mu);
+        return respond(fd, 0, t->value.data(),
+                       t->value.size() * sizeof(float));
+      }
+      case kPushDense:
+      case kPushDenseInit: {
+        DenseTable* t = find_dense(name);
+        if (!t) return respond(fd, 1, nullptr, 0);
+        const float* g = reinterpret_cast<const float*>(payload.data());
+        size_t n = payload.size() / sizeof(float);
+        if (cmd == kPushDenseInit) {
+          std::lock_guard<std::mutex> lk(t->mu);
+          t->value.assign(g, g + n);
+          t->m.assign(n, 0.0f);
+          t->v.assign(n, 0.0f);
+          t->t = 0;
+        } else if (!t->push_grad(g, n)) {
+          return respond(fd, 3, nullptr, 0);  // size mismatch: no silent ACK
+        }
+        return respond(fd, 0, nullptr, 0);
+      }
+      case kPullSparse: {
+        SparseTable* t = find_sparse(name);
+        if (!t) return respond(fd, 1, nullptr, 0);
+        size_t nk = payload.size() / sizeof(int64_t);
+        std::vector<float> out(nk * t->dim);
+        t->pull(reinterpret_cast<const int64_t*>(payload.data()), nk,
+                out.data());
+        return respond(fd, 0, out.data(), out.size() * sizeof(float));
+      }
+      case kPushSparse:
+      case kPushSparseDelta: {
+        SparseTable* t = find_sparse(name);
+        if (!t) return respond(fd, 1, nullptr, 0);
+        if (payload.size() < 8) return respond(fd, 3, nullptr, 0);
+        uint64_t nk;
+        std::memcpy(&nk, payload.data(), 8);
+        // validate wire-supplied nk against the actual payload size
+        // before any pointer arithmetic
+        const uint64_t want =
+            8 + nk * (sizeof(int64_t) + t->dim * sizeof(float));
+        if (nk > (1ull << 28) || payload.size() != want)
+          return respond(fd, 3, nullptr, 0);
+        const int64_t* keys =
+            reinterpret_cast<const int64_t*>(payload.data() + 8);
+        const float* g = reinterpret_cast<const float*>(
+            payload.data() + 8 + nk * sizeof(int64_t));
+        t->push(keys, nk, g, cmd == kPushSparseDelta);
+        return respond(fd, 0, nullptr, 0);
+      }
+      case kBarrier: {
+        std::lock_guard<std::mutex> lk(barrier_mu);
+        ++barrier_count;
+        uint64_t c = static_cast<uint64_t>(barrier_count);
+        return respond(fd, 0, &c, 8);
+      }
+      case kSparseSize: {
+        SparseTable* t = find_sparse(name);
+        if (!t) return respond(fd, 1, nullptr, 0);
+        std::lock_guard<std::mutex> lk(t->mu);
+        uint64_t n = t->rows.size();
+        return respond(fd, 0, &n, 8);
+      }
+      case kTableDim: {
+        SparseTable* t = find_sparse(name);
+        if (!t) return respond(fd, 1, nullptr, 0);
+        uint64_t d = static_cast<uint64_t>(t->dim);
+        return respond(fd, 0, &d, 8);
+      }
+      case kStop:
+        respond(fd, 0, nullptr, 0);
+        return false;
+      default:
+        return respond(fd, 1, nullptr, 0);
+    }
+  }
+
+  DenseTable* find_dense(const std::string& n) {
+    std::lock_guard<std::mutex> lk(tables_mu);
+    auto it = dense.find(n);
+    return it == dense.end() ? nullptr : &it->second;
+  }
+  SparseTable* find_sparse(const std::string& n) {
+    std::lock_guard<std::mutex> lk(tables_mu);
+    auto it = sparse.find(n);
+    return it == sparse.end() ? nullptr : &it->second;
+  }
+
+  void conn_loop(Conn* c) {
+    const int fd = c->fd;
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    try {
+      while (!stopping.load() && handle_one(fd)) {
+      }
+    } catch (...) {
+      // a malformed/oversized request must not take down the service
+    }
+    std::lock_guard<std::mutex> lk(conn_mu);
+    ::close(fd);
+    c->fd = -1;  // stop() must never shutdown() a reused fd number
+    c->done = true;
+  }
+
+  void reap_finished_conns() {
+    // join+erase finished connections so long-lived servers don't
+    // accumulate dead threads (called from the accept loop, no joins of
+    // self possible)
+    std::list<Conn> done;
+    {
+      std::lock_guard<std::mutex> lk(conn_mu);
+      for (auto it = conns.begin(); it != conns.end();) {
+        if (it->done) {
+          done.splice(done.end(), conns, it++);
+        } else {
+          ++it;
+        }
+      }
+    }
+    for (Conn& c : done)
+      if (c.th.joinable()) c.th.join();
+  }
+
+  void accept_loop() {
+    while (!stopping.load()) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) break;  // listen socket closed by stop()
+      reap_finished_conns();
+      std::lock_guard<std::mutex> lk(conn_mu);
+      conns.emplace_back();
+      Conn* c = &conns.back();
+      c->fd = fd;
+      c->th = std::thread([this, c] { conn_loop(c); });
+    }
+  }
+
+  bool start(const char* host, int port_req) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port_req));
+    ::inet_pton(AF_INET, host, &addr.sin_addr);
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(listen_fd, 64) != 0) {
+      ::close(listen_fd);
+      return false;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = ntohs(addr.sin_port);
+    accept_thread = std::thread([this] { accept_loop(); });
+    return true;
+  }
+
+  void stop() {
+    if (stopped) return;
+    stopped = true;
+    // refuse new mutations, then drain in-flight ones before the caller
+    // snapshots/destroys tables
+    stopping.store(true);
+    {
+      std::unique_lock<std::mutex> lk(active_mu);
+      active_cv.wait_for(lk, std::chrono::seconds(30),
+                         [this] { return active == 0; });
+    }
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    {
+      std::lock_guard<std::mutex> lk(conn_mu);
+      for (Conn& c : conns)
+        if (c.fd >= 0) ::shutdown(c.fd, SHUT_RDWR);
+    }
+    // join outside the lock: conn threads take conn_mu to finish
+    for (Conn& c : conns) {
+      std::thread t;
+      {
+        std::lock_guard<std::mutex> lk(conn_mu);
+        t = std::move(c.th);
+      }
+      if (t.joinable()) t.join();
+    }
+    conns.clear();
+  }
+
+  ~Server() { stop(); }
+};
+
+// ---- client ----------------------------------------------------------------
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+
+  bool request(uint8_t cmd, const std::string& name, const void* payload,
+               uint64_t plen, std::vector<char>* out) {
+    std::lock_guard<std::mutex> lk(mu);
+    uint16_t nl = static_cast<uint16_t>(name.size());
+    std::vector<char> hdr(4 + 1 + 2 + name.size() + 8);
+    std::memcpy(hdr.data(), &kMagic, 4);
+    hdr[4] = static_cast<char>(cmd);
+    std::memcpy(hdr.data() + 5, &nl, 2);
+    std::memcpy(hdr.data() + 7, name.data(), name.size());
+    std::memcpy(hdr.data() + 7 + name.size(), &plen, 8);
+    if (!send_all(fd, hdr.data(), hdr.size())) return false;
+    if (plen && !send_all(fd, payload, plen)) return false;
+    uint8_t status;
+    uint64_t rlen;
+    if (!recv_all(fd, &status, 1) || !recv_all(fd, &rlen, 8)) return false;
+    std::vector<char> resp(rlen);
+    if (rlen && !recv_all(fd, resp.data(), rlen)) return false;
+    if (status != 0) return false;
+    if (out) *out = std::move(resp);
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_ps_server_create() { return new Server(); }
+
+int pt_ps_server_add_dense(void* h, const char* name, uint64_t size,
+                           int opt, float lr, float beta1, float beta2,
+                           float eps) {
+  Server* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> lk(s->tables_mu);
+  DenseTable& t = s->dense[name];
+  t.value.assign(size, 0.0f);
+  t.m.assign(size, 0.0f);
+  t.v.assign(size, 0.0f);
+  t.opt = opt;
+  t.lr = lr;
+  t.beta1 = beta1;
+  t.beta2 = beta2;
+  t.eps = eps;
+  return 0;
+}
+
+int pt_ps_server_add_sparse(void* h, const char* name, int dim, int opt,
+                            float lr, float init_std, uint64_t seed) {
+  Server* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> lk(s->tables_mu);
+  SparseTable& t = s->sparse[name];
+  t.dim = dim;
+  t.opt = opt;
+  t.lr = lr;
+  t.init_std = init_std;
+  t.seed = seed;
+  return 0;
+}
+
+int pt_ps_server_start(void* h, const char* host, int port) {
+  return static_cast<Server*>(h)->start(host, port) ? 0 : -1;
+}
+
+int pt_ps_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void pt_ps_server_stop(void* h) { static_cast<Server*>(h)->stop(); }
+
+void pt_ps_server_destroy(void* h) {
+  // ~Server stops first if the caller never did, so destroying a running
+  // server cannot hit std::terminate on joinable threads
+  delete static_cast<Server*>(h);
+}
+
+int pt_ps_server_dense_read(void* h, const char* name, float* out,
+                            uint64_t n) {
+  DenseTable* t = static_cast<Server*>(h)->find_dense(name);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  if (n != t->value.size()) return -2;
+  std::memcpy(out, t->value.data(), n * sizeof(float));
+  return 0;
+}
+
+int64_t pt_ps_server_sparse_size(void* h, const char* name) {
+  SparseTable* t = static_cast<Server*>(h)->find_sparse(name);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  return static_cast<int64_t>(t->rows.size());
+}
+
+void* pt_ps_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, host, &addr.sin_addr);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+      0) {
+    ::close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  Client* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+void pt_ps_disconnect(void* h) {
+  Client* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+int pt_ps_pull_dense(void* h, const char* name, float* out, uint64_t n) {
+  std::vector<char> resp;
+  if (!static_cast<Client*>(h)->request(kPullDense, name, nullptr, 0,
+                                        &resp))
+    return -1;
+  if (resp.size() != n * sizeof(float)) return -2;
+  std::memcpy(out, resp.data(), resp.size());
+  return 0;
+}
+
+int pt_ps_push_dense(void* h, const char* name, const float* g, uint64_t n,
+                     int init) {
+  return static_cast<Client*>(h)->request(
+             init ? kPushDenseInit : kPushDense, name, g,
+             n * sizeof(float), nullptr)
+             ? 0
+             : -1;
+}
+
+int pt_ps_pull_sparse(void* h, const char* name, const int64_t* keys,
+                      uint64_t nk, float* out, int dim) {
+  std::vector<char> resp;
+  if (!static_cast<Client*>(h)->request(kPullSparse, name, keys,
+                                        nk * sizeof(int64_t), &resp))
+    return -1;
+  if (resp.size() != nk * dim * sizeof(float)) return -2;
+  std::memcpy(out, resp.data(), resp.size());
+  return 0;
+}
+
+int pt_ps_push_sparse(void* h, const char* name, const int64_t* keys,
+                      uint64_t nk, const float* g, int dim, int is_delta) {
+  std::vector<char> payload(8 + nk * sizeof(int64_t) +
+                            nk * dim * sizeof(float));
+  std::memcpy(payload.data(), &nk, 8);
+  std::memcpy(payload.data() + 8, keys, nk * sizeof(int64_t));
+  std::memcpy(payload.data() + 8 + nk * sizeof(int64_t), g,
+              nk * dim * sizeof(float));
+  return static_cast<Client*>(h)->request(
+             is_delta ? kPushSparseDelta : kPushSparse, name,
+             payload.data(), payload.size(), nullptr)
+             ? 0
+             : -1;
+}
+
+int64_t pt_ps_table_dim(void* h, const char* name) {
+  std::vector<char> resp;
+  if (!static_cast<Client*>(h)->request(kTableDim, name, nullptr, 0, &resp))
+    return -1;
+  uint64_t d;
+  std::memcpy(&d, resp.data(), 8);
+  return static_cast<int64_t>(d);
+}
+
+int64_t pt_ps_sparse_size(void* h, const char* name) {
+  std::vector<char> resp;
+  if (!static_cast<Client*>(h)->request(kSparseSize, name, nullptr, 0,
+                                        &resp))
+    return -1;
+  uint64_t n;
+  std::memcpy(&n, resp.data(), 8);
+  return static_cast<int64_t>(n);
+}
+
+int pt_ps_barrier(void* h) {
+  std::vector<char> resp;
+  return static_cast<Client*>(h)->request(kBarrier, "", nullptr, 0, &resp)
+             ? 0
+             : -1;
+}
+
+int pt_ps_stop_server(void* h) {
+  return static_cast<Client*>(h)->request(kStop, "", nullptr, 0, nullptr)
+             ? 0
+             : -1;
+}
+
+}  // extern "C"

@@ -8,8 +8,16 @@ fleet/runtime/the_one_ps.py:434).
 
 This build: the same wire protocol shape (push/pull dense + sparse,
 sync/async/geo modes, id-sharded tables across servers) over a
-length-prefixed socket RPC. The transport is Python; the table math is
-numpy — PS mode is a CPU-side capability (huge sparse embeddings), the
+length-prefixed socket RPC. Two transports share one client surface:
+
+- ``PSServer``/``PSClient`` — Python sockets + pickle; hosts every table
+  kind including the sqlite-backed ``SSDSparseTable``.
+- ``NativePSServer``/``NativePSClient`` — the C++ service
+  (native/pt_ps.cc): binary protocol, threaded POSIX-socket server,
+  dense SGD/Adam + sparse SGD/Adagrad/geo-delta applied in C++ (the
+  brpc_ps_server.cc equivalent; no pickle on the hot path).
+
+PS mode is a CPU-side capability (huge sparse embeddings); the
 TPU-native mainline is the collective path. Protocol constants mirror
 distributed/ps.proto.
 """
@@ -428,7 +436,11 @@ class PSClient:
         return resp
 
     def _dense_server(self, table: str) -> int:
-        return hash(table) % len(self.endpoints)
+        # stable across processes (built-in hash() is salted per process,
+        # which would route the same table to different servers on
+        # different trainers)
+        import zlib
+        return zlib.crc32(table.encode()) % len(self.endpoints)
 
     def push_dense_init(self, table: str, value: np.ndarray) -> None:
         self._call(self._dense_server(table),
@@ -632,3 +644,222 @@ class AsyncCommunicator:
         if self._thread:
             self._thread.join(timeout=5)
         self.flush()
+
+
+class NativePSServer:
+    """C++ PS service (native/pt_ps.cc): POSIX-socket transport, binary
+    protocol, table math (dense SGD/Adam, sparse SGD/Adagrad, geo deltas)
+    applied in C++ — the brpc_ps_server.cc equivalent. Same surface as
+    PSServer for in-memory tables; SSD/sqlite tables stay on the Python
+    server."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from .. import native
+        lib = native.get_lib()
+        if lib is None or not hasattr(lib, "pt_ps_server_create"):
+            raise RuntimeError("native PS transport unavailable "
+                               "(toolchain missing?)")
+        self._lib = lib
+        self._h = lib.pt_ps_server_create()
+        self.host = host
+        self._port_req = port
+        self._dense_sizes: Dict[str, Tuple[int, ...]] = {}
+        self._started = False
+
+    def add_dense_table(self, name: str, shape, optimizer: str = "sgd",
+                        lr: float = 0.01, beta1=0.9, beta2=0.999,
+                        eps=1e-8) -> None:
+        shape = tuple(int(s) for s in np.atleast_1d(shape))
+        size = int(np.prod(shape))
+        self._dense_sizes[name] = shape
+        self._lib.pt_ps_server_add_dense(
+            self._h, name.encode(), size,
+            1 if optimizer == "adam" else 0, lr, beta1, beta2, eps)
+
+    def add_sparse_table(self, name: str, emb_dim: int, lr: float = 0.01,
+                         initializer_std: float = 0.01,
+                         optimizer: str = "adagrad", seed: int = 0) -> None:
+        self._lib.pt_ps_server_add_sparse(
+            self._h, name.encode(), int(emb_dim),
+            1 if optimizer == "adagrad" else 0, lr, initializer_std,
+            int(seed))
+
+    def start(self) -> None:
+        rc = self._lib.pt_ps_server_start(self._h, self.host.encode(),
+                                          self._port_req)
+        if rc != 0:
+            raise RuntimeError("native PS server failed to bind")
+        self.port = self._lib.pt_ps_server_port(self._h)
+        self._started = True
+
+    def dense_value(self, name: str) -> np.ndarray:
+        import ctypes
+        shape = self._dense_sizes[name]
+        out = np.empty(int(np.prod(shape)), np.float32)
+        rc = self._lib.pt_ps_server_dense_read(
+            self._h, name.encode(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size)
+        if rc != 0:
+            raise KeyError(name)
+        return out.reshape(shape)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.pt_ps_server_stop(self._h)
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.pt_ps_server_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+class NativePSClient:
+    """C++-transport client with the PSClient surface (sparse keys shard
+    by key % n_servers; dense tables on a table-hash server) — works as a
+    drop-in for GeoCommunicator/AsyncCommunicator."""
+
+    def __init__(self, endpoints: Sequence[str]):
+        import ctypes
+        from .. import native
+        lib = native.get_lib()
+        if lib is None or not hasattr(lib, "pt_ps_connect"):
+            raise RuntimeError("native PS transport unavailable")
+        self._lib = lib
+        self._ct = ctypes
+        self.endpoints = list(endpoints)
+        self._conns = []
+        for ep in self.endpoints:
+            host, _, port = ep.partition(":")
+            c = lib.pt_ps_connect(host.encode(), int(port))
+            if not c:
+                raise ConnectionError(f"cannot connect to PS {ep}")
+            self._conns.append(c)
+        self._dims: Dict[str, int] = {}
+        self._dense_sizes: Dict[str, int] = {}
+
+    def _fp(self, arr: np.ndarray):
+        return arr.ctypes.data_as(self._ct.POINTER(self._ct.c_float))
+
+    def _kp(self, arr: np.ndarray):
+        return arr.ctypes.data_as(self._ct.POINTER(self._ct.c_int64))
+
+    def _dense_server(self, table: str) -> int:
+        # stable across processes (built-in hash() is salted per process,
+        # which would route the same table to different servers on
+        # different trainers)
+        import zlib
+        return zlib.crc32(table.encode()) % len(self.endpoints)
+
+    def _dim(self, table: str) -> int:
+        d = self._dims.get(table)
+        if d is None:
+            d = int(self._lib.pt_ps_table_dim(self._conns[0],
+                                              table.encode()))
+            if d <= 0:
+                raise KeyError(f"unknown sparse table {table!r}")
+            self._dims[table] = d
+        return d
+
+    def push_dense_init(self, table: str, value: np.ndarray) -> None:
+        v = np.ascontiguousarray(value, np.float32)
+        self._dense_sizes[table] = v.size
+        rc = self._lib.pt_ps_push_dense(
+            self._conns[self._dense_server(table)], table.encode(),
+            self._fp(v), v.size, 1)
+        if rc != 0:
+            raise RuntimeError(f"push_dense_init {table} failed")
+
+    def push_dense_grad(self, table: str, grad: np.ndarray) -> None:
+        g = np.ascontiguousarray(grad, np.float32)
+        self._dense_sizes.setdefault(table, g.size)
+        rc = self._lib.pt_ps_push_dense(
+            self._conns[self._dense_server(table)], table.encode(),
+            self._fp(g), g.size, 0)
+        if rc != 0:
+            raise RuntimeError(f"push_dense_grad {table} failed")
+
+    def pull_dense(self, table: str, size: Optional[int] = None
+                   ) -> np.ndarray:
+        n = size or self._dense_sizes.get(table)
+        if n is None:
+            raise KeyError(f"dense table {table!r}: size unknown — pass "
+                           "size= or push first")
+        out = np.empty(int(n), np.float32)
+        rc = self._lib.pt_ps_pull_dense(
+            self._conns[self._dense_server(table)], table.encode(),
+            self._fp(out), out.size)
+        if rc != 0:
+            raise RuntimeError(f"pull_dense {table} failed")
+        return out
+
+    def pull_sparse(self, table: str, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(np.asarray(keys, np.int64).ravel())
+        n = len(self.endpoints)
+        dim = self._dim(table)
+        full = np.zeros((keys.size, dim), np.float32)
+        for srv in range(n):
+            mask = (keys % n) == srv
+            if not mask.any():
+                continue
+            sub = np.ascontiguousarray(keys[mask])
+            out = np.empty((sub.size, dim), np.float32)
+            rc = self._lib.pt_ps_pull_sparse(
+                self._conns[srv], table.encode(), self._kp(sub), sub.size,
+                self._fp(out), dim)
+            if rc != 0:
+                raise RuntimeError(f"pull_sparse {table} failed")
+            full[mask] = out
+        return full
+
+    def _push_sparse(self, table: str, keys, grads, delta: int) -> None:
+        keys = np.ascontiguousarray(np.asarray(keys, np.int64).ravel())
+        grads = np.ascontiguousarray(grads, np.float32)
+        n = len(self.endpoints)
+        dim = self._dim(table)
+        for srv in range(n):
+            mask = (keys % n) == srv
+            if not mask.any():
+                continue
+            sub = np.ascontiguousarray(keys[mask])
+            g = np.ascontiguousarray(grads[mask])
+            rc = self._lib.pt_ps_push_sparse(
+                self._conns[srv], table.encode(), self._kp(sub), sub.size,
+                self._fp(g), dim, delta)
+            if rc != 0:
+                raise RuntimeError(f"push_sparse {table} failed")
+
+    def push_sparse_grad(self, table, keys, grads) -> None:
+        self._push_sparse(table, keys, grads, 0)
+
+    def push_sparse_delta(self, table, keys, deltas) -> None:
+        self._push_sparse(table, keys, deltas, 1)
+
+    def sparse_size(self, table: str) -> int:
+        return int(self._lib.pt_ps_sparse_size(self._conns[0],
+                                               table.encode()))
+
+    def barrier(self) -> None:
+        for c in self._conns:
+            self._lib.pt_ps_barrier(c)
+
+    def close(self) -> None:
+        """Disconnect without stopping the servers."""
+        for c in self._conns:
+            self._lib.pt_ps_disconnect(c)
+        self._conns = []
+
+    def stop(self) -> None:
+        for c in self._conns:
+            try:
+                self._lib.pt_ps_stop_server(c)
+            except Exception:
+                pass
+            self._lib.pt_ps_disconnect(c)
+        self._conns = []

@@ -18,6 +18,7 @@ import numpy as np
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_REPO_ROOT, "native", "ptnative.cc")
+_SRC_PS = os.path.join(_REPO_ROOT, "native", "pt_ps.cc")
 _LIB_PATH = os.path.join(_REPO_ROOT, "native", "libptnative.so")
 
 _lib = None
@@ -27,7 +28,7 @@ _build_failed = False
 
 def _build() -> Optional[str]:
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           _SRC, "-o", _LIB_PATH, "-lpthread", "-lrt"]
+           _SRC, _SRC_PS, "-o", _LIB_PATH, "-lpthread", "-lrt"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return _LIB_PATH
@@ -42,9 +43,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _build_failed:
             return _lib
         path = _LIB_PATH
-        if not os.path.exists(path) or (
-                os.path.exists(_SRC) and
-                os.path.getmtime(_SRC) > os.path.getmtime(path)):
+        stale = not os.path.exists(path) or any(
+            os.path.exists(s) and os.path.getmtime(s) > os.path.getmtime(path)
+            for s in (_SRC, _SRC_PS))
+        if stale:
             path = _build()
         if path is None or not os.path.exists(path):
             _build_failed = True
@@ -79,6 +81,54 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_uint64]
+        # --- parameter-server transport (native/pt_ps.cc) ---
+        fp = ctypes.POINTER(ctypes.c_float)
+        kp = ctypes.POINTER(ctypes.c_int64)
+        lib.pt_ps_server_create.restype = ctypes.c_void_p
+        lib.pt_ps_server_add_dense.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float]
+        lib.pt_ps_server_add_sparse.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_uint64]
+        lib.pt_ps_server_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.c_int]
+        lib.pt_ps_server_start.restype = ctypes.c_int
+        lib.pt_ps_server_port.argtypes = [ctypes.c_void_p]
+        lib.pt_ps_server_port.restype = ctypes.c_int
+        lib.pt_ps_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pt_ps_server_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_ps_server_dense_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, fp, ctypes.c_uint64]
+        lib.pt_ps_server_dense_read.restype = ctypes.c_int
+        lib.pt_ps_server_sparse_size.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_char_p]
+        lib.pt_ps_server_sparse_size.restype = ctypes.c_int64
+        lib.pt_ps_connect.restype = ctypes.c_void_p
+        lib.pt_ps_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.pt_ps_disconnect.argtypes = [ctypes.c_void_p]
+        lib.pt_ps_pull_dense.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         fp, ctypes.c_uint64]
+        lib.pt_ps_pull_dense.restype = ctypes.c_int
+        lib.pt_ps_push_dense.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         fp, ctypes.c_uint64, ctypes.c_int]
+        lib.pt_ps_push_dense.restype = ctypes.c_int
+        lib.pt_ps_pull_sparse.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          kp, ctypes.c_uint64, fp,
+                                          ctypes.c_int]
+        lib.pt_ps_pull_sparse.restype = ctypes.c_int
+        lib.pt_ps_push_sparse.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          kp, ctypes.c_uint64, fp,
+                                          ctypes.c_int, ctypes.c_int]
+        lib.pt_ps_push_sparse.restype = ctypes.c_int
+        lib.pt_ps_table_dim.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pt_ps_table_dim.restype = ctypes.c_int64
+        lib.pt_ps_sparse_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pt_ps_sparse_size.restype = ctypes.c_int64
+        lib.pt_ps_barrier.argtypes = [ctypes.c_void_p]
+        lib.pt_ps_barrier.restype = ctypes.c_int
+        lib.pt_ps_stop_server.argtypes = [ctypes.c_void_p]
+        lib.pt_ps_stop_server.restype = ctypes.c_int
         _lib = lib
         return _lib
 
