@@ -1,0 +1,256 @@
+// C inference API over the AOT predictor.
+//
+// Reference parity: paddle/fluid/inference/capi_exp/ (pd_config.h,
+// pd_predictor.h, pd_tensor.h) exposes AnalysisPredictor to C/C++/Go
+// deployments. TPU-native equivalent: this library embeds CPython and
+// drives paddle_tpu.inference (StableHLO artifact -> XLA AOT compile);
+// payloads cross as raw bytes + shape/dtype via
+// paddle_tpu/inference/capi_bridge.py, so no numpy C headers are
+// needed and the ABI below is pure C.
+//
+// Build (see paddle_tpu/native.py build_capi):
+//   g++ -O2 -shared -fPIC pt_capi.cc -I<python-include> \
+//       -L<python-libdir> -lpython3.12 -o libpt_infer.so
+//
+// Threading: every entry point takes the GIL via PyGILState_Ensure, so
+// the API may be called from any thread of the host program.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      g_last_error = PyUnicode_AsUTF8(s) ? PyUnicode_AsUTF8(s) : "error";
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error = "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+PyObject* bridge_module() {
+  // one-time interpreter bootstrap, serialized so concurrent first calls
+  // from different host threads cannot race Py_InitializeEx; afterwards
+  // callers only need the GIL
+  static std::mutex boot_mu;
+  static PyObject* mod = nullptr;
+  std::lock_guard<std::mutex> lk(boot_mu);
+  if (mod) return mod;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL acquired by initialization so PyGILState_Ensure
+    // works uniformly from any thread (including this one)
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  mod = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+  if (!mod) set_error_from_python();
+  PyGILState_Release(g);
+  return mod;
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+extern "C" {
+
+typedef struct PD_Config {
+  std::string prefix;
+  std::string precision = "float32";
+  std::string device = "auto";
+} PD_Config;
+
+typedef struct PD_Predictor {
+  long handle = 0;
+  // cached names (bytes owned here so returned pointers stay valid)
+  std::string scratch;
+} PD_Predictor;
+
+const char* PD_GetLastError() { return g_last_error.c_str(); }
+
+PD_Config* PD_ConfigCreate() { return new PD_Config(); }
+
+void PD_ConfigSetModel(PD_Config* c, const char* prefix) {
+  c->prefix = prefix;
+}
+
+void PD_ConfigSetPrecision(PD_Config* c, const char* precision) {
+  c->precision = precision;
+}
+
+void PD_ConfigDisableGpu(PD_Config* c) { c->device = "cpu"; }
+
+void PD_ConfigDestroy(PD_Config* c) { delete c; }
+
+PD_Predictor* PD_PredictorCreate(PD_Config* c) {
+  PyObject* mod = bridge_module();
+  if (!mod) return nullptr;
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(mod, "create", "sss", c->prefix.c_str(),
+                                    c->precision.c_str(),
+                                    c->device.c_str());
+  if (!r) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PD_Predictor* p = new PD_Predictor();
+  p->handle = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return p;
+}
+
+static int get_names(PD_Predictor* p, const char* method, int index,
+                     char* buf, int buflen) {
+  // returns the number of names; if index >= 0 also copies that name
+  PyObject* mod = bridge_module();
+  if (!mod) return -1;
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(mod, method, "l", p->handle);
+  if (!r) {
+    set_error_from_python();
+    return -1;
+  }
+  int n = static_cast<int>(PyList_Size(r));
+  if (index >= 0 && index < n && buf) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, index));
+    std::snprintf(buf, buflen, "%s", s ? s : "");
+  }
+  Py_DECREF(r);
+  return n;
+}
+
+int PD_PredictorGetInputNum(PD_Predictor* p) {
+  return get_names(p, "input_names", -1, nullptr, 0);
+}
+
+int PD_PredictorGetInputName(PD_Predictor* p, int i, char* buf,
+                             int buflen) {
+  int n = get_names(p, "input_names", i, buf, buflen);
+  return (n > i && i >= 0) ? 0 : -1;
+}
+
+int PD_PredictorSetInput(PD_Predictor* p, const char* name,
+                         const void* data, const int64_t* shape, int ndim,
+                         const char* dtype) {
+  PyObject* mod = bridge_module();
+  if (!mod) return -1;
+  Gil gil;
+  int64_t elems = 1;
+  for (int i = 0; i < ndim; ++i) elems *= shape[i];
+  int64_t esize = 4;
+  if (std::strcmp(dtype, "int64") == 0) esize = 8;
+  if (std::strcmp(dtype, "float16") == 0) esize = 2;
+  if (std::strcmp(dtype, "uint8") == 0 || std::strcmp(dtype, "bool") == 0)
+    esize = 1;
+  PyObject* tup = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SetItem(tup, i, PyLong_FromLongLong(shape[i]));
+  PyObject* r = PyObject_CallMethod(
+      mod, "set_input", "lsy#Os", p->handle, name,
+      static_cast<const char*>(data),
+      static_cast<Py_ssize_t>(elems * esize), tup, dtype);
+  Py_DECREF(tup);
+  if (!r) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int PD_PredictorRun(PD_Predictor* p) {
+  PyObject* mod = bridge_module();
+  if (!mod) return -1;
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(mod, "run", "l", p->handle);
+  if (!r) {
+    set_error_from_python();
+    return -1;
+  }
+  int n = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return n;  // number of outputs
+}
+
+int PD_PredictorGetOutputNum(PD_Predictor* p) {
+  return get_names(p, "output_names", -1, nullptr, 0);
+}
+
+int PD_PredictorGetOutputName(PD_Predictor* p, int i, char* buf,
+                              int buflen) {
+  int n = get_names(p, "output_names", i, buf, buflen);
+  return (n > i && i >= 0) ? 0 : -1;
+}
+
+// Query output i: writes up to *ndim dims into shape, sets *ndim to the
+// actual rank, copies up to bufbytes of data into buf (pass buf=NULL to
+// only query shape/size). Returns total byte size of the output, or -1.
+int64_t PD_PredictorGetOutput(PD_Predictor* p, const char* name,
+                              void* buf, int64_t bufbytes, int64_t* shape,
+                              int* ndim, char* dtype_buf,
+                              int dtype_buflen) {
+  PyObject* mod = bridge_module();
+  if (!mod) return -1;
+  Gil gil;
+  PyObject* r =
+      PyObject_CallMethod(mod, "get_output", "ls", p->handle, name);
+  if (!r) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* bytes = PyTuple_GetItem(r, 0);
+  PyObject* shp = PyTuple_GetItem(r, 1);
+  PyObject* dt = PyTuple_GetItem(r, 2);
+  const int rank = static_cast<int>(PyTuple_Size(shp));
+  if (shape && ndim) {
+    for (int i = 0; i < rank && i < *ndim; ++i)
+      shape[i] = PyLong_AsLongLong(PyTuple_GetItem(shp, i));
+  }
+  if (ndim) *ndim = rank;
+  if (dtype_buf) {
+    const char* s = PyUnicode_AsUTF8(dt);
+    std::snprintf(dtype_buf, dtype_buflen, "%s", s ? s : "");
+  }
+  char* raw = nullptr;
+  Py_ssize_t nbytes = 0;
+  PyBytes_AsStringAndSize(bytes, &raw, &nbytes);
+  if (buf && raw) std::memcpy(buf, raw, std::min<int64_t>(bufbytes, nbytes));
+  Py_DECREF(r);
+  return static_cast<int64_t>(nbytes);
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (!p) return;
+  PyObject* mod = bridge_module();
+  if (mod) {
+    Gil gil;
+    PyObject* r = PyObject_CallMethod(mod, "destroy", "l", p->handle);
+    Py_XDECREF(r);
+    if (!r) PyErr_Clear();
+  }
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,81 @@
+"""Marshalling bridge for the C inference API (native/pt_capi.cc).
+
+Reference parity: paddle/fluid/inference/capi_exp/ wraps
+AnalysisPredictor behind a C ABI for deployment from C/C++/Go. Here the
+C library embeds CPython and calls these helpers; payloads cross the
+boundary as raw bytes + (shape, dtype) so the C side needs no numpy
+headers.
+
+Everything is keyed by integer handles so the C side holds no Python
+pointers beyond the module itself.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+_handles: Dict[int, "object"] = {}
+_ids = itertools.count(1)
+
+_DTYPES = {"float32": np.float32, "float16": np.float16,
+           "int32": np.int32, "int64": np.int64, "uint8": np.uint8,
+           "bool": np.bool_}
+
+
+def create(prefix: str, precision: str = "float32",
+           device: str = "auto") -> int:
+    if device == "cpu":
+        # a C host cannot set JAX_PLATFORMS after process start; honor
+        # PD_ConfigDisableGpu here, before the first backend touch
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already initialized; keep it
+    from .predictor import Config, Predictor
+    cfg = Config(prefix)
+    cfg.set_precision(precision)
+    if device == "cpu":
+        cfg.disable_gpu()
+    h = next(_ids)
+    _handles[h] = Predictor(cfg)
+    return h
+
+
+def _p(h: int):
+    p = _handles.get(h)
+    if p is None:
+        raise KeyError(f"invalid predictor handle {h}")
+    return p
+
+
+def input_names(h: int) -> List[str]:
+    return _p(h).get_input_names()
+
+
+def set_input(h: int, name: str, data: bytes, shape: Tuple[int, ...],
+              dtype: str) -> None:
+    arr = np.frombuffer(data, _DTYPES[dtype]).reshape(shape)
+    _p(h).get_input_handle(name).copy_from_cpu(arr)
+
+
+def run(h: int) -> int:
+    p = _p(h)
+    p.run()
+    return len(p.get_output_names())
+
+
+def output_names(h: int) -> List[str]:
+    return _p(h).get_output_names()
+
+
+def get_output(h: int, name: str) -> Tuple[bytes, Tuple[int, ...], str]:
+    arr = np.ascontiguousarray(_p(h).get_output_handle(name).copy_to_cpu())
+    return arr.tobytes(), tuple(int(s) for s in arr.shape), str(arr.dtype)
+
+
+def destroy(h: int) -> None:
+    _handles.pop(h, None)

@@ -87,15 +87,11 @@ class Predictor:
         from ..static.program import LoadedProgram
 
         self.config = config
-        self._program = LoadedProgram(config.model_path_prefix)
-        if config._precision in (PrecisionType.Bfloat16,
-                                 PrecisionType.Half):
-            dt = jnp.bfloat16 if config._precision == \
-                PrecisionType.Bfloat16 else jnp.float16
-            self._program.params = {
-                k: (v.astype(dt) if jnp.issubdtype(v.dtype, jnp.floating)
-                    else v)
-                for k, v in self._program.params.items()}
+        # low-precision serving: params held in bf16/f16 (HBM footprint/
+        # bandwidth win), cast back to the artifact signature inside the
+        # jitted call where XLA fuses the casts
+        self._program = LoadedProgram(config.model_path_prefix,
+                                      precision=config._precision)
         self._input_names = [
             s.name or f"x{i}"
             for i, s in enumerate(self._program.input_specs)]

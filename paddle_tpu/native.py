@@ -137,6 +137,49 @@ def available() -> bool:
     return get_lib() is not None
 
 
+_CAPI_SRC = os.path.join(_REPO_ROOT, "native", "pt_capi.cc")
+_CAPI_LIB = os.path.join(_REPO_ROOT, "native", "libpt_infer.so")
+
+
+_capi_lock = threading.Lock()
+
+
+def _capi_loadable() -> bool:
+    try:
+        ctypes.CDLL(_CAPI_LIB)
+        return True
+    except OSError:
+        return False
+
+
+def build_capi() -> Optional[str]:
+    """Build the C inference API (native/pt_capi.cc -> libpt_infer.so),
+    the capi_exp-equivalent deployment library. Returns the .so path or
+    None if the toolchain is unavailable."""
+    import sysconfig
+    with _capi_lock:
+        fresh = (os.path.exists(_CAPI_LIB) and os.path.exists(_CAPI_SRC)
+                 and os.path.getmtime(_CAPI_SRC) <=
+                 os.path.getmtime(_CAPI_LIB))
+        # a stale-or-foreign cached lib (e.g. linked against another
+        # libpython) must be rebuilt, not returned
+        if fresh and _capi_loadable():
+            return _CAPI_LIB
+        inc = sysconfig.get_paths()["include"]
+        libdir = sysconfig.get_config_var("LIBDIR")
+        pyver = f"python{sysconfig.get_config_var('py_version_short')}"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _CAPI_SRC,
+               f"-I{inc}", f"-L{libdir}", f"-l{pyver}",
+               f"-Wl,-rpath,{libdir}", "-o", _CAPI_LIB]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=180)
+            return _CAPI_LIB if _capi_loadable() else None
+        except (subprocess.CalledProcessError, FileNotFoundError,
+                subprocess.TimeoutExpired):
+            return None
+
+
 class ShmQueue:
     """Shared-memory ring buffer for raw byte payloads (multiprocess
     DataLoader transport)."""

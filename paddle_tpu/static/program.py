@@ -101,9 +101,17 @@ class Program:
 
 
 class LoadedProgram:
-    """Program deserialized from a .pdmodel StableHLO artifact."""
+    """Program deserialized from a .pdmodel StableHLO artifact.
 
-    def __init__(self, path_prefix: str):
+    ``precision``: None/"float32" keeps the exported dtypes; "bfloat16"/
+    "float16" stores floating params in low precision — the serving win
+    on TPU is HBM footprint/bandwidth (f32 matmuls already run bf16
+    multiplier passes on the MXU) — and casts back to the artifact's
+    rigid signature dtypes at the call boundary, where XLA fuses the
+    casts into the consumers.
+    """
+
+    def __init__(self, path_prefix: str, precision: Optional[str] = None):
         from jax import export as jexport
         with open(path_prefix + ".pdmodel", "rb") as f:
             self.exported = jexport.deserialize(f.read())
@@ -115,7 +123,22 @@ class LoadedProgram:
         self.input_specs = [InputSpec(s, d, n)
                             for s, d, n in meta["input_specs"]]
         self.name = meta.get("name", "main")
-        self._call = jax.jit(self.exported.call)
+        self._orig_dtypes = {k: v.dtype for k, v in self.params.items()}
+        if precision in ("bfloat16", "float16"):
+            low = jnp.bfloat16 if precision == "bfloat16" else jnp.float16
+            self.params = {
+                k: (v.astype(low)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in self.params.items()}
+        exported = self.exported
+        orig = self._orig_dtypes
+
+        def call_with_signature_dtypes(params, *xs):
+            restored = {k: (v.astype(orig[k]) if v.dtype != orig[k] else v)
+                        for k, v in params.items()}
+            return exported.call(restored, *xs)
+
+        self._call = jax.jit(call_with_signature_dtypes)
 
     def run(self, *inputs):
         raw = [i.value if isinstance(i, Tensor) else jnp.asarray(i)

@@ -1,0 +1,173 @@
+"""C inference API tests (native/pt_capi.cc, the capi_exp equivalent).
+
+A real C program is compiled with g++ and linked against libpt_infer.so;
+it loads a saved inference model, runs it, and prints the output, which
+is compared against the in-process Python predictor.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import native
+
+CAPI_LIB = native.build_capi()
+
+pytestmark = pytest.mark.skipif(CAPI_LIB is None,
+                                reason="C toolchain unavailable")
+
+_C_PROGRAM = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <stdint.h>
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+extern PD_Config* PD_ConfigCreate();
+extern void PD_ConfigSetModel(PD_Config*, const char*);
+extern void PD_ConfigDisableGpu(PD_Config*);
+extern void PD_ConfigDestroy(PD_Config*);
+extern PD_Predictor* PD_PredictorCreate(PD_Config*);
+extern int PD_PredictorGetInputNum(PD_Predictor*);
+extern int PD_PredictorGetInputName(PD_Predictor*, int, char*, int);
+extern int PD_PredictorSetInput(PD_Predictor*, const char*, const void*,
+                                const int64_t*, int, const char*);
+extern int PD_PredictorRun(PD_Predictor*);
+extern int PD_PredictorGetOutputNum(PD_Predictor*);
+extern int PD_PredictorGetOutputName(PD_Predictor*, int, char*, int);
+extern int64_t PD_PredictorGetOutput(PD_Predictor*, const char*, void*,
+                                     int64_t, int64_t*, int*, char*, int);
+extern const char* PD_GetLastError();
+extern void PD_PredictorDestroy(PD_Predictor*);
+
+int main(int argc, char** argv) {
+  PD_Config* cfg = PD_ConfigCreate();
+  PD_ConfigSetModel(cfg, argv[1]);
+  PD_ConfigDisableGpu(cfg);
+  PD_Predictor* pred = PD_PredictorCreate(cfg);
+  if (!pred) { fprintf(stderr, "create: %s\n", PD_GetLastError()); return 1; }
+
+  int n_in = PD_PredictorGetInputNum(pred);
+  char name[128];
+  if (PD_PredictorGetInputName(pred, 0, name, sizeof(name)) != 0) return 2;
+
+  int64_t shape[2] = {2, 8};
+  float x[16];
+  for (int i = 0; i < 16; ++i) x[i] = 0.125f * (float)i;
+  if (PD_PredictorSetInput(pred, name, x, shape, 2, "float32") != 0) {
+    fprintf(stderr, "set_input: %s\n", PD_GetLastError()); return 3;
+  }
+  int n_out = PD_PredictorRun(pred);
+  if (n_out < 1) { fprintf(stderr, "run: %s\n", PD_GetLastError()); return 4; }
+
+  char oname[128];
+  if (PD_PredictorGetOutputName(pred, 0, oname, sizeof(oname)) != 0) return 5;
+  int64_t oshape[8];
+  int ndim = 8;
+  char dtype[32];
+  int64_t nbytes = PD_PredictorGetOutput(pred, oname, NULL, 0, oshape,
+                                         &ndim, dtype, sizeof(dtype));
+  if (nbytes <= 0) { fprintf(stderr, "shape: %s\n", PD_GetLastError()); return 6; }
+  float* out = (float*)malloc((size_t)nbytes);
+  PD_PredictorGetOutput(pred, oname, out, nbytes, oshape, &ndim, dtype,
+                        sizeof(dtype));
+
+  printf("{\"n_in\": %d, \"n_out\": %d, \"ndim\": %d, \"shape\": [", n_in,
+         n_out, ndim);
+  for (int i = 0; i < ndim; ++i)
+    printf("%s%lld", i ? ", " : "", (long long)oshape[i]);
+  printf("], \"dtype\": \"%s\", \"data\": [", dtype);
+  int64_t n = nbytes / 4;
+  for (int64_t i = 0; i < n; ++i)
+    printf("%s%.6f", i ? ", " : "", (double)out[i]);
+  printf("]}\n");
+  free(out);
+  PD_PredictorDestroy(pred);
+  PD_ConfigDestroy(cfg);
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    """Save a small MLP inference model and return (prefix, ref_out)."""
+    import jax
+    from paddle_tpu import nn, static
+
+    pt.seed(0)
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.fc2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F
+            return F.softmax(self.fc2(F.relu(self.fc1(x))), axis=-1)
+
+    model = MLP()
+    model.eval()
+    prefix = str(tmp_path_factory.mktemp("capi") / "mlp")
+    static.save_inference_model(
+        prefix, [static.InputSpec((2, 8), "float32", "x")], layer=model)
+
+    x = (0.125 * np.arange(16, dtype=np.float32)).reshape(2, 8)
+    from paddle_tpu.inference import Config, create_predictor
+    cfg = Config(prefix)
+    cfg.disable_gpu()
+    pred = create_predictor(cfg)
+    (ref,) = pred.run([x])
+    return prefix, np.asarray(ref)
+
+
+def test_c_program_matches_python_predictor(saved_model, tmp_path):
+    prefix, ref = saved_model
+    csrc = tmp_path / "consumer.c"
+    csrc.write_text(_C_PROGRAM)
+    exe = tmp_path / "consumer"
+    libdir = sysconfig.get_config_var("LIBDIR")
+    subprocess.run(
+        ["gcc", str(csrc), "-o", str(exe), f"-L{os.path.dirname(CAPI_LIB)}",
+         "-lpt_infer", f"-Wl,-rpath,{os.path.dirname(CAPI_LIB)}",
+         f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    # the embedded interpreter must run on CPU regardless of the axon
+    # TPU plugin the container pins
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([str(exe), prefix], capture_output=True, text=True,
+                       timeout=300, env=env)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["n_in"] == 1 and out["n_out"] >= 1
+    assert out["shape"] == [2, 4] and out["dtype"] == "float32"
+    np.testing.assert_allclose(
+        np.asarray(out["data"], np.float32).reshape(2, 4), ref,
+        rtol=1e-4, atol=1e-5)
+
+
+def test_c_api_error_surface(tmp_path):
+    """Invalid model path must yield a clean error, not a crash."""
+    import ctypes
+    lib = ctypes.CDLL(CAPI_LIB)
+    lib.PD_ConfigCreate.restype = ctypes.c_void_p
+    lib.PD_ConfigSetModel.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.PD_PredictorCreate.argtypes = [ctypes.c_void_p]
+    lib.PD_PredictorCreate.restype = ctypes.c_void_p
+    lib.PD_GetLastError.restype = ctypes.c_char_p
+    cfg = lib.PD_ConfigCreate()
+    lib.PD_ConfigSetModel(cfg, str(tmp_path / "nope").encode())
+    pred = lib.PD_PredictorCreate(cfg)
+    assert not pred
+    assert lib.PD_GetLastError()
