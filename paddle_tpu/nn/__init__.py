@@ -19,9 +19,9 @@ from .conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D)
 from .initializer import ParamAttr
 from .layer import (Layer, bind_state, functional_call, functional_state)
 from .loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
-                   CrossEntropyLoss, HingeEmbeddingLoss, KLDivLoss, L1Loss,
-                   MarginRankingLoss, MSELoss, NLLLoss, SmoothL1Loss,
-                   TripletMarginLoss)
+                   CrossEntropyLoss, CTCLoss, HingeEmbeddingLoss,
+                   HSigmoidLoss, KLDivLoss, L1Loss, MarginRankingLoss,
+                   MSELoss, NLLLoss, SmoothL1Loss, TripletMarginLoss)
 from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                    GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
                    LayerNorm, LocalResponseNorm, RMSNorm, SyncBatchNorm)

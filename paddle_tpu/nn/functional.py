@@ -36,6 +36,12 @@ _NN_OPS = [
     "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
     "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
     "square_error_cost", "log_loss", "sigmoid_focal_loss",
+    # extended loss family (ops/loss_extra.py)
+    "hinge_loss", "huber_loss", "modified_huber_loss", "rank_loss",
+    "margin_rank_loss", "bpr_loss", "teacher_student_sigmoid_loss",
+    "squared_l2_distance", "squared_l2_norm", "l1_norm", "cos_sim",
+    "dice_loss", "npair_loss", "center_loss", "ctc_loss", "nce",
+    "hsigmoid_loss", "sample_logits", "bce_loss", "kldiv_loss",
 ]
 
 for _name in _NN_OPS:

@@ -147,3 +147,46 @@ class TripletMarginLoss(Layer):
         return F["triplet_margin_loss"](anchor, positive, negative,
                                         self.margin, self.p, self.epsilon,
                                         self.swap, self.reduction)
+
+
+class CTCLoss(Layer):
+    """CTC loss layer (reference: python/paddle/nn/layer/loss.py CTCLoss
+    over operators/warpctc_op.cc; native log-space scan here)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F["ctc_loss"](log_probs, labels, input_lengths,
+                             label_lengths, blank=self.blank,
+                             reduction=self.reduction,
+                             norm_by_times=norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer with owned parameters
+    (reference: python/paddle/nn/layer/loss.py HSigmoidLoss over
+    operators/hierarchical_sigmoid_op.cc)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        # default SimpleCode tree touches internal nodes 0..num_classes-2
+        # (reference weight shape [num_classes-1, D]); custom trees index
+        # up to num_classes rows
+        n_nodes = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter((n_nodes, feature_size))
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter((n_nodes,), is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F["hsigmoid_loss"](
+            input, label, self.weight, self.bias,
+            num_classes=self.num_classes, path_table=path_table,
+            path_code=path_code)

@@ -8,7 +8,7 @@ eager dispatcher can enumerate them.
 
 import inspect as _inspect
 
-from . import creation, detection, linalg, manipulation, math, \
+from . import creation, detection, linalg, loss_extra, manipulation, math, \
     nn_functional, random, rnn, search, sequence
 from .registry import OpDef, all_ops, get_op, has_op, register_op
 
@@ -28,7 +28,7 @@ _NON_DIFF_OPS = {
 
 def _auto_register():
     for mod in (creation, math, manipulation, search, linalg, random,
-                nn_functional, rnn, sequence, detection):
+                nn_functional, rnn, sequence, detection, loss_extra):
         short = mod.__name__.rsplit(".", 1)[-1]
         for name, fn in vars(mod).items():
             if name.startswith("_") or not callable(fn):

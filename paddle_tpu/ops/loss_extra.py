@@ -1,0 +1,416 @@
+"""Extended loss / sampling-loss op family (pure functional).
+
+Reference parity for the loss kernels under paddle/fluid/operators/:
+hinge_loss_op.cc, rank_loss_op.cc, bpr_loss_op.cc, modified_huber_loss_op.cc,
+huber_loss_op.cc, center_loss_op.cc, teacher_student_sigmoid_loss_op.cc,
+squared_l2_distance_op.cc, squared_l2_norm_op.cc, l1_norm_op.cc,
+cos_sim_op.cc, warpctc_op.cc (CTC via external warpctc lib there; native
+log-space lax.scan here), nce_op.cc, hierarchical_sigmoid_op.cc,
+sample_logits_op.cc, and the python-side dice/npair losses
+(python/paddle/fluid/layers/nn.py). All are pure jax functions — safe under
+jit/grad — with NumPy-precomputed static metadata where the reference used
+host-side setup (hsigmoid code tables).
+"""
+
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .nn_functional import _reduce
+
+
+# --- simple pairwise / pointwise losses -------------------------------------
+
+def hinge_loss(logits, labels):
+    """L = max(0, 1 - y*x) with y in {-1, +1} (hinge_loss_op.cc)."""
+    return jnp.maximum(0.0, 1.0 - labels * logits)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):  # noqa: A002
+    """Quadratic within |r|<=delta, linear outside (huber_loss_op.cc)."""
+    r = jnp.abs(label - input)
+    loss = jnp.where(r <= delta, 0.5 * r * r, delta * (r - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+def modified_huber_loss(input, label):  # noqa: A002
+    """Binary-classification modified huber; label in {0,1} is scaled to
+    {-1,+1} (modified_huber_loss_op.cc)."""
+    y = 2.0 * label - 1.0
+    prod = y * input
+    return jnp.where(prod >= -1.0,
+                     jnp.square(jnp.maximum(0.0, 1.0 - prod)),
+                     -4.0 * prod)
+
+
+def rank_loss(label, left, right):
+    """RankNet pairwise loss C = -P*o + log(1+e^o), o = left - right
+    (rank_loss_op.cc)."""
+    o = left - right
+    return jnp.maximum(o, 0.0) - label * o + jnp.log1p(jnp.exp(-jnp.abs(o)))
+
+
+def margin_rank_loss(label, left, right, margin=0.1):
+    """max(0, -label*(left-right) + margin) (margin_rank_loss_op.cc)."""
+    return jnp.maximum(0.0, -label * (left - right) + margin)
+
+
+def bpr_loss(input, label):  # noqa: A002
+    """Bayesian personalized ranking: mean over j of
+    -log(sigmoid(x[label] - x[j])) (bpr_loss_op.cc)."""
+    x = input
+    n = x.shape[-1]
+    pos = jnp.take_along_axis(x, label.astype(jnp.int32).reshape(
+        x.shape[:-1] + (1,)), axis=-1)
+    diff = pos - x
+    # reference averages over all j != label
+    logsig = -jnp.log1p(jnp.exp(-diff))
+    mask = jnp.ones_like(x) - jax.nn.one_hot(
+        label.reshape(x.shape[:-1]), n, dtype=x.dtype)
+    return -(logsig * mask).sum(-1, keepdims=True) / jnp.maximum(n - 1, 1)
+
+
+def teacher_student_sigmoid_loss(x, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """CTR distillation loss (teacher_student_sigmoid_loss_op.cc):
+    label encodes click z and optional teacher score z'."""
+    x = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+    z = jnp.where(label < 0.0,  # {-2: z=0, -1: z=1}
+                  jnp.where(label < -1.5, 0.0, 1.0),
+                  jnp.where(label < 1.0, 0.0, 1.0))
+    has_teacher = label > -0.5
+    zp = jnp.where(has_teacher, label - z, 0.0)
+    ce = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    loss = (ce - x * z) + jnp.where(has_teacher, ce - x * zp, 0.0)
+    return loss
+
+
+def squared_l2_distance(x, y):
+    """Per-row 0.5-free squared L2 distance: sum((x-y)^2) per sample
+    (squared_l2_distance_op.cc). Returns (distance [N,1], sub)."""
+    sub = x - y
+    d = jnp.sum(jnp.square(sub).reshape(sub.shape[0], -1), axis=1,
+                keepdims=True)
+    return d, sub
+
+
+def squared_l2_norm(x):
+    """sum(x^2) over all elements (squared_l2_norm_op.cc)."""
+    return jnp.sum(jnp.square(x))
+
+
+def l1_norm(x):
+    """sum(|x|) over all elements (l1_norm_op.cc)."""
+    return jnp.sum(jnp.abs(x))
+
+
+def cos_sim(x, y):
+    """Row-wise cosine similarity with broadcastable y (cos_sim_op.cc)."""
+    xf = x.reshape(x.shape[0], -1)
+    yf = y.reshape(y.shape[0], -1)
+    xn = jnp.sqrt(jnp.sum(jnp.square(xf), axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(yf), axis=1, keepdims=True))
+    num = jnp.sum(xf * yf, axis=1, keepdims=True)
+    return num / jnp.maximum(xn * yn, 1e-12)
+
+
+def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
+    """Dice coefficient loss (fluid/layers/nn.py dice_loss)."""
+    label = jax.nn.one_hot(jnp.squeeze(label, -1).astype(jnp.int32),
+                           input.shape[-1], dtype=input.dtype)
+    reduce_axes = tuple(range(1, input.ndim))
+    inse = jnp.sum(input * label, axis=reduce_axes)
+    dice_denom = (jnp.sum(input, axis=reduce_axes)
+                  + jnp.sum(label, axis=reduce_axes))
+    dice = (2.0 * inse + epsilon) / (dice_denom + epsilon)
+    return jnp.mean(1.0 - dice)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric loss (fluid/layers/nn.py npair_loss)."""
+    labels = labels.reshape(-1, 1).astype(anchor.dtype)
+    same = (labels == labels.T).astype(anchor.dtype)
+    targets = same / jnp.maximum(jnp.sum(same, axis=1, keepdims=True), 1.0)
+    logits = anchor @ positive.T
+    logp = jax.nn.log_softmax(logits, axis=1)
+    xent = jnp.mean(-jnp.sum(targets * logp, axis=1))
+    reg = jnp.mean(jnp.sum(jnp.square(anchor), 1)
+                   + jnp.sum(jnp.square(positive), 1)) * (l2_reg * 0.25)
+    return xent + reg
+
+
+def center_loss(x, label, centers, alpha=0.1, update_centers=True):
+    """Center loss for deep face recognition (center_loss_op.cc).
+
+    Returns (per-sample loss [N,1], updated centers). Center update follows
+    the reference: delta for center c = sum over samples of (c - x) divided
+    by (1 + count(label==c)), scaled by alpha.
+    """
+    label = label.reshape(-1).astype(jnp.int32)
+    picked = centers[label]                      # [N, D]
+    diff = picked - x
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if not update_centers:
+        return loss, centers
+    num_classes = centers.shape[0]
+    counts = jnp.zeros((num_classes,), x.dtype).at[label].add(1.0)
+    accum = jnp.zeros_like(centers).at[label].add(diff)
+    new_centers = centers - alpha * accum / (1.0 + counts)[:, None]
+    return loss, new_centers
+
+
+# --- CTC (warpctc_op.cc equivalent, native log-space forward) ---------------
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Connectionist Temporal Classification loss.
+
+    TPU-native replacement for the reference's external warpctc binding
+    (paddle/fluid/operators/warpctc_op.cc, cmake/external/warpctc): the
+    forward alpha recursion runs as one lax.scan over time in log space —
+    static shapes, batched over examples — and the gradient falls out of
+    jax autodiff instead of a hand-written backward kernel.
+
+    Args:
+      log_probs: [T, N, C] log-softmax-normalized scores (time-major, as
+        the reference's Logits after softmax; pass raw logits and they are
+        normalized here).
+      labels: [N, S] int labels padded with any value (mask from lengths).
+      input_lengths: [N] valid time steps.
+      label_lengths: [N] valid label counts.
+      blank: blank index.
+    """
+    log_probs = jax.nn.log_softmax(log_probs, axis=-1)
+    T, N, _C = log_probs.shape
+    S = labels.shape[1]
+    labels = labels.astype(jnp.int32)
+    neg_inf = jnp.asarray(-1e30, log_probs.dtype)
+
+    # extended label sequence: blank l1 blank l2 ... lS blank  (len 2S+1)
+    ext = jnp.full((N, 2 * S + 1), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_valid = jnp.arange(2 * S + 1)[None, :] < (
+        2 * label_lengths[:, None] + 1)
+
+    # can we skip from s-2 to s? only if ext[s] != blank and != ext[s-2]
+    can_skip = jnp.zeros((N, 2 * S + 1), bool)
+    if S > 1:
+        skip = (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])
+        can_skip = can_skip.at[:, 2:].set(skip)
+    elif S == 1:
+        can_skip = can_skip.at[:, 2].set(ext[:, 2] != blank)
+
+    def emit(t_logp):  # [N, C] -> [N, 2S+1] scores of extended labels
+        return jnp.take_along_axis(t_logp, ext, axis=1)
+
+    alpha0 = jnp.full((N, 2 * S + 1), neg_inf)
+    e0 = emit(log_probs[0])
+    alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(
+        label_lengths > 0, e0[:, 1], neg_inf))
+
+    def step(alpha, t_logp):
+        from_self = alpha
+        from_prev = jnp.concatenate(
+            [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        from_skip = jnp.concatenate(
+            [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        from_skip = jnp.where(can_skip, from_skip, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(from_self, from_prev),
+                               from_skip)
+        new = merged + emit(t_logp)
+        new = jnp.where(ext_valid, new, neg_inf)
+        return new, new
+
+    _, alphas = jax.lax.scan(step, alpha0, log_probs[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, N, 2S+1]
+
+    # read alpha at t = input_length - 1, s in {2L, 2L-1}
+    t_idx = jnp.clip(input_lengths - 1, 0, T - 1)
+    final = alphas[t_idx, jnp.arange(N)]          # [N, 2S+1]
+    sL = 2 * label_lengths
+    a_blank = jnp.take_along_axis(final, sL[:, None], axis=1)[:, 0]
+    a_label = jnp.where(
+        label_lengths > 0,
+        jnp.take_along_axis(final, jnp.maximum(sL - 1, 0)[:, None],
+                            axis=1)[:, 0],
+        neg_inf)
+    ll = jnp.logaddexp(a_blank, a_label)
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths.astype(loss.dtype), 1.0)
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(
+            label_lengths.astype(loss.dtype), 1.0))
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+warpctc = ctc_loss
+
+
+# --- sampled softmax family -------------------------------------------------
+
+def _log_uniform_sample(key, num_samples, range_max):
+    """Log-uniform (Zipfian) candidate sampler, matching the reference's
+    LogUniformSampler (paddle/fluid/operators/math/sampler.cc)."""
+    u = jax.random.uniform(key, (num_samples,))
+    s = jnp.exp(u * _math.log(range_max + 1.0)) - 1.0
+    return jnp.clip(s.astype(jnp.int32), 0, range_max - 1)
+
+
+def _log_uniform_prob(ids, range_max):
+    ids = ids.astype(jnp.float32)
+    return jnp.log1p(1.0 / (ids + 1.0)) / _math.log(range_max + 1.0)
+
+
+def sample_logits(logits, label, num_samples, key, uniq=True,
+                  remove_accidental_hits=True):
+    """Sample negative classes and gather their logits for sampled softmax
+    (sample_logits_op.cc). Returns (sampled_logits [N, T+num_samples],
+    sampled_label [N, T], samples [T+num_samples])."""
+    n, _c = logits.shape
+    range_max = logits.shape[1]
+    label = label.astype(jnp.int32)
+    num_true = label.shape[1]
+    neg = _log_uniform_sample(key, num_samples, range_max)   # [num_samples]
+
+    true_logit = jnp.take_along_axis(logits, label, axis=1)  # [N, T]
+    neg_logit = logits[:, neg]                               # [N, S]
+
+    # subtract log expected-count correction (sampled-softmax math):
+    # with replacement E[count] = k*p; unique sampling E[count] = 1-(1-p)^k
+    # (the reference LogUniformSampler's unique formula). Sampling itself is
+    # with replacement either way (static shapes); uniq only switches the
+    # bias correction.
+    true_p = _log_uniform_prob(label, range_max)
+    neg_p = _log_uniform_prob(neg, range_max)[None, :]
+
+    def log_expected(p):
+        if uniq:
+            return jnp.log(jnp.maximum(-jnp.expm1(
+                num_samples * jnp.log1p(-p)), 1e-20))
+        return jnp.log(jnp.maximum(p * num_samples, 1e-20))
+
+    true_logit = true_logit - log_expected(true_p).astype(logits.dtype)
+    neg_logit = neg_logit - log_expected(neg_p).astype(logits.dtype)
+
+    if remove_accidental_hits:
+        hit = (neg[None, None, :] == label[:, :, None]).any(axis=1)
+        neg_logit = jnp.where(hit, -1e20, neg_logit)
+
+    sampled = jnp.concatenate([true_logit, neg_logit], axis=1)
+    sampled_label = jnp.tile(jnp.arange(num_true)[None, :], (n, 1))
+    return sampled, sampled_label, jnp.concatenate(
+        [jnp.zeros((num_true,), jnp.int32), neg])
+
+
+def nce(input, label, weight, bias=None, num_neg_samples=10, key=None,  # noqa: A002
+        sample_weight=None):
+    """Noise-contrastive estimation loss (nce_op.cc), log-uniform sampler.
+
+    input: [N, D]; label: [N, T]; weight: [C, D]; bias: [C].
+    Returns per-sample cost [N, 1].
+    """
+    if key is None:
+        from ..core.rng import next_key
+        key = next_key()
+    n, _d = input.shape
+    c = weight.shape[0]
+    label = label.astype(jnp.int32)
+    num_true = label.shape[1]
+    neg = _log_uniform_sample(key, num_neg_samples, c)
+
+    # O(N*T*D) gathered logits — never materialize the [N, C] matmul the
+    # sampled estimator exists to avoid
+    w_true = weight[label]                        # [N, T, D]
+    true_logit = jnp.einsum("nd,ntd->nt", input, w_true)
+    if bias is not None:
+        true_logit = true_logit + bias[label]
+    w_neg = weight[neg]                           # [S, D]
+    neg_logit = jnp.einsum("nd,sd->ns", input, w_neg)
+    if bias is not None:
+        neg_logit = neg_logit + bias[neg][None, :]
+
+    true_p = num_neg_samples * _log_uniform_prob(label, c)
+    neg_p = num_neg_samples * _log_uniform_prob(neg, c)[None, :]
+
+    # P(origin=model) = sigmoid(logit - log(k*P_noise))
+    pos = jax.nn.log_sigmoid(true_logit - jnp.log(true_p))
+    negs = jax.nn.log_sigmoid(-(neg_logit - jnp.log(neg_p)))
+    cost = -(pos.sum(1) / num_true) - negs.sum(1)
+    if sample_weight is not None:
+        cost = cost * sample_weight.reshape(-1)
+    return cost[:, None]
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _hsigmoid_simple_code(num_classes: int):
+    """Precompute the reference's SimpleCode complete-binary-tree paths
+    (paddle/fluid/operators/math/matrix_bit_code.h): class c maps to heap
+    node c + num_classes; path bits are the node id's bits below the MSB."""
+    max_len = int(_math.floor(_math.log2(max(num_classes, 2)))) + 1
+    table = np.zeros((num_classes, max_len), np.int32)
+    code = np.zeros((num_classes, max_len), np.float32)
+    length = np.zeros((num_classes,), np.int32)
+    for cls in range(num_classes):
+        node = cls + num_classes
+        bits = node.bit_length() - 1  # path length
+        length[cls] = bits
+        for j in range(bits):
+            # internal node visited at depth j (root = 1)
+            table[cls, j] = (node >> (bits - j)) - 1
+            code[cls, j] = float((node >> (bits - 1 - j)) & 1)
+    return table, code, length
+
+
+def hsigmoid_loss(input, label, weight, bias=None, num_classes=None,  # noqa: A002
+                  path_table=None, path_code=None):
+    """Hierarchical sigmoid loss (hierarchical_sigmoid_op.cc).
+
+    Default tree = complete binary tree over num_classes (SimpleCode);
+    custom trees via path_table [N, L] / path_code [N, L] with -1 padding.
+    weight: [num_internal_nodes, D]; bias: [num_internal_nodes].
+    Returns per-sample loss [N, 1].
+    """
+    label = label.reshape(-1).astype(jnp.int32)
+    if path_table is None:
+        table_np, code_np, len_np = _hsigmoid_simple_code(int(num_classes))
+        table = jnp.asarray(table_np)[label]      # [N, L]
+        code = jnp.asarray(code_np)[label]
+        valid = (jnp.arange(table.shape[1])[None, :]
+                 < jnp.asarray(len_np)[label][:, None])
+    else:
+        table = path_table.astype(jnp.int32)
+        code = path_code.astype(input.dtype)
+        valid = table >= 0
+        table = jnp.maximum(table, 0)
+    w = weight[table]                             # [N, L, D]
+    z = jnp.einsum("nd,nld->nl", input, w)
+    if bias is not None:
+        z = z + bias[table]
+    # BCE with target = code bit
+    ce = jnp.maximum(z, 0.0) - z * code.astype(z.dtype) + jnp.log1p(
+        jnp.exp(-jnp.abs(z)))
+    ce = jnp.where(valid, ce, 0.0)
+    return ce.sum(1, keepdims=True)
+
+
+# reference op-name spellings (bce_loss_op.cc, kldiv_loss_op.cc)
+def bce_loss(input, label, weight=None, reduction="mean"):  # noqa: A002
+    from .nn_functional import binary_cross_entropy
+    return binary_cross_entropy(input, label, weight=weight,
+                                reduction=reduction)
+
+
+def kldiv_loss(x, target, reduction="mean"):
+    from .nn_functional import kl_div
+    return kl_div(x, target, reduction=reduction)

@@ -1,0 +1,279 @@
+"""Extended loss-op family tests vs NumPy references.
+
+Mirrors the reference's loss-op unit tests (test_hinge_loss_op.py,
+test_rank_loss_op.py, test_bpr_loss_op.py, test_modified_huber_loss_op.py,
+test_huber_loss_op.py, test_center_loss.py, test_warpctc_op.py,
+test_nce.py, test_hsigmoid_op.py, test_sample_logits_op.py under
+python/paddle/fluid/tests/unittests/). CTC is verified against a
+brute-force sum over all alignments.
+"""
+
+import itertools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from op_test import check_forward, check_grad
+
+from paddle_tpu.ops import loss_extra as L
+
+RNG = np.random.default_rng(7)
+
+
+def _f32(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+def test_hinge_loss():
+    x = _f32(8, 1)
+    y = np.where(RNG.random((8, 1)) > 0.5, 1.0, -1.0).astype(np.float32)
+    check_forward("hinge_loss", lambda x, y: np.maximum(0, 1 - y * x), x, y)
+    check_grad("hinge_loss", x, y + 0.0)
+
+
+def test_huber_loss():
+    x, y = _f32(6, 3), _f32(6, 3)
+
+    def ref(x, y, delta=1.0, reduction="mean"):
+        r = np.abs(y - x)
+        out = np.where(r <= delta, 0.5 * r * r, delta * (r - 0.5 * delta))
+        return out.mean()
+
+    check_forward("huber_loss", ref, x, y, delta=0.7)
+    check_grad("huber_loss", x, y, delta=0.7)
+
+
+def test_modified_huber_loss():
+    x = _f32(10, 1)
+    y = (RNG.random((10, 1)) > 0.5).astype(np.float32)
+
+    def ref(x, y):
+        s = 2 * y - 1
+        p = s * x
+        return np.where(p >= -1, np.square(np.maximum(0, 1 - p)), -4 * p)
+
+    check_forward("modified_huber_loss", ref, x, y)
+
+
+def test_rank_loss():
+    lab = (RNG.random((5, 1)) > 0.5).astype(np.float32)
+    left, right = _f32(5, 1), _f32(5, 1)
+
+    def ref(lab, l, r):
+        o = l - r
+        return np.log1p(np.exp(o)) - lab * o
+
+    check_forward("rank_loss", ref, lab, left, right, rtol=1e-4)
+
+
+def test_margin_rank_loss():
+    lab = np.where(RNG.random((5, 1)) > 0.5, 1.0, -1.0).astype(np.float32)
+    left, right = _f32(5, 1), _f32(5, 1)
+    check_forward(
+        "margin_rank_loss",
+        lambda lab, l, r, margin=0.1: np.maximum(0, -lab * (l - r) + margin),
+        lab, left, right, margin=0.2)
+
+
+def test_bpr_loss():
+    x = _f32(4, 6)
+    label = RNG.integers(0, 6, (4, 1))
+
+    def ref(x, label):
+        n, c = x.shape
+        out = np.zeros((n, 1), np.float32)
+        for i in range(n):
+            pos = x[i, label[i, 0]]
+            s = 0.0
+            for j in range(c):
+                s += -np.log1p(np.exp(-(pos - x[i, j])))
+            out[i, 0] = -(s - -np.log1p(np.exp(-0.0))) / (c - 1)
+        return out
+
+    got = L.bpr_loss(jnp.asarray(x), jnp.asarray(label))
+    np.testing.assert_allclose(np.asarray(got), ref(x, label), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_squared_l2_and_l1_norms():
+    x, y = _f32(4, 5), _f32(4, 5)
+    d, sub = L.squared_l2_distance(jnp.asarray(x), jnp.asarray(y))
+    np.testing.assert_allclose(
+        np.asarray(d), np.square(x - y).sum(1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(sub), x - y, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(L.squared_l2_norm(jnp.asarray(x))),
+                               np.square(x).sum(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(L.l1_norm(jnp.asarray(x))),
+                               np.abs(x).sum(), rtol=1e-5)
+
+
+def test_cos_sim():
+    x, y = _f32(4, 8), _f32(4, 8)
+
+    def ref(x, y):
+        num = (x * y).sum(1, keepdims=True)
+        return num / (np.linalg.norm(x, axis=1, keepdims=True)
+                      * np.linalg.norm(y, axis=1, keepdims=True))
+
+    check_forward("cos_sim", ref, x, y, rtol=1e-5)
+
+
+def test_dice_npair_teacher_student():
+    # dice: perfect prediction -> loss ~ 0
+    label = RNG.integers(0, 4, (6, 1))
+    pred = np.eye(4, dtype=np.float32)[label[:, 0]]
+    got = L.dice_loss(jnp.asarray(pred), jnp.asarray(label))
+    assert float(got) < 1e-3
+
+    a, p = _f32(6, 8), _f32(6, 8)
+    lab = RNG.integers(0, 3, (6,))
+    v = float(L.npair_loss(jnp.asarray(a), jnp.asarray(p), jnp.asarray(lab)))
+    assert math.isfinite(v) and v > 0
+
+    x = _f32(8, 1)
+    lbl = np.full((8, 1), -2.0, np.float32)  # no teacher, no click
+    out = L.teacher_student_sigmoid_loss(jnp.asarray(x), jnp.asarray(lbl))
+    ref = np.maximum(x, 0) + np.log1p(np.exp(-np.abs(x)))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_center_loss():
+    x = _f32(6, 4)
+    label = RNG.integers(0, 3, (6,))
+    centers = _f32(3, 4)
+    loss, new_c = L.center_loss(jnp.asarray(x), jnp.asarray(label),
+                                jnp.asarray(centers), alpha=0.5)
+    picked = centers[label]
+    np.testing.assert_allclose(
+        np.asarray(loss),
+        0.5 * np.square(picked - x).sum(1, keepdims=True), rtol=1e-5)
+    # center update: class with no samples stays put
+    unused = [c for c in range(3) if c not in set(label.tolist())]
+    for c in unused:
+        np.testing.assert_allclose(np.asarray(new_c)[c], centers[c])
+
+
+def _brute_force_ctc(log_probs, labels, T, blank):
+    """Sum P(alignment) over all length-T paths collapsing to `labels`."""
+    C = log_probs.shape[1]
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: remove repeats then blanks
+        out, prev = [], None
+        for s in path:
+            if s != prev:
+                if s != blank:
+                    out.append(s)
+            prev = s
+        if out == list(labels):
+            lp = sum(log_probs[t, path[t]] for t in range(T))
+            total = np.logaddexp(total, lp)
+    return -total
+
+
+def test_ctc_loss_brute_force():
+    T, N, C = 4, 2, 3
+    logits = _f32(T, N, C)
+    labels = np.array([[1, 2], [2, 0]], np.int32)
+    in_len = np.array([4, 3], np.int32)
+    lab_len = np.array([2, 1], np.int32)
+
+    got = L.ctc_loss(jnp.asarray(logits), jnp.asarray(labels),
+                     jnp.asarray(in_len), jnp.asarray(lab_len),
+                     blank=0, reduction="none")
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    for i in range(N):
+        expect = _brute_force_ctc(logp[:in_len[i], i],
+                                  labels[i, :lab_len[i]], in_len[i], 0)
+        np.testing.assert_allclose(float(got[i]), expect, rtol=1e-4,
+                                   err_msg=f"sample {i}")
+
+
+def test_ctc_loss_grad_finite():
+    T, N, C = 6, 2, 5
+    logits = jnp.asarray(_f32(T, N, C))
+    labels = jnp.asarray(RNG.integers(1, C, (N, 2)).astype(np.int32))
+    in_len = jnp.asarray(np.array([6, 5], np.int32))
+    lab_len = jnp.asarray(np.array([2, 2], np.int32))
+
+    def f(lg):
+        return L.ctc_loss(lg, labels, in_len, lab_len, reduction="sum")
+
+    g = jax.grad(f)(logits)
+    assert np.isfinite(np.asarray(g)).all()
+    # finite-difference spot check
+    eps = 1e-3
+    i = (2, 0, 1)
+    e = np.zeros_like(np.asarray(logits))
+    e[i] = eps
+    fd = (float(f(logits + e)) - float(f(logits - e))) / (2 * eps)
+    np.testing.assert_allclose(float(np.asarray(g)[i]), fd, rtol=2e-2,
+                               atol=1e-3)
+
+
+def test_nce_and_sample_logits():
+    key = jax.random.PRNGKey(0)
+    x = _f32(4, 6)
+    w = _f32(20, 6)
+    b = _f32(20)
+    label = RNG.integers(0, 20, (4, 1)).astype(np.int32)
+    cost = L.nce(jnp.asarray(x), jnp.asarray(label), jnp.asarray(w),
+                 jnp.asarray(b), num_neg_samples=5, key=key)
+    assert cost.shape == (4, 1)
+    assert np.isfinite(np.asarray(cost)).all() and (np.asarray(cost) > 0).all()
+
+    logits = _f32(4, 50)
+    s_logits, s_label, samples = L.sample_logits(
+        jnp.asarray(logits), jnp.asarray(label), 8, key)
+    assert s_logits.shape == (4, 1 + 8)
+    assert (np.asarray(s_label) == 0).all()
+    assert samples.shape == (9,)
+
+
+def test_hsigmoid_loss():
+    num_classes = 6
+    x = _f32(5, 4)
+    w = _f32(num_classes - 1, 4)  # SimpleCode internal nodes: 0..C-2
+    b = _f32(num_classes - 1)
+    label = RNG.integers(0, num_classes, (5, 1))
+    loss = L.hsigmoid_loss(jnp.asarray(x), jnp.asarray(label),
+                           jnp.asarray(w), jnp.asarray(b),
+                           num_classes=num_classes)
+    assert loss.shape == (5, 1)
+    assert np.isfinite(np.asarray(loss)).all() and (np.asarray(loss) > 0).all()
+    # grad flows to weights
+    g = jax.grad(lambda ww: jnp.sum(L.hsigmoid_loss(
+        jnp.asarray(x), jnp.asarray(label), ww, jnp.asarray(b),
+        num_classes=num_classes)))(jnp.asarray(w))
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0
+
+
+def test_custom_path_hsigmoid():
+    # custom tree: 2 internal nodes, classes routed L/R
+    x = _f32(3, 4)
+    w = _f32(2, 4)
+    table = np.array([[0, 1], [0, -1], [0, 1]], np.int32)
+    code = np.array([[0, 1], [1, 0], [1, 1]], np.float32)
+    label = np.zeros((3, 1), np.int64)  # unused with explicit paths
+    loss = L.hsigmoid_loss(jnp.asarray(x), jnp.asarray(label),
+                           jnp.asarray(w), None,
+                           path_table=jnp.asarray(table),
+                           path_code=jnp.asarray(code))
+    assert loss.shape == (3, 1)
+    # row 1 has one padded entry: its loss counts only 1 term
+    assert np.isfinite(np.asarray(loss)).all()
+
+
+def test_registry_has_new_losses():
+    from paddle_tpu.ops.registry import has_op
+    for name in ["hinge_loss", "huber_loss", "modified_huber_loss",
+                 "rank_loss", "margin_rank_loss", "bpr_loss", "ctc_loss",
+                 "warpctc", "nce", "hsigmoid_loss", "sample_logits",
+                 "center_loss", "cos_sim", "dice_loss", "npair_loss",
+                 "squared_l2_norm", "l1_norm", "bce_loss", "kldiv_loss",
+                 "teacher_student_sigmoid_loss", "squared_l2_distance"]:
+        assert has_op(name), name
