@@ -15,7 +15,8 @@ from .common import (AlphaDropout, Bilinear, CosineSimilarity, Dropout,
                      Pad2D, PixelShuffle, Upsample, UpsamplingBilinear2D,
                      UpsamplingNearest2D)
 from .container import LayerDict, LayerList, ParameterList, Sequential
-from .conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D)
+from .conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D,
+                   Conv3DTranspose, DeformConv2D)
 from .initializer import ParamAttr
 from .layer import (Layer, bind_state, functional_call, functional_state)
 from .loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,

@@ -120,3 +120,42 @@ class Conv2DTranspose(_ConvNd):
                                      self._padding, self._output_padding,
                                      self._dilation, self._groups,
                                      self._data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    """3D transposed conv layer (reference: python/paddle/nn/layer/conv.py
+    Conv3DTranspose over operators/conv_transpose_op.cc)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, bias_attr, weight_attr,
+                         data_format, 3, transposed=True,
+                         output_padding=output_padding)
+
+    def forward(self, x):
+        return F["conv3d_transpose"](x, self.weight, self.bias, self._stride,
+                                     self._padding, self._output_padding,
+                                     self._dilation, self._groups,
+                                     self._data_format)
+
+
+class DeformConv2D(_ConvNd):
+    """Deformable conv v1/v2 layer (reference:
+    python/paddle/vision/ops.py DeformConv2D over
+    operators/deformable_conv_op.cc); pass `mask` for modulated (v2)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, bias_attr, weight_attr,
+                         "NCHW", 2)
+        self._deformable_groups = deformable_groups
+
+    def forward(self, x, offset, mask=None):
+        return F["deformable_conv"](x, offset, self.weight, mask, self.bias,
+                                    self._stride, self._padding,
+                                    self._dilation, self._deformable_groups,
+                                    self._groups)

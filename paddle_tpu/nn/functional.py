@@ -21,6 +21,13 @@ _NN_OPS = [
     "affine_grid", "temporal_shift", "channel_shuffle",
     # conv
     "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "deformable_conv",
+    # vision/CTR extras (ops/vision_extra.py)
+    "affine_channel", "space_to_depth", "shuffle_channel", "cvm",
+    "shuffle_batch", "partial_concat", "partial_sum", "batch_fc",
+    "row_conv", "conv_shift", "im2sequence", "add_position_encoding",
+    "fsp", "bilinear_tensor_product", "correlation", "max_unpool2d",
+    "spp", "psroi_pool", "prroi_pool", "yolov3_loss",
     # pooling
     "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
     "avg_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",

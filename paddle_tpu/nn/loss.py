@@ -181,9 +181,11 @@ class HSigmoidLoss(Layer):
         # (reference weight shape [num_classes-1, D]); custom trees index
         # up to num_classes rows
         n_nodes = num_classes if is_custom else num_classes - 1
-        self.weight = self.create_parameter((n_nodes, feature_size))
+        self.weight = self.create_parameter((n_nodes, feature_size),
+                                            attr=weight_attr)
         self.bias = (None if bias_attr is False
-                     else self.create_parameter((n_nodes,), is_bias=True))
+                     else self.create_parameter((n_nodes,), is_bias=True,
+                                                attr=bias_attr))
 
     def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
         return F["hsigmoid_loss"](

@@ -9,7 +9,7 @@ eager dispatcher can enumerate them.
 import inspect as _inspect
 
 from . import creation, detection, linalg, loss_extra, manipulation, math, \
-    nn_functional, random, rnn, search, sequence
+    nn_functional, random, rnn, search, sequence, vision_extra
 from .registry import OpDef, all_ops, get_op, has_op, register_op
 
 _DYNAMIC_SHAPE_OPS = {
@@ -28,7 +28,8 @@ _NON_DIFF_OPS = {
 
 def _auto_register():
     for mod in (creation, math, manipulation, search, linalg, random,
-                nn_functional, rnn, sequence, detection, loss_extra):
+                nn_functional, rnn, sequence, detection, loss_extra,
+                vision_extra):
         short = mod.__name__.rsplit(".", 1)[-1]
         for name, fn in vars(mod).items():
             if name.startswith("_") or not callable(fn):

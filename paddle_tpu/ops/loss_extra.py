@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .nn_functional import _reduce
+from .nn_functional import _reduce, _sigmoid_ce
 
 
 # --- simple pairwise / pointwise losses -------------------------------------
@@ -50,8 +50,7 @@ def modified_huber_loss(input, label):  # noqa: A002
 def rank_loss(label, left, right):
     """RankNet pairwise loss C = -P*o + log(1+e^o), o = left - right
     (rank_loss_op.cc)."""
-    o = left - right
-    return jnp.maximum(o, 0.0) - label * o + jnp.log1p(jnp.exp(-jnp.abs(o)))
+    return _sigmoid_ce(left - right, label)
 
 
 def margin_rank_loss(label, left, right, margin=0.1):
@@ -84,8 +83,8 @@ def teacher_student_sigmoid_loss(x, label, soft_max_up_bound=15.0,
                   jnp.where(label < 1.0, 0.0, 1.0))
     has_teacher = label > -0.5
     zp = jnp.where(has_teacher, label - z, 0.0)
-    ce = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
-    loss = (ce - x * z) + jnp.where(has_teacher, ce - x * zp, 0.0)
+    loss = _sigmoid_ce(x, z) + jnp.where(
+        has_teacher, _sigmoid_ce(x, zp), 0.0)
     return loss
 
 
@@ -306,8 +305,11 @@ def sample_logits(logits, label, num_samples, key, uniq=True,
 
     sampled = jnp.concatenate([true_logit, neg_logit], axis=1)
     sampled_label = jnp.tile(jnp.arange(num_true)[None, :], (n, 1))
-    return sampled, sampled_label, jnp.concatenate(
-        [jnp.zeros((num_true,), jnp.int32), neg])
+    # per-row class ids backing each sampled-logit column (the reference's
+    # Samples output): true labels first, then the shared negatives
+    samples = jnp.concatenate(
+        [label, jnp.tile(neg[None, :], (n, 1))], axis=1)
+    return sampled, sampled_label, samples
 
 
 def nce(input, label, weight, bias=None, num_neg_samples=10, key=None,  # noqa: A002
@@ -398,9 +400,7 @@ def hsigmoid_loss(input, label, weight, bias=None, num_classes=None,  # noqa: A0
     if bias is not None:
         z = z + bias[table]
     # BCE with target = code bit
-    ce = jnp.maximum(z, 0.0) - z * code.astype(z.dtype) + jnp.log1p(
-        jnp.exp(-jnp.abs(z)))
-    ce = jnp.where(valid, ce, 0.0)
+    ce = jnp.where(valid, _sigmoid_ce(z, code.astype(z.dtype)), 0.0)
     return ce.sum(1, keepdims=True)
 
 

@@ -747,6 +747,13 @@ def _reduce(loss, reduction):
     return loss
 
 
+def _sigmoid_ce(logit, target):
+    """Numerically stable elementwise sigmoid cross entropy:
+    max(z,0) - z*t + log1p(exp(-|z|)). Shared by the loss families."""
+    return (jnp.maximum(logit, 0.0) - logit * target
+            + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0):

@@ -1,0 +1,569 @@
+"""Extended vision / conv / CTR op family (pure functional).
+
+Reference parity for kernels under paddle/fluid/operators/:
+affine_channel_op.cc, space_to_depth_op.cc, shuffle_channel_op.cc,
+row_conv_op.cc, conv_shift_op.cc, bilinear_tensor_product_op.cc,
+add_position_encoding_op.cc, fsp_op.cc, im2sequence_op.cc,
+partial_concat_op.cc, partial_sum_op.cc, shuffle_batch_op.cc,
+batch_fc_op.cc, cvm_op.cc, unpool_op.cc, spp_op.cc,
+detection/{psroi_pool_op.cc, prroi_pool_op.cc, yolov3_loss_op.h},
+deformable_conv_op.cc (+ v1), conv_transpose_op.cc (3d),
+correlation_op.cc.
+
+All vectorized jax — gathers/scatters + einsum contractions instead of the
+reference's per-element CUDA loops, so XLA tiles the contractions onto the
+MXU and fuses the rest.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# --- channel/layout transforms ----------------------------------------------
+
+def affine_channel(x, scale, bias, data_format="NCHW"):
+    """Out = scale*x + bias per channel (affine_channel_op.cc)."""
+    if x.ndim == 2:
+        return x * scale.reshape(1, -1) + bias.reshape(1, -1)
+    if data_format == "NCHW":
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+def space_to_depth(x, blocksize):
+    """YOLOv2 reorg (space_to_depth_op.cc): NCHW [N,C,H,W] ->
+    [N, C*bs*bs, H/bs, W/bs]."""
+    n, c, h, w = x.shape
+    bs = blocksize
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * bs * bs, h // bs, w // bs)
+
+
+def shuffle_channel(x, group):
+    """Channel shuffle (shuffle_channel_op.cc) — NCHW."""
+    n, c, h, w = x.shape
+    return x.reshape(n, group, c // group, h, w).transpose(
+        0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+
+def cvm(x, cvm_input, use_cvm=True):
+    """CTR continuous-value-model feature transform (cvm_op.cc): first two
+    columns are (show, click); use_cvm logs them, else they are dropped."""
+    del cvm_input  # kept for input-signature parity; stats live in x[:, :2]
+    if use_cvm:
+        show = jnp.log(x[:, 0] + 1.0)
+        click = jnp.log(x[:, 1] + 1.0) - show
+        return jnp.concatenate([show[:, None], click[:, None], x[:, 2:]],
+                               axis=1)
+    return x[:, 2:]
+
+
+def shuffle_batch(x, key=None):
+    """Random permutation of rows (shuffle_batch_op.cc). Returns
+    (shuffled, shuffle_idx)."""
+    if key is None:
+        from ..core.rng import next_key
+        key = next_key()
+    idx = jax.random.permutation(key, x.shape[0])
+    return x[idx], idx
+
+
+def partial_concat(xs, start_index=0, length=-1):
+    """Concat a column slice of each input (partial_concat_op.cc)."""
+    pieces = []
+    for x in xs:
+        end = x.shape[1] if length < 0 else start_index + length
+        pieces.append(x[:, start_index:end])
+    return jnp.concatenate(pieces, axis=1)
+
+
+def partial_sum(xs, start_index=0, length=-1):
+    """Sum a column slice of each input (partial_sum_op.cc)."""
+    out = None
+    for x in xs:
+        end = x.shape[1] if length < 0 else start_index + length
+        piece = x[:, start_index:end]
+        out = piece if out is None else out + piece
+    return out
+
+
+def batch_fc(x, w, bias=None):
+    """Per-slot batched FC (batch_fc_op.cc): x [S, N, Din], w [S, Din, Dout],
+    bias [S, Dout] -> [S, N, Dout]."""
+    out = jnp.einsum("snd,sde->sne", x, w)
+    if bias is not None:
+        out = out + bias[:, None, :]
+    return out
+
+
+# --- sequence-ish convs -----------------------------------------------------
+
+def row_conv(x, weight):
+    """Lookahead (row) convolution for DeepSpeech2 (row_conv_op.cc):
+    x [N, T, D], weight [context, D]; out[t] = sum_j w[j]*x[t+j]."""
+    ctx = weight.shape[0]
+    n, t, d = x.shape
+    padded = jnp.pad(x, ((0, 0), (0, ctx - 1), (0, 0)))
+    idx = jnp.arange(t)[:, None] + jnp.arange(ctx)[None, :]   # [T, ctx]
+    windows = padded[:, idx]                                   # [N, T, ctx, D]
+    return jnp.einsum("ntcd,cd->ntd", windows, weight)
+
+
+def conv_shift(x, y):
+    """Circular convolution (conv_shift_op.cc): x [B, M], y [B, N] with N
+    odd; out[i,j] = sum_k x[i, (j - N/2 + k) mod M] * y[i, k]."""
+    m = x.shape[1]
+    nk = y.shape[1]
+    half = nk // 2
+    j = jnp.arange(m)[:, None]
+    k = jnp.arange(nk)[None, :]
+    gather = (j - half + k) % m                                # [M, N]
+    return jnp.einsum("bmn,bn->bm", x[:, gather], y)
+
+
+def im2sequence(x, kernels, strides=(1, 1), paddings=(0, 0, 0, 0)):
+    """Sliding-window im2col to a sequence (im2sequence_op.cc):
+    x [N, C, H, W] -> [N*out_h*out_w, C*kh*kw] row-major over windows."""
+    kh, kw = kernels
+    sh, sw = strides
+    pu, pl, pd, pr = paddings
+    x = jnp.pad(x, ((0, 0), (0, 0), (pu, pd), (pl, pr)))
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    i0 = jnp.arange(oh) * sh
+    j0 = jnp.arange(ow) * sw
+    ii = i0[:, None] + jnp.arange(kh)[None, :]                 # [oh, kh]
+    jj = j0[:, None] + jnp.arange(kw)[None, :]                 # [ow, kw]
+    # [N, C, oh, kh, ow, kw]
+    patches = x[:, :, ii[:, :, None, None], jj[None, None, :, :]]
+    patches = patches.transpose(0, 2, 4, 1, 3, 5)              # N,oh,ow,C,kh,kw
+    return patches.reshape(n * oh * ow, c * kh * kw)
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0):
+    """out = alpha*x + beta*sinusoidal_PE (add_position_encoding_op.cc);
+    x [B, T, D]. PE matches the reference kernel: first half sin, second
+    half cos, frequency indexed within each half."""
+    _b, t, d = x.shape
+    half = d // 2
+    pos = jnp.arange(t, dtype=x.dtype)[:, None]
+    i = jnp.arange(half, dtype=x.dtype)[None, :]
+    div = jnp.power(10000.0, i / jnp.maximum(half - 1.0, 1.0))
+    pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    if d % 2:
+        pe = jnp.pad(pe, ((0, 0), (0, 1)))
+    return alpha * x + beta * pe[None]
+
+
+def fsp(x, y):
+    """Flow-of-solution-procedure matrix for distillation (fsp_op.cc):
+    x [N, C1, H, W], y [N, C2, H, W] -> [N, C1, C2] spatial-mean outer
+    product."""
+    h_w = x.shape[2] * x.shape[3]
+    return jnp.einsum("nchw,ndhw->ncd", x, y) / h_w
+
+
+def bilinear_tensor_product(x, y, weight, bias=None):
+    """out[:, k] = x @ W_k @ y^T diag (bilinear_tensor_product_op.cc):
+    weight [K, Dx, Dy]."""
+    out = jnp.einsum("nd,kde,ne->nk", x, weight, y)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return out
+
+
+def correlation(x1, x2, pad_size, kernel_size, max_displacement, stride1=1,
+                stride2=1, corr_type_multiply=1):
+    """FlowNet correlation layer (correlation_op.cc): patch dot products
+    over a displacement window; NCHW inputs. Only the kernel_size=1 case
+    (the FlowNet configuration) is implemented."""
+    del corr_type_multiply
+    if kernel_size != 1:
+        raise NotImplementedError("correlation: kernel_size != 1")
+    n, c, h, w = x1.shape
+    d = max_displacement
+    x1p = jnp.pad(x1, ((0, 0), (0, 0), (pad_size,) * 2, (pad_size,) * 2))
+    x2p = jnp.pad(x2, ((0, 0), (0, 0), (pad_size,) * 2, (pad_size,) * 2))
+    outs = []
+    for di in range(-(d // stride2), d // stride2 + 1):
+        for dj in range(-(d // stride2), d // stride2 + 1):
+            shifted = jnp.roll(x2p, (-di * stride2, -dj * stride2),
+                               axis=(2, 3))
+            prod = (x1p * shifted).mean(axis=1)                 # [N, H+2p, W+2p]
+            outs.append(prod[:, pad_size:pad_size + h,
+                             pad_size:pad_size + w])
+    out = jnp.stack(outs, axis=1)                               # [N, G*G, H, W]
+    if stride1 > 1:
+        out = out[:, :, ::stride1, ::stride1]
+    return out
+
+
+# --- pooling extras ---------------------------------------------------------
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """Inverse of max_pool2d with indices (unpool_op.cc): scatter pooled
+    values back to their argmax positions. x/indices [N, C, h, w]; indices
+    are flat positions within each [H*W] input map."""
+    if stride is None:
+        stride = kernel_size
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    n, c, h, w = x.shape
+    if output_size is None:
+        oh = (h - 1) * st[0] - 2 * pd[0] + ks[0]
+        ow = (w - 1) * st[1] - 2 * pd[1] + ks[1]
+    else:
+        oh, ow = output_size
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    flat = jax.vmap(jax.vmap(
+        lambda dst, ind, src: dst.at[ind].set(src)))(
+            flat, idx, x.reshape(n, c, -1))
+    return flat.reshape(n, c, oh, ow)
+
+
+unpool = max_unpool2d
+
+
+def spp(x, pyramid_height, pooling_type="max"):
+    """Spatial pyramid pooling (spp_op.cc): concat adaptive pools at bin
+    resolutions 2^0..2^(L-1); NCHW -> [N, C*sum(4^l)]."""
+    from .nn_functional import adaptive_avg_pool2d, adaptive_max_pool2d
+    n = x.shape[0]
+    outs = []
+    for lvl in range(pyramid_height):
+        bins = 2 ** lvl
+        pooled = (adaptive_max_pool2d(x, bins) if pooling_type == "max"
+                  else adaptive_avg_pool2d(x, bins))
+        outs.append(pooled.reshape(n, -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+def psroi_pool(x, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None):
+    """Position-sensitive ROI average pooling (detection/psroi_pool_op.cc):
+    x [N, output_channels*ph*pw, H, W], rois [R, 4] (x1,y1,x2,y2 in image
+    coords), roi i taken from batch image given by rois_num mapping (or
+    image 0 when None and N == 1)."""
+    ph, pw = pooled_height, pooled_width
+    n, ctot, h, w = x.shape
+    del ctot
+    batch_idx = _roi_batch_index(rois, rois_num, n)
+
+    def one(roi, b):
+        x1, y1, x2, y2 = roi * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1) / ph
+        rw = jnp.maximum(x2 - x1, 0.1) / pw
+        # reference channel layout (psroi_pool_op.cc): input channel for
+        # (class c, bin i, j) is (c*ph + i)*pw + j — channel-major
+        img = x[b].reshape(output_channels, ph, pw, h, w)
+        out = jnp.zeros((output_channels, ph, pw), x.dtype)
+        ys = jnp.arange(h, dtype=x.dtype)[:, None]
+        xs = jnp.arange(w, dtype=x.dtype)[None, :]
+        for i in range(ph):
+            for j in range(pw):
+                hs, he = y1 + i * rh, y1 + (i + 1) * rh
+                ws, we = x1 + j * rw, x1 + (j + 1) * rw
+                mask = ((ys >= jnp.floor(hs)) & (ys < jnp.ceil(he))
+                        & (xs >= jnp.floor(ws)) & (xs < jnp.ceil(we)))
+                area = jnp.maximum(mask.sum(), 1)
+                chans = img[:, i, j]                           # [oc, H, W]
+                val = jnp.where(mask[None], chans, 0.0).sum((1, 2)) / area
+                out = out.at[:, i, j].set(val)
+        return out
+
+    return jax.vmap(one)(rois, batch_idx)
+
+
+def _roi_batch_index(rois, rois_num, n_images):
+    if rois_num is None:
+        return jnp.zeros((rois.shape[0],), jnp.int32)
+    # rois_num: [n_images] count per image -> per-roi image index
+    return jnp.repeat(jnp.arange(n_images, dtype=jnp.int32), rois_num,
+                      total_repeat_length=rois.shape[0])
+
+
+def prroi_pool(x, rois, spatial_scale, pooled_height, pooled_width,
+               rois_num=None, sampling=4):
+    """Precise ROI pooling (detection/prroi_pool_op.cc). The reference
+    integrates the bilinear surface exactly; here each bin averages a
+    `sampling` x `sampling` grid of bilinear samples — the same estimator
+    roi_align uses, converging to the precise integral as sampling grows."""
+    ph, pw = pooled_height, pooled_width
+    n, c, h, w = x.shape
+    batch_idx = _roi_batch_index(rois, rois_num, n)
+
+    def bilinear(img, yy, xx):
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        ly = jnp.clip(yy - y0, 0.0, 1.0)
+        lx = jnp.clip(xx - x0, 0.0, 1.0)
+        v = (img[:, y0, x0] * (1 - ly) * (1 - lx)
+             + img[:, y1, x0] * ly * (1 - lx)
+             + img[:, y0, x1] * (1 - ly) * lx
+             + img[:, y1, x1] * ly * lx)
+        return v
+
+    s = sampling
+
+    def one(roi, b):
+        x1, y1, x2, y2 = roi * spatial_scale
+        rh = (y2 - y1) / ph
+        rw = (x2 - x1) / pw
+        ii = jnp.arange(ph, dtype=x.dtype)
+        jj = jnp.arange(pw, dtype=x.dtype)
+        off = (jnp.arange(s, dtype=x.dtype) + 0.5) / s
+        yy = y1 + (ii[:, None] + 0.0)[..., None] * rh + off[None, None] * rh
+        xx = x1 + (jj[:, None] + 0.0)[..., None] * rw + off[None, None] * rw
+        # [ph, s] x [pw, s] sample grids
+        ys = yy.reshape(ph, 1, s, 1)
+        xs = xx.reshape(1, pw, 1, s)
+        ysb = jnp.broadcast_to(ys, (ph, pw, s, s)).reshape(-1)
+        xsb = jnp.broadcast_to(xs, (ph, pw, s, s)).reshape(-1)
+        vals = bilinear(x[b], ysb, xsb)                        # [C, ph*pw*s*s]
+        vals = vals.reshape(c, ph, pw, s * s).mean(-1)
+        return vals
+
+    return jax.vmap(one)(rois, batch_idx)
+
+
+# --- deformable conv --------------------------------------------------------
+
+def deformable_conv(x, offset, weight, mask=None, bias=None, stride=1,
+                    padding=0, dilation=1, deformable_groups=1, groups=1):
+    """Deformable convolution v1/v2 (deformable_conv_op.cc,
+    deformable_conv_v1_op.cc; v2 when mask given).
+
+    x [N, C, H, W]; offset [N, 2*dg*kh*kw, Hout, Wout] ordered (y, x) per
+    tap; mask [N, dg*kh*kw, Hout, Wout]; weight [Cout, C/groups, kh, kw].
+    Implementation: gather bilinear samples per tap -> one einsum
+    contraction (maps to the MXU), instead of the reference's per-element
+    modulated_deformable_im2col CUDA kernel.
+    """
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    n, c, h, w = x.shape
+    cout, _cpg, kh, kw = weight.shape
+    oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+    ow = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+    dg = deformable_groups
+    kk = kh * kw
+
+    off = offset.reshape(n, dg, kk, 2, oh, ow)
+    base_y = (jnp.arange(oh) * s[0] - p[0])[:, None]           # [oh, 1]
+    base_x = (jnp.arange(ow) * s[1] - p[1])[None, :]           # [1, ow]
+    ky = (jnp.arange(kh) * d[0]).repeat(kw)                    # [kk]
+    kx = jnp.tile(jnp.arange(kw) * d[1], kh)                   # [kk]
+    # sample positions [N, dg, kk, oh, ow]
+    yy = base_y[None, None, None] + ky[None, None, :, None, None] \
+        + off[:, :, :, 0]
+    xx = base_x[None, None, None] + kx[None, None, :, None, None] \
+        + off[:, :, :, 1]
+
+    y0 = jnp.floor(yy)
+    x0 = jnp.floor(xx)
+    ly = yy - y0
+    lx = xx - x0
+
+    def gather(py, px):
+        pyc = jnp.clip(py.astype(jnp.int32), 0, h - 1)
+        pxc = jnp.clip(px.astype(jnp.int32), 0, w - 1)
+        valid = ((py >= 0) & (py <= h - 1) & (px >= 0)
+                 & (px <= w - 1)).astype(x.dtype)
+        # x [N, C, H, W] -> group channels by dg: [N, dg, C/dg, H, W]
+        xg = x.reshape(n, dg, c // dg, h, w)
+        flat = xg.reshape(n, dg, c // dg, h * w)
+        ind = (pyc * w + pxc).reshape(n, dg, -1)               # [N,dg,kk*oh*ow]
+        vals = jnp.take_along_axis(flat, ind[:, :, None, :], axis=3)
+        vals = vals.reshape(n, dg, c // dg, kk, oh, ow)
+        return vals * valid[:, :, None]
+
+    v00 = gather(y0, x0) * ((1 - ly) * (1 - lx))[:, :, None]
+    v01 = gather(y0, x0 + 1) * ((1 - ly) * lx)[:, :, None]
+    v10 = gather(y0 + 1, x0) * (ly * (1 - lx))[:, :, None]
+    v11 = gather(y0 + 1, x0 + 1) * (ly * lx)[:, :, None]
+    sampled = v00 + v01 + v10 + v11        # [N, dg, C/dg, kk, oh, ow]
+    if mask is not None:
+        sampled = sampled * mask.reshape(n, dg, 1, kk, oh, ow)
+    sampled = sampled.reshape(n, c, kk, oh, ow)
+
+    wg = weight.reshape(groups, cout // groups, c // groups, kk)
+    sg = sampled.reshape(n, groups, c // groups, kk, oh, ow)
+    out = jnp.einsum("ngckhw,gock->ngohw", sg, wg)
+    out = out.reshape(n, cout, oh, ow)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    """3D transposed convolution (conv_transpose_op.cc conv3d_transpose):
+    x [N, C, D, H, W], weight [Cin, Cout/g, kd, kh, kw]."""
+    if groups != 1:
+        raise NotImplementedError("conv3d_transpose groups>1")
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    d = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    op = (output_padding,) * 3 if isinstance(output_padding, int) \
+        else tuple(output_padding)
+    if data_format == "NDHWC":
+        x = x.transpose(0, 4, 1, 2, 3)
+    # lax.conv_transpose with IOdhw weight layout
+    pads = [(d[i] * (weight.shape[2 + i] - 1) - p[i],
+             d[i] * (weight.shape[2 + i] - 1) - p[i] + op[i])
+            for i in range(3)]
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(weight, (2, 3, 4)).swapaxes(0, 1),
+        window_strides=(1, 1, 1), padding=pads,
+        lhs_dilation=s, rhs_dilation=d,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    if data_format == "NDHWC":
+        out = out.transpose(0, 2, 3, 4, 1)
+    return out
+
+
+# --- YOLOv3 loss ------------------------------------------------------------
+
+def _sce(x, label):
+    """Elementwise sigmoid cross entropy (yolov3_loss_op.h SCE)."""
+    from .nn_functional import _sigmoid_ce
+    return _sigmoid_ce(x, label)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True):
+    """YOLOv3 training loss (detection/yolov3_loss_op.h), fully vectorized.
+
+    x: [N, A*(5+C), H, W] raw head output, A = len(anchor_mask);
+    gt_box: [N, B, 4] normalized (cx, cy, w, h); gt_label: [N, B] int;
+    anchors: flat list of all anchor (w, h) pairs; anchor_mask: indices of
+    the anchors this head predicts. Returns per-image loss [N].
+    """
+    n, _, h, w = x.shape
+    a = len(anchor_mask)
+    cn = class_num
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an_use = an_all[np.asarray(anchor_mask)]                  # [A, 2]
+    input_size = downsample_ratio * h
+    b = gt_box.shape[1]
+
+    x = x.reshape(n, a, 5 + cn, h, w)
+    px, py = x[:, :, 0], x[:, :, 1]                            # [N,A,H,W]
+    pw, ph_ = x[:, :, 2], x[:, :, 3]
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]                                         # [N,A,C,H,W]
+
+    gx, gy = gt_box[..., 0], gt_box[..., 1]                    # [N,B]
+    gw, gh = gt_box[..., 2], gt_box[..., 3]
+    valid = (gw > 0) & (gh > 0)                                # [N,B]
+    if gt_score is None:
+        gt_score = jnp.ones_like(gx)
+
+    # --- responsible anchor per gt: best shape-IoU over ALL anchors
+    inter = (jnp.minimum(gw[..., None], an_all[None, None, :, 0]
+                         / input_size)
+             * jnp.minimum(gh[..., None], an_all[None, None, :, 1]
+                           / input_size))
+    union = (gw * gh)[..., None] + (an_all[None, None, :, 0]
+                                    * an_all[None, None, :, 1]
+                                    / input_size ** 2) - inter
+    an_iou = inter / jnp.maximum(union, 1e-10)                 # [N,B,Atot]
+    best_an = jnp.argmax(an_iou, axis=-1)                      # [N,B]
+    mask_np = np.asarray(anchor_mask)
+    # map best anchor -> local index in this head's mask (or -1)
+    lookup = np.full((an_all.shape[0],), -1, np.int32)
+    for li, g in enumerate(mask_np):
+        lookup[g] = li
+    local_an = jnp.asarray(lookup)[best_an]                    # [N,B]
+    resp = valid & (local_an >= 0)
+
+    gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)        # [N,B]
+    gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+    la = jnp.maximum(local_an, 0)
+
+    # --- location loss at responsible cells
+    tx = gx * w - gi
+    ty = gy * h - gj
+    tw = jnp.log(jnp.maximum(
+        gw * input_size / jnp.asarray(an_use)[la][..., 0], 1e-9))
+    th = jnp.log(jnp.maximum(
+        gh * input_size / jnp.asarray(an_use)[la][..., 1], 1e-9))
+    scale = (2.0 - gw * gh) * gt_score                         # [N,B]
+
+    bidx = jnp.arange(n)[:, None].repeat(b, 1)                 # [N,B]
+    px_g = px[bidx, la, gj, gi]
+    py_g = py[bidx, la, gj, gi]
+    pw_g = pw[bidx, la, gj, gi]
+    ph_g = ph_[bidx, la, gj, gi]
+    loc = (_sce(px_g, tx) + _sce(py_g, ty)
+           + jnp.abs(pw_g - tw) + jnp.abs(ph_g - th)) * scale
+    loss_loc = jnp.where(resp, loc, 0.0).sum(1)                # [N]
+
+    # --- class loss at responsible cells
+    # reference: label_pos = 1 - s, label_neg = s, s = min(1/C, 1/40)
+    smooth = min(1.0 / cn, 1.0 / 40.0) if use_label_smooth else 0.0
+    onehot = jax.nn.one_hot(gt_label, cn, dtype=x.dtype)
+    tcls = onehot * (1.0 - smooth) + (1.0 - onehot) * smooth
+    pcls_g = pcls.transpose(0, 1, 3, 4, 2)[bidx, la, gj, gi]   # [N,B,C]
+    cls = (_sce(pcls_g, tcls) * gt_score[..., None]).sum(-1)
+    loss_cls = jnp.where(resp, cls, 0.0).sum(1)
+
+    # --- objectness: build tobj by scatter; ignore high-IoU preds
+    # decoded pred boxes for ignore mask
+    grid_x = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    bx = (jax.nn.sigmoid(px) + grid_x) / w                     # [N,A,H,W]
+    by = (jax.nn.sigmoid(py) + grid_y) / h
+    bw = jnp.exp(jnp.clip(pw, -20, 20)) * jnp.asarray(
+        an_use[:, 0])[None, :, None, None] / input_size
+    bh = jnp.exp(jnp.clip(ph_, -20, 20)) * jnp.asarray(
+        an_use[:, 1])[None, :, None, None] / input_size
+
+    def iou_xywh(bx, by, bw, bh, gx, gy, gw, gh):
+        # broadcast pred [N,A,H,W] x gt [N,B] -> [N,B,A,H,W]
+        px1 = (bx - bw / 2)[:, None]
+        py1 = (by - bh / 2)[:, None]
+        px2 = (bx + bw / 2)[:, None]
+        py2 = (by + bh / 2)[:, None]
+        gx1 = (gx - gw / 2)[..., None, None, None]
+        gy1 = (gy - gh / 2)[..., None, None, None]
+        gx2 = (gx + gw / 2)[..., None, None, None]
+        gy2 = (gy + gh / 2)[..., None, None, None]
+        iw = jnp.maximum(jnp.minimum(px2, gx2) - jnp.maximum(px1, gx1), 0)
+        ih = jnp.maximum(jnp.minimum(py2, gy2) - jnp.maximum(py1, gy1), 0)
+        inter = iw * ih
+        union = ((px2 - px1) * (py2 - py1)
+                 + (gx2 - gx1) * (gy2 - gy1) - inter)
+        return inter / jnp.maximum(union, 1e-10)
+
+    ious = iou_xywh(bx, by, bw, bh, gx, gy, gw, gh)            # [N,B,A,H,W]
+    ious = jnp.where(valid[..., None, None, None], ious, 0.0)
+    best_iou = ious.max(1)                                     # [N,A,H,W]
+
+    tobj = jnp.zeros((n, a, h, w), x.dtype)
+    score_resp = jnp.where(resp, gt_score, 0.0)
+    tobj = tobj.at[bidx, la, gj, gi].max(score_resp)
+    ignore = (best_iou > ignore_thresh) & (tobj <= 0)
+    obj_pos = jnp.where(tobj > 1e-5, _sce(pobj, 1.0) * tobj, 0.0)
+    obj_neg = jnp.where((tobj <= 1e-5) & ~ignore, _sce(pobj, 0.0), 0.0)
+    loss_obj = (obj_pos + obj_neg).reshape(n, -1).sum(1)
+
+    return loss_loc + loss_cls + loss_obj

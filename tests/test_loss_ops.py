@@ -230,7 +230,8 @@ def test_nce_and_sample_logits():
         jnp.asarray(logits), jnp.asarray(label), 8, key)
     assert s_logits.shape == (4, 1 + 8)
     assert (np.asarray(s_label) == 0).all()
-    assert samples.shape == (9,)
+    assert samples.shape == (4, 9)
+    np.testing.assert_array_equal(np.asarray(samples)[:, :1], label)
 
 
 def test_hsigmoid_loss():

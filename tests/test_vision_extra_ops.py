@@ -1,0 +1,307 @@
+"""Vision/conv/CTR extra-op tests vs NumPy references.
+
+Mirrors reference unit tests: test_affine_channel_op.py,
+test_space_to_depth_op.py, test_row_conv_op.py, test_conv_shift_op.py,
+test_bilinear_tensor_product_op.py, test_fsp_op.py, test_im2sequence_op.py,
+test_partial_concat_op.py, test_unpool_op.py, test_spp_op.py,
+test_psroi_pool_op.py, test_prroi_pool_op.py, test_deformable_conv_op.py,
+test_yolov3_loss_op.py, test_cvm_op.py, test_batch_fc_op.py under
+python/paddle/fluid/tests/unittests/.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import nn_functional as NF
+from paddle_tpu.ops import vision_extra as V
+
+RNG = np.random.default_rng(3)
+
+
+def _f32(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+def test_affine_channel():
+    x = _f32(2, 3, 4, 4)
+    s, b = _f32(3), _f32(3)
+    got = V.affine_channel(jnp.asarray(x), jnp.asarray(s), jnp.asarray(b))
+    np.testing.assert_allclose(
+        np.asarray(got), x * s[None, :, None, None] + b[None, :, None, None],
+        rtol=1e-6)
+    x2 = _f32(5, 3)
+    got2 = V.affine_channel(jnp.asarray(x2), jnp.asarray(s), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(got2), x2 * s + b, rtol=1e-6)
+
+
+def test_space_to_depth_roundtrip():
+    x = _f32(2, 4, 6, 6)
+    y = V.space_to_depth(jnp.asarray(x), 2)
+    assert y.shape == (2, 16, 3, 3)
+    # inverse via pixel_shuffle-style reshape
+    z = np.asarray(y).reshape(2, 2, 2, 4, 3, 3).transpose(
+        0, 3, 4, 1, 5, 2).reshape(2, 4, 6, 6)
+    np.testing.assert_allclose(z, x)
+
+
+def test_shuffle_channel_involution():
+    x = _f32(2, 6, 3, 3)
+    y = V.shuffle_channel(jnp.asarray(x), 2)
+    z = V.shuffle_channel(y, 3)  # shuffling by c//g inverts
+    np.testing.assert_allclose(np.asarray(z), x)
+
+
+def test_cvm():
+    x = np.abs(_f32(4, 6)) + 1.0
+    y = V.cvm(jnp.asarray(x), None, use_cvm=True)
+    np.testing.assert_allclose(np.asarray(y)[:, 0], np.log(x[:, 0] + 1),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(y)[:, 1], np.log(x[:, 1] + 1) - np.log(x[:, 0] + 1),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(y)[:, 2:], x[:, 2:])
+    y2 = V.cvm(jnp.asarray(x), None, use_cvm=False)
+    np.testing.assert_allclose(np.asarray(y2), x[:, 2:])
+
+
+def test_row_conv():
+    x = _f32(2, 5, 3)
+    w = _f32(3, 3)  # context 3
+    got = np.asarray(V.row_conv(jnp.asarray(x), jnp.asarray(w)))
+    ref = np.zeros_like(x)
+    for t in range(5):
+        for j in range(3):
+            if t + j < 5:
+                ref[:, t] += x[:, t + j] * w[j]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_shift():
+    x = _f32(2, 7)
+    y = _f32(2, 3)
+    got = np.asarray(V.conv_shift(jnp.asarray(x), jnp.asarray(y)))
+    ref = np.zeros_like(x)
+    for i in range(2):
+        for j in range(7):
+            for k in range(3):
+                ref[i, j] += x[i, (j - 1 + k) % 7] * y[i, k]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_bilinear_tensor_product():
+    x, y = _f32(4, 3), _f32(4, 5)
+    w = _f32(6, 3, 5)
+    b = _f32(6)
+    got = np.asarray(V.bilinear_tensor_product(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(w), jnp.asarray(b)))
+    ref = np.stack([np.sum(x @ w[k] * y, axis=1) for k in range(6)], 1) + b
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fsp():
+    x, y = _f32(2, 3, 4, 5), _f32(2, 6, 4, 5)
+    got = np.asarray(V.fsp(jnp.asarray(x), jnp.asarray(y)))
+    ref = np.einsum("nchw,ndhw->ncd", x, y) / 20.0
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_add_position_encoding():
+    x = _f32(2, 4, 6)
+    got = np.asarray(V.add_position_encoding(jnp.asarray(x), 0.5, 2.0))
+    assert got.shape == x.shape
+    # beta*PE at position 0: sin(0)=0 for first half, cos(0)=1 for second
+    np.testing.assert_allclose(got[:, 0, :3], 0.5 * x[:, 0, :3], atol=1e-6)
+    np.testing.assert_allclose(got[:, 0, 3:], 0.5 * x[:, 0, 3:] + 2.0,
+                               atol=1e-6)
+
+
+def test_im2sequence():
+    x = _f32(1, 2, 4, 4)
+    out = np.asarray(V.im2sequence(jnp.asarray(x), (2, 2), (2, 2)))
+    assert out.shape == (4, 8)
+    # first window = x[:, :, 0:2, 0:2]
+    np.testing.assert_allclose(out[0], x[0, :, 0:2, 0:2].reshape(-1))
+
+
+def test_partial_concat_sum():
+    a, b = _f32(3, 6), _f32(3, 6)
+    got = np.asarray(V.partial_concat([jnp.asarray(a), jnp.asarray(b)],
+                                      1, 2))
+    np.testing.assert_allclose(got, np.concatenate(
+        [a[:, 1:3], b[:, 1:3]], 1))
+    got2 = np.asarray(V.partial_sum([jnp.asarray(a), jnp.asarray(b)], 1, 2))
+    np.testing.assert_allclose(got2, a[:, 1:3] + b[:, 1:3], rtol=1e-6)
+
+
+def test_batch_fc():
+    x = _f32(3, 4, 5)
+    w = _f32(3, 5, 2)
+    b = _f32(3, 2)
+    got = np.asarray(V.batch_fc(jnp.asarray(x), jnp.asarray(w),
+                                jnp.asarray(b)))
+    ref = np.einsum("snd,sde->sne", x, w) + b[:, None]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_shuffle_batch_permutes():
+    x = jnp.arange(10.0)[:, None]
+    y, idx = V.shuffle_batch(x, key=jax.random.PRNGKey(0))
+    assert sorted(np.asarray(y)[:, 0].tolist()) == list(range(10))
+    np.testing.assert_allclose(np.asarray(x)[np.asarray(idx), 0],
+                               np.asarray(y)[:, 0])
+
+
+def test_max_unpool2d_roundtrip():
+    x = _f32(2, 3, 4, 4)
+    pooled, idx = NF.max_pool2d(jnp.asarray(x), 2, 2, return_mask=True)
+    restored = V.max_unpool2d(pooled, idx, 2, 2)
+    assert restored.shape == x.shape
+    # every pooled max lands back at its argmax position
+    flat = np.asarray(restored).reshape(2, 3, -1)
+    pooled_np = np.asarray(pooled).reshape(2, 3, -1)
+    idx_np = np.asarray(idx).reshape(2, 3, -1)
+    for n in range(2):
+        for c in range(3):
+            np.testing.assert_allclose(flat[n, c][idx_np[n, c]],
+                                       pooled_np[n, c])
+    # non-argmax positions are zero
+    assert np.count_nonzero(np.asarray(restored)) <= 2 * 3 * 4
+
+
+def test_spp():
+    x = _f32(2, 3, 8, 8)
+    out = V.spp(jnp.asarray(x), 2, "max")
+    assert out.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(np.asarray(out)[:, :3],
+                               x.max((2, 3)), rtol=1e-6)
+    out_avg = V.spp(jnp.asarray(x), 1, "avg")
+    np.testing.assert_allclose(np.asarray(out_avg), x.mean((2, 3)),
+                               rtol=1e-5)
+
+
+def test_psroi_pool():
+    # constant feature map -> every bin equals the constant of its channel
+    oc, ph, pw = 2, 2, 2
+    # reference layout (psroi_pool_op.cc): channel (c*ph + i)*pw + j feeds
+    # output class c at bin (i, j)
+    x = np.zeros((1, oc * ph * pw, 8, 8), np.float32)
+    for k in range(oc * ph * pw):
+        x[0, k] = k
+    rois = np.array([[0.0, 0.0, 8.0, 8.0]], np.float32)
+    out = np.asarray(V.psroi_pool(jnp.asarray(x), jnp.asarray(rois),
+                                  oc, 1.0, ph, pw))
+    assert out.shape == (1, oc, ph, pw)
+    for i in range(ph):
+        for j in range(pw):
+            for c in range(oc):
+                assert out[0, c, i, j] == (c * ph + i) * pw + j
+
+
+def test_prroi_pool_constant():
+    x = np.full((1, 3, 6, 6), 2.5, np.float32)
+    rois = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
+    out = np.asarray(V.prroi_pool(jnp.asarray(x), jnp.asarray(rois),
+                                  1.0, 2, 2))
+    np.testing.assert_allclose(out, 2.5, rtol=1e-5)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    x = _f32(1, 4, 6, 6)
+    w = _f32(5, 4, 3, 3)
+    offset = np.zeros((1, 2 * 9, 4, 4), np.float32)
+    got = V.deformable_conv(jnp.asarray(x), jnp.asarray(offset),
+                            jnp.asarray(w), stride=1, padding=0)
+    ref = NF.conv2d(jnp.asarray(x), jnp.asarray(w), stride=1, padding=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deformable_conv_v2_mask_scales():
+    x = _f32(1, 2, 5, 5)
+    w = _f32(3, 2, 3, 3)
+    offset = np.zeros((1, 18, 3, 3), np.float32)
+    mask_half = np.full((1, 9, 3, 3), 0.5, np.float32)
+    full = V.deformable_conv(jnp.asarray(x), jnp.asarray(offset),
+                             jnp.asarray(w))
+    half = V.deformable_conv(jnp.asarray(x), jnp.asarray(offset),
+                             jnp.asarray(w), mask=jnp.asarray(mask_half))
+    np.testing.assert_allclose(np.asarray(half), 0.5 * np.asarray(full),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_conv3d_transpose_shape_and_grad():
+    x = jnp.asarray(_f32(1, 2, 3, 4, 4))
+    w = jnp.asarray(_f32(2, 3, 2, 2, 2))  # [Cin, Cout, kd, kh, kw]
+    out = V.conv3d_transpose(x, w, stride=2)
+    assert out.shape == (1, 3, 6, 8, 8)
+    g = jax.grad(lambda a: V.conv3d_transpose(a, w, stride=2).sum())(x)
+    assert np.isfinite(np.asarray(g)).all()
+    # sum preservation under stride-1 full transpose conv of ones kernel
+    w1 = jnp.ones((2, 1, 2, 2, 2))
+    out1 = V.conv3d_transpose(x, w1, stride=1)
+    np.testing.assert_allclose(float(out1.sum()),
+                               float(x.sum()) * 8, rtol=1e-4)
+
+
+def test_correlation_self_positive():
+    x = _f32(1, 4, 6, 6)
+    out = V.correlation(jnp.asarray(x), jnp.asarray(x), pad_size=2,
+                        kernel_size=1, max_displacement=2)
+    assert out.shape == (1, 25, 6, 6)
+    # center displacement (0,0) channel = mean over C of x*x >= 0
+    center = np.asarray(out)[0, 12]
+    np.testing.assert_allclose(center, (x[0] ** 2).mean(0), rtol=1e-5)
+
+
+def test_yolov3_loss_runs_and_grads():
+    n, cn = 2, 4
+    h = w = 4
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1, 2]
+    a = len(mask)
+    x = jnp.asarray(_f32(n, a * (5 + cn), h, w))
+    gt_box = jnp.asarray(np.array(
+        [[[0.5, 0.5, 0.3, 0.4], [0.2, 0.3, 0.1, 0.2]],
+         [[0.7, 0.2, 0.2, 0.1], [0.0, 0.0, 0.0, 0.0]]], np.float32))
+    gt_label = jnp.asarray(np.array([[1, 2], [3, 0]], np.int32))
+    loss = V.yolov3_loss(x, gt_box, gt_label, anchors, mask, cn,
+                         ignore_thresh=0.7, downsample_ratio=32)
+    assert loss.shape == (n,)
+    assert np.isfinite(np.asarray(loss)).all() and (np.asarray(loss) > 0).all()
+    g = jax.grad(lambda xx: V.yolov3_loss(
+        xx, gt_box, gt_label, anchors, mask, cn, 0.7, 32).sum())(x)
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0
+    # padded gt (zero w/h) contributes nothing: zeroing it changes nothing
+    loss2 = V.yolov3_loss(x, gt_box.at[1, 1].set(0.0), gt_label, anchors,
+                          mask, cn, 0.7, 32)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(loss2),
+                               rtol=1e-6)
+
+
+def test_yolov3_loss_under_jit():
+    n, cn, h = 1, 3, 4
+    anchors = [10, 13, 16, 30]
+    mask = [0, 1]
+    x = jnp.asarray(_f32(n, len(mask) * (5 + cn), h, h))
+    gt_box = jnp.asarray(np.array([[[0.4, 0.6, 0.2, 0.2]]], np.float32))
+    gt_label = jnp.asarray(np.array([[1]], np.int32))
+    f = jax.jit(lambda a, b, c: V.yolov3_loss(
+        a, b, c, anchors, mask, cn, 0.5, 32))
+    l1 = f(x, gt_box, gt_label)
+    l2 = V.yolov3_loss(x, gt_box, gt_label, anchors, mask, cn, 0.5, 32)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-5)
+
+
+def test_registry_has_vision_extras():
+    from paddle_tpu.ops.registry import has_op
+    for name in ["affine_channel", "space_to_depth", "shuffle_channel",
+                 "cvm", "shuffle_batch", "partial_concat", "partial_sum",
+                 "batch_fc", "row_conv", "conv_shift", "im2sequence",
+                 "add_position_encoding", "fsp", "bilinear_tensor_product",
+                 "correlation", "max_unpool2d", "unpool", "spp",
+                 "psroi_pool", "prroi_pool", "deformable_conv",
+                 "conv3d_transpose", "yolov3_loss"]:
+        assert has_op(name), name
