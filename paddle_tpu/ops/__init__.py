@@ -8,8 +8,9 @@ eager dispatcher can enumerate them.
 
 import inspect as _inspect
 
-from . import creation, detection, linalg, loss_extra, manipulation, math, \
-    nn_functional, random, rnn, search, sequence, vision_extra
+from . import creation, decode_extra, detection, linalg, loss_extra, \
+    manipulation, math, nn_functional, random, rnn, search, sequence, \
+    vision_extra
 from .registry import OpDef, all_ops, get_op, has_op, register_op
 
 _DYNAMIC_SHAPE_OPS = {
@@ -29,7 +30,7 @@ _NON_DIFF_OPS = {
 def _auto_register():
     for mod in (creation, math, manipulation, search, linalg, random,
                 nn_functional, rnn, sequence, detection, loss_extra,
-                vision_extra):
+                vision_extra, decode_extra):
         short = mod.__name__.rsplit(".", 1)[-1]
         for name, fn in vars(mod).items():
             if name.startswith("_") or not callable(fn):

@@ -1,0 +1,262 @@
+"""Sequence decoding / segment / misc op family (pure functional).
+
+Reference parity for paddle/fluid/operators/: linear_chain_crf_op.cc,
+crf_decoding_op.cc, gather_tree_op.cc, beam_search_op.cc (+
+beam_search_decode_op.cc), segment_pool (incubate segment ops),
+multiplex_op.cc, mv_op.cc, increment_op.cc, p_norm_op.cc,
+frobenius_norm_op.cc, mul_op.cc.
+
+The CRF pair runs as lax.scan recursions over time (one fused XLA loop,
+batched over sequences) instead of the reference's per-sequence CPU
+kernels; beam search is reshaped to the static-shape dense [batch, beam]
+form idiomatic for TPU decoding rather than the reference's LoD-based op.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# --- linear-chain CRF ---------------------------------------------------------
+
+def _crf_split_transition(transition):
+    """Reference layout (linear_chain_crf_op.cc): row 0 = start weights,
+    row 1 = stop weights, rows 2: = [num_tags, num_tags] transitions."""
+    return transition[0], transition[1], transition[2:]
+
+
+def linear_chain_crf(emission, transition, label, length=None):
+    """Negative log-likelihood of a linear-chain CRF.
+
+    emission: [N, T, K] unary scores; transition: [K+2, K] (start/stop
+    rows first, reference layout); label: [N, T] int; length: [N] valid
+    steps (defaults to T). Returns nll [N, 1] = log Z - score(gold).
+    """
+    start_w, stop_w, trans = _crf_split_transition(transition)
+    n, t, k = emission.shape
+    label = label.astype(jnp.int32)
+    if length is None:
+        length = jnp.full((n,), t, jnp.int32)
+    steps = jnp.arange(t)
+    valid = steps[None, :] < length[:, None]                   # [N, T]
+
+    # --- log partition via forward recursion
+    alpha0 = start_w[None, :] + emission[:, 0]                 # [N, K]
+
+    def fwd(alpha, inp):
+        emit_t, valid_t = inp                                  # [N,K],[N]
+        # logsumexp over previous tag
+        scores = alpha[:, :, None] + trans[None]               # [N, K, K]
+        new = jax.nn.logsumexp(scores, axis=1) + emit_t
+        new = jnp.where(valid_t[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(
+        fwd, alpha0,
+        (emission[:, 1:].swapaxes(0, 1), valid[:, 1:].swapaxes(0, 1)))
+    logz = jax.nn.logsumexp(alpha + stop_w[None, :], axis=1)   # [N]
+
+    # --- gold score
+    first_emit = jnp.take_along_axis(
+        emission[:, 0], label[:, :1], axis=1)[:, 0]
+    gold = start_w[label[:, 0]] + first_emit
+    prev_lab = label[:, :-1]
+    next_lab = label[:, 1:]
+    step_trans = trans[prev_lab, next_lab]                     # [N, T-1]
+    step_emit = jnp.take_along_axis(emission[:, 1:],
+                                    next_lab[..., None], axis=2)[..., 0]
+    gold = gold + jnp.where(valid[:, 1:], step_trans + step_emit,
+                            0.0).sum(1)
+    last_idx = jnp.maximum(length - 1, 0)
+    last_lab = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    gold = gold + stop_w[last_lab]
+
+    return (logz - gold)[:, None]
+
+
+def crf_decoding(emission, transition, length=None):
+    """Viterbi decode with the CRF transition layout of linear_chain_crf
+    (crf_decoding_op.cc). Returns best path [N, T] (entries past `length`
+    are 0)."""
+    start_w, stop_w, trans = _crf_split_transition(transition)
+    n, t, k = emission.shape
+    if length is None:
+        length = jnp.full((n,), t, jnp.int32)
+    steps = jnp.arange(t)
+    valid = steps[None, :] < length[:, None]
+
+    alpha0 = start_w[None, :] + emission[:, 0]
+
+    def fwd(alpha, inp):
+        emit_t, valid_t = inp
+        scores = alpha[:, :, None] + trans[None]               # [N, K, K]
+        best_prev = jnp.argmax(scores, axis=1)                 # [N, K]
+        new = jnp.max(scores, axis=1) + emit_t
+        new = jnp.where(valid_t[:, None], new, alpha)
+        best_prev = jnp.where(valid_t[:, None], best_prev,
+                              jnp.arange(k)[None, :])
+        return new, best_prev
+
+    alpha, backptrs = jax.lax.scan(
+        fwd, alpha0,
+        (emission[:, 1:].swapaxes(0, 1), valid[:, 1:].swapaxes(0, 1)))
+    # stop contribution applies at each sequence's true last step; since
+    # invalid steps copy alpha forward, adding stop_w at the end is exact
+    last_tag = jnp.argmax(alpha + stop_w[None, :], axis=1)     # [N]
+
+    def back(tag, ptr_t):
+        prev = jnp.take_along_axis(ptr_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan over backptrs[t] (maps tag at t+1 -> best tag at t):
+    # emitted ys[t] = tag at step t+1; final carry = tag at step 0
+    first_tag, later = jax.lax.scan(back, last_tag, backptrs, reverse=True)
+    full = jnp.concatenate([first_tag[:, None], later.swapaxes(0, 1)],
+                           axis=1)                             # [N, T]
+    return jnp.where(valid, full, 0)
+
+
+# --- beam search -------------------------------------------------------------
+
+def beam_search_step(log_probs, scores, beam_size, end_token=None,
+                     finished=None):
+    """One dense beam-search expansion (TPU-idiomatic form of
+    beam_search_op.cc): log_probs [B, beam, V] for the current step,
+    scores [B, beam_in] accumulated (beam_in may be 1 on the first step).
+    Returns (next_scores [B, beam_size], parent, token)."""
+    b, beam_in, v = log_probs.shape
+    cand = scores[:, :, None] + log_probs                      # [B, bin, V]
+    if finished is not None:
+        if end_token is None:
+            raise ValueError(
+                "beam_search_step: end_token is required with finished")
+        # finished beams only propagate via end_token at no cost
+        keep = jnp.full((v,), -jnp.inf, cand.dtype).at[
+            int(end_token)].set(0.0)
+        cand = jnp.where(finished[:, :, None], scores[:, :, None] + keep,
+                         cand)
+    flat = cand.reshape(b, beam_in * v)
+    top, idx = jax.lax.top_k(flat, beam_size)
+    parent = idx // v
+    token = idx % v
+    return top, parent, token
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam-search output (gather_tree_op.cc): ids/parents
+    [T, B, beam] -> full sequences [T, B, beam]."""
+    t = ids.shape[0]
+
+    def step(beam_idx, inp):
+        ids_t, par_t = inp
+        tok = jnp.take_along_axis(ids_t, beam_idx, axis=1)
+        prev = jnp.take_along_axis(par_t, beam_idx, axis=1)
+        return prev, tok
+
+    init = jnp.tile(jnp.arange(ids.shape[2])[None, :], (ids.shape[1], 1))
+    _, toks = jax.lax.scan(step, init, (ids, parents), reverse=True)
+    return toks
+
+
+def beam_search_decode(ids, parents, scores=None):
+    """Full decode: backtrace + best-beam selection. Returns
+    (sequences [B, T] of the best beam, best_scores [B])."""
+    full = gather_tree(ids, parents)                           # [T, B, beam]
+    if scores is None:
+        best = jnp.zeros((ids.shape[1],), jnp.int32)
+        best_scores = None
+    else:
+        best = jnp.argmax(scores, axis=1)                      # [B]
+        best_scores = jnp.max(scores, axis=1)
+    seq = jnp.take_along_axis(
+        full, best[None, :, None], axis=2)[:, :, 0]            # [T, B]
+    return seq.swapaxes(0, 1), best_scores
+
+
+# --- segment ops (incubate segment_pool) -------------------------------------
+
+def segment_sum(x, segment_ids, num_segments=None):
+    n = int(num_segments) if num_segments is not None else None
+    if n is None:
+        raise ValueError("segment_sum requires static num_segments on TPU")
+    return jax.ops.segment_sum(x, segment_ids.astype(jnp.int32), n)
+
+
+def segment_mean(x, segment_ids, num_segments=None):
+    n = int(num_segments)
+    s = jax.ops.segment_sum(x, segment_ids.astype(jnp.int32), n)
+    cnt = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype),
+                              segment_ids.astype(jnp.int32), n)
+    return s / jnp.maximum(cnt, 1.0).reshape((-1,) + (1,) * (x.ndim - 1))
+
+
+def segment_max(x, segment_ids, num_segments=None):
+    n = int(num_segments)
+    return jax.ops.segment_max(x, segment_ids.astype(jnp.int32), n)
+
+
+def segment_min(x, segment_ids, num_segments=None):
+    n = int(num_segments)
+    return jax.ops.segment_min(x, segment_ids.astype(jnp.int32), n)
+
+
+def segment_pool(x, segment_ids, pool_type="SUM", num_segments=None):
+    fn = {"SUM": segment_sum, "MEAN": segment_mean, "MAX": segment_max,
+          "MIN": segment_min}[pool_type.upper()]
+    return fn(x, segment_ids, num_segments)
+
+
+# --- misc --------------------------------------------------------------------
+
+def multiplex(inputs, index):
+    """Row-wise select among candidate tensors (multiplex_op.cc):
+    out[i] = inputs[index[i]][i]."""
+    stacked = jnp.stack(inputs, axis=0)                        # [M, N, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    return jnp.take_along_axis(
+        stacked, idx[None, :].reshape((1, -1) + (1,) * (stacked.ndim - 2)),
+        axis=0)[0]
+
+
+def mv(x, vec):
+    """Matrix-vector product (mv_op.cc)."""
+    return x @ vec
+
+
+def increment(x, value=1.0):
+    """x + value for a 1-element tensor (increment_op.cc)."""
+    return x + jnp.asarray(value, x.dtype)
+
+
+def p_norm(x, p=2.0, axis=None, epsilon=1e-12, keepdim=False):
+    """p-norm along an axis (p_norm_op.cc); supports inf/-inf/0."""
+    if p == float("inf"):
+        out = jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    elif p == float("-inf"):
+        out = jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    elif p == 0:
+        out = jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    else:
+        out = jnp.power(
+            jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim)
+            + epsilon, 1.0 / p)
+    return out
+
+
+def frobenius_norm(x, axis=None, keepdim=False):
+    """sqrt(sum(x^2)) over the given axes (frobenius_norm_op.cc)."""
+    if axis is not None and not isinstance(axis, int):
+        axis = tuple(axis)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    """Legacy fluid mul (mul_op.cc): flatten x to 2-D at x_num_col_dims and
+    y at y_num_col_dims, matmul, restore leading dims."""
+    x2 = x.reshape((int(np.prod(x.shape[:x_num_col_dims])), -1))
+    y2 = y.reshape((int(np.prod(y.shape[:y_num_col_dims])), -1))
+    out = x2 @ y2
+    return out.reshape(tuple(x.shape[:x_num_col_dims])
+                       + tuple(y.shape[y_num_col_dims:]))

@@ -272,7 +272,8 @@ def sample_logits(logits, label, num_samples, key, uniq=True,
                   remove_accidental_hits=True):
     """Sample negative classes and gather their logits for sampled softmax
     (sample_logits_op.cc). Returns (sampled_logits [N, T+num_samples],
-    sampled_label [N, T], samples [T+num_samples])."""
+    sampled_label [N, T], samples [N, T+num_samples] — per-row class ids
+    backing each sampled-logit column)."""
     n, _c = logits.shape
     range_max = logits.shape[1]
     label = label.astype(jnp.int32)

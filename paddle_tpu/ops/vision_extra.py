@@ -46,10 +46,10 @@ def space_to_depth(x, blocksize):
 
 
 def shuffle_channel(x, group):
-    """Channel shuffle (shuffle_channel_op.cc) — NCHW."""
-    n, c, h, w = x.shape
-    return x.reshape(n, group, c // group, h, w).transpose(
-        0, 2, 1, 3, 4).reshape(n, c, h, w)
+    """Channel shuffle (shuffle_channel_op.cc) — NCHW. Same math as
+    nn_functional.channel_shuffle; reference-op-name spelling."""
+    from .nn_functional import channel_shuffle
+    return channel_shuffle(x, group)
 
 
 def cvm(x, cvm_input, use_cvm=True):
@@ -74,21 +74,24 @@ def shuffle_batch(x, key=None):
     return x[idx], idx
 
 
+def _partial_slice(x, start_index, length):
+    # reference semantics: negative start_index counts from the end
+    start = start_index + x.shape[1] if start_index < 0 else start_index
+    end = x.shape[1] if length < 0 else start + length
+    return x[:, start:end]
+
+
 def partial_concat(xs, start_index=0, length=-1):
     """Concat a column slice of each input (partial_concat_op.cc)."""
-    pieces = []
-    for x in xs:
-        end = x.shape[1] if length < 0 else start_index + length
-        pieces.append(x[:, start_index:end])
-    return jnp.concatenate(pieces, axis=1)
+    return jnp.concatenate(
+        [_partial_slice(x, start_index, length) for x in xs], axis=1)
 
 
 def partial_sum(xs, start_index=0, length=-1):
     """Sum a column slice of each input (partial_sum_op.cc)."""
     out = None
     for x in xs:
-        end = x.shape[1] if length < 0 else start_index + length
-        piece = x[:, start_index:end]
+        piece = _partial_slice(x, start_index, length)
         out = piece if out is None else out + piece
     return out
 
@@ -129,22 +132,15 @@ def conv_shift(x, y):
 
 def im2sequence(x, kernels, strides=(1, 1), paddings=(0, 0, 0, 0)):
     """Sliding-window im2col to a sequence (im2sequence_op.cc):
-    x [N, C, H, W] -> [N*out_h*out_w, C*kh*kw] row-major over windows."""
-    kh, kw = kernels
-    sh, sw = strides
+    x [N, C, H, W] -> [N*out_h*out_w, C*kh*kw] row-major over windows.
+    Thin wrapper over nn_functional.unfold (one im2col implementation)."""
+    from .nn_functional import unfold
     pu, pl, pd, pr = paddings
     x = jnp.pad(x, ((0, 0), (0, 0), (pu, pd), (pl, pr)))
-    n, c, h, w = x.shape
-    oh = (h - kh) // sh + 1
-    ow = (w - kw) // sw + 1
-    i0 = jnp.arange(oh) * sh
-    j0 = jnp.arange(ow) * sw
-    ii = i0[:, None] + jnp.arange(kh)[None, :]                 # [oh, kh]
-    jj = j0[:, None] + jnp.arange(kw)[None, :]                 # [ow, kw]
-    # [N, C, oh, kh, ow, kw]
-    patches = x[:, :, ii[:, :, None, None], jj[None, None, :, :]]
-    patches = patches.transpose(0, 2, 4, 1, 3, 5)              # N,oh,ow,C,kh,kw
-    return patches.reshape(n * oh * ow, c * kh * kw)
+    n, c, _h, _w = x.shape
+    kh, kw = kernels
+    cols = unfold(x, kernels, strides)          # [N, C*kh*kw, oh*ow]
+    return cols.transpose(0, 2, 1).reshape(-1, c * kh * kw)
 
 
 def add_position_encoding(x, alpha=1.0, beta=1.0):

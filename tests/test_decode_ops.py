@@ -1,0 +1,202 @@
+"""CRF / beam-search / segment / misc op tests.
+
+Mirrors reference unit tests: test_linear_chain_crf_op.py,
+test_crf_decoding_op.py, test_gather_tree_op.py, test_beam_search_op.py,
+test_segment_ops.py, test_multiplex_op.py, test_mv_op.py,
+test_increment.py, test_norm_all.py (p_norm/frobenius), test_mul_op.py
+under python/paddle/fluid/tests/unittests/. CRF is verified against
+brute-force enumeration over all tag paths.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import decode_extra as D
+
+RNG = np.random.default_rng(11)
+
+
+def _f32(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+def _brute_crf(emission, trans_full, labels):
+    """Enumerate all paths for one sequence: returns (logZ, gold_score)."""
+    start_w, stop_w, trans = (trans_full[0], trans_full[1], trans_full[2:])
+    t, k = emission.shape
+
+    def score(path):
+        s = start_w[path[0]] + emission[0, path[0]]
+        for i in range(1, t):
+            s += trans[path[i - 1], path[i]] + emission[i, path[i]]
+        s += stop_w[path[-1]]
+        return s
+
+    all_scores = [score(p) for p in itertools.product(range(k), repeat=t)]
+    logz = np.logaddexp.reduce(all_scores)
+    return logz, score(labels)
+
+
+def test_linear_chain_crf_brute_force():
+    n, t, k = 3, 4, 3
+    em = _f32(n, t, k)
+    tr = _f32(k + 2, k)
+    lab = RNG.integers(0, k, (n, t)).astype(np.int32)
+    nll = np.asarray(D.linear_chain_crf(
+        jnp.asarray(em), jnp.asarray(tr), jnp.asarray(lab)))
+    for i in range(n):
+        logz, gold = _brute_crf(em[i], tr, lab[i])
+        np.testing.assert_allclose(nll[i, 0], logz - gold, rtol=1e-4,
+                                   err_msg=f"seq {i}")
+
+
+def test_linear_chain_crf_variable_length():
+    n, t, k = 2, 5, 3
+    em = _f32(n, t, k)
+    tr = _f32(k + 2, k)
+    lab = RNG.integers(0, k, (n, t)).astype(np.int32)
+    length = np.array([3, 5], np.int32)
+    nll = np.asarray(D.linear_chain_crf(
+        jnp.asarray(em), jnp.asarray(tr), jnp.asarray(lab),
+        jnp.asarray(length)))
+    logz0, gold0 = _brute_crf(em[0, :3], tr, lab[0, :3])
+    np.testing.assert_allclose(nll[0, 0], logz0 - gold0, rtol=1e-4)
+    # grads flow, finite
+    g = jax.grad(lambda e: D.linear_chain_crf(
+        e, jnp.asarray(tr), jnp.asarray(lab), jnp.asarray(length)).sum())(
+            jnp.asarray(em))
+    assert np.isfinite(np.asarray(g)).all()
+    # padded steps of seq 0 get zero emission grad
+    assert np.abs(np.asarray(g)[0, 3:]).sum() < 1e-6
+
+
+def test_crf_decoding_matches_brute_force():
+    n, t, k = 2, 4, 3
+    em = _f32(n, t, k)
+    tr = _f32(k + 2, k)
+    path = np.asarray(D.crf_decoding(jnp.asarray(em), jnp.asarray(tr)))
+    start_w, stop_w, trans = tr[0], tr[1], tr[2:]
+    for i in range(n):
+        best, best_s = None, -np.inf
+        for p in itertools.product(range(k), repeat=t):
+            s = start_w[p[0]] + em[i, 0, p[0]]
+            for j in range(1, t):
+                s += trans[p[j - 1], p[j]] + em[i, j, p[j]]
+            s += stop_w[p[-1]]
+            if s > best_s:
+                best, best_s = p, s
+        assert tuple(path[i]) == best, (path[i], best)
+
+
+def test_gather_tree():
+    # T=3, B=1, beam=2; parents chain: step2 token came from beam 1 at
+    # step1, which came from beam 0 at step0
+    ids = jnp.asarray(np.array(
+        [[[1, 2]], [[3, 4]], [[5, 6]]], np.int32))
+    parents = jnp.asarray(np.array(
+        [[[0, 0]], [[0, 0]], [[1, 0]]], np.int32))
+    out = np.asarray(D.gather_tree(ids, parents))
+    # beam 0 at final step: token 5, parent 1 -> step1 token 4 (beam1),
+    # parent of that is 0 -> step0 token 1
+    assert out[:, 0, 0].tolist() == [1, 4, 5]
+    assert out[:, 0, 1].tolist() == [1, 3, 6]
+
+
+def test_beam_search_step_and_decode():
+    b, beam, v = 1, 2, 5
+    scores = jnp.zeros((b, beam))
+    logp = jnp.asarray(np.log(np.array(
+        [[[0.1, 0.5, 0.2, 0.1, 0.1],
+          [0.3, 0.1, 0.1, 0.4, 0.1]]], np.float32)))
+    top, parent, token = D.beam_search_step(logp, scores, beam)
+    assert top.shape == (1, 2)
+    # best two of {beam0: 0.5@1, beam1: 0.4@3}
+    assert token[0, 0] == 1 and parent[0, 0] == 0
+    assert token[0, 1] == 3 and parent[0, 1] == 1
+
+    # finished beams freeze via end_token
+    fin = jnp.asarray(np.array([[True, False]]))
+    top2, parent2, token2 = D.beam_search_step(
+        logp, scores, beam, end_token=0, finished=fin)
+    assert token2[0, 0] == 0 and parent2[0, 0] == 0  # frozen at cost 0
+
+    ids = jnp.asarray(np.array([[[1, 2]], [[3, 4]]], np.int32))
+    par = jnp.asarray(np.array([[[0, 0]], [[1, 0]]], np.int32))
+    sc = jnp.asarray(np.array([[2.0, 1.0]], np.float32))
+    seqs, best = D.beam_search_decode(ids, par, sc)
+    assert seqs.shape == (1, 2)
+    assert float(best[0]) == 2.0
+    assert seqs[0].tolist() == [2, 3]  # beam0 final came from beam1 step0
+
+
+def test_segment_ops():
+    x = jnp.asarray(_f32(6, 3))
+    seg = jnp.asarray(np.array([0, 0, 1, 1, 1, 3], np.int32))
+    s = np.asarray(D.segment_sum(x, seg, 4))
+    np.testing.assert_allclose(s[0], np.asarray(x)[:2].sum(0), rtol=1e-5)
+    np.testing.assert_allclose(s[2], 0.0)
+    m = np.asarray(D.segment_mean(x, seg, 4))
+    np.testing.assert_allclose(m[1], np.asarray(x)[2:5].mean(0), rtol=1e-5)
+    mx = np.asarray(D.segment_max(x, seg, 4))
+    np.testing.assert_allclose(mx[3], np.asarray(x)[5], rtol=1e-6)
+    p = np.asarray(D.segment_pool(x, seg, "MEAN", 4))
+    np.testing.assert_allclose(p, m)
+
+
+def test_multiplex_mv_increment():
+    a, b = _f32(4, 3), _f32(4, 3)
+    idx = np.array([[0], [1], [1], [0]], np.int32)
+    out = np.asarray(D.multiplex([jnp.asarray(a), jnp.asarray(b)],
+                                 jnp.asarray(idx)))
+    ref = np.where(idx == 0, a, b)
+    np.testing.assert_allclose(out, ref)
+
+    m, vvec = _f32(3, 4), _f32(4)
+    np.testing.assert_allclose(np.asarray(D.mv(jnp.asarray(m),
+                                               jnp.asarray(vvec))),
+                               m @ vvec, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(D.increment(jnp.asarray(np.array([2.0], np.float32)),
+                               3.0)), [5.0])
+
+
+def test_p_norm_frobenius():
+    x = _f32(3, 4)
+    np.testing.assert_allclose(
+        np.asarray(D.p_norm(jnp.asarray(x), 2.0, axis=1)),
+        np.linalg.norm(x, axis=1), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(D.p_norm(jnp.asarray(x), float("inf"), axis=0)),
+        np.abs(x).max(0), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(D.p_norm(jnp.asarray(x), 0, axis=1)),
+        (x != 0).sum(1).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(D.frobenius_norm(jnp.asarray(x))),
+        np.linalg.norm(x), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(D.frobenius_norm(jnp.asarray(x), axis=(0, 1))),
+        np.linalg.norm(x), rtol=1e-5)
+
+
+def test_legacy_mul():
+    x = _f32(2, 3, 4)
+    y = _f32(4, 5)
+    out = np.asarray(D.mul(jnp.asarray(x), jnp.asarray(y),
+                           x_num_col_dims=2))
+    ref = x.reshape(6, 4) @ y
+    np.testing.assert_allclose(out, ref.reshape(2, 3, 5), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_registry_has_decode_ops():
+    from paddle_tpu.ops.registry import has_op
+    for name in ["linear_chain_crf", "crf_decoding", "gather_tree",
+                 "beam_search_step", "beam_search_decode", "segment_sum",
+                 "segment_mean", "segment_max", "segment_min",
+                 "segment_pool", "multiplex", "mv", "increment", "p_norm",
+                 "frobenius_norm", "mul"]:
+        assert has_op(name), name
