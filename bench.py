@@ -92,16 +92,22 @@ def main() -> None:
     ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     batch_data = (ids, ids)
 
+    loss = None
     for _ in range(warmup):
         loss = step(batch_data)
-    jax.block_until_ready(step.params)
+    # Hard sync via host fetch: on the tunneled TPU platform
+    # jax.block_until_ready is unreliable (can return before the step
+    # chain executes, inflating throughput ~70x) — only a device->host
+    # value transfer is a true barrier.
+    float(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step(batch_data)
-    jax.block_until_ready(loss if hasattr(loss, "block_until_ready")
-                          else step.params)
+    final_loss = float(loss)  # hard sync ends the timed region
     dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss) and final_loss < 12.0, \
+        f"training diverged during benchmark: {final_loss}"
 
     tokens_per_step = batch * seq
     tok_s = tokens_per_step * steps / dt

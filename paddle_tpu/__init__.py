@@ -43,7 +43,8 @@ def __getattr__(name):
     if name in ("nn", "optimizer", "amp", "io", "static", "jit",
                 "distributed", "metric", "vision", "models", "hapi",
                 "framework", "inference", "autograd", "ops", "profiler",
-                "quantization", "sparsity", "text", "native"):
+                "quantization", "sparsity", "text", "native", "distribution",
+                "utils"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
@@ -52,7 +53,8 @@ def __dir__():
     return sorted(set(globals()) | {
         "nn", "optimizer", "amp", "io", "static", "jit", "distributed",
         "metric", "vision", "models", "hapi", "framework", "inference",
-        "autograd", "ops", "quantization", "sparsity", "text", "native"})
+        "autograd", "ops", "quantization", "sparsity", "text", "native",
+        "distribution", "utils"})
 
 
 def Model(*args, **kwargs):

@@ -1,0 +1,153 @@
+"""Probability distributions (reference: python/paddle/distribution.py:
+Distribution:41, Uniform:168, Normal:390, Categorical:640).
+
+Same API surface (sample/entropy/log_prob/probs/kl_divergence), jax-native:
+sampling uses the framework RNG stream (core/rng.py) so results are
+reproducible under paddle_tpu.seed, and every method is safe under jit
+when given a key explicitly.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x.value
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jax.Array) \
+        else x
+
+
+def _key(seed):
+    if seed:
+        return jax.random.PRNGKey(seed)
+    from .core.rng import next_key
+    return next_key()
+
+
+class Distribution:
+    """Abstract base (reference distribution.py:41)."""
+
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) (reference distribution.py:168)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        self.name = name
+
+    def sample(self, shape, seed=0):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            jnp.shape(self.low), jnp.shape(self.high))
+        u = jax.random.uniform(_key(seed), shape, jnp.float32)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value).value))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference distribution.py:390)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        self.name = name
+
+    def sample(self, shape, seed=0):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale))
+        z = jax.random.normal(_key(seed), shape, jnp.float32)
+        return Tensor(self.loc + z * self.scale)
+
+    def log_prob(self, value):
+        v = _val(value)
+        var = self.scale * self.scale
+        return Tensor(-((v - self.loc) ** 2) / (2.0 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2.0 * math.pi))
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value).value))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2.0 * math.pi)
+                      + jnp.log(self.scale)
+                      + jnp.zeros_like(self.loc))
+
+    def kl_divergence(self, other):
+        """KL(self || other) for two Normals (reference :595)."""
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio)))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference
+    distribution.py:640)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _val(logits)
+        self.name = name
+
+    def _log_norm(self):
+        return self.logits - jax.nn.logsumexp(self.logits, axis=-1,
+                                              keepdims=True)
+
+    def sample(self, shape, seed=0):
+        draws = jax.random.categorical(
+            _key(seed), self._log_norm(), axis=-1,
+            shape=tuple(shape) + self.logits.shape[:-1])
+        return Tensor(draws)
+
+    def probs(self, value=None):
+        p = jnp.exp(self._log_norm())
+        if value is None:
+            return Tensor(p)
+        idx = _val(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            jnp.broadcast_to(p, idx.shape[:-1] + p.shape[-1:])
+            if p.ndim == 1 else p, idx, axis=-1))
+
+    def log_prob(self, value):
+        return Tensor(jnp.log(jnp.maximum(self.probs(value).value, 1e-38)))
+
+    def entropy(self):
+        logp = self._log_norm()
+        return Tensor(-(jnp.exp(logp) * logp).sum(-1))
+
+    def kl_divergence(self, other):
+        """KL(self || other) for two Categoricals (reference :774)."""
+        logp = self._log_norm()
+        logq = other._log_norm()
+        return Tensor((jnp.exp(logp) * (logp - logq)).sum(-1))

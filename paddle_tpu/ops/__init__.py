@@ -24,6 +24,7 @@ _NON_DIFF_OPS = {
     "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "isnan",
     "isinf", "isfinite", "shape", "numel", "count_nonzero",
     "nms", "multiclass_nms", "bipartite_match",
+    "crf_decoding", "gather_tree", "beam_search_decode", "shuffle_batch",
 }
 
 

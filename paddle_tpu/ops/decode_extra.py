@@ -177,15 +177,19 @@ def beam_search_decode(ids, parents, scores=None):
 
 # --- segment ops (incubate segment_pool) -------------------------------------
 
+def _num_segments(num_segments, op_name):
+    if num_segments is None:
+        raise ValueError(f"{op_name} requires static num_segments on TPU")
+    return int(num_segments)
+
+
 def segment_sum(x, segment_ids, num_segments=None):
-    n = int(num_segments) if num_segments is not None else None
-    if n is None:
-        raise ValueError("segment_sum requires static num_segments on TPU")
+    n = _num_segments(num_segments, "segment_sum")
     return jax.ops.segment_sum(x, segment_ids.astype(jnp.int32), n)
 
 
 def segment_mean(x, segment_ids, num_segments=None):
-    n = int(num_segments)
+    n = _num_segments(num_segments, "segment_mean")
     s = jax.ops.segment_sum(x, segment_ids.astype(jnp.int32), n)
     cnt = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype),
                               segment_ids.astype(jnp.int32), n)
@@ -193,12 +197,12 @@ def segment_mean(x, segment_ids, num_segments=None):
 
 
 def segment_max(x, segment_ids, num_segments=None):
-    n = int(num_segments)
+    n = _num_segments(num_segments, "segment_max")
     return jax.ops.segment_max(x, segment_ids.astype(jnp.int32), n)
 
 
 def segment_min(x, segment_ids, num_segments=None):
-    n = int(num_segments)
+    n = _num_segments(num_segments, "segment_min")
     return jax.ops.segment_min(x, segment_ids.astype(jnp.int32), n)
 
 

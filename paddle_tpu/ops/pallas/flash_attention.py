@@ -77,7 +77,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal,
     acc, m_run, l_run = jax.lax.fori_loop(0, nk_dyn, body, (acc0, m0, l0))
     denom = jnp.maximum(l_run, 1e-30)
     o_ref[0, 0] = (acc / denom[:, None]).astype(o_ref.dtype)
-    l_ref[0, 0] = m_run + jnp.log(denom)  # logsumexp per row
+    # logsumexp per row, stored [BQ, 1] (lane-1 layout keeps the block
+    # spec legal on TPU: last dim equals the array dim)
+    l_ref[0, 0] = (m_run + jnp.log(denom))[:, None]
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -85,8 +87,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qb = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]  # [BQ]
-    delta = delta_ref[0, 0]  # [BQ]
+    lse = lse_ref[0, 0]  # [BQ, 1]
+    delta = delta_ref[0, 0]  # [BQ, 1]
     nk = sk // block_k
     nk_dyn = jnp.minimum(((qb + 1) * block_q + block_k - 1) // block_k, nk)\
         if causal else nk
@@ -104,10 +106,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -132,20 +134,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(
             jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q), :]  # [BQ, 1]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # [BQ, BK]
+        p = jnp.exp(s - lse)  # [BQ, BK]
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
@@ -180,12 +182,13 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
         in_specs=[_spec_q(block_q, d), _spec_full(sk, d), _spec_full(sk, d)],
         out_specs=[
             _spec_q(block_q, d),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h_, i: (b_, h_, i),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, i: (b_, h_, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * sq * sk * d,
@@ -199,7 +202,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)  # [B,H,Sq]
+                    axis=-1, keepdims=True)  # [B,H,Sq,1]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -208,9 +211,11 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
         in_specs=[
             _spec_q(block_q, d), _spec_full(sk, d), _spec_full(sk, d),
             _spec_q(block_q, d),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h_, i: (b_, h_, i),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, i: (b_, h_, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h_, i: (b_, h_, i),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, i: (b_, h_, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=_spec_q(block_q, d),
@@ -228,9 +233,9 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i: (b_, h_, i, 0),
                          memory_space=pltpu.VMEM),
             _spec_full(sq, d),
-            pl.BlockSpec((1, 1, sq), lambda b_, h_, i: (b_, h_, 0),
+            pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, i: (b_, h_, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, sq), lambda b_, h_, i: (b_, h_, 0),
+            pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, i: (b_, h_, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[

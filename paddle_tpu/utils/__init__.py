@@ -1,3 +1,6 @@
 """Utility subpackage (reference: python/paddle/utils/)."""
 
-from . import cpp_extension
+from . import cpp_extension, download
+from ..framework import unique_name
+from .download import get_path_from_url, get_weights_path_from_url
+from .install_check import run_check

@@ -1,0 +1,116 @@
+"""Weight/dataset download cache (reference:
+python/paddle/utils/download.py get_weights_path_from_url:75,
+get_path_from_url:121).
+
+Same cache layout (~/.cache/paddle_tpu/weights/<name>) and md5 check; the
+network fetch uses urllib with retries. In air-gapped environments, a file
+already present in the cache (or a file:// URL) is used without any
+network access.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import shutil
+import tarfile
+import time
+import zipfile
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle_tpu/weights")
+DOWNLOAD_RETRY_LIMIT = 3
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "is_url"]
+
+
+def is_url(path: str) -> bool:
+    return path.startswith(("http://", "https://", "file://"))
+
+
+def _md5check(fullname: str, md5sum: str | None) -> bool:
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def _download(url: str, path: str) -> str:
+    os.makedirs(path, exist_ok=True)
+    fname = osp.split(url)[-1]
+    fullname = osp.join(path, fname)
+    if url.startswith("file://"):
+        shutil.copy(url[len("file://"):], fullname)
+        return fullname
+    import urllib.request
+    last_err = None
+    for attempt in range(DOWNLOAD_RETRY_LIMIT):
+        try:
+            tmp = fullname + ".tmp"
+            urllib.request.urlretrieve(url, tmp)
+            os.replace(tmp, fullname)
+            return fullname
+        except Exception as e:  # noqa: BLE001 - retry any fetch error
+            last_err = e
+            time.sleep(1 + attempt)
+    raise RuntimeError(f"download of {url} failed after "
+                       f"{DOWNLOAD_RETRY_LIMIT} tries: {last_err}")
+
+
+def _decompress(fname: str) -> str:
+    """Extract beside the archive. Returns the single top-level directory
+    when the archive has one (the usual weights layout), else the
+    directory holding the extracted members."""
+    dirpath = osp.dirname(fname)
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as tf:
+            names = tf.getnames()
+            tf.extractall(dirpath, filter="data")
+    elif zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as zf:
+            names = zf.namelist()
+            zf.extractall(dirpath)
+    else:
+        return fname
+    roots = {n.split("/")[0] for n in names if n}
+    if len(roots) == 1:
+        top = osp.join(dirpath, next(iter(roots)))
+        if osp.isdir(top):
+            return top
+    return dirpath
+
+
+def get_path_from_url(url: str, root_dir: str | None = None,
+                      md5sum: str | None = None,
+                      check_exist: bool = True,
+                      decompress: bool = True) -> str:
+    """Fetch (or reuse cached) `url` under `root_dir`; optionally unpack."""
+    root_dir = root_dir or WEIGHTS_HOME
+    fname = osp.split(url)[-1]
+    fullname = osp.join(root_dir, fname)
+    marker = fullname + ".extracted"
+    if check_exist and osp.exists(fullname) and _md5check(fullname, md5sum):
+        cached = True  # no network
+    else:
+        fullname = _download(url, root_dir)
+        if not _md5check(fullname, md5sum):
+            raise RuntimeError(f"md5 mismatch for {url}")
+        cached = False
+    if decompress and (tarfile.is_tarfile(fullname)
+                       or zipfile.is_zipfile(fullname)):
+        # skip re-extraction on cache hits (marker records the result path)
+        if cached and osp.exists(marker):
+            return open(marker).read().strip()
+        out = _decompress(fullname)
+        with open(marker, "w") as f:
+            f.write(out)
+        return out
+    return fullname
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    """Download weights to the shared cache, return the local path."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum, decompress=False)

@@ -1,0 +1,109 @@
+"""Distribution API + utils tests.
+
+Mirrors reference tests test_distribution.py (Uniform/Normal/Categorical)
+and test_utils download/install_check behaviors under
+python/paddle/fluid/tests/unittests/.
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distribution import Categorical, Normal, Uniform
+
+
+def test_normal_sample_logprob_entropy_kl():
+    d = Normal(1.0, 2.0)
+    s = d.sample((20000,), seed=7)
+    arr = np.asarray(s.value)
+    assert abs(arr.mean() - 1.0) < 0.1
+    assert abs(arr.std() - 2.0) < 0.1
+
+    lp = float(d.log_prob(pt.to_tensor(np.float32(1.0))).value)
+    expect = -math.log(2.0) - 0.5 * math.log(2 * math.pi)
+    assert abs(lp - expect) < 1e-5
+
+    ent = float(d.entropy().value)
+    assert abs(ent - (0.5 + 0.5 * math.log(2 * math.pi)
+                      + math.log(2.0))) < 1e-5
+
+    other = Normal(0.0, 1.0)
+    kl = float(d.kl_divergence(other).value)
+    # KL(N(1,4)||N(0,1)) = 0.5*(4 + 1 - 1 - ln 4)
+    assert abs(kl - 0.5 * (4 + 1 - 1 - math.log(4))) < 1e-5
+    assert abs(float(d.kl_divergence(d).value)) < 1e-6
+
+
+def test_uniform():
+    d = Uniform(-1.0, 3.0)
+    s = np.asarray(d.sample((10000,), seed=3).value)
+    assert s.min() >= -1.0 and s.max() < 3.0
+    assert abs(s.mean() - 1.0) < 0.1
+    assert abs(float(d.entropy().value) - math.log(4.0)) < 1e-6
+    lp_in = float(d.log_prob(pt.to_tensor(np.float32(0.0))).value)
+    assert abs(lp_in + math.log(4.0)) < 1e-6
+    assert float(d.log_prob(pt.to_tensor(np.float32(5.0))).value) == -np.inf
+
+
+def test_categorical():
+    logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+    d = Categorical(logits)
+    probs = np.asarray(d.probs().value)
+    np.testing.assert_allclose(probs, [0.2, 0.3, 0.5], rtol=1e-5)
+    s = np.asarray(d.sample((20000,), seed=5).value)
+    freq = np.bincount(s, minlength=3) / s.size
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+    ent = float(d.entropy().value)
+    assert abs(ent - (-(0.2 * math.log(0.2) + 0.3 * math.log(0.3)
+                        + 0.5 * math.log(0.5)))) < 1e-5
+    other = Categorical(np.zeros(3, np.float32))
+    kl = float(d.kl_divergence(other).value)
+    expect = sum(p * math.log(p / (1 / 3))
+                 for p in [0.2, 0.3, 0.5])
+    assert abs(kl - expect) < 1e-5
+    lp = np.asarray(d.log_prob(pt.to_tensor(
+        np.array([0, 2], np.int64))).value)
+    np.testing.assert_allclose(lp, np.log([0.2, 0.5]), rtol=1e-5)
+
+
+def test_download_cache_and_file_url(tmp_path):
+    from paddle_tpu.utils.download import get_path_from_url, is_url
+    src = tmp_path / "weights.bin"
+    src.write_bytes(b"abc123" * 100)
+    assert is_url("file:///x") and is_url("https://x") and not is_url("/x")
+    got = get_path_from_url(f"file://{src}", root_dir=str(tmp_path / "cache"))
+    assert os.path.exists(got)
+    assert open(got, "rb").read() == b"abc123" * 100
+    # cache hit: delete source, fetch again
+    src.unlink()
+    got2 = get_path_from_url(f"file://{src}",
+                             root_dir=str(tmp_path / "cache"))
+    assert got2 == got
+    import hashlib
+    md5 = hashlib.md5(b"abc123" * 100).hexdigest()
+    got3 = get_path_from_url(f"file://{src}",
+                             root_dir=str(tmp_path / "cache"), md5sum=md5)
+    assert got3 == got
+
+
+def test_download_archive_decompress(tmp_path):
+    import tarfile
+    from paddle_tpu.utils.download import get_path_from_url
+    d = tmp_path / "model"
+    d.mkdir()
+    (d / "w.txt").write_text("hi")
+    tar = tmp_path / "model.tar"
+    with tarfile.open(tar, "w") as tf:
+        tf.add(d, arcname="model")
+    out = get_path_from_url(f"file://{tar}",
+                            root_dir=str(tmp_path / "cache2"))
+    assert os.path.isdir(out)
+    assert open(os.path.join(out, "w.txt")).read() == "hi"
+
+
+def test_run_check():
+    from paddle_tpu.utils import run_check
+    run_check()
