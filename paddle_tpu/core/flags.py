@@ -111,3 +111,8 @@ define_flag("amp_dtype", "bfloat16", "Autocast low-precision dtype (bf16 first-c
 define_flag("profiler_enabled", False, "Collect RecordEvent host events.")
 define_flag("log_level", 0, "Verbose log level (higher = chattier).")
 define_flag("seed", 0, "Global RNG seed when not set explicitly.")
+define_flag("fuse_optimizer", False,
+            "Run optimizer updates on one concatenated flat buffer per "
+            "dtype group (analog of the reference's fused-optimizer IR "
+            "passes). Fewer kernels but extra concat/split copies - wins "
+            "only when per-kernel overhead dominates copy bandwidth.")

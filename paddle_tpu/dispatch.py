@@ -47,6 +47,17 @@ def _is_diff_dtype(v) -> bool:
 from jax._src import core as _jax_core
 
 
+_no_constraints_cm = None
+
+
+def _no_sharding_constraints():
+    global _no_constraints_cm
+    if _no_constraints_cm is None:
+        from .distributed.mp_layers import no_sharding_constraints
+        _no_constraints_cm = no_sharding_constraints
+    return _no_constraints_cm
+
+
 def call_fn(fn: Callable, name: str, differentiable: bool, args, kwargs):
     leaves, treedef = _flatten(args, kwargs)
     tensor_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
@@ -89,7 +100,13 @@ def call_fn(fn: Callable, name: str, differentiable: bool, args, kwargs):
             return fn(*a, **kw)
 
         primals = [raw_leaves[i] for i in diff_idx]
-        out_raw, vjp_fn = jax.vjp(closed, *primals)
+        # Eager-tape recording traces the kernel with jax.vjp, which would
+        # make mp-layer sharding constraints fire (they skip plain eager
+        # via trace_state_clean but can't tell this trace from a pjit
+        # capture). Eager semantics = single-device concrete arrays, so
+        # constraints stay off, matching un-taped eager dispatch.
+        with _no_sharding_constraints()():
+            out_raw, vjp_fn = jax.vjp(closed, *primals)
         out_leaves, out_tree = jax.tree_util.tree_flatten(out_raw)
         avals = [jax.ShapeDtypeStruct(jnp.shape(o), jnp.result_type(o))
                  for o in out_leaves]

@@ -28,6 +28,7 @@ from ..nn.container import LayerList
 from ..nn.initializer import Normal
 from ..nn.layer import Layer
 from ..nn.norm import LayerNorm
+from ..tensor import Tensor
 from ..distributed.mp_layers import (ColumnParallelLinear,
                                      ParallelCrossEntropy,
                                      RowParallelLinear,
@@ -57,6 +58,21 @@ class GPTConfig:
     moe_experts: int = 0
     moe_every: int = 2
     moe_top_k: int = 2
+    # Chunked LM loss: compute logits+CE over sequence chunks of this many
+    # positions under jax.checkpoint, so the [B, S, vocab] logits tensor
+    # never materializes (peak activation drops from S*V to chunk*V per
+    # example). 0 = off. Memory-saving analog of the reference's fused
+    # c_softmax_with_cross_entropy (which also avoids a separate softmax
+    # tensor); here it additionally avoids the full logits.
+    loss_chunk_size: int = 0
+    # Rematerialize each transformer block in backward (jax.checkpoint):
+    # O(L) -> O(1) per-layer activation memory at ~33% extra FLOPs.
+    # Single-chip analog of the reference's RecomputeOptimizer
+    # (python/paddle/fluid/optimizer.py:5288). MoE blocks are NOT
+    # rematerialized (their aux-loss side channel cannot escape
+    # jax.checkpoint), so with moe_experts>0 only the dense blocks
+    # drop out of the activation footprint.
+    remat: bool = False
 
     @property
     def head_dim(self):
@@ -82,6 +98,29 @@ def gpt_1p3b(**kw):
 def ernie_10b(**kw):
     return GPTConfig(hidden_size=4096, num_layers=48, num_heads=64,
                      max_seq_len=4096, **kw)
+
+
+def _remat_block(block, x):
+    """Run ``block`` under jax.checkpoint as ONE taped op: the pure kernel
+    takes (hidden, *param_values) so the eager tape differentiates through
+    it (and recomputes block activations in backward instead of storing
+    them), while under jit capture it reduces to a plain checkpointed call.
+    Analog of the reference's RecomputeFunction PyLayer
+    (distributed/fleet/utils/recompute.py:63)."""
+    import jax
+
+    from ..nn.layer import functional_call
+
+    named = list(block.named_parameters())
+    names = [n for n, _ in named]
+    params = [p for _, p in named]
+
+    def kernel(h, *pvals):
+        state = {"params": dict(zip(names, pvals)), "buffers": {}}
+        return jax.checkpoint(
+            lambda s, hh: functional_call(block, s, Tensor(hh)))(state, h)
+
+    return dispatch.call_fn(kernel, "remat_block", True, (x, *params), {})
 
 
 class GPTAttention(Layer):
@@ -213,6 +252,8 @@ class GPTModel(Layer):
             if use_cache:
                 x, nc = block(x, caches[i], use_cache=True)
                 new_caches.append(nc)
+            elif self.config.remat and not hasattr(block.mlp, "aux_loss"):
+                x = _remat_block(block, x)
             else:
                 x = block(x)
         x = self.ln_f(x)
@@ -241,19 +282,84 @@ class GPTForCausalLM(Layer):
             return self.lm_head(hidden)
         return F["matmul"](hidden, self.gpt.wte.weight, transpose_y=True)
 
+    def _chunked_lm_loss(self, hidden, labels, chunk):
+        """Mean next-token CE without materializing full logits: scan over
+        sequence chunks; each chunk's logits+CE run under jax.checkpoint,
+        so backward recomputes the chunk logits instead of storing them.
+        Dispatched as ONE taped op over (hidden, labels, head params) so
+        eager backward differentiates through it."""
+        import jax
+
+        from ..autograd.engine import no_grad
+        from ..nn.layer import bind_state
+
+        head = self.lm_head
+        if head is not None:
+            hp = list(head.named_parameters())
+            names = [n for n, _ in hp]
+            params = [p for _, p in hp]
+        else:
+            names = None
+            params = [self.gpt.wte.weight]
+
+        def kernel(hid, lab, *pvals):
+            lab = lab[:, 1:].astype(jnp.int32)
+            hid = hid[:, :-1]
+            b, s, d = hid.shape
+            pad = (-s) % chunk
+            if pad:
+                hid = jnp.pad(hid, ((0, 0), (0, pad), (0, 0)))
+                lab = jnp.pad(lab, ((0, 0), (0, pad)),
+                              constant_values=-100)  # ignore_index
+            nc = hid.shape[1] // chunk
+            hid = hid.reshape(b, nc, chunk, d).swapaxes(0, 1)  # [nc,B,C,D]
+            lab = lab.reshape(b, nc, chunk).swapaxes(0, 1)
+
+            def apply_head(h):
+                if head is None:
+                    return h @ pvals[0].T
+                with bind_state(head, {"params": dict(zip(names, pvals)),
+                                       "buffers": {}}):
+                    out = head(Tensor(h))
+                return out.value if isinstance(out, Tensor) else out
+
+            @jax.checkpoint
+            def chunk_fn(h, l):  # noqa: E741
+                per = self.loss_fn(Tensor(apply_head(h)), Tensor(l))
+                per = per.value if isinstance(per, Tensor) else per
+                # zero the scan-padding slots; user ignore_index positions
+                # are already zeroed by the loss (and, like the full-logits
+                # F["mean"] path, still count in the denominator)
+                return jnp.where(l != -100, per, 0.0).sum()
+
+            def body(tot, inp):
+                return tot + chunk_fn(*inp), None
+
+            with no_grad():
+                tot, _ = jax.lax.scan(
+                    body, jnp.asarray(0.0, jnp.float32), (hid, lab))
+            return tot / (b * s)
+
+        return dispatch.call_fn(kernel, "chunked_lm_loss", True,
+                                (hidden, labels, *params), {})
+
     def forward(self, input_ids, labels=None, position_ids=None,
                 caches=None):
         if caches is not None:
             hidden, new_caches = self.gpt(input_ids, position_ids, caches)
             return self.logits(hidden), new_caches
         hidden = self.gpt(input_ids, position_ids)
-        logits = self.logits(hidden)
         if labels is None:
-            return logits
+            return self.logits(hidden)
         # next-token LM loss
-        shift_logits = logits[:, :-1]
-        shift_labels = labels[:, 1:]
-        loss = F["mean"](self.loss_fn(shift_logits, shift_labels))
+        if self.config.loss_chunk_size:
+            loss = self._chunked_lm_loss(hidden, labels,
+                                         self.config.loss_chunk_size)
+        else:
+            logits = self.logits(hidden)
+            shift_logits = logits[:, :-1]
+            shift_labels = labels[:, 1:]
+            loss = F["mean"](self.loss_fn(shift_logits, shift_labels))
         # MoE load-balancing aux losses, if any blocks are MoE
         for block in self.gpt.h:
             aux = getattr(block.mlp, "aux_loss", None)

@@ -27,8 +27,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 512-blocks measured fastest on TPU v5e (grad 4.2 ms vs 8.0 ms at 128
+# for B8 H12 S1024 D64); auto-clamped to the sequence length.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
 
 
@@ -273,6 +275,21 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
 _flash_attention_bhsd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _resolve_blocks(sq, sk, block_q, block_k):
+    """Largest 128-multiple block that divides the sequence length, capped
+    at the requested block — so S=640 runs with 128-blocks rather than
+    falling off the flash path entirely."""
+    def best(s, cap):
+        pick = 0
+        m = 128
+        while m <= min(cap, s):
+            if s % m == 0:
+                pick = m
+            m += 128
+        return pick or cap
+    return best(sq, block_q), best(sk, block_k)
+
+
 def flash_attention_supported(q_shape, k_shape, backend: Optional[str] =
                               None, block_q=DEFAULT_BLOCK_Q,
                               block_k=DEFAULT_BLOCK_K) -> bool:
@@ -282,8 +299,10 @@ def flash_attention_supported(q_shape, k_shape, backend: Optional[str] =
         return False
     b, sq, h, d = q_shape
     sk = k_shape[1]
+    block_q, block_k = _resolve_blocks(sq, sk, block_q, block_k)
     return (sq % block_q == 0 and sk % block_k == 0 and
-            d in (64, 128, 256) and sq >= block_q and sk >= block_k)
+            block_q % 128 == 0 and block_k % 128 == 0 and
+            d in (64, 128, 256))
 
 
 def flash_attention(q, k, v, causal: bool = False,
@@ -293,6 +312,7 @@ def flash_attention(q, k, v, causal: bool = False,
     """Public entry, layout [B, S, H, D] (matching
     scaled_dot_product_attention)."""
     b, sq, h, d = q.shape
+    block_q, block_k = _resolve_blocks(sq, k.shape[1], block_q, block_k)
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     qT = jnp.swapaxes(q, 1, 2)
     kT = jnp.swapaxes(k, 1, 2)

@@ -47,6 +47,10 @@ class DGCMomentum(Momentum):
     ramped ``sparsity`` schedule), keeping the rest for later steps.
     """
 
+    # top-k threshold is per-tensor — a fused flat buffer would compute
+    # one global threshold and starve small-magnitude params
+    _elementwise_update = False
+
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  rampup_begin_step: int = 0, rampup_step: int = 1,
                  sparsity: Sequence[float] = (0.999,),

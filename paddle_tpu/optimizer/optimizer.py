@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.enforce import InvalidArgumentError
 from ..tensor import Parameter, Tensor
@@ -100,22 +101,21 @@ class Optimizer:
         if self._grad_clip is not None:
             grads = self._grad_clip.apply(grads)
         lr = self.get_lr()
-        for n, p in named:
-            if n not in grads:
-                continue
-            g = grads[n]
+        live = [(n, p) for n, p in named if n in grads]
+        for n, p in live:
             if n not in self._state:
                 self._state[n] = self._init_state(p.value)
-            if self._weight_decay and self._decoupled_wd is False:
-                g = g + self._weight_decay * p.value
-            new_v, new_s = self._update(p.value, g, self._state[n], lr,
-                                        self._global_step)
-            if self._weight_decay and self._decoupled_wd:
-                new_v = new_v - lr * self._weight_decay * p.value
-            p.value = new_v
-            self._state[n] = new_s
+        new_p, new_s = self._apply_flat(
+            [p.value for _, p in live], [grads[n] for n, _ in live],
+            [self._state[n] for n, _ in live], lr, self._global_step)
+        for (n, p), nv, ns in zip(live, new_p, new_s):
+            p.value = nv
+            self._state[n] = ns
 
     _decoupled_wd = False  # AdamW overrides
+    # Elementwise _update rule => safe to run on one fused flat
+    # buffer. Optimizers with per-tensor norms (LAMB/LARS) opt out.
+    _elementwise_update = True
 
     def clear_grad(self) -> None:
         if self._parameter_list:
@@ -154,24 +154,73 @@ class Optimizer:
             grads = jax.tree_util.tree_unflatten(gdef, flat_g)
 
         flat_p, pdef = jax.tree_util.tree_flatten(params)
-        flat_g = jax.tree_util.tree_leaves(grads)
+        # flatten_up_to (not tree_leaves) so a None grad stays a leaf in
+        # its slot instead of vanishing and misaligning the zip
+        flat_g = pdef.flatten_up_to(grads)
         flat_s = pdef.flatten_up_to(opt_state["slots"])
-        new_p, new_s = [], []
-        for v, g, s in zip(flat_p, flat_g, flat_s):
-            if g is None:
-                new_p.append(v)
-                new_s.append(s)
-                continue
+        new_p, new_s = self._apply_flat(flat_p, flat_g, flat_s, lr, step)
+        return (jax.tree_util.tree_unflatten(pdef, new_p),
+                {"slots": jax.tree_util.tree_unflatten(pdef, new_s),
+                 "step": step})
+
+    def _apply_flat(self, flat_p, flat_g, flat_s, lr, step):
+        """Shared core of step()/apply_gradients: per-param _update calls,
+        or — under FLAGS_fuse_optimizer — one concatenated update per
+        (dtype, slot-dtypes) group."""
+        new_p: list = [None] * len(flat_p)
+        new_s: list = [None] * len(flat_p)
+
+        def update_with_wd(v, g, s):
             if self._weight_decay and not self._decoupled_wd:
                 g = g + self._weight_decay * v
             nv, ns = self._update(v, g, s, lr, step)
             if self._weight_decay and self._decoupled_wd:
                 nv = nv - lr * self._weight_decay * v
-            new_p.append(nv)
-            new_s.append(ns)
-        return (jax.tree_util.tree_unflatten(pdef, new_p),
-                {"slots": jax.tree_util.tree_unflatten(pdef, new_s),
-                 "step": step})
+            return nv, ns
+
+        def update_one(i, v, g, s):
+            new_p[i], new_s[i] = update_with_wd(v, g, s)
+
+        # Fused update: concatenate same-dtype params into one flat buffer
+        # so the whole optimizer step is a handful of large elementwise
+        # kernels instead of ~10 tiny ones per parameter (TPU-native
+        # analog of the reference's coalesce_grad_tensor_pass +
+        # fuse_optimizer_ops_pass; paddle/fluid/framework/ir/).
+        from ..core.flags import get_flag
+        fuse = (get_flag("fuse_optimizer") and self._elementwise_update
+                and getattr(self, "_apply_decay_param_fun", None) is None)
+        groups: Dict[Any, list] = {}
+        for i, (v, g, s) in enumerate(zip(flat_p, flat_g, flat_s)):
+            if g is None:
+                new_p[i], new_s[i] = v, s
+            elif fuse and all(s[k].shape == v.shape for k in s):
+                key = (str(v.dtype),
+                       tuple((k, str(s[k].dtype)) for k in sorted(s)))
+                groups.setdefault(key, []).append(i)
+            else:
+                update_one(i, v, g, s)
+
+        for key, idxs in groups.items():
+            if len(idxs) == 1:
+                i = idxs[0]
+                update_one(i, flat_p[i], flat_g[i], flat_s[i])
+                continue
+            sizes = [int(np.prod(flat_p[i].shape)) for i in idxs]
+            offs = list(np.cumsum(sizes)[:-1])
+            cat_v = jnp.concatenate([flat_p[i].ravel() for i in idxs])
+            cat_g = jnp.concatenate([flat_g[i].ravel() for i in idxs])
+            cat_s = {k: jnp.concatenate([flat_s[i][k].ravel()
+                                         for i in idxs])
+                     for k in flat_s[idxs[0]]}
+            nv, ns = update_with_wd(cat_v, cat_g, cat_s)
+            for i, piece in zip(idxs, jnp.split(nv, offs)):
+                new_p[i] = piece.reshape(flat_p[i].shape)
+            split_s = {k: jnp.split(ns[k], offs) for k in ns}
+            for j, i in enumerate(idxs):
+                new_s[i] = {k: split_s[k][j].reshape(flat_p[i].shape)
+                            for k in split_s}
+
+        return new_p, new_s
 
     # -- state dict -----------------------------------------------------------
 
@@ -379,6 +428,8 @@ class Lamb(Optimizer):
     """Layer-wise adaptive moments for large-batch training
     (reference: optimizer/lamb.py, operators/optimizers/lamb_op)."""
 
+    _elementwise_update = False  # per-param trust ratio uses tensor norms
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
                  beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
                  grad_clip=None, exclude_from_weight_decay_fn=None,
@@ -413,6 +464,8 @@ class Lamb(Optimizer):
 class LarsMomentum(Optimizer):
     """LARS (reference: fluid/optimizer.py LarsMomentumOptimizer,
     operators/optimizers/lars_momentum_op.cu)."""
+
+    _elementwise_update = False  # per-layer norms
 
     def __init__(self, learning_rate=0.001, momentum=0.9,
                  lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,

@@ -69,3 +69,17 @@ def test_supported_gate():
                                         backend="tpu")
     assert not fa.flash_attention_supported((1, 256, 2, 64), (1, 256, 2, 64),
                                             backend="cpu")
+
+
+def test_resolve_blocks_divisor_fallback():
+    """S=640 (multiple of 128, not of 512) must stay on the flash path."""
+    from paddle_tpu.ops.pallas.flash_attention import (
+        _resolve_blocks, flash_attention_supported)
+
+    assert _resolve_blocks(640, 640, 512, 512) == (128, 128)
+    assert _resolve_blocks(1024, 1024, 512, 512) == (512, 512)
+    assert _resolve_blocks(256, 1024, 512, 512) == (256, 512)
+    assert flash_attention_supported((2, 640, 4, 64), (2, 640, 4, 64),
+                                     backend="tpu")
+    assert not flash_attention_supported((2, 100, 4, 64), (2, 100, 4, 64),
+                                         backend="tpu")

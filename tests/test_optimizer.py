@@ -183,3 +183,84 @@ def test_reduce_on_plateau():
     for loss in [1.0, 1.0, 1.0, 1.0]:
         s.step(loss)
     assert s() == 0.5
+
+
+def test_fused_apply_gradients_matches_unfused():
+    """FLAGS_fuse_optimizer concatenated update == per-param update."""
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.optimizer as optim
+
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.standard_normal((8, 4)).astype("f")),
+              "b": jnp.asarray(rng.standard_normal((4,)).astype("f")),
+              "e": jnp.asarray(rng.standard_normal((16, 8)).astype("f"))}
+    grads = {k: jnp.asarray(rng.standard_normal(v.shape).astype("f"))
+             for k, v in params.items()}
+
+    def run(fused):
+        pt.set_flags({"fuse_optimizer": fused})
+        try:
+            opt = optim.AdamW(learning_rate=0.1, weight_decay=0.01)
+            st = opt.init(params)
+            p, st = opt.apply_gradients(params, grads, st)
+            p, st = opt.apply_gradients(p, grads, st)
+            return p, st
+        finally:
+            pt.set_flags({"fuse_optimizer": False})
+
+    p0, s0 = run(False)
+    p1, s1 = run(True)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p0[k]), np.asarray(p1[k]),
+                                   rtol=1e-6, atol=1e-7)
+        for slot in ("moment1", "moment2"):
+            np.testing.assert_allclose(
+                np.asarray(s0["slots"][k][slot]),
+                np.asarray(s1["slots"][k][slot]), rtol=1e-6, atol=1e-7)
+
+
+def test_fused_eager_step_matches_unfused():
+    """FLAGS_fuse_optimizer also applies to the eager step() path."""
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.optimizer as optim
+
+    rng = np.random.default_rng(1)
+    w0 = rng.standard_normal((6, 3)).astype("f")
+    b0 = rng.standard_normal((3,)).astype("f")
+    gw = rng.standard_normal((6, 3)).astype("f")
+    gb = rng.standard_normal((3,)).astype("f")
+
+    def run(fused):
+        pt.set_flags({"fuse_optimizer": fused})
+        try:
+            w, b = pt.Parameter(w0.copy()), pt.Parameter(b0.copy())
+            opt = optim.Adam(learning_rate=0.1, parameters=[w, b])
+            for _ in range(3):
+                w.grad, b.grad = pt.Tensor(gw), pt.Tensor(gb)
+                opt.step()
+            return w.numpy(), b.numpy()
+        finally:
+            pt.set_flags({"fuse_optimizer": False})
+
+    (w_u, b_u), (w_f, b_f) = run(False), run(True)
+    np.testing.assert_allclose(w_u, w_f, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(b_u, b_f, rtol=1e-6, atol=1e-7)
+
+
+def test_apply_gradients_none_grad_alignment():
+    """A None grad leaf must leave its param (and only its param)
+    untouched — tree_leaves drops None, which once misaligned the zip."""
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu.optimizer as optim
+
+    params = {"a": jnp.ones((2,)), "b": jnp.ones((3,))}
+    grads = {"a": None, "b": jnp.ones((3,))}
+    opt = optim.SGD(learning_rate=0.5)
+    st = opt.init(params)
+    new_p, _ = opt.apply_gradients(params, grads, st)
+    np.testing.assert_allclose(np.asarray(new_p["a"]), [1.0, 1.0])
+    np.testing.assert_allclose(np.asarray(new_p["b"]), [0.5, 0.5, 0.5])
