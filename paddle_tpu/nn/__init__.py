@@ -5,15 +5,18 @@ Reference parity: python/paddle/nn/.
 
 from . import functional
 from . import initializer
+from . import utils
 from .activation import (CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid,
                          Hardswish, Hardtanh, LeakyReLU, LogSigmoid,
                          LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6, SELU,
                          Sigmoid, Silu, Softmax, Softplus, Softshrink,
-                         Softsign, Swish, Tanh, Tanhshrink)
+                         Softsign, Swish, Tanh, Tanhshrink,
+                         ThresholdedReLU)
 from .common import (AlphaDropout, Bilinear, CosineSimilarity, Dropout,
                      Dropout2D, Embedding, Flatten, Identity, Linear, Pad1D,
-                     Pad2D, PixelShuffle, Upsample, UpsamplingBilinear2D,
-                     UpsamplingNearest2D)
+                     Pad2D, Pad3D, PixelShuffle, Upsample,
+                     UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
+                     Unfold, Fold)
 from .container import LayerDict, LayerList, ParameterList, Sequential
 from .conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D,
                    Conv3DTranspose, DeformConv2D)
@@ -22,13 +25,16 @@ from .layer import (Layer, bind_state, functional_call, functional_state)
 from .loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
                    CrossEntropyLoss, CTCLoss, HingeEmbeddingLoss,
                    HSigmoidLoss, KLDivLoss, L1Loss, MarginRankingLoss,
-                   MSELoss, NLLLoss, SmoothL1Loss, TripletMarginLoss)
+                   MSELoss, MultiLabelSoftMarginLoss, NLLLoss,
+                   PoissonNLLLoss, GaussianNLLLoss, SmoothL1Loss,
+                   SoftMarginLoss, TripletMarginLoss)
 from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                    GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
                    LayerNorm, LocalResponseNorm, RMSNorm, SyncBatchNorm)
 from .pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
                       AdaptiveMaxPool1D, AdaptiveMaxPool2D, AvgPool1D,
-                      AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D)
+                      AvgPool2D, AvgPool3D, LPPool2D, MaxPool1D,
+                      MaxPool2D, MaxPool3D)
 from .rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell)
 from .transformer import (MultiHeadAttention, Transformer,
                           TransformerDecoder, TransformerDecoderLayer,

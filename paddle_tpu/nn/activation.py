@@ -194,3 +194,12 @@ class GLU(Layer):
 
     def forward(self, x):
         return F["glu"](x, self.axis)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F["thresholded_relu"](x, self.threshold)

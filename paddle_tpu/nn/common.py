@@ -192,3 +192,50 @@ class CosineSimilarity(Layer):
 
     def forward(self, x1, x2):
         return F["cosine_similarity"](x1, x2, axis=self.axis, eps=self.eps)
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW"):
+        super().__init__()
+        self._pad = padding if isinstance(padding, (list, tuple)) else \
+            [padding] * 6
+        self._mode, self._value, self._fmt = mode, value, data_format
+
+    def forward(self, x):
+        return F["pad3d"](x, self._pad, mode=self._mode, value=self._value,
+                          data_format=self._fmt)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW"):
+        super().__init__()
+        self._pad = padding if isinstance(padding, (list, tuple)) else \
+            [padding] * 4
+        self._fmt = data_format
+
+    def forward(self, x):
+        return F["zeropad2d"](x, self._pad, data_format=self._fmt)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        k, s, p, d = self._args
+        return F["unfold"](x, k, strides=s, paddings=p, dilations=d)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings,
+                      dilations)
+
+    def forward(self, x):
+        o, k, s, p, d = self._args
+        return F["fold"](x, o, k, strides=s, paddings=p, dilations=d)

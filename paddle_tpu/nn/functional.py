@@ -17,8 +17,9 @@ _NN_OPS = [
     "linear", "embedding", "one_hot", "bilinear", "dropout", "dropout2d",
     "dropout3d", "alpha_dropout", "label_smooth", "cosine_similarity",
     "normalize", "sequence_mask", "pad", "interpolate", "upsample",
-    "pixel_shuffle", "pixel_unshuffle", "unfold", "grid_sample",
-    "affine_grid", "temporal_shift", "channel_shuffle",
+    "pixel_shuffle", "pixel_unshuffle", "unfold", "fold", "grid_sample",
+    "affine_grid", "temporal_shift", "channel_shuffle", "pad3d",
+    "zeropad2d", "thresholded_relu",
     # conv
     "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
     "conv3d_transpose", "deformable_conv",
@@ -30,8 +31,9 @@ _NN_OPS = [
     "spp", "psroi_pool", "prroi_pool", "yolov3_loss",
     # pooling
     "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
-    "avg_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
-    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "avg_pool3d", "lp_pool2d", "adaptive_avg_pool1d",
+    "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+    "adaptive_max_pool2d",
     # norm
     "layer_norm", "rms_norm", "batch_norm", "instance_norm", "group_norm",
     "local_response_norm",
@@ -43,6 +45,8 @@ _NN_OPS = [
     "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
     "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
     "square_error_cost", "log_loss", "sigmoid_focal_loss",
+    "soft_margin_loss", "multi_label_soft_margin_loss",
+    "poisson_nll_loss", "gaussian_nll_loss",
     # extended loss family (ops/loss_extra.py)
     "hinge_loss", "huber_loss", "modified_huber_loss", "rank_loss",
     "margin_rank_loss", "bpr_loss", "teacher_student_sigmoid_loss",

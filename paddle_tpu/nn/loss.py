@@ -192,3 +192,48 @@ class HSigmoidLoss(Layer):
             input, label, self.weight, self.bias,
             num_classes=self.num_classes, path_table=path_table,
             path_code=path_code)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F["soft_margin_loss"](input, label,
+                                     reduction=self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F["multi_label_soft_margin_loss"](
+            input, label, weight=self.weight, reduction=self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._args = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):  # noqa: A002
+        li, fu, ep, red = self._args
+        return F["poisson_nll_loss"](input, label, log_input=li, full=fu,
+                                     epsilon=ep, reduction=red)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (full, epsilon, reduction)
+
+    def forward(self, input, label, variance):  # noqa: A002
+        fu, ep, red = self._args
+        return F["gaussian_nll_loss"](input, label, variance, full=fu,
+                                      epsilon=ep, reduction=red)

@@ -141,3 +141,16 @@ class AdaptiveAvgPool3D(Layer):
     def forward(self, x):
         return F["adaptive_avg_pool3d"](x, self.output_size,
                                         self.data_format)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                      data_format)
+
+    def forward(self, x):
+        p, k, s, pad, cm, fmt = self._args
+        return F["lp_pool2d"](x, p, k, stride=s, padding=pad,
+                              ceil_mode=cm, data_format=fmt)

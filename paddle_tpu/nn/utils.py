@@ -1,0 +1,131 @@
+"""Parametrization hooks: weight_norm / spectral_norm.
+
+Reference parity: python/paddle/nn/utils/weight_norm_hook.py
+(weight_norm/remove_weight_norm) and spectral_norm_hook.py — implemented
+as forward pre-hooks that recompute the derived weight from the
+reparametrized parameters, so optimizers see only (g, v) / the raw
+orig weight, and the derived value participates in autograd through
+the eager tape / jit trace.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import dispatch
+from ..tensor import Parameter, Tensor
+from .layer import Layer
+
+F = dispatch.wrapped_ops
+
+
+def _norm_except_dim(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return F["sqrt"](F["sum"](v * v, axis=axes, keepdim=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    """Reparametrize ``layer.<name>`` as g * v / ||v|| (per-slice norms
+    along ``dim``). Returns the layer."""
+    w = layer._parameters[name]
+    dim = dim % w.ndim if dim is not None else None
+    if dim is None:
+        g0 = F["sqrt"](F["sum"](Tensor(w.value) * Tensor(w.value)))
+        g0 = g0.value.reshape(())
+    else:
+        g0 = _norm_except_dim(Tensor(w.value), dim).value
+    del layer._parameters[name]
+    layer.__setattr__(name + "_g", Parameter(g0))
+    layer.__setattr__(name + "_v", Parameter(w.value))
+
+    def _compute(lyr, _inputs):
+        g = lyr._parameters[name + "_g"]
+        v = lyr._parameters[name + "_v"]
+        if dim is None:
+            nrm = F["sqrt"](F["sum"](v * v))
+        else:
+            nrm = _norm_except_dim(v, dim)
+        object.__setattr__(lyr, name, v * (g / nrm))
+        return None
+
+    helper = layer.register_forward_pre_hook(_compute)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (helper, dim)
+    _compute(layer, None)  # materialize once for direct .weight access
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    helper, dim = layer._weight_norm_hooks.pop(name)
+    helper.remove()
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    if dim is None:
+        nrm = F["sqrt"](F["sum"](Tensor(v.value) * Tensor(v.value)))
+    else:
+        nrm = _norm_except_dim(Tensor(v.value), dim)
+    w = (Tensor(v.value) * (Tensor(g.value) / nrm)).value
+    layer.__dict__.pop(name, None)
+    layer.__setattr__(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations:
+                  int = 1, eps: float = 1e-12, dim: int = 0):
+    """Divide ``layer.<name>`` by its largest singular value, estimated by
+    power iteration on persistent (u, v) buffers (reference
+    spectral_norm_hook.py / fluid SpectralNorm layer)."""
+    w = layer._parameters[name]
+    dim = dim % w.ndim
+    mat = jnp.moveaxis(w.value, dim, 0).reshape(w.shape[dim], -1)
+    h, ww = mat.shape
+    import numpy as np
+    rng = np.random.default_rng(0)
+    layer.register_buffer(name + "_u", Tensor(
+        _l2norm(jnp.asarray(rng.standard_normal(h), mat.dtype), eps)))
+    layer.register_buffer(name + "_v", Tensor(
+        _l2norm(jnp.asarray(rng.standard_normal(ww), mat.dtype), eps)))
+    orig = Parameter(w.value)
+    del layer._parameters[name]
+    layer.__setattr__(name + "_orig", orig)
+
+    def _compute(lyr, _inputs):
+        wo = lyr._parameters[name + "_orig"]
+        u = lyr._buffers[name + "_u"].value
+        v = lyr._buffers[name + "_v"].value
+        m_raw = jnp.moveaxis(wo.value, dim, 0).reshape(wo.shape[dim], -1)
+        for _ in range(max(1, n_power_iterations)):
+            v = _l2norm(m_raw.T @ u, eps)
+            u = _l2norm(m_raw @ v, eps)
+        lyr._buffers[name + "_u"].value = u
+        lyr._buffers[name + "_v"].value = v
+        # sigma through the live (possibly taped/traced) weight
+        wt = wo if isinstance(wo, Tensor) else Tensor(wo)
+        flat = F["reshape"](F["moveaxis"](wt, dim, 0),
+                            (wo.shape[dim], -1))
+        sigma = F["sum"](flat * Tensor(jnp.outer(u, v)))
+        object.__setattr__(lyr, name, wt / sigma)
+        return None
+
+    layer.register_forward_pre_hook(_compute)
+    _compute(layer, None)
+    return layer
+
+
+def _l2norm(x, eps):
+    return x / (jnp.linalg.norm(x) + eps)
+
+
+def parameters_to_vector(parameters):
+    return F["concat"]([F["reshape"](p, (-1,)) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters):
+    import numpy as np
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p.value = vec[offset:offset + n].value.reshape(p.shape) \
+            if isinstance(vec, Tensor) else vec[offset:offset + n].reshape(
+                p.shape)
+        offset += n

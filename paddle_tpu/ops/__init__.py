@@ -8,14 +8,14 @@ eager dispatcher can enumerate them.
 
 import inspect as _inspect
 
-from . import creation, decode_extra, detection, linalg, loss_extra, \
-    manipulation, math, nn_functional, random, rnn, search, sequence, \
-    vision_extra
+from . import creation, decode_extra, detection, fft, linalg, \
+    loss_extra, manipulation, math, math_extra, nn_functional, random, \
+    rnn, search, sequence, vision_extra
 from .registry import OpDef, all_ops, get_op, has_op, register_op
 
 _DYNAMIC_SHAPE_OPS = {
     "nonzero", "masked_select", "unique", "unique_consecutive", "where",
-    "sequence_unpad",
+    "sequence_unpad", "bincount",
 }
 _NON_DIFF_OPS = {
     "argmax", "argmin", "argsort", "randint", "randperm", "one_hot",
@@ -31,7 +31,7 @@ _NON_DIFF_OPS = {
 def _auto_register():
     for mod in (creation, math, manipulation, search, linalg, random,
                 nn_functional, rnn, sequence, detection, loss_extra,
-                vision_extra, decode_extra):
+                vision_extra, decode_extra, math_extra, fft):
         short = mod.__name__.rsplit(".", 1)[-1]
         for name, fn in vars(mod).items():
             if name.startswith("_") or not callable(fn):

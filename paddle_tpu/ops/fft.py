@@ -1,0 +1,73 @@
+"""FFT family (reference: python/paddle/fft.py — paddle.fft namespace,
+backed by the spectral op kernels). Thin wrappers over jnp.fft so the
+transforms are XLA-compiled and differentiable."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def fft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.hfft(x, n=n, axis=axis, norm=norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ihfft(x, n=n, axis=axis, norm=norm)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.fft2(x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.ifft2(x, s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.rfft2(x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.irfft2(x, s=s, axes=axes, norm=norm)
+
+
+def fftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+def ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    return out.astype(dtype) if dtype else out
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    return out.astype(dtype) if dtype else out

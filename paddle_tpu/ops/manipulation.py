@@ -148,10 +148,13 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):  # noqa: A002
         # Spatial-only pad (e.g. [left,right,top,bottom] on NCHW).
         nsp = len(pad) // 2
         width = [(0, 0)] * nd
-        # pad is given innermost-last like the reference's functional.pad.
+        # pad pairs are given innermost-FIRST like the reference's
+        # functional.pad: (left, right, top, bottom, front, back) with
+        # left/right on the last spatial dim (reference:
+        # python/paddle/nn/functional/common.py:1149).
         spatial = list(range(nd - nsp, nd)) if data_format.startswith("NC") \
             else list(range(1, 1 + nsp))
-        for i, dim in enumerate(spatial):
+        for i, dim in enumerate(reversed(spatial)):
             width[dim] = (pad[2 * i], pad[2 * i + 1])
     mode_map = {"constant": "constant", "reflect": "reflect",
                 "replicate": "edge", "circular": "wrap"}

@@ -1,0 +1,217 @@
+"""Extended math / linalg / manipulation ops.
+
+Reference parity: the long tail of python/paddle/tensor/{math,linalg,
+manipulation,stat}.py beyond the core families (frexp/ldexp/trapezoid-class
+utilities, strided views, masked scatter, LU unpacking, pairwise
+distances). Pure jax functions — safe under jit and from eager dispatch.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# --- elementwise / numeric utilities ----------------------------------------
+
+def frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+def ldexp(x, y):
+    return jnp.ldexp(x, y.astype(jnp.int32) if hasattr(y, "astype") else y)
+
+
+def renorm(x, p, axis, max_norm):
+    """Clamp the p-norm of every sub-tensor along ``axis`` to max_norm."""
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    y1 = _slice_axis(y, axis, 1, None)
+    y0 = _slice_axis(y, axis, 0, -1)
+    if x is not None:
+        d = _slice_axis(x, axis, 1, None) - _slice_axis(x, axis, 0, -1)
+    else:
+        d = 1.0 if dx is None else dx
+    return jnp.cumsum(d * (y0 + y1) / 2.0, axis=axis)
+
+
+def _slice_axis(a, axis, start, stop):
+    idx = [slice(None)] * a.ndim
+    idx[axis % a.ndim] = slice(start, stop)
+    return a[tuple(idx)]
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdim)
+
+
+# --- combinatorics ----------------------------------------------------------
+
+def cartesian_prod(xs):
+    """List of 1-D tensors -> [prod(len), k] cartesian product."""
+    grids = jnp.meshgrid(*xs, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+def combinations(x, r=2, with_replacement=False):
+    """All r-combinations of a 1-D tensor's elements, as [C, r]."""
+    n = x.shape[0]
+    gen = itertools.combinations_with_replacement if with_replacement \
+        else itertools.combinations
+    idx = np.array(list(gen(range(n), r)), dtype=np.int32)
+    if idx.size == 0:
+        return jnp.zeros((0, r), x.dtype)
+    return x[idx]
+
+
+# --- indexing / views -------------------------------------------------------
+
+def index_fill(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis % x.ndim] = index
+    return x.at[tuple(idx)].set(value)
+
+
+def masked_scatter(x, mask, value):
+    """Fill True positions of ``mask`` with consecutive elements of
+    ``value`` (row-major), like the reference masked_scatter."""
+    m = jnp.broadcast_to(mask, x.shape)
+    pos = jnp.cumsum(m.reshape(-1)) - 1
+    src = value.reshape(-1)
+    gathered = src[jnp.clip(pos, 0, src.shape[0] - 1)].reshape(x.shape)
+    return jnp.where(m, gathered.astype(x.dtype), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    """Batch of vectors -> batch of diagonal matrices (reference
+    diag_embed_op)."""
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    rng = jnp.arange(x.shape[-1])
+    r = rng + max(-offset, 0)
+    c = rng + max(offset, 0)
+    out = base.at[..., r, c].set(x)
+    # move the two new axes to dim1/dim2
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for dest, src in order:
+            perm.insert(dest, src)
+        out = jnp.transpose(out, perm)
+    return out
+
+
+def unflatten(x, axis, shape):
+    axis = axis % x.ndim
+    return x.reshape(x.shape[:axis] + tuple(shape) + x.shape[axis + 1:])
+
+
+def view_as(x, other):
+    return x.reshape(other.shape)
+
+
+def as_strided(x, shape, stride, offset=0):
+    """Strided view via gather (XLA has no aliased strides; reference
+    as_strided semantics on a contiguous buffer)."""
+    flat = x.reshape(-1)
+    idx = jnp.asarray(offset)
+    for s, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(s) * st
+    return flat[idx.reshape(-1)].reshape(tuple(shape))
+
+
+# --- counting ---------------------------------------------------------------
+
+def bincount(x, weights=None, minlength=0):
+    """Length is data-dependent unless x is concrete (eager) — mirrors the
+    reference's dynamic-output bincount."""
+    length = int(max(int(jnp.max(x)) + 1 if x.size else 0, minlength))
+    return jnp.bincount(x.reshape(-1), weights=weights, length=length)
+
+
+# --- linalg tail ------------------------------------------------------------
+
+def lu_unpack(lu_data, pivots, unpack_ludata=True, unpack_pivots=True):
+    """(LU, pivots) -> (P, L, U) (reference lu_unpack_op). ``pivots`` are
+    1-based sequential row swaps as returned by lu()."""
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(lu_data[..., :, :k], -1) + \
+            jnp.eye(m, k, dtype=lu_data.dtype)
+        U = jnp.triu(lu_data[..., :k, :])
+    if unpack_pivots:
+        perm = jnp.broadcast_to(jnp.arange(m),
+                                pivots.shape[:-1] + (m,))
+
+        def swap(i, p):
+            pi = pivots[..., i].astype(jnp.int32) - 1
+            a = p[..., i]
+            b = jnp.take_along_axis(p, pi[..., None], -1)[..., 0]
+            p = jnp.put_along_axis(
+                p, jnp.full(p.shape[:-1] + (1,), i), b[..., None], -1,
+                inplace=False)
+            p = jnp.put_along_axis(p, pi[..., None], a[..., None], -1,
+                                   inplace=False)
+            return p
+
+        npiv = pivots.shape[-1]
+        for i in range(npiv):
+            perm = swap(i, perm)
+        P = jax.nn.one_hot(perm, m, dtype=lu_data.dtype)
+        P = jnp.swapaxes(P, -1, -2)
+    return P, L, U
+
+
+def cdist(x, y, p=2.0):
+    """Pairwise p-distance between row sets: [..., M, D] x [..., N, D] ->
+    [..., M, N]."""
+    diff = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == float("inf"):
+        return jnp.max(diff, axis=-1)
+    if p == 0:
+        return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
+    return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = jnp.abs(x - y) + epsilon
+    if p == float("inf"):
+        return jnp.max(d, axis=-1, keepdims=keepdim)
+    return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+
+# --- complex construction ---------------------------------------------------
+
+def complex(real, imag):  # noqa: A001 - mirrors the public API name
+    return jax.lax.complex(real, imag)
+
+
+def polar(abs, angle):  # noqa: A002 - mirrors the public API name
+    return abs * jnp.exp(1j * angle.astype(jnp.result_type(angle, 0.0j)))

@@ -1103,3 +1103,106 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
     row = jnp.arange(maxlen)
     return (row[None, :] < jnp.asarray(lengths)[..., None]).astype(
         jnp.dtype(dtype))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im, the inverse of unfold (reference fold_op).
+    x: [N, C*kh*kw, L] -> [N, C, H, W]; overlapping patches sum."""
+    n = x.shape[0]
+    oh_img, ow_img = _norm_tuple(output_sizes, 2)
+    kh, kw = _norm_tuple(kernel_sizes, 2)
+    sh, sw = _norm_tuple(strides, 2)
+    dh, dw = _norm_tuple(dilations, 2)
+    pads = _conv_padding(paddings, 2, (sh, sw), (dh, dw), (kh, kw))
+    (pt, pb), (pl, pr) = pads
+    hp, wp = oh_img + pt + pb, ow_img + pl + pr
+    oh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (wp - (dw * (kw - 1) + 1)) // sw + 1
+    c = x.shape[1] // (kh * kw)
+    cols = x.reshape(n, c, kh * kw, oh, ow)
+    out = jnp.zeros((n, c, hp, wp), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :, i * dh:i * dh + oh * sh:sh,
+                         j * dw:j * dw + ow * sw:sw].add(
+                cols[:, :, i * kw + j])
+    return out[:, :, pt:pt + oh_img, pl:pl + ow_img]
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW"):
+    """Power-average pooling: (sum |x|^p / 1)^(1/p) over each window."""
+    p = float(norm_type)
+    powed = jnp.abs(x) ** p
+    pooled = avg_pool2d(powed, kernel_size, stride, padding,
+                        ceil_mode=ceil_mode, exclusive=False,
+                        data_format=data_format)
+    k = _norm_tuple(kernel_size, 2)
+    return (pooled * (k[0] * k[1])) ** (1.0 / p)
+
+
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0).astype(x.dtype)
+
+
+def pad3d(x, pad, mode="constant", value=0.0,  # noqa: A002
+          data_format="NCDHW"):
+    """5-D pad over (D, H, W) of NCDHW/NDHWC (reference pad3d_op).
+    pad = [left, right, top, bottom, front, back]."""
+    from .manipulation import pad as _pad
+    l, r, t, b, f, bk = pad
+    if data_format == "NCDHW":
+        width = [(0, 0), (0, 0), (f, bk), (t, b), (l, r)]
+    else:  # NDHWC
+        width = [(0, 0), (f, bk), (t, b), (l, r), (0, 0)]
+    flat = [v for w in width for v in w]
+    return _pad(x, flat, mode=mode, value=value)
+
+
+def zeropad2d(x, padding, data_format="NCHW"):
+    from .manipulation import pad as _pad
+    return _pad(x, list(padding), mode="constant", value=0.0,
+                data_format=data_format)
+
+
+def soft_margin_loss(input, label, reduction="mean"):  # noqa: A002
+    """log(1 + exp(-label * input)); label in {-1, 1}. Stable softplus
+    form (overflow-free for large margins)."""
+    loss = jax.nn.softplus(-label.astype(input.dtype) * input)
+    return _reduce(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean"):
+    y = label.astype(input.dtype)
+    loss = -(y * jax.nn.log_sigmoid(input) +
+             (1.0 - y) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = loss.mean(axis=-1)
+    return _reduce(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
+                     epsilon=1e-8, reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        # evaluate log on a safe argument so the untaken where-branch
+        # cannot poison gradients with nan (label==0 is common)
+        safe = jnp.where(label > 1.0, label, 1.0)
+        stirling = safe * jnp.log(safe) - safe + \
+            0.5 * jnp.log(2.0 * jnp.pi * safe)
+        loss = loss + jnp.where(label > 1.0, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False,  # noqa: A002
+                      epsilon=1e-6, reduction="mean"):
+    var = jnp.clip(variance, epsilon, None)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * jnp.pi, input.dtype))
+    return _reduce(loss, reduction)

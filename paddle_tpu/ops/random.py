@@ -102,3 +102,18 @@ def rand_like(x, key=None):
 def gumbel(shape, dtype=None, key=None):
     dtype = convert_dtype(dtype) if dtype else default_dtype()
     return jax.random.gumbel(_key(key), tuple(shape), dtype=dtype)
+
+
+def binomial(count, prob, key=None):
+    """Sample Binomial(count, prob) elementwise (reference binomial op)."""
+    c = jnp.asarray(count)
+    p = jnp.asarray(prob)
+    shape = jnp.broadcast_shapes(c.shape, p.shape)
+    return jax.random.binomial(
+        _key(key), c.astype(jnp.float32), p.astype(jnp.float32),
+        shape=shape).astype(jnp.int32)
+
+
+def lognormal(mean=1.0, std=2.0, shape=(1,), dtype=None, key=None):
+    return jnp.exp(normal(mean, std, shape, dtype, key))
+

@@ -1,0 +1,106 @@
+"""weight_norm / spectral_norm hooks + new layer wrappers.
+
+Reference parity: python/paddle/fluid/tests/unittests/test_weight_norm_hook
+.py, test_spectral_norm_op.py, and the layer-API tests for Pad3D/Fold/
+LPPool2D/loss layers."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+def test_weight_norm_reparam_and_remove():
+    pt.seed(0)
+    lin = nn.Linear(6, 4)
+    w0 = lin.weight.numpy().copy()
+    nn.utils.weight_norm(lin, "weight", dim=0)
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight_g" in names and "weight_v" in names
+    assert "weight" not in names
+    x = pt.to_tensor(np.random.default_rng(0).standard_normal(
+        (3, 6)).astype("f"))
+    y = lin(x)
+    # reparametrized forward == original weight forward at init
+    np.testing.assert_allclose(np.asarray(y.value), x.numpy() @ w0 +
+                               lin.bias.numpy(), rtol=1e-5, atol=1e-5)
+    # grads flow to g and v through the derived weight
+    loss = (y * y).sum()
+    loss.backward()
+    assert lin.weight_g.grad is not None and lin.weight_v.grad is not None
+
+    nn.utils.remove_weight_norm(lin, "weight")
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight" in names and "weight_g" not in names
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_weight_norm_scales_norm():
+    pt.seed(0)
+    lin = nn.Linear(5, 3)
+    nn.utils.weight_norm(lin, "weight", dim=1)  # per-output-col norms
+    # doubling g doubles the effective weight column norms
+    lin.weight_g.value = lin.weight_g.value * 2.0
+    x = pt.to_tensor(np.eye(5, dtype="f"))
+    y = lin(x) - lin.bias
+    norms = np.linalg.norm(np.asarray(y.value), axis=0)
+    # the effective per-column norm equals the (doubled) g
+    np.testing.assert_allclose(
+        norms, np.asarray(lin.weight_g.value).ravel(), rtol=1e-4)
+
+
+def test_spectral_norm_unit_sigma():
+    pt.seed(0)
+    lin = nn.Linear(8, 8)
+    nn.utils.spectral_norm(lin, "weight", n_power_iterations=20)
+    for _ in range(10):  # power iteration converges across calls
+        lin(pt.to_tensor(np.zeros((1, 8), "f")))
+    w = np.asarray(lin.weight.value if hasattr(lin.weight, "value")
+                   else lin.weight)
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+
+
+def test_vector_param_roundtrip():
+    pt.seed(0)
+    lin = nn.Linear(4, 3)
+    params = list(lin.parameters())
+    vec = nn.utils.parameters_to_vector(params)
+    assert np.asarray(vec.value).shape == (4 * 3 + 3,)
+    doubled = vec * 2.0
+    nn.utils.vector_to_parameters(doubled, params)
+    got = nn.utils.parameters_to_vector(list(lin.parameters()))
+    np.testing.assert_allclose(np.asarray(got.value),
+                               np.asarray(vec.value) * 2.0, rtol=1e-6)
+
+
+def test_new_layer_wrappers_forward():
+    rng = np.random.default_rng(0)
+    x4 = pt.to_tensor(rng.standard_normal((1, 2, 6, 6)).astype("f"))
+    x5 = pt.to_tensor(rng.standard_normal((1, 2, 3, 4, 5)).astype("f"))
+
+    assert nn.Pad3D([1, 1, 2, 2, 0, 1])(x5).shape == (1, 2, 4, 8, 7)
+    assert nn.ZeroPad2D([1, 2, 3, 4])(x4).shape == (1, 2, 13, 9)
+    cols = nn.Unfold(2, strides=2)(x4)
+    assert cols.shape == (1, 2 * 4, 9)
+    back = nn.Fold((6, 6), 2, strides=2)(cols)
+    np.testing.assert_allclose(np.asarray(back.value),
+                               np.asarray(x4.value), rtol=1e-6)
+    assert nn.LPPool2D(2.0, 2, 2)(x4).shape == (1, 2, 3, 3)
+    out = nn.ThresholdedReLU(0.5)(x4)
+    got = np.asarray(out.value)
+    assert ((got == 0) | (got > 0.5)).all()
+
+    inp = pt.to_tensor(rng.standard_normal((4, 5)).astype("f"))
+    sign = pt.to_tensor(np.sign(rng.standard_normal((4, 5))).astype("f"))
+    y01 = pt.to_tensor((rng.random((4, 5)) > 0.5).astype("f"))
+    lam = pt.to_tensor((np.abs(rng.standard_normal((4, 5))) + 0.5)
+                       .astype("f"))
+    var = pt.to_tensor((np.abs(rng.standard_normal((4, 5))) + 0.1)
+                       .astype("f"))
+    for loss in (nn.SoftMarginLoss()(inp, sign),
+                 nn.MultiLabelSoftMarginLoss()(inp, y01),
+                 nn.PoissonNLLLoss()(inp, lam),
+                 nn.GaussianNLLLoss()(inp, lam, var)):
+        assert np.isfinite(float(loss))

@@ -1,0 +1,273 @@
+"""Long-tail math/fft/nn-functional op tests vs NumPy references.
+
+Mirrors the reference's per-op unit tests for the extended surface
+(test_frexp_op, test_lu_unpack_op, test_fold_op, test_fft, ...)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_forward, check_grad
+
+RNG = np.random.default_rng(7)
+
+
+def _f32(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+def test_frexp_ldexp():
+    x = _f32(3, 4) * 10
+    check_forward("frexp", np.frexp, x)
+    m, e = np.frexp(x)
+    check_forward("ldexp", lambda a, b: np.ldexp(a, b), m,
+                  e.astype(np.int32))
+
+
+def test_renorm():
+    import paddle_tpu as pt
+    x = _f32(4, 5)
+    out = pt.dispatch.wrap_op("renorm")(pt.to_tensor(x), 2.0, 0, 1.0)
+    norms = np.linalg.norm(np.asarray(out.value), axis=1)
+    assert (norms <= 1.0 + 1e-5).all()
+    # rows already under the cap are untouched
+    small = x / (np.linalg.norm(x, axis=1, keepdims=True) + 1e-9) * 0.5
+    out2 = pt.dispatch.wrap_op("renorm")(pt.to_tensor(small), 2.0, 0, 1.0)
+    np.testing.assert_allclose(np.asarray(out2.value), small, rtol=1e-5)
+
+
+def test_trapezoid_family():
+    y, x = np.abs(_f32(3, 8)) + 0.1, np.sort(_f32(8))
+    check_forward("trapezoid", lambda yy, xx: np.trapezoid(yy, x=xx), y, x)
+    from scipy.integrate import cumulative_trapezoid as ref_ct
+    check_forward("cumulative_trapezoid",
+                  lambda yy, xx: ref_ct(yy, x=xx, axis=-1), y, x)
+    check_grad("trapezoid", y, x, arg_idx=(0,))
+
+
+def test_vander_cartesian_combinations():
+    x = _f32(5)
+    check_forward("vander", lambda v: np.vander(v, increasing=False), x)
+    import paddle_tpu as pt
+    a = np.array([1.0, 2.0], np.float32)
+    b = np.array([3.0, 4.0, 5.0], np.float32)
+    out = pt.dispatch.wrap_op("cartesian_prod")(
+        [pt.to_tensor(a), pt.to_tensor(b)])
+    assert np.asarray(out.value).shape == (6, 2)
+    comb = pt.dispatch.wrap_op("combinations")(pt.to_tensor(x), 2)
+    import itertools
+    exp = np.array(list(itertools.combinations(x, 2)), np.float32)
+    np.testing.assert_allclose(np.asarray(comb.value), exp, rtol=1e-6)
+
+
+def test_index_fill_masked_scatter_diag_embed():
+    import paddle_tpu as pt
+    x = _f32(3, 4)
+    idx = np.array([0, 2], np.int32)
+    out = pt.dispatch.wrap_op("index_fill")(
+        pt.to_tensor(x), pt.to_tensor(idx), 0, -1.0)
+    got = np.asarray(out.value)
+    assert (got[[0, 2]] == -1.0).all() and (got[1] == x[1]).all()
+
+    mask = x > 0
+    vals = np.arange(mask.sum() + 2, dtype=np.float32)
+    out = pt.dispatch.wrap_op("masked_scatter")(
+        pt.to_tensor(x), pt.to_tensor(mask), pt.to_tensor(vals))
+    got = np.asarray(out.value)
+    np.testing.assert_allclose(got[mask], vals[:mask.sum()])
+    np.testing.assert_allclose(got[~mask], x[~mask])
+
+    v = _f32(2, 3)
+    out = pt.dispatch.wrap_op("diag_embed")(pt.to_tensor(v))
+    got = np.asarray(out.value)
+    assert got.shape == (2, 3, 3)
+    for i in range(2):
+        np.testing.assert_allclose(got[i], np.diag(v[i]), rtol=1e-6)
+    out = pt.dispatch.wrap_op("diag_embed")(pt.to_tensor(v), 1)
+    assert np.asarray(out.value).shape == (2, 4, 4)
+
+
+def test_views_and_strides():
+    import paddle_tpu as pt
+    x = _f32(2, 12)
+    out = pt.dispatch.wrap_op("unflatten")(pt.to_tensor(x), 1, (3, 4))
+    assert np.asarray(out.value).shape == (2, 3, 4)
+    other = np.zeros((4, 6), np.float32)
+    out = pt.dispatch.wrap_op("view_as")(pt.to_tensor(x),
+                                         pt.to_tensor(other))
+    assert np.asarray(out.value).shape == (4, 6)
+    base = np.arange(12, dtype=np.float32)
+    got = pt.dispatch.wrap_op("as_strided")(pt.to_tensor(base),
+                                            (3, 4), (1, 3))
+    exp = np.lib.stride_tricks.as_strided(base, (3, 4), (4, 12))
+    np.testing.assert_allclose(np.asarray(got.value), exp)
+
+
+def test_bincount():
+    import paddle_tpu as pt
+    x = np.array([1, 1, 3, 0, 3, 3], np.int32)
+    got = pt.dispatch.wrap_op("bincount")(pt.to_tensor(x))
+    np.testing.assert_array_equal(np.asarray(got.value), np.bincount(x))
+    w = _f32(6)
+    got = pt.dispatch.wrap_op("bincount")(pt.to_tensor(x),
+                                          pt.to_tensor(w), 6)
+    np.testing.assert_allclose(np.asarray(got.value),
+                               np.bincount(x, w, 6), rtol=1e-6)
+
+
+def test_lu_unpack_reconstructs():
+    import paddle_tpu as pt
+    a = _f32(5, 5) + 5 * np.eye(5, dtype=np.float32)
+    lu_t, piv = pt.dispatch.wrap_op("lu")(pt.to_tensor(a))
+    P, L, U = pt.dispatch.wrap_op("lu_unpack")(lu_t, piv)
+    rec = np.asarray(P.value) @ np.asarray(L.value) @ np.asarray(U.value)
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-4)
+
+
+def test_cdist_pairwise_distance():
+    x, y = _f32(4, 3), _f32(5, 3)
+    from scipy.spatial.distance import cdist as ref_cdist
+    check_forward("cdist", lambda a, b: ref_cdist(a, b, "euclidean"),
+                  x, y, rtol=1e-4, atol=1e-5)
+    check_forward(
+        "pairwise_distance",
+        lambda a, b: np.linalg.norm(np.abs(a - b) + 1e-6, axis=-1),
+        x, _f32(4, 3), rtol=1e-5, atol=1e-6)
+
+
+def test_complex_polar():
+    re, im = _f32(3), _f32(3)
+    check_forward("complex", lambda a, b: a + 1j * b, re, im)
+    r = np.abs(_f32(3)) + 0.1
+    th = _f32(3)
+    check_forward("polar", lambda a, t: a * np.exp(1j * t), r, th,
+                  rtol=1e-5, atol=1e-6)
+
+
+FFT_CASES = [
+    ("fft", np.fft.fft), ("ifft", np.fft.ifft), ("rfft", np.fft.rfft),
+    ("fftshift", np.fft.fftshift),
+]
+
+
+@pytest.mark.parametrize("name,ref", FFT_CASES,
+                         ids=[c[0] for c in FFT_CASES])
+def test_fft_basic(name, ref):
+    x = _f32(4, 8)
+    check_forward(name, ref, x, rtol=1e-4, atol=1e-4)
+
+
+def test_fft_roundtrip_and_2d():
+    import paddle_tpu as pt
+    x = _f32(4, 8)
+    X = pt.dispatch.wrap_op("rfft")(pt.to_tensor(x))
+    back = pt.dispatch.wrap_op("irfft")(X)
+    np.testing.assert_allclose(np.asarray(back.value), x, atol=1e-5)
+    X2 = pt.dispatch.wrap_op("fft2")(pt.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(X2.value), np.fft.fft2(x),
+                               rtol=1e-4, atol=1e-4)
+    f = pt.dispatch.wrap_op("fftfreq")(8, 0.5)
+    np.testing.assert_allclose(np.asarray(f.value), np.fft.fftfreq(8, 0.5))
+
+
+def test_fold_inverts_unfold():
+    import paddle_tpu as pt
+    x = _f32(2, 3, 8, 8)
+    cols = pt.dispatch.wrap_op("unfold")(pt.to_tensor(x), 2, 2, 0)
+    back = pt.dispatch.wrap_op("fold")(cols, (8, 8), 2, 2, 0)
+    # non-overlapping stride == kernel: fold(unfold(x)) == x
+    np.testing.assert_allclose(np.asarray(back.value), x, rtol=1e-6)
+    # overlapping windows sum: ones stay countable
+    ones = np.ones((1, 1, 4, 4), np.float32)
+    cols = pt.dispatch.wrap_op("unfold")(pt.to_tensor(ones), 3, 1, 0)
+    back = pt.dispatch.wrap_op("fold")(cols, (4, 4), 3, 1, 0)
+    assert np.asarray(back.value).max() == 4.0  # center overlaps 4 windows
+
+
+def test_lp_pool_thresholded_relu():
+    import paddle_tpu as pt
+    x = np.abs(_f32(1, 1, 4, 4)) + 0.1
+    out = pt.dispatch.wrap_op("lp_pool2d")(pt.to_tensor(x), 2.0, 2, 2)
+    exp = np.zeros((1, 1, 2, 2), np.float32)
+    for i in range(2):
+        for j in range(2):
+            win = x[0, 0, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+            exp[0, 0, i, j] = np.sqrt((win ** 2).sum())
+    np.testing.assert_allclose(np.asarray(out.value), exp, rtol=1e-5)
+    check_forward("thresholded_relu", lambda v, threshold:
+                  np.where(v > threshold, v, 0.0).astype(v.dtype),
+                  _f32(3, 4), threshold=0.5)
+
+
+def test_pad3d_zeropad2d():
+    import paddle_tpu as pt
+    x = _f32(1, 2, 3, 4, 5)
+    out = pt.dispatch.wrap_op("pad3d")(pt.to_tensor(x),
+                                       [1, 1, 2, 2, 0, 1])
+    assert np.asarray(out.value).shape == (1, 2, 4, 8, 7)
+    y = _f32(1, 2, 3, 4)
+    out = pt.dispatch.wrap_op("zeropad2d")(pt.to_tensor(y), [1, 2, 3, 4])
+    got = np.asarray(out.value)
+    assert got.shape == (1, 2, 10, 7)
+    np.testing.assert_allclose(got[:, :, 3:6, 1:5], y)
+
+
+def test_tail_losses():
+    x, y01 = _f32(4, 5), (RNG.random((4, 5)) > 0.5).astype(np.float32)
+    ysign = np.sign(_f32(4, 5)) + (np.sign(_f32(4, 5)) == 0)
+
+    def ref_soft_margin(inp, lab):
+        return np.log1p(np.exp(-lab * inp)).mean()
+
+    check_forward("soft_margin_loss", ref_soft_margin, x,
+                  ysign.astype(np.float32), rtol=1e-5, atol=1e-6)
+    check_grad("soft_margin_loss", x, ysign.astype(np.float32),
+               arg_idx=(0,))
+
+    def ref_mlsm(inp, lab):
+        sig = 1.0 / (1.0 + np.exp(-inp))
+        per = -(lab * np.log(sig) + (1 - lab) * np.log(1 - sig))
+        return per.mean(axis=-1).mean()
+
+    check_forward("multi_label_soft_margin_loss", ref_mlsm, x, y01,
+                  rtol=1e-4, atol=1e-5)
+
+    lam = np.abs(_f32(4, 5)) + 0.5
+
+    def ref_poisson(inp, lab):
+        return (np.exp(inp) - lab * inp).mean()
+
+    check_forward("poisson_nll_loss", ref_poisson, x, lam,
+                  rtol=1e-4, atol=1e-5)
+
+    var = np.abs(_f32(4, 5)) + 0.1
+
+    def ref_gauss(inp, lab, variance):
+        return (0.5 * (np.log(variance) +
+                       (inp - lab) ** 2 / variance)).mean()
+
+    check_forward("gaussian_nll_loss", ref_gauss, x, lam, var,
+                  rtol=1e-4, atol=1e-5)
+
+
+def test_random_tail():
+    import paddle_tpu as pt
+    pt.seed(0)
+    s = pt.dispatch.wrap_op("binomial")(
+        np.full((20000,), 10.0, np.float32), np.full((20000,), 0.3,
+                                                     np.float32))
+    m = float(np.asarray(s.value).mean())
+    assert abs(m - 3.0) < 0.1
+    ln = pt.dispatch.wrap_op("lognormal")(0.0, 0.5, (20000,))
+    got = np.log(np.asarray(ln.value))
+    assert abs(got.mean()) < 0.05 and abs(got.std() - 0.5) < 0.05
+    g = pt.dispatch.wrap_op("standard_gamma")(
+        np.full((20000,), 2.0, np.float32))
+    assert abs(float(np.asarray(g.value).mean()) - 2.0) < 0.1
+
+
+def test_nan_quantile_median():
+    x = _f32(4, 6)
+    x[1, 2] = np.nan
+    check_forward("nanmedian", lambda v: np.nanmedian(v), x)
+    check_forward("nanquantile", lambda v, q: np.nanquantile(v, q),
+                  x, 0.25, rtol=1e-5, atol=1e-6)
