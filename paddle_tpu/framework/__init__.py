@@ -1,3 +1,5 @@
-"""Framework utilities: save/load, seeding."""
+"""Framework utilities: save/load, seeding, trainer runtime."""
 
 from .io import load, save
+from .trainer import (DeviceWorker, DistMultiTrainer, DownpourWorker,
+                      HogwildWorker, MultiTrainer, TrainerFactory)

@@ -1,0 +1,229 @@
+"""Trainer / device-worker runtime over heavy-IO datasets.
+
+TPU-native equivalent of the reference's trainer fleet runtime
+(reference: paddle/fluid/framework/trainer.h:102 MultiTrainer, :137
+DistMultiTrainer; device_worker.h:244 HogwildWorker, :275 DownpourWorker;
+driven from Python by fluid/trainer_factory.py + executor.py:1662
+train_from_dataset). The reference runs N C++ device-worker threads, each
+interpreting the program over its DataFeed channel; here each worker drives
+ONE jitted step function over its channel, so the hot loop is a single XLA
+launch per batch and workers overlap host-side batch prep with device
+execution. Hogwild semantics (lock-free shared state) map to workers
+applying updates to a shared functional state slot without coordination;
+Downpour semantics map to push-grad / pull-param against the PS client
+between steps.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class DeviceWorker:
+    """Base: one worker thread bound to a dataset channel."""
+
+    def __init__(self):
+        self.metrics: Dict[str, float] = {"steps": 0, "loss_sum": 0.0}
+
+    def bind(self, trainer, worker_id: int, channel) -> None:
+        self.trainer = trainer
+        self.worker_id = worker_id
+        self.channel = channel
+
+    def train_loop(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def avg_loss(self) -> float:
+        n = max(1, int(self.metrics["steps"]))
+        return self.metrics["loss_sum"] / n
+
+
+class HogwildWorker(DeviceWorker):
+    """Lock-free shared-state worker (reference device_worker.h:244).
+
+    Each worker pulls batches from its channel and calls the trainer's
+    step function against the SHARED state (reads and writes race by
+    design — hogwild). With a jitted TrainStep the 'state' is the step
+    object's params/opt_state, mutated without a lock."""
+
+    def train_loop(self) -> None:
+        for batch in self.channel:
+            loss = self.trainer._run_step(batch, self.worker_id)
+            self.metrics["steps"] += 1
+            if loss is not None and np.ndim(loss) == 0:
+                self.metrics["loss_sum"] += float(loss)
+
+
+class DownpourWorker(DeviceWorker):
+    """Async-PS worker (reference device_worker.h:275 DownpourWorker):
+    pull dense params from the PS, run the local step, push gradients —
+    no barrier between workers or trainers."""
+
+    def train_loop(self) -> None:
+        trainer = self.trainer
+        for batch in self.channel:
+            # The pull->step->push cycle is atomic per worker: the jitted
+            # step donates the state buffers the pull installed, so a
+            # concurrent worker's push must not read them mid-donation.
+            # Asynchrony between TRAINERS (processes) is preserved — the
+            # reference's async-PS property — only threads of one trainer
+            # serialize, as they already do at the single device.
+            with trainer._lock:
+                trainer._pull_dense(self.worker_id)
+                loss = trainer._run_step(batch, self.worker_id)
+                trainer._push_dense(self.worker_id)
+            self.metrics["steps"] += 1
+            if loss is not None and np.ndim(loss) == 0:
+                self.metrics["loss_sum"] += float(loss)
+
+
+class MultiTrainer:
+    """Runs N device workers over a Dataset's channels
+    (reference trainer.h:102 MultiTrainer::Run).
+
+    step_fn(batch, worker_id) -> loss is typically a jitted TrainStep
+    bound to shared state; thread-level overlap hides host batch prep
+    behind device steps (the reference's reason for multi-threading the
+    op interpreter does not apply to one fused XLA launch, but IO overlap
+    still does)."""
+
+    worker_cls = HogwildWorker
+
+    def __init__(self, step_fn: Callable[[Any, int], Any],
+                 thread_num: int = 2):
+        self.step_fn = step_fn
+        self.thread_num = max(1, int(thread_num))
+        self.workers: List[DeviceWorker] = []
+        self._lock = threading.RLock()
+
+    # hooks for DistMultiTrainer
+    def _pull_dense(self, worker_id: int) -> None:  # pragma: no cover
+        pass
+
+    def _push_dense(self, worker_id: int) -> None:  # pragma: no cover
+        pass
+
+    def _run_step(self, batch, worker_id: int):
+        # One device executes one program at a time, and jitted steps
+        # donate their state buffers — so the DEVICE step serializes
+        # under the trainer lock while workers overlap host-side batch
+        # prep/IO. (The reference's per-parameter hogwild races are a
+        # CPU-interpreter property with no TPU analog.)
+        with self._lock:
+            return self.step_fn(batch, worker_id)
+
+    def run(self, dataset, debug: bool = False) -> Dict[str, float]:
+        channels = self._channels(dataset)
+        self.workers = []
+        threads = []
+        for i, ch in enumerate(channels):
+            w = self.worker_cls()
+            w.bind(self, i, ch)
+            self.workers.append(w)
+        errors: List[BaseException] = []
+
+        def guarded(w):
+            try:
+                w.train_loop()
+            except BaseException as e:  # propagate to the caller
+                errors.append(e)
+
+        for w in self.workers:
+            t = threading.Thread(target=guarded, args=(w,), daemon=True)
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        steps = sum(int(w.metrics["steps"]) for w in self.workers)
+        loss_sum = sum(w.metrics["loss_sum"] for w in self.workers)
+        return {"steps": steps,
+                "avg_loss": loss_sum / max(1, steps)}
+
+    def _channels(self, dataset) -> List[Any]:
+        bs = getattr(dataset, "batch_size", 1)
+        drop_last = getattr(dataset, "drop_last", False)
+
+        def batched(samples):
+            out, cur = [], []
+            for s in samples:
+                cur.append(s)
+                if len(cur) == bs:
+                    out.append(cur)
+                    cur = []
+            if cur and not drop_last:
+                out.append(cur)
+            return out
+
+        if hasattr(dataset, "channels"):  # InMemoryDataset
+            return [batched(c)
+                    for c in dataset.channels(self.thread_num)]
+        # QueueDataset / any iterable of batches: STREAM from one shared
+        # iterator (the dataset's own bounded queue provides the
+        # backpressure) — draining it up front would defeat the queue and
+        # buffer the whole epoch in host memory.
+        src = iter(dataset)
+        src_lock = threading.Lock()
+
+        def shared_stream():
+            while True:
+                with src_lock:
+                    try:
+                        b = next(src)
+                    except StopIteration:
+                        return
+                yield b
+
+        return [shared_stream() for _ in range(self.thread_num)]
+
+
+class DistMultiTrainer(MultiTrainer):
+    """PS-mode trainer (reference trainer.h:137): Downpour workers sync
+    dense tables with the PS client around each local step."""
+
+    worker_cls = DownpourWorker
+
+    def __init__(self, step_fn, thread_num: int = 2, ps_client=None,
+                 dense_table: str = "dense_0",
+                 get_dense: Optional[Callable[[], np.ndarray]] = None,
+                 set_dense: Optional[Callable[[np.ndarray], None]] = None,
+                 get_grad: Optional[Callable[[], np.ndarray]] = None):
+        super().__init__(step_fn, thread_num)
+        self.ps_client = ps_client
+        self.dense_table = dense_table
+        self._get_dense = get_dense
+        self._set_dense = set_dense
+        self._get_grad = get_grad
+
+    def _pull_dense(self, worker_id: int) -> None:
+        if self.ps_client is None or self._set_dense is None:
+            return
+        self._set_dense(self.ps_client.pull_dense(self.dense_table))
+
+    def _push_dense(self, worker_id: int) -> None:
+        if self.ps_client is None or self._get_grad is None:
+            return
+        g = self._get_grad()
+        if g is not None:
+            self.ps_client.push_dense_grad(self.dense_table, g)
+
+
+class TrainerFactory:
+    """reference fluid/trainer_factory.py — picks the trainer class from a
+    mode string."""
+
+    _TRAINERS = {"MultiTrainer": MultiTrainer,
+                 "DistMultiTrainer": DistMultiTrainer}
+
+    @classmethod
+    def create(cls, name: str, *args, **kwargs):
+        if name not in cls._TRAINERS:
+            from ..core.enforce import NotFoundError
+            raise NotFoundError(f"unknown trainer {name!r}; have "
+                                f"{sorted(cls._TRAINERS)}")
+        return cls._TRAINERS[name](*args, **kwargs)

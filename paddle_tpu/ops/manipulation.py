@@ -18,6 +18,8 @@ _slice = builtins.slice
 
 
 def reshape(x, shape):
+    if isinstance(shape, (int, np.integer)):
+        shape = (shape,)
     return jnp.reshape(x, tuple(shape))
 
 

@@ -12,6 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.dtype import convert_dtype
+from ..core.enforce import InvalidArgumentError
+from ..io.collate import default_collate_fn
 from ..nn.layer import Layer, functional_state, functional_call
 from ..tensor import Tensor
 
@@ -234,6 +236,101 @@ class Executor:
         if return_numpy:
             leaves = [np.asarray(l) for l in leaves]
         return leaves
+
+    # -- dataset-driven training (reference executor.py:1662
+    #    train_from_dataset over framework/trainer.h trainers) --------------
+
+    # shared with io.DataLoader — one collate implementation
+    _default_collate = staticmethod(default_collate_fn)
+
+    def _dataset_step_fn(self, program, collate_fn, train: bool,
+                         fetches: Optional[Dict] = None):
+        collate = collate_fn or self._default_collate
+
+        if isinstance(program, Program):
+            names = [s.name or f"x{i}"
+                     for i, s in enumerate(program.input_specs)]
+
+            def step(batch, worker_id):
+                b = collate(batch)
+                if isinstance(b, dict):
+                    # feed by declared input name when the spec names
+                    # match; never by dict insertion order
+                    if all(n in b for n in names):
+                        vals = [b[n] for n in names]
+                    else:
+                        missing = [n for n in names if n not in b]
+                        raise InvalidArgumentError(
+                            f"batch keys {sorted(b)} do not cover program "
+                            f"inputs {names} (missing {missing}); name "
+                            "the InputSpecs after the sample slots")
+                else:
+                    vals = list(b) if isinstance(b, (tuple, list)) else [b]
+                out = program.run(*vals)
+                leaves = jax.tree_util.tree_leaves(out)
+                if fetches is not None:
+                    fetches["last"] = [np.asarray(l) for l in leaves]
+                # a scalar first output is treated as the loss; anything
+                # else contributes no loss metric (pure scoring programs)
+                if leaves and jnp.ndim(leaves[0]) == 0:
+                    return leaves[0]
+                return None
+            return step
+
+        if callable(program):  # e.g. a jitted TrainStep
+            if not train and (hasattr(program, "optimizer") or
+                              hasattr(program, "opt_state")):
+                raise InvalidArgumentError(
+                    "infer_from_dataset must not mutate state: pass a "
+                    "Program or a pure callable, not a TrainStep")
+
+            def step(batch, worker_id):
+                return program(collate(batch))
+            return step
+
+        raise InvalidArgumentError(
+            "train_from_dataset needs a Program or a callable step "
+            f"(got {type(program).__name__})")
+
+    def _run_dataset(self, program, dataset, thread, debug, fetch_list,
+                     collate_fn, trainer, train, trainer_kwargs):
+        from ..framework.trainer import TrainerFactory
+        if dataset is None:
+            raise InvalidArgumentError("dataset is required")
+        fetches: Optional[Dict] = {} if fetch_list is not None else None
+        step = self._dataset_step_fn(program, collate_fn, train=train,
+                                     fetches=fetches)
+        tr = TrainerFactory.create(
+            trainer, step,
+            thread_num=thread or getattr(dataset, "thread_num", 1) or 1,
+            **trainer_kwargs)
+        result = tr.run(dataset, debug=debug)
+        if fetches is not None:
+            result["fetches"] = fetches.get("last")
+        return result
+
+    def train_from_dataset(self, program=None, dataset=None, thread: int = 0,
+                           debug: bool = False, fetch_list=None,
+                           collate_fn=None, trainer: str = "MultiTrainer",
+                           **trainer_kwargs):
+        """Run N device workers over the dataset's channels (reference
+        Executor.train_from_dataset -> trainer_factory -> MultiTrainer::Run
+        over HogwildWorkers). Returns {'steps', 'avg_loss'} plus
+        'fetches' (the last step's output leaves) when fetch_list is
+        given."""
+        return self._run_dataset(program, dataset, thread, debug,
+                                 fetch_list, collate_fn, trainer, True,
+                                 trainer_kwargs)
+
+    def infer_from_dataset(self, program=None, dataset=None, thread: int = 0,
+                           debug: bool = False, fetch_list=None,
+                           collate_fn=None, trainer: str = "MultiTrainer",
+                           **trainer_kwargs):
+        """Same worker loop for pure scoring: rejects state-mutating
+        TrainStep callables (reference Executor.infer_from_dataset)."""
+        return self._run_dataset(program, dataset, thread, debug,
+                                 fetch_list, collate_fn, trainer, False,
+                                 trainer_kwargs)
 
 
 def save_inference_model(path_prefix: str, feed_vars, fetch_vars=None,

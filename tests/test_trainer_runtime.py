@@ -1,0 +1,216 @@
+"""Trainer/device-worker runtime over heavy-IO datasets.
+
+Reference parity: framework/trainer.h MultiTrainer/DistMultiTrainer +
+device_worker.h Hogwild/Downpour workers driven by
+Executor.train_from_dataset (fluid/executor.py:1662), tested the way the
+reference tests dataset trainers (test_dataset.py, test_monitor.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.optimizer as optim
+from paddle_tpu import nn
+from paddle_tpu.io.heavy_dataset import InMemoryDataset, QueueDataset
+from paddle_tpu.jit import TrainStep
+
+
+def _write_files(tmp_path, n_files=3, rows=40):
+    files = []
+    for fi in range(n_files):
+        p = tmp_path / f"part-{fi}.txt"
+        with open(p, "w") as f:
+            for i in range(rows):
+                sign = (i % 2) * 2 - 1
+                f.write(f"feat:{sign}.0 1.0 2.0 3.0;label:{i % 2}\n")
+        files.append(str(p))
+    return files
+
+
+def _make_step():
+    pt.seed(0)
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, feat, label):
+            return nn.functional.cross_entropy(self.fc(feat),
+                                               label.reshape(-1))
+
+    m = M()
+    return m, TrainStep(
+        m, optim.SGD(learning_rate=0.2),
+        lambda mm, b: mm(b["feat"].astype("float32"),
+                         b["label"].astype("int32")))
+
+
+def test_train_from_dataset_multitrainer(tmp_path):
+    ds = InMemoryDataset()
+    ds.set_filelist(_write_files(tmp_path))
+    ds.set_batch_size(8)
+    ds.set_thread(3)
+    ds.load_into_memory()
+    ds.local_shuffle()
+
+    _, step = _make_step()
+    exe = pt.static.Executor()
+    first = exe.train_from_dataset(program=step, dataset=ds, thread=3)
+    assert first["steps"] == 15  # 3 channels x ceil(40/8)
+    second = exe.train_from_dataset(program=step, dataset=ds, thread=3)
+    assert second["avg_loss"] < first["avg_loss"]
+
+
+def test_train_from_dataset_queue(tmp_path):
+    ds = QueueDataset()
+    ds.set_filelist(_write_files(tmp_path, n_files=2, rows=16))
+    ds.set_batch_size(4)
+    ds.set_thread(2)
+
+    _, step = _make_step()
+    exe = pt.static.Executor()
+    res = exe.train_from_dataset(program=step, dataset=ds, thread=2)
+    assert res["steps"] == 8
+    assert np.isfinite(res["avg_loss"])
+
+
+def test_trainer_factory_and_worker_metrics(tmp_path):
+    from paddle_tpu.framework import MultiTrainer, TrainerFactory
+
+    tr = TrainerFactory.create("MultiTrainer", lambda b, w: 1.0,
+                               thread_num=2)
+    assert isinstance(tr, MultiTrainer)
+    with pytest.raises(Exception):
+        TrainerFactory.create("NopeTrainer", None)
+
+    ds = InMemoryDataset()
+    ds.set_filelist(_write_files(tmp_path, n_files=1, rows=8))
+    ds.set_batch_size(4)
+    ds.load_into_memory()
+    res = tr.run(ds)
+    assert res["steps"] == 2 and res["avg_loss"] == 1.0
+    assert sum(int(w.metrics["steps"]) for w in tr.workers) == 2
+
+
+def test_worker_error_propagates(tmp_path):
+    from paddle_tpu.framework import MultiTrainer
+
+    ds = InMemoryDataset()
+    ds.set_filelist(_write_files(tmp_path, n_files=1, rows=4))
+    ds.set_batch_size(2)
+    ds.load_into_memory()
+
+    def bad_step(batch, worker_id):
+        raise RuntimeError("boom in worker")
+
+    tr = MultiTrainer(bad_step, thread_num=2)
+    with pytest.raises(RuntimeError, match="boom in worker"):
+        tr.run(ds)
+
+
+def test_dist_multitrainer_downpour_ps(tmp_path):
+    """DownpourWorkers pull dense params from a live PSServer, step, and
+    push grads back — end of run, the PS table moved (async-PS flow,
+    reference device_worker.h:275)."""
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+    from paddle_tpu.framework import DistMultiTrainer
+
+    server = PSServer()
+    model, step = _make_step()
+
+    def get_flat():
+        return np.concatenate(
+            [np.asarray(v).ravel() for v in step.params.values()])
+
+    shapes = {k: np.asarray(v).shape for k, v in step.params.items()}
+    # lr=1.0: workers push param DELTAS, so the server-side SGD applies
+    # them verbatim
+    server.add_dense_table("dense_0", get_flat().shape, lr=1.0)
+    server.start()
+    try:
+        client = PSClient([server.endpoint])
+        client.push_dense_init("dense_0", get_flat())
+        before = client.pull_dense("dense_0").copy()
+
+        last = {"flat": get_flat()}
+
+        def set_flat(vec):
+            off = 0
+            import jax.numpy as jnp
+            new = {}
+            for k, shp in shapes.items():
+                n = int(np.prod(shp))
+                new[k] = jnp.asarray(
+                    vec[off:off + n].reshape(shp).astype(np.float32))
+                off += n
+            step.params = new
+            last["flat"] = np.asarray(vec, np.float32)
+
+        def get_grad():
+            # server-side SGD: push the param DELTA as the gradient with
+            # lr 1.0 semantics (delta = old - new)
+            return last["flat"] - get_flat()
+
+        ds = InMemoryDataset()
+        ds.set_filelist(_write_files(tmp_path, n_files=1, rows=16))
+        ds.set_batch_size(4)
+        ds.load_into_memory()
+
+        collate = pt.static.Executor._default_collate
+        tr = DistMultiTrainer(
+            lambda b, w: step(collate(b)), thread_num=2, ps_client=client,
+            dense_table="dense_0", set_dense=set_flat,
+            get_dense=get_flat, get_grad=get_grad)
+        res = tr.run(ds)
+        assert res["steps"] == 4
+        after = client.pull_dense("dense_0")
+        assert not np.allclose(before, after)
+    finally:
+        server.stop()
+
+
+def test_channels_honor_drop_last(tmp_path):
+    from paddle_tpu.framework import MultiTrainer
+
+    ds = InMemoryDataset()
+    ds.set_filelist(_write_files(tmp_path, n_files=1, rows=10))
+    ds.set_batch_size(4)
+    ds.drop_last = True
+    ds.load_into_memory()
+    tr = MultiTrainer(lambda b, w: float(len(b)), thread_num=1)
+    res = tr.run(ds)
+    assert res["steps"] == 2  # 10 rows -> 2 full batches, tail dropped
+    ds.drop_last = False
+    tr2 = MultiTrainer(lambda b, w: float(len(b)), thread_num=1)
+    assert tr2.run(ds)["steps"] == 3
+
+
+def test_program_dict_feed_by_name(tmp_path):
+    """Dict batches bind to Program inputs BY NAME, not dict order."""
+    from paddle_tpu.static import InputSpec, build_program
+
+    ds = InMemoryDataset()
+    ds.set_filelist(_write_files(tmp_path, n_files=1, rows=8))
+    ds.set_batch_size(4)
+    ds.load_into_memory()
+
+    pt.seed(0)
+    net = nn.Linear(4, 2)
+    # declare inputs in the OPPOSITE order of the sample dict keys
+    prog = build_program(
+        lambda label, feat: nn.functional.cross_entropy(
+            net(feat.astype("float32")),
+            label.reshape(-1).astype("int32")),
+        [InputSpec((None, 1), "int64", "label"),
+         InputSpec((None, 4), "float32", "feat")])
+    exe = pt.static.Executor()
+    res = exe.infer_from_dataset(program=prog, dataset=ds, thread=1)
+    assert res["steps"] == 2 and np.isfinite(res["avg_loss"])
+
+    # and infer_from_dataset refuses a mutating TrainStep
+    _, step = _make_step()
+    with pytest.raises(Exception, match="must not mutate"):
+        exe.infer_from_dataset(program=step, dataset=ds, thread=1)
