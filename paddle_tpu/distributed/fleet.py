@@ -344,6 +344,9 @@ class ShardedTrainStep:
                     params, buffers, key, batch)
 
         def step_impl(params, buffers, opt_state, key, lr, batch):
+            # evolve the key inside the launch: one dispatch per step
+            # (a host-side split is a separate device round-trip)
+            key, new_key = jax.random.split(key)
             if gm > 1:
                 # gradient merge: split the batch into k micro-steps and
                 # accumulate grads (reference GradientMergeOptimizer)
@@ -367,7 +370,7 @@ class ShardedTrainStep:
                 (loss, new_buf), grads = vag(params, buffers, key, batch)
             new_params, new_opt = optimizer.apply_gradients(
                 params, grads, opt_state, lr=lr)
-            return new_params, new_buf, new_opt, loss
+            return new_params, new_buf, new_opt, new_key, loss
 
         in_shardings = (self.param_shardings, self.buffer_shardings,
                         {"slots": self.opt_shardings["slots"],
@@ -377,11 +380,16 @@ class ShardedTrainStep:
         out_shardings = (self.param_shardings, self.buffer_shardings,
                          {"slots": self.opt_shardings["slots"],
                           "step": self.opt_shardings["step"]},
+                         NamedSharding(self.mesh, P()),
                          NamedSharding(self.mesh, P()))
         kwargs = {"donate_argnums": (0, 1, 2)} if donate else {}
         return jax.jit(step_impl,
                        in_shardings=in_shardings + (None,),
                        out_shardings=out_shardings, **kwargs)
+
+    def _lr_device(self):
+        from ..jit import cached_lr_device
+        return cached_lr_device(self, self.optimizer)
 
     def __call__(self, batch):
         batch_raw = jax.tree_util.tree_map(
@@ -390,10 +398,9 @@ class ShardedTrainStep:
         batch_raw = jax.tree_util.tree_map(
             lambda v, s: jax.device_put(jnp.asarray(v), s),
             batch_raw, self._batch_sharding(batch_raw))
-        self._key, sub = jax.random.split(self._key)
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        self.params, self.buffers, self.opt_state, loss = self._step(
-            self.params, self.buffers, self.opt_state, sub, lr, batch_raw)
+        self.params, self.buffers, self.opt_state, self._key, loss = \
+            self._step(self.params, self.buffers, self.opt_state,
+                       self._key, self._lr_device(), batch_raw)
         return loss
 
     def sync_to_model(self) -> None:

@@ -35,6 +35,17 @@ def _wrap_tree(tree):
         lambda v: Tensor(v) if isinstance(v, jax.Array) else v, tree)
 
 
+def cached_lr_device(obj, optimizer):
+    """Device f32 scalar for the current lr, re-uploaded only when the
+    value changes — a fresh jnp.asarray per step is a host->device
+    transfer (milliseconds of round-trip on tunneled runtimes)."""
+    lr = float(optimizer.get_lr())
+    cache = getattr(obj, "_lr_cache", None)
+    if cache is None or lr != cache[0]:
+        obj._lr_cache = (lr, jnp.asarray(lr, jnp.float32))
+    return obj._lr_cache[1]
+
+
 class TrainStep:
     """One fused, jitted train step over an eager-style step function.
 
@@ -54,17 +65,20 @@ class TrainStep:
         self.buffers = state["buffers"]
         self.opt_state = optimizer.init(self.params)
         self._key = jax.random.key(seed)
-        self._step = self._build(donate)
+        self._lr_cache = None
+        self._step, self._multi = self._build(donate)
 
     def _build(self, donate: bool):
         model, optimizer, train_fn = self.model, self.optimizer, \
             self.train_fn
 
-        def step_impl(params, buffers, opt_state, key, lr, batch):
+        def one_step(params, buffers, opt_state, key, lr, batch):
+            key, sub = jax.random.split(key)
+
             def loss_of(p):
                 model.train()
                 with bind_state(model, {"params": p, "buffers": buffers}), \
-                        no_grad(), rng_mod.key_scope(key):
+                        no_grad(), rng_mod.key_scope(sub):
                     loss = train_fn(model, _wrap_tree(batch))
                     new_buf = {n: b.value for n, b in model.named_buffers()
                                if b is not None}
@@ -75,21 +89,50 @@ class TrainStep:
                 loss_of, has_aux=True)(params)
             new_params, new_opt = optimizer.apply_gradients(
                 params, grads, opt_state, lr=lr)
-            return new_params, new_buf, new_opt, loss
+            return new_params, new_buf, new_opt, key, loss
 
-        kwargs = {"donate_argnums": (0, 1, 2)} if donate else {}
-        return jax.jit(step_impl, **kwargs)
+        # The PRNG key evolves INSIDE the jitted step: one device dispatch
+        # per step total. A separate host-side jax.random.split is a whole
+        # extra launch, which on remote/tunneled TPU runtimes costs
+        # milliseconds of round-trip per step.
+        kwargs = {"donate_argnums": (0, 1, 2, 3)} if donate else {}
+        step = jax.jit(one_step, **kwargs)
+
+        def multi_impl(params, buffers, opt_state, key, lr, batches):
+            def body(carry, batch):
+                p, b, o, k = carry
+                p, b, o, k, loss = one_step(p, b, o, k, lr, batch)
+                return (p, b, o, k), loss
+
+            (params, buffers, opt_state, key), losses = jax.lax.scan(
+                body, (params, buffers, opt_state, key), batches)
+            return params, buffers, opt_state, key, losses
+
+        multi = jax.jit(multi_impl, **kwargs)
+        return step, multi
+
+    def _lr_device(self):
+        return cached_lr_device(self, self.optimizer)
 
     def __call__(self, batch) -> jax.Array:
         batch_raw = _unwrap_tree(batch)
-        self._key, sub = jax.random.split(self._key)
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        self.params, self.buffers, self.opt_state, loss = self._step(
-            self.params, self.buffers, self.opt_state, sub, lr, batch_raw)
-        sched = self.optimizer._lr_scheduler
-        if sched is not None:
-            pass  # stepping the scheduler is the caller's choice (per epoch)
+        self.params, self.buffers, self.opt_state, self._key, loss = \
+            self._step(self.params, self.buffers, self.opt_state,
+                       self._key, self._lr_device(), batch_raw)
         return loss
+
+    def multi_step(self, batches) -> jax.Array:
+        """Run a whole micro-epoch in ONE device launch: ``batches`` is a
+        pytree whose leaves are stacked along a leading steps axis; the
+        jitted program lax.scans the train step over it. TPU-native analog
+        of the reference's C++ trainer loop (Executor::RunFromDataset,
+        framework/trainer.h) — the hot loop never returns to Python.
+        Returns the per-step losses [n_steps]."""
+        batches_raw = _unwrap_tree(batches)
+        self.params, self.buffers, self.opt_state, self._key, losses = \
+            self._multi(self.params, self.buffers, self.opt_state,
+                        self._key, self._lr_device(), batches_raw)
+        return losses
 
     def sync_to_model(self) -> None:
         """Write the jitted state back into the eager Layer's parameters."""

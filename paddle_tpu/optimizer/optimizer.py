@@ -176,6 +176,10 @@ class Optimizer:
             nv, ns = self._update(v, g, s, lr, step)
             if self._weight_decay and self._decoupled_wd:
                 nv = nv - lr * self._weight_decay * v
+            # a traced f32 lr must not widen low-precision params (bf16
+            # value - f32 scalar promotes): updates keep the param dtype
+            if hasattr(nv, "dtype") and nv.dtype != v.dtype:
+                nv = nv.astype(v.dtype)
             return nv, ns
 
         def update_one(i, v, g, s):

@@ -264,3 +264,23 @@ def test_apply_gradients_none_grad_alignment():
     new_p, _ = opt.apply_gradients(params, grads, st)
     np.testing.assert_allclose(np.asarray(new_p["a"]), [1.0, 1.0])
     np.testing.assert_allclose(np.asarray(new_p["b"]), [0.5, 0.5, 0.5])
+
+
+def test_bf16_params_stay_bf16_with_array_lr():
+    """A traced/device f32 lr must not widen bf16 params across steps
+    (regression: AdamW decoupled decay + SGD's lr*g promoted to f32,
+    silently retracing jitted steps into f32 training)."""
+    import jax.numpy as jnp
+    import paddle_tpu.optimizer as optim
+
+    for opt in (optim.AdamW(learning_rate=0.1, weight_decay=0.01,
+                            multi_precision=True),
+                optim.SGD(learning_rate=0.1),
+                optim.Momentum(learning_rate=0.1, momentum=0.9)):
+        params = {"w": jnp.ones((4, 4), jnp.bfloat16)}
+        grads = {"w": jnp.ones((4, 4), jnp.bfloat16)}
+        st = opt.init(params)
+        lr_dev = jnp.asarray(0.1, jnp.float32)
+        p, st = opt.apply_gradients(params, grads, st, lr=lr_dev)
+        p, st = opt.apply_gradients(p, grads, st, lr=lr_dev)
+        assert p["w"].dtype == jnp.bfloat16, type(opt).__name__
