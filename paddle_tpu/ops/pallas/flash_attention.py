@@ -33,11 +33,20 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
 
+# batch/head grid axes have no cross-iteration state -> Mosaic may run
+# them in any order / pipelined; the block axis carries nothing either
+# (each q- or k-block writes its own output slice) but keeps "arbitrary"
+# so revisiting-order guarantees hold for the full-array K/V blocks.
+_GRID_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal,
                 block_q, block_k, sk):
     qb = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)  # [BQ, D]
+    # operands stay in the input dtype (bf16 on the MXU at full rate);
+    # all accumulation is f32 via preferred_element_type
+    q = q_ref[0, 0]  # [BQ, D]
     nk = sk // block_k
     if causal:
         # highest K block any row of this Q block can see
@@ -51,13 +60,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal,
 
     def body(kb, carry):
         acc, m_run, l_run = carry
-        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+            preferred_element_type=jnp.float32) * scale  # [BQ, BK] f32
         if causal:
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -68,7 +75,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal,
         alpha = jnp.exp(m_run - m_new)
         l_new = l_run * alpha + jnp.sum(p, axis=1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
@@ -87,8 +94,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    *, scale, causal, block_q, block_k, sk):
     qb = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
     lse = lse_ref[0, 0]  # [BQ, 1]
     delta = delta_ref[0, 0]  # [BQ, 1]
     nk = sk // block_k
@@ -98,10 +105,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         jnp.int32, (block_q, block_k), 0)
 
     def body(kb, dq):
-        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -113,7 +118,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, nk_dyn,
@@ -124,8 +129,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, scale, causal, block_q, block_k, sq):
     kb = pl.program_id(2)
-    k_blk = k_ref[0, 0].astype(jnp.float32)  # [BK, D]
-    v_blk = v_ref[0, 0].astype(jnp.float32)
+    k_blk = k_ref[0, 0]  # [BK, D]
+    v_blk = v_ref[0, 0]
     nq = sq // block_q
     start_qb = (kb * block_k) // block_q if causal else 0
     k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -133,9 +138,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(
-            jnp.float32)
+        q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q), :]  # [BQ, 1]
         delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
@@ -145,13 +149,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse)  # [BQ, BK]
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return dk, dv
 
     dk0 = jnp.zeros_like(k_blk, jnp.float32)
@@ -196,6 +202,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             flops=4 * b * h * sq * sk * d,
             bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
             transcendentals=b * h * sq * sk),
+        compiler_params=_GRID_SEMANTICS,
     )(q, k, v)
     return out, lse
 
@@ -222,6 +229,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
         ],
         out_specs=_spec_q(block_q, d),
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        compiler_params=_GRID_SEMANTICS,
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
@@ -250,6 +258,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
             jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
         ],
+        compiler_params=_GRID_SEMANTICS,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
 

@@ -83,3 +83,36 @@ def test_resolve_blocks_divisor_fallback():
                                      backend="tpu")
     assert not flash_attention_supported((2, 100, 4, 64), (2, 100, 4, 64),
                                          backend="tpu")
+
+
+def test_flash_bf16_matches_f32_reference():
+    """bf16 operands (MXU full-rate path): forward + grads must stay
+    within bf16 tolerance of the f32 reference — guards the
+    preferred_element_type=f32 accumulation contract."""
+    q, k, v = _rand(1, 256, 2, 64, seed=5)
+    qb, kb, vb = (jnp.asarray(a, jnp.bfloat16) for a in (q, k, v))
+
+    out_b = fa.flash_attention(qb, kb, vb, causal=True)
+    out_f = scaled_dot_product_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        is_causal=True, use_flash=False)
+    np.testing.assert_allclose(np.asarray(out_b, np.float32),
+                               np.asarray(out_f), atol=2e-2, rtol=2e-2)
+
+    def loss_b(q_, k_, v_):
+        return fa.flash_attention(q_, k_, v_, causal=True).astype(
+            jnp.float32).sum()
+
+    def loss_f(q_, k_, v_):
+        return scaled_dot_product_attention(
+            q_, k_, v_, is_causal=True, use_flash=False).sum()
+
+    gb = jax.grad(loss_b, argnums=(0, 1, 2))(qb, kb, vb)
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(jnp.asarray(q),
+                                             jnp.asarray(k),
+                                             jnp.asarray(v))
+    for got, exp, name in zip(gb, gf, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(exp),
+            atol=0.25, rtol=0.08,
+            err_msg=f"d{name} diverged beyond bf16 tolerance")
