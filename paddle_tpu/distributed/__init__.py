@@ -7,13 +7,14 @@ from .env import (ParallelEnv, device_count, get_rank, get_world_size,
                   init_parallel_env, local_device_count)
 
 from . import collective
-from .collective import (ReduceOp, all_gather, all_reduce, alltoall, barrier,
-                         broadcast, recv, reduce, reduce_scatter, scatter,
-                         send)
+from .collective import (ReduceOp, all_gather, all_gather_object,
+                         all_reduce, alltoall, barrier, broadcast, recv,
+                         reduce, reduce_scatter, scatter, send)
 from .parallel import DataParallel, recompute
 from .strategy import DistributedStrategy
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        create_hybrid_communicate_group,
                        get_hybrid_communicate_group, make_mesh)
 from . import fleet, mp_layers, pp, sp
+from .fleet_util import UtilBase, fleet_util
 from .localsgd import LocalSGDTrainStep

@@ -229,3 +229,24 @@ def _mp_allreduce(x, group=None):
 
     ar.defvjp(fwd, bwd)
     return ar(x)
+
+
+def all_gather_object(obj, group=None):
+    """Gather an arbitrary picklable host object from every PROCESS
+    (reference: distributed/collective.py all_gather_object over gloo;
+    here pickled bytes ride process_allgather through the coordination
+    service). Returns the list in rank order."""
+    import pickle
+
+    if jax.process_count() <= 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+    import numpy as np
+    data = np.frombuffer(pickle.dumps(obj), np.uint8)
+    sizes = multihost_utils.process_allgather(
+        np.array([data.size], np.int64)).ravel()
+    buf = np.zeros(int(sizes.max()), np.uint8)
+    buf[:data.size] = data
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    return [pickle.loads(gathered[i, :int(sizes[i])].tobytes())
+            for i in range(len(sizes))]

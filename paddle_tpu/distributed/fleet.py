@@ -40,6 +40,11 @@ from .topology import (HybridCommunicateGroup,
                        create_hybrid_communicate_group,
                        get_hybrid_communicate_group)
 
+# fleet.util attribute (reference: fleet_base.py exposes UtilBase as a
+# property — host collectives + filelist sharding for dataset/PS training)
+from .fleet_util import fleet_util as _fleet_util_factory
+util = _fleet_util_factory()
+
 _fleet_initialized = False
 _strategy: Optional[DistributedStrategy] = None
 

@@ -1,0 +1,86 @@
+"""fleet.util — cross-rank utilities for dataset/PS training.
+
+Reference parity: python/paddle/distributed/fleet/base/util_factory.py
+(UtilBase: all_reduce:61, barrier:110, all_gather:151, get_file_shard:207,
+print_on_rank:265). The reference runs these over gloo comm worlds; here
+host-side values ride the same XLA collectives as tensors (over the dp
+axis of the live mesh) or degenerate to local no-ops in single-process
+runs, with the coordination service (jax.distributed) as the multi-host
+control plane.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from .env import get_rank, get_world_size
+
+
+class UtilBase:
+    """Host-value collectives + filelist sharding (reference UtilBase)."""
+
+    # -- host collectives ----------------------------------------------------
+
+    def all_reduce(self, input, mode: str = "sum",  # noqa: A002
+                   comm_world: str = "worker"):
+        """Elementwise reduce of a host value across ranks."""
+        vals = self.all_gather(input, comm_world)
+        arr = np.asarray(vals)
+        if mode == "sum":
+            return arr.sum(axis=0)
+        if mode == "max":
+            return arr.max(axis=0)
+        if mode == "min":
+            return arr.min(axis=0)
+        raise ValueError(f"unknown all_reduce mode {mode!r}")
+
+    def all_gather(self, input, comm_world: str = "worker") -> List:  # noqa: A002
+        """Gather a host value from every rank (rank order)."""
+        from .collective import all_gather_object
+        return all_gather_object(input)
+
+    def barrier(self, comm_world: str = "worker") -> None:
+        if get_world_size() <= 1:
+            return
+        from .collective import barrier
+        barrier()
+
+    # -- filelist sharding ---------------------------------------------------
+
+    def get_file_shard(self, files: Sequence[str]) -> List[str]:
+        """Split a filelist across trainers with the reference's BLOCKED
+        split: consecutive spans of len(files)//world, the first
+        len(files)%world ranks taking one extra — deterministic,
+        disjoint, covering."""
+        if not isinstance(files, (list, tuple)):
+            raise TypeError("files should be a list of file paths")
+        trainer_id = get_rank()
+        trainers = get_world_size()
+        begin, end = _blocked_range(len(files), trainer_id, trainers)
+        return list(files[begin:end])
+
+    def print_on_rank(self, message: str, rank_id: int) -> None:
+        if get_rank() == rank_id:
+            print(message, flush=True)
+
+
+def _blocked_range(n: int, rank: int, world: int):
+    """Reference get_file_shard split: blocks of n//world, the first
+    n%world ranks take one extra."""
+    base, rem = divmod(n, max(1, world))
+    if rank < rem:
+        begin = rank * (base + 1)
+        end = begin + base + 1
+    else:
+        begin = rem * (base + 1) + (rank - rem) * base
+        end = begin + base
+    return begin, end
+
+
+_util = UtilBase()
+
+
+def fleet_util() -> UtilBase:
+    return _util

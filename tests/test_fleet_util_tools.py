@@ -1,0 +1,86 @@
+"""fleet.util + tools/ benchmark harness.
+
+Reference parity: distributed/fleet/base/util_factory.py tests and the
+tools/check_op_benchmark_result.py CI gate."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_fleet_util_single_process():
+    u = pt.distributed.fleet.util
+    assert u.all_gather(7) == [7]
+    assert u.all_reduce(np.array([1.0, 2.0])).tolist() == [1.0, 2.0]
+    assert u.all_reduce(5, mode="max") == 5
+    u.barrier()  # no-op single process
+    with pytest.raises(TypeError):
+        u.get_file_shard("not-a-list")
+
+
+def test_get_file_shard_blocked_split():
+    from paddle_tpu.distributed.fleet_util import _blocked_range
+
+    # 7 files over 3 ranks: 3/2/2, disjoint + covering, reference split
+    spans = [_blocked_range(7, r, 3) for r in range(3)]
+    assert spans == [(0, 3), (3, 5), (5, 7)]
+    spans = [_blocked_range(4, r, 4) for r in range(4)]
+    assert spans == [(0, 1), (1, 2), (2, 3), (3, 4)]
+    # more ranks than files: tail ranks get nothing
+    spans = [_blocked_range(2, r, 4) for r in range(4)]
+    assert spans == [(0, 1), (1, 2), (2, 2), (2, 2)]
+
+
+def test_op_benchmark_and_checker(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base = tmp_path / "base"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "op_benchmark.py"),
+         "--ops", "add,softmax", "--repeat", "3",
+         "--output", str(base)],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 0, out.stderr
+    lines = [json.loads(l) for l in out.stdout.splitlines() if l.strip()]
+    assert {r["case"] for r in lines} == {"add", "softmax"}
+    assert all(r["avg_us"] > 0 for r in lines)
+
+    # identical logs pass the gate
+    ck = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "check_op_benchmark_result.py"),
+         "--develop_logs_dir", str(base), "--pr_logs_dir", str(base)],
+        capture_output=True, text=True, timeout=60)
+    assert ck.returncode == 0, ck.stdout + ck.stderr
+
+    # a fabricated 10x regression fails with exit code 8
+    slow = tmp_path / "slow"
+    os.makedirs(slow)
+    for fn in os.listdir(base):
+        rec = json.loads(open(base / fn).read())
+        rec["avg_us"] *= 10
+        with open(slow / fn, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    ck = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "check_op_benchmark_result.py"),
+         "--develop_logs_dir", str(base), "--pr_logs_dir", str(slow)],
+        capture_output=True, text=True, timeout=60)
+    assert ck.returncode == 8
+    assert "REGRESSED" in ck.stdout
+
+
+def test_unknown_op_rejected():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "op_benchmark.py"),
+         "--ops", "definitely_not_an_op"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120)
+    assert out.returncode == 2

@@ -1,0 +1,77 @@
+"""Gate op benchmark results against a stored baseline.
+
+Reference parity: tools/check_op_benchmark_result.py — compares a
+development (baseline) logs dir against a PR logs dir and fails when any
+case regresses beyond the threshold.
+
+Usage:
+    python tools/check_op_benchmark_result.py \
+        --develop_logs_dir baseline_logs --pr_logs_dir new_logs \
+        [--threshold 0.15]
+
+Exit code 0 = pass, 8 = regression found (mirrors the reference's
+behavior of failing CI on speed regressions).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_logs_dir(path: str) -> dict:
+    if not os.path.isdir(path):
+        raise SystemExit(f"logs dir not found: {path}")
+    out = {}
+    for fn in sorted(os.listdir(path)):
+        if not fn.endswith(".log"):
+            continue
+        with open(os.path.join(path, fn)) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    r = json.loads(line)
+                    out[r["case"]] = r
+    return out
+
+
+def compare(develop: dict, pr: dict, threshold: float):
+    failures, checked = [], 0
+    for case, dev in develop.items():
+        if case not in pr:
+            failures.append((case, "missing in PR logs", None))
+            continue
+        checked += 1
+        base, new = dev["avg_us"], pr[case]["avg_us"]
+        ratio = (new - base) / base if base else 0.0
+        status = "OK" if ratio <= threshold else "REGRESSED"
+        print(f"[{status}] {case}: {base:.2f} us -> {new:.2f} us "
+              f"({ratio * 100:+.1f}%)")
+        if ratio > threshold:
+            failures.append((case, f"{ratio * 100:+.1f}%", new))
+    return failures, checked
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--develop_logs_dir", required=True)
+    ap.add_argument("--pr_logs_dir", required=True)
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="max allowed slowdown fraction (0.15 = +15%)")
+    args = ap.parse_args()
+
+    develop = load_logs_dir(args.develop_logs_dir)
+    pr = load_logs_dir(args.pr_logs_dir)
+    failures, checked = compare(develop, pr, args.threshold)
+    print(f"checked {checked} cases, {len(failures)} failures")
+    if failures:
+        for case, why, _ in failures:
+            print(f"FAIL {case}: {why}", file=sys.stderr)
+        return 8
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

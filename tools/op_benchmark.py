@@ -1,0 +1,128 @@
+"""Per-op micro-benchmark harness.
+
+Reference parity: tools/test_op_benchmark.sh + the op micro-bench binary
+paddle/fluid/operators/benchmark/op_tester.cc — measures registered ops'
+latency over standard configs and emits one JSON line per case, which
+check_op_benchmark_result.py gates against a stored baseline.
+
+Usage:
+    python tools/op_benchmark.py [--ops matmul,softmax,...] \
+        [--output logs_dir] [--repeat 50] [--platform cpu|tpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+# Standard configs: (op, args builder). Shapes picked to match the
+# reference harness's medium configs (tileable on TPU).
+_RNG = np.random.default_rng(0)
+
+
+def _f32(*shape):
+    return _RNG.standard_normal(shape).astype(np.float32)
+
+
+def default_cases():
+    return {
+        "matmul": lambda: (_f32(512, 512), _f32(512, 512)),
+        "add": lambda: (_f32(1024, 1024), _f32(1024, 1024)),
+        "multiply": lambda: (_f32(1024, 1024), _f32(1024, 1024)),
+        "softmax": lambda: (_f32(256, 1024),),
+        "layer_norm": lambda: (_f32(256, 1024), (1024,)),
+        "gelu": lambda: (_f32(1024, 1024),),
+        "relu": lambda: (_f32(1024, 1024),),
+        "sum": lambda: (_f32(1024, 1024),),
+        "mean": lambda: (_f32(1024, 1024),),
+        "transpose": lambda: (_f32(1024, 1024), (1, 0)),
+        "concat": lambda: ([_f32(512, 512), _f32(512, 512)],),
+        "exp": lambda: (_f32(1024, 1024),),
+        "sigmoid": lambda: (_f32(1024, 1024),),
+        "conv2d": lambda: (_f32(8, 16, 64, 64), _f32(32, 16, 3, 3)),
+        "cross_entropy": lambda: (
+            _f32(512, 1000),
+            _RNG.integers(0, 1000, (512, 1)).astype(np.int64)),
+    }
+
+
+def bench_op(name: str, make_args, repeat: int) -> dict:
+    import jax
+
+    from paddle_tpu.ops.registry import get_op
+
+    fn = get_op(name).fn
+    full_args = make_args()
+    # only array(-list) args are traced; shapes/perm tuples stay static
+    is_arr = [isinstance(a, np.ndarray) or
+              (isinstance(a, list) and a and
+               isinstance(a[0], np.ndarray)) for a in full_args]
+    args = [a for a, m in zip(full_args, is_arr) if m]
+
+    def call(*arrs):
+        it = iter(arrs)
+        return fn(*[next(it) if m else a
+                    for a, m in zip(full_args, is_arr)])
+
+    jitted = jax.jit(call)
+    out = jitted(*args)
+    jax.block_until_ready(out)
+    # hard sync via host fetch (tunneled TPU: block_until_ready alone is
+    # not a reliable barrier)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = jitted(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf).ravel()[:1]
+    dt = (time.perf_counter() - t0) / repeat
+    return {"case": name, "avg_us": round(dt * 1e6, 2),
+            "repeat": repeat}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default="", help="comma list; default all")
+    ap.add_argument("--output", default="", help="dir for per-case logs")
+    ap.add_argument("--repeat", type=int, default=50)
+    ap.add_argument("--platform", default="cpu", choices=["cpu", "tpu"])
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu  # noqa: F401 - registers ops
+
+    cases = default_cases()
+    if args.ops:
+        wanted = args.ops.split(",")
+        missing = [w for w in wanted if w not in cases]
+        if missing:
+            print(f"no standard config for: {missing}", file=sys.stderr)
+            return 2
+        cases = {k: cases[k] for k in wanted}
+
+    results = []
+    for name, make in cases.items():
+        r = bench_op(name, make, args.repeat)
+        results.append(r)
+        line = json.dumps(r)
+        print(line, flush=True)
+        if args.output:
+            os.makedirs(args.output, exist_ok=True)
+            with open(os.path.join(args.output, f"{name}.log"), "w") as f:
+                f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
