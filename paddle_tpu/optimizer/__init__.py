@@ -3,5 +3,6 @@
 from . import lr
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 from .dgc import DGCMomentum
-from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
-                        LarsMomentum, Momentum, Optimizer, RMSProp)
+from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,
+                        DecayedAdagrad, Dpsgd, Ftrl, Lamb, LarsMomentum,
+                        Momentum, Optimizer, RMSProp, Rprop)

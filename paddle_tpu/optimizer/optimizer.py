@@ -494,3 +494,127 @@ class LarsMomentum(Optimizer):
         v = self._momentum * state["velocity"] + lr * local_lr * (
             g + self._lars_wd * value)
         return value - v, {"velocity": v}
+
+
+class Ftrl(Optimizer):
+    """Follow-the-regularized-leader (reference: fluid/optimizer.py
+    FtrlOptimizer, operators/optimizers/ftrl_op)."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _init_state(self, value):
+        return {"squared": jnp.zeros_like(value),
+                "linear": jnp.zeros_like(value)}
+
+    def _update(self, value, grad, state, lr, step):
+        g = grad.astype(value.dtype)
+        sq, lin = state["squared"], state["linear"]
+        new_sq = sq + g * g
+        lp = -self._lr_power
+        sigma = (new_sq ** lp - sq ** lp) / lr
+        new_lin = lin + g - sigma * value
+        pre = new_sq ** lp / lr + 2.0 * self._l2
+        l1 = self._l1
+        new_value = jnp.where(
+            jnp.abs(new_lin) > l1,
+            (jnp.sign(new_lin) * l1 - new_lin) / pre, 0.0
+        ).astype(value.dtype)
+        return new_value, {"squared": new_sq, "linear": new_lin}
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD: gradient + calibrated Gaussian noise
+    (reference: fluid/optimizer.py DpsgdOptimizer,
+    operators/optimizers/dpsgd_op — clip/batch/sigma parameters)."""
+
+    # per-tensor clip norm + per-param noise draw: a fused concatenated
+    # update would clip the GLOBAL norm and draw one noise vector,
+    # changing the DP sensitivity bound (same reason Lamb/LARS opt out)
+    _elementwise_update = False
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, seed: int = 0, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._clip, self._batch, self._sigma = clip, batch_size, sigma
+        self._seed = seed
+        self._next_noise_id = 0
+
+    def _init_state(self, value):
+        # a unique per-parameter id (assigned at slot-init order) folds
+        # into the noise key so same-shaped params draw INDEPENDENT noise
+        nid = self._next_noise_id
+        self._next_noise_id += 1
+        return {"noise_id": jnp.asarray(nid, jnp.int32)}
+
+    def _update(self, value, grad, state, lr, step):
+        g = grad.astype(jnp.float32)
+        norm = jnp.sqrt(jnp.sum(g * g))
+        scale = jnp.minimum(1.0, self._clip / jnp.maximum(norm, 1e-12))
+        g = g * scale
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.key(self._seed),
+                               jnp.asarray(step, jnp.int32)),
+            state["noise_id"])
+        noise = jax.random.normal(key, g.shape, jnp.float32) * (
+            self._clip * self._sigma / self._batch)
+        new_value = (value.astype(jnp.float32) -
+                     lr * (g + noise)).astype(value.dtype)
+        return new_value, state
+
+
+class DecayedAdagrad(Optimizer):
+    """Adagrad with decaying accumulator (reference: fluid/optimizer.py
+    DecayedAdagradOptimizer, operators/optimizers/decayed_adagrad_op)."""
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _init_state(self, value):
+        return {"moment": jnp.zeros_like(value)}
+
+    def _update(self, value, grad, state, lr, step):
+        g = grad.astype(value.dtype)
+        m = self._decay * state["moment"] + (1 - self._decay) * g * g
+        new_value = value - lr * g / (jnp.sqrt(m) + self._epsilon)
+        return new_value, {"moment": m}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop: sign-based per-weight step sizes (reference:
+    paddle Rprop optimizer family)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 etas=(0.5, 1.2), parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_minus, self._eta_plus = etas
+
+    def _init_state(self, value):
+        return {"prev_grad": jnp.zeros_like(value),
+                "step_size": jnp.full_like(
+                    value, float(self.get_lr()))}
+
+    def _update(self, value, grad, state, lr, step):
+        g = grad.astype(value.dtype)
+        prev, sz = state["prev_grad"], state["step_size"]
+        sign = jnp.sign(g * prev)
+        sz = jnp.clip(
+            jnp.where(sign > 0, sz * self._eta_plus,
+                      jnp.where(sign < 0, sz * self._eta_minus, sz)),
+            self._lr_min, self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_value = value - jnp.sign(g_eff) * sz
+        return new_value, {"prev_grad": g_eff, "step_size": sz}

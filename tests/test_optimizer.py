@@ -284,3 +284,48 @@ def test_bf16_params_stay_bf16_with_array_lr():
         p, st = opt.apply_gradients(params, grads, st, lr=lr_dev)
         p, st = opt.apply_gradients(p, grads, st, lr=lr_dev)
         assert p["w"].dtype == jnp.bfloat16, type(opt).__name__
+
+
+def test_legacy_optimizer_family_converges():
+    """Ftrl/Dpsgd/DecayedAdagrad/Rprop (reference fluid/optimizer.py
+    legacy family) reduce a quadratic loss."""
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.optimizer as optim
+
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+
+    for make in (lambda p: optim.Ftrl(learning_rate=0.5, parameters=p),
+                 lambda p: optim.Dpsgd(learning_rate=0.05, sigma=0.0,
+                                       parameters=p),
+                 lambda p: optim.DecayedAdagrad(learning_rate=0.3,
+                                                parameters=p),
+                 lambda p: optim.Rprop(learning_rate=0.05,
+                                       parameters=p)):
+        w = pt.Parameter(np.zeros(3, np.float32))
+        opt = make([w])
+        first = None
+        for _ in range(60):
+            loss = ((w - pt.Tensor(target)) ** 2).sum()
+            if first is None:
+                first = float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < first * 0.35, \
+            (type(opt).__name__, first, float(loss))
+
+
+def test_dpsgd_noise_independent_across_params():
+    """Same-shaped params must draw DIFFERENT noise (DP independence)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu.optimizer as optim
+
+    opt = optim.Dpsgd(learning_rate=1.0, sigma=1.0, batch_size=1.0,
+                      clip=1e9)
+    params = {"a": jnp.zeros(4), "b": jnp.zeros(4)}
+    grads = {"a": jnp.zeros(4), "b": jnp.zeros(4)}
+    st = opt.init(params)
+    p, _ = opt.apply_gradients(params, grads, st)
+    assert not np.allclose(np.asarray(p["a"]), np.asarray(p["b"]))
