@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Optional
+from typing import Any, NamedTuple, Optional
 
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -100,6 +100,20 @@ def ernie_10b(**kw):
                      max_seq_len=4096, **kw)
 
 
+class StaticKVCache(NamedTuple):
+    """Preallocated per-layer KV buffer for fixed-shape decode.
+
+    ``k``/``v``: [B, max_len, H, D] buffers; ``pos``: number of valid
+    positions already written. Shapes never change across decode steps,
+    so the whole generate loop compiles into one lax.scan (the serving
+    analog of the reference inference engine's fused decoder kernels,
+    e.g. operators/fused/multihead_matmul_op.cu's cache path)."""
+
+    k: Any
+    v: Any
+    pos: Any
+
+
 def _remat_block(block, x):
     """Run ``block`` under jax.checkpoint as ONE taped op: the pure kernel
     takes (hidden, *param_values) so the eager tape differentiates through
@@ -146,6 +160,11 @@ class GPTAttention(Layer):
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
         new_cache = None
+        if use_cache and isinstance(cache, StaticKVCache):
+            # Fixed-shape decode path (scan/jit-able): write the new k/v
+            # at pos into the preallocated buffers and attend over the
+            # whole buffer with a validity mask.
+            return self._decode_static(q, k, v, cache, b, s)
         if use_cache:
             if cache is not None:
                 k = F["concat"]([cache[0], k], axis=1)
@@ -166,6 +185,38 @@ class GPTAttention(Layer):
         if use_cache:
             return out, new_cache
         return out
+
+    def _decode_static(self, q, k, v, cache, b, s):
+        """Single/multi-token decode against a preallocated KV buffer:
+        k/v written at cache.pos via dynamic_update_slice, attention over
+        the full buffer masked to positions < pos + s. Fixed shapes
+        throughout — the building block of the jitted generate loop."""
+        import jax
+
+        def upd(buf, val, p):
+            return jax.lax.dynamic_update_slice(
+                buf, val.astype(buf.dtype), (0, p, 0, 0))
+
+        k_buf = dispatch.call_fn(upd, "kv_cache_update", True,
+                                 (cache.k, k, cache.pos), {})
+        v_buf = dispatch.call_fn(upd, "kv_cache_update", True,
+                                 (cache.v, v, cache.pos), {})
+        total = k_buf.shape[1]
+
+        def attend(qq, kk, vv, p):
+            # causal over absolute positions: query i sits at p + i;
+            # shared sdpa does the fp32-softmax attention under the mask
+            kpos = jnp.arange(total)[None, None, None, :]
+            qpos = p + jnp.arange(qq.shape[1])[None, None, :, None]
+            from .. import ops
+            return ops.nn_functional.scaled_dot_product_attention(
+                qq, kk, vv, attn_mask=kpos <= qpos, use_flash=False)
+
+        out = dispatch.call_fn(attend, "kv_cache_attention", True,
+                               (q, k_buf, v_buf, cache.pos), {})
+        out = F["reshape"](out, (b, s, self.num_heads * self.head_dim))
+        out = self.out_proj(out)
+        return out, StaticKVCache(k_buf, v_buf, cache.pos + s)
 
 
 class GPTMLP(Layer):
@@ -237,7 +288,9 @@ class GPTModel(Layer):
             position_ids = F["arange"](s, dtype="int32")
             offset = 0
             if caches is not None and caches[0] is not None:
-                offset = caches[0][0].shape[1]
+                c0 = caches[0]
+                offset = c0.pos if isinstance(c0, StaticKVCache) \
+                    else c0[0].shape[1]
                 position_ids = position_ids + offset
             position_ids = F["expand"](
                 F["unsqueeze"](position_ids, 0), (b, s))
@@ -371,12 +424,20 @@ class GPTForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens: int = 20,
                  temperature: float = 1.0, top_k: Optional[int] = None,
-                 key=None):
-        """Greedy/top-k sampling with kv cache (eager decode loop)."""
+                 key=None, use_jit: bool = False):
+        """Greedy/top-k sampling with kv cache. ``use_jit`` compiles the
+        WHOLE generation (prefill + lax.scan decode over a StaticKVCache)
+        into one device launch — the serving hot path; the eager loop
+        stays as the debuggable reference."""
         import jax
         from ..core.rng import next_key
         from ..tensor import Tensor
 
+        if use_jit and max_new_tokens > 0:
+            return self._generate_jit(input_ids, max_new_tokens,
+                                      temperature, top_k, key)
+        if max_new_tokens <= 0:
+            return input_ids
         self.eval()
         caches = [None] * self.config.num_layers
         ids = input_ids
@@ -404,3 +465,80 @@ class GPTForCausalLM(Layer):
             logits, caches = self.forward(nxt, caches=caches)
             cur = logits[:, -1]
         return F["concat"](out_ids, axis=1)
+
+    def _generate_jit(self, input_ids, max_new_tokens, temperature, top_k,
+                      key):
+        """One-launch generation: prefill writes the prompt's KV into
+        preallocated buffers, then lax.scan runs fixed-shape decode steps
+        (TPU-native replacement for the reference inference engine's
+        decoder loop — no Python between tokens)."""
+        import jax
+
+        from ..autograd.engine import no_grad
+        from ..core.rng import next_key
+        from ..nn.layer import bind_state, functional_state
+
+        self.eval()
+        ids_raw = input_ids.value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        b, s = ids_raw.shape
+        total = s + max_new_tokens
+        cfg = self.config
+        nh, hd, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
+        state = functional_state(self)
+        dt = state["params"]["gpt.wte.weight"].dtype
+        key_raw = key.value if isinstance(key, Tensor) else key
+        if key_raw is None:
+            key_raw = next_key()
+        temp, tk = float(temperature), top_k
+
+        def raw(t):
+            return t.value if isinstance(t, Tensor) else t
+
+        def fwd(params, ids, caches):
+            with bind_state(self, {"params": params, "buffers": {}}), \
+                    no_grad():
+                logits, nc = self.forward(Tensor(ids), caches=caches)
+            return raw(logits), [
+                StaticKVCache(raw(c.k), raw(c.v), raw(c.pos)) for c in nc]
+
+        def sample(last, k):  # last: [B, V]
+            if temp == 0.0:
+                return jnp.argmax(last, -1).astype(jnp.int32), k
+            scaled = last.astype(jnp.float32) / temp
+            if tk is not None:
+                kth = jax.lax.top_k(scaled, tk)[0][:, -1:]
+                scaled = jnp.where(scaled < kth, -1e10, scaled)
+            k, sub = jax.random.split(k)
+            return jax.random.categorical(sub, scaled, axis=-1).astype(
+                jnp.int32), k
+
+        def run(params, ids, k):
+            caches = [StaticKVCache(jnp.zeros((b, total, nh, hd), dt),
+                                    jnp.zeros((b, total, nh, hd), dt),
+                                    jnp.asarray(0, jnp.int32))
+                      for _ in range(nl)]
+            logits, caches = fwd(params, ids, caches)  # prefill
+            nxt, k = sample(logits[:, -1], k)
+
+            def body(carry, _):
+                cur, cs, kk = carry
+                lg, cs = fwd(params, cur[:, None], cs)
+                nxt2, kk = sample(lg[:, -1], kk)
+                return (nxt2, cs, kk), cur
+
+            (last, _, _), toks = jax.lax.scan(
+                body, (nxt, caches, k), None, length=max_new_tokens - 1)
+            # toks: [N-1, B] tokens fed at each step; `last` is token N
+            all_new = jnp.concatenate(
+                [toks, last[None]], axis=0).swapaxes(0, 1)  # [B, N]
+            return jnp.concatenate([ids, all_new], axis=1)
+
+        sig = (b, s, max_new_tokens, temp, tk)
+        cache = getattr(self, "_gen_jit_cache", None)
+        if cache is None:
+            cache = self._gen_jit_cache = {}
+        if sig not in cache:
+            cache[sig] = jax.jit(run)
+        out = cache[sig](state["params"], ids_raw, key_raw)
+        return Tensor(out)
