@@ -62,6 +62,20 @@ def Model(*args, **kwargs):
     return _M(*args, **kwargs)
 
 
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """reference: paddle.flops (hapi/dynamic_flops.py) — exact count via
+    XLA cost analysis of the traced forward."""
+    from .hapi.flops import flops as _flops
+    return _flops(net, input_size, custom_ops, print_detail)
+
+
+def summary(net, input_size=None, dtypes=None):
+    """reference: paddle.summary — per-layer parameter table (shapes are
+    not traced; the table reports parameter counts)."""
+    from .hapi import Model as _M
+    return _M(net).summary(input_size, dtypes)
+
+
 def DataParallel(*args, **kwargs):
     from .distributed.parallel import DataParallel as _DP
     return _DP(*args, **kwargs)

@@ -3,4 +3,5 @@
 from . import callbacks
 from .callbacks import (Callback, EarlyStopping, LRScheduler,
                         ModelCheckpoint, ProgBarLogger, ReduceLROnPlateau)
+from .flops import flops
 from .model import Model

@@ -1,0 +1,87 @@
+"""Public API surface freeze.
+
+Reference parity: paddle/fluid/API.spec — the reference freezes its
+public API signatures so accidental removals/renames fail CI. Here the
+freeze list is the load-bearing subset a reference user would reach
+for; anything vanishing from it is a breaking change this test turns
+into a loud failure."""
+
+import importlib
+
+import pytest
+
+SURFACE = {
+    "paddle_tpu": [
+        "Tensor", "to_tensor", "Parameter", "seed", "set_flags",
+        "get_flags", "save", "load", "no_grad", "grad", "Model",
+        "DataParallel", "flops", "summary", "set_grad_enabled",
+    ],
+    "paddle_tpu.nn": [
+        "Layer", "Linear", "Embedding", "Conv2D", "LayerNorm",
+        "BatchNorm2D", "Transformer", "TransformerEncoder", "LSTM", "GRU",
+        "MultiHeadAttention", "Sequential", "LayerList", "CrossEntropyLoss",
+        "MSELoss", "Dropout", "ReLU", "GELU", "Softmax", "Pad2D", "Pad3D",
+        "ZeroPad2D", "Unfold", "Fold", "MaxPool2D", "AdaptiveAvgPool2D",
+        "functional", "initializer", "utils",
+    ],
+    "paddle_tpu.nn.functional": [
+        "relu", "gelu", "softmax", "cross_entropy", "mse_loss", "linear",
+        "embedding", "conv2d", "layer_norm", "dropout", "pad",
+        "scaled_dot_product_attention", "ctc_loss", "one_hot",
+    ],
+    "paddle_tpu.nn.utils": [
+        "weight_norm", "remove_weight_norm", "spectral_norm",
+        "parameters_to_vector", "vector_to_parameters",
+    ],
+    "paddle_tpu.optimizer": [
+        "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+        "Adagrad", "Adadelta", "RMSProp", "Lamb", "LarsMomentum",
+        "DGCMomentum", "Ftrl", "Dpsgd", "DecayedAdagrad", "Rprop", "lr",
+        "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",
+    ],
+    "paddle_tpu.optimizer.lr": [
+        "LRScheduler", "StepDecay", "MultiStepDecay", "ExponentialDecay",
+        "CosineAnnealingDecay", "NoamDecay", "PolynomialDecay",
+        "LinearWarmup", "ReduceOnPlateau",
+    ],
+    "paddle_tpu.distributed": [
+        "init_parallel_env", "get_rank", "get_world_size", "all_reduce",
+        "all_gather", "all_gather_object", "broadcast", "reduce_scatter",
+        "alltoall", "barrier", "fleet", "DistributedStrategy",
+        "DataParallel", "HybridCommunicateGroup", "UtilBase",
+    ],
+    "paddle_tpu.distributed.fleet": [
+        "init", "distributed_optimizer", "distributed_model",
+        "distributed_jit", "util", "worker_index", "worker_num",
+    ],
+    "paddle_tpu.io": [
+        "Dataset", "IterableDataset", "TensorDataset", "DataLoader",
+        "BatchSampler", "DistributedBatchSampler", "Sampler",
+        "RandomSampler", "SequenceSampler",
+    ],
+    "paddle_tpu.static": [
+        "InputSpec", "Program", "Executor", "build_program",
+        "save_inference_model", "load_inference_model", "program_guard",
+        "data",
+    ],
+    "paddle_tpu.jit": [
+        "TrainStep", "EvalStep", "to_static", "save", "load",
+    ],
+    "paddle_tpu.amp": ["auto_cast", "GradScaler", "decorate"],
+    "paddle_tpu.metric": ["Accuracy", "Precision", "Recall", "Auc"],
+    "paddle_tpu.inference": ["Config", "Predictor", "create_predictor"],
+    "paddle_tpu.vision": ["models", "transforms", "datasets"],
+    "paddle_tpu.framework": [
+        "save", "load", "MultiTrainer", "DistMultiTrainer",
+        "TrainerFactory",
+    ],
+}
+
+
+@pytest.mark.parametrize("module", sorted(SURFACE))
+def test_api_surface_frozen(module):
+    mod = importlib.import_module(module)
+    missing = [n for n in SURFACE[module] if not hasattr(mod, n)]
+    assert not missing, (f"{module} lost public API: {missing} — "
+                        "update the freeze list ONLY for deliberate "
+                        "breaking changes")

@@ -84,3 +84,37 @@ def test_unknown_op_rejected():
         capture_output=True, text=True,
         env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120)
     assert out.returncode == 2
+
+
+def test_merge_traces(tmp_path):
+    t0 = {"traceEvents": [
+        {"name": "step", "ph": "X", "ts": 1000, "dur": 5, "pid": 1,
+         "tid": 1}]}
+    t1 = [{"name": "allreduce", "ph": "X", "ts": 2000, "dur": 3,
+           "pid": 1, "tid": 1}]
+    p0, p1 = tmp_path / "host0.json", tmp_path / "host1.json"
+    p0.write_text(json.dumps(t0))
+    p1.write_text(json.dumps(t1))
+    out = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "merge_traces.py"),
+         "--out", str(out), str(p0), str(p1)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    merged = json.loads(out.read_text())["traceEvents"]
+    pids = {e["pid"] for e in merged if e.get("ph") == "X"}
+    assert pids == {"host0/1", "host1/1"}
+    # per-source start alignment
+    assert all(e["ts"] == 0 for e in merged if e.get("ph") == "X")
+
+
+def test_flops_and_summary():
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+
+    net = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 8))
+    f = pt.flops(net, (2, 32))
+    ref = 2 * 2 * (32 * 64 + 64 * 8)  # 2 * batch * madds
+    assert ref * 0.5 <= f <= ref * 2.5, (f, ref)
+    info = pt.summary(net)
+    assert info["total_params"] == 32 * 64 + 64 + 64 * 8 + 8

@@ -1,0 +1,89 @@
+"""Merge per-host/per-process chrome traces into one timeline.
+
+Reference parity: tools/CrossStackProfiler/ (CspReporter.py merges op
+logs + DCGM + net logs from every worker into a single chrome trace).
+Here every worker exports a chrome trace via paddle_tpu.profiler
+(chrome_trace()); this tool merges them with per-source pid namespacing
+so chrome://tracing / Perfetto shows all hosts on one timeline.
+
+Usage:
+    python tools/merge_traces.py --out merged.json trace0.json trace1.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_trace(path: str):
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        return data.get("traceEvents", [])
+    return data
+
+
+def _labels(paths):
+    """Short unique label per source: basename, disambiguated by the
+    shortest distinguishing path suffix (host dirs usually differ while
+    filenames repeat, e.g. host0/trace.json host1/trace.json)."""
+    bases = [os.path.splitext(os.path.basename(p))[0] for p in paths]
+    labels = []
+    for i, p in enumerate(paths):
+        if bases.count(bases[i]) == 1:
+            labels.append(bases[i])
+        else:
+            parent = os.path.basename(os.path.dirname(os.path.abspath(p)))
+            labels.append(f"{parent}/{bases[i]}" if parent else
+                          f"{bases[i]}#{i}")
+    # last resort: force uniqueness
+    seen = {}
+    for i, l in enumerate(labels):
+        if l in seen:
+            labels[i] = f"{l}#{i}"
+        seen[l] = i
+    return labels
+
+
+def merge(paths, align_start: bool = True):
+    merged = []
+    for path, label in zip(paths, _labels(paths)):
+        events = load_trace(path)
+        t0 = min((e["ts"] for e in events if "ts" in e), default=0)
+        pids = set()
+        for e in events:
+            e = dict(e)
+            # namespace pids so sources do not collide on one track
+            pid = f"{label}/{e.get('pid', 0)}"
+            e["pid"] = pid
+            pids.add(pid)
+            if align_start and "ts" in e:
+                e["ts"] = e["ts"] - t0
+            merged.append(e)
+        for pid in sorted(pids):
+            merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": pid}})
+    merged.sort(key=lambda e: e.get("ts", 0))
+    return merged
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("traces", nargs="+", help="chrome trace json files")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--no-align", action="store_true",
+                    help="keep absolute timestamps (clock-synced hosts)")
+    args = ap.parse_args()
+    merged = merge(args.traces, align_start=not args.no_align)
+    with open(args.out, "w") as f:
+        json.dump({"traceEvents": merged}, f)
+    print(f"merged {len(args.traces)} traces, {len(merged)} events -> "
+          f"{args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
