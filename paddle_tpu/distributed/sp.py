@@ -124,9 +124,67 @@ def ulysses_attention(q, k, v, axis_name: str = "sep",
     return head_to_seq(out)
 
 
+def _axis_bound(axis_name: str) -> bool:
+    try:
+        jax.lax.axis_size(axis_name)
+        return True
+    except NameError:
+        return False
+
+
 def sequence_parallel_attention(q, k, v, mode: str = "ring",
                                 axis_name: str = "sep",
                                 causal: bool = False):
-    if mode == "ring":
-        return ring_attention(q, k, v, axis_name, causal)
-    return ulysses_attention(q, k, v, axis_name, causal)
+    """Three calling contexts, one entry point:
+
+    - inside shard_map with ``axis_name`` bound: run the sharded
+      algorithm directly (the op-level usage);
+    - under jit with a live hybrid mesh whose sep degree > 1: enter a
+      shard_map region here, sharding batch over (dp, sharding) and
+      sequence over sep — this is what the model-level
+      ``seq_parallel_mode`` config reaches through GSPMD-jitted steps;
+    - anywhere else (eager single device, sep degree 1): dense
+      attention fallback with identical semantics.
+    """
+    if _axis_bound(axis_name):
+        if mode == "ring":
+            return ring_attention(q, k, v, axis_name, causal)
+        return ulysses_attention(q, k, v, axis_name, causal)
+
+    from .topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    from jax._src import core as _jax_core
+    in_trace = not _jax_core.trace_state_clean()
+    dims = dict(hcg.mesh.shape) if hcg is not None else {}
+    sep = dims.get(axis_name, 1)
+    if hcg is not None and in_trace and sep > 1:
+        for bad in ("mp", "pp"):
+            if dims.get(bad, 1) > 1:
+                raise NotImplementedError(
+                    "model-level sequence parallelism composes with dp/"
+                    f"sharding but not {bad} (use the op-level "
+                    "ring/ulysses_attention inside your own shard_map)")
+        if q.shape[1] % sep:
+            raise ValueError(
+                f"sequence length {q.shape[1]} must divide the sep "
+                f"degree {sep} for seq_parallel_mode")
+        if mode == "ulysses" and q.shape[2] % sep:
+            raise ValueError(
+                f"ulysses redistributes heads over sep: num_heads "
+                f"{q.shape[2]} must divide the sep degree {sep}")
+        from jax import shard_map
+        batch_axes = tuple(a for a in ("dp", "sharding")
+                           if dims.get(a, 1) > 1) or None
+        spec = P(batch_axes, axis_name)
+
+        def sharded(qq, kk, vv):
+            if mode == "ring":
+                return ring_attention(qq, kk, vv, axis_name, causal)
+            return ulysses_attention(qq, kk, vv, axis_name, causal)
+
+        return shard_map(sharded, mesh=hcg.mesh, in_specs=spec,
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+    from ..ops.nn_functional import scaled_dot_product_attention
+    return scaled_dot_product_attention(q, k, v, is_causal=causal,
+                                        use_flash=False)
