@@ -74,8 +74,10 @@ class DownpourWorker(DeviceWorker):
             # serialize, as they already do at the single device.
             with trainer._lock:
                 trainer._pull_dense(self.worker_id)
+                trainer._pull_sparse(batch)
                 loss = trainer._run_step(batch, self.worker_id)
                 trainer._push_dense(self.worker_id)
+                trainer._push_sparse(batch)
             self.metrics["steps"] += 1
             if loss is not None and np.ndim(loss) == 0:
                 self.metrics["loss_sum"] += float(loss)
@@ -105,6 +107,12 @@ class MultiTrainer:
         pass
 
     def _push_dense(self, worker_id: int) -> None:  # pragma: no cover
+        pass
+
+    def _pull_sparse(self, batch) -> None:  # pragma: no cover
+        pass
+
+    def _push_sparse(self, batch) -> None:  # pragma: no cover
         pass
 
     def _run_step(self, batch, worker_id: int):
@@ -192,13 +200,21 @@ class DistMultiTrainer(MultiTrainer):
                  dense_table: str = "dense_0",
                  get_dense: Optional[Callable[[], np.ndarray]] = None,
                  set_dense: Optional[Callable[[np.ndarray], None]] = None,
-                 get_grad: Optional[Callable[[], np.ndarray]] = None):
+                 get_grad: Optional[Callable[[], np.ndarray]] = None,
+                 sparse_pull: Optional[Callable] = None,
+                 sparse_push: Optional[Callable] = None):
         super().__init__(step_fn, thread_num)
         self.ps_client = ps_client
         self.dense_table = dense_table
         self._get_dense = get_dense
         self._set_dense = set_dense
         self._get_grad = get_grad
+        # sparse hooks (reference DownpourWorker sparse tables / the
+        # heter-PS split: embedding rows live server-side; each cycle
+        # pulls the batch's rows and pushes their grads):
+        # sparse_pull(ps_client, batch), sparse_push(ps_client, batch)
+        self._sparse_pull = sparse_pull
+        self._sparse_push = sparse_push
 
     def _pull_dense(self, worker_id: int) -> None:
         if self.ps_client is None or self._set_dense is None:
@@ -211,6 +227,14 @@ class DistMultiTrainer(MultiTrainer):
         g = self._get_grad()
         if g is not None:
             self.ps_client.push_dense_grad(self.dense_table, g)
+
+    def _pull_sparse(self, batch) -> None:
+        if self.ps_client is not None and self._sparse_pull is not None:
+            self._sparse_pull(self.ps_client, batch)
+
+    def _push_sparse(self, batch) -> None:
+        if self.ps_client is not None and self._sparse_push is not None:
+            self._sparse_push(self.ps_client, batch)
 
 
 class TrainerFactory:
@@ -227,3 +251,4 @@ class TrainerFactory:
             raise NotFoundError(f"unknown trainer {name!r}; have "
                                 f"{sorted(cls._TRAINERS)}")
         return cls._TRAINERS[name](*args, **kwargs)
+

@@ -29,6 +29,7 @@ from .loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
                    PoissonNLLLoss, GaussianNLLLoss, SmoothL1Loss,
                    SoftMarginLoss, TripletMarginLoss)
 from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                   DataNorm,
                    GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
                    LayerNorm, LocalResponseNorm, RMSNorm, SyncBatchNorm)
 from .pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,

@@ -204,3 +204,46 @@ class LocalResponseNorm(Layer):
     def forward(self, x):
         return F["local_response_norm"](x, self.size, self.alpha, self.beta,
                                         self.k, self._data_format)
+
+
+class DataNorm(Layer):
+    """CTR data normalization with accumulated statistics (reference:
+    fluid layers.data_norm / operators/data_norm_op.cc). Buffers
+    batch_size/batch_sum/batch_square_sum accumulate during training;
+    forward normalizes from the accumulated moments."""
+
+    def __init__(self, num_features, epsilon=1e-4,
+                 slot_dim: int = -1, summary_decay_rate: float = 0.9999999,
+                 name=None):
+        super().__init__()
+        if slot_dim > 0:
+            raise NotImplementedError(
+                "DataNorm slot_dim>0 (show/click slot handling) is not "
+                "implemented; pass slot_dim=-1 for plain per-feature "
+                "normalization")
+        self._epsilon = epsilon
+        self._decay = summary_decay_rate
+        init_size = 1e4
+        self.register_buffer("batch_size", Tensor(
+            jnp.full((num_features,), init_size, jnp.float32)))
+        self.register_buffer("batch_sum", Tensor(
+            jnp.zeros((num_features,), jnp.float32)))
+        self.register_buffer("batch_square_sum", Tensor(
+            jnp.full((num_features,), init_size, jnp.float32)))
+
+    def forward(self, x):
+        out = F["data_norm"](x, self.batch_size, self.batch_sum,
+                             self.batch_square_sum, self._epsilon)
+        if self.training:
+            xv = x.value if isinstance(x, Tensor) else x
+            n = x.shape[0]
+            d = self._decay
+            mean = self.batch_sum.value / self.batch_size.value
+            self.batch_size.value = self.batch_size.value * d + n
+            self.batch_sum.value = self.batch_sum.value * d + xv.sum(0)
+            # centered accumulator (reference: square sums are taken
+            # around the running mean, so scales = sqrt(size/square_sum))
+            self.batch_square_sum.value = (
+                self.batch_square_sum.value * d +
+                ((xv - mean) ** 2).sum(0))
+        return out

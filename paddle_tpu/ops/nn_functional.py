@@ -1206,3 +1206,13 @@ def gaussian_nll_loss(input, label, variance, full=False,  # noqa: A002
     if full:
         loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * jnp.pi, input.dtype))
     return _reduce(loss, reduction)
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4):
+    """CTR feature normalization from ACCUMULATED batch statistics
+    (reference data_norm_op.cc: means = batch_sum / batch_size, scales =
+    sqrt(batch_size / batch_square_sum) — batch_square_sum accumulates
+    CENTERED squares, so scales is 1/std)."""
+    mean = batch_sum / batch_size
+    scale = jnp.sqrt(batch_size / jnp.maximum(batch_square_sum, epsilon))
+    return (x - mean) * scale

@@ -104,3 +104,38 @@ def test_new_layer_wrappers_forward():
                  nn.PoissonNLLLoss()(inp, lam),
                  nn.GaussianNLLLoss()(inp, lam, var)):
         assert np.isfinite(float(loss))
+
+
+def test_data_norm():
+    """data_norm op formula + DataNorm layer stat accumulation
+    (reference operators/data_norm_op.cc semantics: normalize from
+    ACCUMULATED batch statistics, heavy prior decays slowly)."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 4)).astype("f") * 3 + 1
+
+    from paddle_tpu.ops.nn_functional import data_norm
+
+    bsize = np.full((4,), 100.0, "f")
+    bsum = np.full((4,), 200.0, "f")   # mean 2
+    bss = np.full((4,), 400.0, "f")    # centered: var 4 -> scale 0.5
+    got = np.asarray(data_norm(x, bsize, bsum, bss))
+    np.testing.assert_allclose(got, (x - 2.0) * 0.5, rtol=1e-4, atol=1e-4)
+
+    dn = nn.DataNorm(4)
+    dn.train()
+    s0 = np.asarray(dn.batch_sum.value).copy()
+    out = dn(pt.Tensor(x))
+    assert out.shape == (32, 4)
+    # accumulators moved toward the batch stats
+    assert (np.asarray(dn.batch_sum.value) != s0).all()
+    np.testing.assert_allclose(
+        np.asarray(dn.batch_sum.value) - s0 * (1 - 7e-7) - s0 * 7e-7,
+        x.sum(0), rtol=1e-3, atol=2e-3)
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        nn.DataNorm(4, slot_dim=8)
+    # eval mode: stats frozen
+    dn.eval()
+    s1 = np.asarray(dn.batch_sum.value).copy()
+    dn(pt.Tensor(x))
+    np.testing.assert_array_equal(np.asarray(dn.batch_sum.value), s1)
