@@ -25,6 +25,8 @@ _NON_DIFF_OPS = {
     "isinf", "isfinite", "shape", "numel", "count_nonzero",
     "nms", "multiclass_nms", "bipartite_match",
     "crf_decoding", "gather_tree", "beam_search_decode", "shuffle_batch",
+    "digitize", "bitwise_left_shift", "bitwise_right_shift",
+    "is_complex", "is_floating_point", "rank",
 }
 
 

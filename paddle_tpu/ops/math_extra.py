@@ -12,6 +12,8 @@ import itertools
 
 import jax
 import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+import jax.scipy.special as jsp
 import numpy as np
 
 
@@ -215,3 +217,120 @@ def complex(real, imag):  # noqa: A001 - mirrors the public API name
 
 def polar(abs, angle):  # noqa: A002 - mirrors the public API name
     return abs * jnp.exp(1j * angle.astype(jnp.result_type(angle, 0.0j)))
+
+
+# --- tensor-API tail --------------------------------------------------------
+
+def take(x, index, mode="raise"):
+    """Flattened-index gather (reference take: treats x as 1-D)."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"take mode must be raise/wrap/clip, got {mode!r}")
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int32) if hasattr(index, "astype") else index
+    if mode == "wrap":
+        idx = jnp.mod(idx, flat.shape[0])
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+    else:  # 'raise' cannot raise under jit; clamp like gather semantics
+        idx = jnp.clip(idx, -flat.shape[0], flat.shape[0] - 1)
+    return flat[idx]
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def polygamma(x, n):
+    return jsp.polygamma(n, x)
+
+
+def i0(x):
+    return jsp.i0(x)
+
+
+def i0e(x):
+    return jsp.i0e(x)
+
+
+def i1(x):
+    return jsp.i1(x)
+
+
+def i1e(x):
+    return jsp.i1e(x)
+
+
+def digitize(x, bins, right=False):
+    return jnp.digitize(x, bins, right=right)
+
+
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    if isinstance(num_or_indices, int):
+        return jnp.array_split(x, num_or_indices, axis=axis)
+    return jnp.split(x, list(num_or_indices), axis=axis)
+
+
+def hsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def atleast_1d(*xs):
+    return jnp.atleast_1d(*xs)
+
+
+def atleast_2d(*xs):
+    return jnp.atleast_2d(*xs)
+
+
+def atleast_3d(*xs):
+    return jnp.atleast_3d(*xs)
+
+
+def block_diag(xs):
+    return jsl.block_diag(*xs)
+
+
+def float_power(x, y):
+    return jnp.float_power(x, y)
+
+
+def addcmul(x, tensor1, tensor2, value=1.0):
+    return x + value * tensor1 * tensor2
+
+
+def addcdiv(x, tensor1, tensor2, value=1.0):
+    return x + value * tensor1 / tensor2
+
+
+def bitwise_left_shift(x, y):
+    return jnp.left_shift(x, y)
+
+
+def bitwise_right_shift(x, y):
+    return jnp.right_shift(x, y)
+
+
+def is_complex(x):
+    return bool(jnp.issubdtype(jnp.result_type(x), jnp.complexfloating))
+
+
+def is_floating_point(x):
+    return bool(jnp.issubdtype(jnp.result_type(x), jnp.floating))
+
+
+def rank(x):
+    return jnp.ndim(x)

@@ -271,3 +271,39 @@ def test_nan_quantile_median():
     check_forward("nanmedian", lambda v: np.nanmedian(v), x)
     check_forward("nanquantile", lambda v, q: np.nanquantile(v, q),
                   x, 0.25, rtol=1e-5, atol=1e-6)
+
+
+def test_tensor_api_tail():
+    import paddle_tpu as pt
+    W = pt.dispatch.wrap_op
+
+    x = _f32(3, 4)
+    np.testing.assert_allclose(
+        np.asarray(W("take")(pt.to_tensor(x),
+                             pt.to_tensor(np.array([0, 5, 11]))).value),
+        x.ravel()[[0, 5, 11]])
+    p = np.clip(np.abs(_f32(5)), 0.05, 0.95)
+    check_forward("logit", lambda v: np.log(v / (1 - v)), p,
+                  rtol=1e-5, atol=1e-6)
+    from scipy import special as sp
+    check_forward("i0", sp.i0, _f32(4), rtol=1e-4, atol=1e-5)
+    check_forward("i1", sp.i1, _f32(4), rtol=1e-4, atol=1e-5)
+    bins = np.array([0.0, 1.0, 2.0], np.float32)
+    check_forward("digitize", np.digitize, _f32(6) + 1.0, bins)
+    a, b = _f32(2, 3, 4), _f32(4, 3, 5)
+    check_forward("tensordot",
+                  lambda u, v, axes: np.tensordot(u, v, axes=axes),
+                  a, b, axes=[[2, 1], [0, 1]], rtol=1e-4, atol=1e-5)
+    parts = W("tensor_split")(pt.to_tensor(_f32(7, 2)), 3)
+    assert [np.asarray(q.value).shape[0] for q in parts] == [3, 2, 2]
+    bd = W("block_diag")([pt.to_tensor(_f32(2, 2)),
+                          pt.to_tensor(_f32(3, 1))])
+    assert np.asarray(bd.value).shape == (5, 3)
+    check_forward("addcmul", lambda v, t1, t2, value: v + value * t1 * t2,
+                  _f32(3), _f32(3), _f32(3), value=0.5)
+    check_forward("bitwise_left_shift", np.left_shift,
+                  np.array([1, 2, 4], np.int32), np.array([1, 2, 3],
+                                                          np.int32))
+    assert W("is_floating_point")(pt.to_tensor(x))
+    assert not W("is_complex")(pt.to_tensor(x))
+    assert W("rank")(pt.to_tensor(x)) == 2
