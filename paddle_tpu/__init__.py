@@ -23,11 +23,14 @@ from .autograd.engine import enable_grad, grad, is_grad_enabled, no_grad
 from . import dispatch as _dispatch
 
 # Publish every wrapped op at top level (paddle.add, paddle.reshape, ...).
+# Names that are namespace MODULES in the reference (paddle.fft is the
+# module; the transform lives at paddle.fft.fft) stay unpublished.
+_module_names = {"fft", "linalg"}
 _mod = _sys.modules[__name__]
 for _name, _fn in _dispatch.wrapped_ops.items():
-    if not hasattr(_mod, _name):
+    if _name not in _module_names and not hasattr(_mod, _name):
         setattr(_mod, _name, _fn)
-del _mod, _name, _fn
+del _mod, _name, _fn, _module_names
 
 # Creation aliases matching the public reference API
 rand = _dispatch.wrapped_ops["rand"]
@@ -44,7 +47,7 @@ def __getattr__(name):
                 "distributed", "metric", "vision", "models", "hapi",
                 "framework", "inference", "autograd", "ops", "profiler",
                 "quantization", "sparsity", "text", "native", "distribution",
-                "utils"):
+                "utils", "fft", "linalg"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
@@ -54,7 +57,7 @@ def __dir__():
         "nn", "optimizer", "amp", "io", "static", "jit", "distributed",
         "metric", "vision", "models", "hapi", "framework", "inference",
         "autograd", "ops", "quantization", "sparsity", "text", "native",
-        "distribution", "utils"})
+        "distribution", "utils", "fft", "linalg"})
 
 
 def Model(*args, **kwargs):

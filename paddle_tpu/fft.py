@@ -1,0 +1,19 @@
+"""paddle_tpu.fft — spectral transforms namespace.
+
+Reference parity: python/paddle/fft.py (paddle.fft.*). Autograd-aware
+wrapped versions of ops/fft.py kernels: eager calls record on the tape,
+jitted callers get the raw kernels via paddle_tpu.ops.fft.
+"""
+
+from . import dispatch as _dispatch
+from .ops import fft as _kernels
+
+_NAMES = [n for n in dir(_kernels) if not n.startswith("_")
+          and callable(getattr(_kernels, n))
+          and getattr(_kernels, n).__module__ == _kernels.__name__]
+
+for _n in _NAMES:
+    globals()[_n] = _dispatch.wrap_op(_n)
+
+__all__ = sorted(_NAMES)
+del _n

@@ -85,3 +85,24 @@ def test_api_surface_frozen(module):
     assert not missing, (f"{module} lost public API: {missing} — "
                         "update the freeze list ONLY for deliberate "
                         "breaking changes")
+
+
+def test_namespace_modules():
+    """paddle.fft / paddle.linalg are MODULES (reference layout), with
+    the transforms inside them, autograd-aware."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import fft, linalg
+
+    for n in ("fft", "ifft", "rfft", "irfft", "fft2", "fftshift",
+              "fftfreq"):
+        assert hasattr(fft, n), n
+    for n in ("svd", "qr", "cholesky", "eigh", "det", "slogdet", "pinv",
+              "matrix_power", "lu", "lu_unpack", "cdist"):
+        assert hasattr(linalg, n), n
+    # autograd flows through the namespace wrappers
+    x = pt.to_tensor(np.ones(8, np.float32))
+    x.stop_gradient = False
+    y = fft.fft(x)
+    (y.real() ** 2).sum().backward() if hasattr(y, "real") else None
