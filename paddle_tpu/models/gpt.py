@@ -73,6 +73,11 @@ class GPTConfig:
     # jax.checkpoint), so with moe_experts>0 only the dense blocks
     # drop out of the activation footprint.
     remat: bool = False
+    # With remat on, rematerialize only blocks where
+    # layer_idx % remat_every == 0: trades activation memory back for
+    # fewer recomputed FLOPs when HBM has headroom (selective
+    # checkpointing; remat_every=1 = every block).
+    remat_every: int = 1
 
     @property
     def head_dim(self):
@@ -305,7 +310,8 @@ class GPTModel(Layer):
             if use_cache:
                 x, nc = block(x, caches[i], use_cache=True)
                 new_caches.append(nc)
-            elif self.config.remat and not hasattr(block.mlp, "aux_loss"):
+            elif self.config.remat and not hasattr(block.mlp, "aux_loss") \
+                    and i % max(1, self.config.remat_every) == 0:
                 x = _remat_block(block, x)
             else:
                 x = block(x)
