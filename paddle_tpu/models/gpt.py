@@ -79,6 +79,12 @@ class GPTConfig:
     # checkpointing; remat_every=1 = every block).
     remat_every: int = 1
 
+    def __post_init__(self):
+        if self.remat and self.remat_every < 1:
+            raise ValueError(
+                "remat_every must be >= 1 (1 = remat every block); to "
+                "disable rematerialization set remat=False")
+
     @property
     def head_dim(self):
         return self.hidden_size // self.num_heads
@@ -311,7 +317,7 @@ class GPTModel(Layer):
                 x, nc = block(x, caches[i], use_cache=True)
                 new_caches.append(nc)
             elif self.config.remat and not hasattr(block.mlp, "aux_loss") \
-                    and i % max(1, self.config.remat_every) == 0:
+                    and i % self.config.remat_every == 0:
                 x = _remat_block(block, x)
             else:
                 x = block(x)
