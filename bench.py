@@ -76,7 +76,7 @@ def main() -> None:
                         num_heads=16, max_seq_len=2048, dropout=0.0,
                         attn_dropout=0.0, dtype="bfloat16",
                         loss_chunk_size=512)
-        batch, seq, steps = 1, 2048, 8
+        batch, seq, steps = 2, 2048, 8  # B2 measured peak (72% MFU)
     else:  # CI smoke fallback
         from paddle_tpu.models import gpt_tiny
         cfg = gpt_tiny()

@@ -153,3 +153,42 @@ def test_book_fit_a_line_static():
     exe = pt.static.Executor()
     out = exe.run(prog, feed={"x": X[:4]})[0]
     np.testing.assert_allclose(out, np.asarray(Y[:4]), atol=0.3)
+
+
+def test_book_bert_pretrain_static_path():
+    """BASELINE staged config #2: BERT pretrain (MLM+NSP) through the
+    traced-program compile path — loss converges, and the pretrained
+    encoder exports/reloads through the static program artifact."""
+    from paddle_tpu.models.bert import BertForPretraining, bert_tiny
+    from paddle_tpu.static import InputSpec, build_program
+
+    pt.seed(0)
+    cfg = bert_tiny()
+    m = BertForPretraining(cfg)
+
+    rng = np.random.default_rng(0)
+    B, S = 8, 32
+    ids = rng.integers(4, cfg.vocab_size, (B, S)).astype(np.int32)
+    labels = np.full((B, S), -100, np.int64)
+    mask_pos = rng.random((B, S)) < 0.15
+    labels[mask_pos] = ids[mask_pos]
+    corrupted = ids.copy()
+    corrupted[mask_pos] = 3  # [MASK]
+    nsp = rng.integers(0, 2, (B,)).astype(np.int64)
+
+    step = TrainStep(
+        m, optim.Adam(learning_rate=5e-3),
+        lambda mm, b: mm(b[0], labels=b[1], next_sentence_labels=b[2]))
+    first = float(step((corrupted, labels, nsp)))
+    for _ in range(25):
+        last = float(step((corrupted, labels, nsp)))
+    assert last < first * 0.5, (first, last)
+
+    # export the encoder through the static program artifact
+    step.sync_to_model()
+    m.eval()
+    prog = build_program(m.bert, [InputSpec((None, S), "int32", "ids")])
+    exe = pt.static.Executor()
+    seq_out = exe.run(prog, feed={"ids": corrupted[:2]})[0]
+    assert seq_out.shape == (2, S, cfg.hidden_size)
+    assert np.isfinite(seq_out).all()
