@@ -49,6 +49,10 @@ def __getattr__(name):
                 "quantization", "sparsity", "text", "native", "distribution",
                 "utils", "fft", "linalg"):
         return importlib.import_module(f".{name}", __name__)
+    if name == "ParamAttr":  # lazy: avoids eager-importing all of nn
+        from .nn.initializer import ParamAttr as _PA
+        globals()["ParamAttr"] = _PA
+        return _PA
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 
@@ -95,3 +99,77 @@ def save(obj, path, **kwargs):
 def load(path, **kwargs):
     from .framework.io import load as _load
     return _load(path, **kwargs)
+
+
+# -- reference-parity surface tail (paddle.* __all__ names) -------------------
+
+from .core.dtype import (complex128, get_default_dtype,  # noqa: E402
+                         convert_dtype as _convert_dtype)
+from .core.place import CUDAPinnedPlace, NPUPlace, XPUPlace  # noqa: E402
+from .framework.mode import (batch, check_shape, disable_static,  # noqa: E402
+                             enable_static, in_dygraph_mode,
+                             in_dynamic_mode, set_printoptions)
+
+import numpy as _np  # noqa: E402
+
+# paddle.dtype: Tensor.dtype objects are numpy dtype instances, so the
+# reference's ``isinstance(x.dtype, paddle.dtype)`` idiom holds.
+dtype = _np.dtype
+setattr(_sys.modules[__name__], "bool", bool_)  # paddle.bool
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference: paddle.create_parameter (fluid/layers/tensor.py) — a free
+    Parameter outside any Layer."""
+    from .core.dtype import convert_dtype
+    from .nn.initializer import resolve_initializer
+    dt = convert_dtype(dtype or get_default_dtype())
+    init = resolve_initializer(default_initializer, attr, is_bias)
+    p = Parameter(init(tuple(shape), dt),
+                  name=name or (getattr(attr, "name", None)
+                                if attr is not None else None))
+    if attr is not None and getattr(attr, "trainable", True) is False:
+        p.trainable = False
+        p.stop_gradient = True
+    return p
+
+
+def is_tensor(x) -> bool:
+    """reference: paddle.is_tensor."""
+    return isinstance(x, Tensor)
+
+
+def tolist(x):
+    """reference: paddle.tolist."""
+    return x.tolist() if isinstance(x, Tensor) else _np.asarray(x).tolist()
+
+
+def get_cuda_rng_state():
+    """reference: paddle.get_cuda_rng_state — here the accelerator RNG
+    state is the default generator's jax PRNG key."""
+    from .core.rng import default_generator
+    return [default_generator().get_state()]
+
+
+def set_cuda_rng_state(state_list):
+    """reference: paddle.set_cuda_rng_state."""
+    from .core.rng import default_generator
+    if state_list:
+        default_generator().set_state(state_list[0])
+
+
+def _inplace_top(name):
+    def f(x, *args, **kwargs):
+        return getattr(x, name)(*args, **kwargs)
+    f.__name__ = name
+    f.__doc__ = f"In-place variant (reference: paddle.{name})."
+    return f
+
+
+reshape_ = _inplace_top("reshape_")
+squeeze_ = _inplace_top("squeeze_")
+unsqueeze_ = _inplace_top("unsqueeze_")
+scatter_ = _inplace_top("scatter_")
+tanh_ = _inplace_top("tanh_")
+del _inplace_top

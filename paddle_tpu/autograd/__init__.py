@@ -2,3 +2,4 @@
 
 from .engine import (GradNode, backward, enable_grad, grad, is_grad_enabled,
                      no_grad, set_grad_enabled)
+from .py_layer import PyLayer, PyLayerContext

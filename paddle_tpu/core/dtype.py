@@ -29,6 +29,7 @@ uint16 = jnp.uint16
 uint32 = jnp.uint32
 bool_ = jnp.bool_
 complex64 = jnp.complex64
+complex128 = jnp.complex128
 
 _ALIASES = {
     "bf16": "bfloat16",
@@ -79,6 +80,11 @@ def convert_dtype(dtype: DTypeLike):
 
 def default_dtype():
     return jnp.dtype(convert_dtype(get_flag("default_dtype")))
+
+
+def get_default_dtype() -> str:
+    """reference: paddle.get_default_dtype (fluid/framework.py)."""
+    return default_dtype().name
 
 
 def set_default_dtype(dtype: DTypeLike) -> None:

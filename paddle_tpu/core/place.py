@@ -57,6 +57,28 @@ class GPUPlace(Place):
 CUDAPlace = GPUPlace
 
 
+class CUDAPinnedPlace(Place):
+    """reference: platform/place.h CUDAPinnedPlace — page-locked host
+    staging memory. On TPU, host staging is managed by PJRT; this place is
+    accepted by the API surface and maps to host memory."""
+    platform = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class NPUPlace(Place):
+    """reference: platform/place.h NPUPlace (Ascend). Accepted for API
+    parity; resolves to the default accelerator platform if present."""
+    platform = "tpu"
+
+
+class XPUPlace(Place):
+    """reference: platform/place.h XPUPlace (Kunlun). Accepted for API
+    parity; resolves to the default accelerator platform if present."""
+    platform = "tpu"
+
+
 @functools.lru_cache(maxsize=None)
 def _default_place() -> Place:
     plat = jax.default_backend()

@@ -1,0 +1,93 @@
+"""Execution-mode switch + small framework-level utilities.
+
+Reference parity: paddle.enable_static/disable_static/in_dynamic_mode
+(python/paddle/fluid/framework.py _dygraph_tracer switch), paddle.batch
+(python/paddle/batch.py), check_shape (fluid/layers/utils.py:364),
+set_printoptions (tensor/to_string.py).
+
+TPU-native stance: there is no op-by-op static interpreter — "static mode"
+means building Programs by tracing (paddle_tpu.static.build_program /
+program_guard). The mode flag exists so reference code that branches on
+``in_dynamic_mode()`` behaves, and ``enable_static`` makes
+``paddle.static.default_main_program`` the capture target.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_dynamic_mode = True
+
+
+def enable_static() -> None:
+    global _dynamic_mode
+    _dynamic_mode = False
+
+
+def disable_static() -> None:
+    global _dynamic_mode
+    _dynamic_mode = True
+
+
+def in_dynamic_mode() -> bool:
+    return _dynamic_mode
+
+
+# Alias used throughout fluid-era reference code.
+def in_dygraph_mode() -> bool:
+    return _dynamic_mode
+
+
+def batch(reader, batch_size, drop_last: bool = False):
+    """Wrap a sample reader into a mini-batch reader
+    (reference: paddle.batch, python/paddle/batch.py:18)."""
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive")
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def check_shape(shape) -> None:
+    """Validate a shape argument (reference: fluid/layers/utils.py:364)."""
+    from ..tensor import Tensor
+    if isinstance(shape, Tensor):
+        if shape.dtype not in (np.int32, np.int64):
+            raise TypeError(
+                f"shape tensor must be int32/int64, got {shape.dtype}")
+        return
+    if not isinstance(shape, (list, tuple)):
+        raise TypeError(f"shape must be a list/tuple/Tensor, got "
+                        f"{type(shape).__name__}")
+    for s in shape:
+        if not isinstance(s, (int, np.integer)) and not hasattr(s, "dtype"):
+            raise TypeError(f"shape elements must be ints, got "
+                            f"{type(s).__name__}")
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None) -> None:
+    """Tensor print formatting (reference: paddle.set_printoptions,
+    tensor/to_string.py). Tensor repr renders via numpy, so this delegates
+    to numpy's print options."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)

@@ -3,6 +3,7 @@
 
 from .collate import default_collate_fn
 from .dataloader import DataLoader
+from .worker_info import WorkerInfo, get_worker_info
 from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
                       IterableDataset, Subset, TensorDataset, random_split)
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,

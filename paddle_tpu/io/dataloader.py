@@ -42,6 +42,22 @@ def _to_device(batch, place=None):
     return jax.tree_util.tree_map(convert, batch)
 
 
+def _worker_initializer(counter, num_workers, dataset, worker_init_fn):
+    """Pool initializer: record this worker's identity for
+    io.get_worker_info(). ``counter`` is a per-DataLoader
+    multiprocessing.Value, so ids are unique within one loader for both
+    thread- and process-pool workers (mp.Value is inherited through
+    ProcessPoolExecutor initargs; with threads it's just a locked int)."""
+    from .worker_info import WorkerInfo, _set_worker_info
+    with counter.get_lock():
+        wid = counter.value
+        counter.value += 1
+    info = WorkerInfo(wid, num_workers, dataset)
+    _set_worker_info(info)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+
+
 class _Fetcher:
     """Picklable index->batch function for pool workers."""
 
@@ -69,6 +85,7 @@ class DataLoader:
         self.prefetch_factor = max(1, int(prefetch_factor))
         self.use_buffer_reader = use_buffer_reader
         self.places = places
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self.use_process_workers = use_process_workers
         if self._iterable_mode:
@@ -108,7 +125,13 @@ class DataLoader:
         pool_cls = ProcessPoolExecutor if self.use_process_workers else \
             ThreadPoolExecutor
         inflight = self.num_workers * self.prefetch_factor
-        with pool_cls(max_workers=self.num_workers) as pool:
+        import multiprocessing as mp
+        init_args = {
+            "initializer": _worker_initializer,
+            "initargs": (mp.Value("i", 0), self.num_workers, self.dataset,
+                         self.worker_init_fn),
+        }
+        with pool_cls(max_workers=self.num_workers, **init_args) as pool:
             pending = queue.Queue()
             it = iter(self.batch_sampler)
 

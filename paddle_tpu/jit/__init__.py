@@ -274,3 +274,87 @@ def load(path: str):
 
 from .dy2static import (ProgramTranslator, convert_to_static,  # noqa: E402
                         enable_to_static)
+
+
+def not_to_static(fn=None):
+    """Mark a function to be skipped by to_static conversion
+    (reference: paddle.jit.not_to_static)."""
+    def deco(f):
+        f.__pt_not_to_static__ = True
+        return f
+    return deco(fn) if fn is not None else deco
+
+
+_code_level = 0
+_verbosity = 0
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False) -> None:
+    """reference: paddle.jit.set_code_level — controls dumping of the
+    transformed code (here: the dy2static-rewritten AST source)."""
+    global _code_level
+    _code_level = int(level)
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False) -> None:
+    """reference: paddle.jit.set_verbosity."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+class TracedLayer:
+    """reference: paddle.jit.TracedLayer (fluid/dygraph/jit.py) — a
+    layer captured by running it once on example inputs. Here the trace
+    is a static Program; ``trace`` returns (eager_outputs, traced)."""
+
+    def __init__(self, program, layer):
+        self._program = program
+        self._layer = layer
+
+    @staticmethod
+    def trace(layer, inputs):
+        from ..static import InputSpec, build_program
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = layer(*ins)
+        specs = [InputSpec.from_tensor(i) for i in ins]
+        program = build_program(layer, specs)
+        return outs, TracedLayer(program, layer)
+
+    def __call__(self, inputs):
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return self._program.run(*ins)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        self._program.save(path)
+
+
+class TranslatedLayer:
+    """reference: paddle.jit.TranslatedLayer (fluid/dygraph/io.py:1082) —
+    a Layer reconstructed from a saved program artifact; forward runs the
+    loaded StableHLO computation."""
+
+    def __init__(self, loaded_program):
+        self._loaded = loaded_program
+        self.training = False
+
+    @classmethod
+    def from_path(cls, path_prefix: str):
+        from ..static import load_inference_model
+        return cls(load_inference_model(path_prefix))
+
+    def __call__(self, *inputs):
+        return self.forward(*inputs)
+
+    def forward(self, *inputs):
+        return self._loaded.run(*inputs)
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "TranslatedLayer wraps a frozen inference artifact; retraining "
+            "requires the original Layer (reference TranslatedLayer "
+            "supports train mode only for programs saved with dropout "
+            "etc. intact)")

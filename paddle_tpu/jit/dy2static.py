@@ -538,6 +538,9 @@ def convert_to_static(fn, *, raise_on_error: bool = False):
     plain tracer still handles tensor-independent control flow)."""
     if getattr(fn, "__pt_converted__", False) or not callable(fn):
         return fn
+    if getattr(fn, "__pt_not_to_static__", False):
+        # user opt-out (paddle.jit.not_to_static)
+        return fn
     try:
         return _convert(fn)
     except Exception:

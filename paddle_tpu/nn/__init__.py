@@ -13,7 +13,8 @@ from .activation import (CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid,
                          Softsign, Swish, Tanh, Tanhshrink,
                          ThresholdedReLU)
 from .common import (AlphaDropout, Bilinear, CosineSimilarity, Dropout,
-                     Dropout2D, Embedding, Flatten, Identity, Linear, Pad1D,
+                     Dropout2D, Dropout3D, Embedding, Flatten, Identity,
+                     Linear, Pad1D, PairwiseDistance,
                      Pad2D, Pad3D, PixelShuffle, Upsample,
                      UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
                      Unfold, Fold)
@@ -31,12 +32,19 @@ from .loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
 from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                    DataNorm,
                    GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
-                   LayerNorm, LocalResponseNorm, RMSNorm, SyncBatchNorm)
+                   LayerNorm, LocalResponseNorm, RMSNorm, SpectralNorm,
+                   SyncBatchNorm)
 from .pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
-                      AdaptiveMaxPool1D, AdaptiveMaxPool2D, AvgPool1D,
+                      AdaptiveMaxPool1D, AdaptiveMaxPool2D,
+                      AdaptiveMaxPool3D, AvgPool1D,
                       AvgPool2D, AvgPool3D, LPPool2D, MaxPool1D,
                       MaxPool2D, MaxPool3D)
-from .rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell)
+from .rnn import (BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase,
+                  SimpleRNN, SimpleRNNCell)
+from .decode import BeamSearchDecoder, dynamic_decode
+# grad-clip classes are exported from paddle.nn in the reference too
+from ..optimizer.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                              ClipGradByValue)
 from .transformer import (MultiHeadAttention, Transformer,
                           TransformerDecoder, TransformerDecoderLayer,
                           TransformerEncoder, TransformerEncoderLayer)

@@ -86,12 +86,14 @@ class Dropout(Layer):
 
 
 class Dropout2D(Layer):
-    def __init__(self, p: float = 0.5, name=None):
+    def __init__(self, p: float = 0.5, data_format="NCHW", name=None):
         super().__init__()
         self.p = p
+        self.data_format = data_format
 
     def forward(self, x):
-        return F["dropout2d"](x, p=self.p, training=self.training)
+        return F["dropout2d"](x, p=self.p, training=self.training,
+                              data_format=self.data_format)
 
 
 class AlphaDropout(Layer):
@@ -239,3 +241,32 @@ class Fold(Layer):
     def forward(self, x):
         o, k, s, p, d = self._args
         return F["fold"](x, o, k, strides=s, paddings=p, dilations=d)
+
+
+class Dropout3D(Layer):
+    """Channel-wise 3-D dropout (reference: paddle.nn.Dropout3D)."""
+
+    def __init__(self, p: float = 0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F["dropout3d"](x, p=self.p, training=self.training,
+                              data_format=self.data_format)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row pairs (reference:
+    paddle.nn.PairwiseDistance, operators/dist_op)."""
+
+    def __init__(self, p: float = 2.0, epsilon: float = 1e-6,
+                 keepdim: bool = False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        d = F["add"](F["subtract"](x, y), self.epsilon)
+        return F["norm"](d, p=self.p, axis=-1, keepdim=self.keepdim)

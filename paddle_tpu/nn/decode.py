@@ -1,0 +1,158 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference parity: python/paddle/fluid/layers/rnn.py BeamSearchDecoder /
+dynamic_decode (exported as paddle.nn.BeamSearchDecoder,
+paddle.nn.dynamic_decode).
+
+TPU-native design: the reference drives a While loop of beam_search +
+beam_search_decode ops over LoD tensors; here decoding is a dense
+fixed-shape loop over ``ops.decode_extra.beam_search_step`` (top-k over
+MXU-friendly [batch*beam, vocab] logits) with the backtrace done by
+``gather_tree`` — the whole decode can sit inside one jit when shapes are
+static.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dispatch
+from ..ops.decode_extra import beam_search_step, gather_tree
+from ..tensor import Tensor
+from .layer import Layer
+
+F = dispatch.wrapped_ops
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    """Beam-search decoder over a recurrent cell (reference:
+    fluid/layers/rnn.py BeamSearchDecoder).
+
+    cell: an RNN cell ``(inputs, states) -> (output, new_states)``.
+    output_fn: maps cell output -> logits over the vocabulary (e.g. the
+    projection layer); defaults to identity.
+    embedding_fn: maps token ids -> cell inputs; required unless the cell
+    consumes raw ids.
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn: Optional[Callable] = None,
+                 output_fn: Optional[Callable] = None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers --------------------------------------------------------------
+
+    def _merge(self, t):
+        v = t.value if isinstance(t, Tensor) else jnp.asarray(t)
+        return v.reshape((-1,) + v.shape[2:])  # [B, beam, ...] -> [B*beam]
+
+    def _split(self, v, batch):
+        v = v.value if isinstance(v, Tensor) else jnp.asarray(v)
+        return v.reshape((batch, self.beam_size) + v.shape[1:])
+
+    def _logits(self, cell_out):
+        out = self.output_fn(cell_out) if self.output_fn else cell_out
+        return out.value if isinstance(out, Tensor) else jnp.asarray(out)
+
+    def decode(self, initial_states, max_step_num: int):
+        """Run the full beam search; returns (ids [B, T], scores [B])."""
+        import jax
+        # infer batch from the states pytree
+        leaves = jax.tree_util.tree_leaves(
+            initial_states, is_leaf=lambda t: isinstance(t, Tensor))
+        batch = (leaves[0].shape[0] if leaves else 1)
+
+        def tile_state(t):
+            v = t.value if isinstance(t, Tensor) else jnp.asarray(t)
+            v = jnp.repeat(v[:, None], self.beam_size, axis=1)
+            return Tensor(v.reshape((-1,) + v.shape[2:]))
+
+        states = jax.tree_util.tree_map(
+            tile_state, initial_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+        tokens = jnp.full((batch, self.beam_size), self.start_token,
+                          jnp.int32)
+        # first expansion starts from one live beam per batch row
+        scores = jnp.where(
+            jnp.arange(self.beam_size)[None, :] == 0, 0.0, -jnp.inf
+        ) * jnp.ones((batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        ids_steps, parent_steps = [], []
+
+        for _ in range(max_step_num):
+            flat_tok = Tensor(tokens.reshape(-1))
+            inp = self.embedding_fn(flat_tok) if self.embedding_fn \
+                else flat_tok
+            cell_out, states = self.cell(inp, states)
+            logits = self._logits(cell_out)            # [B*beam, V]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            logp = logp.reshape(batch, self.beam_size, -1)
+            scores, parent, tok = beam_search_step(
+                logp, scores, self.beam_size, end_token=self.end_token,
+                finished=finished)
+            # reorder states along the chosen parents
+            flat_parent = (parent +
+                           jnp.arange(batch)[:, None] * self.beam_size
+                           ).reshape(-1)
+            states = jax.tree_util.tree_map(
+                lambda t: Tensor(jnp.take(
+                    t.value if isinstance(t, Tensor) else jnp.asarray(t),
+                    flat_parent, axis=0)),
+                states, is_leaf=lambda t: isinstance(t, Tensor))
+            finished = jnp.take_along_axis(finished, parent, axis=1) | (
+                tok == self.end_token)
+            tokens = tok
+            ids_steps.append(tok)
+            parent_steps.append(parent)
+            from jax._src import core as _jc
+            if _jc.trace_state_clean() and bool(jnp.all(finished)):
+                break  # eager early exit; under jit the loop is static
+
+        ids = jnp.stack(ids_steps)                     # [T, B, beam]
+        parents = jnp.stack(parent_steps)
+        full = gather_tree(ids, parents)               # [T, B, beam]
+        best = jnp.argmax(scores, axis=1)              # [B]
+        seq = jnp.take_along_axis(
+            full, best[None, :, None], axis=2)[:, :, 0]
+        return Tensor(seq.swapaxes(0, 1)), Tensor(
+            jnp.max(scores, axis=1))
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """reference helper: repeat batch entries beam_size times."""
+        v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        v = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor(v.reshape((-1,) + v.shape[2:]))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num: int = 100,
+                   output_time_major: bool = False, impute_finished=False,
+                   is_test: bool = False, return_length: bool = False,
+                   **kwargs):
+    """Drive a decoder to completion (reference: fluid/layers/rnn.py
+    dynamic_decode). Returns (ids, scores) — and lengths when
+    ``return_length``."""
+    ids, scores = decoder.decode(inits, max_step_num)
+    lengths = None
+    if return_length:
+        v = ids.value  # [B, T] batch-major here, before any transpose
+        lengths = jnp.argmax(
+            jnp.concatenate(
+                [(v == decoder.end_token),
+                 jnp.ones_like(v[:, :1], bool)], axis=1), axis=1)
+    if output_time_major:
+        ids = F["transpose"](ids, [1, 0])
+    if return_length:
+        return ids, scores, Tensor(lengths.astype(jnp.int32))
+    return ids, scores

@@ -33,7 +33,7 @@ _NN_OPS = [
     "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
     "avg_pool3d", "lp_pool2d", "adaptive_avg_pool1d",
     "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
-    "adaptive_max_pool2d",
+    "adaptive_max_pool2d", "adaptive_max_pool3d",
     # norm
     "layer_norm", "rms_norm", "batch_norm", "instance_norm", "group_norm",
     "local_response_norm",
@@ -53,9 +53,28 @@ _NN_OPS = [
     "squared_l2_distance", "squared_l2_norm", "l1_norm", "cos_sim",
     "dice_loss", "npair_loss", "center_loss", "ctc_loss", "nce",
     "hsigmoid_loss", "sample_logits", "bce_loss", "kldiv_loss",
+    # decode / misc
+    "gather_tree", "diag_embed",
 ]
 
 for _name in _NN_OPS:
     globals()[_name] = _dispatch.wrapped_ops[_name]
 
 del _name
+
+
+def _inplace(name):
+    def f(x, *args, **kwargs):
+        out = _dispatch.wrapped_ops[name](x, *args, **kwargs)
+        return x._inplace_assign(out) if hasattr(x, "_inplace_assign") \
+            else out
+    f.__name__ = name + "_"
+    f.__doc__ = f"In-place variant of {name} (reference: F.{name}_)."
+    return f
+
+
+relu_ = _inplace("relu")
+elu_ = _inplace("elu")
+tanh_ = _inplace("tanh")
+softmax_ = _inplace("softmax")
+del _inplace

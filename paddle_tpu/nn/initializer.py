@@ -208,3 +208,66 @@ class ParamAttr:
         self.trainable = trainable
         self.do_model_average = do_model_average
         self.need_clip = need_clip
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling initializer for transposed-conv weights
+    (reference: paddle.nn.initializer.Bilinear,
+    fluid/initializer.py BilinearInitializer)."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        if len(shape) != 4:
+            from ..core.enforce import InvalidArgumentError
+            raise InvalidArgumentError(
+                "Bilinear initializer needs a 4-D weight [c_out, c_in, "
+                f"kh, kw], got {shape}")
+        c_out, c_in, kh, kw = shape
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        cw = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        yy = np.arange(kh)[:, None]
+        xx = np.arange(kw)[None, :]
+        filt = ((1 - np.abs(yy / f_h - ch)) *
+                (1 - np.abs(xx / f_w - cw))).astype("float32")
+        # every [c_out, c_in] plane gets the filter (reference
+        # BilinearInitializer tiles the interpolation kernel across all
+        # channel pairs)
+        w = np.broadcast_to(filt, shape).copy().astype("float32")
+        import jax.numpy as jnp
+        return jnp.asarray(w, dtype=dtype)
+
+
+_REGISTRY["bilinear"] = Bilinear
+
+_global_initializer = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None) -> None:
+    """reference: paddle.nn.initializer.set_global_initializer
+    (fluid/initializer.py:964) — overrides the default initializer used
+    when a layer creates parameters without an explicit one. Pass None to
+    restore the built-in defaults."""
+    _global_initializer["weight"] = (
+        get_initializer(weight_init) if weight_init is not None else None)
+    _global_initializer["bias"] = (
+        get_initializer(bias_init) if bias_init is not None else None)
+
+
+def global_initializer(is_bias: bool):
+    return _global_initializer["bias" if is_bias else "weight"]
+
+
+def resolve_initializer(init, attr=None, is_bias: bool = False):
+    """One resolution chain for parameter initializers, shared by
+    Layer.create_parameter and the free paddle.create_parameter:
+    explicit attr.initializer > explicit init > global override >
+    built-in default (xavier_uniform / zeros)."""
+    if attr is not None and getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    if init is None:
+        return global_initializer(is_bias) or get_initializer(
+            "zeros" if is_bias else "xavier_uniform")
+    if isinstance(init, Initializer) or callable(init):
+        return init
+    return get_initializer(init)

@@ -54,14 +54,9 @@ class Layer:
 
     def create_parameter(self, shape, dtype=None, default_initializer=None,
                          is_bias: bool = False, attr=None) -> Parameter:
+        from .initializer import resolve_initializer
         dtype = convert_dtype(dtype or self._dtype)
-        init = default_initializer
-        if attr is not None and getattr(attr, "initializer", None) is not None:
-            init = attr.initializer
-        if init is None:
-            init = get_initializer("zeros" if is_bias else "xavier_uniform")
-        elif not isinstance(init, Initializer) and not callable(init):
-            init = get_initializer(init)
+        init = resolve_initializer(default_initializer, attr, is_bias)
         value = init(tuple(shape), dtype)
         name = getattr(attr, "name", None) if attr is not None else None
         p = Parameter(value, name=name)

@@ -247,3 +247,49 @@ class DataNorm(Layer):
                 self.batch_square_sum.value * d +
                 ((xv - mean) ** 2).sum(0))
         return out
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of an input weight tensor (reference:
+    paddle.nn.SpectralNorm, operators/spectral_norm_op.cc): maintains the
+    power-iteration vectors u/v as buffers and returns W / sigma(W)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = int(weight_shape[dim])
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= int(s)
+        import numpy as _np
+        from ..core.rng import next_key
+        import jax
+        ku, kv = jax.random.split(next_key())
+        u = jax.random.normal(ku, (h,), self._dtype)
+        v = jax.random.normal(kv, (w,), self._dtype)
+        self.register_buffer("weight_u", Tensor(
+            u / (jnp.linalg.norm(u) + eps), stop_gradient=True))
+        self.register_buffer("weight_v", Tensor(
+            v / (jnp.linalg.norm(v) + eps), stop_gradient=True))
+
+    def forward(self, weight):
+        wv = weight.value if isinstance(weight, Tensor) else jnp.asarray(
+            weight)
+        wm = jnp.moveaxis(wv, self.dim, 0).reshape(wv.shape[self.dim], -1)
+        u = self.weight_u.value
+        v = self.weight_v.value
+        for _ in range(max(1, self.power_iters)):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        from jax._src import core as _jc
+        if _jc.trace_state_clean():  # persist power-iteration state eagerly
+            self.weight_u.set_value(u)
+            self.weight_v.set_value(v)
+        sigma = u @ wm @ v
+        return F["divide"](weight, Tensor(sigma))

@@ -154,3 +154,16 @@ class LPPool2D(Layer):
         p, k, s, pad, cm, fmt = self._args
         return F["lp_pool2d"](x, p, k, stride=s, padding=pad,
                               ceil_mode=cm, data_format=fmt)
+
+
+class AdaptiveMaxPool3D(Layer):
+    """reference: paddle.nn.AdaptiveMaxPool3D."""
+
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F["adaptive_max_pool3d"](x, self.output_size,
+                                        self.return_mask)

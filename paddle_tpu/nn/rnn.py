@@ -172,17 +172,109 @@ class RNN(Layer):
         self.is_reverse = is_reverse
         self.time_major = time_major
 
-    def forward(self, inputs, initial_states=None):
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import jax
         time_axis = 0 if self.time_major else 1
         steps = inputs.shape[time_axis]
         order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        lens = None
+        if sequence_length is not None:
+            lens = sequence_length.value if isinstance(
+                sequence_length, Tensor) else jnp.asarray(sequence_length)
         states = initial_states
         outs = []
         for t in order:
             xt = inputs[:, t] if time_axis == 1 else inputs[t]
-            out, states = self.cell(xt, states)
+            out, new_states = self.cell(xt, states)
+            if lens is not None:
+                # freeze state and zero the output beyond each row's valid
+                # length (reference RNN wrapper masking with
+                # sequence_length): final_states land on step len-1
+                valid = (lens > t)
+
+                def _mask(new, old):
+                    nv = new.value if isinstance(new, Tensor) else new
+                    m = valid.reshape((-1,) + (1,) * (nv.ndim - 1))
+                    if old is None:
+                        return Tensor(jnp.where(m, nv, jnp.zeros_like(nv)))
+                    ov = old.value if isinstance(old, Tensor) else old
+                    return Tensor(jnp.where(m, nv, ov))
+
+                if states is None:
+                    new_states = jax.tree_util.tree_map(
+                        lambda n: _mask(n, None), new_states,
+                        is_leaf=lambda x: isinstance(x, Tensor))
+                else:
+                    new_states = jax.tree_util.tree_map(
+                        _mask, new_states, states,
+                        is_leaf=lambda x: isinstance(x, Tensor))
+                om = valid.reshape((-1,) + (1,) * (out.ndim - 1))
+                out = Tensor(jnp.where(
+                    om, out.value if isinstance(out, Tensor) else out, 0))
+            states = new_states
             outs.append(out)
         if self.is_reverse:
             outs = outs[::-1]
         stacked = F["stack"](outs, axis=time_axis)
         return stacked, states
+
+
+class RNNCellBase(Layer):
+    """Base class for user-defined recurrent cells (reference:
+    paddle.nn.RNNCellBase, nn/layer/rnn.py). Subclasses implement
+    ``forward(inputs, states) -> (output, new_states)``; this base supplies
+    zero-filled initial states from ``state_shape``."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        shape = shape if shape is not None else self.state_shape
+        batch = batch_ref.shape[batch_dim_idx]
+
+        def one(s):
+            full = (batch,) + tuple(int(d) for d in s)
+            return F["full"](full, init_value,
+                             dtype or str(batch_ref.dtype))
+
+        if isinstance(shape, (list, tuple)) and shape and \
+                isinstance(shape[0], (list, tuple)):
+            return type(shape)(one(s) for s in shape)
+        return one(shape)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError(
+            "RNNCellBase subclasses must define state_shape or override "
+            "get_initial_states")
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (reference: paddle.nn.BiRNN):
+    runs cell_fw forward and cell_bw reversed, concatenating outputs on
+    the feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.time_major = time_major
+        # cells are registered once, through the wrapping RNNs (registering
+        # them directly too would duplicate every parameter)
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    @property
+    def cell_fw(self):
+        return self.rnn_fw.cell
+
+    @property
+    def cell_bw(self):
+        return self.rnn_bw.cell
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        fw_init, bw_init = (initial_states if initial_states is not None
+                            else (None, None))
+        # RNN's sequence_length masking freezes states outside each row's
+        # valid window in BOTH directions: the reverse pass walks t from
+        # maxlen-1 down, keeping the initial state until it enters the
+        # valid prefix, so padding never contaminates states or outputs.
+        out_fw, st_fw = self.rnn_fw(inputs, fw_init, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_init, sequence_length)
+        return F["concat"]([out_fw, out_bw], axis=-1), (st_fw, st_bw)

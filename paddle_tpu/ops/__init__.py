@@ -23,6 +23,7 @@ _NON_DIFF_OPS = {
     "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
     "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "isnan",
     "isinf", "isfinite", "shape", "numel", "count_nonzero",
+    "is_empty", "broadcast_shape",
     "nms", "multiclass_nms", "bipartite_match",
     "crf_decoding", "gather_tree", "beam_search_decode", "shuffle_batch",
     "digitize", "bitwise_left_shift", "bitwise_right_shift",

@@ -337,3 +337,23 @@ def crop(x, shape, offsets=None):
     offsets = offsets or [0] * x.ndim
     idx = tuple(_slice(o, o + s) for o, s in zip(offsets, shape))
     return x[idx]
+
+
+def reverse(x, axis):
+    """Reverse x along the given axis/axes (reference: paddle.reverse,
+    fluid/layers/tensor.py:1114)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+def shape(x):
+    """Shape of x as an int32 tensor (reference: paddle.shape,
+    fluid/layers/nn.py:11256 — returns a 1-D tensor, not a list)."""
+    return jnp.asarray(jnp.shape(x), dtype=jnp.int32)
+
+
+def is_empty(x):
+    """True iff x has zero elements (reference: paddle.is_empty,
+    fluid/layers/control_flow.py:3777)."""
+    return jnp.asarray(jnp.size(x) == 0)

@@ -562,3 +562,25 @@ def histogram(x, bins=100, min=0, max=0):  # noqa: A002
         rng = (min, max)
     hist, _ = jnp.histogram(x, bins=bins, range=rng)
     return hist
+
+
+def add_n(inputs):
+    """Sum a list of same-shaped tensors (reference: paddle.add_n,
+    operators/sum_op.cc)."""
+    if not isinstance(inputs, (list, tuple)):
+        return jnp.asarray(inputs)
+    out = jnp.asarray(inputs[0])
+    for t in inputs[1:]:
+        out = out + jnp.asarray(t)
+    return out
+
+
+def floor_mod(x, y):
+    """Alias of mod (reference: paddle.floor_mod == elementwise_mod)."""
+    return jnp.mod(x, y)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Broadcast result shape of two shapes (reference: paddle.broadcast_shape,
+    tensor/math.py:2262). Pure host computation; returns a list."""
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

@@ -214,12 +214,14 @@ def dropout(x, p=0.5, training=True, mode="upscale_in_train", axis=None,
     return jnp.where(keep, x, 0.0).astype(x.dtype)
 
 
-def dropout2d(x, p=0.5, training=True, key=None):
-    return dropout(x, p, training, axis=(0, 1), key=key)
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", key=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, training, axis=axis, key=key)
 
 
-def dropout3d(x, p=0.5, training=True, key=None):
-    return dropout(x, p, training, axis=(0, 1), key=key)
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", key=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, training, axis=axis, key=key)
 
 
 def alpha_dropout(x, p=0.5, training=True, key=None):
@@ -582,6 +584,19 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
     out_size = _norm_tuple(output_size, 3)
     sp_axes = (1, 2, 3) if data_format == "NDHWC" else (2, 3, 4)
     return _adaptive_pool_general(x, out_size, sp_axes, "avg")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False,
+                        data_format="NCDHW"):
+    """Adaptive 3-D max pool (reference: nn/functional/pooling.py
+    adaptive_max_pool3d, operators/pool_op.cc adaptive path)."""
+    out_size = _norm_tuple(output_size, 3)
+    sp_axes = (1, 2, 3) if data_format == "NDHWC" else (2, 3, 4)
+    in_size = tuple(x.shape[a] for a in sp_axes)
+    if all(i % o == 0 for i, o in zip(in_size, out_size)):
+        k = tuple(i // o for i, o in zip(in_size, out_size))
+        return max_pool3d(x, k, k, 0, data_format=data_format)
+    return _adaptive_pool_general(x, out_size, sp_axes, "max")
 
 
 # --------------------------------------------------------------------------

@@ -117,3 +117,9 @@ def binomial(count, prob, key=None):
 def lognormal(mean=1.0, std=2.0, shape=(1,), dtype=None, key=None):
     return jnp.exp(normal(mean, std, shape, dtype, key))
 
+
+
+def standard_normal(shape, dtype=None, key=None):
+    """N(0,1) samples (reference: paddle.standard_normal,
+    tensor/random.py:220)."""
+    return randn(shape, dtype, key)

@@ -212,3 +212,28 @@ def sequence_conv(x, lengths, weight, context_length, context_start=None):
     out = im2col.reshape(b * m, -1) @ weight
     out = out.reshape(b, m, -1)
     return jnp.where(valid[..., None], out, 0)
+
+
+def sequence_reshape(x, lengths, new_dim):
+    """Re-bucket each sequence's features into rows of width ``new_dim``
+    (ref sequence_reshape_op.h: total elements per sequence preserved,
+    len_i * D must divide new_dim). x [batch, maxlen, D] -> out
+    [batch, maxlen*D//new_dim, new_dim], new_lengths = lengths*D//new_dim."""
+    b, m, d = x.shape[0], x.shape[1], x.shape[2]
+    if (m * d) % new_dim != 0:
+        raise ValueError(
+            f"maxlen*dim {m}*{d} not divisible by new_dim {new_dim}")
+    out = jnp.reshape(x, (b, (m * d) // new_dim, new_dim))
+    new_len = (jnp.asarray(lengths) * d) // new_dim
+    return out, new_len.astype(jnp.int32)
+
+
+def sequence_scatter(x, index, updates, lengths):
+    """Per-row scatter-add of a variable-length update sequence
+    (ref sequence_scatter_op.h: out[i][index[i][j]] += updates[i][j] for
+    j < lengths[i]). x [batch, D], index [batch, T] ints,
+    updates [batch, T], lengths [batch]."""
+    mask = _valid_mask(lengths, index.shape[1])
+    upd = jnp.where(mask, updates, 0).astype(x.dtype)
+    idx = jnp.clip(index, 0, x.shape[1] - 1)
+    return jax.vmap(lambda row, ii, uu: row.at[ii].add(uu))(x, idx, upd)

@@ -16,3 +16,14 @@ from .program import (CompiledProgram, Executor, InputSpec, Program,
                       build_program, data, default_main_program,
                       load_inference_model, program_guard,
                       save_inference_model)
+from .api import (BuildStrategy, ExecutionStrategy, ParallelExecutor,  # noqa: E402
+                  Print, Scope, Variable, WeightNormParamAttr, accuracy,
+                  append_backward, auc, cpu_places, create_global_var,
+                  create_parameter, cuda_places, default_startup_program,
+                  deserialize_persistables, deserialize_program,
+                  device_guard, global_scope, gradients, load,
+                  load_from_file, load_program_state, name_scope,
+                  normalize_program, py_func, save, save_to_file,
+                  scope_guard, serialize_persistables, serialize_program,
+                  set_program_state)
+from . import nn  # noqa: E402

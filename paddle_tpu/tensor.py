@@ -187,6 +187,29 @@ class Tensor:
         self.value = self.value - other
         return self
 
+    def _inplace_op(self, name: str, *args, **kwargs) -> "Tensor":
+        from . import dispatch
+        return self._inplace_assign(dispatch.apply(name, self, *args,
+                                                   **kwargs))
+
+    def reshape_(self, shape) -> "Tensor":
+        return self._inplace_op("reshape", shape)
+
+    def squeeze_(self, axis=None) -> "Tensor":
+        return self._inplace_op("squeeze", axis)
+
+    def unsqueeze_(self, axis) -> "Tensor":
+        return self._inplace_op("unsqueeze", axis)
+
+    def scatter_(self, index, updates, overwrite: bool = True) -> "Tensor":
+        return self._inplace_op("scatter", index, updates, overwrite)
+
+    def tanh_(self) -> "Tensor":
+        return self._inplace_op("tanh")
+
+    def tolist(self):
+        return np.asarray(self.value).tolist()
+
     # -- python protocol ------------------------------------------------------
 
     def __len__(self) -> int:

@@ -1,0 +1,453 @@
+"""Reference-parity tail APIs: top-level paddle names, paddle.static
+module surface, static.nn builders, nn layer/functional additions.
+
+Reference: python/paddle/__init__.py __all__, python/paddle/static/
+__init__.py __all__, python/paddle/static/nn/__init__.py __all__.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.static as st
+import paddle_tpu.static.nn as snn
+
+
+# -- top-level names ----------------------------------------------------------
+
+def test_top_level_surface_present():
+    names = ["CUDAPinnedPlace", "NPUPlace", "ParamAttr", "add_n", "batch",
+             "bool", "broadcast_shape", "check_shape", "complex128",
+             "create_parameter", "disable_static", "dtype", "enable_static",
+             "floor_mod", "get_cuda_rng_state", "get_default_dtype",
+             "in_dynamic_mode", "is_empty", "is_tensor", "reshape_",
+             "reverse", "scatter_", "set_cuda_rng_state", "set_printoptions",
+             "shape", "squeeze_", "standard_normal", "tanh_", "tolist",
+             "unsqueeze_"]
+    missing = [n for n in names if not hasattr(pt, n)]
+    assert not missing, missing
+
+
+def test_top_level_semantics():
+    x = pt.to_tensor(np.arange(6.0, dtype="float32").reshape(2, 3))
+    assert pt.shape(x).numpy().tolist() == [2, 3]
+    assert pt.reverse(x, 0).numpy()[0].tolist() == [3.0, 4.0, 5.0]
+    np.testing.assert_allclose(pt.add_n([x, x]).numpy(), 2 * x.numpy())
+    assert pt.broadcast_shape([2, 1, 3], [1, 4, 3]) == [2, 4, 3]
+    assert not bool(pt.is_empty(x).numpy())
+    assert pt.is_tensor(x) and not pt.is_tensor(x.numpy())
+    assert pt.tolist(x)[1] == [3.0, 4.0, 5.0]
+    assert pt.floor_mod(pt.to_tensor(np.array([5])),
+                        pt.to_tensor(np.array([3]))).numpy()[0] == 2
+
+
+def test_inplace_variants_mutate():
+    y = pt.to_tensor(np.arange(6.0, dtype="float32").reshape(2, 3))
+    pt.reshape_(y, [3, 2])
+    assert tuple(y.shape) == (3, 2)
+    pt.unsqueeze_(y, 0)
+    assert tuple(y.shape) == (1, 3, 2)
+    pt.squeeze_(y, 0)
+    assert tuple(y.shape) == (3, 2)
+    t = pt.to_tensor(np.array([-1.0, 1.0], dtype="float32"))
+    pt.tanh_(t)
+    np.testing.assert_allclose(t.numpy(), np.tanh([-1.0, 1.0]), rtol=1e-6)
+
+
+def test_batch_reader_and_mode_switch():
+    b = pt.batch(lambda: iter(range(5)), 2, drop_last=True)
+    assert list(b()) == [[0, 1], [2, 3]]
+    assert pt.in_dynamic_mode()
+    pt.enable_static()
+    assert not pt.in_dynamic_mode()
+    pt.disable_static()
+    assert pt.in_dynamic_mode()
+
+
+def test_create_parameter_and_rng_state():
+    p = pt.create_parameter([3, 4], dtype="float32")
+    assert isinstance(p, pt.Parameter) and tuple(p.shape) == (3, 4)
+    s = pt.get_cuda_rng_state()
+    a = pt.standard_normal([4]).numpy()
+    pt.set_cuda_rng_state(s)
+    b = pt.standard_normal([4]).numpy()
+    np.testing.assert_allclose(a, b)
+
+
+# -- paddle.static surface ----------------------------------------------------
+
+def test_static_scope_and_global_vars():
+    s = st.Scope()
+    with st.scope_guard(s):
+        v = st.create_global_var([2], 3.0, "float32", name="gv")
+        assert st.global_scope().find_var("gv") is v
+        inner = s.new_scope()
+        assert inner.find_var("gv") is v  # parent lookup
+    assert st.global_scope().find_var("gv") is None
+
+
+def test_static_program_serialization_roundtrip(tmp_path):
+    prog = st.build_program(lambda x: x * 2.0 + 1.0,
+                            [st.InputSpec([2, 2], name="x")])
+    blob = st.serialize_program(prog)
+    exported = st.deserialize_program(blob)
+    import jax.numpy as jnp
+    out = np.asarray(exported.call({}, jnp.ones((2, 2), "float32")))
+    np.testing.assert_allclose(out, np.full((2, 2), 3.0))
+    pers = st.serialize_persistables(program=prog)
+    st.deserialize_persistables(prog, pers)
+    path = str(tmp_path / "m.bin")
+    st.save_to_file(path, blob)
+    assert st.load_from_file(path) == blob
+
+
+def test_static_program_state_roundtrip(tmp_path):
+    lin = nn.Linear(4, 3)
+    prog = st.build_program(lin, [st.InputSpec([2, 4], name="x")])
+    prefix = str(tmp_path / "model")
+    st.save(prog, prefix)
+    state = st.load_program_state(prefix)
+    assert set(state) == set(prog.params)
+    zeroed = {k: np.zeros_like(v) for k, v in state.items()}
+    st.set_program_state(prog, zeroed)
+    out = np.asarray(prog.run(np.ones((2, 4), "float32")))
+    np.testing.assert_allclose(out, 0.0)
+    st.load(prog, prefix)  # restore
+    out2 = np.asarray(prog.run(np.ones((2, 4), "float32")))
+    assert np.abs(out2).sum() > 0
+
+
+def test_static_gradients_and_append_backward():
+    x = pt.to_tensor(np.ones((2, 4), "float32"))
+    y = snn.fc(x, 3)
+    pairs = st.append_backward(y.sum())
+    assert len(pairs) == 2  # weight + bias
+    shapes = sorted(tuple(p.shape) for p, _ in pairs)
+    assert shapes == [(3,), (4, 3)]
+    for p, g in pairs:
+        assert tuple(p.shape) == tuple(g.shape)
+
+    a = pt.to_tensor(np.ones((2, 2), "float32"))
+    a.stop_gradient = False
+    g = st.gradients((a * a).sum(), a)
+    np.testing.assert_allclose(np.asarray(g[0].value), 2.0)
+
+
+def test_static_py_func_eager_and_traced():
+    x = pt.to_tensor(np.ones((2, 2), "float32"))
+    out = st.py_func(lambda a: np.asarray(a) + 1.0, x,
+                     out=pt.to_tensor(np.zeros((2, 2), "float32")))
+    np.testing.assert_allclose(out.numpy(), 2.0)
+    prog = st.build_program(
+        lambda t: st.py_func(
+            lambda a: np.asarray(a) * 3.0, t,
+            out=pt.to_tensor(np.zeros((2, 2), "float32"))),
+        [st.InputSpec([2, 2])])
+    np.testing.assert_allclose(
+        np.asarray(prog.run(np.ones((2, 2), "float32"))), 3.0)
+
+
+def test_static_auc_and_accuracy():
+    scores = pt.to_tensor(np.array(
+        [[0.3, 0.7], [0.6, 0.4], [0.2, 0.8], [0.9, 0.1]], "float32"))
+    labels = pt.to_tensor(np.array([1, 0, 1, 0]))
+    assert float(st.auc(scores, labels).numpy()) == pytest.approx(1.0)
+    acc = st.accuracy(scores, pt.to_tensor(np.array([[1], [0], [1], [0]])))
+    assert float(np.asarray(acc.value if hasattr(acc, "value") else acc)) \
+        == pytest.approx(1.0)
+
+
+def test_static_misc_shells():
+    bs = st.BuildStrategy()
+    bs.fuse_all_reduce_ops = False
+    es = st.ExecutionStrategy()
+    es.num_threads = 4
+    assert st.cpu_places(2)[1].device_id == 1
+    assert len(st.cuda_places()) >= 1
+    with st.device_guard("gpu:0"):
+        from paddle_tpu.static.api import current_device_tag
+        assert current_device_tag() == "gpu:0"
+    with st.name_scope("blk"):
+        pass
+    sp = st.default_startup_program()
+    sp.random_seed = 7
+    assert sp.random_seed == 7
+    assert st.normalize_program(None) is None
+    wn = st.WeightNormParamAttr(dim=0, name="w")
+    assert wn.dim == 0
+
+
+# -- static.nn builders -------------------------------------------------------
+
+def test_static_nn_fc_embedding_conv():
+    x = pt.to_tensor(np.random.default_rng(0).standard_normal(
+        (4, 8)).astype("float32"))
+    y = snn.fc(x, 16, activation="relu")
+    assert tuple(y.shape) == (4, 16)
+    assert float(y.numpy().min()) >= 0.0  # relu applied
+    ids = pt.to_tensor(np.array([[1, 2], [3, 4]], dtype="int64"))
+    e = snn.embedding(ids, (10, 5))
+    assert tuple(e.shape) == (2, 2, 5)
+    e2 = snn.sparse_embedding(ids, (10, 5))
+    assert tuple(e2.shape) == (2, 2, 5)
+    img = pt.to_tensor(np.random.default_rng(1).standard_normal(
+        (2, 3, 8, 8)).astype("float32"))
+    c = snn.conv2d(img, 4, 3, padding=1)
+    assert tuple(c.shape) == (2, 4, 8, 8)
+    ct = snn.conv2d_transpose(img, 4, filter_size=2, stride=2)
+    assert tuple(ct.shape) == (2, 4, 16, 16)
+
+
+def test_static_nn_param_reuse_by_name():
+    x = pt.to_tensor(np.ones((2, 4), "float32"))
+    s = st.Scope()
+    with st.scope_guard(s):
+        y1 = snn.fc(x, 3, weight_attr=pt.ParamAttr(name="shared_w"),
+                    bias_attr=False)
+        y2 = snn.fc(x, 3, weight_attr=pt.ParamAttr(name="shared_w"),
+                    bias_attr=False)
+    np.testing.assert_allclose(y1.numpy(), y2.numpy())
+
+
+def test_static_nn_norms_and_bn_state():
+    img = pt.to_tensor(np.random.default_rng(2).standard_normal(
+        (2, 3, 6, 6)).astype("float32"))
+    s = st.Scope()
+    with st.scope_guard(s):
+        out = snn.batch_norm(img, moving_mean_name="bn_m",
+                             moving_variance_name="bn_v")
+        assert tuple(out.shape) == (2, 3, 6, 6)
+        m = st.global_scope().find_var("bn_m")
+        # train-mode call must have updated the moving mean off zero
+        assert np.abs(np.asarray(m.value)).sum() > 0
+    ln = snn.layer_norm(pt.to_tensor(np.ones((2, 5), "float32")))
+    assert tuple(ln.shape) == (2, 5)
+    gn = snn.group_norm(img, 3)
+    assert tuple(gn.shape) == (2, 3, 6, 6)
+    inorm = snn.instance_norm(img)
+    assert tuple(inorm.shape) == (2, 3, 6, 6)
+    dn = snn.data_norm(pt.to_tensor(np.ones((4, 3), "float32")))
+    assert tuple(dn.shape) == (4, 3)
+
+
+def test_static_nn_spectral_norm_scales_to_unit_sigma():
+    w = np.random.default_rng(3).standard_normal((6, 4)).astype("float32")
+    wn = snn.spectral_norm(pt.to_tensor(w), power_iters=50)
+    sigma = np.linalg.svd(wn.numpy(), compute_uv=False)[0]
+    assert sigma == pytest.approx(1.0, abs=1e-3)
+
+
+def test_static_nn_misc_builders():
+    x = pt.to_tensor(np.random.default_rng(4).standard_normal(
+        (3, 4)).astype("float32"))
+    pr = snn.prelu(pt.to_tensor(np.array([[-2.0, 2.0]], "float32")), "all")
+    np.testing.assert_allclose(pr.numpy(), [[-0.5, 2.0]])
+    seq = pt.to_tensor(np.random.default_rng(5).standard_normal(
+        (2, 5, 4)).astype("float32"))
+    rc = snn.row_conv(seq, 2)
+    assert tuple(rc.shape) == (2, 5, 4)
+    y = pt.to_tensor(np.random.default_rng(6).standard_normal(
+        (3, 5)).astype("float32"))
+    bt = snn.bilinear_tensor_product(x, y, 7)
+    assert tuple(bt.shape) == (3, 7)
+    lbl = pt.to_tensor(np.array([[1], [0], [2]], dtype="int64"))
+    loss = snn.nce(x, lbl, num_total_classes=6)
+    assert np.isfinite(np.asarray(loss.value)).all()
+
+
+def test_static_nn_multi_box_head():
+    feats = [pt.to_tensor(np.random.default_rng(7).standard_normal(
+        (1, 8, s, s)).astype("float32")) for s in (4, 2)]
+    image = pt.to_tensor(np.zeros((1, 3, 32, 32), "float32"))
+    locs, confs, boxes, variances = snn.multi_box_head(
+        feats, image, base_size=32, num_classes=3,
+        aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90)
+    assert locs.shape[0] == 1 and locs.shape[2] == 4
+    assert confs.shape[2] == 3
+    assert boxes.shape[0] == locs.shape[1]  # one prior per loc slot
+    assert tuple(boxes.shape) == tuple(variances.shape)
+
+
+def test_static_nn_control_flow_and_sequence_reexports():
+    import jax.numpy as jnp
+    r = snn.cond(jnp.asarray(True), lambda: jnp.ones(2), lambda: jnp.zeros(2))
+    assert np.asarray(r.value if hasattr(r, "value") else r).sum() == 2
+    sm = snn.sequence_softmax(
+        pt.to_tensor(np.ones((2, 3, 1), "float32")),
+        pt.to_tensor(np.array([2, 3])))
+    assert np.asarray(sm.value if hasattr(sm, "value") else sm).shape \
+        == (2, 3, 1)
+
+
+def test_sequence_reshape_and_scatter():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.sequence import sequence_reshape, sequence_scatter
+    out, nl = sequence_reshape(jnp.ones((2, 4, 6)), jnp.array([2, 4]), 3)
+    assert out.shape == (2, 8, 3)
+    assert nl.tolist() == [4, 8]
+    res = sequence_scatter(
+        jnp.zeros((2, 5)), jnp.array([[0, 1], [2, 3]]),
+        jnp.array([[1.0, 2.0], [3.0, 4.0]]), jnp.array([2, 1]))
+    np.testing.assert_allclose(
+        np.asarray(res), [[1, 2, 0, 0, 0], [0, 0, 3, 0, 0]])
+
+
+# -- nn layer/functional additions -------------------------------------------
+
+def test_nn_new_layers():
+    rng = np.random.default_rng(8)
+    x5 = pt.to_tensor(rng.standard_normal((2, 3, 4, 4, 4)).astype("float32"))
+    assert tuple(nn.AdaptiveMaxPool3D(2)(x5).shape) == (2, 3, 2, 2, 2)
+    d3 = nn.Dropout3D(0.5)
+    d3.eval()
+    np.testing.assert_allclose(d3(x5).numpy(), x5.numpy())
+    pd = nn.PairwiseDistance()
+    out = pd(pt.to_tensor(np.ones((2, 3), "float32")),
+             pt.to_tensor(np.zeros((2, 3), "float32")))
+    np.testing.assert_allclose(out.numpy(), np.sqrt(3.0), rtol=1e-4)
+    assert nn.ClipGradByGlobalNorm is not None
+
+
+def test_nn_birnn_and_cellbase():
+    cell_fw, cell_bw = nn.GRUCell(4, 5), nn.GRUCell(4, 5)
+    bi = nn.BiRNN(cell_fw, cell_bw)
+    out, (st_fw, st_bw) = bi(pt.to_tensor(
+        np.random.default_rng(9).standard_normal((2, 3, 4)).astype(
+            "float32")))
+    assert tuple(out.shape) == (2, 3, 10)
+
+    class MyCell(nn.RNNCellBase):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        @property
+        def state_shape(self):
+            return (4,)
+
+        def forward(self, x, s=None):
+            if s is None:
+                s = self.get_initial_states(x)
+            h = self.lin(x) + s
+            return h, h
+
+    cell = MyCell()
+    rnn = nn.RNN(cell)
+    out, _ = rnn(pt.to_tensor(np.ones((2, 3, 4), "float32")))
+    assert tuple(out.shape) == (2, 3, 4)
+
+
+def test_nn_spectral_norm_layer_updates_buffers():
+    sn = nn.SpectralNorm((4, 3), power_iters=2)
+    u0 = np.asarray(sn.weight_u.value).copy()
+    w = pt.to_tensor(np.random.default_rng(10).standard_normal(
+        (4, 3)).astype("float32"))
+    out = sn(w)
+    assert tuple(out.shape) == (4, 3)
+    assert not np.allclose(np.asarray(sn.weight_u.value), u0)
+
+
+def test_beam_search_decoder_dynamic_decode():
+    pt.seed(0)
+    V, H = 7, 8
+    cell = nn.GRUCell(H, H)
+    emb = nn.Embedding(V, H)
+    proj = nn.Linear(H, V)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2, beam_size=3,
+                               embedding_fn=emb, output_fn=proj)
+    init = pt.to_tensor(np.zeros((2, H), "float32"))
+    ids, scores, lens = nn.dynamic_decode(dec, inits=init, max_step_num=5,
+                                          return_length=True)
+    assert ids.shape[0] == 2 and ids.shape[1] <= 5
+    assert np.isfinite(scores.numpy()).all()
+    assert (lens.numpy() <= 5).all()
+
+
+def test_functional_inplace_and_new_ops():
+    import paddle_tpu.nn.functional as F
+    y = pt.to_tensor(np.array([-1.0, 2.0], "float32"))
+    F.relu_(y)
+    np.testing.assert_allclose(y.numpy(), [0.0, 2.0])
+    z = pt.to_tensor(np.array([0.0, 1.0], "float32"))
+    F.softmax_(z)
+    assert z.numpy().sum() == pytest.approx(1.0)
+    assert tuple(F.diag_embed(
+        pt.to_tensor(np.ones(3, "float32"))).shape) == (3, 3)
+    x5 = pt.to_tensor(np.ones((1, 1, 4, 4, 4), "float32"))
+    assert tuple(F.adaptive_max_pool3d(x5, 2).shape) == (1, 1, 2, 2, 2)
+    ids = np.zeros((3, 2, 2), "int32")
+    parents = np.zeros((3, 2, 2), "int32")
+    assert tuple(np.asarray(F.gather_tree(
+        pt.to_tensor(ids), pt.to_tensor(parents)).value).shape) == (3, 2, 2)
+
+
+def test_initializer_bilinear_and_global():
+    from paddle_tpu.nn.initializer import (Bilinear, set_global_initializer)
+    w = Bilinear()((1, 1, 4, 4), "float32")
+    assert np.asarray(w).max() <= 1.0 and np.asarray(w).min() >= 0.0
+    set_global_initializer(nn.initializer.Constant(0.5))
+    try:
+        lin = nn.Linear(2, 2)
+        np.testing.assert_allclose(np.asarray(lin.weight.value), 0.5)
+    finally:
+        set_global_initializer(None)
+    lin2 = nn.Linear(2, 2)
+    assert not np.allclose(np.asarray(lin2.weight.value), 0.5)
+
+
+def test_jit_traced_translated_layers(tmp_path):
+    from paddle_tpu import jit
+    lin = nn.Linear(4, 3)
+    x = pt.to_tensor(np.ones((2, 4), "float32"))
+    outs, traced = jit.TracedLayer.trace(lin, x)
+    ref = np.asarray(outs.value)
+    np.testing.assert_allclose(np.asarray(traced(x)), ref, rtol=1e-5)
+    prefix = str(tmp_path / "tl")
+    traced.save_inference_model(prefix)
+    tl = jit.TranslatedLayer.from_path(prefix)
+    np.testing.assert_allclose(
+        np.asarray(tl(np.ones((2, 4), "float32"))), ref, rtol=1e-5)
+    with pytest.raises(RuntimeError):
+        tl.train()
+    jit.set_code_level(10)
+    jit.set_verbosity(1)
+
+    @jit.not_to_static
+    def f():
+        return 1
+
+    assert f.__pt_not_to_static__ and f() == 1
+
+
+def test_io_get_worker_info():
+    import paddle_tpu.io as pio
+    assert pio.get_worker_info() is None
+
+    class DS(pio.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            info = pio.get_worker_info()
+            assert info is not None and 0 <= info.id < info.num_workers
+            return np.float32(i)
+
+    dl = pio.DataLoader(DS(), batch_size=2, num_workers=2,
+                        use_buffer_reader=False)
+    seen = [b for b in dl]
+    assert len(seen) == 4
+
+
+def test_utils_parity_tail():
+    from paddle_tpu import utils
+    with pytest.raises(ImportError):
+        utils.try_import("definitely_not_a_module_xyz")
+    utils.require_version("0.0.1")
+    with pytest.raises(RuntimeError):
+        utils.require_version("999.0.0")
+
+
+def test_autograd_pylayer_exports():
+    from paddle_tpu.autograd import PyLayer, PyLayerContext
+    assert PyLayer is not None and PyLayerContext is not None
