@@ -1,0 +1,45 @@
+"""Device management module (reference: python/paddle/device.py __all__:
+get_cudnn_version, set_device, get_device, XPUPlace,
+is_compiled_with_xpu/cuda/rocm/npu).
+
+TPU-native: the accelerator is a TPU reached through PJRT; the
+is_compiled_with_* probes answer for the CUDA/ROCm/XPU/NPU stacks this
+build intentionally does not carry.
+"""
+
+from __future__ import annotations
+
+from .core import get_device, set_device
+from .core.place import XPUPlace
+
+__all__ = ["get_cudnn_version", "set_device", "get_device", "XPUPlace",
+           "is_compiled_with_xpu", "is_compiled_with_cuda",
+           "is_compiled_with_rocm", "is_compiled_with_npu",
+           "is_compiled_with_tpu"]
+
+
+def get_cudnn_version():
+    """reference: paddle.device.get_cudnn_version — None when no cuDNN
+    (this build targets TPU)."""
+    return None
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    """Beyond-reference probe: True — the TPU backend is the point."""
+    return True

@@ -7,9 +7,13 @@ from .env import (ParallelEnv, device_count, get_rank, get_world_size,
                   init_parallel_env, local_device_count)
 
 from . import collective
-from .collective import (ReduceOp, all_gather, all_gather_object,
-                         all_reduce, alltoall, barrier, broadcast, recv,
-                         reduce, reduce_scatter, scatter, send)
+from .collective import (Group, ReduceOp, all_gather, all_gather_object,
+                         all_reduce, alltoall, barrier, broadcast,
+                         get_group, new_group, recv, reduce,
+                         reduce_scatter, scatter, send, split, wait)
+from .entry import CountFilterEntry, EntryAttr, ProbabilityEntry
+from .spawn import spawn
+from ..io.heavy_dataset import InMemoryDataset, QueueDataset
 from .parallel import DataParallel, recompute
 from .strategy import DistributedStrategy
 from .topology import (CommunicateTopology, HybridCommunicateGroup,

@@ -184,12 +184,16 @@ def barrier(group=None):
         multihost_utils.sync_global_devices("paddle_tpu_barrier")
 
 
-def get_group(axis_name: str = "dp"):
-    class _Group:
-        def __init__(self, name):
-            self.axis_name = name
-            self.nranks = -1
-    return _Group(axis_name)
+def get_group(id_or_axis="dp"):
+    """reference: paddle.distributed.get_group(id) — retrieve a group
+    created by new_group; an axis name returns a fresh handle for that
+    mesh axis."""
+    if isinstance(id_or_axis, int):
+        g = _custom_groups.get(id_or_axis)
+        if g is None:
+            raise ValueError(f"no group with id {id_or_axis}")
+        return g
+    return Group(id_or_axis)
 
 
 # -- TP helper collectives (reference: collective.py:747-919 c_identity /
@@ -250,3 +254,78 @@ def all_gather_object(obj, group=None):
     gathered = np.asarray(multihost_utils.process_allgather(buf))
     return [pickle.loads(gathered[i, :int(sizes[i])].tobytes())
             for i in range(len(sizes))]
+
+
+class Group:
+    """Communication-group handle (reference: distributed/collective.py
+    Group). On the mesh runtime a group is a named mesh axis; ranks is
+    informational."""
+
+    def __init__(self, axis_name: str = "dp", ranks=None, id: int = 0):  # noqa: A002
+        self.axis_name = axis_name
+        self.ranks = list(ranks) if ranks is not None else []
+        self.id = id
+        self.nranks = len(self.ranks) if self.ranks else -1
+
+    def is_member(self) -> bool:
+        import jax
+        return not self.ranks or jax.process_index() in self.ranks
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name!r}, ranks={self.ranks})"
+
+
+_custom_groups = {}
+
+
+def new_group(ranks=None, backend=None, axis_name: str = "dp") -> Group:
+    """reference: paddle.distributed.new_group — a handle for a rank
+    subset. Collectives inside jit resolve groups by mesh axis name; the
+    returned Group carries that axis."""
+    gid = len(_custom_groups) + 1
+    g = Group(axis_name, ranks, gid)
+    _custom_groups[gid] = g
+    return g
+
+
+def wait(tensor, group=None, use_calc_stream: bool = True) -> None:
+    """reference: paddle.distributed.wait (stream sync op) — on XLA,
+    device-side ordering is by data dependency; this blocks the host on
+    the value like c_sync_calc_stream."""
+    v = tensor.value if hasattr(tensor, "value") else tensor
+    if hasattr(v, "block_until_ready"):
+        v.block_until_ready()
+
+
+def split(x, size, operation: str = "linear", axis: int = 0,
+          num_partitions: int = 1, gather_out: bool = True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference: paddle.distributed.split (collective.py split) — run a
+    linear/embedding with its weight sharded over the mp mesh axis.
+
+    operation='linear': size=(in, out); axis=1 shards columns
+    (ColumnParallelLinear), axis=0 shards rows (RowParallelLinear).
+    operation='embedding': size=(vocab, dim), vocab-sharded.
+    """
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr, name=name)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError(f"unsupported split operation {operation!r}")
+    if axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out, name=name)
+    else:
+        layer = RowParallelLinear(size[0], size[1],
+                                  weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  name=name)
+    return layer(x)

@@ -1,0 +1,84 @@
+"""Fleet data generators (reference:
+python/paddle/distributed/fleet/data_generator/data_generator.py —
+MultiSlotDataGenerator / MultiSlotStringDataGenerator).
+
+A user subclass implements ``generate_sample(line)`` returning a
+generator of (slot_name, values) lists; ``run_from_stdin`` /
+``run_from_memory`` emit the slot-line text format consumed by
+io.heavy_dataset.parse_slot_line ("slot:v1 v2;slot2:...").
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Iterable, List, Tuple
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    # -- user hooks -----------------------------------------------------------
+
+    def generate_sample(self, line):
+        """Override: return a generator yielding one or more samples, each
+        a list of (slot_name, values) pairs."""
+        raise NotImplementedError(
+            "subclasses must implement generate_sample(line)")
+
+    def generate_batch(self, samples):
+        """Optional override for batch-level rewriting."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def set_batch(self, batch_size: int):
+        self.batch_size_ = batch_size
+
+    # -- drivers --------------------------------------------------------------
+
+    def _format_sample(self, sample: List[Tuple[str, Iterable]]) -> str:
+        parts = []
+        for slot, values in sample:
+            vals = " ".join(str(v) for v in values)
+            parts.append(f"{slot}:{vals}")
+        return ";".join(parts)
+
+    def _iter_lines(self, lines):
+        batch = []
+        for line in lines:
+            g = self.generate_sample(line)
+            if g is None:
+                continue
+            for sample in g():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) >= self.batch_size_:
+                    for s in self.generate_batch(batch)():
+                        yield self._format_sample(s)
+                    batch = []
+        if batch:
+            for s in self.generate_batch(batch)():
+                yield self._format_sample(s)
+
+    def run_from_memory(self, lines=None):
+        """Process an in-memory iterable; returns slot-format lines."""
+        return list(self._iter_lines(lines or [None]))
+
+    def run_from_stdin(self):
+        """Reference entry point: stdin lines -> stdout slot lines."""
+        for out in self._iter_lines(sys.stdin):
+            sys.stdout.write(out + "\n")
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slot values (reference MultiSlotDataGenerator: emits
+    '<num> v... ' per slot; here the canonical slot-line format)."""
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String slot values passed through untouched (reference
+    MultiSlotStringDataGenerator)."""

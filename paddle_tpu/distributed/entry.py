@@ -1,0 +1,56 @@
+"""Sparse-table entry (feature-admission) configs.
+
+Reference parity: python/paddle/distributed/entry_attr.py —
+ProbabilityEntry / CountFilterEntry attached to sparse_embedding params,
+controlling which new sparse features a PS table admits. Consumed by
+distributed.ps sparse tables as an admission policy.
+"""
+
+from __future__ import annotations
+
+
+class EntryAttr:
+    """Base (reference: entry_attr.py EntryAttr)."""
+
+    def _to_attr(self) -> str:
+        raise NotImplementedError
+
+    def admit(self, count: int, rng=None) -> bool:
+        """Whether a feature seen ``count`` times should be admitted."""
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit new features with probability p (reference:
+    entry_attr.py ProbabilityEntry)."""
+
+    def __init__(self, probability: float):
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+        self._name = "probability_entry"
+        self._probability = float(probability)
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._probability}"
+
+    def admit(self, count: int, rng=None) -> bool:
+        import random
+        r = rng.random() if rng is not None else random.random()
+        return r < self._probability
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit features only after ``count_filter`` occurrences (reference:
+    entry_attr.py CountFilterEntry)."""
+
+    def __init__(self, count_filter: int):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._name = "count_filter_entry"
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._count_filter}"
+
+    def admit(self, count: int, rng=None) -> bool:
+        return count >= self._count_filter

@@ -444,3 +444,56 @@ def distributed_jit(model: Layer, optimizer, train_fn: Callable,
             hcg=kwargs.get("hcg"), seed=kwargs.get("seed", 0),
             donate=kwargs.get("donate", True))
     return ShardedTrainStep(model, optimizer, train_fn, **kwargs)
+
+
+# -- reference-parity class surface ------------------------------------------
+
+from .data_generator import (DataGenerator,  # noqa: E402,F401
+                             MultiSlotDataGenerator,
+                             MultiSlotStringDataGenerator)
+from .fleet_util import UtilBase  # noqa: E402,F401
+from .role_maker import (PaddleCloudRoleMaker, Role,  # noqa: E402,F401
+                         RoleMakerBase, UserDefinedRoleMaker)
+from .topology import CommunicateTopology  # noqa: E402,F401
+
+
+class Fleet:
+    """Class facade over this module's fleet functions (reference:
+    fleet/base/fleet_base.py Fleet — there the singleton
+    ``paddle.distributed.fleet`` IS a Fleet instance; here the module is
+    the singleton and this class delegates for API parity)."""
+
+    def __init__(self):
+        self._role_maker = None
+
+    def init(self, role_maker=None, is_collective: bool = True,
+             strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        return init(role_maker, is_collective, strategy)
+
+    def is_first_worker(self) -> bool:
+        return is_first_worker()
+
+    def worker_index(self) -> int:
+        return worker_index()
+
+    def worker_num(self) -> int:
+        return worker_num()
+
+    def is_worker(self) -> bool:
+        return self._role_maker is None or self._role_maker.is_worker()
+
+    def is_server(self) -> bool:
+        return self._role_maker is not None and self._role_maker.is_server()
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    @property
+    def util(self):
+        from .fleet_util import fleet_util
+        return fleet_util()

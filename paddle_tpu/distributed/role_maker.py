@@ -1,0 +1,103 @@
+"""Role makers: who am I in the job?
+
+Reference parity: python/paddle/distributed/fleet/base/role_maker.py
+(PaddleCloudRoleMaker parses the fleetrun env contract; UserDefinedRoleMaker
+takes explicit ranks; Role enumerates WORKER/SERVER/HETER_WORKER).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def _worker_index(self) -> int:
+        raise NotImplementedError
+
+    def _worker_num(self) -> int:
+        raise NotImplementedError
+
+    def _is_worker(self) -> bool:
+        raise NotImplementedError
+
+    def _is_server(self) -> bool:
+        raise NotImplementedError
+
+    def _is_first_worker(self) -> bool:
+        return self._is_worker() and self._worker_index() == 0
+
+    # reference public aliases
+    def worker_index(self) -> int:
+        return self._worker_index()
+
+    def worker_num(self) -> int:
+        return self._worker_num()
+
+    def is_worker(self) -> bool:
+        return self._is_worker()
+
+    def is_server(self) -> bool:
+        return self._is_server()
+
+    def is_first_worker(self) -> bool:
+        return self._is_first_worker()
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Parses the launcher env contract (reference role_maker.py:946-area;
+    contract set by distributed/launch.py: PADDLE_TRAINER_ID,
+    PADDLE_TRAINERS_NUM, TRAINING_ROLE, PADDLE_PORT/POD_IP for servers)."""
+
+    def __init__(self, is_collective: bool = False, **kwargs):
+        self._is_collective = is_collective
+        self._role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = [e for e in eps.split(",") if e]
+        weps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = [e for e in weps.split(",") if e]
+
+    def _worker_index(self) -> int:
+        return self._trainer_id
+
+    def _worker_num(self) -> int:
+        return self._trainers_num
+
+    def _is_worker(self) -> bool:
+        return self._role in ("TRAINER", "WORKER")
+
+    def _is_server(self) -> bool:
+        return self._role == "PSERVER"
+
+    def _server_num(self) -> int:
+        return len(self._server_endpoints)
+
+    def get_pserver_endpoints(self) -> List[str]:
+        return list(self._server_endpoints)
+
+    def get_trainer_endpoints(self) -> List[str]:
+        return list(self._worker_endpoints)
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit role configuration (reference: role_maker.py
+    UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective: bool = False,
+                 current_id: int = 0, role: int = Role.WORKER,
+                 worker_num: int = 1,
+                 server_endpoints: Optional[List[str]] = None, **kwargs):
+        super().__init__(is_collective)
+        self._trainer_id = current_id
+        self._trainers_num = worker_num
+        self._role = "PSERVER" if role == Role.SERVER else "TRAINER"
+        self._server_endpoints = server_endpoints or []

@@ -1,0 +1,71 @@
+"""paddle.distributed.spawn — run a function on N local ranks.
+
+Reference parity: python/paddle/distributed/spawn.py (spawns worker
+processes with the fleetrun env contract and joins them).
+
+TPU-native note: SPMD training normally runs ONE process per host with
+all chips visible (pjit over a Mesh) — spawn exists for the reference's
+process-per-rank model and for CPU-mesh tests; each child gets the
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM env contract used by
+distributed.env.ParallelEnv.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+from typing import Sequence
+
+
+def _worker(rank: int, nprocs: int, fn_name_queue, func, args, env):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["FLAGS_selected_devices"] = str(rank)
+    try:
+        func(*args)
+        fn_name_queue.put((rank, None))
+    except Exception:
+        fn_name_queue.put((rank, traceback.format_exc()))
+
+
+class SpawnContext:
+    def __init__(self, procs, queue):
+        self.processes = procs
+        self._queue = queue
+
+    def join(self, timeout=None):
+        errs = []
+        for _ in self.processes:
+            rank, err = self._queue.get(timeout=timeout)
+            if err:
+                errs.append((rank, err))
+        for p in self.processes:
+            p.join(timeout)
+        if errs:
+            rank, err = errs[0]
+            raise RuntimeError(f"spawned rank {rank} failed:\n{err}")
+        return True
+
+
+def spawn(func, args: Sequence = (), nprocs: int = -1, join: bool = True,
+          daemon: bool = False, **options) -> SpawnContext:
+    """reference: paddle.distributed.spawn(func, args, nprocs, join)."""
+    if nprocs == -1:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    ctx = mp.get_context("spawn")  # fork is unsafe under JAX threads
+    q = ctx.Queue()
+    env = {k: v for k, v in os.environ.items()
+           if k.startswith(("PADDLE_", "FLAGS_", "XLA_", "JAX_"))}
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(rank, nprocs, q, func, tuple(args), env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    sctx = SpawnContext(procs, q)
+    if join:
+        sctx.join()
+    return sctx

@@ -1,0 +1,11 @@
+"""Incubating APIs (reference: python/paddle/incubate/__init__.py
+__all__: LookAhead, ModelAverage — re-exported from the optimizer-wrapper
+family, plus the segment ops the reference keeps under incubate.tensor).
+"""
+
+from ..optimizer.wrappers import Lookahead as LookAhead, ModelAverage
+from ..ops.decode_extra import (segment_max, segment_mean, segment_min,
+                                segment_sum)
+
+__all__ = ["LookAhead", "ModelAverage", "segment_sum", "segment_mean",
+           "segment_max", "segment_min"]

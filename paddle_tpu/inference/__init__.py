@@ -9,5 +9,7 @@ AOT compile of the exported program; precision switching is a dtype cast
 at load; zero-copy handles are device arrays.
 """
 
-from .predictor import Config, PrecisionType, Predictor, Tensor as \
-    InferTensor, create_predictor
+from .predictor import (Config, DataType, PlaceType, PrecisionType,
+                        Predictor, PredictorPool, Tensor,
+                        Tensor as InferTensor, create_predictor,
+                        get_num_bytes_of_data_type, get_version)

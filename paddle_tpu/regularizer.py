@@ -1,0 +1,51 @@
+"""Weight regularizers (reference: python/paddle/regularizer.py
+L1Decay/L2Decay backed by fluid/regularizer.py
+L1DecayRegularizer/L2DecayRegularizer).
+
+The optimizer consumes these through its ``weight_decay`` argument: L2Decay
+adds ``coeff * param`` to the gradient (or decoupled decay for AdamW-style
+optimizers); L1Decay adds ``coeff * sign(param)``.
+"""
+
+from __future__ import annotations
+
+
+class L2Decay:
+    """reference: paddle.regularizer.L2Decay — loss += 0.5*coeff*||w||^2,
+    i.e. grad += coeff * w."""
+
+    mode = "l2"
+
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def grad_term(self, param):
+        return self._coeff * param
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self._coeff})"
+
+
+class L1Decay:
+    """reference: paddle.regularizer.L1Decay — loss += coeff*||w||_1,
+    i.e. grad += coeff * sign(w)."""
+
+    mode = "l1"
+
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def grad_term(self, param):
+        import jax.numpy as jnp
+        return self._coeff * jnp.sign(param)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self._coeff})"

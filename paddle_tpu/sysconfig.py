@@ -1,0 +1,23 @@
+"""Build configuration paths (reference: python/paddle/sysconfig.py:
+get_include / get_lib for compiling custom ops against the install)."""
+
+from __future__ import annotations
+
+import os
+
+
+def _root() -> str:
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory with the C headers for custom-op builds (reference:
+    paddle.sysconfig.get_include). The custom-op ABI header lives in
+    native/ (pt_custom_op.h)."""
+    return os.path.join(os.path.dirname(_root()), "native")
+
+
+def get_lib() -> str:
+    """Directory with the native shared library (reference:
+    paddle.sysconfig.get_lib)."""
+    return os.path.join(os.path.dirname(_root()), "native")

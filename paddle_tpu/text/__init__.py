@@ -159,3 +159,225 @@ def viterbi_decode(potentials, transitions):
     paths = jnp.concatenate([jnp.moveaxis(path_rev, 0, 1),
                              last[:, None]], axis=1)
     return final_scores, paths
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference: paddle.text.UCIHousing,
+    text/datasets/uci_housing.py: 13 features -> price). Reads the
+    whitespace-separated housing.data file when given; else a
+    deterministic synthetic linear-model corpus."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 download: bool = True, synthetic_size: int = 404):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file, dtype=np.float32)
+            feats, prices = raw[:, :-1], raw[:, -1:]
+            # reference normalizes features by train-split statistics
+            mx, mn = feats.max(0), feats.min(0)
+            feats = (feats - feats.mean(0)) / np.maximum(mx - mn, 1e-6)
+            split = int(len(raw) * 0.8)
+            if mode == "train":
+                feats, prices = feats[:split], prices[:split]
+            else:
+                feats, prices = feats[split:], prices[split:]
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            feats = rng.standard_normal(
+                (synthetic_size, self.FEATURE_DIM)).astype(np.float32)
+            w = np.linspace(-1.0, 1.0, self.FEATURE_DIM, dtype=np.float32)
+            prices = (feats @ w[:, None] + 22.5 +
+                      0.1 * rng.standard_normal((synthetic_size, 1))
+                      ).astype(np.float32)
+        self.samples = [(feats[i], prices[i]) for i in range(len(feats))]
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Imikolov(Dataset):
+    """PTB n-gram/sequence dataset (reference: paddle.text.Imikolov,
+    text/datasets/imikolov.py). data_type='NGRAM' yields window_size word
+    ids; 'SEQ' yields (src, trg) shifted sequences. Local PTB text file
+    or deterministic synthetic corpus."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
+                 window_size: int = 5, mode: str = "train",
+                 min_word_freq: int = 1, seq_len: int = 20,
+                 synthetic_size: int = 500):
+        if data_file and os.path.exists(data_file):
+            with open(data_file, encoding="utf-8") as f:
+                lines = [ln.strip() for ln in f if ln.strip()]
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            words = ["the", "of", "market", "stock", "bank", "price",
+                     "trade", "rate", "dollar", "share"]
+            lines = [" ".join(words[int(j)] for j in
+                              rng.integers(0, len(words), 12))
+                     for _ in range(synthetic_size)]
+        self.vocab = Vocab.build_from_texts(lines, min_freq=min_word_freq)
+        self.samples = []
+        for ln in lines:
+            ids = self.vocab.encode(ln.lower().split())
+            if data_type.upper() == "NGRAM":
+                for i in range(len(ids) - window_size + 1):
+                    self.samples.append(tuple(
+                        np.int64(t) for t in ids[i:i + window_size]))
+            else:
+                seq = [self.vocab.bos_id] + ids[:seq_len] + \
+                    [self.vocab.eos_id]
+                src = np.asarray(seq[:-1], np.int64)
+                trg = np.asarray(seq[1:], np.int64)
+                self.samples.append((src, trg))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M rating dataset (reference: paddle.text.Movielens,
+    text/datasets/movielens.py): samples are (user_id, gender, age, job,
+    movie_id, category_ids, title_ids, rating). Reads the ml-1m directory
+    when given; else deterministic synthetic interactions."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed: int = 0,
+                 synthetic_size: int = 600):
+        rng = np.random.default_rng(rand_seed)
+        if data_file and os.path.isdir(data_file):
+            ratings = os.path.join(data_file, "ratings.dat")
+            rows = []
+            with open(ratings, encoding="latin-1") as f:
+                for ln in f:
+                    u, m, r, _ = ln.strip().split("::")
+                    rows.append((int(u), int(m), float(r)))
+        else:
+            rows = [(int(rng.integers(1, 500)), int(rng.integers(1, 300)),
+                     float(rng.integers(1, 6)))
+                    for _ in range(synthetic_size)]
+        self.samples = []
+        for u, m, r in rows:
+            is_test = rng.random() < test_ratio
+            if (mode == "test") != is_test:
+                continue
+            gender = np.int64(u % 2)
+            age = np.int64(u % 7)
+            job = np.int64(u % 21)
+            cats = np.asarray([m % 18, (m * 7) % 18], np.int64)
+            title = np.asarray([(m * 13 + k) % 5000 for k in range(4)],
+                               np.int64)
+            self.samples.append((np.int64(u), gender, age, job,
+                                 np.int64(m), cats, title,
+                                 np.float32(r)))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 semantic role labeling (reference: paddle.text.Conll05st,
+    text/datasets/conll05.py): samples are (word_ids[T], predicate_index,
+    mark[T], label_ids[T]). Local conll-format file or deterministic
+    synthetic sentences."""
+
+    NUM_LABELS = 9
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 seq_len: int = 16, synthetic_size: int = 200):
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        self.seq_len = seq_len
+        if data_file and os.path.exists(data_file):
+            raise NotImplementedError(
+                "parsing official conll05 props files is not wired; "
+                "provide preprocessed .npy arrays or use the synthetic "
+                "corpus")
+        self.samples = []
+        for _ in range(synthetic_size):
+            t = int(rng.integers(5, seq_len + 1))
+            words = rng.integers(4, 200, t)
+            pred = int(rng.integers(0, t))
+            mark = np.zeros(seq_len, np.int64)
+            mark[pred] = 1
+            wid = np.zeros(seq_len, np.int64)
+            wid[:t] = words
+            labels = np.zeros(seq_len, np.int64)
+            labels[:t] = rng.integers(0, self.NUM_LABELS, t)
+            self.samples.append((wid, np.int64(pred), mark, labels))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT14(Dataset):
+    """WMT'14 en-fr translation (reference: paddle.text.WMT14,
+    text/datasets/wmt14.py): samples are (src_ids, trg_ids,
+    trg_ids_next). Local parallel corpus (tab-separated src\\ttrg lines)
+    or deterministic synthetic pairs."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 dict_size: int = 1000, seq_len: int = 16,
+                 synthetic_size: int = 300):
+        self.dict_size = dict_size
+        rng = np.random.default_rng(
+            (14 if mode == "train" else 15))
+        pairs = []
+        if data_file and os.path.exists(data_file):
+            with open(data_file, encoding="utf-8") as f:
+                for ln in f:
+                    if "\t" in ln:
+                        s, t = ln.rstrip("\n").split("\t")[:2]
+                        pairs.append((s.split(), t.split()))
+            texts = [" ".join(s) + " " + " ".join(t) for s, t in pairs]
+            self.vocab = Vocab.build_from_texts(texts,
+                                                max_size=dict_size)
+            # the default vocab tokenizer lowercases; match it here
+            enc = lambda toks: self.vocab.encode(
+                [t.lower() for t in toks])  # noqa: E731
+        else:
+            self.vocab = None
+            for _ in range(synthetic_size):
+                t = int(rng.integers(4, seq_len))
+                src = rng.integers(4, dict_size, t)
+                trg = (src[::-1] % dict_size)  # learnable mapping
+                pairs.append((src, trg))
+            enc = None
+        self.samples = []
+        bos, eos = 2, 3
+        for s, t in pairs:
+            sid = np.asarray(enc(s) if enc else s, np.int64)[:seq_len]
+            tid = np.asarray(enc(t) if enc else t, np.int64)[:seq_len - 1]
+            trg_in = np.concatenate([[bos], tid]).astype(np.int64)
+            trg_next = np.concatenate([tid, [eos]]).astype(np.int64)
+            self.samples.append((sid, trg_in, trg_next))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT16(WMT14):
+    """WMT'16 en-de translation (reference: paddle.text.WMT16,
+    text/datasets/wmt16.py) — same sample contract as WMT14."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 src_dict_size: int = 1000, trg_dict_size: int = 1000,
+                 src_lang: str = "en", seq_len: int = 16,
+                 synthetic_size: int = 300):
+        super().__init__(data_file, mode,
+                         max(src_dict_size, trg_dict_size), seq_len,
+                         synthetic_size)

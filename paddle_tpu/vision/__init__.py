@@ -1,3 +1,34 @@
 """paddle_tpu.vision (reference parity: python/paddle/vision/)."""
 
-from . import datasets, models, transforms
+from . import datasets, models, ops, transforms
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str) -> None:
+    """reference: paddle.vision.set_image_backend ('pil' or 'cv2')."""
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"unsupported image backend {backend!r}, "
+                         "expected 'pil' or 'cv2'")
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    """reference: paddle.vision.get_image_backend."""
+    return _image_backend
+
+
+def image_load(path: str, backend=None):
+    """reference: paddle.vision.image_load — load an image file with the
+    configured backend (PIL here; cv2 is not in this environment)."""
+    backend = backend or _image_backend
+    if backend == "cv2":
+        try:
+            import cv2
+            return cv2.imread(path)
+        except ImportError:
+            raise ImportError("cv2 backend requested but OpenCV is not "
+                              "installed; use the 'pil' backend") from None
+    from PIL import Image
+    return Image.open(path)

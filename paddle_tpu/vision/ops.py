@@ -1,0 +1,78 @@
+"""paddle.vision.ops (reference: python/paddle/vision/ops.py __all__:
+yolo_loss, yolo_box, deform_conv2d, DeformConv2D, read_file, decode_jpeg).
+
+The compute kernels live in paddle_tpu.ops (yolov3_loss/yolo_box/
+deformable_conv); this module provides the reference's argument order on
+top of them plus the file/JPEG IO helpers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import dispatch
+from ..nn.conv import DeformConv2D
+from ..tensor import Tensor
+
+F = dispatch.wrapped_ops
+
+__all__ = ["yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D",
+           "read_file", "decode_jpeg"]
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference: paddle.vision.ops.yolo_loss (yolov3_loss_op.cc)."""
+    return F["yolov3_loss"](x, gt_box, gt_label, anchors, anchor_mask,
+                            class_num, ignore_thresh, downsample_ratio,
+                            gt_score, use_label_smooth)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """reference: paddle.vision.ops.yolo_box (yolo_box_op.cc)."""
+    return F["yolo_box"](x, img_size, anchors, class_num, conf_thresh,
+                         downsample_ratio, clip_bbox, scale_x_y)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """reference: paddle.vision.ops.deform_conv2d (v1 without mask, v2
+    with)."""
+    return F["deformable_conv"](x, offset, weight, mask, bias, stride,
+                                padding, dilation, deformable_groups,
+                                groups)
+
+
+def read_file(filename: str, name=None) -> Tensor:
+    """reference: paddle.vision.ops.read_file — raw bytes as a uint8
+    tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(np.frombuffer(data, dtype=np.uint8))
+
+
+def decode_jpeg(x, mode: str = "unchanged", name=None) -> Tensor:
+    """reference: paddle.vision.ops.decode_jpeg (nvjpeg-backed there) —
+    decodes a uint8 byte tensor to CHW uint8 via the host image backend
+    (PIL)."""
+    import io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(x.value if isinstance(x, Tensor) else x,
+                           dtype=np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(arr.copy())

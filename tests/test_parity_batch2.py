@@ -1,0 +1,325 @@
+"""Behavior tests for the second parity batch: vision transforms/datasets/
+ops, text datasets, distributed tail (split/new_group/entries/spawn/data
+generators/role makers), regularizer, device/sysconfig/hub/incubate,
+inference tail.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+# -- vision.transforms --------------------------------------------------------
+
+def test_functional_flips_crops_pad():
+    import paddle_tpu.vision.transforms as T
+    img = (np.random.default_rng(0).random((8, 10, 3)) * 255).astype(
+        "uint8")
+    assert np.array_equal(T.hflip(T.hflip(img)), img)
+    assert np.array_equal(T.vflip(T.vflip(img)), img)
+    assert T.crop(img, 1, 2, 3, 4).shape == (3, 4, 3)
+    assert T.center_crop(img, 4).shape == (4, 4, 3)
+    assert T.pad(img, 2).shape == (12, 14, 3)
+    assert T.pad(img, (1, 2, 3, 4)).shape == (8 + 2 + 4, 10 + 1 + 3, 3)
+    # short-edge resize from an int size
+    assert T.resize(img, 4).shape == (4, 5, 3)
+
+
+def test_functional_rotate():
+    import paddle_tpu.vision.transforms as T
+    img = np.zeros((6, 6), "float32")
+    img[0, :] = 1.0  # top row
+    r = T.rotate(img, 90)  # counter-clockwise: top row -> left column
+    assert r.shape == (6, 6)
+    assert r[:, 0].sum() > r[:, -1].sum()
+    e = T.rotate(np.ones((4, 8), "float32"), 90, expand=True)
+    assert e.shape == (8, 4)
+
+
+def test_functional_color_adjust():
+    import paddle_tpu.vision.transforms as T
+    img = (np.random.default_rng(1).random((6, 6, 3)) * 255).astype(
+        "uint8")
+    assert np.array_equal(T.adjust_brightness(img, 1.0), img)
+    dark = T.adjust_brightness(img, 0.5)
+    assert dark.mean() < img.mean()
+    # hue round-trip at zero shift (within uint8 rounding)
+    h0 = T.adjust_hue(img, 0.0)
+    assert np.abs(h0.astype(int) - img.astype(int)).max() <= 1
+    assert np.abs(T.adjust_hue(img, 0.3).astype(int) -
+                  img.astype(int)).max() > 2
+    g = T.to_grayscale(img)
+    assert g.shape == (6, 6, 1)
+    assert T.to_grayscale(img, 3).shape == (6, 6, 3)
+    c = T.adjust_contrast(img, 1.5)
+    assert c.shape == img.shape
+
+
+def test_transform_classes():
+    import paddle_tpu.vision.transforms as T
+    img = (np.random.default_rng(2).random((16, 16, 3)) * 255).astype(
+        "uint8")
+    assert T.ColorJitter(0.2, 0.2, 0.2, 0.1)(img).shape == img.shape
+    assert T.Grayscale(3)(img).shape == (16, 16, 3)
+    assert T.Pad(2)(img).shape == (20, 20, 3)
+    out = T.RandomResizedCrop(8)(img)
+    assert out.shape[:2] == (8, 8)
+    assert T.RandomRotation(10)(img).shape == img.shape
+    with pytest.raises(ValueError):
+        T.HueTransform(0.7)
+
+
+# -- vision datasets / backend / ops -----------------------------------------
+
+@pytest.fixture(scope="module")
+def image_dir(tmp_path_factory):
+    from PIL import Image
+    root = tmp_path_factory.mktemp("imgs")
+    for cls in ("a", "b"):
+        d = root / cls
+        d.mkdir()
+        for i in range(2):
+            arr = (np.random.default_rng(i).random((8, 8, 3)) * 255
+                   ).astype("uint8")
+            Image.fromarray(arr).save(str(d / f"{cls}{i}.png"))
+    return str(root)
+
+
+def test_dataset_folder(image_dir):
+    import paddle_tpu.vision as V
+    df = V.datasets.DatasetFolder(image_dir)
+    assert len(df) == 4 and df.classes == ["a", "b"]
+    img, label = df[0]
+    assert img.shape == (8, 8, 3) and label == 0
+    imf = V.datasets.ImageFolder(image_dir)
+    assert len(imf) == 4 and imf[0][0].shape == (8, 8, 3)
+
+
+def test_flowers_voc_synthetic():
+    import paddle_tpu.vision as V
+    fl = V.datasets.Flowers(mode="test")
+    img, label = fl[1]
+    assert img.shape == (64, 64, 3) and 0 <= int(label) < 102
+    voc = V.datasets.VOC2012(mode="train")
+    img, mask = voc[0]
+    assert img.shape == (96, 96, 3) and mask.shape == (96, 96)
+    assert int(mask.max()) < 21
+
+
+def test_image_backend_and_ops(image_dir):
+    import paddle_tpu.vision as V
+    assert V.get_image_backend() == "pil"
+    with pytest.raises(ValueError):
+        V.set_image_backend("magick")
+    path = os.path.join(image_dir, "a", "a0.png")
+    arr = np.asarray(V.image_load(path))
+    assert arr.shape == (8, 8, 3)
+    raw = V.ops.read_file(path)
+    assert raw.numpy().dtype == np.uint8
+    # decode via PIL handles png too
+    dec = V.ops.decode_jpeg(raw, mode="rgb")
+    assert tuple(dec.shape) == (3, 8, 8)
+
+
+def test_vision_ops_yolo_and_deform():
+    import paddle_tpu.vision.ops as ops
+    rng = np.random.default_rng(3)
+    x = pt.to_tensor(rng.standard_normal((1, 12, 4, 4)).astype("float32"))
+    img_size = pt.to_tensor(np.array([[32, 32]], "int32"))
+    boxes, scores = ops.yolo_box(x, img_size, [10, 13, 16, 30], 1, 0.01,
+                                 8)
+    assert boxes.shape[-1] == 4
+    xc = pt.to_tensor(rng.standard_normal((1, 3, 6, 6)).astype("float32"))
+    offset = pt.to_tensor(np.zeros((1, 2 * 9, 6, 6), "float32"))
+    w = pt.to_tensor(rng.standard_normal((4, 3, 3, 3)).astype("float32"))
+    out = ops.deform_conv2d(xc, offset, w, padding=1)
+    assert tuple(out.shape) == (1, 4, 6, 6)
+
+
+# -- text datasets ------------------------------------------------------------
+
+def test_text_datasets_shapes():
+    import paddle_tpu.text as T
+    uh = T.UCIHousing(mode="train")
+    f, p = uh[0]
+    assert f.shape == (13,) and p.shape == (1,)
+    ng = T.Imikolov(data_type="NGRAM", window_size=5)
+    assert len(ng[0]) == 5
+    sq = T.Imikolov(data_type="SEQ")
+    src, trg = sq[0]
+    assert src.shape == trg.shape
+    ml = T.Movielens()
+    s = ml[0]
+    assert len(s) == 8 and s[-1].dtype == np.float32
+    co = T.Conll05st()
+    wid, pred, mark, labels = co[0]
+    assert wid.shape == mark.shape == labels.shape
+    assert mark[int(pred)] == 1
+    for cls in (T.WMT14, T.WMT16):
+        src, trg_in, trg_next = cls()[0]
+        assert trg_in.shape == trg_next.shape
+        assert trg_in[0] == 2  # <bos>
+
+
+def test_uci_housing_learnable():
+    """The synthetic corpus must be learnable (linear model fits)."""
+    import paddle_tpu.text as T
+    uh = T.UCIHousing(mode="train")
+    X = np.stack([s[0] for s in uh.samples])
+    y = np.stack([s[1] for s in uh.samples])[:, 0]
+    coef, *_ = np.linalg.lstsq(
+        np.concatenate([X, np.ones((len(X), 1), "float32")], axis=1), y,
+        rcond=None)
+    resid = y - np.concatenate(
+        [X, np.ones((len(X), 1), "float32")], axis=1) @ coef
+    assert np.abs(resid).mean() < 0.5
+
+
+# -- distributed tail ---------------------------------------------------------
+
+def test_new_group_wait_entries():
+    import paddle_tpu.distributed as dist
+    g = dist.new_group([0, 1], axis_name="mp")
+    assert g.nranks == 2 and g.get_group_rank(1) == 1
+    assert g.get_group_rank(7) == -1
+    t = pt.to_tensor(np.ones(3, "float32"))
+    dist.wait(t)  # must not raise
+    pe = dist.ProbabilityEntry(1.0)
+    assert pe.admit(0)
+    assert dist.ProbabilityEntry(0.0).admit(0) is False
+    cf = dist.CountFilterEntry(3)
+    assert not cf.admit(2) and cf.admit(3)
+    assert "count_filter" in cf._to_attr()
+    with pytest.raises(ValueError):
+        dist.ProbabilityEntry(1.5)
+
+
+def test_split_linear_and_embedding():
+    import paddle_tpu.distributed as dist
+    x = pt.to_tensor(np.random.default_rng(4).standard_normal(
+        (2, 6)).astype("float32"))
+    out = dist.split(x, (6, 8), operation="linear", axis=1)
+    assert tuple(out.shape) == (2, 8)
+    out = dist.split(x, (6, 8), operation="linear", axis=0)
+    assert tuple(out.shape) == (2, 8)
+    ids = pt.to_tensor(np.array([[1, 2]], "int64"))
+    emb = dist.split(ids, (16, 4), operation="embedding")
+    assert tuple(emb.shape) == (1, 2, 4)
+    with pytest.raises(ValueError):
+        dist.split(x, (6, 8), operation="conv")
+
+
+def test_fleet_class_and_role_makers(monkeypatch):
+    import paddle_tpu.distributed.fleet as fleet
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    rm = fleet.PaddleCloudRoleMaker()
+    assert rm.worker_index() == 2 and rm.worker_num() == 4
+    assert rm.is_worker() and not rm.is_server()
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    assert fleet.PaddleCloudRoleMaker().is_server()
+    udf = fleet.UserDefinedRoleMaker(current_id=1, role=fleet.Role.SERVER,
+                                     worker_num=3,
+                                     server_endpoints=["127.0.0.1:1"])
+    assert udf.is_server() and udf.get_pserver_endpoints()
+    f = fleet.Fleet()
+    assert hasattr(f, "distributed_optimizer")
+    assert fleet.CommunicateTopology is not None
+
+
+def test_multi_slot_data_generator_roundtrip():
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.io.heavy_dataset import parse_slot_line
+
+    class Gen(fleet.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def g():
+                yield [("ids", [1, 2, 3]), ("label", [1])]
+            return g
+
+    out = Gen().run_from_memory(["x"])
+    assert out == ["ids:1 2 3;label:1"]
+    parsed = parse_slot_line(out[0])
+    assert parsed["ids"].tolist() == [1, 2, 3]
+    assert parsed["label"].tolist() == [1]
+
+
+# -- regularizer --------------------------------------------------------------
+
+def test_l1_l2_decay_in_optimizer():
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+    w = pt.to_tensor(np.array([2.0, -2.0], "float32"))
+    w.stop_gradient = False
+    p = pt.Parameter(w.value)
+    # L2: update = lr*(g + coeff*w); with g=0, w shrinks toward 0
+    sgd = opt.SGD(learning_rate=0.1, parameters=[p],
+                  weight_decay=L2Decay(0.5))
+    p.grad = pt.Tensor(np.zeros(2, "float32"))
+    sgd.step()
+    np.testing.assert_allclose(p.numpy(), [1.9, -1.9], rtol=1e-6)
+    # L1: update = lr*coeff*sign(w): equal magnitude shift
+    p2 = pt.Parameter(np.array([2.0, -0.5], "float32"))
+    sgd2 = opt.SGD(learning_rate=0.1, parameters=[p2],
+                   weight_decay=L1Decay(0.5))
+    p2.grad = pt.Tensor(np.zeros(2, "float32"))
+    sgd2.step()
+    np.testing.assert_allclose(p2.numpy(), [1.95, -0.45], rtol=1e-6)
+
+
+# -- small modules ------------------------------------------------------------
+
+def test_device_module():
+    import paddle_tpu.device as device
+    assert device.get_cudnn_version() is None
+    assert not device.is_compiled_with_cuda()
+    assert device.is_compiled_with_tpu()
+    assert device.XPUPlace is not None
+
+
+def test_sysconfig_paths_exist():
+    import paddle_tpu.sysconfig as sysconfig
+    assert os.path.isdir(sysconfig.get_include())
+    assert os.path.isdir(sysconfig.get_lib())
+    assert os.path.exists(os.path.join(sysconfig.get_include(),
+                                       "pt_custom_op.h"))
+
+
+def test_hub_local_repo(tmp_path):
+    import paddle_tpu.hub as hub
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def toy(scale=2):\n"
+        "    'doubles the scale'\n"
+        "    return scale * 2\n")
+    repo = str(tmp_path)
+    assert hub.list(repo, source="local") == ["toy"]
+    assert "doubles" in hub.help(repo, "toy", source="local")
+    assert hub.load(repo, "toy", source="local", scale=5) == 10
+    with pytest.raises(RuntimeError):
+        hub.list("user/repo", source="github")
+
+
+def test_incubate_and_onnx():
+    import paddle_tpu.incubate as incubate
+    assert incubate.LookAhead is not None
+    assert incubate.ModelAverage is not None
+    import jax.numpy as jnp
+    s = incubate.segment_sum(jnp.ones((4, 2)), jnp.array([0, 0, 1, 1]),
+                             num_segments=2)
+    np.testing.assert_allclose(np.asarray(s), [[2, 2], [2, 2]])
+    import paddle_tpu.onnx as onnx
+    with pytest.raises(ImportError):
+        onnx.export(None, "/tmp/x")
+
+
+def test_inference_tail():
+    import paddle_tpu.inference as inf
+    assert inf.get_num_bytes_of_data_type(inf.DataType.FLOAT32) == 4
+    assert inf.get_num_bytes_of_data_type(inf.DataType.BFLOAT16) == 2
+    assert "paddle_tpu" in inf.get_version()
+    assert inf.PlaceType.TPU == 4
+    assert inf.Tensor is not None and inf.PredictorPool is not None
