@@ -47,7 +47,8 @@ def __getattr__(name):
                 "distributed", "metric", "vision", "models", "hapi",
                 "framework", "inference", "autograd", "ops", "profiler",
                 "quantization", "sparsity", "text", "native", "distribution",
-                "utils", "fft", "linalg"):
+                "utils", "fft", "linalg", "regularizer", "device", "hub",
+                "onnx", "incubate", "sysconfig"):
         return importlib.import_module(f".{name}", __name__)
     if name == "ParamAttr":  # lazy: avoids eager-importing all of nn
         from .nn.initializer import ParamAttr as _PA
@@ -61,7 +62,8 @@ def __dir__():
         "nn", "optimizer", "amp", "io", "static", "jit", "distributed",
         "metric", "vision", "models", "hapi", "framework", "inference",
         "autograd", "ops", "quantization", "sparsity", "text", "native",
-        "distribution", "utils", "fft", "linalg"})
+        "distribution", "utils", "fft", "linalg", "regularizer", "device",
+        "hub", "onnx", "incubate", "sysconfig"})
 
 
 def Model(*args, **kwargs):
@@ -173,3 +175,37 @@ unsqueeze_ = _inplace_top("unsqueeze_")
 scatter_ = _inplace_top("scatter_")
 tanh_ = _inplace_top("tanh_")
 del _inplace_top
+
+
+def _with_out_param(name, unary):
+    base = _dispatch.wrapped_ops[name]
+
+    def _finish(res, out):
+        if out is None:
+            return res
+        if not hasattr(out, "_inplace_assign"):
+            raise TypeError(
+                f"{name}: out= must be a paddle Tensor, got "
+                f"{type(out).__name__}")
+        return out._inplace_assign(res)
+
+    if unary:
+        def f(x, out=None, name=None):
+            return _finish(base(x), out)
+    else:
+        def f(x, y, out=None, name=None):
+            return _finish(base(x, y), out)
+    f.__name__ = name
+    f.__doc__ = (base.__doc__ or "") + \
+        "\n\nAccepts the reference's ``out=`` tensor (written in place)."
+    return f
+
+
+# logical/bitwise ops take an optional out= tensor in the reference;
+# the *_not ops are unary with out as the SECOND positional slot
+for _n in ("logical_and", "logical_or", "logical_xor",
+           "bitwise_and", "bitwise_or", "bitwise_xor"):
+    setattr(_sys.modules[__name__], _n, _with_out_param(_n, unary=False))
+for _n in ("logical_not", "bitwise_not"):
+    setattr(_sys.modules[__name__], _n, _with_out_param(_n, unary=True))
+del _n, _with_out_param

@@ -35,14 +35,42 @@ def set_grad_enabled(mode: bool) -> None:
     _tls.grad_enabled = bool(mode)
 
 
-@contextlib.contextmanager
-def no_grad():
-    prev = _tls.grad_enabled
-    _tls.grad_enabled = False
-    try:
-        yield
-    finally:
-        _tls.grad_enabled = prev
+class no_grad:  # noqa: N801 - reference API name
+    """Disable grad recording — usable as a context manager OR a
+    decorator (reference: paddle.no_grad(func) wraps func)."""
+
+    def __init__(self, func=None):
+        self._func = func
+        self._prev = None
+        if func is not None:
+            import functools
+
+            @functools.wraps(func)
+            def wrapper(*args, **kwargs):
+                with no_grad():
+                    return func(*args, **kwargs)
+            self._wrapper = wrapper
+
+    def __new__(cls, func=None):
+        inst = super().__new__(cls)
+        return inst
+
+    def __call__(self, *args, **kwargs):
+        if self._func is not None:
+            return self._wrapper(*args, **kwargs)
+        # @paddle.no_grad() decorator-instance form (reference-valid)
+        if len(args) == 1 and not kwargs and callable(args[0]):
+            return no_grad(args[0])
+        raise TypeError("no_grad() context instance is not callable")
+
+    def __enter__(self):
+        self._prev = _tls.grad_enabled
+        _tls.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self._prev
+        return False
 
 
 @contextlib.contextmanager

@@ -87,9 +87,10 @@ def get_default_dtype() -> str:
     return default_dtype().name
 
 
-def set_default_dtype(dtype: DTypeLike) -> None:
+def set_default_dtype(d: DTypeLike) -> None:
+    """reference: paddle.set_default_dtype(d)."""
     from .flags import set_flags
-    set_flags({"default_dtype": str(jnp.dtype(convert_dtype(dtype)))})
+    set_flags({"default_dtype": str(jnp.dtype(convert_dtype(d)))})
 
 
 def is_floating(dtype: DTypeLike) -> bool:

@@ -70,10 +70,10 @@ def default_generator() -> Generator:
     return _DEFAULT
 
 
-def seed(s: int) -> Generator:
+def seed(seed: int) -> Generator:
     global _seeded
     _seeded = True
-    _DEFAULT.seed(int(s))
+    _DEFAULT.seed(int(seed))
     return _DEFAULT
 
 

@@ -56,7 +56,7 @@ def _axis(group):
 
 
 def all_reduce(tensor, op: str = ReduceOp.SUM, group=None,
-               sync_op: bool = True):
+               sync_op: bool = True, use_calc_stream: bool = True):
     """In-trace: psum/pmax/pmin over the group axis. Eager single-process:
     identity (the process holds the global array)."""
     x = _unwrap(tensor)
@@ -76,27 +76,27 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group=None,
     return x
 
 
-def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
-               axis: int = 0):
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True,
+               use_calc_stream: bool = True, axis: int = 0):
     """In-trace gather along the group axis. Reference signature
     all_gather(tensor_list, tensor) appends per-rank shards to the list;
     the jax-native form returns the concatenated array."""
     if tensor is None:
-        x = _unwrap(tensor_or_list)
+        x = _unwrap(tensor_list)
         if _in_trace():
             out = jax.lax.all_gather(x, _axis(group), axis=axis,
                                      tiled=True)
-            return _rewrap(tensor_or_list, out)
-        return tensor_or_list
+            return _rewrap(tensor_list, out)
+        return tensor_list
     # reference-style (list, tensor) call
     x = _unwrap(tensor)
     if _in_trace():
         out = jax.lax.all_gather(x, _axis(group))
         n = out.shape[0]
-        tensor_or_list.extend(_rewrap(tensor, out[i]) for i in range(n))
+        tensor_list.extend(_rewrap(tensor, out[i]) for i in range(n))
     else:
-        tensor_or_list.append(tensor)
-    return tensor_or_list
+        tensor_list.append(tensor)
+    return tensor_list
 
 
 def reduce_scatter(tensor, op: str = ReduceOp.SUM, group=None,
@@ -109,7 +109,8 @@ def reduce_scatter(tensor, op: str = ReduceOp.SUM, group=None,
     return tensor
 
 
-def broadcast(tensor, src: int = 0, group=None, sync_op=True):
+def broadcast(tensor, src: int = 0, group=None, sync_op=True,
+              use_calc_stream: bool = True):
     x = _unwrap(tensor)
     if _in_trace():
         axis = _axis(group)
@@ -119,12 +120,14 @@ def broadcast(tensor, src: int = 0, group=None, sync_op=True):
     return tensor
 
 
-def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None):
+def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None,
+           use_calc_stream: bool = True):
     # SPMD collectives are symmetric; reduce == all_reduce w.r.t. content
     return all_reduce(tensor, op, group)
 
 
-def scatter(tensor, tensor_list=None, src: int = 0, group=None):
+def scatter(tensor, tensor_list=None, src: int = 0, group=None,
+            use_calc_stream: bool = True):
     if _in_trace():
         axis = _axis(group)
         idx = jax.lax.axis_index(axis)
@@ -136,6 +139,7 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None):
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None,
+             use_calc_stream: bool = True,
              split_axis: int = 0, concat_axis: int = 0):
     """In-trace all_to_all (the exchange primitive behind expert and
     Ulysses sequence parallelism; reference only ships the raw op
@@ -150,7 +154,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None,
     return in_tensor_list
 
 
-def send(tensor, dst: int, group=None):
+def send(tensor, dst: int, group=None, use_calc_stream: bool = True):
     """P2P along the pipeline axis via ppermute (reference send_v2)."""
     x = _unwrap(tensor)
     if _in_trace():
@@ -162,7 +166,7 @@ def send(tensor, dst: int, group=None):
     return tensor
 
 
-def recv(tensor, src: int, group=None):
+def recv(tensor, src: int, group=None, use_calc_stream: bool = True):
     return send(tensor, src, group)
 
 
@@ -184,10 +188,11 @@ def barrier(group=None):
         multihost_utils.sync_global_devices("paddle_tpu_barrier")
 
 
-def get_group(id_or_axis="dp"):
+def get_group(id="dp"):  # noqa: A002 - reference param name
     """reference: paddle.distributed.get_group(id) — retrieve a group
     created by new_group; an axis name returns a fresh handle for that
     mesh axis."""
+    id_or_axis = id
     if isinstance(id_or_axis, int):
         g = _custom_groups.get(id_or_axis)
         if g is None:

@@ -448,6 +448,8 @@ def distributed_jit(model: Layer, optimizer, train_fn: Callable,
 
 # -- reference-parity class surface ------------------------------------------
 
+from . import meta_parallel  # noqa: E402,F401
+from . import fleet_utils as utils  # noqa: E402,F401
 from .data_generator import (DataGenerator,  # noqa: E402,F401
                              MultiSlotDataGenerator,
                              MultiSlotStringDataGenerator)

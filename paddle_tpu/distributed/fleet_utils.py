@@ -1,0 +1,20 @@
+"""fleet.utils compatibility namespace (reference:
+python/paddle/distributed/fleet/utils/ — recompute and
+hybrid-parallel gradient helpers)."""
+
+from .parallel import recompute
+from .fleet_util import UtilBase, fleet_util
+
+__all__ = ["recompute", "UtilBase", "fleet_util",
+           "fused_allreduce_gradients"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """reference: fleet/utils/hybrid_parallel_util.py:117
+    fused_allreduce_gradients — dp-group grad sync for eager layers.
+    Under the SPMD train step GSPMD inserts the reductions; this eager
+    helper all-reduces .grad fields over the dp axis when tracing."""
+    from .collective import all_reduce
+    for p in parameter_list:
+        if getattr(p, "grad", None) is not None:
+            p.grad = all_reduce(p.grad, group="dp")

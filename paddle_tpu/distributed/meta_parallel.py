@@ -1,0 +1,32 @@
+"""fleet.meta_parallel compatibility namespace.
+
+Reference parity: python/paddle/distributed/fleet/meta_parallel/ — the
+import path reference hybrid-parallel code uses for TP layers
+(parallel_layers/mp_layers.py), pipeline layers (pp_layers.py), and the
+per-axis RNG tracker (parallel_layers/random.py). Everything re-exported
+here lives in paddle_tpu.distributed.{mp_layers,pp} and core.rng.
+"""
+
+from ..core.rng import (RNGStatesTracker, get_rng_state_tracker)
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .pp import LayerDesc, PipelineLayer, SharedLayerDesc
+
+__all__ = [
+    "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+    "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc",
+    "PipelineLayer", "RNGStatesTracker", "get_rng_state_tracker",
+    "model_parallel_random_seed",
+]
+
+
+def model_parallel_random_seed(seed: int = None) -> None:
+    """reference: meta_parallel.parallel_layers.random.
+    model_parallel_random_seed — reseed the global + per-axis streams."""
+    import paddle_tpu as pt
+    base = seed if seed is not None else 0
+    pt.seed(base)
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("global_seed", base)
+    tracker.add("local_seed", base + 1024)

@@ -157,8 +157,10 @@ class Auc(Metric):
         return area / (tot_pos * tot_neg)
 
 
-def accuracy(input, label, k=1):  # noqa: A002
-    """Functional accuracy (reference: paddle.metric.accuracy)."""
+def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
+    """Functional accuracy (reference: paddle.metric.accuracy). The
+    optional correct/total output tensors are filled in place when
+    given (reference accuracy_op outputs)."""
     import jax.numpy as jnp
     from .. import dispatch
     topk_vals, topk_idx = dispatch.wrapped_ops["topk"](input, k)
@@ -166,5 +168,10 @@ def accuracy(input, label, k=1):  # noqa: A002
     idx = topk_idx.value if isinstance(topk_idx, Tensor) else topk_idx
     if lbl.ndim == 1:
         lbl = lbl[:, None]
-    correct = (idx == lbl).any(axis=-1)
-    return Tensor(jnp.mean(correct.astype(jnp.float32)))
+    hit = (idx == lbl).any(axis=-1)
+    n_correct = hit.astype(jnp.int64).sum()
+    if correct is not None and hasattr(correct, "_inplace_assign"):
+        correct._inplace_assign(Tensor(n_correct))
+    if total is not None and hasattr(total, "_inplace_assign"):
+        total._inplace_assign(Tensor(jnp.asarray(hit.size)))
+    return Tensor(jnp.mean(hit.astype(jnp.float32)))

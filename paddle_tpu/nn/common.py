@@ -143,25 +143,29 @@ class Pad2D(Layer):
 
 class Upsample(Layer):
     def __init__(self, size=None, scale_factor=None, mode="nearest",
-                 align_corners=False, data_format="NCHW"):
+                 align_corners=False, align_mode=0, data_format="NCHW"):
         super().__init__()
         self.size, self.scale_factor = size, scale_factor
         self.mode, self.align_corners = mode, align_corners
+        self.align_mode = align_mode
         self.data_format = data_format
 
     def forward(self, x):
         return F["interpolate"](x, self.size, self.scale_factor, self.mode,
-                                self.align_corners, self.data_format)
+                                self.align_corners, self.align_mode,
+                                self.data_format)
 
 
 class UpsamplingNearest2D(Upsample):
     def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
-        super().__init__(size, scale_factor, "nearest", False, data_format)
+        super().__init__(size, scale_factor, "nearest",
+                         align_corners=False, data_format=data_format)
 
 
 class UpsamplingBilinear2D(Upsample):
     def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
-        super().__init__(size, scale_factor, "bilinear", True, data_format)
+        super().__init__(size, scale_factor, "bilinear",
+                         align_corners=True, data_format=data_format)
 
 
 class PixelShuffle(Layer):

@@ -55,7 +55,31 @@ class _BatchNormBase(Layer):
 
 
 class BatchNorm(_BatchNormBase):
-    pass
+    """Fluid-era BatchNorm signature (reference: fluid/dygraph/nn.py
+    BatchNorm(num_channels, act, is_test, momentum, epsilon, param_attr,
+    bias_attr, dtype, data_layout, ...)); the 2.0-style BatchNorm1D/2D/3D
+    subclasses keep the modern signature."""
+
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__(num_channels, momentum=momentum, epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr,
+                         data_format=data_layout,
+                         use_global_stats=use_global_stats or None)
+        self._act = act
+        if is_test:
+            self.eval()
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            from .. import dispatch
+            out = dispatch.apply(self._act, out)
+        return out
 
 
 class BatchNorm1D(_BatchNormBase):

@@ -376,7 +376,7 @@ def _hsigmoid_simple_code(num_classes: int):
 
 
 def hsigmoid_loss(input, label, weight, bias=None, num_classes=None,  # noqa: A002
-                  path_table=None, path_code=None):
+                  path_table=None, path_code=None, is_sparse=False):
     """Hierarchical sigmoid loss (hierarchical_sigmoid_op.cc).
 
     Default tree = complete binary tree over num_classes (SimpleCode);

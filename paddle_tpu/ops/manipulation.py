@@ -27,7 +27,8 @@ def transpose(x, perm):
     return jnp.transpose(x, perm)
 
 
-def t(x):
+def t(input):  # noqa: A002
+    x = input
     return jnp.transpose(x)
 
 
@@ -43,7 +44,8 @@ def concat(xs, axis=0):
     return jnp.concatenate(list(xs), axis=axis)
 
 
-def stack(xs, axis=0):
+def stack(x, axis=0):
+    xs = x
     return jnp.stack(list(xs), axis=axis)
 
 
@@ -52,7 +54,9 @@ def unstack(x, axis=0, num=None):
     return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]
 
 
-unbind = unstack
+def unbind(input, axis=0):  # noqa: A002 - reference name
+    """reference: paddle.unbind(input, axis)."""
+    return unstack(input, axis=axis)
 
 
 def split(x, num_or_sections, axis=0):
@@ -229,7 +233,8 @@ def index_put(x, indices, value, accumulate=False):
     return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
 
 
-def slice(x, axes, starts, ends):  # noqa: A001
+def slice(input, axes, starts, ends):  # noqa: A001,A002
+    x = input
     """Static slice (reference slice_op)."""
     idx = [_slice(None)] * x.ndim
     for ax, s, e in zip(axes, starts, ends):
@@ -269,13 +274,17 @@ def masked_fill(x, mask, value):
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
-           axis=None):
-    """Dynamic-shape op: eager-only."""
+           axis=None, dtype="int64"):
+    """Dynamic-shape op: eager-only. ``dtype`` sets the index-output
+    dtype (reference: paddle.unique dtype arg)."""
     res = np.unique(np.asarray(x), return_index=return_index,
                     return_inverse=return_inverse,
                     return_counts=return_counts, axis=axis)
     if isinstance(res, tuple):
-        return tuple(jnp.asarray(r) for r in res)
+        idx_dt = np.dtype(dtype) if str(dtype) != "int64" else np.int64
+        return tuple(jnp.asarray(
+            r.astype(idx_dt) if i > 0 and r.dtype.kind in "iu" else r)
+            for i, r in enumerate(res))
     return jnp.asarray(res)
 
 
@@ -313,7 +322,9 @@ def numel(x):
     return jnp.asarray(x.size, dtype=jnp.int32)
 
 
-def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+def shard_index(input, index_num, nshards, shard_id,  # noqa: A002
+                ignore_value=-1):
+    x = input
     """Map global ids to shard-local ids (reference shard_index_op, used by
     sharded embedding)."""
     shard_size = (index_num + nshards - 1) // nshards

@@ -201,8 +201,8 @@ def round(x):  # noqa: A001
     return jnp.round(x)
 
 
-def trunc(x):
-    return jnp.trunc(x)
+def trunc(input):  # noqa: A002 - reference name
+    return jnp.trunc(input)
 
 
 def frac(x):
@@ -253,10 +253,12 @@ def clip(x, min=None, max=None):  # noqa: A002
     return jnp.clip(x, min, max)
 
 
-def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
-    if bias_after_scale:
-        return x * scale + bias
-    return (x + bias) * scale
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    if act is not None:
+        import jax.nn as _jnn
+        out = getattr(_jnn, act, getattr(jnp, act, None))(out)
+    return out
 
 
 def stanh(x, scale_a=0.67, scale_b=1.7159):
@@ -511,8 +513,8 @@ def dot(x, y):
     return jnp.sum(x * y, axis=-1)
 
 
-def mm(x, y):
-    return jnp.matmul(x, y)
+def mm(input, mat2):  # noqa: A002 - reference names
+    return jnp.matmul(input, mat2)
 
 
 def bmm(x, y):
@@ -555,12 +557,12 @@ def diff(x, n=1, axis=-1):
     return jnp.diff(x, n=n, axis=axis)
 
 
-def histogram(x, bins=100, min=0, max=0):  # noqa: A002
+def histogram(input, bins=100, min=0, max=0):  # noqa: A002
     if min == 0 and max == 0:
         rng = None
     else:
         rng = (min, max)
-    hist, _ = jnp.histogram(x, bins=bins, range=rng)
+    hist, _ = jnp.histogram(input, bins=bins, range=rng)
     return hist
 
 

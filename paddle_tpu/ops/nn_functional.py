@@ -328,11 +328,44 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     return out
 
 
+def _out_padding_from_size(in_sp, output_size, stride, padding,
+                           dilation, ksp, nsp):
+    """Derive output_padding from a requested output_size (reference
+    conv_transpose output_size arg). Valid range per dim: [0, stride)."""
+    st = _norm_tuple(stride, nsp)
+    dl = _norm_tuple(dilation, nsp)
+    osz = _norm_tuple(output_size, nsp)
+    op = []
+    for i in range(nsp):
+        if isinstance(padding, str):
+            # SAME: base out = in*stride; VALID: zero padding
+            if padding.upper() == "SAME":
+                base = in_sp[i] * st[i]
+            else:
+                base = (in_sp[i] - 1) * st[i] + dl[i] * (ksp[i] - 1) + 1
+        else:
+            pd = _norm_tuple(padding, nsp)
+            base = (in_sp[i] - 1) * st[i] - 2 * pd[i] + \
+                dl[i] * (ksp[i] - 1) + 1
+        op.append(int(osz[i]) - base)
+    if any(o < 0 or o >= st[i] for i, o in enumerate(op)):
+        raise ValueError(
+            f"output_size {tuple(int(o) for o in osz)} unreachable from "
+            f"input {tuple(in_sp)}: derived output_padding {op} must be "
+            f"in [0, stride) per dim (stride {st})")
+    return tuple(op)
+
+
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
-                     data_format="NCHW"):
+                     output_size=None, data_format="NCHW"):
     """Transposed conv via gradient-of-conv (reference conv2d_transpose_op).
     weight layout matches the reference: [in, out//groups, kh, kw]."""
+    if output_size is not None:
+        sp = x.shape[1:3] if data_format == "NHWC" else x.shape[2:4]
+        output_padding = _out_padding_from_size(
+            sp, output_size, stride, padding, dilation, weight.shape[2:4],
+            2)
     channel_last = data_format == "NHWC"
     nsp = 2
     strides = _norm_tuple(stride, nsp)
@@ -367,7 +400,12 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
-                     data_format="NCL"):
+                     output_size=None, data_format="NCL"):
+    if output_size is not None:
+        sp = (x.shape[1],) if data_format == "NLC" else (x.shape[2],)
+        output_padding = _out_padding_from_size(
+            sp, output_size, stride, padding, dilation,
+            (weight.shape[2],), 1)[0]
     x4 = jnp.expand_dims(x, -1 if data_format == "NCL" else 2)
     w4 = jnp.expand_dims(weight, -1)
     out = conv2d_transpose(
@@ -575,9 +613,21 @@ def adaptive_avg_pool1d(x, output_size):
     return jnp.squeeze(adaptive_avg_pool2d(x4, (output_size, 1)), -1)
 
 
-def adaptive_max_pool1d(x, output_size):
+def adaptive_max_pool1d(x, output_size, return_mask=False):
     x4 = jnp.expand_dims(x, -1)
-    return jnp.squeeze(adaptive_max_pool2d(x4, (output_size, 1)), -1)
+    out = jnp.squeeze(adaptive_max_pool2d(x4, (output_size, 1)), -1)
+    if return_mask:
+        # divisible case: argmax within each window, offset to input index
+        n, c, l = x.shape
+        o = int(output_size)
+        if l % o == 0:
+            k = l // o
+            win = x.reshape(n, c, o, k)
+            idx = jnp.argmax(win, axis=-1) + jnp.arange(o)[None, None] * k
+            return out, idx.astype(jnp.int64)
+        raise NotImplementedError(
+            "return_mask needs input length divisible by output_size")
+    return out
 
 
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
@@ -815,7 +865,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, axis=-1,
-                               return_softmax=False):
+                               return_softmax=False,
+                               numeric_stable_mode=True):
+    # numeric_stable_mode accepted for reference parity: the log-softmax
+    # formulation here is always the stable path
     sm = jax.nn.softmax(logits, axis=axis)
     loss = cross_entropy(logits, label, soft_label=soft_label,
                          ignore_index=ignore_index, reduction="none",
@@ -955,7 +1008,7 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
 # --------------------------------------------------------------------------
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
-                align_corners=False, data_format="NCHW"):
+                align_corners=False, align_mode=0, data_format="NCHW"):
     channel_last = data_format in ("NHWC", "NWC", "NDHWC")
     nsp = x.ndim - 2
     sp_axes = tuple(range(1, 1 + nsp)) if channel_last else \
@@ -980,13 +1033,30 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         for a, ix in zip(sp_axes, idx):
             out = jnp.take(out, ix, axis=a)
         return out
+    if align_mode == 1 and method == "linear" and not align_corners:
+        # reference align_mode=1: asymmetric src = dst/scale (the default
+        # jax.image.resize linear path is the align_mode=0 half-pixel
+        # map). Manual per-axis lerp with edge-clamped gathers — the
+        # reference clamps at the boundary, scale_and_translate zero-pads.
+        out = x
+        for a, (osz, isz) in zip(sp_axes, zip(size, in_size)):
+            src = jnp.arange(osz) * (isz / osz)
+            i0 = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, isz - 1)
+            i1 = jnp.clip(i0 + 1, 0, isz - 1)
+            frac = (src - i0).astype(out.dtype)
+            shape = [1] * out.ndim
+            shape[a] = osz
+            frac = frac.reshape(shape)
+            out = (jnp.take(out, i0, axis=a) * (1 - frac) +
+                   jnp.take(out, i1, axis=a) * frac)
+        return out
     return jax.image.resize(x, new_shape, method=method)
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest",
-             align_corners=False, data_format="NCHW"):
+             align_corners=False, align_mode=0, data_format="NCHW"):
     return interpolate(x, size, scale_factor, mode, align_corners,
-                       data_format)
+                       align_mode, data_format)
 
 
 def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
@@ -1085,7 +1155,11 @@ def affine_grid(theta, out_shape, align_corners=True):
     return jnp.einsum("nij,hwj->nhwi", theta, base)
 
 
-def temporal_shift(x, seg_num, shift_ratio=0.25):
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    if data_format == "NHWC":
+        out = temporal_shift(jnp.transpose(x, (0, 3, 1, 2)), seg_num,
+                             shift_ratio)
+        return jnp.transpose(out, (0, 2, 3, 1))
     n, c, h, w = x.shape
     nt = n // seg_num
     x5 = x.reshape(nt, seg_num, c, h, w)
@@ -1110,7 +1184,8 @@ def channel_shuffle(x, groups, data_format="NCHW"):
     return x.reshape(n, h, w, c)
 
 
-def sequence_mask(lengths, maxlen=None, dtype="int64"):
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    lengths = x  # reference name: sequence_mask(x, maxlen, dtype)
     maxlen = int(maxlen) if maxlen is not None else None
     if maxlen is None:
         raise ValueError(

@@ -18,7 +18,13 @@ def _key(key):
     return key if key is not None else next_key()
 
 
-def uniform(shape, dtype=None, min=-1.0, max=1.0, key=None):  # noqa: A002
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0,  # noqa: A002
+            key=None):
+    # reference: uniform(shape, dtype, min, max, seed); a nonzero int
+    # seed pins the draw (key= stays the explicit functional override)
+    if isinstance(seed, int) and seed and key is None:
+        import jax as _jax
+        key = _jax.random.PRNGKey(seed)
     dtype = convert_dtype(dtype) if dtype else default_dtype()
     return jax.random.uniform(_key(key), tuple(shape), dtype=dtype,
                               minval=min, maxval=max)
@@ -35,7 +41,7 @@ def randn(shape, dtype=None, key=None):
 
 
 def rand(shape, dtype=None, key=None):
-    return uniform(shape, dtype, 0.0, 1.0, key)
+    return uniform(shape, dtype, 0.0, 1.0, key=key)
 
 
 def randint(low=0, high=None, shape=(1,), dtype="int64", key=None):
@@ -92,7 +98,7 @@ def normal_like(x, mean=0.0, std=1.0, key=None):
 
 
 def uniform_like(x, min=-1.0, max=1.0, key=None):  # noqa: A002
-    return uniform(x.shape, x.dtype, min, max, key)
+    return uniform(x.shape, x.dtype, min, max, key=key)
 
 
 def rand_like(x, key=None):

@@ -407,9 +407,15 @@ def deformable_conv(x, offset, weight, mask=None, bias=None, stride=1,
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
-                     data_format="NCDHW"):
+                     output_size=None, data_format="NCDHW"):
     """3D transposed convolution (conv_transpose_op.cc conv3d_transpose):
     x [N, C, D, H, W], weight [Cin, Cout/g, kd, kh, kw]."""
+    if output_size is not None:
+        from .nn_functional import _out_padding_from_size
+        sp = x.shape[1:4] if data_format == "NDHWC" else x.shape[2:5]
+        output_padding = _out_padding_from_size(
+            sp, output_size, stride, padding, dilation, weight.shape[2:5],
+            3)
     if groups != 1:
         raise NotImplementedError("conv3d_transpose groups>1")
     s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)

@@ -246,8 +246,8 @@ def name_scope(prefix: Optional[str] = None):
 
 def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
           print_tensor_name=True, print_tensor_type=True,
-          print_tensor_shape=True, print_tensor_lod=False,
-          print_phase="both"):
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=False, print_phase="both"):
     """reference: paddle.static.Print (fluid/layers/control_flow.py) —
     identity that prints the value, trace-safe via jax.debug.print."""
     from jax._src import core as _jax_core
@@ -358,9 +358,10 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
 # -- metrics ------------------------------------------------------------------
 
 def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
-    """reference: paddle.static.accuracy (fluid/layers/metric_op.py)."""
+    """reference: paddle.static.accuracy (fluid/layers/metric_op.py);
+    correct/total output vars are accepted and filled when given."""
     from ..metric import accuracy as _acc
-    return _acc(input, label, k=k)
+    return _acc(input, label, k=k, correct=correct, total=total)
 
 
 def auc(input, label, curve="ROC", num_thresholds=4095,  # noqa: A002
@@ -384,8 +385,18 @@ def auc(input, label, curve="ROC", num_thresholds=4095,  # noqa: A002
 
 # -- program (de)serialization ------------------------------------------------
 
-def serialize_program(program: Program) -> bytes:
-    """reference: paddle.static.serialize_program (fluid/io.py)."""
+def serialize_program(feed_vars=None, fetch_vars=None,
+                      program: Program = None) -> bytes:
+    """reference: paddle.static.serialize_program(feed_vars, fetch_vars)
+    (static/io.py). Trace-based programs are self-contained, so the
+    program itself is accepted (positionally or via ``program=``) and
+    feed/fetch pruning is already done by the trace."""
+    if program is None and isinstance(feed_vars, Program):
+        program = feed_vars
+    if not isinstance(program, Program):
+        raise InvalidArgumentError(
+            "serialize_program needs a Program (pass it positionally or "
+            "as program=...)")
     meta = {"input_specs": [(s.shape, str(s.dtype), s.name)
                             for s in program.input_specs],
             "name": program.name}
@@ -441,16 +452,18 @@ def normalize_program(program: Program, feed_vars=None, fetch_vars=None):
     return program
 
 
-def save(program: Program, path_prefix: str) -> None:
-    """reference: paddle.static.save (fluid/io.py save) — persist params
-    (+ a .pdmodel next to them)."""
-    program.save(path_prefix)
+def save(program: Program, model_path: str, protocol: int = 4,
+         **configs) -> None:
+    """reference: paddle.static.save(program, model_path)
+    (fluid/io.py:1840) — persist params (+ a .pdmodel next to them)."""
+    program.save(model_path)
 
 
-def load(program: Program, path_prefix: str, executor=None,
+def load(program: Program, model_path: str, executor=None,
          var_list=None) -> None:
-    """reference: paddle.static.load — restore params into program."""
-    with open(path_prefix + ".pdiparams", "rb") as f:
+    """reference: paddle.static.load(program, model_path)
+    (fluid/io.py:1948) — restore params into program."""
+    with open(model_path + ".pdiparams", "rb") as f:
         params = pickle.load(f)
     program.params = {k: jnp.asarray(v) for k, v in params.items()}
 

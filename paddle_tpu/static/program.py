@@ -198,7 +198,7 @@ def program_guard(main_program=None, startup_program=None):
         _default_program = prev
 
 
-def data(name: str, shape, dtype="float32"):
+def data(name: str, shape, dtype="float32", lod_level=0):
     """Symbolic placeholder (reference: paddle.static.data) — returns an
     InputSpec consumed by build_program."""
     return InputSpec(shape, dtype, name)
@@ -207,8 +207,8 @@ def data(name: str, shape, dtype="float32"):
 class CompiledProgram:
     """Reference-API shim: compilation happens at Program build."""
 
-    def __init__(self, program, build_strategy=None):
-        self.program = program
+    def __init__(self, program_or_graph, build_strategy=None):
+        self.program = program_or_graph
 
 
 class Executor:

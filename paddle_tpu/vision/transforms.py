@@ -20,6 +20,10 @@ class Compose:
 class ToTensor:
     """HWC uint8 -> CHW float32/255 (no-op on already-CHW float)."""
 
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+        self.keys = keys
+
     def __call__(self, img):
         img = np.asarray(img)
         if img.ndim == 2:
@@ -29,11 +33,16 @@ class ToTensor:
             img = np.transpose(img, (2, 0, 1))
         if img.dtype == np.uint8:
             img = img.astype(np.float32) / 255.0
-        return img.astype(np.float32)
+        out = img.astype(np.float32)
+        if getattr(self, "data_format", "CHW") == "HWC":
+            out = np.transpose(out, (1, 2, 0))
+        return out
 
 
 class Normalize:
-    def __init__(self, mean, std, data_format="CHW", to_rgb=False):
+    def __init__(self, mean, std, data_format="CHW", to_rgb=False,
+                 keys=None):
+        self.keys = keys
         self.mean = np.asarray(mean, dtype=np.float32)
         self.std = np.asarray(std, dtype=np.float32)
         self.data_format = data_format
@@ -45,7 +54,8 @@ class Normalize:
 
 
 class Resize:
-    def __init__(self, size, interpolation="bilinear"):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.keys = keys
         self.size = size if isinstance(size, (list, tuple)) else (size, size)
 
     def __call__(self, img):
@@ -61,7 +71,8 @@ class Resize:
 
 
 class CenterCrop:
-    def __init__(self, size):
+    def __init__(self, size, keys=None):
+        self.keys = keys
         self.size = size if isinstance(size, (list, tuple)) else (size, size)
 
     def __call__(self, img):
@@ -78,20 +89,35 @@ class CenterCrop:
 
 
 class RandomCrop:
-    def __init__(self, size, padding=None):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
         self.size = size if isinstance(size, (list, tuple)) else (size, size)
         self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+        self.keys = keys
 
     def __call__(self, img):
         img = np.asarray(img)
         chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
         h_ax, w_ax = (1, 2) if chw else (0, 1)
+        mode = {"constant": "constant", "edge": "edge",
+                "reflect": "reflect", "symmetric": "symmetric"}[
+            self.padding_mode]
+        kw = {"constant_values": self.fill} if mode == "constant" else {}
         if self.padding:
             pad = [(0, 0)] * img.ndim
             pad[h_ax] = (self.padding, self.padding)
             pad[w_ax] = (self.padding, self.padding)
-            img = np.pad(img, pad, mode="constant")
+            img = np.pad(img, pad, mode=mode, **kw)
         th, tw = self.size
+        if self.pad_if_needed and (img.shape[h_ax] < th or
+                                   img.shape[w_ax] < tw):
+            pad = [(0, 0)] * img.ndim
+            pad[h_ax] = (0, max(0, th - img.shape[h_ax]))
+            pad[w_ax] = (0, max(0, tw - img.shape[w_ax]))
+            img = np.pad(img, pad, mode=mode, **kw)
         i = np.random.randint(0, img.shape[h_ax] - th + 1)
         j = np.random.randint(0, img.shape[w_ax] - tw + 1)
         sl = [slice(None)] * img.ndim
@@ -101,8 +127,9 @@ class RandomCrop:
 
 
 class RandomHorizontalFlip:
-    def __init__(self, prob=0.5):
+    def __init__(self, prob=0.5, keys=None):
         self.prob = prob
+        self.keys = keys
 
     def __call__(self, img):
         img = np.asarray(img)
@@ -113,8 +140,9 @@ class RandomHorizontalFlip:
 
 
 class RandomVerticalFlip:
-    def __init__(self, prob=0.5):
+    def __init__(self, prob=0.5, keys=None):
         self.prob = prob
+        self.keys = keys
 
     def __call__(self, img):
         img = np.asarray(img)
@@ -125,8 +153,9 @@ class RandomVerticalFlip:
 
 
 class Transpose:
-    def __init__(self, order=(2, 0, 1)):
+    def __init__(self, order=(2, 0, 1), keys=None):
         self.order = order
+        self.keys = keys
 
     def __call__(self, img):
         return np.transpose(np.asarray(img), self.order)

@@ -323,3 +323,48 @@ def test_inference_tail():
     assert "paddle_tpu" in inf.get_version()
     assert inf.PlaceType.TPU == 4
     assert inf.Tensor is not None and inf.PredictorPool is not None
+
+
+def test_fleet_meta_parallel_namespace():
+    import paddle_tpu.distributed.fleet as fleet
+    mp = fleet.meta_parallel
+    for n in ("VocabParallelEmbedding", "ColumnParallelLinear",
+              "RowParallelLinear", "ParallelCrossEntropy", "LayerDesc",
+              "SharedLayerDesc", "PipelineLayer",
+              "get_rng_state_tracker"):
+        assert hasattr(mp, n), n
+    mp.model_parallel_random_seed(11)
+    tracker = mp.get_rng_state_tracker()
+    with tracker.rng_state("global_seed"):
+        a = pt.randn([2]).numpy()
+    with tracker.rng_state("global_seed"):
+        b = pt.randn([2]).numpy()
+    assert not np.array_equal(a, b)  # stream advances
+    assert hasattr(fleet.utils, "recompute")
+    assert hasattr(fleet.utils, "fused_allreduce_gradients")
+
+
+def test_signature_compat_calls():
+    """Reference-style keyword calls that used to TypeError."""
+    import paddle_tpu.nn.functional as F
+    a = pt.to_tensor(np.array([True, False]))
+    o = pt.to_tensor(np.array([False, False]))
+    assert pt.logical_or(a, a, out=o) is o
+    m = F.sequence_mask(x=pt.to_tensor(np.array([1, 3])), maxlen=4)
+    assert tuple(np.asarray(m.value).shape) == (2, 4)
+    import paddle_tpu.distributed as dist
+    dist.all_reduce(pt.to_tensor(np.ones(2, "float32")),
+                    use_calc_stream=False)
+    w = pt.to_tensor(np.random.default_rng(0).standard_normal(
+        (3, 4, 2, 2)).astype("float32"))
+    x = pt.to_tensor(np.random.default_rng(1).standard_normal(
+        (1, 3, 4, 4)).astype("float32"))
+    out = F.conv2d_transpose(x, w, stride=2, output_size=(8, 8))
+    assert tuple(out.shape)[2:] == (8, 8)
+    correct = pt.to_tensor(np.zeros((), "int64"))
+    total = pt.to_tensor(np.zeros((), "int64"))
+    from paddle_tpu.metric import accuracy
+    accuracy(pt.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]], "float32")),
+             pt.to_tensor(np.array([[0], [1]])), correct=correct,
+             total=total)
+    assert int(correct.numpy()) == 2 and int(total.numpy()) == 2

@@ -86,3 +86,20 @@ def test_all_names_present(ref_mod, rel):
     assert not missing, (
         f"{ref_mod}: {len(missing)}/{len(ref_names)} reference __all__ "
         f"names missing: {missing}")
+
+
+@pytest.mark.skipif(not os.path.isdir(REF),
+                    reason="reference tree not present")
+def test_signature_parity_frozen():
+    """Parameter-name parity for the audited public surface: a param the
+    reference accepts that we don't means reference user code raises
+    TypeError (tools/signature_parity.py)."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        from signature_parity import audit
+    finally:
+        sys.path.pop(0)
+    findings = audit()
+    assert not findings, findings

@@ -1,0 +1,154 @@
+"""Signature-parity audit: for every public callable in the reference's
+__all__, compare its parameter names with ours. A parameter the reference
+accepts but we don't means reference user code raises TypeError.
+
+Usage: python tools/signature_parity.py [module ...]
+"""
+import ast
+import importlib
+import inspect
+import os
+import sys
+
+REF = "/root/reference/python/paddle"
+
+MODS = {
+    "paddle": "__init__.py",
+    "paddle.nn": "nn/__init__.py",
+    "paddle.nn.functional": "nn/functional/__init__.py",
+    "paddle.nn.initializer": "nn/initializer/__init__.py",
+    "paddle.optimizer": "optimizer/__init__.py",
+    "paddle.static": "static/__init__.py",
+    "paddle.io": "io/__init__.py",
+    "paddle.metric": "metric/__init__.py",
+    "paddle.vision.transforms": "vision/transforms/__init__.py",
+    "paddle.vision.models": "vision/models/__init__.py",
+    "paddle.distributed": "distributed/__init__.py",
+}
+
+
+def collect_all(path):
+    names = []
+    try:
+        tree = ast.parse(open(path).read())
+    except Exception:
+        return names
+    for node in ast.walk(tree):
+        v = None
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    v = node.value
+        elif isinstance(node, ast.AugAssign) and \
+                getattr(node.target, "id", None) == "__all__":
+            v = node.value
+        if v is not None:
+            try:
+                names += [n for n in ast.literal_eval(v)
+                          if isinstance(n, str)]
+            except Exception:
+                pass
+    return names
+
+
+def index_defs(root):
+    """name -> arg names, from every def/class __init__ in the ref tree."""
+    defs = {}
+    for dirpath, _, files in os.walk(root):
+        if "tests" in dirpath or "incubate" in dirpath or \
+                "contrib" in dirpath:
+            continue
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, f)
+            try:
+                tree = ast.parse(open(path).read())
+            except Exception:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.FunctionDef):
+                    args = [a.arg for a in node.args.args +
+                            node.args.kwonlyargs]
+                    defs.setdefault(node.name, []).append(args)
+                elif isinstance(node, ast.ClassDef):
+                    for item in node.body:
+                        if isinstance(item, ast.FunctionDef) and \
+                                item.name == "__init__":
+                            args = [a.arg for a in item.args.args +
+                                    item.args.kwonlyargs]
+                            defs.setdefault(node.name, []).append(args)
+    return defs
+
+
+def our_params(obj):
+    try:
+        if inspect.isclass(obj):
+            sig = inspect.signature(obj.__init__)
+        else:
+            sig = inspect.signature(obj)
+    except (TypeError, ValueError):
+        return None, False
+    names = set()
+    has_var_kw = False
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_KEYWORD:
+            has_var_kw = True
+        elif p.kind != inspect.Parameter.VAR_POSITIONAL:
+            names.add(p.name)
+    return names, has_var_kw
+
+
+# Known-clean exceptions:
+# - round/scale's flagged defs are unrelated internal helpers named
+#   round(d)/scale(var) elsewhere in the reference tree; the real tensor
+#   ops match.
+# - static.Variable is the Tensor alias by design (traced world).
+EXCLUDE = {"paddle.round", "paddle.scale", "paddle.static.Variable"}
+
+
+def audit(only=()):
+    """Return [(qualname, missing_param_list)] across the audited mods."""
+    defs = index_defs(REF)
+    findings = []
+    for mod, rel in MODS.items():
+        if only and mod not in only:
+            continue
+        ref_names = collect_all(os.path.join(REF, rel))
+        try:
+            ours_mod = importlib.import_module(
+                mod.replace("paddle", "paddle_tpu", 1))
+        except Exception as e:
+            print(f"{mod}: import error {e}")
+            continue
+        for name in sorted(set(ref_names)):
+            if f"{mod}.{name}" in EXCLUDE or name not in defs:
+                continue
+            obj = getattr(ours_mod, name, None)
+            if obj is None or not callable(obj):
+                continue
+            ours, has_var_kw = our_params(obj)
+            if ours is None or has_var_kw:
+                continue
+            # the most permissive reference overload wins
+            best_missing = None
+            for ref_args in defs[name]:
+                ra = [a for a in ref_args if a not in ("self", "name")]
+                missing = [a for a in ra if a not in ours]
+                if best_missing is None or len(missing) < len(best_missing):
+                    best_missing = missing
+            if best_missing:
+                findings.append((f"{mod}.{name}", best_missing))
+    return findings
+
+
+def main():
+    findings = audit(sys.argv[1:])
+    for qual, missing in findings:
+        print(f"{qual}: missing params {missing}")
+    print("TOTAL MISSING PARAMS:",
+          sum(len(m) for _, m in findings))
+
+
+if __name__ == "__main__":
+    main()
