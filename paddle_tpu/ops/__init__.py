@@ -9,13 +9,18 @@ eager dispatcher can enumerate them.
 import inspect as _inspect
 
 from . import creation, decode_extra, detection, fft, linalg, \
-    loss_extra, manipulation, math, math_extra, nn_functional, random, \
+    loss_extra, manipulation, math, math_extra, metric_extra, \
+    nlp_ctr_extra, nn_functional, random, \
     rnn, search, sequence, vision_extra
 from .registry import OpDef, all_ops, get_op, has_op, register_op
 
 _DYNAMIC_SHAPE_OPS = {
     "nonzero", "masked_select", "unique", "unique_consecutive", "where",
     "sequence_unpad", "bincount",
+    "chunk_eval", "detection_map", "positive_negative_pair",
+    "rpn_target_assign", "distribute_fpn_proposals",
+    "collect_fpn_proposals", "mine_hard_examples", "locality_aware_nms",
+    "filter_by_instag", "tdm_sampler", "similarity_focus",
 }
 _NON_DIFF_OPS = {
     "argmax", "argmin", "argsort", "randint", "randperm", "one_hot",
@@ -24,6 +29,13 @@ _NON_DIFF_OPS = {
     "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "isnan",
     "isinf", "isfinite", "shape", "numel", "count_nonzero",
     "is_empty", "broadcast_shape",
+    "edit_distance", "ctc_align", "mean_iou", "precision_recall",
+    "chunk_eval", "detection_map", "positive_negative_pair",
+    "density_prior_box", "target_assign", "rpn_target_assign",
+    "generate_proposals", "matrix_nms", "distribute_fpn_proposals",
+    "collect_fpn_proposals", "mine_hard_examples", "locality_aware_nms",
+    "polygon_box_transform", "hash_ids", "sampling_id", "tdm_child",
+    "tdm_sampler", "filter_by_instag", "similarity_focus",
     "nms", "multiclass_nms", "bipartite_match",
     "crf_decoding", "gather_tree", "beam_search_decode", "shuffle_batch",
     "digitize", "bitwise_left_shift", "bitwise_right_shift",
@@ -34,7 +46,8 @@ _NON_DIFF_OPS = {
 def _auto_register():
     for mod in (creation, math, manipulation, search, linalg, random,
                 nn_functional, rnn, sequence, detection, loss_extra,
-                vision_extra, decode_extra, math_extra, fft):
+                vision_extra, decode_extra, math_extra, fft,
+                metric_extra, nlp_ctr_extra):
         short = mod.__name__.rsplit(".", 1)[-1]
         for name, fn in vars(mod).items():
             if name.startswith("_") or not callable(fn):

@@ -380,3 +380,329 @@ def bipartite_match(dist):
     _, idx, val = jax.lax.fori_loop(
         0, steps, body, (dist.astype(jnp.float32), idx0, val0))
     return idx, val
+
+
+# --------------------------------------------------------------------------
+# detection training-op tail (reference: operators/detection/ —
+# density_prior_box_op, target_assign_op, rpn_target_assign_op,
+# generate_proposals_op, matrix_nms_op, distribute/collect_fpn_proposals,
+# box_decoder_and_assign_op, mine_hard_examples_op,
+# polygon_box_transform_op, locality_aware_nms)
+# --------------------------------------------------------------------------
+
+def density_prior_box(feature_h, feature_w, image_h, image_w, fixed_sizes,
+                      fixed_ratios=(1.0,), densities=(1,),
+                      variances=(0.1, 0.1, 0.2, 0.2), step_w=0.0,
+                      step_h=0.0, offset=0.5, clip=False,
+                      flatten_to_2d=False):
+    """Density prior boxes (density_prior_box_op.h): per (fixed_size,
+    density) pair, a density x density grid of shifted anchors per ratio.
+    Returns (boxes [fh, fw, P, 4], variances same shape) — or [N, 4] when
+    flatten_to_2d."""
+    sw = step_w or image_w / feature_w
+    sh = step_h or image_h / feature_h
+    cx = (jnp.arange(feature_w) + offset) * sw
+    cy = (jnp.arange(feature_h) + offset) * sh
+    boxes = []
+    for size, dens in zip(fixed_sizes, densities):
+        shift = int(size / dens)
+        for ratio in fixed_ratios:
+            bw = size * float(ratio) ** 0.5
+            bh = size / float(ratio) ** 0.5
+            for dy in range(dens):
+                for dx in range(dens):
+                    ccx = cx[None, :] + (dx + 0.5) * shift - size / 2.0
+                    ccy = cy[:, None] + (dy + 0.5) * shift - size / 2.0
+                    ccx = jnp.broadcast_to(ccx, (feature_h, feature_w))
+                    ccy = jnp.broadcast_to(ccy, (feature_h, feature_w))
+                    boxes.append(jnp.stack(
+                        [(ccx - bw / 2.0) / image_w,
+                         (ccy - bh / 2.0) / image_h,
+                         (ccx + bw / 2.0) / image_w,
+                         (ccy + bh / 2.0) / image_h], axis=-1))
+    out = jnp.stack(boxes, axis=2)  # [fh, fw, P, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, out.dtype), out.shape)
+    if flatten_to_2d:
+        return out.reshape(-1, 4), var.reshape(-1, 4)
+    return out, var
+
+
+def target_assign(x, match_indices, mismatch_value=0.0):
+    """Gather targets by match index with a mismatch fill
+    (target_assign_op.h): out[i, j] = x[match_indices[i, j]] when the
+    index >= 0, else mismatch_value. Returns (out, out_weight)."""
+    x = jnp.asarray(x)
+    mi = jnp.asarray(match_indices)
+    safe = jnp.clip(mi, 0, x.shape[0] - 1)
+    gathered = x[safe]  # [b, np, ...]
+    matched = (mi >= 0)
+    shape = matched.shape + (1,) * (gathered.ndim - matched.ndim)
+    out = jnp.where(matched.reshape(shape), gathered, mismatch_value)
+    return out, matched.astype(x.dtype).reshape(shape)
+
+
+def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_height=None,
+                      im_width=None, rpn_batch_size_per_im=256,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True, seed=0):
+    """Sample RPN training anchors (rpn_target_assign_op.cc), host-side
+    eager: returns (loc_index, score_index, tgt_bbox, tgt_label,
+    bbox_inside_weight) as numpy arrays."""
+    anchors = np.asarray(anchors, np.float32)
+    gts = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
+    n = len(anchors)
+    if len(gts) == 0:
+        labels = np.zeros(n, np.int32)
+    else:
+        ious = np.asarray(iou_similarity(jnp.asarray(anchors),
+                                         jnp.asarray(gts)))
+        best_gt = ious.argmax(1)
+        best_iou = ious.max(1)
+        labels = -np.ones(n, np.int32)
+        labels[best_iou < rpn_negative_overlap] = 0
+        labels[best_iou >= rpn_positive_overlap] = 1
+        # every gt's best anchor is positive (reference rule)
+        labels[ious.argmax(0)] = 1
+    rng = np.random.default_rng(seed)
+    fg_cap = int(rpn_batch_size_per_im * rpn_fg_fraction)
+    fg = np.nonzero(labels == 1)[0]
+    if len(fg) > fg_cap:
+        drop = rng.choice(fg, len(fg) - fg_cap, replace=False) \
+            if use_random else fg[fg_cap:]
+        labels[drop] = -1
+        fg = np.nonzero(labels == 1)[0]
+    bg_cap = rpn_batch_size_per_im - len(fg)
+    bg = np.nonzero(labels == 0)[0]
+    if len(bg) > bg_cap:
+        drop = rng.choice(bg, len(bg) - bg_cap, replace=False) \
+            if use_random else bg[bg_cap:]
+        labels[drop] = -1
+        bg = np.nonzero(labels == 0)[0]
+    loc_index = fg
+    score_index = np.concatenate([fg, bg])
+    if len(gts) and len(fg):
+        enc = np.asarray(box_coder(jnp.asarray(anchors[fg]), None,
+                                   jnp.asarray(gts[best_gt[fg]]),
+                                   code_type="encode"))
+        # box_coder encode is pairwise [T, P, 4]; the per-anchor target
+        # is the (i, i) diagonal
+        tgt = enc[np.arange(len(fg)), np.arange(len(fg))] \
+            if enc.ndim == 3 else enc
+    else:
+        tgt = np.zeros((0, 4), np.float32)
+    tgt_label = labels[score_index].astype(np.int32)
+    inside_w = np.ones_like(tgt, np.float32)
+    return loc_index, score_index, tgt, tgt_label, inside_w
+
+
+def generate_proposals(scores, bbox_deltas, im_shape, anchors,
+                       variances=None, pre_nms_top_n=6000,
+                       post_nms_top_n=1000, nms_thresh=0.5, min_size=0.1,
+                       eta=1.0):
+    """RPN proposal generation (generate_proposals_op.cc), jittable with
+    fixed output size: scores [A], bbox_deltas [A, 4], anchors [A, 4].
+    Returns (rois [post_nms_top_n, 4], roi_scores [post_nms_top_n],
+    valid mask)."""
+    scores = jnp.asarray(scores).reshape(-1)
+    deltas = jnp.asarray(bbox_deltas).reshape(-1, 4)
+    anchors = jnp.asarray(anchors).reshape(-1, 4)
+    k = min(int(pre_nms_top_n), scores.shape[0])
+    top, idx = jax.lax.top_k(scores, k)
+    boxes = box_coder(anchors[idx], variances, deltas[idx],
+                      code_type="decode", box_normalized=False)
+    h, w = im_shape[0], im_shape[1]
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, w - 1),
+                       jnp.clip(boxes[:, 1], 0, h - 1),
+                       jnp.clip(boxes[:, 2], 0, w - 1),
+                       jnp.clip(boxes[:, 3], 0, h - 1)], axis=1)
+    ws = boxes[:, 2] - boxes[:, 0] + 1
+    hs = boxes[:, 3] - boxes[:, 1] + 1
+    keep_size = (ws >= min_size) & (hs >= min_size)
+    cand_scores = jnp.where(keep_size, top, -jnp.inf)
+    sel, valid = nms(boxes, cand_scores, iou_threshold=nms_thresh,
+                     max_out=int(post_nms_top_n))
+    rois = boxes[sel]
+    roi_scores = cand_scores[sel]
+    return rois, roi_scores, valid
+
+
+def matrix_nms(boxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True):
+    """Matrix (soft-decay) NMS (matrix_nms_op.cc), fully vectorized and
+    jittable: boxes [N, 4], scores [C, N]. Returns
+    (out [keep_top_k, 6] rows (label, score, x1, y1, x2, y2), valid)."""
+    boxes = jnp.asarray(boxes)
+    scores = jnp.asarray(scores)
+    c, n = scores.shape
+    outs = []
+    for cls in range(c):
+        if cls == background_label:
+            continue
+        s = scores[cls]
+        k = min(int(nms_top_k), n)
+        top, idx = jax.lax.top_k(s, k)
+        b = boxes[idx]
+        ious = jnp.asarray(iou_similarity(b, b))
+        ious = jnp.triu(ious, k=1)                      # i<j only
+        # reference decay (matrix_nms_op.cc): decay_j = min_{i<j}
+        # f(iou_ij) / f(compensate_i), compensate_i = max_{k<i} iou_ki
+        compensate = ious.max(axis=0)                   # per index i
+        if use_gaussian:
+            dmat = jnp.exp(-(ious ** 2 - compensate[:, None] ** 2) /
+                           gaussian_sigma)
+        else:
+            dmat = (1 - ious) / jnp.maximum(1 - compensate[:, None], 1e-9)
+        # only i<j entries participate; others must not shrink the min
+        tri = jnp.triu(jnp.ones_like(dmat, bool), k=1)
+        decay = jnp.where(tri, dmat, 1.0).min(axis=0)
+        dec_scores = top * decay
+        dec_scores = jnp.where(dec_scores > max(score_threshold,
+                                                post_threshold),
+                               dec_scores, -jnp.inf)
+        outs.append(jnp.concatenate(
+            [jnp.full((k, 1), float(cls)), dec_scores[:, None], b],
+            axis=1))
+    if not outs:  # only the background class present
+        return (jnp.zeros((0, 6), boxes.dtype), jnp.zeros((0,), bool))
+    allc = jnp.concatenate(outs, axis=0)
+    kk = min(int(keep_top_k), allc.shape[0])
+    best, bidx = jax.lax.top_k(allc[:, 1], kk)
+    out = allc[bidx]
+    return out, jnp.isfinite(best)
+
+
+def distribute_fpn_proposals(rois, min_level=2, max_level=5,
+                             refer_level=4, refer_scale=224):
+    """Assign RoIs to FPN levels (distribute_fpn_proposals_op.h):
+    level = floor(refer_level + log2(sqrt(area)/refer_scale)). Host-side
+    eager (per-level counts are dynamic). Returns (rois_per_level list,
+    restore_index)."""
+    r = np.asarray(rois, np.float32)
+    scale = np.sqrt(np.maximum(
+        (r[:, 2] - r[:, 0]) * (r[:, 3] - r[:, 1]), 1e-9))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-9))
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    outs, order = [], []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        outs.append(r[idx])
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros(0, int)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    return outs, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, post_nms_top_n):
+    """Merge per-level proposals by score (collect_fpn_proposals_op.h):
+    returns the top post_nms_top_n rois across levels (host-side)."""
+    rois = np.concatenate([np.asarray(r, np.float32).reshape(-1, 4)
+                           for r in multi_rois], axis=0)
+    scores = np.concatenate([np.asarray(s, np.float32).reshape(-1)
+                             for s in multi_scores], axis=0)
+    order = np.argsort(-scores)[:int(post_nms_top_n)]
+    return rois[order], scores[order]
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip_value=4.135):
+    """Decode per-class box deltas and pick each row's best-scoring class
+    box (box_decoder_and_assign_op.h): target_box [N, C*4],
+    box_score [N, C]. Returns (decoded [N, C*4], assigned [N, 4])."""
+    pb = jnp.asarray(prior_box)
+    tb = jnp.asarray(target_box)
+    bs = jnp.asarray(box_score)
+    n, c4 = tb.shape
+    c = c4 // 4
+    decoded = []
+    for cls in range(c):
+        delta = tb[:, cls * 4:(cls + 1) * 4]
+        # reference clamps dw/dh at box_clip_value before exp
+        delta = jnp.concatenate(
+            [delta[:, :2],
+             jnp.minimum(delta[:, 2:], box_clip_value)], axis=1)
+        d = box_coder(pb, prior_box_var, delta,
+                      code_type="decode", box_normalized=False)
+        decoded.append(d)
+    dec = jnp.stack(decoded, axis=1)            # [N, C, 4]
+    best = jnp.argmax(bs, axis=1)
+    assigned = jnp.take_along_axis(
+        dec, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    return dec.reshape(n, c4), assigned
+
+
+def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio=3.0,
+                       mining_type="max_negative", loc_loss=None,
+                       neg_dist_threshold=0.5, sample_size=None):
+    """OHEM negative mining for SSD (mine_hard_examples_op.cc), host-side:
+    keeps all positives plus the highest-loss negatives up to
+    neg_pos_ratio * n_pos per row (mining_type='max_negative' ranks by
+    cls_loss; 'hard_example' ranks by cls_loss + loc_loss). Returns
+    (match_indices — unchanged, since unmatched priors are already -1 and
+    positives always stay, matching the reference's UpdatedMatchIndices
+    contract — and the per-row selected-negative index lists)."""
+    loss = np.asarray(cls_loss, np.float32)
+    if mining_type == "hard_example" and loc_loss is not None:
+        loss = loss + np.asarray(loc_loss, np.float32)
+    mi = np.asarray(match_indices).copy()
+    neg_sel = []
+    for i in range(mi.shape[0]):
+        pos = mi[i] >= 0
+        n_neg = int(pos.sum() * neg_pos_ratio) if sample_size is None \
+            else int(sample_size)
+        neg_idx = np.nonzero(~pos)[0]
+        order = neg_idx[np.argsort(-loss[i][neg_idx])]
+        keep = set(order[:n_neg].tolist())
+        neg_sel.append(sorted(keep))
+    return mi, neg_sel
+
+
+def polygon_box_transform(x):
+    """EAST geometry head transform (polygon_box_transform_op.cc):
+    channel 2k is offset from the pixel x-coordinate, 2k+1 from y.
+    x [N, C, H, W] -> absolute coordinates."""
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    xs = jnp.arange(w)[None, None, None, :]
+    ys = jnp.arange(h)[None, None, :, None]
+    chan = jnp.arange(c)[None, :, None, None]
+    grid = jnp.where(chan % 2 == 0, xs, ys).astype(x.dtype)
+    return 4.0 * grid - x
+
+
+def locality_aware_nms(boxes, scores, iou_threshold=0.5,
+                       score_threshold=0.0):
+    """Locality-aware NMS for quadrangle/box text detection (EAST
+    postprocess; reference incubate op): weighted-merge consecutive
+    overlapping boxes, then standard NMS. Host-side eager."""
+    b = np.asarray(boxes, np.float32).reshape(-1, 4).copy()
+    s = np.asarray(scores, np.float32).reshape(-1).copy()
+    keep_b, keep_s = [], []
+    for i in range(len(b)):
+        if s[i] < score_threshold:
+            continue
+        if keep_b:
+            last = keep_b[-1]
+            ix1 = max(last[0], b[i][0]); iy1 = max(last[1], b[i][1])
+            ix2 = min(last[2], b[i][2]); iy2 = min(last[3], b[i][3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            ua = ((last[2] - last[0]) * (last[3] - last[1]) +
+                  (b[i][2] - b[i][0]) * (b[i][3] - b[i][1]) - inter)
+            if ua > 0 and inter / ua >= iou_threshold:
+                wsum = keep_s[-1] + s[i]
+                keep_b[-1] = (last * keep_s[-1] + b[i] * s[i]) / wsum
+                keep_s[-1] = wsum
+                continue
+        keep_b.append(b[i])
+        keep_s.append(s[i])
+    if not keep_b:
+        return np.zeros((0, 4), np.float32), np.zeros((0,), np.float32)
+    kb = np.stack(keep_b)
+    ks = np.asarray(keep_s)
+    sel, valid = nms(jnp.asarray(kb), jnp.asarray(ks),
+                     iou_threshold=iou_threshold, max_out=len(kb))
+    sel = np.asarray(sel)[np.asarray(valid)]
+    return kb[sel], ks[sel]

@@ -1,0 +1,295 @@
+"""Metric / evaluation op family.
+
+Reference parity: paddle/fluid/operators/ edit_distance_op, ctc_align_op,
+mean_iou_op, precision_recall_op, chunk_eval_op, detection_map_op,
+positive_negative_pair_op. These run as evaluation ops; the sequential/
+dynamic ones (chunk_eval, detection_map) are host-side eager ops like the
+reference's CPU-only kernels, the dense ones are jittable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def edit_distance(hyps, refs, hyp_lengths=None, ref_lengths=None,
+                  normalized: bool = True):
+    """Levenshtein distance per sequence pair (edit_distance_op.h).
+
+    hyps/refs: [batch, maxlen] int tokens with per-row valid lengths.
+    Returns (distances [batch, 1] float, sequence_num [1]). Jittable:
+    the DP runs over the padded grid with length masking.
+    """
+    hyps = jnp.asarray(hyps)
+    refs = jnp.asarray(refs)
+    b, m = hyps.shape
+    n = refs.shape[1]
+    hl = jnp.asarray(hyp_lengths) if hyp_lengths is not None else \
+        jnp.full((b,), m)
+    rl = jnp.asarray(ref_lengths) if ref_lengths is not None else \
+        jnp.full((b,), n)
+
+    # DP rows over hyp positions; carry = dp row [batch, n+1]
+    row0 = jnp.broadcast_to(jnp.arange(n + 1, dtype=jnp.float32),
+                            (b, n + 1))
+
+    def step(prev, i):
+        # prev: dp[i-1, :]; compute dp[i, :]
+        cost_del = prev + 1.0                         # delete hyp[i-1]
+        sub = (hyps[:, i - 1][:, None] != refs).astype(jnp.float32)
+        cost_sub = prev[:, :-1] + sub                 # substitute
+        first = jnp.full((b, 1), jnp.float32(i))
+
+        def inner(carry, j):
+            # carry: dp[i, j-1]
+            val = jnp.minimum(jnp.minimum(
+                cost_del[:, j], cost_sub[:, j - 1]), carry + 1.0)
+            return val, val
+
+        _, rest = jax.lax.scan(inner, first[:, 0],
+                               jnp.arange(1, n + 1))
+        row = jnp.concatenate([first, rest.T], axis=1)
+        return row, row
+
+    _, stacked = jax.lax.scan(step, row0, jnp.arange(1, m + 1))
+    # dp value at (hl, rl) per row: gather from the right dp row
+    all_rows = jnp.concatenate([row0[None], stacked],
+                               axis=0)  # [m+1, b, n+1]
+    dist = all_rows[hl, jnp.arange(b), rl]
+    if normalized:
+        dist = dist / jnp.maximum(rl.astype(jnp.float32), 1.0)
+    return dist[:, None], jnp.asarray([b])
+
+
+def ctc_align(x, lengths, blank: int = 0, merge_repeated: bool = True):
+    """Collapse CTC paths: merge repeats then drop blanks
+    (ctc_align_op.h). x [batch, maxlen] ints; returns (aligned
+    [batch, maxlen] zero-padded, new_lengths)."""
+    x = jnp.asarray(x)
+    b, m = x.shape
+    valid = jnp.arange(m)[None, :] < jnp.asarray(lengths)[:, None]
+    if merge_repeated:
+        first = jnp.concatenate(
+            [jnp.ones((b, 1), bool), x[:, 1:] != x[:, :-1]], axis=1)
+    else:
+        first = jnp.ones((b, m), bool)
+    keep = valid & first & (x != blank)
+    # stable compaction
+    order = jnp.argsort(jnp.where(keep, 0, 1) * m +
+                        jnp.arange(m)[None, :], axis=1)
+    gathered = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(jnp.arange(m)[None, :] < new_len[:, None], gathered, 0)
+    return out, new_len
+
+
+def mean_iou(predictions, labels, num_classes: int):
+    """Mean intersection-over-union over classes (mean_iou_op.h).
+    Returns (mean_iou scalar, out_wrong [C], out_correct [C])."""
+    p = jnp.asarray(predictions).reshape(-1)
+    l = jnp.asarray(labels).reshape(-1)  # noqa: E741
+    hit = (p == l)
+    correct = jax.ops.segment_sum(hit.astype(jnp.int32), l, num_classes)
+    pred_cnt = jax.ops.segment_sum(jnp.ones_like(p, jnp.int32), p,
+                                   num_classes)
+    label_cnt = jax.ops.segment_sum(jnp.ones_like(l, jnp.int32), l,
+                                    num_classes)
+    union = pred_cnt + label_cnt - correct
+    present = union > 0
+    iou = jnp.where(present, correct / jnp.maximum(union, 1), 0.0)
+    miou = iou.sum() / jnp.maximum(present.sum(), 1)
+    wrong = label_cnt - correct
+    return miou.astype(jnp.float32), wrong, correct
+
+
+def precision_recall(predictions, labels, num_classes: int,
+                     weights=None, states=None):
+    """Multi-class precision/recall/F1 (precision_recall_op.h).
+
+    predictions: [N, C] scores or [N] class ids; labels [N].
+    Returns (batch_metrics [6], accum_metrics [6], accum_states [C, 4])
+    where metrics = (macro-P, macro-R, macro-F1, micro-P, micro-R,
+    micro-F1) and states rows are (TP, FP, TN, FN) per class.
+    """
+    p = jnp.asarray(predictions)
+    if p.ndim == 2:
+        p = jnp.argmax(p, axis=1)
+    l = jnp.asarray(labels).reshape(-1)  # noqa: E741
+    w = jnp.asarray(weights).reshape(-1) if weights is not None else \
+        jnp.ones_like(p, jnp.float32)
+    ids = jnp.arange(num_classes)
+    pred_onehot = (p[:, None] == ids[None, :]).astype(jnp.float32) * \
+        w[:, None]
+    label_onehot = (l[:, None] == ids[None, :]).astype(jnp.float32) * \
+        w[:, None]
+    tp = (pred_onehot * label_onehot).sum(0)
+    fp = (pred_onehot * (1 - label_onehot)).sum(0)
+    fn = ((1 - pred_onehot) * label_onehot).sum(0)
+    tn = w.sum() - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)
+    total = batch_states if states is None else \
+        batch_states + jnp.asarray(states)
+
+    def metrics(st):
+        tp_, fp_, _, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-9),
+                         0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-9),
+                        0.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / jnp.maximum(prec + rec, 1e-9), 0.0)
+        macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+        stp, sfp, sfn = tp_.sum(), fp_.sum(), fn_.sum()
+        mp = stp / jnp.maximum(stp + sfp, 1e-9)
+        mr = stp / jnp.maximum(stp + sfn, 1e-9)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / jnp.maximum(mp + mr,
+                                                              1e-9), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    return metrics(batch_states), metrics(total), total
+
+
+def chunk_eval(inference, label, lengths, chunk_scheme: str = "IOB",
+               num_chunk_types: int = 1, excluded_chunk_types=()):
+    """Chunking precision/recall/F1 over IOB/IOE/IOBES tags
+    (chunk_eval_op.h). Host-side eager op (dynamic chunk counts).
+    Returns (precision, recall, f1, num_infer, num_label, num_correct).
+    """
+    inf = np.asarray(inference)
+    lab = np.asarray(label)
+    lens = np.asarray(lengths).reshape(-1)
+
+    def extract(tags, ln):
+        """Decode chunks [(start, end, type)] from tag ids.
+        Tag layout (reference): tag = type * n_parts + part, where parts
+        follow the scheme order (IOB: B=0, I=1; O = n_types*n_parts)."""
+        parts = {"IOB": 2, "IOE": 2, "IOBES": 4}[chunk_scheme]
+        chunks = []
+        start, ctype = None, None
+        for i in range(ln):
+            t = int(tags[i])
+            if t >= num_chunk_types * parts:  # outside
+                if start is not None:
+                    chunks.append((start, i - 1, ctype))
+                    start = None
+                continue
+            ty, part = divmod(t, parts)
+            begin = part == 0 if chunk_scheme != "IOE" else False
+            if chunk_scheme == "IOBES" and part in (0, 3):
+                begin = True
+            if start is None or begin or ty != ctype:
+                if start is not None:
+                    chunks.append((start, i - 1, ctype))
+                start, ctype = i, ty
+            # end tags close the chunk at this position (IOE: E=1;
+            # IOBES: E=1, S=3)
+            ends = {"IOE": (1,), "IOBES": (1, 3)}.get(chunk_scheme, ())
+            if part in ends and start is not None:
+                chunks.append((start, i, ctype))
+                start = None
+        if start is not None:
+            chunks.append((start, ln - 1, ctype))
+        return {c for c in chunks if c[2] not in excluded_chunk_types}
+
+    n_inf = n_lab = n_cor = 0
+    for row in range(inf.shape[0]):
+        ci = extract(inf[row], int(lens[row]))
+        cl = extract(lab[row], int(lens[row]))
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    prec = n_cor / n_inf if n_inf else 0.0
+    rec = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    return (np.float32(prec), np.float32(rec), np.float32(f1),
+            np.int64(n_inf), np.int64(n_lab), np.int64(n_cor))
+
+
+def detection_map(detections, gt_boxes, gt_labels, class_num: int,
+                  overlap_threshold: float = 0.5,
+                  ap_type: str = "integral"):
+    """Detection mAP (detection_map_op.h), host-side eager.
+
+    detections: [M, 6] rows (label, score, x1, y1, x2, y2);
+    gt_boxes: [G, 4]; gt_labels: [G]. Single-image/accumulated form.
+    """
+    det = np.asarray(detections, np.float32)
+    gtb = np.asarray(gt_boxes, np.float32)
+    gtl = np.asarray(gt_labels).reshape(-1)
+
+    def iou(a, b):
+        ix1 = np.maximum(a[0], b[:, 0])
+        iy1 = np.maximum(a[1], b[:, 1])
+        ix2 = np.minimum(a[2], b[:, 2])
+        iy2 = np.minimum(a[3], b[:, 3])
+        iw = np.maximum(ix2 - ix1, 0)
+        ih = np.maximum(iy2 - iy1, 0)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1]) +
+              (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]) - inter)
+        return inter / np.maximum(ua, 1e-9)
+
+    aps = []
+    for c in range(class_num):
+        gt_c = gtb[gtl == c]
+        det_c = det[det[:, 0] == c]
+        if len(gt_c) == 0:
+            continue
+        order = np.argsort(-det_c[:, 1])
+        det_c = det_c[order]
+        matched = np.zeros(len(gt_c), bool)
+        tp = np.zeros(len(det_c))
+        fp = np.zeros(len(det_c))
+        for i, d in enumerate(det_c):
+            if len(gt_c) == 0:
+                fp[i] = 1
+                continue
+            ious = iou(d[2:6], gt_c)
+            j = int(np.argmax(ious))
+            if ious[j] >= overlap_threshold and not matched[j]:
+                tp[i] = 1
+                matched[j] = True
+            else:
+                fp[i] = 1
+        ctp = np.cumsum(tp)
+        cfp = np.cumsum(fp)
+        rec = ctp / len(gt_c)
+        prec = ctp / np.maximum(ctp + cfp, 1e-9)
+        if ap_type == "11point":
+            ap = np.mean([prec[rec >= t].max() if (rec >= t).any() else 0.0
+                          for t in np.linspace(0, 1, 11)])
+        else:  # integral
+            ap = 0.0
+            prev_r = 0.0
+            for r, p in zip(rec, prec):
+                ap += (r - prev_r) * p
+                prev_r = r
+        aps.append(ap)
+    return np.float32(np.mean(aps) if aps else 0.0)
+
+
+def positive_negative_pair(score, label, query_ids):
+    """Pairwise ranking quality per query (positive_negative_pair_op.h):
+    counts correctly-ordered / wrongly-ordered / neutral pairs.
+    Returns (positive, negative, neutral) float scalars."""
+    s = np.asarray(score).reshape(-1)
+    l = np.asarray(label).reshape(-1)  # noqa: E741
+    q = np.asarray(query_ids).reshape(-1)
+    pos = neg = neu = 0.0
+    for qid in np.unique(q):
+        idx = np.nonzero(q == qid)[0]
+        for a in range(len(idx)):
+            for b in range(a + 1, len(idx)):
+                i, j = idx[a], idx[b]
+                if l[i] == l[j]:
+                    continue
+                hi, lo = (i, j) if l[i] > l[j] else (j, i)
+                if s[hi] > s[lo]:
+                    pos += 1
+                elif s[hi] < s[lo]:
+                    neg += 1
+                else:
+                    neu += 1
+    return (np.float32(pos), np.float32(neg), np.float32(neu))

@@ -1,0 +1,341 @@
+"""NLP-match / CTR / tree-index op family.
+
+Reference parity: paddle/fluid/operators/ sequence_topk_avg_pooling_op,
+match_matrix_tensor_op, var_conv_2d_op, tree_conv_op (math/tree2col),
+pyramid_hash_op, rank_attention_op, filter_by_instag_op, tdm_child_op,
+tdm_sampler_op, hash_op, sampling_id_op, similarity_focus_op,
+pad_constant_like_op, random_crop_op. Dense/jittable where shapes are
+static; instag filtering and tdm sampling are host-side eager like the
+reference's CPU kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rng import next_key
+
+
+def sequence_topk_avg_pooling(x, row_lengths, col_lengths, topks,
+                              channel_num: int):
+    """Top-k average pooling over similarity matrices
+    (sequence_topk_avg_pooling_op.h): x [batch, C, H, W] with per-row
+    valid (row_len, col_len); for each k in topks, the mean of the k
+    largest valid entries per channel row. Returns
+    [batch, H, C * len(topks)]."""
+    x = jnp.asarray(x)
+    b, c, h, w = x.shape
+    rl = jnp.asarray(row_lengths)
+    cl = jnp.asarray(col_lengths)
+    col_valid = jnp.arange(w)[None, None, None, :] < \
+        cl[:, None, None, None]
+    masked = jnp.where(col_valid, x, -jnp.inf)
+    max_k = max(int(k) for k in topks)
+    vals, _ = jax.lax.top_k(masked, min(max_k, w))      # [b, c, h, K]
+    counts = jnp.minimum(cl[:, None, None, None],
+                         jnp.arange(1, vals.shape[-1] + 1)[None, None,
+                                                           None, :])
+    csum = jnp.cumsum(jnp.where(jnp.isfinite(vals), vals, 0), axis=-1)
+    outs = []
+    for k in topks:
+        kk = min(int(k), vals.shape[-1])
+        denom = jnp.maximum(counts[..., kk - 1], 1).astype(x.dtype)
+        outs.append(csum[..., kk - 1] / denom)          # [b, c, h]
+    out = jnp.stack(outs, axis=-1)                      # [b, c, h, K]
+    row_valid = jnp.arange(h)[None, None, :, None] < \
+        rl[:, None, None, None]
+    out = jnp.where(row_valid, out, 0)
+    return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, h, -1)
+
+
+def match_matrix_tensor(x, y, w, x_lengths=None, y_lengths=None,
+                        dim_t: int | None = None):
+    """Semantic matching tensor (match_matrix_tensor_op.h):
+    x [b, lx, dx], y [b, ly, dy], w [dx, dim_t, dy] ->
+    out [b, dim_t, lx, ly] = x_i^T W_t y_j, masked past valid lengths."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    w = jnp.asarray(w)
+    out = jnp.einsum("bid,dte,bje->btij", x, w, y)
+    if x_lengths is not None:
+        mx = jnp.arange(x.shape[1])[None, None, :, None] < \
+            jnp.asarray(x_lengths)[:, None, None, None]
+        out = jnp.where(mx, out, 0)
+    if y_lengths is not None:
+        my = jnp.arange(y.shape[1])[None, None, None, :] < \
+            jnp.asarray(y_lengths)[:, None, None, None]
+        out = jnp.where(my, out, 0)
+    return out
+
+
+def var_conv_2d(x, row_lengths, col_lengths, weight, input_channel: int,
+                output_channel: int, filter_size: int, stride: int = 1):
+    """Variable-size 2-D conv over per-sample valid regions
+    (var_conv_2d_op.h): x [b, C, H, W] padded, weight
+    [out_c, in_c, k, k]; positions outside (row_len, col_len) are zeroed
+    before and after the conv."""
+    from .nn_functional import conv2d
+    x = jnp.asarray(x)
+    b, c, h, w = x.shape
+    rm = jnp.arange(h)[None, None, :, None] < \
+        jnp.asarray(row_lengths)[:, None, None, None]
+    cm = jnp.arange(w)[None, None, None, :] < \
+        jnp.asarray(col_lengths)[:, None, None, None]
+    x = jnp.where(rm & cm, x, 0)
+    out = conv2d(x, jnp.asarray(weight), stride=stride,
+                 padding=filter_size // 2)
+    oh, ow = out.shape[2], out.shape[3]
+    orl = jnp.minimum((jnp.asarray(row_lengths) + stride - 1) // stride,
+                      oh)
+    ocl = jnp.minimum((jnp.asarray(col_lengths) + stride - 1) // stride,
+                      ow)
+    rm = jnp.arange(oh)[None, None, :, None] < orl[:, None, None, None]
+    cm = jnp.arange(ow)[None, None, None, :] < ocl[:, None, None, None]
+    return jnp.where(rm & cm, out, 0)
+
+
+def tree_conv(nodes_vector, edge_set, filter, max_depth: int = 2):  # noqa: A002
+    """Tree-based convolution (tree_conv_op.h, math/tree2col): for each
+    node, combine its <= max_depth-hop neighborhood with three positional
+    weights (top/left/right mix, simplified to the reference's eta
+    parameterization). nodes_vector [b, n, f], edge_set [b, e, 2]
+    parent->child pairs (0-padded), filter [f, 3, out]."""
+    nv = jnp.asarray(nodes_vector)
+    es = jnp.asarray(edge_set)
+    w = jnp.asarray(filter)
+    b, n, f = nv.shape
+    # adjacency (parent->child) as dense [b, n, n]
+    src = es[..., 0]
+    dst = es[..., 1]
+    valid = (src != dst)  # 0-padded rows have src == dst == 0
+
+    def adj_one(s, d, v):
+        a = jnp.zeros((n, n))
+        return a.at[s, d].add(jnp.where(v, 1.0, 0.0))
+
+    adj = jax.vmap(adj_one)(src, dst, valid)
+    feats = [nv]                               # depth 0: self
+    reach = adj
+    for _ in range(max_depth - 1):
+        feats.append(jnp.einsum("bij,bjf->bif", reach, nv))
+        reach = jnp.einsum("bij,bjk->bik", reach, adj)
+    # three positional roles: self, children-aggregate, depth-2 aggregate
+    roles = [feats[0],
+             feats[1] if len(feats) > 1 else jnp.zeros_like(nv),
+             feats[2] if len(feats) > 2 else jnp.zeros_like(nv)]
+    stacked = jnp.stack(roles, axis=2)          # [b, n, 3, f]
+    return jnp.einsum("bnrf,fro->bno", stacked, w)
+
+
+_HASH_PRIME = 0x9E3779B1
+
+
+def hash_ids(x, num_hash: int = 1, mod_by: int = 100000007):
+    """Multiplicative hashing of int ids (hash_op.cc, xxhash there):
+    x [.., 1] -> [.., num_hash] hashed ids in [0, mod_by)."""
+    x = jnp.asarray(x).astype(jnp.uint32)
+    outs = []
+    for i in range(num_hash):
+        h = (x * jnp.uint32(_HASH_PRIME) +
+             jnp.uint32(i * 0x85EBCA77)) ^ (x >> 16)
+        h = h * jnp.uint32(0xC2B2AE3D)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    return jnp.stack(outs, axis=-1)
+
+
+def pyramid_hash(x, lengths, weight, num_emb: int, space_len: int,
+                 pyramid_layer: int = 2, rand_len: int = 16):
+    """Hashed n-gram embeddings (pyramid_hash_op.h): for window sizes
+    2..pyramid_layer+1, hash each valid n-gram of x [b, maxlen] into
+    ``space_len`` buckets of ``weight`` [space_len, rand_len] and sum the
+    (reshaped) embeddings into [b, num_emb]."""
+    x = jnp.asarray(x).astype(jnp.int64)
+    w = jnp.asarray(weight)
+    b, m = x.shape
+    lens = jnp.asarray(lengths)
+    total = jnp.zeros((b, num_emb), w.dtype)
+    reps = num_emb // rand_len
+    for win in range(2, pyramid_layer + 2):
+        if m < win:
+            break
+        # rolling polynomial key per n-gram
+        key = jnp.zeros((b, m - win + 1), jnp.uint32)
+        for j in range(win):
+            key = key * jnp.uint32(131) + \
+                x[:, j:m - win + 1 + j].astype(jnp.uint32)
+        valid = (jnp.arange(m - win + 1)[None, :] + win) <= lens[:, None]
+        emb_rows = []
+        for r in range(reps):
+            idx = ((key * jnp.uint32(_HASH_PRIME) +
+                    jnp.uint32(r)) % jnp.uint32(space_len)).astype(
+                jnp.int32)
+            e = w[idx]                          # [b, g, rand_len]
+            emb_rows.append(jnp.where(valid[..., None], e, 0).sum(axis=1))
+        total = total + jnp.concatenate(emb_rows, axis=-1)
+    return total
+
+
+def rank_attention(x, rank_offset, rank_param, max_rank: int,
+                   max_size: int = 0):
+    """Rank-aware attention for CTR (rank_attention_op.h): each instance
+    selects a parameter block by its rank pair. x [n, d],
+    rank_offset [n, 1 + 2*max_rank] (reference layout: col 0 = ins rank;
+    cols 1,3,... = other ranks, cols 2,4,... = memory indices),
+    rank_param [max_rank * max_rank * d, p]. out [n, p]."""
+    x = jnp.asarray(x)
+    ro = jnp.asarray(rank_offset).astype(jnp.int32)
+    w = jnp.asarray(rank_param)
+    n, d = x.shape
+    p = w.shape[1]
+    wb = w.reshape(max_rank * max_rank, d, p)
+    ins_rank = ro[:, 0]
+
+    def one(xi, rank_i, others):
+        acc = jnp.zeros((p,), x.dtype)
+        cnt = jnp.zeros((), x.dtype)
+        for k in range(max_rank):
+            other = others[2 * k]
+            ok = (rank_i >= 0) & (other >= 0)
+            block = jnp.clip(rank_i * max_rank + other, 0,
+                             max_rank * max_rank - 1)
+            acc = acc + jnp.where(ok, xi @ wb[block], 0.0)
+            cnt = cnt + jnp.where(ok, 1.0, 0.0)
+        return acc / jnp.maximum(cnt, 1.0)
+
+    return jax.vmap(one)(x, ins_rank, ro[:, 1:])
+
+
+def filter_by_instag(ins, ins_tags, filter_tags, is_lod: bool = True,
+                     out_val_if_empty: float = 0.0):
+    """Keep rows whose tag set intersects filter_tags
+    (filter_by_instag_op.h), host-side eager. ins [n, d]; ins_tags a list
+    of per-row tag lists. Returns (filtered rows, kept indices,
+    loss_weight)."""
+    ins = np.asarray(ins)
+    want = set(int(t) for t in np.asarray(filter_tags).reshape(-1))
+    keep = [i for i, tags in enumerate(ins_tags)
+            if want & set(int(t) for t in np.asarray(tags).reshape(-1))]
+    if not keep:
+        out = np.full((1,) + ins.shape[1:], out_val_if_empty,
+                      ins.dtype)
+        return out, np.array([0]), np.zeros((1, 1), np.float32)
+    idx = np.asarray(keep)
+    return ins[idx], idx, np.ones((len(idx), 1), np.float32)
+
+
+def tdm_child(x, tree_info, child_nums: int):
+    """Look up each node's children in a TDM tree table (tdm_child_op.h):
+    tree_info [n_nodes, 3 + child_nums] rows
+    (item_id, layer, parent, child_0..child_k); 0 = no child.
+    Returns (children [.., child_nums], leaf_mask)."""
+    x = jnp.asarray(x).astype(jnp.int32)
+    info = jnp.asarray(tree_info).astype(jnp.int32)
+    children = info[x][..., 3:3 + child_nums]
+    item_ids = info[children][..., 0]
+    is_leaf = (info[children][..., 3:3 + child_nums].sum(-1) == 0) & \
+        (children != 0)
+    return children, jnp.where(children != 0, is_leaf, False).astype(
+        jnp.int32)
+
+
+def tdm_sampler(x, travel_list, layer_node_lists, neg_samples_per_layer,
+                seed: int = 0, output_positive: bool = True):
+    """Per-layer positive+negative sampling along TDM tree paths
+    (tdm_sampler_op.h), host-side eager. x [n] leaf items;
+    travel_list[item] = [node per layer]; layer_node_lists[l] = nodes of
+    layer l. Returns (out [n, sum(counts)], labels same shape)."""
+    rng = np.random.default_rng(seed)
+    xs = np.asarray(x).reshape(-1)
+    outs, labels = [], []
+    for item in xs:
+        row, lab = [], []
+        path = travel_list[int(item)]
+        for layer, neg_k in enumerate(neg_samples_per_layer):
+            pos = path[layer]
+            if output_positive:
+                row.append(pos)
+                lab.append(1)
+            cands = [nd for nd in layer_node_lists[layer] if nd != pos]
+            take = min(neg_k, len(cands))
+            row.extend(rng.choice(cands, take, replace=False).tolist())
+            lab.extend([0] * take)
+        outs.append(row)
+        labels.append(lab)
+    return np.asarray(outs, np.int64), np.asarray(labels, np.int64)
+
+
+def sampling_id(x, seed: int = 0, key=None):
+    """Sample one index per row from probability rows (sampling_id_op.h).
+    x [n, c] probabilities."""
+    x = jnp.asarray(x)
+    k = key if key is not None else (
+        jax.random.PRNGKey(seed) if seed else next_key())
+    return jax.random.categorical(k, jnp.log(jnp.maximum(x, 1e-20)),
+                                  axis=-1)
+
+
+def similarity_focus(x, axis: int, indexes):
+    """Similarity-focus mask (similarity_focus_op.h): for each sample and
+    each selected index along ``axis``, greedily mark one (row, col) max
+    per rank, producing a 0/1 focus mask of x's shape. Host-side eager
+    (data-dependent greedy selection)."""
+    xv = np.asarray(x)
+    n = xv.shape[0]
+    out = np.zeros_like(xv, np.float32)
+    for i in range(n):
+        for idx in indexes:
+            if axis == 1:
+                plane = xv[i, idx]              # [h, w]
+            elif axis == 2:
+                plane = xv[i, :, idx]
+            else:
+                plane = xv[i, :, :, idx]
+            h, w = plane.shape
+            used_r = np.zeros(h, bool)
+            used_c = np.zeros(w, bool)
+            order = np.argsort(-plane.ravel())
+            marked = 0
+            for flat in order:
+                r, cc = divmod(int(flat), w)
+                if used_r[r] or used_c[cc]:
+                    continue
+                used_r[r] = used_c[cc] = True
+                if axis == 1:
+                    out[i, :, r, cc] = 1.0
+                elif axis == 2:
+                    out[i, r, :, cc] = 1.0
+                else:
+                    out[i, r, cc, :] = 1.0
+                marked += 1
+                if marked >= min(h, w):
+                    break
+    return out
+
+
+def pad_constant_like(x, y, pad_value: float = 0.0):
+    """Pad y up to x's shape with a constant (pad_constant_like_op.cc)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    pads = [(0, int(a) - int(b)) for a, b in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, constant_values=pad_value)
+
+
+def random_crop(x, shape, seed: int = 0, key=None):
+    """Random spatial crop to ``shape`` (random_crop_op.h): the leading
+    dims of x are kept, trailing len(shape) dims are cropped."""
+    x = jnp.asarray(x)
+    k = key if key is not None else (
+        jax.random.PRNGKey(seed) if seed else next_key())
+    nd = len(shape)
+    lead = x.ndim - nd
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[lead + i] - int(s)
+        k, sub = jax.random.split(k)
+        starts.append(jax.random.randint(sub, (), 0, limit + 1))
+    out = x
+    for i, (st, s) in enumerate(zip(starts, shape)):
+        out = jax.lax.dynamic_slice_in_dim(out, st, int(s), axis=lead + i)
+    return out

@@ -1,0 +1,364 @@
+"""Metric / detection-training / NLP-CTR op tail families.
+
+Reference parity: operators/ edit_distance_op, ctc_align_op, mean_iou_op,
+precision_recall_op, chunk_eval_op, detection_map_op,
+positive_negative_pair_op, density_prior_box_op, target_assign_op,
+rpn_target_assign_op, generate_proposals_op, matrix_nms_op,
+distribute/collect_fpn_proposals, mine_hard_examples_op,
+polygon_box_transform_op, sequence_topk_avg_pooling_op,
+match_matrix_tensor_op, var_conv_2d_op, tree_conv_op, pyramid_hash_op,
+rank_attention_op, filter_by_instag_op, tdm_child_op, tdm_sampler_op,
+hash_op, sampling_id_op, similarity_focus_op, pad_constant_like_op,
+random_crop_op.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _np_edit_distance(a, b):
+    dp = np.zeros((len(a) + 1, len(b) + 1), int)
+    dp[:, 0] = np.arange(len(a) + 1)
+    dp[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                           dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return dp[-1, -1]
+
+
+def test_edit_distance_matches_dp():
+    rng = np.random.default_rng(0)
+    hyps = rng.integers(1, 5, (4, 6))
+    refs = rng.integers(1, 5, (4, 7))
+    hl = np.array([6, 4, 5, 3])
+    rl = np.array([7, 6, 2, 3])
+    d, n = pt.edit_distance(pt.to_tensor(hyps), pt.to_tensor(refs),
+                            pt.to_tensor(hl), pt.to_tensor(rl),
+                            normalized=False)
+    exp = [_np_edit_distance(list(hyps[i][:hl[i]]), list(refs[i][:rl[i]]))
+           for i in range(4)]
+    np.testing.assert_allclose(np.asarray(d.value).ravel(), exp)
+    dn, _ = pt.edit_distance(pt.to_tensor(hyps), pt.to_tensor(refs),
+                             pt.to_tensor(hl), pt.to_tensor(rl),
+                             normalized=True)
+    np.testing.assert_allclose(np.asarray(dn.value).ravel(),
+                               np.asarray(exp) / rl, rtol=1e-6)
+
+
+def test_ctc_align():
+    out, nl = pt.ctc_align(pt.to_tensor(np.array([[1, 1, 0, 2, 2, 3],
+                                                  [0, 0, 1, 1, 0, 0]])),
+                           pt.to_tensor(np.array([6, 4])))
+    o = np.asarray(out.value)
+    assert o[0][:3].tolist() == [1, 2, 3] and int(nl.numpy()[0]) == 3
+    assert o[1][:1].tolist() == [1] and int(nl.numpy()[1]) == 1
+
+
+def test_mean_iou_and_precision_recall():
+    miou, wrong, correct = pt.mean_iou(
+        pt.to_tensor(np.array([0, 1, 1, 2])),
+        pt.to_tensor(np.array([0, 1, 2, 2])), 3)
+    # class IoUs: 1, 0.5, 0.5 -> mean 2/3
+    assert float(miou.numpy()) == pytest.approx(2 / 3, rel=1e-5)
+    bm, am, st = pt.precision_recall(
+        pt.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]],
+                              "float32")),
+        pt.to_tensor(np.array([0, 1, 1])), 2)
+    s = np.asarray(st.value)
+    assert s[:, 0].sum() == 2  # two true positives
+    # accumulation: passing states back doubles counts
+    _, am2, st2 = pt.precision_recall(
+        pt.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]],
+                              "float32")),
+        pt.to_tensor(np.array([0, 1, 1])), 2, states=st)
+    assert np.asarray(st2.value)[:, 0].sum() == 4
+
+
+def test_chunk_eval_iob():
+    from paddle_tpu.ops.metric_extra import chunk_eval
+    # tags: type0 B=0 I=1, outside=2
+    p, r, f1, ni, nl, nc = chunk_eval(
+        np.array([[0, 1, 2, 0, 2]]), np.array([[0, 1, 2, 2, 2]]),
+        np.array([5]))
+    assert ni == 2 and nl == 1 and nc == 1
+    assert r == 1.0 and p == 0.5
+
+
+def test_detection_map_and_pnpair():
+    from paddle_tpu.ops.metric_extra import (detection_map,
+                                             positive_negative_pair)
+    det = np.array([[0, 0.9, 0, 0, 10, 10], [0, 0.8, 50, 50, 60, 60]])
+    m = detection_map(det, np.array([[0, 0, 10, 10]]), np.array([0]), 1)
+    assert 0.9 < float(m) <= 1.0
+    pos, neg, neu = positive_negative_pair(
+        np.array([0.9, 0.1, 0.5]), np.array([1, 0, 0]),
+        np.array([0, 0, 0]))
+    assert pos == 2 and neg == 0
+
+
+def test_density_prior_box_and_target_assign():
+    b, v = pt.density_prior_box(4, 4, 32, 32, [8.0], [1.0], [2])
+    assert tuple(b.shape) == (4, 4, 4, 4)  # density 2 -> 4 priors
+    out, w = pt.target_assign(
+        pt.to_tensor(np.arange(12.0, dtype="float32").reshape(4, 3)),
+        pt.to_tensor(np.array([[0, -1], [2, 3]])), mismatch_value=-5.0)
+    o = np.asarray(out.value)
+    np.testing.assert_allclose(o[0, 0], [0, 1, 2])
+    np.testing.assert_allclose(o[0, 1], -5.0)
+    assert np.asarray(w.value)[0, 1, 0] == 0.0
+
+
+def test_rpn_target_assign_and_generate_proposals():
+    from paddle_tpu.ops.detection import (generate_proposals,
+                                          rpn_target_assign)
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30], [5, 5, 15, 15]],
+                       np.float32)
+    gts = np.array([[0, 0, 10, 10]], np.float32)
+    li, si, tb, tl, iw = rpn_target_assign(anchors, gts)
+    assert 0 in li  # the perfectly-matching anchor is foreground
+    assert set(tl.tolist()) <= {0, 1}
+    rng = np.random.default_rng(1)
+    scores = rng.random(12).astype("float32")
+    anch = np.abs(rng.random((12, 4)).astype("float32")) * 10
+    anch[:, 2:] += anch[:, :2] + 5
+    rois, rs, valid = generate_proposals(
+        scores, np.zeros((12, 4), "float32"), (50, 50), anch,
+        post_nms_top_n=5)
+    assert np.asarray(rois).shape == (5, 4)
+    r = np.asarray(rois)
+    assert (r >= 0).all() and (r <= 49).all()
+
+
+def test_matrix_nms_decay():
+    from paddle_tpu.ops.detection import matrix_nms
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([[0, 0, 0], [0.9, 0.8, 0.7]], np.float32)
+    out, valid = matrix_nms(boxes, scores, keep_top_k=3)
+    o = np.asarray(out)
+    assert o[0, 1] == pytest.approx(0.9)       # top box undecayed
+    assert o[1, 1] == pytest.approx(0.7)       # disjoint box undecayed
+    assert o[2, 1] < 0.5                       # overlapped box decayed
+
+
+def test_fpn_distribute_collect_roundtrip():
+    from paddle_tpu.ops.detection import (collect_fpn_proposals,
+                                          distribute_fpn_proposals)
+    rois = np.array([[0, 0, 16, 16], [0, 0, 300, 300], [0, 0, 40, 40]],
+                    np.float32)
+    levels, restore = distribute_fpn_proposals(rois)
+    flat = np.concatenate([l for l in levels if len(l)])
+    np.testing.assert_allclose(flat[restore], rois)
+    out, sc = collect_fpn_proposals(
+        [np.ones((2, 4), "float32"), np.zeros((1, 4), "float32")],
+        [np.array([0.5, 0.9], "float32"), np.array([0.99], "float32")], 2)
+    assert sc.tolist() == [pytest.approx(0.99), pytest.approx(0.9)]
+
+
+def test_polygon_box_transform():
+    from paddle_tpu.ops.detection import polygon_box_transform
+    out = np.asarray(polygon_box_transform(np.zeros((1, 2, 2, 3),
+                                                    "float32")))
+    # channel 0 = 4*x grid, channel 1 = 4*y grid
+    np.testing.assert_allclose(out[0, 0, 0], [0, 4, 8])
+    np.testing.assert_allclose(out[0, 1, :, 0], [0, 4])
+
+
+def test_sequence_topk_avg_pooling():
+    x = np.zeros((1, 1, 2, 4), "float32")
+    x[0, 0, 0] = [3, 1, 2, 99]  # col 3 invalid
+    out = pt.sequence_topk_avg_pooling(
+        pt.to_tensor(x), pt.to_tensor(np.array([2])),
+        pt.to_tensor(np.array([3])), [1, 2], 1)
+    o = np.asarray(out.value)
+    assert o.shape == (1, 2, 2)
+    assert o[0, 0, 0] == pytest.approx(3.0)        # top-1 avg
+    assert o[0, 0, 1] == pytest.approx(2.5)        # top-2 avg (3+2)/2
+
+
+def test_match_matrix_and_var_conv():
+    rng = np.random.default_rng(2)
+    x = rng.random((2, 4, 6)).astype("float32")
+    y = rng.random((2, 5, 6)).astype("float32")
+    w = rng.random((6, 2, 6)).astype("float32")
+    out = pt.match_matrix_tensor(
+        pt.to_tensor(x), pt.to_tensor(y), pt.to_tensor(w),
+        pt.to_tensor(np.array([4, 3])), pt.to_tensor(np.array([5, 2])))
+    o = np.asarray(out.value)
+    assert o.shape == (2, 2, 4, 5)
+    exp = x[0, 1] @ w[:, 1] @ y[0, 2]
+    assert o[0, 1, 1, 2] == pytest.approx(exp, rel=1e-5)
+    assert o[1, 0, 3, 0] == 0.0  # masked row
+    vc = pt.var_conv_2d(
+        pt.to_tensor(rng.random((2, 1, 4, 5)).astype("float32")),
+        pt.to_tensor(np.array([4, 2])), pt.to_tensor(np.array([5, 3])),
+        pt.to_tensor(rng.random((2, 1, 3, 3)).astype("float32")), 1, 2, 3)
+    v = np.asarray(vc.value)
+    assert v.shape == (2, 2, 4, 5)
+    assert np.abs(v[1, :, 2:, :]).sum() == 0  # outside valid rows
+
+
+def test_tree_conv_aggregates_children():
+    nv = np.zeros((1, 3, 2), "float32")
+    nv[0, 1] = [1, 0]
+    nv[0, 2] = [0, 1]
+    edges = np.array([[[0, 1], [0, 2], [0, 0], [0, 0]]])
+    w = np.zeros((2, 3, 1), "float32")
+    w[:, 1, 0] = 1.0  # only the children-aggregate role contributes
+    out = np.asarray(pt.tree_conv(pt.to_tensor(nv), pt.to_tensor(edges),
+                                  pt.to_tensor(w)).value)
+    assert out[0, 0, 0] == pytest.approx(2.0)  # root sums both children
+    assert out[0, 1, 0] == pytest.approx(0.0)  # leaves have none
+
+
+def test_hash_and_pyramid_hash():
+    h = pt.hash_ids(pt.to_tensor(np.array([[5], [9], [5]])), num_hash=2,
+                    mod_by=997)
+    hv = np.asarray(h.value)
+    assert (hv < 997).all()
+    np.testing.assert_array_equal(hv[0], hv[2])  # deterministic
+    assert not np.array_equal(hv[0], hv[1])
+    w = np.random.default_rng(3).random((64, 16)).astype("float32")
+    e1 = pt.pyramid_hash(pt.to_tensor(np.array([[1, 2, 3, 0]])),
+                         pt.to_tensor(np.array([3])), pt.to_tensor(w),
+                         32, 64)
+    e2 = pt.pyramid_hash(pt.to_tensor(np.array([[1, 2, 3, 9]])),
+                         pt.to_tensor(np.array([3])), pt.to_tensor(w),
+                         32, 64)
+    np.testing.assert_allclose(np.asarray(e1.value),
+                               np.asarray(e2.value), rtol=1e-5)
+
+
+def test_rank_attention_selects_blocks():
+    x = np.ones((2, 3), "float32")
+    param = np.zeros((2 * 2 * 3, 4), "float32")
+    param[0:3] = 1.0   # block (rank 0, other 0)
+    param[9:12] = 2.0  # block (rank 1, other 1)
+    ro = np.array([[0, 0, 0, -1, 0],   # ins rank 0, one valid other 0
+                   [1, 1, 1, -1, 0]])  # ins rank 1, one valid other 1
+    out = np.asarray(pt.rank_attention(
+        pt.to_tensor(x), pt.to_tensor(ro), pt.to_tensor(param), 2).value)
+    np.testing.assert_allclose(out[0], 3.0)   # 1x3 @ ones(3,4)
+    np.testing.assert_allclose(out[1], 6.0)
+
+
+def test_tdm_child_and_sampler():
+    info = np.array([[10, 0, 0, 1, 2],
+                     [11, 1, 0, 0, 0],
+                     [12, 1, 0, 0, 0]])
+    ch, leaf = pt.tdm_child(pt.to_tensor(np.array([0])),
+                            pt.to_tensor(info), 2)
+    assert np.asarray(ch.value).tolist() == [[1, 2]]
+    assert np.asarray(leaf.value).tolist() == [[1, 1]]
+    from paddle_tpu.ops.nlp_ctr_extra import tdm_sampler
+    travel = {5: [1, 3]}
+    layers = [[1, 2], [3, 4]]
+    out, labels = tdm_sampler(np.array([5]), travel, layers, [1, 1],
+                              seed=0)
+    assert out.shape == labels.shape == (1, 4)
+    assert labels[0].tolist() == [1, 0, 1, 0]
+    assert out[0, 0] == 1 and out[0, 2] == 3
+
+
+def test_filter_by_instag_and_sampling_id():
+    rows, idx, lw = pt.filter_by_instag(
+        np.arange(6.0).reshape(3, 2), [[1], [2], [1, 3]], [1])
+    assert np.asarray(idx.value if hasattr(idx, "value") else
+                      idx).tolist() == [0, 2]
+    sid = pt.sampling_id(pt.to_tensor(
+        np.array([[0.0, 1.0], [1.0, 0.0]], "float32")), seed=1)
+    assert np.asarray(sid.value).tolist() == [1, 0]
+
+
+def test_similarity_focus_marks_unique_rows_cols():
+    from paddle_tpu.ops.nlp_ctr_extra import similarity_focus
+    x = np.random.default_rng(4).random((1, 2, 3, 3)).astype("float32")
+    mask = similarity_focus(x, 1, [0])
+    m = mask[0, 0]
+    assert m.sum() == 3  # one mark per row/col pair
+    assert (m.sum(0) <= 1).all() and (m.sum(1) <= 1).all()
+
+
+def test_pad_constant_like_and_random_crop():
+    out = pt.pad_constant_like(
+        pt.to_tensor(np.zeros((3, 4), "float32")),
+        pt.to_tensor(np.ones((2, 2), "float32")), pad_value=7.0)
+    o = np.asarray(out.value)
+    assert o.shape == (3, 4) and o[2, 3] == 7.0 and o[0, 0] == 1.0
+    rc = pt.random_crop(pt.to_tensor(
+        np.random.default_rng(5).random((2, 3, 8, 8)).astype("float32")),
+        (4, 4), seed=2)
+    assert tuple(rc.shape) == (2, 3, 4, 4)
+
+
+def test_mine_hard_examples_quota():
+    from paddle_tpu.ops.detection import mine_hard_examples
+    loss = np.random.default_rng(6).random((1, 8)).astype("float32")
+    mi = np.array([[0, -1, -1, -1, 1, -1, -1, -1]])
+    _, neg = mine_hard_examples(loss, mi, neg_pos_ratio=2.0)
+    assert len(neg[0]) == 4  # 2 positives * ratio 2
+    # chosen negatives are the highest-loss ones
+    neg_losses = loss[0][neg[0]]
+    others = [loss[0][i] for i in range(8)
+              if mi[0, i] < 0 and i not in neg[0]]
+    assert all(nl >= max(others) - 1e-6 for nl in [neg_losses.min()])
+
+
+def test_locality_aware_nms_merges():
+    from paddle_tpu.ops.detection import locality_aware_nms
+    kb, ks = locality_aware_nms(
+        np.array([[0, 0, 10, 10], [1, 1, 10, 10], [30, 30, 40, 40]],
+                 np.float32),
+        np.array([0.9, 0.8, 0.7], np.float32))
+    assert kb.shape[0] == 2  # first two merged
+    assert ks[0] == pytest.approx(1.7)  # weights accumulate
+
+
+def test_rpn_target_assign_multi_gt_shapes():
+    from paddle_tpu.ops.detection import rpn_target_assign
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [5, 5, 15, 15], [22, 22, 32, 32]], np.float32)
+    gts = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+    li, si, tb, tl, iw = rpn_target_assign(anchors, gts)
+    assert tb.ndim == 2 and tb.shape[1] == 4
+    assert tb.shape[0] == len(li) and iw.shape == tb.shape
+
+
+def test_sequence_topk_avg_pooling_k_exceeds_length():
+    x = np.zeros((1, 1, 1, 4), "float32")
+    x[0, 0, 0] = [3, 1, 2, 99]  # col 3 is padding
+    out = np.asarray(pt.sequence_topk_avg_pooling(
+        pt.to_tensor(x), pt.to_tensor(np.array([1])),
+        pt.to_tensor(np.array([3])), [4], 1).value)
+    assert out.ravel()[0] == pytest.approx(2.0)  # mean of 3 valid
+
+
+def test_matrix_nms_background_only():
+    from paddle_tpu.ops.detection import matrix_nms
+    out, valid = matrix_nms(np.ones((2, 4), "float32"),
+                            np.ones((1, 2), "float32"))
+    assert np.asarray(out).shape == (0, 6)
+
+
+def test_chunk_eval_ioe_adjacent_chunks():
+    from paddle_tpu.ops.metric_extra import chunk_eval
+    # IOE: I=0, E=1 — [I, E, I, E] is TWO chunks
+    p, r, f1, ni, nl, nc = chunk_eval(
+        np.array([[0, 1, 0, 1]]), np.array([[0, 1, 0, 1]]),
+        np.array([4]), chunk_scheme="IOE")
+    assert ni == 2 and nc == 2 and f1 == 1.0
+
+
+def test_box_decoder_clamps_deltas():
+    from paddle_tpu.ops.detection import box_decoder_and_assign
+    dec, assigned = box_decoder_and_assign(
+        np.array([[0, 0, 10, 10]], np.float32), None,
+        np.array([[0, 0, 10.0, 10.0]], np.float32),
+        np.array([[1.0]], np.float32))
+    a = np.asarray(assigned)
+    width = float(a[0, 2] - a[0, 0])
+    # pw = 11 (norm=1 coords); decoded width = exp(clamped 4.135)*pw - 1
+    assert width == pytest.approx(np.exp(4.135) * 11 - 1, rel=1e-3)
