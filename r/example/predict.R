@@ -1,0 +1,26 @@
+#!/usr/bin/env Rscript
+# paddle_tpu inference from R (reference parity: r/example/mobilenet.r).
+# Usage: Rscript predict.R <model_prefix>
+
+library(reticulate)
+
+args <- commandArgs(trailingOnly = TRUE)
+prefix <- if (length(args) >= 1) args[[1]] else "model"
+
+inference <- import("paddle_tpu.inference")
+np <- import("numpy")
+
+config <- inference$Config(prefix)
+config$enable_memory_optim()
+predictor <- inference$create_predictor(config)
+
+input_names <- predictor$get_input_names()
+h <- predictor$get_input_handle(input_names[[1]])
+h$copy_from_cpu(np$random$rand(1L, 3L, 224L, 224L)$astype("float32"))
+
+predictor$run()
+
+out_names <- predictor$get_output_names()
+out <- predictor$get_output_handle(out_names[[1]])
+result <- out$copy_to_cpu()
+cat("output shape:", paste(dim(result), collapse = "x"), "\n")

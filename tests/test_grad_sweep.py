@@ -1,0 +1,187 @@
+"""Automated numeric-gradient sweep over the op registry.
+
+Reference parity: the OpTest fixture's check_grad with finite-difference
+verification is applied across the operator zoo via per-op test classes
+(reference: unittests/op_test.py:1405 + ~700 test files). Here the
+registry makes the sweep mechanical: every differentiable single-array op
+is finite-difference-checked automatically, so newly added kernels get
+gradient coverage without writing a test.
+"""
+
+import inspect
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.registry import all_ops
+
+# Ops whose domain needs shifting away from the default (0.2, 0.8) range.
+DOMAIN = {
+    "acosh": (1.2, 2.0),
+    "atanh": (-0.6, 0.6),
+    "erfinv": (-0.6, 0.6),
+    "log": (0.3, 1.5),
+    "log2": (0.3, 1.5),
+    "log10": (0.3, 1.5),
+    "log1p": (0.3, 1.5),
+    "rsqrt": (0.3, 1.5),
+    "sqrt": (0.3, 1.5),
+    "reciprocal": (0.4, 1.5),
+    "digamma": (1.0, 2.0),
+    "lgamma": (1.0, 2.0),
+}
+
+# Not meaningfully differentiable w.r.t. a dense float input, or
+# non-deterministic, or needing structured input — excluded from the
+# sweep (most have dedicated tests elsewhere).
+SKIP = {
+    # integer / index ops that accept floats but produce discrete outputs
+    "floor", "ceil", "round", "trunc", "sign", "sgn", "frac", "exponent",
+    "digitize", "histogram", "searchsorted", "bucketize",
+    # random
+    "shuffle", "bernoulli", "poisson", "multinomial", "binomial",
+    "lognormal", "standard_gamma", "gumbel", "exponential_",
+    # structured-input ops (dedicated tests exist)
+    "crf_decoding", "viterbi_decode", "as_complex", "as_real",
+    "polygon_box_transform", "partial_concat", "partial_sum",
+    # piecewise-constant almost everywhere
+    "isneginf", "isposinf", "isreal",
+    # stochastic outputs: finite differences see different draws
+    "dropout", "dropout2d", "dropout3d", "alpha_dropout", "exponential",
+    "normal_like", "rand_like", "uniform_like", "randn_like",
+    # complex outputs (holomorphic grads out of the sweep's scope;
+    # fft family has dedicated tests) / unimplemented jax vjp
+    "qr", "eig", "eigvals",
+    # creation / shape-argument / string-argument / list-argument ops:
+    # the single required arg is not a differentiable array
+    "einsum", "empty", "eye", "ones", "zeros", "rand", "randn",
+    "uniform", "standard_normal", "randint_like", "multi_dot",
+    "interpolate", "upsample", "sequence_mask", "tril_indices",
+    "triu_indices", "vander",
+}
+
+
+def _is_fft(name: str) -> bool:
+    return name.startswith(("fft", "ifft", "rfft", "irfft", "hfft",
+                            "ihfft", "fftshift", "ifftshift"))
+
+
+def _sweepable():
+    out = []
+    for name, opdef in sorted(all_ops().items()):
+        if not opdef.differentiable or opdef.dynamic_shape:
+            continue
+        if name in SKIP or _is_fft(name):
+            continue
+        try:
+            sig = inspect.signature(opdef.fn)
+        except (TypeError, ValueError):
+            continue
+        params = list(sig.parameters.values())
+        if not params:
+            continue
+        required = [p for p in params
+                    if p.default is inspect.Parameter.empty and
+                    p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+        if len(required) != 1:
+            continue  # unary-only sweep; n-ary ops have dedicated tests
+        out.append(name)
+    return out
+
+
+SWEEP = _sweepable()
+
+
+# Ops needing structured inputs: name -> factory(rng) -> array
+def _square(rng):
+    return jnp.asarray(rng.uniform(0.2, 0.8, (4, 4)).astype(np.float32))
+
+
+def _spd(rng):
+    a = rng.uniform(0.2, 0.8, (4, 4)).astype(np.float32)
+    return jnp.asarray(a @ a.T + 4.0 * np.eye(4, dtype=np.float32))
+
+
+def _batch3d(rng):
+    return jnp.asarray(rng.uniform(0.2, 0.8, (2, 3, 4)).astype(
+        np.float32))
+
+
+INPUT_FACTORY = {
+    "cholesky": _spd,
+    "inv": _spd,
+    "matrix_power": _spd,
+    "logdet": _spd,
+    "slogdet": _spd,
+    "det": _square,
+    "eigh": _spd,
+    "eigvalsh": _spd,
+    "lu": _square,
+    "matrix_rank": _square,
+    "pinv": _square,
+    "add_position_encoding": _batch3d,
+    "inverse": _spd,
+}
+
+
+def _sweep_input(name):
+    # content-derived seed: reproducible across processes
+    # (hash() varies with PYTHONHASHSEED)
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    if name in INPUT_FACTORY:
+        return INPUT_FACTORY[name](rng)
+    lo, hi = DOMAIN.get(name, (0.2, 0.8))
+    return jnp.asarray(rng.uniform(lo, hi, (3, 4)).astype(np.float32))
+
+
+def _scalar_fn(opdef):
+    def f(v):
+        out = opdef.fn(v)
+        leaves = [o for o in jax.tree_util.tree_leaves(out)
+                  if hasattr(o, "dtype") and
+                  jnp.issubdtype(o.dtype, jnp.inexact)]
+        if not leaves:
+            return None
+        return sum(jnp.sum(o) for o in leaves)
+    return f
+
+
+@pytest.mark.parametrize("name", SWEEP)
+def test_numeric_gradient(name):
+    opdef = all_ops()[name]
+    x = _sweep_input(name)
+    scalar_fn = _scalar_fn(opdef)
+    try:
+        out0 = scalar_fn(x)
+    except (TypeError, ValueError) as e:
+        pytest.skip(f"{name}: needs non-array args ({e})")
+    if out0 is None:
+        pytest.skip(f"{name}: no float output")
+    if not np.all(np.isfinite(np.asarray(out0))):
+        pytest.skip(f"{name}: non-finite at sweep point")
+    from jax.test_util import check_grads as jax_check_grads
+    jax_check_grads(scalar_fn, (x,), order=1, modes=("rev",),
+                    rtol=2e-2, atol=2e-3)
+
+
+def test_sweep_covers_a_meaningful_slice():
+    # guard against the sweep silently collapsing (e.g. a registry change
+    # making every op look non-unary) ...
+    assert len(SWEEP) >= 60, sorted(SWEEP)
+    # ... and against runtime skips silently eating coverage: ops that
+    # error or go non-finite on the standard sweep input must stay rare
+    # and get either a DOMAIN entry or an explicit SKIP when they grow
+    bad = []
+    for name in SWEEP:
+        opdef = all_ops()[name]
+        try:
+            out0 = _scalar_fn(opdef)(_sweep_input(name))
+            if out0 is not None and \
+                    not np.all(np.isfinite(np.asarray(out0))):
+                bad.append((name, "non-finite"))
+        except (TypeError, ValueError) as e:
+            bad.append((name, str(e)[:60]))
+    assert len(bad) <= 4, bad
