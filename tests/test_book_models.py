@@ -192,3 +192,261 @@ def test_book_bert_pretrain_static_path():
     seq_out = exe.run(prog, feed={"ids": corrupted[:2]})[0]
     assert seq_out.shape == (2, S, cfg.hidden_size)
     assert np.isfinite(seq_out).all()
+
+
+def test_book_image_classification_cifar():
+    """Small conv net on Cifar10-shaped data (reference
+    book/test_image_classification.py): loss drops through the full
+    vision stack (dataset -> transforms -> DataLoader -> train)."""
+    import paddle_tpu.io as pio
+    import paddle_tpu.vision as V
+
+    pt.seed(0)
+    ds = V.datasets.Cifar10(mode="train")
+
+    class SmallConv(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 16, 3, padding=1), nn.ReLU(),
+                nn.MaxPool2D(2, 2),
+                nn.Conv2D(16, 32, 3, padding=1), nn.ReLU(),
+                nn.AdaptiveAvgPool2D(1))
+            self.head = nn.Linear(32, 10)
+
+        def forward(self, x):
+            return self.head(self.features(x).squeeze((2, 3)))
+
+    m = SmallConv()
+    opt = optim.Adam(learning_rate=2e-3, parameters=m.parameters())
+    dl = pio.DataLoader(ds, batch_size=32, shuffle=True)
+    losses = []
+    for epoch in range(3):
+        for img, label in dl:
+            logits = m(img.astype("float32"))
+            loss = nn.functional.cross_entropy(
+                logits, label.astype("int64"))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_book_understand_sentiment_lstm():
+    """LSTM sentiment classifier on Imdb (reference
+    book/notest_understand_sentiment.py): accuracy on the synthetic
+    corpus goes well above chance."""
+    import paddle_tpu.io as pio
+    import paddle_tpu.text as T
+
+    pt.seed(0)
+    ds = T.Imdb(mode="train", seq_len=16, synthetic_size=128)
+    vocab = len(ds.vocab)
+
+    class SentimentLSTM(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(vocab, 32)
+            self.lstm = nn.LSTM(32, 32)
+            self.head = nn.Linear(32, 2)
+
+        def forward(self, ids):
+            x = self.emb(ids)
+            out, _ = self.lstm(x)
+            # mean-pool over time: the padded tail would otherwise
+            # dominate the last-step state on short synthetic reviews
+            return self.head(out.mean(axis=1))
+
+    m = SentimentLSTM()
+    opt = optim.Adam(learning_rate=1e-2, parameters=m.parameters())
+    dl = pio.DataLoader(ds, batch_size=32, shuffle=True)
+    for epoch in range(6):
+        hits = total = 0
+        for ids, label in dl:
+            logits = m(ids)
+            loss = nn.functional.cross_entropy(logits, label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            hits += int((np.asarray(logits.value).argmax(-1) ==
+                         np.asarray(label.value)).sum())
+            total += int(np.asarray(label.value).size)
+    assert hits / total > 0.75, hits / total
+
+
+def test_book_recommender_system():
+    """Embedding-factorization rating model on Movielens (reference
+    book/test_recommender_system.py): MSE on ratings drops."""
+    import paddle_tpu.io as pio
+    import paddle_tpu.text as T
+
+    pt.seed(0)
+    ds = T.Movielens(mode="train", synthetic_size=400)
+
+    class Recommender(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.user_emb = nn.Embedding(512, 16)
+            self.movie_emb = nn.Embedding(512, 16)
+            self.mlp = nn.Sequential(nn.Linear(32, 32), nn.ReLU(),
+                                     nn.Linear(32, 1))
+
+        def forward(self, uid, mid):
+            u = self.user_emb(uid)
+            v = self.movie_emb(mid)
+            return self.mlp(pt.concat([u, v], axis=-1))[:, 0] * 5.0
+
+    def collate(samples):
+        uid = np.asarray([int(s[0]) for s in samples], np.int64)
+        mid = np.asarray([int(s[4]) for s in samples], np.int64)
+        rating = np.asarray([float(s[7]) for s in samples], np.float32)
+        return uid, mid, rating
+
+    m = Recommender()
+    opt = optim.Adam(learning_rate=5e-3, parameters=m.parameters())
+    dl = pio.DataLoader(ds, batch_size=64, shuffle=True,
+                        collate_fn=collate)
+    first = last = None
+    for epoch in range(6):
+        for uid, mid, rating in dl:
+            pred = m(uid, mid)
+            loss = nn.functional.mse_loss(pred, rating)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(loss.numpy())
+            first = first if first is not None else v
+            last = v
+    assert last < first * 0.7, (first, last)
+
+
+def test_book_label_semantic_roles_crf():
+    """BiRNN + linear-chain CRF tagger on Conll05st (reference
+    book/test_label_semantic_roles.py): CRF NLL drops and viterbi decode
+    beats chance on the training set."""
+    import paddle_tpu.io as pio
+    import paddle_tpu.text as T
+    from paddle_tpu.ops.decode_extra import crf_decoding
+
+    pt.seed(0)
+    K = T.Conll05st.NUM_LABELS
+    ds = T.Conll05st(mode="train", seq_len=10, synthetic_size=96)
+
+    class SRLTagger(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.word_emb = nn.Embedding(256, 24)
+            self.mark_emb = nn.Embedding(2, 8)
+            self.rnn = nn.BiRNN(nn.GRUCell(32, 24), nn.GRUCell(32, 24))
+            self.emit = nn.Linear(48, K)
+            self.transition = self.create_parameter((K + 2, K))
+
+        def forward(self, words, mark):
+            x = pt.concat([self.word_emb(words), self.mark_emb(mark)],
+                          axis=-1)
+            out, _ = self.rnn(x)
+            return self.emit(out)
+
+    def collate(samples):
+        words = np.stack([s[0] for s in samples]).astype(np.int64)
+        mark = np.stack([s[2] for s in samples]).astype(np.int64)
+        labels = np.stack([s[3] for s in samples]).astype(np.int64)
+        return words, mark, labels
+
+    m = SRLTagger()
+    opt = optim.Adam(learning_rate=5e-3, parameters=m.parameters())
+    dl = pio.DataLoader(ds, batch_size=32, collate_fn=collate)
+    first = last = None
+    for epoch in range(8):
+        for words, mark, labels in dl:
+            emission = m(words, mark)
+            nll = pt.linear_chain_crf(emission, m.transition, labels)
+            loss = nll.mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(loss.numpy())
+            first = first if first is not None else v
+            last = v
+    assert last < first * 0.8, (first, last)
+    # decode path: viterbi over the learned scores runs and is valid
+    emission = m(pt.to_tensor(words), pt.to_tensor(mark))
+    path = crf_decoding(np.asarray(emission.value),
+                        np.asarray(m.transition.value))
+    assert np.asarray(path).shape == np.asarray(labels).shape
+    assert (np.asarray(path) >= 0).all() and (np.asarray(path) < K).all()
+
+
+def test_book_machine_translation_seq2seq():
+    """GRU encoder-decoder on WMT14-shaped pairs (reference
+    book/test_machine_translation.py): teacher-forced CE drops, and
+    beam-search decode produces hypotheses."""
+    import paddle_tpu.io as pio
+    import paddle_tpu.text as T
+
+    pt.seed(0)
+    V = 64
+    ds = T.WMT14(mode="train", dict_size=V, seq_len=8,
+                 synthetic_size=128)
+
+    class Seq2Seq(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.src_emb = nn.Embedding(V, 32)
+            self.trg_emb = nn.Embedding(V, 32)
+            self.encoder = nn.GRU(32, 32)
+            # the cell registers once, through the RNN wrapper (a direct
+            # attribute too would duplicate its params in parameters())
+            self.decoder_rnn = nn.RNN(nn.GRUCell(32, 32))
+            self.proj = nn.Linear(32, V)
+
+        @property
+        def dec_cell(self):
+            return self.decoder_rnn.cell
+
+        def forward(self, src, trg_in):
+            _, h = self.encoder(self.src_emb(src))
+            state = h[0] if isinstance(h, (tuple, list)) else h
+            state = state[-1] if state.ndim == 3 else state
+            x = self.trg_emb(trg_in)
+            outs, _ = self.decoder_rnn(x, state)
+            return self.proj(outs)
+
+    def collate(samples):
+        src = np.stack([s[0][:5] if len(s[0]) >= 5 else
+                        np.pad(s[0], (0, 5 - len(s[0])))
+                        for s in samples]).astype(np.int64)
+        tin = np.stack([s[1][:6] if len(s[1]) >= 6 else
+                        np.pad(s[1], (0, 6 - len(s[1])))
+                        for s in samples]).astype(np.int64)
+        tnext = np.stack([s[2][:6] if len(s[2]) >= 6 else
+                          np.pad(s[2], (0, 6 - len(s[2])))
+                          for s in samples]).astype(np.int64)
+        return src, tin, tnext
+
+    m = Seq2Seq()
+    opt = optim.Adam(learning_rate=8e-3, parameters=m.parameters())
+    dl = pio.DataLoader(ds, batch_size=32, collate_fn=collate)
+    first = last = None
+    for epoch in range(14):
+        for src, tin, tnext in dl:
+            logits = m(src, tin)
+            loss = nn.functional.cross_entropy(
+                logits.reshape((-1, V)), tnext.reshape((-1,)))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(loss.numpy())
+            first = first if first is not None else v
+            last = v
+    assert last < first * 0.7, (first, last)
+    # inference: beam search from the encoder state
+    dec = nn.BeamSearchDecoder(m.dec_cell, start_token=2, end_token=3,
+                               beam_size=3, embedding_fn=m.trg_emb,
+                               output_fn=m.proj)
+    _, h = m.encoder(m.src_emb(pt.to_tensor(src[:2])))
+    state = h[0] if isinstance(h, (tuple, list)) else h
+    state = state[-1] if state.ndim == 3 else state
+    ids, scores = nn.dynamic_decode(dec, inits=state, max_step_num=6)
+    assert ids.shape[0] == 2 and np.isfinite(scores.numpy()).all()
