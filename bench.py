@@ -66,13 +66,16 @@ def main() -> None:
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
     # Single-chip config: GPT-3 1.3B-class (BASELINE.md staged config #3)
-    # in bf16; fits one chip via per-block remat + chunked CE, and runs
+    # in bf16; fits one chip via chunked CE alone (no remat), and runs
     # at HIGHER MFU than small configs (larger matmuls fill the MXU).
     if on_tpu:
         # chunked CE alone makes 1.3B fit up to B2 S2048 (the
         # [B,S,32768] logits were the memory problem, not block
         # activations); remat would cost ~12% MFU and is not needed.
-        # Measured batch sweep: B1 67.5%, B2 72.3% (peak), B3 70.1%.
+        # Measured sweep (v5e MFU): B1 67.5%, B2 72.3% (peak), B3 70.1%;
+        # longer-seq/no-remat: B2xS3072 70.3%, B1xS4096 71.2%;
+        # with selective remat: B4xS2048 every=3 62.8%, B2xS4096
+        # every=2 66.3% — B2xS2048 no-remat stays the sweet spot.
         cfg = GPTConfig(vocab_size=32768, hidden_size=2048, num_layers=24,
                         num_heads=16, max_seq_len=2048, dropout=0.0,
                         attn_dropout=0.0, dtype="bfloat16",

@@ -413,15 +413,15 @@ def test_book_machine_translation_seq2seq():
             outs, _ = self.decoder_rnn(x, state)
             return self.proj(outs)
 
+    def fix_len(a, n):
+        return np.pad(a[:n], (0, max(0, n - len(a))))
+
     def collate(samples):
-        src = np.stack([s[0][:5] if len(s[0]) >= 5 else
-                        np.pad(s[0], (0, 5 - len(s[0])))
+        src = np.stack([fix_len(s[0], 5)
                         for s in samples]).astype(np.int64)
-        tin = np.stack([s[1][:6] if len(s[1]) >= 6 else
-                        np.pad(s[1], (0, 6 - len(s[1])))
+        tin = np.stack([fix_len(s[1], 6)
                         for s in samples]).astype(np.int64)
-        tnext = np.stack([s[2][:6] if len(s[2]) >= 6 else
-                          np.pad(s[2], (0, 6 - len(s[2])))
+        tnext = np.stack([fix_len(s[2], 6)
                           for s in samples]).astype(np.int64)
         return src, tin, tnext
 
