@@ -22,7 +22,7 @@ from .core.dtype import convert_dtype
 class Tensor:
     __slots__ = ("value", "stop_gradient", "grad", "grad_node", "_out_index",
                  "name", "persistable", "_retain_grads", "_grad_hooks",
-                 "__weakref__")
+                 "_inplace_version", "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True,
                  name: Optional[str] = None):
@@ -37,6 +37,7 @@ class Tensor:
         self.persistable = False
         self._retain_grads = False
         self._grad_hooks: List[Any] = []
+        self._inplace_version = 0
 
     # -- array protocol ------------------------------------------------------
 
@@ -157,6 +158,7 @@ class Tensor:
         if isinstance(new, Tensor):
             self.grad_node = new.grad_node
             self._out_index = new._out_index
+        self._inplace_version += 1
         return self
 
     def set_value(self, value) -> None:
@@ -164,27 +166,33 @@ class Tensor:
             value)
         self.value = value.astype(self.dtype) if value.dtype != self.dtype \
             else value
+        self._inplace_version += 1
 
     def fill_(self, v) -> "Tensor":
         self.value = jnp.full_like(self.value, v)
+        self._inplace_version += 1
         return self
 
     def zero_(self) -> "Tensor":
         self.value = jnp.zeros_like(self.value)
+        self._inplace_version += 1
         return self
 
     def scale_(self, v) -> "Tensor":
         self.value = self.value * v
+        self._inplace_version += 1
         return self
 
     def add_(self, other) -> "Tensor":
         other = other.value if isinstance(other, Tensor) else other
         self.value = self.value + other
+        self._inplace_version += 1
         return self
 
     def subtract_(self, other) -> "Tensor":
         other = other.value if isinstance(other, Tensor) else other
         self.value = self.value - other
+        self._inplace_version += 1
         return self
 
     def _inplace_op(self, name: str, *args, **kwargs) -> "Tensor":
@@ -207,8 +215,63 @@ class Tensor:
     def tanh_(self) -> "Tensor":
         return self._inplace_op("tanh")
 
-    def tolist(self):
-        return np.asarray(self.value).tolist()
+    def ceil_(self) -> "Tensor":
+        return self._inplace_op("ceil")
+
+    def floor_(self) -> "Tensor":
+        return self._inplace_op("floor")
+
+    def round_(self) -> "Tensor":
+        return self._inplace_op("round")
+
+    def exp_(self) -> "Tensor":
+        return self._inplace_op("exp")
+
+    def sqrt_(self) -> "Tensor":
+        return self._inplace_op("sqrt")
+
+    def rsqrt_(self) -> "Tensor":
+        return self._inplace_op("rsqrt")
+
+    def reciprocal_(self) -> "Tensor":
+        return self._inplace_op("reciprocal")
+
+    def clip_(self, min=None, max=None) -> "Tensor":  # noqa: A002
+        return self._inplace_op("clip", min, max)
+
+    def flatten_(self, start_axis: int = 0,
+                 stop_axis: int = -1) -> "Tensor":
+        return self._inplace_op("flatten", start_axis, stop_axis)
+
+    def gradient(self):
+        """Legacy accessor (reference: varbase_patch_methods.py
+        gradient()) — the accumulated grad as a numpy array, or None."""
+        if self.grad is None:
+            return None
+        return np.asarray(self.grad.value)
+
+    @property
+    def inplace_version(self) -> int:
+        """reference: Tensor.inplace_version — bumped on each in-place
+        write (used by autograd safety checks there; informational
+        here since in-place ops are functional underneath)."""
+        return self._inplace_version
+
+    @property
+    def block(self):
+        """reference: Tensor.block (the owning program block). The
+        traced world has no block under construction; returns the
+        current default program when one is active, else the global
+        startup-program holder so attribute access never lands on
+        None."""
+        from .static.api import default_startup_program
+        from .static.program import default_main_program
+        return default_main_program() or default_startup_program()
+
+    def where(self, x, y) -> "Tensor":
+        """reference: Tensor.where(x, y) — self is the bool condition."""
+        from . import dispatch
+        return dispatch.apply("where", self, x, y)
 
     # -- python protocol ------------------------------------------------------
 

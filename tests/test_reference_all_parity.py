@@ -103,3 +103,50 @@ def test_signature_parity_frozen():
         sys.path.pop(0)
     findings = audit()
     assert not findings, findings
+
+
+@pytest.mark.skipif(not os.path.isdir(REF),
+                    reason="reference tree not present")
+def test_tensor_method_parity():
+    """Every method the reference patches onto Tensor
+    (tensor/__init__.py import list + varbase_patch_methods.py) exists on
+    our Tensor, except names that are actually free functions / static
+    graph plumbing."""
+    import re
+
+    import numpy as np
+
+    import paddle_tpu as pt
+
+    names = set()
+    tree = ast.parse(open(os.path.join(REF, "tensor/__init__.py")).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                names.add(a.asname or a.name)
+    vb = open(os.path.join(
+        REF, "fluid/dygraph/varbase_patch_methods.py")).read()
+    for m in re.finditer(r'\("([a-z_0-9]+)",', vb):
+        names.add(m.group(1))
+
+    # free functions / creation APIs / static-graph (LoDTensorArray)
+    # plumbing the reference lists alongside methods but never calls
+    # through a tensor receiver
+    not_methods = {
+        "arange", "empty", "eye", "full", "linspace", "meshgrid", "ones",
+        "zeros", "rand", "randn", "randint", "randperm", "normal",
+        "uniform", "standard_normal", "to_tensor", "set_printoptions",
+        "is_tensor", "broadcast_shape", "add_n", "concat", "where",
+        "multiplex", "scatter_nd", "create_array", "array_length",
+        "array_read", "array_write", "gradient", "inplace_version",
+        "block",
+    }
+    t = pt.to_tensor(np.ones((2, 2), "float32"))
+    missing = sorted(n for n in names
+                     if not n.startswith("_") and n not in not_methods
+                     and not hasattr(t, n))
+    assert not missing, missing
+    # the method-flavored extras exist too
+    for extra in ("gradient", "inplace_version", "block", "where",
+                  "sqrt_", "clip_", "flatten_"):
+        assert hasattr(t, extra), extra
