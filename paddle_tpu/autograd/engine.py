@@ -22,6 +22,7 @@ import numpy as np
 class _TLS(threading.local):
     def __init__(self):
         self.grad_enabled = True
+        self.guard_stack = []
 
 
 _tls = _TLS()
@@ -37,39 +38,68 @@ def set_grad_enabled(mode: bool) -> None:
 
 class no_grad:  # noqa: N801 - reference API name
     """Disable grad recording — usable as a context manager OR a
-    decorator (reference: paddle.no_grad(func) wraps func)."""
-
-    def __init__(self, func=None):
-        self._func = func
-        self._prev = None
-        if func is not None:
-            import functools
-
-            @functools.wraps(func)
-            def wrapper(*args, **kwargs):
-                with no_grad():
-                    return func(*args, **kwargs)
-            self._wrapper = wrapper
+    decorator (reference: paddle.no_grad(func) wraps func). The decorator
+    path returns a plain function so instance methods bind ``self``
+    normally; the context path keeps a stack of prior states so one
+    instance nests safely."""
 
     def __new__(cls, func=None):
-        inst = super().__new__(cls)
-        return inst
+        if func is not None:
+            import functools
+            import inspect
+
+            if inspect.isgeneratorfunction(func):
+                # Hold the guard across iteration, not just generator
+                # creation (reference decorates generator functions the
+                # same way, fluid/dygraph/base.py _decorate_function).
+                # Full delegation: send()/throw()/return-value all pass
+                # through; only the inner generator's advances run with
+                # grad disabled.
+                @functools.wraps(func)
+                def wrapper(*args, **kwargs):
+                    gen = func(*args, **kwargs)
+                    try:
+                        with no_grad():
+                            item = next(gen)
+                        while True:
+                            try:
+                                sent = yield item
+                            except GeneratorExit:
+                                with no_grad():
+                                    gen.close()
+                                raise
+                            except BaseException as exc:
+                                with no_grad():
+                                    item = gen.throw(exc)
+                            else:
+                                with no_grad():
+                                    item = gen.send(sent)
+                    except StopIteration as stop:
+                        return stop.value
+            else:
+                @functools.wraps(func)
+                def wrapper(*args, **kwargs):
+                    with no_grad():
+                        return func(*args, **kwargs)
+            return wrapper
+        return super().__new__(cls)
 
     def __call__(self, *args, **kwargs):
-        if self._func is not None:
-            return self._wrapper(*args, **kwargs)
         # @paddle.no_grad() decorator-instance form (reference-valid)
         if len(args) == 1 and not kwargs and callable(args[0]):
             return no_grad(args[0])
         raise TypeError("no_grad() context instance is not callable")
 
     def __enter__(self):
-        self._prev = _tls.grad_enabled
+        # The saved-state stack lives in thread-local storage (not on the
+        # instance): one shared instance stays correct across threads and
+        # nested re-entry.
+        _tls.guard_stack.append(_tls.grad_enabled)
         _tls.grad_enabled = False
         return self
 
     def __exit__(self, *exc):
-        _tls.grad_enabled = self._prev
+        _tls.grad_enabled = _tls.guard_stack.pop()
         return False
 
 

@@ -468,8 +468,12 @@ class Fleet:
     def __init__(self):
         self._role_maker = None
 
-    def init(self, role_maker=None, is_collective: bool = True,
+    def init(self, role_maker=None, is_collective: bool = False,
              strategy=None):
+        # reference Fleet.init defaults is_collective=False
+        # (fleet/base/fleet_base.py:139) — PS users calling Fleet().init()
+        # must not silently get collective mode. The module-level init()
+        # keeps its TPU-mainline default of True.
         self._role_maker = role_maker or PaddleCloudRoleMaker(
             is_collective=is_collective)
         return init(role_maker, is_collective, strategy)

@@ -401,27 +401,33 @@ def density_prior_box(feature_h, feature_w, image_h, image_w, fixed_sizes,
     flatten_to_2d."""
     sw = step_w or image_w / feature_w
     sh = step_h or image_h / feature_h
+    # density_prior_box_op.h:68-101: the density grid is laid out over one
+    # step cell (step_average), not over the fixed_size, and box coords are
+    # clamped into [0,1] regardless of the clip attr.
+    step_average = int((sw + sh) * 0.5)
     cx = (jnp.arange(feature_w) + offset) * sw
     cy = (jnp.arange(feature_h) + offset) * sh
     boxes = []
     for size, dens in zip(fixed_sizes, densities):
-        shift = int(size / dens)
+        shift = step_average // dens
         for ratio in fixed_ratios:
             bw = size * float(ratio) ** 0.5
             bh = size / float(ratio) ** 0.5
-            for dy in range(dens):
-                for dx in range(dens):
-                    ccx = cx[None, :] + (dx + 0.5) * shift - size / 2.0
-                    ccy = cy[:, None] + (dy + 0.5) * shift - size / 2.0
+            origin = -step_average / 2.0 + shift / 2.0
+            for di in range(dens):
+                for dj in range(dens):
+                    ccx = cx[None, :] + origin + dj * shift
+                    ccy = cy[:, None] + origin + di * shift
                     ccx = jnp.broadcast_to(ccx, (feature_h, feature_w))
                     ccy = jnp.broadcast_to(ccy, (feature_h, feature_w))
                     boxes.append(jnp.stack(
-                        [(ccx - bw / 2.0) / image_w,
-                         (ccy - bh / 2.0) / image_h,
-                         (ccx + bw / 2.0) / image_w,
-                         (ccy + bh / 2.0) / image_h], axis=-1))
+                        [jnp.maximum((ccx - bw / 2.0) / image_w, 0.0),
+                         jnp.maximum((ccy - bh / 2.0) / image_h, 0.0),
+                         jnp.minimum((ccx + bw / 2.0) / image_w, 1.0),
+                         jnp.minimum((ccy + bh / 2.0) / image_h, 1.0)],
+                        axis=-1))
     out = jnp.stack(boxes, axis=2)  # [fh, fw, P, 4]
-    if clip:
+    if clip:  # ClipFunctor pass: force every coordinate into [0, 1]
         out = jnp.clip(out, 0.0, 1.0)
     var = jnp.broadcast_to(jnp.asarray(variances, out.dtype), out.shape)
     if flatten_to_2d:

@@ -256,8 +256,23 @@ def clip(x, min=None, max=None):  # noqa: A002
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
     out = x * scale + bias if bias_after_scale else (x + bias) * scale
     if act is not None:
-        import jax.nn as _jnn
-        out = getattr(_jnn, act, getattr(jnp, act, None))(out)
+        # Route through the op registry (the same table nn layers use as
+        # F[name]) so activation numerics match the registered ops — e.g.
+        # gelu here is the exact erf form, not jax.nn's tanh approximation.
+        from .. import dispatch
+        act_fn = (dispatch.wrapped_ops.get(act)
+                  or dispatch.wrapped_ops.get(act.replace("_", "")))
+        if act_fn is None:
+            # Fluid attr spellings not in the registry (e.g. older
+            # underscore names) fall back to jax.nn / jnp.
+            import jax.nn as _jnn
+            act_fn = getattr(_jnn, act, getattr(jnp, act, None))
+        if act_fn is None:
+            from ..core.enforce import InvalidArgumentError
+            raise InvalidArgumentError(
+                "scale(): unknown activation %r (not a registered op and "
+                "not found in jax.nn or jax.numpy)" % (act,))
+        out = act_fn(out)
     return out
 
 

@@ -366,9 +366,11 @@ def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
 
 def auc(input, label, curve="ROC", num_thresholds=4095,  # noqa: A002
         topk=1, slide_steps=1):
-    """reference: paddle.static.auc — one-shot ROC AUC via the
-    rank-statistic (Mann-Whitney) formulation; returns (auc, ...) like the
-    reference's first output."""
+    """reference: paddle.static.auc (fluid/layers/metric_op.py:257) —
+    returns (auc_out, batch_auc_out, [batch_stat_pos, batch_stat_neg,
+    stat_pos, stat_neg]). One-shot ROC AUC via the rank-statistic
+    (Mann-Whitney) formulation; the batch AUC equals the global AUC and the
+    stat vars hold the positive/negative histogram over thresholds."""
     x = input.value if isinstance(input, Tensor) else jnp.asarray(input)
     y = label.value if isinstance(label, Tensor) else jnp.asarray(label)
     score = x[:, 1] if x.ndim == 2 and x.shape[1] == 2 else x.reshape(-1)
@@ -380,7 +382,19 @@ def auc(input, label, curve="ROC", num_thresholds=4095,  # noqa: A002
     neg = y.size - pos
     sum_rank_pos = jnp.sum(jnp.where(y > 0, ranks.astype(jnp.float32), 0.0))
     a = (sum_rank_pos - pos * (pos + 1) / 2.0) / jnp.maximum(pos * neg, 1.0)
-    return Tensor(a)
+    auc_out = Tensor(a)
+    # Threshold-bucketed stat vars, same shape contract as the reference's
+    # StatPos/StatNeg ([1, num_thresholds + 1]).
+    bucket = jnp.clip((score * num_thresholds).astype(jnp.int32),
+                      0, num_thresholds)
+    stat_pos = jnp.zeros((1, num_thresholds + 1), jnp.int32).at[
+        0, bucket].add(jnp.where(y > 0, 1, 0).astype(jnp.int32))
+    stat_neg = jnp.zeros((1, num_thresholds + 1), jnp.int32).at[
+        0, bucket].add(jnp.where(y > 0, 0, 1).astype(jnp.int32))
+    batch_auc_out = Tensor(a)
+    states = [Tensor(stat_pos), Tensor(stat_neg),
+              Tensor(stat_pos), Tensor(stat_neg)]
+    return auc_out, batch_auc_out, states
 
 
 # -- program (de)serialization ------------------------------------------------

@@ -152,7 +152,10 @@ def test_static_auc_and_accuracy():
     scores = pt.to_tensor(np.array(
         [[0.3, 0.7], [0.6, 0.4], [0.2, 0.8], [0.9, 0.1]], "float32"))
     labels = pt.to_tensor(np.array([1, 0, 1, 0]))
-    assert float(st.auc(scores, labels).numpy()) == pytest.approx(1.0)
+    auc_out, batch_auc, states = st.auc(scores, labels)
+    assert float(auc_out.numpy()) == pytest.approx(1.0)
+    assert float(batch_auc.numpy()) == pytest.approx(1.0)
+    assert len(states) == 4 and int(states[0].numpy().sum()) == 2
     acc = st.accuracy(scores, pt.to_tensor(np.array([[1], [0], [1], [0]])))
     assert float(np.asarray(acc.value if hasattr(acc, "value") else acc)) \
         == pytest.approx(1.0)

@@ -102,6 +102,16 @@ def test_detection_map_and_pnpair():
 def test_density_prior_box_and_target_assign():
     b, v = pt.density_prior_box(4, 4, 32, 32, [8.0], [1.0], [2])
     assert tuple(b.shape) == (4, 4, 4, 4)  # density 2 -> 4 priors
+    # fixed_size != step: the density grid spans one step cell
+    # (density_prior_box_op.h:69-101): step=16, step_average=16, shift=8,
+    # density centers at center - 8 + 4 + {0,8}; box coords clamped to
+    # [0,1] regardless of clip.
+    b2, _ = pt.density_prior_box(2, 2, 32, 32, [4.0], [1.0], [2])
+    np.testing.assert_allclose(
+        np.asarray(b2.value)[0, 0, 0], [0.0625, 0.0625, 0.1875, 0.1875])
+    b3, _ = pt.density_prior_box(2, 2, 32, 32, [40.0], [1.0], [1])
+    assert float(np.asarray(b3.value)[0, 0, 0, 0]) == 0.0  # clamped
+    assert float(np.asarray(b3.value)[1, 1, 0, 2]) == 1.0  # clamped
     out, w = pt.target_assign(
         pt.to_tensor(np.arange(12.0, dtype="float32").reshape(4, 3)),
         pt.to_tensor(np.array([[0, -1], [2, 3]])), mismatch_value=-5.0)
