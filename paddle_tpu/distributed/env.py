@@ -35,6 +35,17 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
         os.environ.get("PT_PROCESS_ID", os.environ.get(
             "PADDLE_TRAINER_ID", "0")))
     if coord and nproc > 1:
+        # CPU backend needs an explicit cross-process collectives
+        # implementation (the TPU backend rides ICI/DCN natively). gloo is
+        # the reference's CPU fabric too (framework/fleet/gloo_wrapper.cc);
+        # PT_CPU_COLLECTIVES=none opts out.
+        impl = os.environ.get("PT_CPU_COLLECTIVES", "gloo")
+        if impl and impl != "none":
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  impl)
+            except Exception:
+                pass  # older jax without the option
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid)
     _initialized = True

@@ -57,12 +57,19 @@ def launch_procs(entry: List[str], nproc: int, coordinator: str,
     return procs
 
 
-def watch_procs(procs: List[ProcInfo], poll_s: float = 1.0) -> int:
+def watch_procs(procs: List[ProcInfo], poll_s: float = 1.0,
+                timeout_s: Optional[float] = None) -> int:
     """Reference behavior (fleet/elastic.py:36 LauncherInterface
     _check_procs): any rank failing tears the job down; returns the exit
-    code."""
+    code. ``timeout_s`` bounds the whole job (returns 124, like
+    timeout(1))."""
+    deadline = time.time() + timeout_s if timeout_s else None
     try:
         while True:
+            if deadline and time.time() > deadline:
+                print("job timed out; terminating", file=sys.stderr)
+                terminate_procs(procs)
+                return 124
             alive = 0
             for info in procs:
                 ret = info.proc.poll()

@@ -275,6 +275,12 @@ class PSServer:
         self.sparse: Dict[str, SparseTable] = {}
         self._barrier_count = 0
         self._barrier_lock = threading.Lock()
+        # Blocking rendezvous barrier (sync-PS lockstep, reference:
+        # brpc_ps_server barrier service): arrivals wait until `world`
+        # trainers reach the same generation.
+        self._rdv_cv = threading.Condition()
+        self._rdv_arrived = 0
+        self._rdv_generation = 0
         # Handler threads are daemonic and may sit blocked in _recv_msg on
         # idle connections, so stop() cannot join them. Instead dispatches
         # are counted: stop() flips _stopping (new mutations get a NACK,
@@ -362,6 +368,20 @@ class PSServer:
                         "sparse": {k: v.size()
                                    for k, v in self.sparse.items()}}
             if cmd == BARRIER:
+                world = int(msg.get("world", 0))
+                if world > 1:
+                    # blocking rendezvous: wait for `world` arrivals
+                    with self._rdv_cv:
+                        gen = self._rdv_generation
+                        self._rdv_arrived += 1
+                        if self._rdv_arrived >= world:
+                            self._rdv_arrived = 0
+                            self._rdv_generation += 1
+                            self._rdv_cv.notify_all()
+                        else:
+                            while (self._rdv_generation == gen
+                                   and not self._stopping):
+                                self._rdv_cv.wait(timeout=1.0)
                 with self._barrier_lock:
                     self._barrier_count += 1
                     n = self._barrier_count
@@ -499,9 +519,17 @@ class PSClient:
                              "keys": keys[mask].tolist(),
                              "delta": deltas[mask]})
 
-    def barrier(self) -> None:
+    def barrier(self, world: int = 0) -> None:
+        """world > 1: blocking rendezvous across that many trainers
+        (sync-PS lockstep); otherwise the legacy counter ping."""
         for srv in range(len(self.endpoints)):
-            self._call(srv, {"cmd": BARRIER})
+            self._call(srv, {"cmd": BARRIER, "world": world})
+
+    def close(self) -> None:
+        """Disconnect without stopping the servers (a trainer leaving a
+        shared job)."""
+        for s in self._socks:
+            s.close()
 
     def stop(self) -> None:
         for srv in range(len(self.endpoints)):

@@ -1,0 +1,144 @@
+"""Multi-process distributed worker model script.
+
+TestDistBase analog (reference:
+python/paddle/fluid/tests/unittests/test_dist_base.py:743 spawns worker
+scripts like dist_mnist.py as localhost subprocesses with the fleetrun
+env contract, then asserts loss parity between 1-proc and N-proc runs).
+
+This script is launched by tests/test_dist_procs.py (directly for the
+1-proc baseline, via paddle_tpu.distributed.launch for N procs). Each
+process: force CPU with PT_LOCAL_DEVICES virtual devices, bootstrap
+jax.distributed through init_parallel_env (gloo cross-process
+collectives), build the fleet mesh over ALL global devices, and train
+GPT-tiny on deterministic synthetic data. Per-step losses are written to
+``$PT_DIST_OUT.<rank>`` as JSON.
+
+Env contract (set by the launcher / test):
+  PT_PROCESS_ID / PT_NUM_PROCESSES / PT_COORDINATOR_ADDRESS  bootstrap
+  PT_LOCAL_DEVICES   virtual CPU devices per process (default 2)
+  PT_DIST_STEPS      training steps (default 4)
+  PT_DIST_BATCH      global batch size (default 8)
+  PT_DIST_HYBRID     "dp" (default) or "dp_mp" (mp_degree=2 hybrid)
+  PT_DIST_OUT        output path prefix for the loss JSON
+  PT_DIST_CKPT       checkpoint path; save each step, resume if present
+  PT_DIST_FAIL_RANK / PT_DIST_FAIL_STEP / PT_DIST_FAIL_ONCE_FILE
+                     simulate a transient crash: that rank exits with
+                     ELASTIC_EXIT_CODE at the start of that step, once —
+                     the marker file records that the crash already
+                     happened so the elastic relaunch completes
+"""
+
+import json
+import os
+import pickle
+
+
+def save_ckpt(path, step_obj, next_step):
+    """Atomic full-state checkpoint (params + optimizer state).
+
+    dp-only meshes keep params/slots replicated, so np.asarray of the
+    global arrays is process-local-safe."""
+    import jax
+    import numpy as np
+    state = {
+        "next_step": next_step,
+        "params": {n: np.asarray(v) for n, v in step_obj.params.items()},
+        "buffers": {n: np.asarray(v)
+                    for n, v in step_obj.buffers.items()},
+        "opt": jax.tree_util.tree_map(lambda v: np.asarray(v),
+                                      step_obj.opt_state),
+    }
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(state, f)
+    os.replace(tmp, path)
+
+
+def load_ckpt(path, step_obj):
+    import jax
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    step_obj.params = {
+        n: jax.device_put(v, step_obj.param_shardings[n])
+        for n, v in state["params"].items()}
+    step_obj.buffers = {
+        n: jax.device_put(v, step_obj.buffer_shardings[n])
+        for n, v in state["buffers"].items()}
+    shardings = {"slots": step_obj.opt_shardings["slots"],
+                 "step": step_obj.opt_shardings["step"]}
+    step_obj.opt_state = jax.tree_util.tree_map(
+        lambda v, s: jax.device_put(v, s), state["opt"], shardings)
+    return state["next_step"]
+
+
+def main():
+    local_dev = os.environ.get("PT_LOCAL_DEVICES", "2")
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local_dev}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.distributed.env import init_parallel_env
+    init_parallel_env()
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.distributed import DistributedStrategy, fleet
+    from paddle_tpu.distributed.elastic import ELASTIC_EXIT_CODE
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+    rank = jax.process_index()
+    n_dev = jax.device_count()
+
+    strategy = DistributedStrategy()
+    if os.environ.get("PT_DIST_HYBRID", "dp") == "dp_mp":
+        strategy.hybrid_configs = {"dp_degree": n_dev // 2, "mp_degree": 2}
+    else:
+        strategy.hybrid_configs = {"dp_degree": n_dev}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    pt.seed(42)
+    model = GPTForCausalLM(gpt_tiny())
+    opt = optim.SGD(learning_rate=0.1)
+    step = fleet.distributed_jit(model, opt,
+                                 lambda m, b: m(b[0], labels=b[1]))
+
+    steps = int(os.environ.get("PT_DIST_STEPS", "4"))
+    batch = int(os.environ.get("PT_DIST_BATCH", "8"))
+    fail_rank = int(os.environ.get("PT_DIST_FAIL_RANK", "-1"))
+    fail_step = int(os.environ.get("PT_DIST_FAIL_STEP", "-1"))
+    ckpt = os.environ.get("PT_DIST_CKPT")
+
+    start = 0
+    if ckpt and os.path.exists(ckpt):
+        start = load_ckpt(ckpt, step)
+
+    fail_once = os.environ.get("PT_DIST_FAIL_ONCE_FILE")
+    losses = []
+    for i in range(start, steps):
+        if (i == fail_step and rank == fail_rank and fail_once
+                and not os.path.exists(fail_once)):
+            with open(fail_once, "w") as f:
+                f.write("crashed")
+            os._exit(ELASTIC_EXIT_CODE)
+        # global batch is a pure function of the step index: every
+        # process generates the same array; device_put shards it
+        rng = np.random.default_rng(1000 + i)
+        ids = rng.integers(0, 1024, size=(batch, 32)).astype(np.int32)
+        losses.append(float(step((ids, ids))))
+        if ckpt and rank == 0:
+            save_ckpt(ckpt, step, i + 1)
+
+    out = os.environ.get("PT_DIST_OUT")
+    if out:
+        with open(f"{out}.{rank}", "w") as f:
+            json.dump({"rank": rank, "world": jax.process_count(),
+                       "n_dev": n_dev, "start": start,
+                       "losses": losses}, f)
+    print(json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    main()

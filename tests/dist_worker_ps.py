@@ -1,0 +1,122 @@
+"""Parameter-server-mode multi-process worker script.
+
+Reference pattern: test_dist_base.py:959 _run_cluster starts pserver
+subprocesses plus trainer subprocesses and checks training progress.
+Here the server process hosts a PSServer (dense SGD table over real
+sockets) and trainer processes run lockstep synchronous SGD on a linear
+regression: pull weights, compute the local-shard gradient, push, and
+rendezvous on the server-side blocking barrier — so the 2-trainer run
+applies exactly the same global-batch updates as a 1-trainer run
+(sync-PS semantics; async/geo modes are covered in-process by
+tests/test_native_ps.py and test_heavy_dataset_geo_ps.py).
+
+Env contract:
+  PT_ROLE              "server" | "trainer"
+  PT_PS_ENDPOINT_FILE  server writes host:port here; trainers poll it
+  PT_PS_DONE_DIR       trainers drop rank files here; server exits when
+                       all PT_PS_TRAINERS have finished
+  PT_PS_TRAINERS       number of trainer processes
+  PT_PS_TRAINER_ID     this trainer's id
+  PT_PS_STEPS          sgd steps (default 30)
+  PT_DIST_OUT          per-trainer JSON output path prefix
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def make_data():
+    """Deterministic synthetic regression task shared by every process."""
+    rng = np.random.default_rng(3)
+    w_true = rng.normal(size=(8, 1)).astype(np.float32)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = x @ w_true
+    return x, y
+
+
+def run_server():
+    from paddle_tpu.distributed.ps import PSServer
+    server = PSServer()
+    server.add_dense_table("w", (8, 1), optimizer="sgd", lr=0.1)
+    server.start()
+    with open(os.environ["PT_PS_ENDPOINT_FILE"] + ".tmp", "w") as f:
+        f.write(f"{server.host}:{server.port}")
+    os.replace(os.environ["PT_PS_ENDPOINT_FILE"] + ".tmp",
+               os.environ["PT_PS_ENDPOINT_FILE"])
+    done_dir = os.environ["PT_PS_DONE_DIR"]
+    n_trainers = int(os.environ["PT_PS_TRAINERS"])
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            if len(os.listdir(done_dir)) >= n_trainers:
+                break
+        except FileNotFoundError:
+            pass
+        time.sleep(0.05)
+    server.stop()
+
+
+def run_trainer():
+    from paddle_tpu.distributed.ps import PSClient
+    ep_file = os.environ["PT_PS_ENDPOINT_FILE"]
+    deadline = time.time() + 60
+    while not os.path.exists(ep_file):
+        if time.time() > deadline:
+            raise TimeoutError("server endpoint never appeared")
+        time.sleep(0.05)
+    with open(ep_file) as f:
+        endpoint = f.read().strip()
+
+    tid = int(os.environ["PT_PS_TRAINER_ID"])
+    world = int(os.environ["PT_PS_TRAINERS"])
+    steps = int(os.environ.get("PT_PS_STEPS", "30"))
+
+    client = PSClient([endpoint])
+    x, y = make_data()
+    # disjoint row shards, reference DistributedBatchSampler-style
+    shard = slice(tid * (len(x) // world), (tid + 1) * (len(x) // world))
+    xs, ys = x[shard], y[shard]
+
+    if tid == 0:
+        client.push_dense_init("w", np.zeros((8, 1), np.float32))
+    client.barrier(world=world)  # everyone sees the initialized table
+
+    losses = []
+    for _ in range(steps):
+        w = client.pull_dense("w")
+        client.barrier(world=world)  # all pulls see the same w ...
+        pred = xs @ w
+        err = pred - ys
+        losses.append(float((err ** 2).mean()))
+        # grad of mean-over-global-batch MSE: each trainer contributes
+        # its shard's sum / global_n, so the pushed grads add up to the
+        # exact full-batch gradient
+        grad = (2.0 / len(x)) * (xs.T @ err)
+        client.push_dense_grad("w", grad.astype(np.float32))
+        client.barrier(world=world)  # ... and all pushes land per step
+
+    w_final = client.pull_dense("w")
+    out = os.environ.get("PT_DIST_OUT")
+    if out:
+        with open(f"{out}.{tid}", "w") as f:
+            json.dump({"trainer": tid, "losses": losses,
+                       "w": w_final.ravel().tolist()}, f)
+    os.makedirs(os.environ["PT_PS_DONE_DIR"], exist_ok=True)
+    with open(os.path.join(os.environ["PT_PS_DONE_DIR"], str(tid)),
+              "w") as f:
+        f.write("done")
+    client.close()
+
+
+def main():
+    if os.environ["PT_ROLE"] == "server":
+        run_server()
+    else:
+        run_trainer()
+
+
+if __name__ == "__main__":
+    main()
