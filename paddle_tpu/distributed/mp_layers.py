@@ -60,15 +60,26 @@ def no_sharding_constraints():
 
 
 def _constrain(x, *spec):
-    """Apply a sharding constraint when a mesh is active (inside pjit)."""
+    """Apply a sharding constraint when a mesh is active (inside pjit).
+
+    Inside a manual-subset shard_map (the hybrid pipeline runs manual
+    over "pp" with dp/mp/sharding/sep left to GSPMD), the constraint must
+    carry a bare PartitionSpec resolved against the context's abstract
+    mesh — a NamedSharding over the concrete mesh has all-Auto axis types
+    and is rejected in the backward pass."""
     hcg = get_hybrid_communicate_group()
     from jax._src import core as _jax_core
     if hcg is None or _constraints_disabled or \
             _jax_core.trace_state_clean():
         return x
     raw = x.value if isinstance(x, Tensor) else x
-    out = jax.lax.with_sharding_constraint(
-        raw, jax.sharding.NamedSharding(hcg.mesh, P(*spec)))
+    try:
+        manual = bool(jax.sharding.get_abstract_mesh().manual_axes)
+    except Exception:
+        manual = False
+    sharding = (P(*spec) if manual
+                else jax.sharding.NamedSharding(hcg.mesh, P(*spec)))
+    out = jax.lax.with_sharding_constraint(raw, sharding)
     return Tensor(out, stop_gradient=getattr(x, "stop_gradient", True)) \
         if isinstance(x, Tensor) else out
 

@@ -49,6 +49,12 @@ def _split_block_params(params: Dict[str, jax.Array], num_layers: int
     return stacked, shared
 
 
+def _param_pspecs(model) -> Dict[str, P]:
+    """Tensor-parallel PartitionSpec per param name (P() when dense)."""
+    return {n: (getattr(p, "pspec", None) or P())
+            for n, p in model.named_parameters()}
+
+
 def _merge_block_params(stacked: Dict[str, jax.Array],
                         shared: Dict[str, jax.Array], num_layers: int
                         ) -> Dict[str, jax.Array]:
@@ -60,11 +66,24 @@ def _merge_block_params(stacked: Dict[str, jax.Array],
 
 
 class GPTPipelineTrainStep:
-    """shard_map(pp × dp) train step for GPTForCausalLM."""
+    """shard_map(pp × dp) train step for GPTForCausalLM.
+
+    Two modes:
+    - standalone (default): builds its own (pp, dp) mesh, everything
+      inside shard_map is fully manual.
+    - hybrid (``hcg=`` the fleet HybridCommunicateGroup): runs on the ONE
+      global mesh with manual={"pp"} only — tensor parallel (mp) and
+      sequence parallel (sep) ride GSPMD constraints inside each stage,
+      the batch shards over dp×sharding, and optimizer slots ZeRO-shard
+      over ``zero_axis``. This is the reference's hardest composition
+      (sharding_optimizer.py:968 _build_groups pp×mp×sharding interplay)
+      expressed as one SPMD program.
+    """
 
     def __init__(self, config: GPTConfig, optimizer, pp: int, dp: int = 1,
                  n_micro: int = 2, devices=None, remat: bool = False,
-                 seed: int = 0, schedule: str = "fthenb"):
+                 seed: int = 0, schedule: str = "fthenb", hcg=None,
+                 zero_axis: Optional[str] = None):
         assert config.num_layers % pp == 0, "layers must divide pp"
         assert config.dropout == 0.0 and config.attn_dropout == 0.0, \
             "pipeline step requires dropout=0 (rng is not plumbed per-stage)"
@@ -75,24 +94,85 @@ class GPTPipelineTrainStep:
         pt.seed(seed)
         self.model = GPTForCausalLM(config)
         self.model.eval()  # dropout off; training math identical
-        devices = list(devices if devices is not None else jax.devices())
-        dev = np.asarray(devices[:pp * dp]).reshape(pp, dp)
-        self.mesh = Mesh(dev, ("pp", "dp"))
+        self.hybrid = hcg is not None
+        if self.hybrid:
+            self.mesh = hcg.mesh
+            assert self.mesh.shape["pp"] == pp, \
+                (self.mesh.shape, pp)
+        else:
+            devices = list(devices if devices is not None
+                           else jax.devices())
+            dev = np.asarray(devices[:pp * dp]).reshape(pp, dp)
+            self.mesh = Mesh(dev, ("pp", "dp"))
         state = functional_state(self.model)
         stacked, shared = _split_block_params(state["params"],
                                               config.num_layers)
-        self.stacked = jax.device_put(
-            stacked, NamedSharding(self.mesh, P("pp")))
-        self.shared = jax.device_put(
-            shared, NamedSharding(self.mesh, P()))
+        if self.hybrid:
+            pspecs = _param_pspecs(self.model)
+            # every layer's suffix carries the same TP spec; index layer 0
+            stacked_specs = {
+                suf: P("pp", *pspecs[f"gpt.h.0.{suf}"])
+                for suf in stacked}
+            # embed/head/final-norm run replicated on every stage (by
+            # design — tied-embedding sync for free); also, a
+            # vocab-sharded embedding gather inside a manual-pp subgroup
+            # trips XLA's SPMD partitioner, so mp shards block matmuls
+            # only.
+            shared_specs = {n: P() for n in shared}
+            self.stacked = {
+                suf: jax.device_put(
+                    v, NamedSharding(self.mesh, stacked_specs[suf]))
+                for suf, v in stacked.items()}
+            self.shared = {
+                n: jax.device_put(
+                    v, NamedSharding(self.mesh, shared_specs[n]))
+                for n, v in shared.items()}
+            self._data_axes = tuple(
+                ax for ax in ("dp", "sharding")
+                if self.mesh.shape.get(ax, 1) > 1)
+        else:
+            self.stacked = jax.device_put(
+                stacked, NamedSharding(self.mesh, P("pp")))
+            self.shared = jax.device_put(
+                shared, NamedSharding(self.mesh, P()))
+            self._data_axes = ("dp",)
         params = {"stacked": self.stacked, "shared": self.shared}
         # slots inherit their param's sharding (stacked slots ride pp)
         self.opt_state = optimizer.init(params)
+        if self.hybrid and zero_axis and \
+                self.mesh.shape.get(zero_axis, 1) > 1:
+            self._zero_shard_slots(zero_axis)
 
         assert schedule in ("fthenb", "1f1b"), schedule
         self.schedule = schedule
         self._step = (self._build(remat) if schedule == "fthenb"
                       else self._build_1f1b(remat))
+
+    def _zero_shard_slots(self, axis: str) -> None:
+        """ZeRO-1: moment slots of the stacked block params shard over
+        `axis` on their first free, divisible dim (reference:
+        sharding_optimizer.py optimizer-state sharding; the param itself
+        stays pp/mp-sharded). Shared embedding/head slots stay replicated:
+        they are small, and a sharded slot's spec propagates back onto the
+        embedding-gather operand, which XLA's gather partitioner cannot
+        handle under manual-pp subgroups."""
+        deg = self.mesh.shape[axis]
+
+        def reshard(slot):
+            if not isinstance(slot, jax.Array) or slot.ndim == 0:
+                return slot
+            spec = list(getattr(slot.sharding, "spec", P()) or [])
+            spec += [None] * (slot.ndim - len(spec))
+            for d in range(slot.ndim):
+                if spec[d] is None and slot.shape[d] % deg == 0 \
+                        and slot.shape[d] >= deg:
+                    spec[d] = axis
+                    return jax.device_put(
+                        slot, NamedSharding(self.mesh, P(*spec)))
+            return slot
+
+        self.opt_state["slots"]["stacked"] = jax.tree_util.tree_map(
+            reshard, self.opt_state["slots"]["stacked"])
 
     # -- functional pieces ----------------------------------------------------
 
@@ -145,11 +225,27 @@ class GPTPipelineTrainStep:
             return h
 
         sfn = jax.checkpoint(stage_fn) if remat else stage_fn
+        hybrid = self.hybrid
+        data_axes = self._data_axes
 
         def loss_fn(stacked, shared, ids, labels):
             def inner(stacked_l, shared_l, ids_l, labels_l):
-                # stacked_l: [L/pp, ...] local blocks; ids_l: dp-local batch
+                # stacked_l: [L/pp, ...] local blocks; ids_l: dp-local
+                # batch (standalone) or the global batch with auto
+                # dp/sharding sharding (hybrid)
+                if hybrid:
+                    # keep the embedding/CE gathers' indices replicated
+                    # (XLA's gather partitioner mishandles sharded
+                    # indices under manual-pp subgroups), then push the
+                    # activations onto the data axes
+                    ids_l = jax.lax.with_sharding_constraint(ids_l, P())
+                    labels_l = jax.lax.with_sharding_constraint(
+                        labels_l, P())
                 x = embed(shared_l, ids_l)  # [mb*nm, s, h]
+                if hybrid and data_axes:
+                    x = jax.lax.with_sharding_constraint(
+                        x, P(data_axes if len(data_axes) > 1
+                             else data_axes[0]))
                 b = x.shape[0]
                 mb = b // n_micro
                 x_micro = x.reshape(n_micro, mb, *x.shape[1:])
@@ -162,18 +258,24 @@ class GPTPipelineTrainStep:
                 stage = jax.lax.axis_index("pp")
                 loss = jnp.where(stage == n_stages - 1, loss, 0.0)
                 loss = jax.lax.psum(loss, "pp")
-                loss = jax.lax.pmean(loss, "dp")
+                if not hybrid:  # hybrid: dp is auto; mean is global
+                    loss = jax.lax.pmean(loss, "dp")
                 return loss
 
+            data_spec = P() if hybrid else P("dp")
             smapped = shard_map(
                 inner, mesh=mesh,
-                in_specs=(P("pp"), P(), P("dp"), P("dp")),
-                out_specs=P(), check_vma=False)
+                in_specs=(P("pp"), P(), data_spec, data_spec),
+                out_specs=P(), check_vma=False,
+                **({"axis_names": frozenset({"pp"})} if hybrid else {}))
             return smapped(stacked, shared, ids, labels)
 
         def step_impl(params, opt_state, lr, ids, labels):
             from ..distributed.mp_layers import no_sharding_constraints
-            with no_sharding_constraints():
+            import contextlib
+            guard = (contextlib.nullcontext() if hybrid
+                     else no_sharding_constraints())
+            with guard:
                 loss, grads = jax.value_and_grad(
                     lambda p: loss_fn(p["stacked"], p["shared"], ids,
                                       labels))(params)
@@ -205,6 +307,8 @@ class GPTPipelineTrainStep:
             h, _ = jax.lax.scan(body, x, blocks_local)
             return h
 
+        hybrid = self.hybrid
+
         def inner(stacked_l, shared_l, ids_l, labels_l):
             b, s = ids_l.shape
             mb = b // n_micro
@@ -223,21 +327,30 @@ class GPTPipelineTrainStep:
             loss_sum, d_stacked, d_shared = spmd_pipeline_1f1b(
                 stage_fn, stacked_l, shared_l, first_fn, last_fn,
                 n_micro, axis_name="pp", remat=remat)
-            loss = jax.lax.pmean(jax.lax.psum(loss_sum, "pp"), "dp")
-            d_stacked = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, "dp"), d_stacked)
+            loss = jax.lax.psum(loss_sum, "pp")
             d_shared = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(jax.lax.psum(g, "pp"), "dp"),
-                d_shared)
+                lambda g: jax.lax.psum(g, "pp"), d_shared)
+            if not hybrid:  # hybrid: dp/sharding are auto; GSPMD sums
+                loss = jax.lax.pmean(loss, "dp")
+                d_stacked = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, "dp"), d_stacked)
+                d_shared = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, "dp"), d_shared)
             return loss, d_stacked, d_shared
 
         def step_impl(params, opt_state, lr, ids, labels):
             from ..distributed.mp_layers import no_sharding_constraints
-            with no_sharding_constraints():
+            import contextlib
+            guard = (contextlib.nullcontext() if hybrid
+                     else no_sharding_constraints())
+            data_spec = P() if hybrid else P("dp")
+            with guard:
                 smapped = shard_map(
                     inner, mesh=mesh,
-                    in_specs=(P("pp"), P(), P("dp"), P("dp")),
-                    out_specs=(P(), P("pp"), P()), check_vma=False)
+                    in_specs=(P("pp"), P(), data_spec, data_spec),
+                    out_specs=(P(), P("pp"), P()), check_vma=False,
+                    **({"axis_names": frozenset({"pp"})} if hybrid
+                       else {}))
                 loss, d_stacked, d_shared = smapped(
                     params["stacked"], params["shared"], ids, labels)
             grads = {"stacked": d_stacked, "shared": d_shared}
@@ -250,9 +363,18 @@ class GPTPipelineTrainStep:
     def __call__(self, ids, labels) -> jax.Array:
         params = {"stacked": self.stacked, "shared": self.shared}
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        ids, labels = jnp.asarray(ids), jnp.asarray(labels)
+        if self.hybrid and self._data_axes:
+            # batch dim over dp×sharding (the pp split is handled by the
+            # manual shard_map in_specs)
+            bspec = NamedSharding(
+                self.mesh,
+                P(self._data_axes if len(self._data_axes) > 1
+                  else self._data_axes[0]))
+            ids = jax.device_put(ids, bspec)
+            labels = jax.device_put(labels, bspec)
         params, self.opt_state, loss = self._step(
-            params, self.opt_state, lr, jnp.asarray(ids),
-            jnp.asarray(labels))
+            params, self.opt_state, lr, ids, labels)
         self.stacked = params["stacked"]
         self.shared = params["shared"]
         return loss
