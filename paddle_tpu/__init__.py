@@ -54,6 +54,13 @@ def __getattr__(name):
         from .nn.initializer import ParamAttr as _PA
         globals()["ParamAttr"] = _PA
         return _PA
+    if name in ("TensorArray", "create_array", "array_write",
+                "array_read", "array_length", "tensor_array_to_tensor",
+                "array_to_lod_tensor", "lod_tensor_to_array"):
+        from .ops import control_flow as _cf
+        val = getattr(_cf, name)
+        globals()[name] = val
+        return val
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 

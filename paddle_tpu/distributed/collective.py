@@ -334,3 +334,26 @@ def split(x, size, operation: str = "linear", axis: int = 0,
                                   has_bias=bias_attr is not False,
                                   name=name)
     return layer(x)
+
+
+def c_identity(x, group=None):
+    """Public spelling of the identity-with-allreduce-grad collective
+    (reference: operators/collective/c_identity_op.cc)."""
+    from ..tensor import Tensor as _T
+    raw = x.value if isinstance(x, _T) else x
+    out = _c_identity(raw, group=group)
+    return _T(out) if isinstance(x, _T) else out
+
+
+def concat(x, group=None, axis: int = -1):
+    """Gather mp-sharded activations and concatenate along ``axis``
+    (reference: operators/collective/c_concat_op.cc — the
+    gather_output path of ColumnParallelLinear)."""
+    parts: list = []
+    all_gather(parts, x, group=group)
+    import jax.numpy as _jnp
+
+    from ..tensor import Tensor as _T
+    raw = [p.value if isinstance(p, _T) else p for p in parts]
+    out = _jnp.concatenate(raw, axis=axis)
+    return _T(out) if isinstance(x, _T) else out

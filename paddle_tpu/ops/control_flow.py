@@ -126,3 +126,190 @@ def switch_case(branch_index, branch_fns, default: Callable = None):
     branch = jnp.where(in_table, dense, len(fns))
     out = jax.lax.switch(branch, [mk(f) for f in fns] + [mk(default)], None)
     return _wrap(out)
+
+
+# --------------------------------------------------------------------------
+# TensorArray (reference: framework/lod_tensor_array.h:22 LoDTensorArray,
+# layers/control_flow.py:1459 array_write/array_read/array_length,
+# operators/array_to_lod_tensor_op.cc, tensor_array_to_tensor_op.cc,
+# controlflow/while_op.cc consumption). Dual mode:
+# - eager: plain python-list semantics (the reference's dygraph
+#   LoDTensorArray IS a list).
+# - traced: a fixed-capacity ring of one stacked buffer + a length
+#   scalar, registered as a jax pytree so it threads through
+#   lax.while_loop / lax.cond bodies; writes lower to
+#   dynamic_update_index (static shapes, XLA-friendly).
+# --------------------------------------------------------------------------
+
+class TensorArray:
+    """Dynamic tensor collection; traced mode needs `capacity`."""
+
+    def __init__(self, items=None, capacity: int = 0, example=None):
+        self._items: List[Any] = list(items) if items else []
+        self._buf = None
+        self._len = None
+        if capacity:
+            if example is None:
+                raise ValueError(
+                    "traced TensorArray needs an example element for "
+                    "shape/dtype (static shapes under jit)")
+            ex = example.value if isinstance(example, Tensor) else \
+                jnp.asarray(example)
+            self._buf = jnp.zeros((capacity,) + ex.shape, ex.dtype)
+            self._len = jnp.zeros((), jnp.int32)
+
+    # -- traced state as a pytree -------------------------------------
+    def _tree_flatten(self):
+        if self._buf is not None:
+            return (self._buf, self._len), ("traced",)
+        return tuple(self._items), ("eager",)
+
+    @classmethod
+    def _tree_unflatten(cls, aux, children):
+        ta = cls.__new__(cls)
+        if aux[0] == "traced":
+            ta._items = []
+            ta._buf, ta._len = children
+        else:
+            ta._items = list(children)
+            ta._buf = None
+            ta._len = None
+        return ta
+
+    @property
+    def traced(self) -> bool:
+        return self._buf is not None
+
+    def __len__(self):
+        if self.traced:
+            return int(self._len)
+        return len(self._items)
+
+
+jax.tree_util.register_pytree_node(
+    TensorArray,
+    lambda ta: ta._tree_flatten(),
+    lambda aux, children: TensorArray._tree_unflatten(aux, children))
+
+
+def create_array(dtype="float32", initialized_list=None) -> TensorArray:
+    """reference: paddle.tensor.create_array (fluid/layers/tensor.py)."""
+    return TensorArray(initialized_list)
+
+
+def array_write(x, i, array: TensorArray = None) -> TensorArray:
+    """reference: layers/control_flow.py:1459 array_write — write x at
+    index i (eager list append/replace; traced dynamic_update_index)."""
+    if array is None:
+        array = TensorArray()
+    raw = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    if array.traced:
+        idx = i.value if isinstance(i, Tensor) else jnp.asarray(i)
+        idx = idx.astype(jnp.int32).reshape(())
+        out = TensorArray.__new__(TensorArray)
+        out._items = []
+        out._buf = jax.lax.dynamic_update_index_in_dim(
+            array._buf, raw.astype(array._buf.dtype), idx, 0)
+        out._len = jnp.maximum(array._len, idx + 1)
+        return out
+    idx = int(i.value if isinstance(i, Tensor) else i)
+    while len(array._items) <= idx:
+        array._items.append(None)
+    array._items[idx] = Tensor(raw)
+    return array
+
+
+def array_read(array: TensorArray, i):
+    """reference: layers/control_flow.py array_read."""
+    if array.traced:
+        idx = i.value if isinstance(i, Tensor) else jnp.asarray(i)
+        return Tensor(jax.lax.dynamic_index_in_dim(
+            array._buf, idx.astype(jnp.int32).reshape(()), 0,
+            keepdims=False))
+    return array._items[int(i.value if isinstance(i, Tensor) else i)]
+
+
+def array_length(array: TensorArray):
+    """reference: layers/control_flow.py array_length /
+    lod_array_length_op.cc."""
+    if array.traced:
+        return Tensor(array._len)
+    return Tensor(jnp.asarray(len(array._items), jnp.int32))
+
+
+def tensor_array_to_tensor(array: TensorArray, axis: int = 0,
+                           use_stack: bool = False):
+    """reference: tensor_array_to_tensor_op.cc — concat (or stack) the
+    written elements. Traced mode returns the full-capacity stack and the
+    valid length (static shapes); eager concatenates exactly the written
+    items. Returns (tensor, index/length info)."""
+    if array.traced:
+        if use_stack:
+            out = jnp.moveaxis(array._buf, 0, axis)
+        elif axis == 0:
+            out = jnp.reshape(array._buf,
+                              (-1,) + array._buf.shape[2:])
+        else:
+            raise NotImplementedError(
+                "traced tensor_array_to_tensor supports axis=0 concat")
+        return Tensor(out), Tensor(array._len)
+    gaps = [i for i, t in enumerate(array._items) if t is None]
+    if gaps:
+        raise ValueError(
+            f"tensor_array_to_tensor: uninitialized slots {gaps} "
+            "(array_write skipped those indices)")
+    vals = [t.value if isinstance(t, Tensor) else jnp.asarray(t)
+            for t in array._items]
+    sizes = jnp.asarray([v.shape[axis] if not use_stack else 1
+                         for v in vals], jnp.int32)
+    out = jnp.stack(vals, axis=axis) if use_stack else \
+        jnp.concatenate(vals, axis=axis)
+    return Tensor(out), Tensor(sizes)
+
+
+def array_to_lod_tensor(array: TensorArray, table=None):
+    """reference: array_to_lod_tensor_op.cc — collapse a TensorArray to
+    one ragged batch (RaggedTensor analog of LoDTensor)."""
+    from ..framework.ragged import RaggedTensor
+    if array.traced:
+        items = [array._buf[i] for i in range(int(array._len))]
+    else:
+        gaps = [i for i, t in enumerate(array._items) if t is None]
+        if gaps:
+            raise ValueError(
+                f"array_to_lod_tensor: uninitialized slots {gaps}")
+        items = array._items
+    vals = [t.value if isinstance(t, Tensor) else jnp.asarray(t)
+            for t in items]
+    return RaggedTensor.from_rows(vals)
+
+
+def lod_tensor_to_array(x, table=None) -> TensorArray:
+    """reference: lod_tensor_to_array_op.cc — split a ragged batch into a
+    TensorArray, one row group per entry."""
+    from ..framework.ragged import RaggedTensor
+    if isinstance(x, RaggedTensor):
+        rows = x.rows()
+    else:
+        raw = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        rows = [raw[i] for i in range(raw.shape[0])]
+    return TensorArray([Tensor(jnp.asarray(r)) for r in rows])
+
+
+def Assert(cond, data=None, summarize=20):  # noqa: N802 - reference name
+    """reference: operators/controlflow/assert_op.cc
+    (paddle.static.nn.control_flow.Assert). Eager: raises immediately on
+    a false condition; under a trace the check is skipped (the reference
+    op only runs in executor mode — XLA programs have no host assert)."""
+    raw = cond.value if isinstance(cond, Tensor) else cond
+    try:
+        ok = bool(jnp.all(raw))
+    except jax.errors.TracerBoolConversionError:
+        return None
+    if not ok:
+        shown = []
+        for d in (data or []):
+            v = d.value if isinstance(d, Tensor) else d
+            shown.append(jnp.ravel(jnp.asarray(v))[:summarize])
+        raise AssertionError(f"Assert failed; data={shown}")
+    return None

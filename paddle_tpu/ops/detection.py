@@ -449,6 +449,38 @@ def target_assign(x, match_indices, mismatch_value=0.0):
     return out, matched.astype(x.dtype).reshape(shape)
 
 
+def _assign_anchors(anchors, gts, positive_overlap, negative_overlap):
+    """Shared anchor-assignment core (rpn_target_assign_op.cc /
+    retinanet_target_assign_op.cc): IoU-threshold labels (-1 ignore, 0
+    bg, 1 fg) with the every-gt's-best-anchor-is-positive rule. Returns
+    (labels, best_gt)."""
+    n = len(anchors)
+    if len(gts) == 0:
+        return np.zeros(n, np.int32), np.zeros(n, np.int64)
+    ious = np.asarray(iou_similarity(jnp.asarray(anchors),
+                                     jnp.asarray(gts)))
+    best_gt = ious.argmax(1)
+    best_iou = ious.max(1)
+    labels = -np.ones(n, np.int32)
+    labels[best_iou < negative_overlap] = 0
+    labels[best_iou >= positive_overlap] = 1
+    labels[ious.argmax(0)] = 1  # every gt's best anchor is positive
+    return labels, best_gt
+
+
+def _encode_fg_targets(anchors, gts, best_gt, fg):
+    """Per-fg-anchor regression targets via box_coder's encode diagonal."""
+    if not (len(gts) and len(fg)):
+        return np.zeros((0, 4), np.float32)
+    enc = np.asarray(box_coder(jnp.asarray(anchors[fg]), None,
+                               jnp.asarray(gts[best_gt[fg]]),
+                               code_type="encode"))
+    # box_coder encode is pairwise [T, P, 4]; the per-anchor target is
+    # the (i, i) diagonal
+    return enc[np.arange(len(fg)), np.arange(len(fg))] \
+        if enc.ndim == 3 else enc
+
+
 def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_height=None,
                       im_width=None, rpn_batch_size_per_im=256,
                       rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
@@ -458,19 +490,8 @@ def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_height=None,
     bbox_inside_weight) as numpy arrays."""
     anchors = np.asarray(anchors, np.float32)
     gts = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
-    n = len(anchors)
-    if len(gts) == 0:
-        labels = np.zeros(n, np.int32)
-    else:
-        ious = np.asarray(iou_similarity(jnp.asarray(anchors),
-                                         jnp.asarray(gts)))
-        best_gt = ious.argmax(1)
-        best_iou = ious.max(1)
-        labels = -np.ones(n, np.int32)
-        labels[best_iou < rpn_negative_overlap] = 0
-        labels[best_iou >= rpn_positive_overlap] = 1
-        # every gt's best anchor is positive (reference rule)
-        labels[ious.argmax(0)] = 1
+    labels, best_gt = _assign_anchors(anchors, gts, rpn_positive_overlap,
+                                      rpn_negative_overlap)
     rng = np.random.default_rng(seed)
     fg_cap = int(rpn_batch_size_per_im * rpn_fg_fraction)
     fg = np.nonzero(labels == 1)[0]
@@ -488,16 +509,7 @@ def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_height=None,
         bg = np.nonzero(labels == 0)[0]
     loc_index = fg
     score_index = np.concatenate([fg, bg])
-    if len(gts) and len(fg):
-        enc = np.asarray(box_coder(jnp.asarray(anchors[fg]), None,
-                                   jnp.asarray(gts[best_gt[fg]]),
-                                   code_type="encode"))
-        # box_coder encode is pairwise [T, P, 4]; the per-anchor target
-        # is the (i, i) diagonal
-        tgt = enc[np.arange(len(fg)), np.arange(len(fg))] \
-            if enc.ndim == 3 else enc
-    else:
-        tgt = np.zeros((0, 4), np.float32)
+    tgt = _encode_fg_targets(anchors, gts, best_gt, fg)
     tgt_label = labels[score_index].astype(np.int32)
     inside_w = np.ones_like(tgt, np.float32)
     return loc_index, score_index, tgt, tgt_label, inside_w
@@ -712,3 +724,203 @@ def locality_aware_nms(boxes, scores, iou_threshold=0.5,
                      iou_threshold=iou_threshold, max_out=len(kb))
     sel = np.asarray(sel)[np.asarray(valid)]
     return kb[sel], ks[sel]
+
+
+def retinanet_target_assign(anchors, gt_boxes, gt_labels, is_crowd=None,
+                            im_height=None, im_width=None,
+                            positive_overlap=0.5, negative_overlap=0.4):
+    """RetinaNet anchor assignment (retinanet_target_assign_op.cc),
+    host-side eager. Unlike rpn_target_assign there is no fg/bg sampling:
+    every anchor above/below the overlap thresholds trains, targets carry
+    the gt CLASS label, and fg_num (for focal-loss normalization) is
+    returned. Returns (loc_index, score_index, tgt_bbox, tgt_label,
+    bbox_inside_weight, fg_num)."""
+    anchors = np.asarray(anchors, np.float32)
+    gts = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
+    gtl = np.asarray(gt_labels, np.int32).reshape(-1)
+    labels, best_gt = _assign_anchors(anchors, gts, positive_overlap,
+                                      negative_overlap)
+    fg = np.nonzero(labels == 1)[0]
+    bg = np.nonzero(labels == 0)[0]
+    loc_index = fg
+    score_index = np.concatenate([fg, bg])
+    tgt = _encode_fg_targets(anchors, gts, best_gt, fg)
+    # class label per trained anchor: gt class for fg, 0 (background) bg
+    tgt_label = np.zeros(len(score_index), np.int32)
+    if len(gts):
+        tgt_label[:len(fg)] = gtl[best_gt[fg]]
+    inside_w = np.ones_like(tgt, np.float32)
+    # reference counts fg + 1 (rpn_target_assign_op.cc:862
+    # "fg_num_data[0] = fg_fake.size() + 1") for focal normalization
+    fg_num = np.asarray([len(fg) + 1], np.int32)
+    return loc_index, score_index, tgt, tgt_label, inside_w, fg_num
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_scale=1.0,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.5):
+    """RetinaNet inference head (retinanet_detection_output_op.cc),
+    host-side eager. Per FPN level: keep anchors whose best class score
+    clears score_threshold (top nms_top_k), decode against that level's
+    anchors; merged candidates go through per-class NMS; top keep_top_k
+    overall are returned as [N, 6] (label, score, x0, y0, x1, y1).
+    ``bboxes``/``scores``/``anchors`` are lists with one entry per level:
+    deltas [A_l, 4], class probs [A_l, C], anchors [A_l, 4]."""
+    cands_box, cands_score = [], []
+    for deltas, probs, anc in zip(bboxes, scores, anchors):
+        deltas = np.asarray(deltas, np.float32)
+        probs = np.asarray(probs, np.float32)
+        anc = np.asarray(anc, np.float32)
+        best = probs.max(1)
+        keep = np.nonzero(best > score_threshold)[0]
+        if len(keep) > nms_top_k:
+            keep = keep[np.argsort(-best[keep])[:nms_top_k]]
+        if not len(keep):
+            continue
+        dec = np.asarray(box_coder(jnp.asarray(anc[keep]), None,
+                                   jnp.asarray(deltas[keep]),
+                                   code_type="decode"))
+        cands_box.append(dec / im_scale)
+        cands_score.append(probs[keep])
+    if not cands_box:
+        return np.zeros((0, 6), np.float32)
+    boxes_all = np.concatenate(cands_box)       # [M, 4]
+    scores_all = np.concatenate(cands_score)    # [M, C]
+    out = []
+    for c in range(scores_all.shape[1]):
+        sc = scores_all[:, c]
+        keep = np.nonzero(sc > score_threshold)[0]
+        if not len(keep):
+            continue
+        idx, valid = nms(jnp.asarray(boxes_all[keep]),
+                         jnp.asarray(sc[keep]),
+                         iou_threshold=nms_threshold)
+        kept = keep[np.asarray(idx)[np.asarray(valid)]]
+        for i in kept:
+            out.append([c + 1, sc[i], *boxes_all[i]])
+    if not out:
+        return np.zeros((0, 6), np.float32)
+    out = np.asarray(out, np.float32)
+    if len(out) > keep_top_k:
+        out = out[np.argsort(-out[:, 1])[:keep_top_k]]
+    return out
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0, num_classes=81,
+                             use_random=True, seed=0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2)):
+    """Fast R-CNN training-label sampling
+    (generate_proposal_labels_op.cc SampleRoisForOneImage), host-side
+    eager: sample fg rois (IoU >= fg_thresh, capped at
+    batch_size_per_im * fg_fraction) and bg rois (bg_thresh_lo <= IoU <
+    bg_thresh_hi) against the ground truth. Returns (rois, labels,
+    bbox_targets, bbox_inside_weights, bbox_outside_weights)."""
+    rois = np.asarray(rpn_rois, np.float32).reshape(-1, 4)
+    gts = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
+    gtc = np.asarray(gt_classes, np.int32).reshape(-1)
+    # gt boxes join the candidate pool (reference appends them)
+    cand = np.concatenate([rois, gts]) if len(gts) else rois
+    rng = np.random.default_rng(seed)
+    if len(gts):
+        ious = np.asarray(iou_similarity(jnp.asarray(cand),
+                                         jnp.asarray(gts)))
+        best_gt = ious.argmax(1)
+        best_iou = ious.max(1)
+    else:
+        best_gt = np.zeros(len(cand), np.int64)
+        best_iou = np.zeros(len(cand), np.float32)
+    fg = np.nonzero(best_iou >= fg_thresh)[0]
+    bg = np.nonzero((best_iou >= bg_thresh_lo)
+                    & (best_iou < bg_thresh_hi))[0]
+    fg_cap = int(batch_size_per_im * fg_fraction)
+    if len(fg) > fg_cap:
+        fg = rng.choice(fg, fg_cap, replace=False) if use_random \
+            else fg[:fg_cap]
+    bg_cap = batch_size_per_im - len(fg)
+    if len(bg) > bg_cap:
+        bg = rng.choice(bg, bg_cap, replace=False) if use_random \
+            else bg[:bg_cap]
+    keep = np.concatenate([fg, bg]).astype(np.int64)
+    out_rois = cand[keep]
+    labels = np.zeros(len(keep), np.int32)
+    if len(gts):
+        labels[:len(fg)] = gtc[best_gt[fg]]
+    # per-class box targets (reference expand_bbox_targets layout)
+    tgt = np.zeros((len(keep), 4 * num_classes), np.float32)
+    inside = np.zeros_like(tgt)
+    if len(gts) and len(fg):
+        enc = np.asarray(box_coder(jnp.asarray(cand[fg]), None,
+                                   jnp.asarray(gts[best_gt[fg]]),
+                                   code_type="encode"))
+        enc = enc[np.arange(len(fg)), np.arange(len(fg))] \
+            if enc.ndim == 3 else enc
+        enc = enc / np.asarray(bbox_reg_weights, np.float32)
+        for i, c in enumerate(labels[:len(fg)]):
+            tgt[i, 4 * c:4 * c + 4] = enc[i]
+            inside[i, 4 * c:4 * c + 4] = 1.0
+    outside = (inside > 0).astype(np.float32)
+    return out_rois, labels, tgt, inside, outside
+
+
+def generate_mask_labels(im_h, im_w, gt_classes, gt_segms, rois,
+                         roi_labels, num_classes=81, resolution=14):
+    """Mask R-CNN mask-target rasterization
+    (generate_mask_labels_op.cc), host-side eager: for each positive roi,
+    rasterize its matched instance's polygon into a resolution x
+    resolution binary grid (the reference uses COCO poly2mask; PIL
+    rasterization here). gt_segms: list of polygons (one flat [x0, y0,
+    x1, y1, ...] list per instance). Returns (mask_rois, roi_has_mask,
+    mask_int32 [N, num_classes * resolution**2]) where, as in the
+    reference's ExpandMaskTarget, every class slot is -1 (ignore) except
+    the matched gt class's slot, which holds the binary mask."""
+    from PIL import Image, ImageDraw
+    rois = np.asarray(rois, np.float32).reshape(-1, 4)
+    roi_labels = np.asarray(roi_labels, np.int32).reshape(-1)
+    gtc = np.asarray(gt_classes, np.int32).reshape(-1)
+    fg = np.nonzero(roi_labels > 0)[0]
+    masks, keep_rois = [], []
+    # match each fg roi to the gt instance with max IoU of boxes derived
+    # from the polygons
+    gt_boxes = []
+    for poly in gt_segms:
+        p = np.asarray(poly, np.float32).reshape(-1, 2)
+        gt_boxes.append([p[:, 0].min(), p[:, 1].min(),
+                         p[:, 0].max(), p[:, 1].max()])
+    gt_boxes = np.asarray(gt_boxes, np.float32) if gt_segms else \
+        np.zeros((0, 4), np.float32)
+    for i in fg:
+        if not len(gt_boxes):
+            continue
+        ious = np.asarray(iou_similarity(
+            jnp.asarray(rois[i:i + 1]), jnp.asarray(gt_boxes)))[0]
+        g = int(ious.argmax())
+        x0, y0, x1, y1 = rois[i]
+        w = max(x1 - x0, 1e-3)
+        h = max(y1 - y0, 1e-3)
+        poly = np.asarray(gt_segms[g], np.float32).reshape(-1, 2)
+        # polygon into roi-local resolution grid
+        px = (poly[:, 0] - x0) * resolution / w
+        py = (poly[:, 1] - y0) * resolution / h
+        img = Image.new("L", (resolution, resolution), 0)
+        ImageDraw.Draw(img).polygon(
+            list(zip(px.tolist(), py.tolist())), outline=1, fill=1)
+        m = np.asarray(img, np.int32)
+        # ExpandMaskTarget layout: -1 everywhere, the matched class's
+        # slot carries the binary mask
+        expanded = np.full(num_classes * resolution * resolution, -1,
+                           np.int32)
+        c = int(gtc[g])
+        lo = c * resolution * resolution
+        expanded[lo:lo + resolution * resolution] = m.reshape(-1)
+        masks.append(expanded)
+        keep_rois.append(rois[i])
+    if not masks:
+        return (np.zeros((0, 4), np.float32), np.zeros((0,), np.int32),
+                np.zeros((0, num_classes * resolution * resolution),
+                         np.int32))
+    return (np.asarray(keep_rois, np.float32),
+            np.ones(len(masks), np.int32),
+            np.stack(masks))

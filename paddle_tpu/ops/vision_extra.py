@@ -569,3 +569,34 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     loss_obj = (obj_pos + obj_neg).reshape(n, -1).sum(1)
 
     return loss_loc + loss_cls + loss_obj
+
+
+def read_file(path):
+    """reference: operators/read_file_op.cc (paddle.vision.ops.read_file)
+    — raw file bytes as a uint8 vector. Host-side eager op."""
+    with open(path, "rb") as f:
+        data = f.read()
+    import numpy as _np
+    return jnp.asarray(_np.frombuffer(data, _np.uint8))
+
+
+def decode_jpeg(x, mode="unchanged"):
+    """reference: operators/decode_jpeg_op.cu (paddle.vision.ops
+    .decode_jpeg, nvjpeg-backed there) — decode a uint8 byte vector to a
+    [C, H, W] uint8 image. Host-side eager op (PIL)."""
+    import io as _io
+
+    import numpy as _np
+    from PIL import Image
+    raw = _np.asarray(x).astype(_np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)

@@ -1,0 +1,394 @@
+"""Generate the frozen op-registration audit.
+
+Extracts every operator the reference registers (REGISTER_OPERATOR and
+its macro families under /root/reference/paddle/fluid/operators) and maps
+each to its disposition in this framework:
+
+  op         registered under the same name in the op registry
+  renamed    registered under a different (2.x API) name -> target
+  autodiff   a *_grad / *_grad_grad op: synthesized by jax.vjp/jax.grad
+             of the base op (reference: grad_op_desc_maker.h; here the
+             whole point of the functional design)
+  api        implemented as a framework component, not a registry op
+             (optimizer classes, collective functions, IO, control flow,
+             AMP internals, PS runtime, ...) -> target dotted path
+  subsumed   the capability is owned by XLA/JAX (fusion ops, stream sync,
+             memory ops, program plumbing)
+  na         hardware- or backend-specific mechanism with no TPU meaning
+             (nccl/bkcl/hccl id generation, TensorRT/Lite/MKLDNN engine
+             ops, Ascend, BoxPS) -> note says why
+
+Writes tools/op_registration_audit.json (checked in; the test validates
+it against the live registry without needing /root/reference).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+REF = "/root/reference/paddle/fluid/operators"
+OUT = os.path.join(os.path.dirname(__file__),
+                   "op_registration_audit.json")
+
+
+def extract_reference_ops():
+    names = set()
+    pat_direct = re.compile(
+        r'REGISTER_(?:OPERATOR|OP_WITHOUT_GRADIENT|FILE_READER_OPERATOR|'
+        r'DECORATED_READER_OPERATOR)\(\s*([a-z][a-z0-9_]*)\s*,', re.S)
+    pat_family = re.compile(
+        r'REGISTER_(?:COMPARE_OP|REDUCE_OP|REDUCE_OP_WITHOUT_GRAD|'
+        r'BINARY_LOGICAL_OP|BINARY_BITWISE_OP|UNARY_LOGICAL_OP|'
+        r'UNARY_BITWISE_OP|COMPARE_REDUCE_OP|'
+        r'ELEMWISE_EXPLICIT_OP_WITHOUT_GRAD)\(\s*([a-z][a-z0-9_]*)', re.S)
+    for path in (glob.glob(REF + "/**/*.cc", recursive=True)
+                 + glob.glob(REF + "/**/*.cu", recursive=True)):
+        src = open(path, errors="ignore").read()
+        for m in pat_direct.finditer(src):
+            names.add(m.group(1))
+        for m in pat_family.finditer(src):
+            names.add(m.group(1))
+    act_h = open(REF + "/activation_op.h", errors="ignore").read()
+    act_cc = open(REF + "/activation_op.cc", errors="ignore").read()
+    for m in re.finditer(r'__macro\(([a-z][a-z0-9_]*)\s*,', act_h):
+        names.add(m.group(1))
+    for m in re.finditer(r'REGISTER_ACTIVATION_OP\(([a-z][a-z0-9_]*)\s*,',
+                         act_cc):
+        names.add(m.group(1))
+    names.discard("op_type")  # macro placeholder, not an op
+    return sorted(names)
+
+
+# -- explicit rename table: reference op name -> registry name -----------
+RENAMES = {
+    "arg_max": "argmax", "arg_min": "argmin",
+    "batch_norm": "batch_norm", "bicubic_interp": "interpolate",
+    "bicubic_interp_v2": "interpolate", "bilinear_interp": "interpolate",
+    "bilinear_interp_v2": "interpolate", "linear_interp": "interpolate",
+    "linear_interp_v2": "interpolate", "nearest_interp": "interpolate",
+    "nearest_interp_v2": "interpolate", "trilinear_interp": "interpolate",
+    "trilinear_interp_v2": "interpolate",
+    "brelu": "hardtanh", "hard_shrink": "hardshrink",
+    "hard_sigmoid": "hardsigmoid", "hard_swish": "hardswish",
+    "logsigmoid": "log_sigmoid", "soft_relu": "softplus",
+    "tanh_shrink": "tanhshrink",
+    "beam_search": "beam_search_step",
+    "crop_tensor": "crop",
+    "cross_entropy2": "cross_entropy",
+    "cross_entropy_grad2": "cross_entropy",
+    "deformable_conv_v1": "deformable_conv",
+    "depthwise_conv2d": "conv2d", "depthwise_conv2d_transpose":
+        "conv2d_transpose",
+    "diag_v2": "diag",
+    "elementwise_add": "add", "elementwise_div": "divide",
+    "elementwise_floordiv": "floor_divide", "elementwise_max": "maximum",
+    "elementwise_min": "minimum", "elementwise_mod": "remainder",
+    "elementwise_mul": "multiply", "elementwise_pow": "pow",
+    "elementwise_sub": "subtract", "grad_add": "add", "minus": "subtract",
+    "expand_as_v2": "expand_as", "expand_v2": "expand",
+    "fill": "full", "fill_any_like": "full_like",
+    "fill_constant": "full", "fill_constant_batch_size_like": "full",
+    "fill_zeros_like": "zeros_like", "fill_zeros_like2": "zeros_like",
+    "flatten2": "flatten", "flatten_contiguous_range": "flatten",
+    "fc": "linear",
+    "gaussian_random": "normal",
+    "gaussian_random_batch_size_like": "normal",
+    "generate_proposals_v2": "generate_proposals",
+    "grid_sampler": "grid_sample",
+    "gru": "rnn", "gru_unit": "gru_cell", "lstm": "rnn",
+    "lstm_unit": "lstm_cell", "lstmp": "rnn", "cudnn_lstm": "rnn",
+    "multi_gru": "rnn", "recurrent": "rnn",
+    "hash": "hash_ids",
+    "hierarchical_sigmoid": "hsigmoid_loss",
+    "lookup_table": "embedding", "lookup_table_v2": "embedding",
+    "lookup_table_dequant": "embedding",
+    "lrn": "local_response_norm",
+    "matmul_v2": "matmul",
+    "max_pool2d_with_index": "max_pool2d",
+    "max_pool3d_with_index": "max_pool3d",
+    "merge_selected_rows": "add_n",
+    "multiclass_nms2": "multiclass_nms", "multiclass_nms3":
+        "multiclass_nms",
+    "mul": "mul",
+    "one_hot_v2": "one_hot",
+    "pad2d": "pad",
+    "pool2d": "avg_pool2d", "pool3d": "avg_pool3d",
+    "range": "arange",
+    "reduce_all": "all", "reduce_any": "any", "reduce_max": "max",
+    "reduce_mean": "mean", "reduce_min": "min", "reduce_prod": "prod",
+    "reduce_sum": "sum",
+    "reshape2": "reshape", "squeeze2": "squeeze",
+    "unsqueeze2": "unsqueeze", "transpose2": "transpose",
+    "sigmoid_cross_entropy_with_logits":
+        "binary_cross_entropy_with_logits",
+    "size": "numel",
+    "top_k": "topk", "top_k_v2": "topk",
+    "tril_triu": "tril",
+    "uniform_random": "uniform",
+    "uniform_random_batch_size_like": "uniform",
+    "unique_with_counts": "unique",
+    "where_index": "nonzero",
+}
+
+# -- api-level components: reference op -> dotted repo path --------------
+API = {
+    # optimizers (operators/optimizers/*) -> paddle_tpu.optimizer classes
+    "adadelta": "optimizer.Adadelta", "adagrad": "optimizer.Adagrad",
+    "adam": "optimizer.Adam", "adamax": "optimizer.Adamax",
+    "decayed_adagrad": "optimizer.DecayedAdagrad",
+    "dpsgd": "optimizer.Dpsgd", "ftrl": "optimizer.Ftrl",
+    "lamb": "optimizer.Lamb", "lars_momentum": "optimizer.LarsMomentum",
+    "momentum": "optimizer.Momentum", "rmsprop": "optimizer.RMSProp",
+    "sgd": "optimizer.SGD",
+    "proximal_adagrad": "optimizer.wrappers",
+    "proximal_gd": "optimizer.wrappers",
+    "average_accumulates": "optimizer.wrappers.ModelAverage",
+    "dgc": "optimizer.DGCMomentum",
+    "dgc_momentum": "optimizer.DGCMomentum",
+    "dgc_clip_by_norm": "optimizer.DGCMomentum",
+    # AMP (operators/amp/*)
+    "check_finite_and_unscale": "amp.GradScaler",
+    "update_loss_scaling": "amp.GradScaler",
+    "alloc_float_status": "amp.GradScaler",
+    # metrics
+    "accuracy": "metric.accuracy", "auc": "metric.Auc",
+    # collectives (operators/collective/*) -> distributed.collective
+    "allreduce": "distributed.collective.all_reduce",
+    "alltoall": "distributed.collective.alltoall",
+    "barrier": "distributed.collective.barrier",
+    "broadcast": "distributed.collective.broadcast",
+    "c_allgather": "distributed.collective.all_gather",
+    "c_allreduce_max": "distributed.collective.all_reduce",
+    "c_allreduce_min": "distributed.collective.all_reduce",
+    "c_allreduce_prod": "distributed.collective.all_reduce",
+    "c_allreduce_sum": "distributed.collective.all_reduce",
+    "c_broadcast": "distributed.collective.broadcast",
+    "c_concat": "distributed.collective.concat",
+    "c_embedding": "distributed.mp_layers.VocabParallelEmbedding",
+    "c_identity": "distributed.collective.c_identity",
+    "c_reduce_max": "distributed.collective.reduce",
+    "c_reduce_min": "distributed.collective.reduce",
+    "c_reduce_prod": "distributed.collective.reduce",
+    "c_reduce_sum": "distributed.collective.reduce",
+    "c_reducescatter": "distributed.collective.reduce_scatter",
+    "c_scatter": "distributed.collective.scatter",
+    "c_softmax_with_cross_entropy":
+        "distributed.mp_layers.ParallelCrossEntropy",
+    "c_split": "distributed.collective.split",
+    "send_v2": "distributed.collective.send",
+    "recv_v2": "distributed.collective.recv",
+    "send": "distributed.ps.PSClient.push_dense_grad",
+    "send_barrier": "distributed.ps.PSClient.barrier",
+    "fetch_barrier": "distributed.ps.PSClient.barrier",
+    "send_and_recv": "distributed.ps.PSClient",
+    "listen_and_serv": "distributed.ps.PSServer",
+    "distributed_lookup_table": "distributed.ps.SparseTable",
+    "push_dense": "distributed.ps.PSClient.push_dense_grad",
+    "push_sparse": "distributed.ps.PSClient.push_sparse_grad",
+    "push_sparse_v2": "distributed.ps.PSClient.push_sparse_grad",
+    "pull_sparse": "distributed.ps.PSClient.pull_sparse",
+    "pull_sparse_v2": "distributed.ps.PSClient.pull_sparse",
+    # control flow / program plumbing
+    "assert": "ops.control_flow.Assert",
+    "assign_value": "ops.creation.assign",
+    "conditional_block": "ops.control_flow.cond",
+    "conditional_block_infer": "ops.control_flow.cond",
+    "while": "ops.control_flow.while_loop",
+    "select_input": "ops.control_flow.cond",
+    "select_output": "ops.control_flow.cond",
+    "print": "static.Print",
+    "py_func": "static.py_func",
+    "py_layer": "autograd.PyLayer",
+    "run_program": "jit.to_static",
+    "feed": "static.program.Executor", "fetch": "static.program.Executor",
+    "get_places": "static.cpu_places",
+    # tensor arrays / LoD machinery
+    "array_to_lod_tensor": "ops.control_flow.array_to_lod_tensor",
+    "lod_tensor_to_array": "ops.control_flow.lod_tensor_to_array",
+    "lod_array_length": "ops.control_flow.array_length",
+    "read_from_array": "ops.control_flow.array_read",
+    "write_to_array": "ops.control_flow.array_write",
+    "tensor_array_to_tensor": "ops.control_flow.tensor_array_to_tensor",
+    "beam_search_decode": "ops.decode_extra.beam_search_decode",
+    "lod_reset": "framework.ragged.RaggedTensor",
+    "lod_rank_table": "framework.ragged.RaggedTensor",
+    "max_sequence_len": "framework.ragged.RaggedTensor",
+    "merge_lod_tensor": "framework.ragged.RaggedTensor",
+    "merge_lod_tensor_infer": "framework.ragged.RaggedTensor",
+    "split_lod_tensor": "framework.ragged.RaggedTensor",
+    "reorder_lod_tensor_by_rank": "framework.ragged.RaggedTensor",
+    # io / readers (operators/reader/*)
+    "create_ctr_reader": "io.heavy_dataset",
+    "create_custom_reader": "io.DataLoader",
+    "create_double_buffer_reader": "io.DataLoader",
+    "create_py_reader": "io.DataLoader",
+    "read": "io.DataLoader", "read_file": "ops.vision_extra.read_file",
+    "enqueue": "native.ShmQueue",
+    "dequeue": "native.ShmQueue",
+    "queue_generator": "native.ShmQueue",
+    # serialization
+    "load": "framework.io.load", "load_combine": "framework.io.load",
+    "save": "framework.io.save", "save_combine": "framework.io.save",
+    "set_value": "tensor.Tensor.__setitem__",
+    "share_data": "tensor.Tensor.detach",
+    # quantization (fake_* ops) -> quantization module
+    "dequantize_abs_max": "quantization.quant",
+    "dequantize_log": "quantization.quant",
+    "fake_channel_wise_dequantize_max_abs": "quantization.quant",
+    "fake_channel_wise_quantize_abs_max": "quantization.quant",
+    "fake_channel_wise_quantize_dequantize_abs_max":
+        "quantization.quant",
+    "fake_dequantize_max_abs": "quantization.quant",
+    "fake_quantize_abs_max": "quantization.quant",
+    "fake_quantize_dequantize_abs_max": "quantization.quant",
+    "fake_quantize_dequantize_moving_average_abs_max":
+        "quantization.quant",
+    "fake_quantize_moving_average_abs_max": "quantization.quant",
+    "fake_quantize_range_abs_max": "quantization.quant",
+    "moving_average_abs_max_scale": "quantization.quant",
+    "quantize": "quantization.quant",
+    "dequantize": "quantization.quant",
+    "requantize": "quantization.quant",
+    # misc api
+    "seed": "paddle_tpu.seed",
+    "clip_by_norm": "optimizer.clip.ClipGradByNorm",
+    "truncated_gaussian_random": "nn.initializer.TruncatedNormal",
+    "spectral_norm": "nn.utils.spectral_norm",
+    "sync_batch_norm": "nn.SyncBatchNorm",
+    "inplace_abn": "nn.BatchNorm2D",
+    "fake_init": "nn.initializer",
+    "decode_jpeg": "ops.vision_extra.decode_jpeg",
+    "retinanet_target_assign": "ops.detection.retinanet_target_assign",
+    "retinanet_detection_output":
+        "ops.detection.retinanet_detection_output",
+    "fused_embedding_seq_pool": "ops.sequence.sequence_pool",
+    "pull_gpups_sparse": "distributed.ps",
+}
+
+# -- capabilities owned by XLA/JAX ---------------------------------------
+SUBSUMED = {
+    # fusion kernels: XLA fuses automatically; flash-attention Pallas
+    # kernel covers the attention fusions
+    "conv2d_fusion", "conv2d_inception_fusion", "fused_batch_norm_act",
+    "fused_bn_add_activation", "fused_elemwise_activation",
+    "fused_elemwise_add_activation", "fused_embedding_eltwise_layernorm",
+    "fused_embedding_fc_lstm", "fused_fc_elementwise_layernorm",
+    "fusion_group", "fusion_gru", "fusion_lstm",
+    "fusion_repeated_fc_relu", "fusion_seqconv_eltadd_relu",
+    "fusion_seqexpand_concat_fc", "fusion_seqpool_concat",
+    "fusion_seqpool_cvm_concat", "fusion_squared_mat_sub",
+    "fusion_transpose_flatten_concat", "multihead_matmul",
+    "skip_layernorm",
+    # memory/program plumbing: PJRT/XLA owns buffers and scheduling
+    "coalesce_tensor", "memcpy", "delete_var", "copy_cross_scope",
+    "rnn_memory_helper", "shrink_rnn_memory",
+    "get_tensor_from_selected_rows",
+    # stream sync: XLA schedules collectives; no manual stream ops
+    "c_sync_calc_stream", "c_sync_comm_stream", "c_wait_comm",
+    "c_wait_compute",
+    "c_comm_init", "c_comm_init_all",
+    "marker",
+}
+
+# -- hardware/backend-specific, no TPU-native meaning --------------------
+NA = {
+    "ascend_trigger": "Ascend NPU trigger op",
+    "c_comm_init_hccl": "Ascend HCCL bootstrap",
+    "c_gen_bkcl_id": "Kunlun BKCL bootstrap",
+    "c_gen_hccl_id": "Ascend HCCL bootstrap",
+    "c_gen_nccl_id": "NCCL id broadcast (jax.distributed coordination "
+                     "service replaces it)",
+    "gen_bkcl_id": "Kunlun BKCL bootstrap",
+    "gen_hccl_id": "Ascend HCCL bootstrap",
+    "gen_nccl_id": "NCCL id broadcast (jax.distributed replaces it)",
+    "dlnne_engine": "DL-NNE (Iluvatar) inference engine op",
+    "lite_engine": "Paddle-Lite subgraph engine op (AOT predictor "
+                   "replaces engine-in-graph)",
+    "tensorrt_engine": "TensorRT subgraph engine op (AOT predictor "
+                       "replaces engine-in-graph)",
+    "heter_listen_and_serv": "heterogeneous PS (documented out-of-scope "
+                             "in COMPONENTS.md)",
+    "pull_box_extended_sparse": "BoxPS ads hardware PS",
+    "pull_box_sparse": "BoxPS ads hardware PS",
+    "push_box_extended_sparse": "BoxPS ads hardware PS",
+    "push_box_sparse": "BoxPS ads hardware PS",
+    "bilateral_slice": "HDRNet mobile-camera contrib op (CUDA demo)",
+    "deformable_psroi_pooling": "deformable R-FCN CUDA contrib op; "
+                                "deformable_conv + roi_align cover the "
+                                "supported detection zoo",
+    "roi_perspective_transform": "OCR contrib CUDA op",
+    "attention_lstm": "x86 fused LSTM variant; scan RNN covers it",
+}
+
+
+def classify(name, repo_ops):
+    if name in repo_ops:
+        return {"status": "op", "target": name}
+    base = None
+    if name.endswith("_grad_grad"):
+        base = name[:-10]
+    elif name.endswith("_grad"):
+        base = name[:-5]
+    if name == "stright_throuth_estimator_grad":
+        # [sic] the straight-through-estimator grad the reference
+        # registers for its fake_quantize ops (fake_quantize_op.cc);
+        # jax.custom_vjp inside quantization.quant plays that role
+        return {"status": "api", "target": "quantization.quant"}
+    if base is not None:
+        return {"status": "autodiff", "base": base}
+    if name in RENAMES:
+        return {"status": "renamed", "target": RENAMES[name]}
+    if name in API:
+        return {"status": "api", "target": API[name]}
+    if name in SUBSUMED:
+        return {"status": "subsumed"}
+    if name in NA:
+        return {"status": "na", "note": NA[name]}
+    return {"status": "UNMAPPED"}
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.dispatch as dispatch
+    repo_ops = set(dispatch.wrapped_ops)
+
+    ref_ops = extract_reference_ops()
+    audit = {n: classify(n, repo_ops) for n in ref_ops}
+    unmapped = [n for n, v in audit.items() if v["status"] == "UNMAPPED"]
+    # base-op sanity for autodiff entries: base must itself be mapped
+    for n, v in audit.items():
+        if v["status"] == "autodiff":
+            b = v["base"]
+            if b in audit and audit[b]["status"] != "UNMAPPED":
+                continue
+            bc = classify(b, repo_ops)
+            if bc["status"] == "UNMAPPED":
+                unmapped.append(n)
+            else:
+                v["base_mapping"] = bc
+
+    with open(OUT, "w") as f:
+        json.dump({"reference_root": REF,
+                   "total": len(ref_ops),
+                   "ops": audit}, f, indent=1, sort_keys=True)
+    counts = {}
+    for v in audit.values():
+        counts[v["status"]] = counts.get(v["status"], 0) + 1
+    print("total:", len(ref_ops), counts)
+    if unmapped:
+        print("UNMAPPED:", sorted(set(unmapped)))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
