@@ -21,6 +21,9 @@ _DYNAMIC_SHAPE_OPS = {
     "rpn_target_assign", "distribute_fpn_proposals",
     "collect_fpn_proposals", "mine_hard_examples", "locality_aware_nms",
     "filter_by_instag", "tdm_sampler", "similarity_focus",
+    "read_file", "decode_jpeg", "retinanet_target_assign",
+    "retinanet_detection_output", "generate_proposal_labels",
+    "generate_mask_labels",
 }
 _NON_DIFF_OPS = {
     "argmax", "argmin", "argsort", "randint", "randperm", "one_hot",
@@ -37,6 +40,9 @@ _NON_DIFF_OPS = {
     "polygon_box_transform", "hash_ids", "sampling_id", "tdm_child",
     "tdm_sampler", "filter_by_instag", "similarity_focus",
     "nms", "multiclass_nms", "bipartite_match",
+    "read_file", "decode_jpeg", "retinanet_target_assign",
+    "retinanet_detection_output", "generate_proposal_labels",
+    "generate_mask_labels",
     "crf_decoding", "gather_tree", "beam_search_decode", "shuffle_batch",
     "digitize", "bitwise_left_shift", "bitwise_right_shift",
     "is_complex", "is_floating_point", "rank",
