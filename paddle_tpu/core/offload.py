@@ -1,0 +1,48 @@
+"""Process-global activation-offload switch.
+
+Reference parity: recompute_configs.enable_offload
+(fleet/meta_optimizers/recompute_optimizer + offload_helper) moves
+checkpointed activations to host memory. TPU-native: the rematerialized
+blocks' jax.checkpoint calls adopt an offload policy — saved dot results
+stage to pinned host memory during forward and stream back in backward.
+The switch is process-global, mirroring the reference's global FLAGS_*
+style; it is consulted at trace time by the remat wrappers
+(models/gpt.py _remat_block and nn layers using jax.checkpoint).
+"""
+
+from __future__ import annotations
+
+_activation_offload = False
+
+
+def set_activation_offload(enabled: bool) -> None:
+    global _activation_offload
+    _activation_offload = bool(enabled)
+
+
+def activation_offload_enabled() -> bool:
+    return _activation_offload
+
+
+def remat_policy():
+    """The jax.checkpoint policy to use for rematerialized blocks (None
+    = plain full-remat). With offload on, the named block inputs — the
+    only residuals a fully-rematerialized block keeps — are staged to
+    pinned host memory (the reference's recompute offload stashes
+    exactly these checkpoint inputs on host)."""
+    if not _activation_offload:
+        return None
+    import jax
+    return jax.checkpoint_policies.save_and_offload_only_these_names(
+        names_which_can_be_saved=[],
+        names_which_can_be_offloaded=["remat_block_in"],
+        offload_src="device", offload_dst="pinned_host")
+
+
+def name_block_input(x):
+    """Tag a rematerialized block's input so the offload policy can
+    target it (no-op data-wise)."""
+    if not _activation_offload:
+        return x
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(x, "remat_block_in")

@@ -265,6 +265,21 @@ class ShardedTrainStep:
                 "k_steps", 1))
         self._gm_steps = max(1, gm_steps)
 
+        # Optimizer-state host offload (reference:
+        # sharding/offload_helper.py:21): slots live in pinned host
+        # memory between steps; the step splits into a grad phase (slots
+        # absent from HBM while activations peak) and an update phase
+        # (slots staged in, updated, staged back out).
+        self._offload = bool(
+            self.strategy.sharding
+            and self.strategy.sharding_configs.get("optimize_offload"))
+        if self._offload:
+            self._host_slot_shardings = jax.tree_util.tree_map(
+                lambda s: s.with_memory_kind("pinned_host"),
+                self.opt_shardings["slots"])
+            self.opt_state["slots"] = jax.device_put(
+                self.opt_state["slots"], self._host_slot_shardings)
+
         self._compress_grads = bool(self.strategy.fp16_allreduce)
         if self._compress_grads:
             for ax in ("mp", "pp", "sep", "sharding"):
@@ -293,6 +308,25 @@ class ShardedTrainStep:
         gm = self._gm_steps
 
         loss_of = make_functional_loss(model, train_fn)
+        if self.strategy.recompute and \
+                self.strategy.recompute_configs.get("enable_offload"):
+            # Activation offload (reference recompute_configs
+            # .enable_offload) is implemented on the remat path
+            # (core/offload.py: checkpointed block inputs stage to
+            # pinned host memory) and works on the single-chip TrainStep;
+            # composed with GSPMD it trips XLA's SPMD partitioner
+            # (annotate_device_placement without sharding, RET_CHECK at
+            # spmd_partitioner.cc:5743), so the sharded path refuses
+            # instead of crashing mid-compile.
+            from ..core.enforce import UnimplementedError
+            raise UnimplementedError(
+                "recompute_configs.enable_offload under the sharded "
+                "(GSPMD) step: XLA's SPMD partitioner rejects host-"
+                "offload annotations from this composition. Use "
+                "sharding_configs.optimize_offload (optimizer-state "
+                "offload) here; activation offload is available on the "
+                "single-chip TrainStep via "
+                "core.offload.set_activation_offload(True).")
 
         mesh, bspec = self.mesh, self.batch_spec
         data_axes: list = []
@@ -348,7 +382,7 @@ class ShardedTrainStep:
                 return jax.value_and_grad(loss_of, has_aux=True)(
                     params, buffers, key, batch)
 
-        def step_impl(params, buffers, opt_state, key, lr, batch):
+        def grad_impl(params, buffers, key, batch):
             # evolve the key inside the launch: one dispatch per step
             # (a host-side split is a separate device round-trip)
             key, new_key = jax.random.split(key)
@@ -373,20 +407,68 @@ class ShardedTrainStep:
                 loss = jnp.zeros((), jnp.float32)
             else:
                 (loss, new_buf), grads = vag(params, buffers, key, batch)
+            return grads, new_buf, new_key, loss
+
+        scalar = NamedSharding(self.mesh, P())
+        slots_sh = {"slots": self.opt_shardings["slots"],
+                    "step": self.opt_shardings["step"]}
+
+        if self._offload:
+            # split step: grads with slots out of HBM, then the update.
+            # Slot staging happens at the Python level (device_put before
+            # /after the update jit): in-program host transfers
+            # (annotate_device_placement) and host-space compute are both
+            # rejected by the CPU test backend, so the jit boundary IS
+            # the transfer point.
+            def update_impl(params, grads, opt_state, lr):
+                return optimizer.apply_gradients(params, grads,
+                                                 opt_state, lr=lr)
+
+            grad_step = jax.jit(
+                grad_impl,
+                in_shardings=(self.param_shardings,
+                              self.buffer_shardings, scalar, None),
+                out_shardings=(self.param_shardings,
+                               self.buffer_shardings, scalar, scalar),
+                **({"donate_argnums": (1,)} if donate else {}))
+            # donate params + slots (aliased by the two param-sized
+            # outputs); grads have no matching output, donating them
+            # would only trigger the unused-donation warning
+            update_step = jax.jit(
+                update_impl,
+                in_shardings=(self.param_shardings,
+                              self.param_shardings, slots_sh, scalar),
+                out_shardings=(self.param_shardings, slots_sh),
+                **({"donate_argnums": (0, 2)} if donate else {}))
+            dev_slots = self.opt_shardings["slots"]
+            host_slots = self._host_slot_shardings
+
+            def offload_step(params, buffers, opt_state, key, lr, batch):
+                grads, new_buf, new_key, loss = grad_step(
+                    params, buffers, key, batch)
+                staged = {"slots": jax.device_put(opt_state["slots"],
+                                                  dev_slots),
+                          "step": opt_state["step"]}
+                new_params, new_opt = update_step(params, grads, staged,
+                                                  lr)
+                new_opt = {"slots": jax.device_put(new_opt["slots"],
+                                                   host_slots),
+                           "step": new_opt["step"]}
+                return new_params, new_buf, new_opt, new_key, loss
+
+            return offload_step
+
+        def step_impl(params, buffers, opt_state, key, lr, batch):
+            grads, new_buf, new_key, loss = grad_impl(params, buffers,
+                                                      key, batch)
             new_params, new_opt = optimizer.apply_gradients(
                 params, grads, opt_state, lr=lr)
             return new_params, new_buf, new_opt, new_key, loss
 
         in_shardings = (self.param_shardings, self.buffer_shardings,
-                        {"slots": self.opt_shardings["slots"],
-                         "step": self.opt_shardings["step"]},
-                        NamedSharding(self.mesh, P()),
-                        NamedSharding(self.mesh, P()))
+                        slots_sh, scalar, scalar)
         out_shardings = (self.param_shardings, self.buffer_shardings,
-                         {"slots": self.opt_shardings["slots"],
-                          "step": self.opt_shardings["step"]},
-                         NamedSharding(self.mesh, P()),
-                         NamedSharding(self.mesh, P()))
+                         slots_sh, scalar, scalar)
         kwargs = {"donate_argnums": (0, 1, 2)} if donate else {}
         return jax.jit(step_impl,
                        in_shardings=in_shardings + (None,),
@@ -428,6 +510,14 @@ def distributed_jit(model: Layer, optimizer, train_fn: Callable,
     strategy = kwargs.get("strategy") or _strategy
     if strategy is not None and (strategy.localsgd or
                                  strategy.adaptive_localsgd):
+        from ..core.enforce import UnimplementedError
+        if strategy.sharding_configs.get("optimize_offload") or (
+                strategy.recompute
+                and strategy.recompute_configs.get("enable_offload")):
+            raise UnimplementedError(
+                "offload (sharding_configs.optimize_offload / "
+                "recompute_configs.enable_offload) is not implemented "
+                "for the localsgd step — it must not silently no-op")
         from .localsgd import LocalSGDTrainStep
         if kwargs.get("batch_spec") is not None:
             raise ValueError(

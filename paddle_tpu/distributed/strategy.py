@@ -38,6 +38,14 @@ _DEFAULTS: Dict[str, Any] = {
     "without_graph_optimization": False,
 }
 
+# Accepted-field names whose capability is deliberately absent: setting
+# them True raises instead of silently no-oping (the migration contract
+# must not lie). Heterogeneous PS scope is documented in COMPONENTS.md.
+_NOT_SUPPORTED_FLAGS = {
+    "heter_ccl_mode": "heterogeneous (CPU+accelerator mixed) collective "
+                      "mode has no TPU-native equivalent here",
+}
+
 _DEFAULT_CONFIGS: Dict[str, Dict[str, Any]] = {
     "amp_configs": {
         "init_loss_scaling": 32768.0, "incr_every_n_steps": 1000,
@@ -94,6 +102,11 @@ class DistributedStrategy:
 
     def __setattr__(self, name, value):
         if name in self._flags:
+            if name in _NOT_SUPPORTED_FLAGS and bool(value):
+                from ..core.enforce import UnimplementedError
+                raise UnimplementedError(
+                    f"DistributedStrategy.{name}: "
+                    f"{_NOT_SUPPORTED_FLAGS[name]}")
             self._flags[name] = bool(value)
         elif name in self._configs:
             cfg = self._configs[name]

@@ -141,9 +141,12 @@ def _remat_block(block, x):
     params = [p for _, p in named]
 
     def kernel(h, *pvals):
+        from ..core.offload import name_block_input, remat_policy
         state = {"params": dict(zip(names, pvals)), "buffers": {}}
         return jax.checkpoint(
-            lambda s, hh: functional_call(block, s, Tensor(hh)))(state, h)
+            lambda s, hh: functional_call(
+                block, s, Tensor(name_block_input(hh))),
+            policy=remat_policy())(state, h)
 
     return dispatch.call_fn(kernel, "remat_block", True, (x, *params), {})
 
