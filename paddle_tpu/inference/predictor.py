@@ -49,6 +49,15 @@ class Config:
     def set_precision(self, precision: str) -> None:
         self._precision = precision
 
+    def enable_low_precision(self, precision: str = PrecisionType.Int8
+                             ) -> None:
+        """Serve in low precision. bf16/f16: params are cast (HBM
+        footprint/bandwidth win). int8: the model must have been
+        PTQ-converted (quantization.convert_to_int8) before export — the
+        saved program already contains the int8 dot/conv kernels, so no
+        param cast is applied at load."""
+        self._precision = precision
+
     # reference naming: enable_tensorrt_engine configures the fused
     # low-precision path; here it just selects precision.
     def enable_tensorrt_engine(self, workspace_size=0, max_batch_size=1,

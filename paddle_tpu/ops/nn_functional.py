@@ -249,12 +249,16 @@ def label_smooth(label, prior_dist=None, epsilon=0.1):
 # --------------------------------------------------------------------------
 
 def _conv_dimension_numbers(ndim, channel_last):
+    # data_format only changes the input/output layout; the weight stays
+    # [out_c, in_c, *k] in the reference (conv_op.cc filter layout), so
+    # the rhs spec is OI* either way.
     if ndim == 3:
-        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+        return ("NWC", "OIW", "NWC") if channel_last else \
+            ("NCW", "OIW", "NCW")
     if ndim == 4:
-        return ("NHWC", "HWIO", "NHWC") if channel_last else \
+        return ("NHWC", "OIHW", "NHWC") if channel_last else \
             ("NCHW", "OIHW", "NCHW")
-    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else \
+    return ("NDHWC", "OIDHW", "NDHWC") if channel_last else \
         ("NCDHW", "OIDHW", "NCDHW")
 
 

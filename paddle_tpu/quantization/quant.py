@@ -203,3 +203,145 @@ class PTQ:
                 out[name] = {"weight_int8": q, "weight_scale": s,
                              "act_scale": self.act_ranges.get(name)}
         return out
+
+
+# --------------------------------------------------------------------------
+# int8 EXECUTION path (reference: slim quantization_pass.py rewrites the
+# program for quantized inference; trt_int8_calibrator.cc feeds TensorRT
+# int8 engines). TPU-native: weights stored as int8 arrays, activations
+# quantized on the fly, and the matmul/conv runs as an int8 x int8 ->
+# int32 XLA dot/conv (the MXU's native int8 path) with one scale-multiply
+# to come back to float.
+# --------------------------------------------------------------------------
+
+def quantize_int8(x, scale):
+    """Symmetric rounding quantization to int8 (execution-path analog of
+    the reference's quantize_op): q = clip(round(x / scale * 127))."""
+    raw = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    s = jnp.maximum(jnp.asarray(scale), 1e-8)
+    return jnp.clip(jnp.round(raw / s * 127.0), -127, 127).astype(jnp.int8)
+
+
+def dequantize_int8(q, scale):
+    """reference dequantize_op: float = q * scale / 127."""
+    raw = q.value if isinstance(q, Tensor) else jnp.asarray(q)
+    return raw.astype(jnp.float32) * (jnp.asarray(scale) / 127.0)
+
+
+class Int8Linear(Layer):
+    """Linear executing as int8 x int8 -> int32 on the MXU.
+
+    Weight is held as an int8 buffer with a per-output-channel scale;
+    the activation quantizes against the calibrated abs-max. One float
+    multiply recovers the result scale — XLA fuses it into the dot's
+    epilogue."""
+
+    def __init__(self, inner: Linear, act_scale: float, bits: int = 8):
+        super().__init__()
+        assert bits == 8, "int8 execution supports 8-bit only"
+        q, w_scale = quant_dequant(inner.weight, bits, axis=1)
+        self.register_buffer("weight_int8", Tensor(jnp.asarray(q)))
+        self.register_buffer("weight_scale",
+                             Tensor(jnp.asarray(w_scale)))  # [out]
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(np.float32(act_scale))))
+        self.bias = inner.bias
+
+    def forward(self, x):
+        def kernel(xv, wq, ws, asc, *maybe_bias):
+            qx = quantize_int8(xv, asc)
+            acc = jax.lax.dot_general(
+                qx, wq, (((qx.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (
+                (asc / 127.0) * (ws / 127.0))
+            if maybe_bias:
+                out = out + maybe_bias[0]
+            return out
+
+        args = [x, self.weight_int8, self.weight_scale, self.act_scale]
+        if self.bias is not None:
+            args.append(self.bias)
+        return dispatch.call_fn(kernel, "int8_linear", False,
+                                tuple(args), {})
+
+
+class Int8Conv2D(Layer):
+    """Conv2D executing as int8 x int8 -> int32 (per-tensor weight
+    scale; NCHW)."""
+
+    def __init__(self, inner: Conv2D, act_scale: float, bits: int = 8):
+        super().__init__()
+        assert bits == 8
+        q, w_scale = quant_dequant(inner.weight, bits, axis=None)
+        self.register_buffer("weight_int8", Tensor(jnp.asarray(q)))
+        self.register_buffer("weight_scale",
+                             Tensor(jnp.asarray(np.float32(w_scale))))
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(np.float32(act_scale))))
+        self.bias = inner.bias
+        self._stride = inner._stride
+        self._padding = inner._padding
+        self._dilation = inner._dilation
+        self._groups = inner._groups
+        self._data_format = inner._data_format
+
+    def forward(self, x):
+        # same stride/padding/dilation normalization as the fp32 conv2d
+        # kernel (ops/nn_functional.py) so both paths accept identical
+        # configs
+        from ..ops.nn_functional import _conv_padding, _norm_tuple
+        stride, padding = self._stride, self._padding
+        dilation, groups = self._dilation, self._groups
+
+        def kernel(xv, wq, ws, asc, *maybe_bias):
+            qx = quantize_int8(xv, asc)
+            acc = jax.lax.conv_general_dilated(
+                qx, wq, window_strides=_norm_tuple(stride, 2),
+                padding=_conv_padding(padding, 2, stride, dilation,
+                                      wq.shape[2:]),
+                rhs_dilation=_norm_tuple(dilation, 2),
+                feature_group_count=groups,
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (
+                (asc / 127.0) * (ws / 127.0))
+            if maybe_bias:
+                out = out + maybe_bias[0].reshape(1, -1, 1, 1)
+            return out
+
+        args = [x, self.weight_int8, self.weight_scale, self.act_scale]
+        if self.bias is not None:
+            args.append(self.bias)
+        return dispatch.call_fn(kernel, "int8_conv2d", False,
+                                tuple(args), {})
+
+
+def convert_to_int8(model: Layer, ptq: "PTQ") -> Layer:
+    """Swap calibrated Linear/Conv2D layers for int8-executing versions
+    (reference: quantization_pass.py program rewrite). The model must
+    have been run through ptq.calibrate() first."""
+    from ..core.enforce import InvalidArgumentError
+
+    def convert(layer: Layer, prefix: str = "") -> None:
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            act = ptq.act_ranges.get(full)
+            if type(sub) is Linear or type(sub) is Conv2D:
+                if act is None:
+                    raise InvalidArgumentError(
+                        f"no calibration range for layer {full!r}; run "
+                        "PTQ.calibrate() over representative data first")
+                if type(sub) is Conv2D:
+                    if sub._data_format not in ("NCHW", None):
+                        raise InvalidArgumentError(
+                            f"int8 conversion of layer {full!r}: only "
+                            "NCHW Conv2D is supported (got "
+                            f"{sub._data_format!r})")
+                    layer._sub_layers[name] = Int8Conv2D(sub, act)
+                else:
+                    layer._sub_layers[name] = Int8Linear(sub, act)
+            else:
+                convert(sub, full)
+
+    convert(model)
+    return model

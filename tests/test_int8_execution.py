@@ -1,0 +1,100 @@
+"""int8 execution-path tests.
+
+Reference: slim/quantization/quantization_pass.py rewrites programs for
+quantized inference and trt_int8_calibrator.cc feeds TensorRT int8
+engines. TPU-native: PTQ calibration -> convert_to_int8 swaps
+Linear/Conv2D for layers holding int8 weight buffers whose matmul/conv
+execute as int8 x int8 -> int32 XLA ops (the MXU's native int8 path),
+and the exported program serves through the AOT predictor.
+"""
+
+import os
+import tempfile
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.optimizer as optim
+import paddle_tpu.static as st
+from paddle_tpu import inference
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.quantization.quant import (PTQ, Int8Conv2D, Int8Linear,
+                                           convert_to_int8,
+                                           dequantize_int8, quantize_int8)
+from paddle_tpu.vision.models import LeNet
+
+
+@pytest.fixture(scope="module")
+def trained_lenet():
+    pt.seed(0)
+    rng = np.random.default_rng(0)
+    templates = rng.normal(size=(10, 1, 28, 28)).astype("float32")
+    y = rng.integers(0, 10, size=512).astype("int64")
+    x = (templates[y]
+         + 0.3 * rng.normal(size=(512, 1, 28, 28))).astype("float32")
+    model = LeNet()
+    opt = optim.Adam(learning_rate=1e-3, parameters=model.parameters())
+    step = TrainStep(model, opt, lambda m, b: pt.nn.functional
+                     .cross_entropy(m(b[0]), b[1]).mean())
+    for _ in range(60):
+        step((x[:256], y[:256]))
+    step.sync_to_model()
+    model.eval()
+    return model, x, y
+
+
+@pytest.mark.slow
+def test_int8_conversion_and_accuracy(trained_lenet):
+    model, x, y = trained_lenet
+    logits = model(pt.Tensor(jnp.asarray(x[256:])))
+    acc_fp32 = float((np.asarray(logits.value).argmax(1)
+                      == y[256:]).mean())
+    assert acc_fp32 > 0.9  # the smoke model actually learned
+
+    ptq = PTQ()
+    ptq.calibrate(model, [(x[i * 32:(i + 1) * 32],) for i in range(8)],
+                  num_batches=8)
+    convert_to_int8(model, ptq)
+
+    n_int8 = 0
+    for _, sub in model.named_sublayers():
+        if isinstance(sub, (Int8Linear, Int8Conv2D)):
+            assert sub.weight_int8.value.dtype == jnp.int8
+            n_int8 += 1
+    assert n_int8 >= 3  # LeNet's convs + fcs now execute int8
+
+    logits8 = model(pt.Tensor(jnp.asarray(x[256:])))
+    acc_int8 = float((np.asarray(logits8.value).argmax(1)
+                      == y[256:]).mean())
+    assert acc_fp32 - acc_int8 <= 0.01, (acc_fp32, acc_int8)
+    agree = float((np.asarray(logits8.value).argmax(1)
+                   == np.asarray(logits.value).argmax(1)).mean())
+    assert agree >= 0.98, agree
+
+    # predictor serves the int8 program end-to-end
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, "lenet_int8")
+    st.save_inference_model(
+        path, [st.InputSpec([32, 1, 28, 28], "float32")], layer=model)
+    cfg = inference.Config(path)
+    cfg.enable_low_precision("int8")
+    pred = inference.Predictor(cfg)
+    out = pred.run([x[256:288]])[0]
+    np.testing.assert_allclose(out, np.asarray(logits8.value)[:32],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_quantize_dequantize_roundtrip():
+    q = quantize_int8(pt.to_tensor(np.array([0.5, -1.0], "float32")), 1.0)
+    assert q.dtype == jnp.int8
+    d = dequantize_int8(q, 1.0)
+    np.testing.assert_allclose(np.asarray(d), [0.5, -1.0], atol=1 / 127)
+
+
+def test_int8_requires_calibration():
+    from paddle_tpu.core.enforce import InvalidArgumentError
+    model = LeNet()
+    with pytest.raises(InvalidArgumentError, match="calibration"):
+        convert_to_int8(model, PTQ())

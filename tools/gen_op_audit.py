@@ -250,9 +250,9 @@ API = {
     "fake_quantize_moving_average_abs_max": "quantization.quant",
     "fake_quantize_range_abs_max": "quantization.quant",
     "moving_average_abs_max_scale": "quantization.quant",
-    "quantize": "quantization.quant",
-    "dequantize": "quantization.quant",
-    "requantize": "quantization.quant",
+    "quantize": "quantization.quant.quantize_int8",
+    "dequantize": "quantization.quant.dequantize_int8",
+    "requantize": "quantization.quant.quantize_int8",
     # misc api
     "seed": "paddle_tpu.seed",
     "clip_by_norm": "optimizer.clip.ClipGradByNorm",
