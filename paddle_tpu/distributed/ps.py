@@ -273,6 +273,7 @@ class PSServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.dense: Dict[str, DenseTable] = {}
         self.sparse: Dict[str, SparseTable] = {}
+        self.graph: Dict[str, "GraphTable"] = {}
         self._barrier_count = 0
         self._barrier_lock = threading.Lock()
         # Blocking rendezvous barrier (sync-PS lockstep, reference:
@@ -338,6 +339,13 @@ class PSServer:
         self.sparse[name] = t
         return t
 
+    def add_graph_table(self, name: str, feat_dim: int = 0
+                        ) -> "GraphTable":
+        """reference: common_graph_table.cc registered as a PS table."""
+        t = GraphTable(feat_dim)
+        self.graph[name] = t
+        return t
+
     def _dispatch(self, msg: Dict) -> Dict:
         cmd = msg.get("cmd")
         try:
@@ -386,10 +394,42 @@ class PSServer:
                     self._barrier_count += 1
                     n = self._barrier_count
                 return {"ok": True, "count": n}
+            if cmd == GRAPH_ADD_NODES:
+                self.graph[msg["table"]].add_nodes(msg["ids"],
+                                                  msg.get("feats"))
+                return {"ok": True}
+            if cmd == GRAPH_ADD_EDGES:
+                self.graph[msg["table"]].add_edges(msg["srcs"],
+                                                  msg["dsts"],
+                                                  msg.get("weights"))
+                return {"ok": True}
+            if cmd == GRAPH_REMOVE_NODES:
+                self.graph[msg["table"]].remove_nodes(msg["ids"])
+                return {"ok": True}
+            if cmd == GRAPH_SAMPLE_NEIGHBORS:
+                nbrs, cnt = self.graph[msg["table"]].sample_neighbors(
+                    msg["ids"], msg["sample_size"], msg.get("seed", 0))
+                return {"ok": True, "neighbors": nbrs, "counts": cnt}
+            if cmd == GRAPH_SAMPLE_NODES:
+                return {"ok": True,
+                        "ids": self.graph[msg["table"]].sample_nodes(
+                            msg["n"], msg.get("seed", 0))}
+            if cmd == GRAPH_GET_FEAT:
+                return {"ok": True,
+                        "feats": self.graph[msg["table"]].get_feat(
+                            msg["ids"])}
+            if cmd == GRAPH_LIST:
+                return {"ok": True,
+                        "ids": self.graph[msg["table"]].node_list(
+                            msg["start"], msg["size"])}
             if cmd == STOP:
                 return {"ok": True}
         except KeyError as e:
             return {"ok": False, "error": f"unknown table {e}"}
+        except Exception as e:  # noqa: BLE001 - a handler thread must
+            # always answer; the client re-raises the message
+            return {"ok": False,
+                    "error": f"{type(e).__name__}: {e}"}
         return {"ok": False, "error": f"unknown cmd {cmd!r}"}
 
     def start(self) -> None:
@@ -518,6 +558,109 @@ class PSClient:
             self._call(srv, {"cmd": PUSH_SPARSE_DELTA, "table": table,
                              "keys": keys[mask].tolist(),
                              "delta": deltas[mask]})
+
+    # -- graph engine (reference: brpc client graph RPCs over
+    #    common_graph_table.cc; nodes shard by id % n_servers) ---------
+
+    def add_graph_node(self, table: str, ids, feats=None) -> None:
+        ids = np.asarray(ids, np.int64).ravel()
+        n = len(self.endpoints)
+        for srv in range(n):
+            mask = (ids % n) == srv
+            if not mask.any():
+                continue
+            msg = {"cmd": GRAPH_ADD_NODES, "table": table,
+                   "ids": ids[mask].tolist()}
+            if feats is not None:
+                msg["feats"] = np.asarray(feats, np.float32)[mask]
+            self._call(srv, msg)
+
+    def add_graph_edges(self, table: str, srcs, dsts,
+                        weights=None) -> None:
+        srcs = np.asarray(srcs, np.int64).ravel()
+        dsts = np.asarray(dsts, np.int64).ravel()
+        n = len(self.endpoints)
+        for srv in range(n):
+            mask = (srcs % n) == srv  # edges live with their source node
+            if not mask.any():
+                continue
+            msg = {"cmd": GRAPH_ADD_EDGES, "table": table,
+                   "srcs": srcs[mask].tolist(),
+                   "dsts": dsts[mask].tolist()}
+            if weights is not None:
+                msg["weights"] = np.asarray(
+                    weights, np.float32)[mask].tolist()
+            self._call(srv, msg)
+
+    def remove_graph_node(self, table: str, ids) -> None:
+        ids = np.asarray(ids, np.int64).ravel()
+        n = len(self.endpoints)
+        for srv in range(n):
+            mask = (ids % n) == srv
+            if mask.any():
+                self._call(srv, {"cmd": GRAPH_REMOVE_NODES,
+                                 "table": table,
+                                 "ids": ids[mask].tolist()})
+
+    def sample_neighbors(self, table: str, ids, sample_size: int,
+                         seed: int = 0):
+        """Per-node weighted neighbor sample; server-side sampling, only
+        sampled ids cross the wire. Returns ([len(ids), sample_size]
+        int64 padded with -1, counts)."""
+        ids = np.asarray(ids, np.int64).ravel()
+        n = len(self.endpoints)
+        nbrs = np.full((ids.size, sample_size), -1, np.int64)
+        cnt = np.zeros(ids.size, np.int32)
+        for srv in range(n):
+            mask = (ids % n) == srv
+            if not mask.any():
+                continue
+            r = self._call(srv, {"cmd": GRAPH_SAMPLE_NEIGHBORS,
+                                 "table": table,
+                                 "ids": ids[mask].tolist(),
+                                 "sample_size": sample_size,
+                                 "seed": seed})
+            nbrs[mask] = r["neighbors"]
+            cnt[mask] = r["counts"]
+        return nbrs, cnt
+
+    def sample_graph_nodes(self, table: str, n_nodes: int,
+                           seed: int = 0) -> np.ndarray:
+        per = -(-n_nodes // len(self.endpoints))  # ceil: no remainder loss
+        out = []
+        for srv in range(len(self.endpoints)):
+            r = self._call(srv, {"cmd": GRAPH_SAMPLE_NODES,
+                                 "table": table, "n": per, "seed": seed})
+            out.append(np.asarray(r["ids"], np.int64))
+        return np.concatenate(out)[:n_nodes]
+
+    def get_node_feat(self, table: str, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).ravel()
+        n = len(self.endpoints)
+        out = None
+        for srv in range(n):
+            mask = (ids % n) == srv
+            if not mask.any():
+                continue
+            r = self._call(srv, {"cmd": GRAPH_GET_FEAT, "table": table,
+                                 "ids": ids[mask].tolist()})
+            f = np.asarray(r["feats"], np.float32)
+            if out is None:
+                out = np.zeros((ids.size, f.shape[1]), np.float32)
+            out[mask] = f
+        return out if out is not None else np.zeros((ids.size, 0),
+                                                    np.float32)
+
+    def pull_graph_list(self, table: str, start: int, size: int):
+        # global pagination: each server returns its first start+size
+        # ids; the offset applies to the merged order (a per-server
+        # offset would skip ids)
+        out = []
+        for srv in range(len(self.endpoints)):
+            r = self._call(srv, {"cmd": GRAPH_LIST, "table": table,
+                                 "start": 0, "size": start + size})
+            out.extend(r["ids"])
+        return sorted(out)[start:start + size]
 
     def barrier(self, world: int = 0) -> None:
         """world > 1: blocking rendezvous across that many trainers
@@ -891,3 +1034,127 @@ class NativePSClient:
                 pass
             self._lib.pt_ps_disconnect(c)
         self._conns = []
+
+
+# --------------------------------------------------------------------------
+# Graph engine table (reference: distributed/table/common_graph_table.cc —
+# the GNN graph store: sharded node/edge storage, weighted neighbor
+# sampling, node sampling, feature pull, served over the PS RPC).
+# Nodes shard across servers by id % n_servers (the reference shards by
+# id % shard_num); sampling RPCs run server-side so only the sampled
+# ids/features cross the wire.
+# --------------------------------------------------------------------------
+
+GRAPH_ADD_NODES = "graph_add_nodes"
+GRAPH_ADD_EDGES = "graph_add_edges"
+GRAPH_REMOVE_NODES = "graph_remove_nodes"
+GRAPH_SAMPLE_NEIGHBORS = "graph_sample_neighbors"
+GRAPH_SAMPLE_NODES = "graph_sample_nodes"
+GRAPH_GET_FEAT = "graph_get_feat"
+GRAPH_LIST = "graph_list"
+
+
+class GraphTable:
+    """Server-side graph store (common_graph_table.cc capability)."""
+
+    def __init__(self, feat_dim: int = 0):
+        self.feat_dim = feat_dim
+        self.nodes: Dict[int, np.ndarray] = {}
+        self.edges: Dict[int, List[Tuple[int, float]]] = {}
+        # thread-per-connection server: same locking discipline as the
+        # other table kinds
+        self._lock = threading.Lock()
+
+    def add_nodes(self, ids, feats=None) -> None:
+        with self._lock:
+            for i, nid in enumerate(ids):
+                nid = int(nid)
+                if feats is not None:
+                    self.nodes[nid] = np.asarray(feats[i], np.float32)
+                else:
+                    self.nodes.setdefault(
+                        nid, np.zeros(self.feat_dim, np.float32))
+
+    def add_edges(self, srcs, dsts, weights=None) -> None:
+        with self._lock:
+            for i, (s, d) in enumerate(zip(srcs, dsts)):
+                w = float(weights[i]) if weights is not None else 1.0
+                self.edges.setdefault(int(s), []).append((int(d), w))
+                self.nodes.setdefault(
+                    int(s), np.zeros(self.feat_dim, np.float32))
+
+    def remove_nodes(self, ids) -> None:
+        with self._lock:
+            for nid in ids:
+                self.nodes.pop(int(nid), None)
+                self.edges.pop(int(nid), None)
+
+    def sample_neighbors(self, ids, sample_size: int, seed: int = 0):
+        """Weighted sampling without replacement per node (reference
+        random_sample_neighboors); returns (neighbor ids padded with -1,
+        actual counts). Zero/negative-weight edges are never sampled."""
+        rng = np.random.default_rng(seed)
+        out = np.full((len(ids), sample_size), -1, np.int64)
+        cnt = np.zeros(len(ids), np.int32)
+        with self._lock:
+            for r, nid in enumerate(ids):
+                nbrs = [e for e in self.edges.get(int(nid), [])
+                        if e[1] > 0.0]
+                if not nbrs:
+                    continue
+                k = min(sample_size, len(nbrs))
+                w = np.asarray([x[1] for x in nbrs], np.float64)
+                pick = rng.choice(len(nbrs), size=k, replace=False,
+                                  p=w / w.sum())
+                out[r, :k] = [nbrs[i][0] for i in pick]
+                cnt[r] = k
+        return out, cnt
+
+    def sample_nodes(self, n: int, seed: int = 0):
+        with self._lock:
+            ids = np.asarray(sorted(self.nodes), np.int64)
+        if not len(ids):
+            return ids
+        rng = np.random.default_rng(seed)
+        return rng.choice(ids, size=min(n, len(ids)), replace=False)
+
+    def get_feat(self, ids) -> np.ndarray:
+        dim = self.feat_dim
+        out = np.zeros((len(ids), dim), np.float32)
+        with self._lock:
+            for r, nid in enumerate(ids):
+                f = self.nodes.get(int(nid))
+                if f is not None and len(f):
+                    out[r, :len(f)] = f[:dim]
+        return out
+
+    def node_list(self, start: int, size: int):
+        with self._lock:
+            ids = sorted(self.nodes)
+        return ids[start:start + size]
+
+    def load_edges(self, path: str, reversed_edge: bool = False) -> None:
+        """reference load_edges: lines of 'src\\tdst[\\tweight]'."""
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 2:
+                    continue
+                s, d = int(parts[0]), int(parts[1])
+                w = float(parts[2]) if len(parts) > 2 else 1.0
+                if reversed_edge:
+                    s, d = d, s
+                self.add_edges([s], [d], [w])
+
+    def load_nodes(self, path: str) -> None:
+        """reference load_nodes: 'node_id feat0 feat1 ...' per line."""
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                nid = int(parts[0])
+                feats = [np.asarray([float(v) for v in parts[1:]],
+                                    np.float32)] if len(parts) > 1 else \
+                    None
+                self.add_nodes([nid], feats)
