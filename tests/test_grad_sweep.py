@@ -18,6 +18,8 @@ import pytest
 
 from paddle_tpu.ops.registry import all_ops
 
+pytestmark = pytest.mark.slow  # covered breadth; fast lane keeps sibling smokes
+
 # Ops whose domain needs shifting away from the default (0.2, 0.8) range.
 DOMAIN = {
     "acosh": (1.2, 2.0),
