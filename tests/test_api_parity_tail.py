@@ -13,6 +13,8 @@ import paddle_tpu.nn as nn
 import paddle_tpu.static as st
 import paddle_tpu.static.nn as snn
 
+pytestmark = pytest.mark.slow  # covered breadth; fast lane keeps sibling smokes
+
 
 # -- top-level names ----------------------------------------------------------
 

@@ -15,6 +15,8 @@ import paddle_tpu.optimizer as optim
 from paddle_tpu import nn
 from paddle_tpu.jit import TrainStep
 
+pytestmark = pytest.mark.slow  # convergence-scale runtime
+
 RNG = np.random.default_rng(0)
 
 

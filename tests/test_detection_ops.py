@@ -7,6 +7,8 @@ import pytest
 
 from paddle_tpu.ops import detection as det
 
+pytestmark = pytest.mark.slow  # covered breadth; fast lane keeps sibling smokes
+
 
 def _np_iou(a, b):
     lt = np.maximum(a[:, None, :2], b[None, :, :2])

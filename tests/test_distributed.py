@@ -107,6 +107,7 @@ def test_sharded_train_step_gpt():
     assert slot_shard.spec == P("sharding", None)
 
 
+@pytest.mark.slow
 def test_sharded_matches_single_device():
     """Hybrid-parallel loss == single-device TrainStep loss (the
     reference's core hybrid test invariant)."""
@@ -263,6 +264,7 @@ def test_recompute_matches_plain():
                                    rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_gradient_merge_step():
     from paddle_tpu.models import GPTForCausalLM, gpt_tiny
 

@@ -186,6 +186,7 @@ def test_grad_scaler_fp16_flow():
     np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_chunked_loss_remat_eager_grad_parity():
     """loss_chunk_size + remat must match the full-logits path in BOTH the
     loss value and eager-tape gradients (regression: raw-jax chunk/remat
@@ -219,6 +220,7 @@ def test_chunked_loss_remat_eager_grad_parity():
                                    rtol=2e-3, atol=2e-5, err_msg=n)
 
 
+@pytest.mark.slow
 def test_chunked_loss_ignore_index_matches_full():
     """Labels containing ignore_index (-100) must give the SAME loss in
     chunked and full-logits paths (both count ignored slots in the mean's

@@ -13,6 +13,8 @@ import pytest
 
 import paddle_tpu as pt
 
+pytestmark = pytest.mark.slow  # covered breadth; fast lane keeps sibling smokes
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -8,6 +8,7 @@ import paddle_tpu.optimizer as optim
 from paddle_tpu.models import GPTForCausalLM, gpt_tiny
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_matches_single_device():
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.gpt_pipeline import GPTPipelineTrainStep
@@ -30,6 +31,7 @@ def test_gpt_pipeline_matches_single_device():
                                atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_four_stages():
     from paddle_tpu.models.gpt_pipeline import GPTPipelineTrainStep
 
@@ -56,6 +58,7 @@ def test_moe_gpt_trains():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_moe_expert_sharding_in_hybrid_step():
     from jax.sharding import PartitionSpec as P
     from paddle_tpu.distributed import DistributedStrategy, fleet
@@ -77,6 +80,7 @@ def test_moe_expert_sharding_in_hybrid_step():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_1f1b_matches_fthenb():
     """True 1F1B schedule (manual backward, O(pp) activation memory)
     must produce the same losses as F-then-B and the single-device
@@ -121,6 +125,7 @@ def test_generate_jit_matches_eager_greedy():
                                   np.asarray(out_j2.value))
 
 
+@pytest.mark.slow
 def test_hybrid_pipeline_all_axes_one_mesh():
     """pp composed with mp/dp/sharding in ONE mesh: shard_map manual over
     pp only, GSPMD auto over the rest; optimizer slots ZeRO-shard over

@@ -17,6 +17,8 @@ from paddle_tpu.distributed import DistributedStrategy, fleet
 from paddle_tpu.jit import TrainStep
 from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
+pytestmark = pytest.mark.slow  # convergence-scale runtime
+
 
 @pytest.fixture(scope="module", autouse=True)
 def sep_env():

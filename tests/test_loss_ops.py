@@ -20,6 +20,8 @@ from op_test import check_forward, check_grad
 
 from paddle_tpu.ops import loss_extra as L
 
+pytestmark = pytest.mark.slow  # covered breadth; fast lane keeps sibling smokes
+
 RNG = np.random.default_rng(7)
 
 

@@ -16,6 +16,8 @@ import paddle_tpu.optimizer as optim
 from paddle_tpu import nn
 from paddle_tpu.jit import TrainStep
 
+pytestmark = pytest.mark.slow  # covered breadth; fast lane keeps sibling smokes
+
 
 def _make_cnn():
     pt.seed(7)

@@ -168,6 +168,7 @@ def test_transformer_encoder():
     assert not np.allclose(p0, p1)
 
 
+@pytest.mark.slow
 def test_transformer_full():
     model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
                            num_decoder_layers=1, dim_feedforward=32,
@@ -178,6 +179,7 @@ def test_transformer_full():
     assert out.shape == (2, 3, 16)
 
 
+@pytest.mark.slow
 def test_lstm():
     lstm = nn.LSTM(4, 8, num_layers=2)
     x = pt.randn((3, 5, 4))

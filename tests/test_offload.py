@@ -41,6 +41,7 @@ def _sharded_losses(offload, steps=3):
     return losses
 
 
+@pytest.mark.slow
 def test_optimizer_state_offload_matches_resident():
     """Slots parked in pinned host memory between steps produce the
     exact same training trajectory as HBM-resident slots."""
@@ -50,6 +51,7 @@ def test_optimizer_state_offload_matches_resident():
     assert off[-1] < off[0]
 
 
+@pytest.mark.slow
 def test_activation_offload_single_chip_matches():
     """Rematerialized block inputs staged to host (single-chip path)
     leave the trajectory unchanged."""

@@ -17,6 +17,8 @@ import pytest
 
 import paddle_tpu as pt
 
+pytestmark = pytest.mark.slow  # covered breadth; fast lane keeps sibling smokes
+
 
 def _np_edit_distance(a, b):
     dp = np.zeros((len(a) + 1, len(b) + 1), int)

@@ -8,6 +8,8 @@ import pytest
 
 from op_test import check_forward, check_grad
 
+pytestmark = pytest.mark.slow  # covered breadth; fast lane keeps sibling smokes
+
 RNG = np.random.default_rng(7)
 
 

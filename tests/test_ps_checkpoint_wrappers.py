@@ -9,6 +9,8 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu import nn, optimizer as optim
 
+pytestmark = pytest.mark.slow  # covered breadth; fast lane keeps sibling smokes
+
 
 # -- parameter server ---------------------------------------------------------
 

@@ -6,6 +6,8 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu import nn, optimizer as optim
 
+pytestmark = pytest.mark.slow  # covered breadth; fast lane keeps sibling smokes
+
 
 def test_fake_quant_ste_grads():
     from paddle_tpu.quantization import fake_quant

@@ -9,6 +9,8 @@ import pytest
 from paddle_tpu.framework.ragged import RaggedTensor
 from paddle_tpu.ops import sequence as seq
 
+pytestmark = pytest.mark.slow  # covered breadth; fast lane keeps sibling smokes
+
 
 @pytest.fixture
 def batch(rng):

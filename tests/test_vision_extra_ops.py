@@ -17,6 +17,8 @@ import pytest
 from paddle_tpu.ops import nn_functional as NF
 from paddle_tpu.ops import vision_extra as V
 
+pytestmark = pytest.mark.slow  # covered breadth; fast lane keeps sibling smokes
+
 RNG = np.random.default_rng(3)
 
 
