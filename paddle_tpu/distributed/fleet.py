@@ -270,6 +270,12 @@ class ShardedTrainStep:
         # memory between steps; the step splits into a grad phase (slots
         # absent from HBM while activations peak) and an update phase
         # (slots staged in, updated, staged back out).
+        if self.strategy.sharding_configs.get("optimize_offload") and \
+                not self.strategy.sharding:
+            from ..core.enforce import InvalidArgumentError
+            raise InvalidArgumentError(
+                "sharding_configs.optimize_offload requires "
+                "strategy.sharding = True (it must not silently no-op)")
         self._offload = bool(
             self.strategy.sharding
             and self.strategy.sharding_configs.get("optimize_offload"))
