@@ -120,15 +120,25 @@ def main() -> None:
     # value transfer is a true barrier.
     float(losses[-1])
 
-    t0 = time.perf_counter()
-    losses = step.multi_step(timed_batches)
-    final_loss = float(losses[-1])  # hard sync ends the timed region
-    dt = time.perf_counter() - t0
+    # Median of >=3 timed windows with the run-to-run spread quantified
+    # (the r1 verdict flagged a single-window number with ~5% unexplained
+    # variance; the median is robust to a straggler window on the
+    # tunneled runtime)
+    n_windows = max(1, int(os.environ.get("PT_BENCH_WINDOWS", "3")))
+    window_toks = []
+    final_loss = None
+    tokens_per_step = batch * seq
+    for _ in range(n_windows):
+        t0 = time.perf_counter()
+        losses = step.multi_step(timed_batches)
+        final_loss = float(losses[-1])  # hard sync ends the timed region
+        dt = time.perf_counter() - t0
+        window_toks.append(tokens_per_step * steps / dt)
     assert np.isfinite(final_loss) and final_loss < 12.0, \
         f"training diverged during benchmark: {final_loss}"
 
-    tokens_per_step = batch * seq
-    tok_s = tokens_per_step * steps / dt
+    tok_s = float(np.median(window_toks))
+    spread_pct = 100.0 * (max(window_toks) - min(window_toks)) / tok_s
 
     # 6ND model FLOPs + attention term, x3 for fwd+bwd via 6N
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
@@ -144,6 +154,9 @@ def main() -> None:
         "value": round(tok_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4) if on_tpu else 0.0,
+        "mfu_pct": round(100.0 * mfu, 2) if on_tpu else 0.0,
+        "windows": [round(t, 1) for t in window_toks],
+        "spread_pct": round(spread_pct, 2),
     }
     print(json.dumps(result))
 

@@ -1,0 +1,45 @@
+// Config wraps PD_Config (reference: goapi/config.go over pd_config.h).
+package paddle
+
+// #cgo CFLAGS: -I../native
+// #cgo LDFLAGS: -L../native -lpt_infer
+// #include <stdlib.h>
+// #include "pt_capi.h"
+import "C"
+import "unsafe"
+
+type Config struct {
+	c *C.PD_Config
+}
+
+// NewConfig mirrors paddle.NewConfig in the reference goapi.
+func NewConfig() *Config {
+	return &Config{c: C.PD_ConfigCreate()}
+}
+
+// SetModel points the predictor at an exported model prefix
+// (<prefix>.pdmodel / <prefix>.pdiparams).
+func (cfg *Config) SetModel(prefix string) {
+	p := C.CString(prefix)
+	defer C.free(unsafe.Pointer(p))
+	C.PD_ConfigSetModel(cfg.c, p)
+}
+
+// SetPrecision selects serving precision: "float32", "bfloat16",
+// "float16", or "int8" (PTQ-exported models).
+func (cfg *Config) SetPrecision(precision string) {
+	p := C.CString(precision)
+	defer C.free(unsafe.Pointer(p))
+	C.PD_ConfigSetPrecision(cfg.c, p)
+}
+
+// DisableGpu forces host execution.
+func (cfg *Config) DisableGpu() {
+	C.PD_ConfigDisableGpu(cfg.c)
+}
+
+// Destroy releases the config (safe after NewPredictor).
+func (cfg *Config) Destroy() {
+	C.PD_ConfigDestroy(cfg.c)
+	cfg.c = nil
+}

@@ -1,0 +1,132 @@
+// Predictor wraps PD_Predictor (reference: goapi/predictor.go +
+// tensor.go over pd_predictor.h/pd_tensor.h; the zero-copy tensor
+// handles collapse into typed Set/Get calls on this ABI).
+package paddle
+
+// #cgo CFLAGS: -I../native
+// #cgo LDFLAGS: -L../native -lpt_infer
+// #include <stdlib.h>
+// #include "pt_capi.h"
+import "C"
+
+import (
+	"fmt"
+	"unsafe"
+)
+
+type Predictor struct {
+	p *C.PD_Predictor
+}
+
+// LastError returns the C API's last failure message.
+func LastError() string {
+	return C.GoString(C.PD_GetLastError())
+}
+
+// NewPredictor AOT-loads the exported program (reference:
+// paddle.NewPredictor).
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	p := C.PD_PredictorCreate(cfg.c)
+	if p == nil {
+		return nil, fmt.Errorf("PD_PredictorCreate: %s", LastError())
+	}
+	return &Predictor{p: p}, nil
+}
+
+func (pr *Predictor) names(num int, get func(int, *C.char, C.int) C.int,
+) []string {
+	out := make([]string, 0, num)
+	buf := (*C.char)(C.malloc(256))
+	defer C.free(unsafe.Pointer(buf))
+	for i := 0; i < num; i++ {
+		if get(i, buf, 256) == 0 {
+			out = append(out, C.GoString(buf))
+		}
+	}
+	return out
+}
+
+// GetInputNames mirrors predictor.GetInputNames().
+func (pr *Predictor) GetInputNames() []string {
+	n := int(C.PD_PredictorGetInputNum(pr.p))
+	return pr.names(n, func(i int, buf *C.char, l C.int) C.int {
+		return C.PD_PredictorGetInputName(pr.p, C.int(i), buf, l)
+	})
+}
+
+// GetOutputNames mirrors predictor.GetOutputNames().
+func (pr *Predictor) GetOutputNames() []string {
+	n := int(C.PD_PredictorGetOutputNum(pr.p))
+	return pr.names(n, func(i int, buf *C.char, l C.int) C.int {
+		return C.PD_PredictorGetOutputName(pr.p, C.int(i), buf, l)
+	})
+}
+
+// SetInput copies data for the named input; dtype is the numpy-style
+// name ("float32", "int32", ...).
+func (pr *Predictor) SetInput(name string, data unsafe.Pointer,
+	shape []int64, dtype string) error {
+	cname := C.CString(name)
+	defer C.free(unsafe.Pointer(cname))
+	cdtype := C.CString(dtype)
+	defer C.free(unsafe.Pointer(cdtype))
+	var shp *C.int64_t
+	if len(shape) > 0 {
+		shp = (*C.int64_t)(unsafe.Pointer(&shape[0]))
+	}
+	if C.PD_PredictorSetInput(pr.p, cname, data, shp,
+		C.int(len(shape)), cdtype) != 0 {
+		return fmt.Errorf("PD_PredictorSetInput: %s", LastError())
+	}
+	return nil
+}
+
+// SetInputFloat32 is the typed convenience used by the examples.
+func (pr *Predictor) SetInputFloat32(name string, data []float32,
+	shape []int64) error {
+	return pr.SetInput(name, unsafe.Pointer(&data[0]), shape, "float32")
+}
+
+// Run executes the AOT-compiled program once.
+func (pr *Predictor) Run() error {
+	if C.PD_PredictorRun(pr.p) != 0 {
+		return fmt.Errorf("PD_PredictorRun: %s", LastError())
+	}
+	return nil
+}
+
+// GetOutput fetches the named output as raw bytes plus shape/dtype.
+func (pr *Predictor) GetOutput(name string) ([]byte, []int64, string,
+	error) {
+	cname := C.CString(name)
+	defer C.free(unsafe.Pointer(cname))
+	shape := make([]int64, 16)
+	var ndim C.int
+	dtypeBuf := (*C.char)(C.malloc(32))
+	defer C.free(unsafe.Pointer(dtypeBuf))
+	// first call sizes the buffer
+	need := C.PD_PredictorGetOutput(pr.p, cname, nil, 0,
+		(*C.int64_t)(unsafe.Pointer(&shape[0])), &ndim, dtypeBuf, 32)
+	if need < 0 {
+		return nil, nil, "", fmt.Errorf("PD_PredictorGetOutput: %s",
+			LastError())
+	}
+	if need == 0 {
+		return nil, shape[:int(ndim)], C.GoString(dtypeBuf), nil
+	}
+	buf := make([]byte, int(need))
+	got := C.PD_PredictorGetOutput(pr.p, cname, unsafe.Pointer(&buf[0]),
+		need, (*C.int64_t)(unsafe.Pointer(&shape[0])), &ndim, dtypeBuf,
+		32)
+	if got < 0 {
+		return nil, nil, "", fmt.Errorf("PD_PredictorGetOutput: %s",
+			LastError())
+	}
+	return buf[:int(got)], shape[:int(ndim)], C.GoString(dtypeBuf), nil
+}
+
+// Destroy releases the predictor.
+func (pr *Predictor) Destroy() {
+	C.PD_PredictorDestroy(pr.p)
+	pr.p = nil
+}

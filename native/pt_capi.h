@@ -1,0 +1,51 @@
+/* C inference ABI (reference: paddle/fluid/inference/capi_exp/ public
+ * headers). Declares the extern "C" surface of pt_capi.cc; consumed by C
+ * programs (tests/test_capi.py compiles one) and the Go wrapper (go/).
+ */
+#ifndef PT_CAPI_H_
+#define PT_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+
+/* last error message for any failed (-1) call */
+const char* PD_GetLastError();
+
+/* config (reference: pd_config.h AnalysisConfig surface) */
+PD_Config* PD_ConfigCreate();
+void PD_ConfigSetModel(PD_Config* c, const char* prefix);
+void PD_ConfigSetPrecision(PD_Config* c, const char* precision);
+void PD_ConfigDisableGpu(PD_Config* c);
+void PD_ConfigDestroy(PD_Config* c);
+
+/* predictor (reference: pd_predictor.h) */
+PD_Predictor* PD_PredictorCreate(PD_Config* c);
+int PD_PredictorGetInputNum(PD_Predictor* p);
+int PD_PredictorGetInputName(PD_Predictor* p, int i, char* buf,
+                             int buflen);
+int PD_PredictorSetInput(PD_Predictor* p, const char* name,
+                         const void* data, const int64_t* shape, int ndim,
+                         const char* dtype);
+int PD_PredictorRun(PD_Predictor* p);
+int PD_PredictorGetOutputNum(PD_Predictor* p);
+int PD_PredictorGetOutputName(PD_Predictor* p, int i, char* buf,
+                              int buflen);
+/* returns bytes written (or required when buf is NULL); fills shape,
+ * ndim, dtype */
+int64_t PD_PredictorGetOutput(PD_Predictor* p, const char* name,
+                              void* buf, int64_t bufbytes, int64_t* shape,
+                              int* ndim, char* dtype_buf,
+                              int dtype_buflen);
+void PD_PredictorDestroy(PD_Predictor* p);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PT_CAPI_H_ */

@@ -2,6 +2,7 @@
 
 from . import callbacks
 from .callbacks import (Callback, EarlyStopping, LRScheduler,
-                        ModelCheckpoint, ProgBarLogger, ReduceLROnPlateau)
+                        ModelCheckpoint, ProgBarLogger,
+                        ReduceLROnPlateau, VisualDL)
 from .flops import flops
 from .model import Model

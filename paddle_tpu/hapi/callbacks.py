@@ -238,3 +238,80 @@ class ReduceLROnPlateau(Callback):
                     pass
                 self.cooldown_counter = self.cooldown
                 self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging to a logdir (reference: hapi/callbacks.py:839
+    VisualDL callback over the visualdl LogWriter). The visualdl package
+    is CUDA-ecosystem tooling; here scalars stream to
+    ``<log_dir>/scalars-<mode>.jsonl`` (one {"tag", "step", "value"}
+    record per line — trivially loadable into pandas/TensorBoard), and
+    ``read_scalars`` loads them back."""
+
+    def __init__(self, log_dir: str = "./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self.epochs = None
+        self._writers = {}
+        self._train_step = 0
+
+    def _writer(self, mode: str):
+        import os
+        w = self._writers.get(mode)
+        if w is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            path = os.path.join(self.log_dir, f"scalars-{mode}.jsonl")
+            # one file per callback instance (a fresh fit() run starts a
+            # fresh log; appending would interleave restarting steps)
+            w = open(path, "w")
+            self._writers[mode] = w
+        return w
+
+    def _log(self, mode: str, step: int, logs) -> None:
+        import json as _json
+        w = self._writer(mode)
+        for tag, value in (logs or {}).items():
+            try:
+                vals = np.asarray(value).ravel()
+                if not len(vals):
+                    continue
+                v = float(vals[0])
+            except (TypeError, ValueError):
+                continue
+            w.write(_json.dumps({"tag": f"{mode}/{tag}", "step": step,
+                                 "value": v}) + "\n")
+        w.flush()
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._train_step += 1
+        self._log("train", self._train_step, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log("train-epoch", epoch, logs)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", self._train_step, logs)
+
+    def on_train_end(self, logs=None):
+        for w in self._writers.values():
+            w.close()
+        self._writers.clear()
+
+    @staticmethod
+    def read_scalars(log_dir: str, mode: str = "train"):
+        """Load logged scalars back: {tag: [(step, value), ...]}."""
+        import json as _json
+        import os
+        out = {}
+        path = os.path.join(log_dir, f"scalars-{mode}.jsonl")
+        if not os.path.exists(path):
+            return out
+        with open(path) as f:
+            for line in f:
+                rec = _json.loads(line)
+                out.setdefault(rec["tag"], []).append(
+                    (rec["step"], rec["value"]))
+        return out

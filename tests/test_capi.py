@@ -28,24 +28,7 @@ _C_PROGRAM = r"""
 #include <string.h>
 #include <stdint.h>
 
-typedef struct PD_Config PD_Config;
-typedef struct PD_Predictor PD_Predictor;
-extern PD_Config* PD_ConfigCreate();
-extern void PD_ConfigSetModel(PD_Config*, const char*);
-extern void PD_ConfigDisableGpu(PD_Config*);
-extern void PD_ConfigDestroy(PD_Config*);
-extern PD_Predictor* PD_PredictorCreate(PD_Config*);
-extern int PD_PredictorGetInputNum(PD_Predictor*);
-extern int PD_PredictorGetInputName(PD_Predictor*, int, char*, int);
-extern int PD_PredictorSetInput(PD_Predictor*, const char*, const void*,
-                                const int64_t*, int, const char*);
-extern int PD_PredictorRun(PD_Predictor*);
-extern int PD_PredictorGetOutputNum(PD_Predictor*);
-extern int PD_PredictorGetOutputName(PD_Predictor*, int, char*, int);
-extern int64_t PD_PredictorGetOutput(PD_Predictor*, const char*, void*,
-                                     int64_t, int64_t*, int*, char*, int);
-extern const char* PD_GetLastError();
-extern void PD_PredictorDestroy(PD_Predictor*);
+#include "pt_capi.h"
 
 int main(int argc, char** argv) {
   PD_Config* cfg = PD_ConfigCreate();
@@ -136,7 +119,9 @@ def test_c_program_matches_python_predictor(saved_model, tmp_path):
     exe = tmp_path / "consumer"
     libdir = sysconfig.get_config_var("LIBDIR")
     subprocess.run(
-        ["gcc", str(csrc), "-o", str(exe), f"-L{os.path.dirname(CAPI_LIB)}",
+        ["gcc", str(csrc), "-o", str(exe),
+         f"-I{os.path.dirname(CAPI_LIB)}",
+         f"-L{os.path.dirname(CAPI_LIB)}",
          "-lpt_infer", f"-Wl,-rpath,{os.path.dirname(CAPI_LIB)}",
          f"-Wl,-rpath,{libdir}"],
         check=True, capture_output=True)
@@ -171,3 +156,31 @@ def test_c_api_error_surface(tmp_path):
     pred = lib.PD_PredictorCreate(cfg)
     assert not pred
     assert lib.PD_GetLastError()
+
+
+def test_go_wrapper_matches_c_abi():
+    """Every C symbol the Go wrapper (go/*.go) calls must exist in
+    pt_capi.h AND pt_capi.cc — the goapi parity contract validated
+    without a Go toolchain (reference: inference/goapi over capi_exp)."""
+    import glob
+    import re
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    header = open(os.path.join(root, "native", "pt_capi.h")).read()
+    impl = open(os.path.join(root, "native", "pt_capi.cc")).read()
+    go_files = glob.glob(os.path.join(root, "go", "*.go"))
+    assert go_files, "go wrapper missing"
+    called = set()
+    for gf in go_files:
+        called |= set(re.findall(r"C\.(PD_[A-Za-z]+)\(", open(gf).read()))
+    assert len(called) >= 12, called
+    missing_h = [c for c in called if c + "(" not in header]
+    missing_cc = [c for c in called if c + "(" not in impl]
+    assert missing_h == [], missing_h
+    assert missing_cc == [], missing_cc
+    # and the header covers the full implemented surface
+    # ("new PD_Config()" constructor calls are type uses, not functions)
+    impl_syms = set(re.findall(r"\b(PD_[A-Za-z]+)\(", impl)) - \
+        {"PD_Config", "PD_Predictor"}
+    undeclared = [s2 for s2 in impl_syms if s2 + "(" not in header]
+    assert undeclared == [], undeclared

@@ -1,0 +1,219 @@
+"""ONNX export tests.
+
+Reference: python/paddle/onnx/export.py:21 converts traced programs.
+This image has no ``onnx`` package, so correctness is proven the hard
+way: the exported bytes are parsed back with a generic protobuf reader
+(paddle_tpu.onnx._proto.parse) and the graph is re-executed with a tiny
+numpy interpreter of the emitted ONNX ops — outputs must match the eager
+model.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.onnx import _proto as P
+from paddle_tpu.onnx import export
+from paddle_tpu.static import InputSpec
+
+ONNX_DT = {P.DT_FLOAT: np.float32, P.DT_INT32: np.int32,
+           P.DT_INT64: np.int64, P.DT_BOOL: np.bool_}
+
+
+def _parse_tensor(data):
+    msg = P.parse(data)
+    dims = [v for _, v in msg.get(1, [])]
+    dt = msg[2][0][1]
+    name = msg[8][0][1].decode()
+    raw = msg[9][0][1]
+    return name, np.frombuffer(raw, ONNX_DT[dt]).reshape(dims)
+
+
+def _parse_attr(data):
+    msg = P.parse(data)
+    name = msg[1][0][1].decode()
+    at = msg[20][0][1]
+    if at == P.AT_FLOAT:
+        return name, msg[2][0][1]
+    if at == P.AT_INT:
+        return name, msg[3][0][1]
+    if at == P.AT_STRING:
+        return name, msg[4][0][1].decode()
+    if at == P.AT_INTS:
+        return name, [v for _, v in msg.get(8, [])]
+    if at == P.AT_FLOATS:
+        return name, [v for _, v in msg.get(7, [])]
+    raise AssertionError(f"attr type {at}")
+
+
+def _parse_model(data):
+    model = P.parse(data)
+    assert model[1][0][1] == 8  # ir_version
+    g = P.parse(model[7][0][1])
+    nodes = []
+    for _, nd in g.get(1, []):
+        n = P.parse(nd)
+        nodes.append({
+            "op": n[4][0][1].decode(),
+            "inputs": [v.decode() for _, v in n.get(1, [])],
+            "outputs": [v.decode() for _, v in n.get(2, [])],
+            "attrs": dict(_parse_attr(a) for _, a in n.get(5, [])),
+        })
+    inits = dict(_parse_tensor(t) for _, t in g.get(5, []))
+    def names(field):
+        return [P.parse(vi)[1][0][1].decode()
+                for _, vi in g.get(field, [])]
+    return nodes, inits, names(11), names(12)
+
+
+def _run_graph(nodes, env):
+    """Tiny numpy interpreter for the op set the exporter emits."""
+    for n in nodes:
+        i = [env[x] for x in n["inputs"]]
+        op, attrs = n["op"], n["attrs"]
+        if op == "MatMul":
+            out = i[0] @ i[1]
+        elif op == "Add":
+            out = i[0] + i[1]
+        elif op == "Sub":
+            out = i[0] - i[1]
+        elif op == "Mul":
+            out = i[0] * i[1]
+        elif op == "Div":
+            out = i[0] / i[1]
+        elif op == "Max":
+            out = np.maximum(i[0], i[1])
+        elif op == "Tanh":
+            out = np.tanh(i[0])
+        elif op == "Sigmoid":
+            out = 1.0 / (1.0 + np.exp(-i[0]))
+        elif op == "Exp":
+            out = np.exp(i[0])
+        elif op == "Erf":
+            from scipy.special import erf as _erf  # pragma: no cover
+            out = _erf(i[0])
+        elif op == "ReduceSum":
+            out = i[0].sum(axis=tuple(i[1].tolist()))
+        elif op == "ReduceMax":  # opset-13 signature: axes attribute
+            out = i[0].max(axis=tuple(attrs["axes"]))
+        elif op == "Reshape":
+            out = i[0].reshape(i[1].tolist())
+        elif op == "Transpose":
+            out = i[0].transpose(attrs["perm"])
+        elif op == "Expand":
+            out = np.broadcast_to(i[0], i[1].tolist())
+        elif op == "Identity":
+            out = i[0]
+        elif op == "Cast":
+            out = i[0].astype(ONNX_DT[attrs["to"]])
+        elif op == "Conv":
+            out = _np_conv(i[0], i[1], i[2] if len(i) > 2 else None,
+                           attrs)
+        else:
+            raise AssertionError(f"interpreter: unexpected op {op}")
+        env[n["outputs"][-1]] = out
+        for extra in n["outputs"][:-1]:
+            env[extra] = out
+    return env
+
+
+def _np_conv(x, w, b, attrs):
+    sh, sw = attrs.get("strides", [1, 1])
+    pads = attrs.get("pads", [0, 0, 0, 0])
+    x = np.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]),
+                   (pads[1], pads[3])))
+    n, cin, h, wdt = x.shape
+    cout, _, kh, kw = w.shape
+    oh = (h - kh) // sh + 1
+    ow = (wdt - kw) // sw + 1
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    for oy in range(oh):
+        for ox in range(ow):
+            patch = x[:, :, oy * sh:oy * sh + kh, ox * sw:ox * sw + kw]
+            out[:, :, oy, ox] = np.einsum("nchw,ochw->no", patch, w)
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return F.softmax(self.fc2(F.relu(self.fc1(x))), axis=-1)
+
+
+def test_export_mlp_matches_eager(tmp_path):
+    import jax.numpy as jnp
+    pt.seed(0)
+    model = MLP()
+    model.eval()
+    path = export(model, str(tmp_path / "mlp"),
+                  input_spec=[InputSpec([2, 8], "float32", "x")])
+    data = open(path, "rb").read()
+    nodes, inits, in_names, out_names = _parse_model(data)
+    assert in_names == ["x"]
+    assert {n["op"] for n in nodes} >= {"MatMul", "Add", "Max"}
+    # weights exported byte-exact
+    w1 = np.asarray(model.fc1.weight.value)
+    assert any(np.array_equal(v, w1) for v in inits.values())
+
+    x = np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32)
+    env = dict(inits)
+    env["x"] = x
+    env = _run_graph(nodes, env)
+    got = env[out_names[0]]
+    ref = np.asarray(model(pt.Tensor(jnp.asarray(x))).value)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_export_conv_matches_eager(tmp_path):
+    import jax.numpy as jnp
+
+    class ConvNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(1, 3, 3, padding=1)
+            self.fc = nn.Linear(3 * 6 * 6, 5)
+
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F
+            h = F.relu(self.conv(x))
+            return self.fc(h.reshape((2, -1)))
+
+    pt.seed(1)
+    model = ConvNet()
+    model.eval()
+    path = export(model, str(tmp_path / "convnet"),
+                  input_spec=[InputSpec([2, 1, 6, 6], "float32", "img")])
+    nodes, inits, in_names, out_names = _parse_model(
+        open(path, "rb").read())
+    assert any(n["op"] == "Conv" for n in nodes)
+
+    x = np.random.default_rng(1).normal(
+        size=(2, 1, 6, 6)).astype(np.float32)
+    env = dict(inits)
+    env["img"] = x
+    env = _run_graph(nodes, env)
+    ref = np.asarray(model(pt.Tensor(jnp.asarray(x))).value)
+    np.testing.assert_allclose(env[out_names[0]], ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_export_unsupported_is_explicit(tmp_path):
+    class Pooled(nn.Layer):
+        def __init__(self):
+            super().__init__()
+
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F
+            return F.max_pool2d(x, 2)
+
+    with pytest.raises(NotImplementedError, match="primitive"):
+        export(Pooled(), str(tmp_path / "pool"),
+               input_spec=[InputSpec([1, 1, 4, 4], "float32")])

@@ -190,3 +190,29 @@ def test_functional_accuracy():
     label = pt.to_tensor(np.array([[1], [1]]))
     a = accuracy(pred, label, k=1)
     assert abs(float(a.numpy()) - 0.5) < 1e-6
+
+
+def test_visualdl_callback_scalars(tmp_path):
+    """VisualDL-style scalar logging (reference: hapi/callbacks.py:839):
+    per-batch train scalars and per-epoch scalars stream to the logdir
+    and load back in order."""
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.hapi import Model, VisualDL
+
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model = Model(net)
+    model.prepare(
+        optim.SGD(learning_rate=0.1, parameters=net.parameters()),
+        nn.CrossEntropyLoss())
+    x = np.random.default_rng(0).normal(size=(32, 4)).astype("float32")
+    y = np.random.default_rng(1).integers(0, 2, 32).astype("int64")
+    logdir = str(tmp_path / "vdl")
+    model.fit(list(zip(x.reshape(8, 4, 4), y.reshape(8, 4))), epochs=2,
+              callbacks=[VisualDL(log_dir=logdir)], verbose=0)
+    scalars = VisualDL.read_scalars(logdir, "train")
+    assert "train/loss" in scalars
+    steps = [s for s, _ in scalars["train/loss"]]
+    assert len(steps) == 16 and steps == sorted(steps)
+    assert "train-epoch/loss" in VisualDL.read_scalars(logdir,
+                                                       "train-epoch")
