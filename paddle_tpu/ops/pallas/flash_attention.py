@@ -29,8 +29,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 # 512-blocks measured fastest on TPU v5e (grad 4.2 ms vs 8.0 ms at 128
 # for B8 H12 S1024 D64); auto-clamped to the sequence length.
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# PT_FLASH_BLOCK_Q/K override for shape-specific tuning (the analog of
+# the reference's per-kernel-key JIT selection, operators/jit/README).
+import os as _os
+
+DEFAULT_BLOCK_Q = int(_os.environ.get("PT_FLASH_BLOCK_Q", 512))
+DEFAULT_BLOCK_K = int(_os.environ.get("PT_FLASH_BLOCK_K", 512))
 _NEG_INF = -1e30
 
 # batch/head grid axes have no cross-iteration state -> Mosaic may run
