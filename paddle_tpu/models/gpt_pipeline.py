@@ -43,8 +43,12 @@ def _split_block_params(params: Dict[str, jax.Array], num_layers: int
                              for k in params if k.startswith("gpt.h.")})
     stacked = {}
     for suffix in block_suffixes:
-        stacked[suffix] = jnp.stack(
-            [params[f"gpt.h.{i}.{suffix}"] for i in range(num_layers)])
+        leaves = [params[f"gpt.h.{i}.{suffix}"] for i in range(num_layers)]
+        if isinstance(leaves[0], jax.ShapeDtypeStruct):  # abstract mode
+            stacked[suffix] = jax.ShapeDtypeStruct(
+                (num_layers,) + tuple(leaves[0].shape), leaves[0].dtype)
+        else:
+            stacked[suffix] = jnp.stack(leaves)
     shared = {k: v for k, v in params.items() if not k.startswith("gpt.h.")}
     return stacked, shared
 
@@ -83,16 +87,23 @@ class GPTPipelineTrainStep:
     def __init__(self, config: GPTConfig, optimizer, pp: int, dp: int = 1,
                  n_micro: int = 2, devices=None, remat: bool = False,
                  seed: int = 0, schedule: str = "fthenb", hcg=None,
-                 zero_axis: Optional[str] = None):
+                 zero_axis: Optional[str] = None, abstract: bool = False):
         assert config.num_layers % pp == 0, "layers must divide pp"
         assert config.dropout == 0.0 and config.attn_dropout == 0.0, \
             "pipeline step requires dropout=0 (rng is not plumbed per-stage)"
         self.config = config
         self.optimizer = optimizer
         self.n_micro = n_micro
+        self.abstract = abstract
+        import contextlib
         import paddle_tpu as pt
+        from ..nn.initializer import abstract_init
         pt.seed(seed)
-        self.model = GPTForCausalLM(config)
+        # abstract: params are ShapeDtypeStructs (nothing materializes) so
+        # multi-billion-param configs can be AOT-lowered against a target
+        # topology (tools/scale_proof.py) without host/device memory.
+        with (abstract_init() if abstract else contextlib.nullcontext()):
+            self.model = GPTForCausalLM(config)
         self.model.eval()  # dropout off; training math identical
         self.hybrid = hcg is not None
         if self.hybrid:
@@ -107,6 +118,14 @@ class GPTPipelineTrainStep:
         state = functional_state(self.model)
         stacked, shared = _split_block_params(state["params"],
                                               config.num_layers)
+
+        def _place(v, spec):
+            sh = NamedSharding(self.mesh, spec)
+            if self.abstract:
+                return jax.ShapeDtypeStruct(tuple(v.shape), v.dtype,
+                                            sharding=sh)
+            return jax.device_put(v, sh)
+        self._place = _place
         if self.hybrid:
             pspecs = _param_pspecs(self.model)
             # every layer's suffix carries the same TP spec; index layer 0
@@ -119,26 +138,24 @@ class GPTPipelineTrainStep:
             # trips XLA's SPMD partitioner, so mp shards block matmuls
             # only.
             shared_specs = {n: P() for n in shared}
-            self.stacked = {
-                suf: jax.device_put(
-                    v, NamedSharding(self.mesh, stacked_specs[suf]))
-                for suf, v in stacked.items()}
-            self.shared = {
-                n: jax.device_put(
-                    v, NamedSharding(self.mesh, shared_specs[n]))
-                for n, v in shared.items()}
+            self.stacked = {suf: _place(v, stacked_specs[suf])
+                            for suf, v in stacked.items()}
+            self.shared = {n: _place(v, shared_specs[n])
+                           for n, v in shared.items()}
             self._data_axes = tuple(
                 ax for ax in ("dp", "sharding")
                 if self.mesh.shape.get(ax, 1) > 1)
         else:
-            self.stacked = jax.device_put(
-                stacked, NamedSharding(self.mesh, P("pp")))
-            self.shared = jax.device_put(
-                shared, NamedSharding(self.mesh, P()))
+            self.stacked = {suf: _place(v, P("pp"))
+                            for suf, v in stacked.items()}
+            self.shared = {n: _place(v, P()) for n, v in shared.items()}
             self._data_axes = ("dp",)
         params = {"stacked": self.stacked, "shared": self.shared}
         # slots inherit their param's sharding (stacked slots ride pp)
-        self.opt_state = optimizer.init(params)
+        if self.abstract:
+            self.opt_state = self._abstract_opt_init(params)
+        else:
+            self.opt_state = optimizer.init(params)
         if self.hybrid and zero_axis and \
                 self.mesh.shape.get(zero_axis, 1) > 1:
             self._zero_shard_slots(zero_axis)
@@ -147,6 +164,29 @@ class GPTPipelineTrainStep:
         self.schedule = schedule
         self._step = (self._build(remat) if schedule == "fthenb"
                       else self._build_1f1b(remat))
+
+    def _abstract_opt_init(self, params):
+        """optimizer.init without materializing: eval_shape the slot tree,
+        then give every slot its param's sharding (shape-matched leaves)
+        or replication (scalars/step counters) — the same placements the
+        concrete Optimizer.init assigns via place_like."""
+        opt_shapes = jax.eval_shape(self.optimizer.init, params)
+        flat_p, pdef = jax.tree_util.tree_flatten(params)
+        flat_slots = pdef.flatten_up_to(opt_shapes["slots"])
+
+        def attach(p, slot_tree):
+            def leaf(s):
+                sh = (p.sharding if tuple(s.shape) == tuple(p.shape)
+                      else NamedSharding(self.mesh, P()))
+                return jax.ShapeDtypeStruct(tuple(s.shape), s.dtype,
+                                            sharding=sh)
+            return jax.tree_util.tree_map(leaf, slot_tree)
+
+        slots = jax.tree_util.tree_unflatten(
+            pdef, [attach(p, s) for p, s in zip(flat_p, flat_slots)])
+        step = jax.ShapeDtypeStruct((), jnp.int32,
+                                    sharding=NamedSharding(self.mesh, P()))
+        return {"slots": slots, "step": step}
 
     def _zero_shard_slots(self, axis: str) -> None:
         """ZeRO-1: moment slots of the stacked block params shard over
@@ -159,7 +199,8 @@ class GPTPipelineTrainStep:
         deg = self.mesh.shape[axis]
 
         def reshard(slot):
-            if not isinstance(slot, jax.Array) or slot.ndim == 0:
+            if not isinstance(slot, (jax.Array, jax.ShapeDtypeStruct)) \
+                    or slot.ndim == 0:
                 return slot
             spec = list(getattr(slot.sharding, "spec", P()) or [])
             spec += [None] * (slot.ndim - len(spec))
@@ -167,8 +208,7 @@ class GPTPipelineTrainStep:
                 if spec[d] is None and slot.shape[d] % deg == 0 \
                         and slot.shape[d] >= deg:
                     spec[d] = axis
-                    return jax.device_put(
-                        slot, NamedSharding(self.mesh, P(*spec)))
+                    return self._place(slot, P(*spec))
             return slot
 
         self.opt_state["slots"]["stacked"] = jax.tree_util.tree_map(
@@ -193,6 +233,12 @@ class GPTPipelineTrainStep:
         with bind_state(model, {"params": shared, "buffers": {}}), \
                 no_grad():
             h = model.gpt.ln_f(Tensor(hidden))
+            if model.config.loss_chunk_size:
+                # chunked CE: the [mb, S, vocab] logits never materialize
+                # (same path as GPTForCausalLM.forward)
+                loss = model._chunked_lm_loss(
+                    h, Tensor(labels), model.config.loss_chunk_size)
+                return loss.value if isinstance(loss, Tensor) else loss
             logits = model.logits(h)
             import paddle_tpu.dispatch as dispatch
             F = dispatch.wrapped_ops
@@ -360,17 +406,39 @@ class GPTPipelineTrainStep:
 
         return jax.jit(step_impl, donate_argnums=(0, 1))
 
+    def _batch_pspec(self) -> P:
+        """PartitionSpec for the [batch, seq] token arrays (one source of
+        truth for __call__ and lower())."""
+        if self.hybrid and self._data_axes:
+            return P(self._data_axes if len(self._data_axes) > 1
+                     else self._data_axes[0])
+        if not self.hybrid:
+            return P("dp")
+        return P()
+
+    def lower(self, batch_size: int, seq_len: int):
+        """AOT-lower one train step with abstract arguments (usable in
+        both modes; the point of abstract=True). Returns the jax Lowered —
+        .compile() against the mesh's (possibly compile-only) topology
+        yields per-device memory analysis without running anything."""
+        ids = jax.ShapeDtypeStruct(
+            (batch_size, seq_len), jnp.int32,
+            sharding=NamedSharding(self.mesh, self._batch_pspec()))
+        lr = jax.ShapeDtypeStruct(
+            (), jnp.float32, sharding=NamedSharding(self.mesh, P()))
+        params = {"stacked": self.stacked, "shared": self.shared}
+        return self._step.lower(params, self.opt_state, lr, ids, ids)
+
     def __call__(self, ids, labels) -> jax.Array:
+        assert not self.abstract, \
+            "abstract=True builds a compile-only step: use lower()"
         params = {"stacked": self.stacked, "shared": self.shared}
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         ids, labels = jnp.asarray(ids), jnp.asarray(labels)
         if self.hybrid and self._data_axes:
             # batch dim over dp×sharding (the pp split is handled by the
             # manual shard_map in_specs)
-            bspec = NamedSharding(
-                self.mesh,
-                P(self._data_axes if len(self._data_axes) > 1
-                  else self._data_axes[0]))
+            bspec = NamedSharding(self.mesh, self._batch_pspec())
             ids = jax.device_put(ids, bspec)
             labels = jax.device_put(labels, bspec)
         params, self.opt_state, loss = self._step(

@@ -258,11 +258,47 @@ def global_initializer(is_bias: bool):
     return _global_initializer["bias" if is_bias else "weight"]
 
 
+_abstract_init = {"on": False}
+
+
+class _AbstractInit(Initializer):
+    """Shape-only initializer: returns a jax.ShapeDtypeStruct instead of
+    allocating a buffer. Used by abstract_init() so billion-parameter
+    models can be built for AOT lowering / memory analysis without ever
+    materializing weights (the TPU analog of building a ProgramDesc
+    without running startup_program — reference: fluid/framework.py's
+    separate startup/main programs)."""
+
+    def __call__(self, shape, dtype):
+        return jax.ShapeDtypeStruct(tuple(int(d) for d in shape),
+                                    jnp.dtype(dtype))
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def abstract_init():
+    """Within this context every parameter a Layer creates is a
+    ShapeDtypeStruct (no device/host memory). The resulting model can't
+    run eagerly, but functional_state() yields an abstract pytree that
+    jax.jit(...).lower() accepts for AOT compilation against any
+    topology."""
+    prev = _abstract_init["on"]
+    _abstract_init["on"] = True
+    try:
+        yield
+    finally:
+        _abstract_init["on"] = prev
+
+
 def resolve_initializer(init, attr=None, is_bias: bool = False):
     """One resolution chain for parameter initializers, shared by
     Layer.create_parameter and the free paddle.create_parameter:
     explicit attr.initializer > explicit init > global override >
     built-in default (xavier_uniform / zeros)."""
+    if _abstract_init["on"]:
+        return _AbstractInit()
     if attr is not None and getattr(attr, "initializer", None) is not None:
         init = attr.initializer
     if init is None:

@@ -137,9 +137,24 @@ class Optimizer:
     # -- functional path (jit/pjit) -------------------------------------------
 
     def init(self, params: Dict[str, jax.Array]) -> Dict[str, Any]:
-        """Build the optimizer-state pytree for a params pytree."""
+        """Build the optimizer-state pytree for a params pytree. Slot
+        leaves whose shape matches their param inherit the param's
+        NamedSharding (moments must shard like the weight — pp/mp-sharded
+        params with replicated moments would hold the FULL moment tree on
+        every device; reference: sharding_optimizer.py shards slots with
+        their params)."""
         flat, treedef = jax.tree_util.tree_flatten(params)
-        states = [self._init_state(v) for v in flat]
+
+        def place_like(p, state_tree):
+            sh = getattr(p, "sharding", None)
+            if not isinstance(sh, jax.sharding.NamedSharding):
+                return state_tree
+            return jax.tree_util.tree_map(
+                lambda s: jax.device_put(s, sh)
+                if hasattr(s, "shape") and tuple(s.shape) == tuple(p.shape)
+                else s, state_tree)
+
+        states = [place_like(v, self._init_state(v)) for v in flat]
         return {"slots": jax.tree_util.tree_unflatten(treedef, states),
                 "step": jnp.zeros((), jnp.int32)}
 

@@ -312,7 +312,8 @@ def test_incubate_and_onnx():
                              num_segments=2)
     np.testing.assert_allclose(np.asarray(s), [[2, 2], [2, 2]])
     import paddle_tpu.onnx as onnx
-    with pytest.raises(ImportError):
+    from paddle_tpu.core.enforce import InvalidArgumentError
+    with pytest.raises(InvalidArgumentError):
         onnx.export(None, "/tmp/x")
 
 

@@ -1,0 +1,82 @@
+"""North-star scale proof (BASELINE.json config 4): the ERNIE-10B-class
+hybrid config (mp x pp x sharding) AOT-compiles for a TPU v4-64 topology
+and fits per-device HBM — evidence for the v4-64 target without a pod.
+
+Reference machinery being matched: fleet's sharding_optimizer.py:87
+(mp x pp x sharding placement decisions); here the XLA:TPU compile-only
+topology proves memory fit ahead of time.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def _tpu_plugin_available():
+    try:
+        from jax.experimental import topologies
+        topologies.get_topology_desc(platform="tpu",
+                                     topology_name="v4:2x2x1")
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _tpu_plugin_available(),
+                    reason="libtpu compile-only plugin unavailable")
+def test_10b_v4_64_aot_fits():
+    from scale_proof import run_proof
+
+    report = run_proof()
+    assert report["n_devices"] == 64
+    assert report["model"]["params_b"] > 9.0  # 10B-class
+    assert report["fits"], report["per_device_gib"]
+    # the compile is real: nonzero generated code and temps
+    assert report["per_device_bytes"]["generated_code"] > 1_000_000
+    assert report["per_device_bytes"]["temps"] > 1 << 30
+
+    # the committed artifact must agree with what this run proved
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "SCALE_PROOF.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            committed = json.load(f)
+        assert committed["fits"]
+        assert committed["degrees"] == report["degrees"]
+        # byte counts can drift across XLA versions; same ballpark
+        assert np.isclose(
+            committed["per_device_bytes"]["temps"],
+            report["per_device_bytes"]["temps"], rtol=0.25)
+
+
+def test_abstract_pipeline_lower_tiny():
+    """The abstract=True path itself (no materialization) on the virtual
+    CPU mesh: lower a tiny hybrid config and check input placements."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.distributed.topology import HybridCommunicateGroup
+    from paddle_tpu.models import gpt_tiny
+    from paddle_tpu.models.gpt_pipeline import GPTPipelineTrainStep
+
+    hcg = HybridCommunicateGroup(mp_degree=2, pp_degree=2,
+                                 sharding_degree=2,
+                                 devices=jax.devices()[:8])
+    cfg = gpt_tiny()
+    step = GPTPipelineTrainStep(
+        cfg, optim.AdamW(learning_rate=1e-4), pp=2, n_micro=2, hcg=hcg,
+        zero_axis="sharding", schedule="1f1b", abstract=True)
+    # nothing materialized
+    assert all(isinstance(v, jax.ShapeDtypeStruct)
+               for v in step.stacked.values())
+    lowered = step.lower(8, 64)
+    compiled = lowered.compile()
+    mem = compiled.memory_analysis()
+    assert int(mem.temp_size_in_bytes) > 0
