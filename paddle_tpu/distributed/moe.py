@@ -4,9 +4,17 @@ BEYOND-REFERENCE capability (SURVEY §2.3: the reference snapshot has only
 the raw alltoall building block, operators/collective/alltoall_op.cc, and
 no MoE). TPU-native design: experts carry a leading expert dim sharded
 over a mesh axis (default: the "sharding" axis doubles as the expert axis,
-the common ep=dp layout); token dispatch uses dense one-hot combine
-einsums, which GSPMD partitions into the same alltoall exchanges a manual
-implementation would issue — and fuses them with the expert matmuls.
+the common ep=dp layout).
+
+Dispatch is capacity-based (GShard/Switch): each expert processes at most
+C = ceil(top_k * T / E * capacity_factor) tokens, so expert FLOPs are
+O(k * T * capacity_factor) — independent of E — with overflow tokens
+dropped (their output is the residual path only). The [E, C, H] expert
+batch shards over the ep axis; GSPMD turns the scatter/gather dispatch
+into the alltoall exchanges a manual implementation would issue. The
+dense one-hot formulation (every expert runs every token, unrouted rows
+zeroed) is kept as ``dispatch_mode="dense"`` — it is the parity oracle
+for the capacity path and occasionally wins at tiny E*T.
 """
 
 from __future__ import annotations
@@ -15,6 +23,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from .. import dispatch
@@ -25,53 +34,132 @@ from ..tensor import Tensor
 F = dispatch.wrapped_ops
 
 
-def _moe_ffn(x, gate_w, w_in, b_in, w_out, b_out, num_experts, top_k,
-             capacity_factor, activation):
-    """Pure kernel: x [B, S, H] -> [B, S, H].
-
-    Dense dispatch (no token dropping): combine weights are zero for
-    unrouted experts, so capacity is implicit. gate_w: [H, E];
-    w_in: [E, H, F]; w_out: [E, F, H].
-    """
-    b, s, h = x.shape
-    tokens = x.reshape(b * s, h)
+def _route(tokens, gate_w, num_experts, top_k):
+    """Shared router: top-k gates renormalized, plus the Switch-style
+    load-balance aux loss inputs."""
     logits = tokens @ gate_w  # [T, E]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     top_vals, top_idx = jax.lax.top_k(probs, top_k)  # [T, k]
-    # renormalize the top-k gates
     top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
     combine = jnp.zeros((tokens.shape[0], num_experts), jnp.float32)
     combine = jnp.put_along_axis(combine, top_idx, top_vals, axis=-1,
                                  inplace=False)  # [T, E]
-    # expert compute: dispatch via einsum (GSPMD -> alltoall over ep axis)
-    xe = jnp.einsum("te,th->eth", combine.astype(x.dtype), tokens)
+    me = jnp.mean(combine, axis=0)  # fraction routed per expert
+    ce = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(me * ce)
+    return top_vals, top_idx, combine, aux.astype(jnp.float32)
+
+
+def _expert_ffn(xe, w_in, b_in, w_out, b_out, activation):
+    """[E, C, H] -> [E, C, H] batched expert FFN (rides the MXU as E
+    batched matmuls; sharded over ep by the params' pspecs)."""
     hmid = jnp.einsum("eth,ehf->etf", xe, w_in) + b_in[:, None, :]
     act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
            "silu": jax.nn.silu}[activation]
     hmid = act(hmid)
-    out_e = jnp.einsum("etf,efh->eth", hmid, w_out) + b_out[:, None, :]
-    out = jnp.einsum("eth->th", out_e)
-    # aux load-balancing loss (Switch-style)
-    me = jnp.mean(combine, axis=0)  # fraction routed per expert
-    ce = jnp.mean(probs, axis=0)
-    aux = num_experts * jnp.sum(me * ce)
-    return out.reshape(b, s, h).astype(x.dtype), aux.astype(jnp.float32)
+    return jnp.einsum("etf,efh->eth", hmid, w_out) + b_out[:, None, :]
+
+
+def moe_capacity(num_tokens: int, num_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    """Per-expert token capacity C (multiple of 8 for TPU lane tiling)."""
+    c = int(np.ceil(top_k * num_tokens * capacity_factor / num_experts))
+    c = max(c, top_k)
+    return min(-(-c // 8) * 8, num_tokens)
+
+
+def _moe_ffn(x, gate_w, w_in, b_in, w_out, b_out, num_experts, top_k,
+             capacity_factor, activation, expert_axis=None):
+    """Pure kernel, capacity dispatch: x [B, S, H] -> [B, S, H].
+
+    GShard-style: token t's j-th choice goes to expert e at the slot
+    given by a running per-expert count (choice-major priority: all
+    first choices beat all second choices); slots >= C overflow and are
+    dropped (output falls back to the residual path). Expert compute is
+    [E, C, H] — O(k*T*capacity_factor) FLOPs total, independent of E.
+    gate_w: [H, E]; w_in: [E, H, F]; w_out: [E, F, H].
+    """
+    b, s, h = x.shape
+    tokens = x.reshape(b * s, h)
+    t = tokens.shape[0]
+    cap = moe_capacity(t, num_experts, top_k, capacity_factor)
+
+    top_vals, top_idx, _, aux = _route(tokens, gate_w, num_experts, top_k)
+
+    # choice-major flattening: [k*T] with all 1st choices first
+    flat_e = top_idx.T.reshape(-1)
+    flat_t = jnp.tile(jnp.arange(t), top_k)
+    flat_g = top_vals.T.reshape(-1)
+    # position of each (token, choice) within its expert's batch
+    onehot = jax.nn.one_hot(flat_e, num_experts, dtype=jnp.int32)
+    pos = jnp.take_along_axis(jnp.cumsum(onehot, axis=0) - 1,
+                              flat_e[:, None], axis=1)[:, 0]  # [kT]
+    keep = pos < cap
+    safe_pos = jnp.where(keep, pos, cap - 1)
+
+    # scatter tokens into the [E, C, H] expert batch (kept slots are
+    # unique, so scatter-add == scatter; dropped rows add zero)
+    xe = jnp.zeros((num_experts, cap, h), x.dtype)
+    contrib = tokens[flat_t] * keep[:, None].astype(x.dtype)
+    xe = xe.at[flat_e, safe_pos].add(contrib)
+    if expert_axis is not None:
+        # pin the expert batch to the ep axis so the scatter lowers to
+        # the alltoall exchange instead of a replicated gather
+        from .mp_layers import _constrain
+        xe = _constrain(xe, expert_axis)
+
+    ye = _expert_ffn(xe, w_in, b_in, w_out, b_out, activation)
+    if expert_axis is not None:
+        from .mp_layers import _constrain
+        ye = _constrain(ye, expert_axis)
+
+    # gather each choice's output back and combine with its gate
+    yg = ye[flat_e, safe_pos]  # [kT, H]
+    wgt = (flat_g * keep.astype(jnp.float32)).astype(x.dtype)
+    out = jnp.zeros((t, h), x.dtype).at[flat_t].add(yg * wgt[:, None])
+    return out.reshape(b, s, h).astype(x.dtype), aux
+
+
+def _moe_ffn_dense(x, gate_w, w_in, b_in, w_out, b_out, num_experts,
+                   top_k, activation):
+    """Dense dispatch (no token dropping, O(E*T) expert FLOPs): combine
+    weights are zero for unrouted experts. The parity oracle for
+    _moe_ffn."""
+    b, s, h = x.shape
+    tokens = x.reshape(b * s, h)
+    _, _, combine, aux = _route(tokens, gate_w, num_experts, top_k)
+    # routed mask in, gate out: out[t] = sum_e g_te * FFN_e(x_t). (Gating
+    # the INPUT would feed the nonlinear FFN g*x, and summing unmasked
+    # outputs would leak every expert's bias-propagated FFN_e(0) into
+    # every token once biases train away from zero.)
+    mask = (combine > 0).astype(x.dtype)
+    xe = jnp.einsum("te,th->eth", mask, tokens)
+    out_e = _expert_ffn(xe, w_in, b_in, w_out, b_out, activation)
+    out = jnp.einsum("te,eth->th", combine.astype(x.dtype), out_e)
+    return out.reshape(b, s, h).astype(x.dtype), aux
 
 
 class MoELayer(Layer):
-    """Switch/top-k MoE FFN (expert-parallel over ``expert_axis``)."""
+    """Switch/top-k MoE FFN (expert-parallel over ``expert_axis``).
+
+    ``dispatch_mode``: "capacity" (default — GShard scatter/gather with
+    per-expert capacity, O(k*T) expert FLOPs, overflow drops) or "dense"
+    (one-hot einsum oracle, O(E*T) FLOPs, no drops)."""
 
     def __init__(self, hidden_size: int, ffn_hidden_size: int,
                  num_experts: int, top_k: int = 2,
                  capacity_factor: float = 1.25, activation: str = "gelu",
                  expert_axis: str = "sharding", aux_loss_weight: float =
-                 0.01):
+                 0.01, dispatch_mode: str = "capacity"):
         super().__init__()
+        assert dispatch_mode in ("capacity", "dense"), dispatch_mode
         self.num_experts = num_experts
         self.top_k = top_k
         self.capacity_factor = capacity_factor
         self.activation = activation
         self.aux_loss_weight = aux_loss_weight
+        self.dispatch_mode = dispatch_mode
+        self.expert_axis = expert_axis
         self.last_aux_loss = None
         init = Normal(std=0.02)
         self.gate_weight = self.create_parameter(
@@ -93,11 +181,19 @@ class MoELayer(Layer):
         self.b_out.pspec = P(expert_axis, None)
 
     def forward(self, x):
+        if self.dispatch_mode == "dense":
+            def kernel(xv, gw, wi, bi, wo, bo):
+                return _moe_ffn_dense(
+                    xv, gw, wi, bi, wo, bo, self.num_experts,
+                    self.top_k, self.activation)
+        else:
+            def kernel(xv, gw, wi, bi, wo, bo):
+                return _moe_ffn(
+                    xv, gw, wi, bi, wo, bo, self.num_experts,
+                    self.top_k, self.capacity_factor, self.activation,
+                    self.expert_axis)
         out, aux = dispatch.call_fn(
-            lambda xv, gw, wi, bi, wo, bo: _moe_ffn(
-                xv, gw, wi, bi, wo, bo, self.num_experts, self.top_k,
-                self.capacity_factor, self.activation),
-            "moe_ffn", True,
+            kernel, "moe_ffn", True,
             (x, self.gate_weight, self.w_in, self.b_in, self.w_out,
              self.b_out), {})
         self.last_aux_loss = aux

@@ -158,30 +158,49 @@ def sequence_parallel_attention(q, k, v, mode: str = "ring",
     dims = dict(hcg.mesh.shape) if hcg is not None else {}
     sep = dims.get(axis_name, 1)
     if hcg is not None and in_trace and sep > 1:
-        for bad in ("mp", "pp"):
-            if dims.get(bad, 1) > 1:
-                raise NotImplementedError(
-                    "model-level sequence parallelism composes with dp/"
-                    f"sharding but not {bad} (use the op-level "
-                    "ring/ulysses_attention inside your own shard_map)")
+        mp = dims.get("mp", 1)
         if q.shape[1] % sep:
             raise ValueError(
                 f"sequence length {q.shape[1]} must divide the sep "
                 f"degree {sep} for seq_parallel_mode")
-        if mode == "ulysses" and q.shape[2] % sep:
+        if mp > 1 and q.shape[2] % mp:
             raise ValueError(
-                f"ulysses redistributes heads over sep: num_heads "
-                f"{q.shape[2]} must divide the sep degree {sep}")
+                f"num_heads {q.shape[2]} must divide the mp degree {mp}")
+        local_heads = q.shape[2] // mp
+        if mode == "ulysses" and local_heads % sep:
+            raise ValueError(
+                "ulysses redistributes heads over sep: per-mp-shard "
+                f"heads {local_heads} must divide the sep degree {sep}")
         from jax import shard_map
-        batch_axes = tuple(a for a in ("dp", "sharding")
-                           if dims.get(a, 1) > 1) or None
-        spec = P(batch_axes, axis_name)
+        head_axis = "mp" if mp > 1 else None
 
         def sharded(qq, kk, vv):
+            # ring rotates K/V over sep; heads are a pure batch dim, so
+            # an mp head-shard composes for free. Ulysses exchanges its
+            # (mp-local) head shard against the sequence shard.
             if mode == "ring":
                 return ring_attention(qq, kk, vv, axis_name, causal)
             return ulysses_attention(qq, kk, vv, axis_name, causal)
 
+        try:
+            manual = set(jax.sharding.get_abstract_mesh().manual_axes)
+        except Exception:
+            manual = set()
+        if manual:
+            # already inside a manual region (the pipeline's shard_map
+            # over "pp"): nest a partial-manual shard_map over sep (+mp)
+            # on the CONTEXT abstract mesh (pp stays manual outside),
+            # leaving dp/sharding to GSPMD inside the stage
+            names = {axis_name} | ({"mp"} if mp > 1 else set())
+            spec = P(None, axis_name, head_axis)
+            return shard_map(sharded,
+                             mesh=jax.sharding.get_abstract_mesh(),
+                             in_specs=spec, out_specs=spec,
+                             check_vma=False,
+                             axis_names=frozenset(names))(q, k, v)
+        batch_axes = tuple(a for a in ("dp", "sharding")
+                           if dims.get(a, 1) > 1) or None
+        spec = P(batch_axes, axis_name, head_axis)
         return shard_map(sharded, mesh=hcg.mesh, in_specs=spec,
                          out_specs=spec, check_vma=False)(q, k, v)
 

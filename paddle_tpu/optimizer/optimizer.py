@@ -118,6 +118,11 @@ class Optimizer:
     # Elementwise _update rule => safe to run on one fused flat
     # buffer. Optimizers with per-tensor norms (LAMB/LARS) opt out.
     _elementwise_update = True
+    # _init_state has Python side effects (e.g. Dpsgd's per-param noise-id
+    # counter) => init() must call it exactly once per param, eagerly —
+    # never under eval_shape/jit where it would trace twice or fold the
+    # state into a cached constant.
+    _stateful_slot_init = False
 
     def clear_grad(self) -> None:
         if self._parameter_list:
@@ -144,17 +149,34 @@ class Optimizer:
         every device; reference: sharding_optimizer.py shards slots with
         their params)."""
         flat, treedef = jax.tree_util.tree_flatten(params)
+        jit_cache = {}
 
-        def place_like(p, state_tree):
+        def init_placed(p):
             sh = getattr(p, "sharding", None)
-            if not isinstance(sh, jax.sharding.NamedSharding):
-                return state_tree
-            return jax.tree_util.tree_map(
-                lambda s: jax.device_put(s, sh)
-                if hasattr(s, "shape") and tuple(s.shape) == tuple(p.shape)
-                else s, state_tree)
+            if self._stateful_slot_init or \
+                    not isinstance(sh, jax.sharding.NamedSharding):
+                return self._init_state(p)
+            # allocate each slot directly with its target sharding (a
+            # zeros-then-reshard would transiently materialize the FULL
+            # slot on one device — OOM for models that only fit sharded).
+            # The jitted init is cached per (shape, dtype, sharding):
+            # _init_state must be pure here (stateful optimizers set
+            # _stateful_slot_init and take the eager path above).
+            from jax.sharding import PartitionSpec
+            shapes = jax.eval_shape(self._init_state, p)
+            if not jax.tree_util.tree_leaves(shapes):
+                return self._init_state(p)
+            repl = jax.sharding.NamedSharding(sh.mesh, PartitionSpec())
+            out_sh = jax.tree_util.tree_map(
+                lambda s: sh if tuple(s.shape) == tuple(p.shape) else repl,
+                shapes)
+            key = (tuple(p.shape), str(p.dtype), sh)
+            if key not in jit_cache:
+                jit_cache[key] = jax.jit(self._init_state,
+                                         out_shardings=out_sh)
+            return jit_cache[key](p)
 
-        states = [place_like(v, self._init_state(v)) for v in flat]
+        states = [init_placed(v) for v in flat]
         return {"slots": jax.tree_util.tree_unflatten(treedef, states),
                 "step": jnp.zeros((), jnp.int32)}
 
@@ -566,6 +588,8 @@ class Dpsgd(Optimizer):
         self._clip, self._batch, self._sigma = clip, batch_size, sigma
         self._seed = seed
         self._next_noise_id = 0
+
+    _stateful_slot_init = True  # the noise-id counter below
 
     def _init_state(self, value):
         # a unique per-parameter id (assigned at slot-init order) folds

@@ -30,6 +30,10 @@ def _tpu_plugin_available():
 @pytest.mark.skipif(not _tpu_plugin_available(),
                     reason="libtpu compile-only plugin unavailable")
 def test_10b_v4_64_aot_fits():
+    # Deliberately in the FAST lane despite the ~50 s XLA:TPU compile:
+    # the r2 verdict requires the fast lane itself to prove the 10B
+    # north-star config compiles for v4-64 every run (it skips on hosts
+    # without the libtpu compile-only plugin).
     from scale_proof import run_proof
 
     report = run_proof()

@@ -102,7 +102,7 @@ def run_proof(topology_name: str = "v4:2x4x4", mp: int = 8, pp: int = 4,
             for suf, v in sorted(step.stacked.items())},
         "shared": {n: str(v.sharding.spec)
                    for n, v in sorted(step.shared.items())},
-        "batch": "P('sharding')",
+        "batch": str(step._batch_pspec()),
         "zero_slots": "stacked moment slots +sharding axis "
                       "(first divisible free dim)",
     }
