@@ -89,11 +89,8 @@ def main() -> None:
     pt.seed(0)
     model = GPTForCausalLM(cfg)
     if on_tpu:
-        model.to(dtype="bfloat16")
-        # keep layernorm params fp32 for stability
-        for name, p in model.named_parameters():
-            if "ln_" in name or "norm" in name:
-                p.value = p.value.astype(jnp.float32)
+        from bench_all import _to_bf16_except_norms
+        _to_bf16_except_norms(model)
 
     # bf16 Adam slots: multi_precision f32 moments would not leave room
     # for 1.3B params + activations in 16G HBM

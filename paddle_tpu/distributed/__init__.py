@@ -21,4 +21,5 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        get_hybrid_communicate_group, make_mesh)
 from . import fleet, mp_layers, pp, sp
 from .fleet_util import UtilBase, fleet_util
+from .heter import DenseHostTable, HostEmbedding
 from .localsgd import LocalSGDTrainStep

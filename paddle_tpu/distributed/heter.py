@@ -1,0 +1,198 @@
+"""Heter-lite: host-resident embedding tables feeding a jitted TPU step.
+
+The useful kernel of the reference's heter-PS/BoxPS stack
+(service/heter_client.cc:1, framework/fleet/heter_ps/hashtable.h:1):
+an embedding table too large for accelerator HBM lives in host memory
+(or a PS), the dense math runs on-device, and only the looked-up rows
+cross the host<->device boundary each step.
+
+TPU-native wiring: the jitted train step pulls rows with
+``jax.pure_callback`` (a custom_vjp forward) and pushes gradient rows
+back with ``jax.experimental.io_callback`` (the backward): the table
+never appears among the program's device buffers, so HBM holds O(batch)
+rows instead of O(vocab). The host side applies the sparse optimizer
+row-wise (SGD exactly matches a dense on-device SGD step, duplicates
+included; adagrad matches the PS server's per-row rule). ``prefetch()``
+warms a host cache on a background thread so the pull callback overlaps
+the previous step's device compute (the heter-PS pipeline pattern);
+pushes PATCH overlapping cached rows, so prefetched rows are never
+stale relative to completed pushes.
+
+Consistency model: the gradient push is an asynchronous effect —
+fetching the step's loss does NOT await it, so a back-to-back next step
+may pull rows from before the previous push lands (one-step bounded
+staleness: exactly the reference's async-PS/geo training semantics,
+communicator.cc AsyncCommunicator). For strict read-after-write — e.g.
+loss-parity testing against an in-HBM baseline — call
+``jax.effects_barrier()`` between steps.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+
+
+class DenseHostTable:
+    """Contiguous host-RAM embedding store with row-sparse updates.
+
+    update="sgd": w[k] -= lr * g (sequential over duplicates — identical
+    to a dense device SGD step on the summed gradient).
+    update="adagrad": per-row accumulator, the common_sparse_table.cc
+    server rule."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 lr: float = 0.1, update: str = "sgd",
+                 initializer_std: float = 0.02, seed: int = 0):
+        assert update in ("sgd", "adagrad"), update
+        rng = np.random.default_rng(seed)
+        self.weight = (rng.standard_normal(
+            (num_embeddings, embedding_dim)) * initializer_std
+        ).astype(np.float32)
+        self.lr = lr
+        self.update = update
+        self._accum: Optional[np.ndarray] = None
+        self._lock = threading.Lock()
+
+    @property
+    def nbytes(self) -> int:
+        return self.weight.nbytes
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        with self._lock:
+            return self.weight[np.asarray(ids, np.int64)]
+
+    def push_grad(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        with self._lock:
+            if self.update == "adagrad":
+                if self._accum is None:
+                    self._accum = np.zeros_like(self.weight)
+                np.add.at(self._accum, ids, g * g)
+                denom = np.sqrt(self._accum[ids]) + 1e-6
+                np.subtract.at(self.weight, ids, self.lr * g / denom)
+            else:
+                np.subtract.at(self.weight, ids, self.lr * g)
+
+
+class HostEmbedding(Layer):
+    """Embedding whose table lives on the HOST; drop-in for nn.Embedding
+    inside any jitted step (TrainStep / fleet.distributed_jit).
+
+    The table is NOT a Parameter: the device optimizer never sees it;
+    its rows update host-side in the backward push. ``table`` may be a
+    DenseHostTable or any object with pull(ids)/push_grad(ids, grads)
+    (e.g. distributed.ps.SparseTable — the PS-backed variant)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 lr: float = 0.1, update: str = "sgd", table=None,
+                 seed: int = 0):
+        super().__init__()
+        self.table = table if table is not None else DenseHostTable(
+            num_embeddings, embedding_dim, lr=lr, update=update,
+            seed=seed)
+        self._dim = embedding_dim
+        self._cache: Dict[bytes, np.ndarray] = {}
+        self._prefetch_threads: Dict[bytes, threading.Thread] = {}
+        # A zero-valued scalar Parameter threaded through the lookup's
+        # custom_vjp. Without it autodiff PRUNES the lookup's backward
+        # (its only real input is integer ids, so no differentiable path
+        # reaches it) and the gradient push would silently never fire.
+        # Its own gradient is defined as zero, so the device optimizer
+        # never moves it.
+        from ..nn.initializer import Constant
+        self.anchor = self.create_parameter(
+            (1,), default_initializer=Constant(0.0))
+
+        dim = embedding_dim
+        table_ref = self.table
+        cache = self._cache
+        threads = self._prefetch_threads
+        # One lock makes prefetch fills and gradient pushes atomic with
+        # respect to each other: a push PATCHES any already-cached rows
+        # it just updated, and a fill that starts after a push reads the
+        # fresh table — so prefetched rows are never stale even though
+        # the fill overlaps the previous step's backward.
+        coherence = threading.Lock()
+        self._coherence = coherence
+
+        def host_pull(ids: np.ndarray) -> np.ndarray:
+            key = np.asarray(ids).tobytes()
+            t = threads.pop(key, None)
+            if t is not None:
+                t.join()
+            with coherence:
+                hit = cache.pop(key, None)
+                if hit is not None:
+                    return hit[1]
+                return table_ref.pull(
+                    np.asarray(ids).reshape(-1)).reshape(
+                        ids.shape + (dim,)).astype(np.float32)
+
+        def host_push(ids: np.ndarray, grads: np.ndarray) -> None:
+            flat = np.asarray(ids).reshape(-1)
+            with coherence:
+                table_ref.push_grad(flat, np.asarray(grads))
+                pushed = np.unique(flat)
+                for key, (cached_ids, rows) in list(cache.items()):
+                    mask = np.isin(cached_ids.reshape(-1), pushed)
+                    if mask.any():
+                        fresh = table_ref.pull(
+                            cached_ids.reshape(-1)[mask])
+                        rows.reshape(-1, dim)[mask] = fresh
+
+        @jax.custom_vjp
+        def lookup(ids, anchor):
+            del anchor  # differentiability anchor only
+            return jax.pure_callback(
+                host_pull,
+                jax.ShapeDtypeStruct(tuple(ids.shape) + (dim,),
+                                     jnp.float32),
+                ids, vmap_method="sequential")
+
+        def lookup_fwd(ids, anchor):
+            return lookup(ids, anchor), ids
+
+        def lookup_bwd(ids, g):
+            from jax.experimental import io_callback
+            io_callback(host_push, None, ids, g, ordered=True)
+            return (np.zeros(ids.shape, jax.dtypes.float0),
+                    jnp.zeros((1,), jnp.float32))
+
+        lookup.defvjp(lookup_fwd, lookup_bwd)
+        self._lookup = lookup
+
+    def prefetch(self, ids) -> None:
+        """Warm the pull cache on a background thread (overlaps the
+        current step's device compute — call before the step that will
+        consume ``ids``)."""
+        ids = np.asarray(ids)
+        key = ids.tobytes()
+        if key in self._cache or key in self._prefetch_threads:
+            return
+        dim = self._dim
+
+        def work():
+            with self._coherence:
+                rows = self.table.pull(ids.reshape(-1)).reshape(
+                    ids.shape + (dim,)).astype(np.float32)
+                self._cache[key] = (ids, rows)
+
+        t = threading.Thread(target=work, daemon=True)
+        self._prefetch_threads[key] = t
+        t.start()
+
+    def forward(self, x):
+        from .. import dispatch
+        ids = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        out = dispatch.call_fn(self._lookup, "host_embedding", True,
+                               (ids, self.anchor), {})
+        return out if isinstance(out, Tensor) else Tensor(out)

@@ -165,12 +165,14 @@ def sequence_parallel_attention(q, k, v, mode: str = "ring",
                 f"degree {sep} for seq_parallel_mode")
         if mp > 1 and q.shape[2] % mp:
             raise ValueError(
-                f"num_heads {q.shape[2]} must divide the mp degree {mp}")
+                f"num_heads {q.shape[2]} must be divisible by the mp "
+                f"degree {mp}")
         local_heads = q.shape[2] // mp
         if mode == "ulysses" and local_heads % sep:
             raise ValueError(
                 "ulysses redistributes heads over sep: per-mp-shard "
-                f"heads {local_heads} must divide the sep degree {sep}")
+                f"heads {local_heads} must be divisible by the sep "
+                f"degree {sep}")
         from jax import shard_map
         head_axis = "mp" if mp > 1 else None
 

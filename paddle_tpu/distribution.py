@@ -10,9 +10,11 @@ when given a key explicitly.
 from __future__ import annotations
 
 import math
+import warnings
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .tensor import Tensor
 
@@ -49,11 +51,75 @@ class Distribution:
     def probs(self, value):
         raise NotImplementedError
 
+    # -- argument plumbing (reference distribution.py:70-136) ---------------
+
+    @staticmethod
+    def _validate_args(*args):
+        """Mixing Tensors with python numbers/lists is rejected, exactly
+        like the reference (:70): returns True iff args are Tensors."""
+        is_variable = any(isinstance(a, (Tensor, jax.Array)) for a in args)
+        is_number = any(not isinstance(a, (Tensor, jax.Array))
+                        for a in args)
+        if is_variable and is_number:
+            raise ValueError(
+                "if one argument is Tensor, all arguments should be "
+                "Tensor")
+        return is_variable
+
+    @staticmethod
+    def _to_tensor(*args):
+        """Convert float/list/ndarray args to mutually-broadcast f32/f64
+        arrays (reference :92 _to_tensor): floats become shape-[1]
+        tensors, dtypes outside {f32, f64} warn and convert to f32."""
+        arrays = []
+        for arg in args:
+            if isinstance(arg, float):
+                arg = [arg]
+            if isinstance(arg, int):
+                arg = [float(arg)]
+            if not isinstance(arg, (list, tuple, np.ndarray, Tensor,
+                                    jax.Array)):
+                raise TypeError(
+                    "Type of input args must be float, list, "
+                    "numpy.ndarray or Tensor, but received type "
+                    f"{type(arg)}")
+            a = np.asarray(arg.value if isinstance(arg, Tensor) else arg)
+            if a.dtype not in (np.float32, np.float64):
+                warnings.warn(
+                    "data type of argument only support float32 and "
+                    "float64, your argument will be convert to float32.")
+                a = a.astype(np.float32)
+            arrays.append(a)
+        common = np.result_type(*arrays)
+        shape = np.broadcast_shapes(*(a.shape for a in arrays))
+        return tuple(jnp.asarray(np.broadcast_to(a.astype(common), shape))
+                     for a in arrays)
+
+    @staticmethod
+    def _check_values_dtype_in_probs(param, value):
+        """Cast ``value`` to the parameter dtype with a warning when they
+        disagree (reference :136)."""
+        v = value.value if isinstance(value, Tensor) else \
+            jnp.asarray(value)  # keep the caller's dtype for the check
+        if not jnp.issubdtype(v.dtype, jnp.floating):
+            raise TypeError(
+                f"value dtype must be floating, got {v.dtype}")
+        p = _val(param)
+        if v.dtype != p.dtype:
+            warnings.warn(
+                "dtype of input 'value' needs to be the same as "
+                "parameters of distribution class. dtype of 'value' "
+                "will be converted.")
+            v = v.astype(p.dtype)
+        return v
+
 
 class Uniform(Distribution):
     """U(low, high) (reference distribution.py:168)."""
 
     def __init__(self, low, high, name=None):
+        if not self._validate_args(low, high):
+            low, high = self._to_tensor(low, high)
         self.low = _val(low)
         self.high = _val(high)
         self.name = name
@@ -61,11 +127,11 @@ class Uniform(Distribution):
     def sample(self, shape, seed=0):
         shape = tuple(shape) + jnp.broadcast_shapes(
             jnp.shape(self.low), jnp.shape(self.high))
-        u = jax.random.uniform(_key(seed), shape, jnp.float32)
+        u = jax.random.uniform(_key(seed), shape, self.low.dtype)
         return Tensor(self.low + u * (self.high - self.low))
 
     def log_prob(self, value):
-        v = _val(value)
+        v = self._check_values_dtype_in_probs(self.low, value)
         inside = (v >= self.low) & (v < self.high)
         lp = -jnp.log(self.high - self.low)
         return Tensor(jnp.where(inside, lp, -jnp.inf))
@@ -81,6 +147,8 @@ class Normal(Distribution):
     """N(loc, scale) (reference distribution.py:390)."""
 
     def __init__(self, loc, scale, name=None):
+        if not self._validate_args(loc, scale):
+            loc, scale = self._to_tensor(loc, scale)
         self.loc = _val(loc)
         self.scale = _val(scale)
         self.name = name
@@ -88,11 +156,11 @@ class Normal(Distribution):
     def sample(self, shape, seed=0):
         shape = tuple(shape) + jnp.broadcast_shapes(
             jnp.shape(self.loc), jnp.shape(self.scale))
-        z = jax.random.normal(_key(seed), shape, jnp.float32)
+        z = jax.random.normal(_key(seed), shape, self.loc.dtype)
         return Tensor(self.loc + z * self.scale)
 
     def log_prob(self, value):
-        v = _val(value)
+        v = self._check_values_dtype_in_probs(self.loc, value)
         var = self.scale * self.scale
         return Tensor(-((v - self.loc) ** 2) / (2.0 * var)
                       - jnp.log(self.scale) - 0.5 * math.log(2.0 * math.pi))

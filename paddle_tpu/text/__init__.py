@@ -284,23 +284,112 @@ class Movielens(Dataset):
         return len(self.samples)
 
 
+def _expand_srl_column(col):
+    """One predicate column of a CoNLL-05 props file -> B/I/O tags.
+    Tokens are '*' (continue), '*)' (close), '(TAG*' (open), '(TAG*)'
+    (single-token span). Reference semantics: conll05.py:200-222."""
+    out, cur, inside = [], None, False
+    for tok in col:
+        if tok == "*":
+            out.append("I-" + cur if inside else "O")
+        elif tok == "*)":
+            out.append("I-" + cur)
+            inside = False
+        elif "(" in tok and "*" in tok:
+            cur = tok[1:tok.index("*")]
+            out.append("B-" + cur)
+            inside = ")" not in tok
+        else:
+            raise ValueError(f"unexpected props token: {tok!r}")
+    return out
+
+
+def _parse_conll05_tar(data_file):
+    """The official conll05st-release tar: words/*.words.gz (one token
+    per line, blank line ends a sentence) zipped against
+    props/*.props.gz (column 0 = verb lemma or '-', one tag column per
+    predicate). Yields (words, predicate_lemma, bio_labels) per
+    (sentence, predicate) pair — reference: conll05.py:172-235."""
+    import gzip
+    import tarfile
+
+    with tarfile.open(data_file) as tf:
+        words_names = sorted(n for n in tf.getnames()
+                             if n.endswith(".words.gz"))
+        props_names = sorted(n for n in tf.getnames()
+                             if n.endswith(".props.gz"))
+        if not words_names or len(words_names) != len(props_names):
+            raise ValueError(
+                f"{data_file} needs matching words.gz/props.gz members "
+                f"(got {len(words_names)}/{len(props_names)})")
+        word_lines, prop_lines = [], []
+        # every section (e.g. test.wsj AND test.brown), paired by order
+        for wn, pn in zip(words_names, props_names):
+            with gzip.GzipFile(fileobj=tf.extractfile(wn)) as wf:
+                word_lines += [l.decode().strip() for l in wf]
+                word_lines.append("")  # section boundary = sentence end
+            with gzip.GzipFile(fileobj=tf.extractfile(pn)) as pf:
+                prop_lines += [l.decode().strip().split() for l in pf]
+                prop_lines.append([])
+
+    samples = []
+    words, rows = [], []
+
+    def flush():
+        if words:
+            lemmas = [r[0] for r in rows if r[0] != "-"]
+            n_pred = len(rows[0]) - 1 if rows else 0
+            for i in range(n_pred):
+                col = [r[i + 1] for r in rows]
+                samples.append((words[:], lemmas[i],
+                                _expand_srl_column(col)))
+
+    for word, row in zip(word_lines, prop_lines):
+        if not word and not row:  # sentence boundary
+            flush()
+            words, rows = [], []
+        else:
+            words.append(word)
+            rows.append(row)
+    flush()  # archives without a trailing blank line
+    return samples
+
+
 class Conll05st(Dataset):
     """CoNLL-2005 semantic role labeling (reference: paddle.text.Conll05st,
-    text/datasets/conll05.py): samples are (word_ids[T], predicate_index,
-    mark[T], label_ids[T]). Local conll-format file or deterministic
-    synthetic sentences."""
+    text/datasets/conll05.py): samples are (word_ids[T], predicate_id,
+    mark[T], label_ids[T]) at fixed seq_len (TPU static shapes; the
+    reference returns ragged context arrays). Given the official release
+    tar via ``data_file`` (+ optional word/verb/target dict files, one
+    entry per line) it parses the real words/props format; otherwise it
+    serves deterministic synthetic sentences. ``mark`` flags the
+    reference's 5-token predicate context window (conll05.py:246-276).
+    In real-archive mode ``mode`` is ignored — like the reference, whose
+    Conll05st serves only the public test sections (conll05.py:65-67) —
+    and out-of-vocabulary words map to id 0, the reference's UNK_IDX
+    convention (conll05.py:52: the released dicts put UNK at row 0)."""
 
     NUM_LABELS = 9
 
     def __init__(self, data_file: Optional[str] = None, mode: str = "train",
-                 seq_len: int = 16, synthetic_size: int = 200):
+                 seq_len: int = 16, synthetic_size: int = 200,
+                 word_dict_file: Optional[str] = None,
+                 verb_dict_file: Optional[str] = None,
+                 target_dict_file: Optional[str] = None):
         rng = np.random.default_rng(0 if mode == "train" else 1)
         self.seq_len = seq_len
+        self.word_dict = self.predicate_dict = self.label_dict = None
         if data_file and os.path.exists(data_file):
-            raise NotImplementedError(
-                "parsing official conll05 props files is not wired; "
-                "provide preprocessed .npy arrays or use the synthetic "
-                "corpus")
+            raw = _parse_conll05_tar(data_file)
+            self.word_dict = self._load_or_build_dict(
+                word_dict_file, sorted({w for ws, _, _ in raw
+                                        for w in ws}))
+            self.predicate_dict = self._load_or_build_dict(
+                verb_dict_file, sorted({p for _, p, _ in raw}))
+            self.label_dict = self._build_label_dict(
+                target_dict_file, raw)
+            self.samples = [self._encode(*s) for s in raw]
+            return
         self.samples = []
         for _ in range(synthetic_size):
             t = int(rng.integers(5, seq_len + 1))
@@ -313,6 +402,53 @@ class Conll05st(Dataset):
             labels = np.zeros(seq_len, np.int64)
             labels[:t] = rng.integers(0, self.NUM_LABELS, t)
             self.samples.append((wid, np.int64(pred), mark, labels))
+
+    @staticmethod
+    def _load_or_build_dict(path, fallback_entries):
+        if path and os.path.exists(path):
+            with open(path) as f:
+                return {ln.strip(): i for i, ln in enumerate(f)
+                        if ln.strip()}
+        return {w: i for i, w in enumerate(fallback_entries)}
+
+    @staticmethod
+    def _build_label_dict(path, raw):
+        """B-X/I-X pairs for every tag, then 'O' last (reference:
+        conll05.py:146-163 load_label_dict)."""
+        if path and os.path.exists(path):
+            with open(path) as f:
+                tags = sorted({ln.strip()[2:] for ln in f
+                               if ln.strip()[:2] in ("B-", "I-")})
+        else:
+            tags = sorted({lb[2:] for _, _, lbs in raw
+                           for lb in lbs if lb != "O"})
+        d = {}
+        for t in tags:
+            d["B-" + t] = len(d)
+            d["I-" + t] = len(d)
+        d["O"] = len(d)
+        return d
+
+    def _encode(self, words, predicate, labels):
+        T = self.seq_len
+        unk = 0
+        wid = np.zeros(T, np.int64)
+        lid = np.full(T, self.label_dict["O"], np.int64)
+        mark = np.zeros(T, np.int64)
+        n = min(len(words), T)
+        wid[:n] = [self.word_dict.get(w, unk) for w in words[:n]]
+        lid[:n] = [self.label_dict[lb] for lb in labels[:n]]
+        v = labels.index("B-V")
+        for k in range(max(0, v - 2), min(len(labels), v + 3)):
+            if k < T:
+                mark[k] = 1
+        pred = np.int64(self.predicate_dict.get(predicate, 0))
+        return wid, pred, mark, lid
+
+    def get_dict(self):
+        """(word_dict, predicate_dict, label_dict) — real-archive mode
+        only (reference: conll05.py get_dict)."""
+        return self.word_dict, self.predicate_dict, self.label_dict
 
     def __getitem__(self, idx):
         return self.samples[idx]

@@ -199,50 +199,103 @@ class ImageFolder(Dataset):
 
 
 class Flowers(Dataset):
-    """Flowers-102 (reference: vision/datasets/flowers.py). Reads local
-    data_file/label_file mat+tgz when provided; otherwise serves a
+    """Flowers-102 (reference: vision/datasets/flowers.py). Given the
+    official archives — ``data_file`` 102flowers.tgz (jpg/image_%05d.jpg
+    members), ``label_file`` imagelabels.mat ('labels', 1-indexed),
+    ``setid_file`` setid.mat ('trnid'/'valid'/'tstid' image indices) —
+    parses the real formats (scipy.io + PIL decode). Otherwise serves a
     deterministic synthetic set with the real shapes (zero-egress
-    environment — see module docstring)."""
+    environment — see module docstring).
+
+    Mirrors the reference's split swap (flowers.py MODE_FLAG_MAP):
+    'train' reads the (larger) tstid list, 'test' reads trnid."""
 
     _SPLIT_SIZES = {"train": 60, "valid": 20, "test": 60}
+    _MODE_FLAG = {"train": "tstid", "test": "trnid", "valid": "valid"}
 
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, download=True, backend=None):
         self.mode = mode
         self.transform = transform
+        self._tar = None
         if data_file and os.path.exists(data_file):
-            raise NotImplementedError(
-                "parsing the official 102flowers archive needs scipy.io; "
-                "provide extracted images via DatasetFolder instead")
+            if not (label_file and os.path.exists(label_file) and
+                    setid_file and os.path.exists(setid_file)):
+                raise ValueError(
+                    "Flowers needs label_file (imagelabels.mat) and "
+                    "setid_file (setid.mat) together with data_file")
+            import tarfile
+
+            import scipy.io as scio
+            self._labels_mat = scio.loadmat(label_file)["labels"][0]
+            self._indexes = scio.loadmat(setid_file)[
+                self._MODE_FLAG.get(mode.lower(), "valid")][0]
+            self._tar = tarfile.open(data_file)
+            self._members = {m.name: m for m in self._tar.getmembers()}
+            return
         n = self._SPLIT_SIZES.get(mode, 60)
         # per-mode seeds: splits must be disjoint image sets
         rng = np.random.RandomState(
             102 + {"train": 0, "valid": 1, "test": 2}.get(mode, 3))
         self._images = (rng.rand(n, 64, 64, 3) * 255).astype("uint8")
-        self._labels = (rng.randint(0, 102, size=n)).astype("int64")
+        # labels shaped [1] like the real-archive path (reference
+        # flowers.py:127 returns np.array([label]))
+        self._labels = (rng.randint(0, 102, size=(n, 1))).astype("int64")
 
     def __getitem__(self, idx):
+        if self._tar is not None:
+            import io as _io
+
+            from PIL import Image
+            index = int(self._indexes[idx])
+            name = "jpg/image_%05d.jpg" % index
+            raw = self._tar.extractfile(self._members[name]).read()
+            img = np.asarray(Image.open(_io.BytesIO(raw)))
+            label = np.array([self._labels_mat[index - 1]], "int64")
+            if self.transform is not None:
+                img = self.transform(img)
+            return img, label
         img = self._images[idx]
         if self.transform is not None:
             img = self.transform(img)
         return img, self._labels[idx]
 
     def __len__(self):
+        if self._tar is not None:
+            return len(self._indexes)
         return len(self._images)
 
 
 class VOC2012(Dataset):
     """Pascal VOC 2012 segmentation (reference: vision/datasets/voc2012.py):
-    samples are (image, segmentation mask). Local archive parsing is not
-    wired (zero egress); serves deterministic synthetic pairs with real
-    shapes/dtypes unless a prepared directory of (img, mask) .npy pairs is
-    given via data_file."""
+    samples are (image, segmentation mask). Given the official
+    VOCtrainval tar via ``data_file``, parses the real layout
+    (ImageSets/Segmentation/{mode}.txt -> JPEGImages/*.jpg +
+    SegmentationClass/*.png, PIL-decoded). A directory of (img, mask)
+    .npy pairs also works; otherwise serves deterministic synthetic
+    pairs with real shapes/dtypes (zero-egress environment)."""
+
+    _SET = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    _IMG = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    _MASK = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None):
         self.mode = mode
         self.transform = transform
-        if data_file and os.path.isdir(data_file):
+        self._tar = None
+        if data_file and os.path.isfile(data_file):
+            import tarfile
+            self._tar = tarfile.open(data_file)
+            self._members = {m.name: m for m in self._tar.getmembers()}
+            flag = {"train": "train", "valid": "val",
+                    "test": "val"}.get(mode, "train")
+            listing = self._tar.extractfile(
+                self._members[self._SET.format(flag)]).read()
+            self._names = [ln.strip().decode()
+                           for ln in listing.splitlines() if ln.strip()]
+            self._pairs = None
+        elif data_file and os.path.isdir(data_file):
             files = sorted(f for f in os.listdir(data_file)
                            if f.endswith("_img.npy"))
             self._pairs = [
@@ -258,10 +311,23 @@ class VOC2012(Dataset):
                                 "int64")) for _ in range(n)]
 
     def __getitem__(self, idx):
-        img, mask = self._pairs[idx]
+        if self._tar is not None:
+            import io as _io
+
+            from PIL import Image
+            name = self._names[idx]
+            img = np.asarray(Image.open(_io.BytesIO(self._tar.extractfile(
+                self._members[self._IMG.format(name)]).read())))
+            mask = np.asarray(Image.open(_io.BytesIO(self._tar.extractfile(
+                self._members[self._MASK.format(name)]).read())),
+                dtype="int64")
+        else:
+            img, mask = self._pairs[idx]
         if self.transform is not None:
             img = self.transform(img)
         return img, mask
 
     def __len__(self):
+        if self._tar is not None:
+            return len(self._names)
         return len(self._pairs)

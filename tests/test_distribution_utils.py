@@ -22,19 +22,20 @@ def test_normal_sample_logprob_entropy_kl():
     assert abs(arr.mean() - 1.0) < 0.1
     assert abs(arr.std() - 2.0) < 0.1
 
-    lp = float(d.log_prob(pt.to_tensor(np.float32(1.0))).value)
+    lp = float(np.asarray(d.log_prob(
+        pt.to_tensor(np.float32(1.0))).value).squeeze())
     expect = -math.log(2.0) - 0.5 * math.log(2 * math.pi)
     assert abs(lp - expect) < 1e-5
 
-    ent = float(d.entropy().value)
+    ent = float(np.asarray(d.entropy().value).squeeze())
     assert abs(ent - (0.5 + 0.5 * math.log(2 * math.pi)
                       + math.log(2.0))) < 1e-5
 
     other = Normal(0.0, 1.0)
-    kl = float(d.kl_divergence(other).value)
+    kl = float(np.asarray(d.kl_divergence(other).value).squeeze())
     # KL(N(1,4)||N(0,1)) = 0.5*(4 + 1 - 1 - ln 4)
     assert abs(kl - 0.5 * (4 + 1 - 1 - math.log(4))) < 1e-5
-    assert abs(float(d.kl_divergence(d).value)) < 1e-6
+    assert abs(float(np.asarray(d.kl_divergence(d).value).squeeze())) < 1e-6
 
 
 def test_uniform():
@@ -42,10 +43,13 @@ def test_uniform():
     s = np.asarray(d.sample((10000,), seed=3).value)
     assert s.min() >= -1.0 and s.max() < 3.0
     assert abs(s.mean() - 1.0) < 0.1
-    assert abs(float(d.entropy().value) - math.log(4.0)) < 1e-6
-    lp_in = float(d.log_prob(pt.to_tensor(np.float32(0.0))).value)
+    assert abs(float(np.asarray(d.entropy().value).squeeze())
+               - math.log(4.0)) < 1e-6
+    lp_in = float(np.asarray(d.log_prob(
+        pt.to_tensor(np.float32(0.0))).value).squeeze())
     assert abs(lp_in + math.log(4.0)) < 1e-6
-    assert float(d.log_prob(pt.to_tensor(np.float32(5.0))).value) == -np.inf
+    assert float(np.asarray(d.log_prob(
+        pt.to_tensor(np.float32(5.0))).value).squeeze()) == -np.inf
 
 
 def test_categorical():
@@ -107,3 +111,58 @@ def test_download_archive_decompress(tmp_path):
 def test_run_check():
     from paddle_tpu.utils import run_check
     run_check()
+
+
+def test_distribution_arg_validation_and_promotion():
+    """reference python/paddle/distribution.py:70-136 _validate_args /
+    _to_tensor / _check_values_dtype_in_probs semantics."""
+    import warnings as _w
+
+    import jax.numpy as jnp
+    import pytest as _pt
+
+    from paddle_tpu.distribution import Normal, Uniform
+
+    # mixing Tensor and python-number args is rejected
+    with _pt.raises(ValueError):
+        Normal(pt.to_tensor([0.0]), 1.0)
+    with _pt.raises(ValueError):
+        Uniform(0.0, pt.to_tensor([1.0]))
+
+    # unsupported arg types are a TypeError
+    with _pt.raises(TypeError):
+        Normal("zero", "one")
+
+    # floats become shape-[1] params, mutually broadcast with lists
+    n = Normal(0.0, [1.0, 2.0])
+    assert n.loc.shape == (2,) and n.scale.shape == (2,)
+
+    # int lists warn and promote to float32
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        u = Uniform([0, 0], [2, 4])
+    assert u.low.dtype == jnp.float32
+    assert any("float32" in str(r.message) for r in rec)
+
+    # float64 args keep float64 (promotion over the pair)
+    f64 = np.array([0.0, 1.0], np.float64)
+    n64 = Normal(f64, np.array([1.0], np.float64))
+    assert n64.loc.dtype == jnp.float64 or n64.loc.dtype == jnp.float32
+    # (jax may downcast without x64 mode; shape promotion still applies)
+    assert n64.loc.shape == (2,)
+
+    # value dtype converts (with a warning) to the param dtype
+    n32 = Normal([0.0], [1.0])
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        lp = n32.log_prob(jnp.asarray([0.5], jnp.bfloat16))
+    assert np.asarray(lp).dtype == np.float32
+    assert any("converted" in str(r.message) for r in rec)
+
+    # integer values in log_prob are rejected (floating only)
+    with _pt.raises(TypeError):
+        n32.log_prob(np.array([1], np.int32))
+
+    # samples follow the parameter dtype
+    s = n32.sample([3])
+    assert np.asarray(s).dtype == np.float32 and tuple(s.shape) == (3, 1)

@@ -1,0 +1,86 @@
+"""The per-op benchmark gate has teeth: committed baselines exist, the
+compare logic fails on regressions, and a live CPU smoke run gates
+against the committed CPU baseline.
+
+Reference parity: tools/test_op_benchmark.sh:1 +
+tools/check_op_benchmark_result.py:1 (CI fails on per-op speed
+regressions against stored develop logs)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+sys.path.insert(0, TOOLS)
+
+
+def test_committed_baselines_are_complete():
+    from op_benchmark import default_cases
+
+    for platform in ("cpu_smoke", "tpu_v5e"):
+        d = os.path.join(TOOLS, "op_baselines", platform)
+        assert os.path.isdir(d), f"missing committed baseline: {d}"
+        cases = {}
+        for fn in os.listdir(d):
+            with open(os.path.join(d, fn)) as f:
+                r = json.loads(f.read().strip())
+            cases[r["case"]] = r
+        assert set(cases) == set(default_cases()), (
+            platform, sorted(set(default_cases()) - set(cases)))
+        assert all(r["avg_us"] > 0 for r in cases.values())
+
+
+def test_compare_flags_regressions(tmp_path):
+    from check_op_benchmark_result import compare, load_logs_dir
+
+    dev = tmp_path / "dev"
+    pr = tmp_path / "pr"
+    dev.mkdir()
+    pr.mkdir()
+    (dev / "a.log").write_text(
+        json.dumps({"case": "matmul", "avg_us": 100.0}) + "\n")
+    (dev / "b.log").write_text(
+        json.dumps({"case": "softmax", "avg_us": 50.0}) + "\n")
+    (pr / "a.log").write_text(
+        json.dumps({"case": "matmul", "avg_us": 200.0}) + "\n")  # 2x slower
+    (pr / "b.log").write_text(
+        json.dumps({"case": "softmax", "avg_us": 51.0}) + "\n")
+    failures, checked = compare(load_logs_dir(str(dev)),
+                                load_logs_dir(str(pr)), threshold=0.15)
+    assert checked == 2
+    assert [f[0] for f in failures] == ["matmul"]
+    # and the CLI exit code mirrors the reference (8 on regression)
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(TOOLS, "check_op_benchmark_result.py"),
+         "--develop_logs_dir", str(dev), "--pr_logs_dir", str(pr)],
+        capture_output=True)
+    assert r.returncode == 8
+
+
+@pytest.mark.parametrize("ops", ["add,matmul,softmax,layer_norm"])
+def test_cpu_smoke_gate_against_committed_baseline(tmp_path, ops):
+    """Re-measure a subset on this host and gate against the committed
+    CPU baseline with a catastrophic-only threshold (4x): cross-host
+    variance is real, silent O(n^2) regressions are what this catches.
+    The TPU baseline is gated the same way by tools/op_benchmark_tpu.sh
+    on chip-attached hosts (the driver-visible path)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "op_benchmark.py"),
+         "--platform", "cpu", "--ops", ops, "--repeat", "10",
+         "--output", str(tmp_path / "pr")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    from check_op_benchmark_result import compare, load_logs_dir
+    dev = load_logs_dir(os.path.join(TOOLS, "op_baselines", "cpu_smoke"))
+    dev = {k: v for k, v in dev.items() if k in ops.split(",")}
+    pr = load_logs_dir(str(tmp_path / "pr"))
+    failures, checked = compare(dev, pr, threshold=4.0)
+    assert checked == len(ops.split(","))
+    assert not failures, failures

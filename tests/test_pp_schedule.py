@@ -1,0 +1,109 @@
+"""Pipeline schedule efficiency: 1F1B vs F-then-B, measured.
+
+The reference's whole reason for 1F1B is memory x throughput
+(section_worker.cc:130-180: F-then-B stores every microbatch's
+activations; 1F1B bounds them by the stage count). This file measures
+both claims on the virtual mesh:
+
+- peak memory: XLA compiled-executable temp bytes — 1F1B must hold
+  O(pp) activation slots while F-then-B grows with n_micro;
+- step time: both schedules run the same per-tick fwd+bwd work in the
+  SPMD lockstep formulation, with tick counts m + pp - 1 (per phase,
+  F-then-B) vs m + 2(pp-1) (combined, 1F1B) — the analytic bubble
+  fractions asserted below.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu.optimizer as optim
+from paddle_tpu.models import gpt_tiny
+from paddle_tpu.models.gpt_pipeline import GPTPipelineTrainStep
+
+pytestmark = pytest.mark.slow  # several XLA compiles of whole train steps
+
+
+def _metrics(schedule, pp, n_micro, seq=64):
+    cfg = gpt_tiny()
+    cfg.num_layers = 4
+    dp = len(jax.devices()) // pp
+    step = GPTPipelineTrainStep(
+        cfg, optim.SGD(learning_rate=0.1), pp=pp, dp=dp,
+        n_micro=n_micro, schedule=schedule, abstract=True)
+    # microbatch size fixed at 2 rows per device so only the schedule's
+    # in-flight count varies with n_micro
+    compiled = step.lower(dp * n_micro * 2, seq).compile()
+    mem = compiled.memory_analysis()
+    return int(mem.temp_size_in_bytes)
+
+
+def _timed(schedule, pp, n_micro, seq=64, iters=3):
+    cfg = gpt_tiny()
+    cfg.num_layers = 4
+    dp = len(jax.devices()) // pp
+    batch = dp * n_micro * 2
+    step = GPTPipelineTrainStep(
+        cfg, optim.SGD(learning_rate=0.1), pp=pp, dp=dp,
+        n_micro=n_micro, schedule=schedule)
+    ids = (np.arange(batch * seq).reshape(batch, seq)
+           % cfg.vocab_size).astype(np.int32)
+    float(step(ids, ids))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = float(step(ids, ids))
+    dt = (time.perf_counter() - t0) / iters
+    assert np.isfinite(loss)
+    return dt
+
+
+def bubble_fraction(schedule: str, pp: int, m: int) -> float:
+    """Analytic bubble of the SPMD lockstep schedules: every tick costs
+    one fwd+bwd unit; the ideal is m busy ticks."""
+    if schedule == "fthenb":
+        # fwd phase m+pp-1 ticks, bwd phase m+pp-1 ticks; ideal m each
+        return (pp - 1) / (m + pp - 1)
+    # 1f1b: single combined scan of m + 2(pp-1) ticks
+    return 2 * (pp - 1) / (m + 2 * (pp - 1))
+
+
+def test_analytic_bubble_fractions():
+    # spot values
+    assert bubble_fraction("fthenb", 4, 8) == pytest.approx(3 / 11)
+    assert bubble_fraction("1f1b", 4, 8) == pytest.approx(6 / 14)
+    # both converge to zero as m grows; fthenb's bubble is smaller in
+    # the lockstep formulation (1f1b's edge is MEMORY, not ticks)
+    for pp in (2, 4):
+        for m in (4, 16, 64):
+            assert bubble_fraction("1f1b", pp, m) < \
+                bubble_fraction("1f1b", pp, m // 2 if m > 4 else 4) + 1e-9
+        assert bubble_fraction("fthenb", pp, 256) < 0.02
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+@pytest.mark.parametrize("pp", [2, 4])
+def test_1f1b_memory_bounded_by_stages(pp):
+    """The load-bearing claim: growing n_micro grows F-then-B's live
+    activation memory ~linearly while 1F1B stays flat (O(pp) slots)."""
+    t_f_4 = _metrics("fthenb", pp, 4)
+    t_f_16 = _metrics("fthenb", pp, 16)
+    t_1_4 = _metrics("1f1b", pp, 4)
+    t_1_16 = _metrics("1f1b", pp, 16)
+    # F-then-B's temps grow substantially with microbatch count
+    assert t_f_16 > 1.5 * t_f_4, (t_f_4, t_f_16)
+    # 1F1B's temps are (nearly) independent of n_micro
+    assert t_1_16 < 1.15 * t_1_4, (t_1_4, t_1_16)
+    # and at large n_micro, 1F1B uses materially less temp memory
+    assert t_1_16 < 0.7 * t_f_16, (t_1_16, t_f_16)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_1f1b_step_time_competitive():
+    """CPU proxy timing: the 1F1B schedule's step time stays within 2x
+    of F-then-B at pp=4/m=8 (same per-tick work, 14 vs 11+11 ticks —
+    analytically 1f1b should be FASTER; the margin absorbs CPU noise)."""
+    dt_f = _timed("fthenb", 4, 8)
+    dt_1 = _timed("1f1b", 4, 8)
+    assert dt_1 < 2.0 * dt_f, (dt_1, dt_f)

@@ -72,19 +72,59 @@ def bench_op(name: str, make_args, repeat: int) -> dict:
         return fn(*[next(it) if m else a
                     for a, m in zip(full_args, is_arr)])
 
-    jitted = jax.jit(call)
-    out = jitted(*args)
-    jax.block_until_ready(out)
-    # hard sync via host fetch (tunneled TPU: block_until_ready alone is
-    # not a reliable barrier)
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    np.asarray(leaf).ravel()[:1]
-    t0 = time.perf_counter()
-    for _ in range(repeat):
-        out = jitted(*args)
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    np.asarray(leaf).ravel()[:1]
-    dt = (time.perf_counter() - t0) / repeat
+    import jax.numpy as jnp
+
+    # The whole repeat loop runs INSIDE one launch (lax.scan with a
+    # serial carry dependency): on the tunneled TPU runtime a per-call
+    # loop would time the ~90 ms dispatch round trip, not the op. The
+    # carry perturbs the first float arg so XLA can neither hoist the op
+    # out of the loop nor DCE it.
+    def scan_all(*arrs):
+        def body(c, _):
+            it = iter(arrs)
+            perturbed = False
+            call_args = []
+            for a, m in zip(full_args, is_arr):
+                v = next(it) if m else a
+                if m and not perturbed:
+                    if isinstance(v, (list, tuple)) and len(v) and \
+                            jnp.issubdtype(jnp.asarray(v[0]).dtype,
+                                           jnp.floating):
+                        # list-args (concat): perturb the first element,
+                        # else the body is loop-invariant and hoisted
+                        v = [v[0] + c.astype(v[0].dtype), *v[1:]]
+                        perturbed = True
+                    elif not isinstance(v, (list, tuple)) and \
+                            jnp.issubdtype(jnp.asarray(v).dtype,
+                                           jnp.floating):
+                        v = v + c.astype(v.dtype)
+                        perturbed = True
+                call_args.append(v)
+            out = fn(*call_args)
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            # consume EVERY output element (a fused cheap reduce): a
+            # single-element carry would let XLA slice the op down to
+            # computing one element
+            return (leaf.astype(jnp.float32).sum() * 1e-30), None
+
+        c, _ = jax.lax.scan(body, jnp.asarray(0.0, jnp.float32), None,
+                            length=repeat)
+        return c
+
+    # stage the operand arrays on device ONCE: passing numpy would
+    # re-transfer them every timed window (the tunneled dev runtime's
+    # ~7 MB/s host link would dominate every measurement)
+    args = jax.tree_util.tree_map(jnp.asarray, args)
+    jitted = jax.jit(scan_all)
+    # warm (compile) + hard sync via host fetch (tunneled TPU:
+    # block_until_ready alone is not a reliable barrier)
+    float(jitted(*args))
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(jitted(*args))
+        times.append((time.perf_counter() - t0) / repeat)
+    dt = sorted(times)[1]  # median window
     return {"case": name, "avg_us": round(dt * 1e6, 2),
             "repeat": repeat}
 
@@ -93,9 +133,14 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", default="", help="comma list; default all")
     ap.add_argument("--output", default="", help="dir for per-case logs")
-    ap.add_argument("--repeat", type=int, default=50)
+    ap.add_argument("--repeat", type=int, default=None,
+                    help="scan length per window; default 20 on cpu, "
+                         "10000 on tpu (amortizes the tunneled runtime's "
+                         "~120 ms launch round trip to ~12 us/iter)")
     ap.add_argument("--platform", default="cpu", choices=["cpu", "tpu"])
     args = ap.parse_args()
+    if args.repeat is None:
+        args.repeat = 10000 if args.platform == "tpu" else 20
 
     if args.platform == "cpu":
         import jax
