@@ -69,18 +69,21 @@ def main() -> None:
     # in bf16; fits one chip via chunked CE alone (no remat), and runs
     # at HIGHER MFU than small configs (larger matmuls fill the MXU).
     if on_tpu:
-        # chunked CE alone makes 1.3B fit up to B2 S2048 (the
-        # [B,S,32768] logits were the memory problem, not block
-        # activations); remat would cost ~12% MFU and is not needed.
-        # Measured sweep (v5e MFU): B1 67.5%, B2 72.3% (peak), B3 70.1%;
+        # Measured sweep (v5e MFU): B1 67.5%, B2 72.3%, B3 70.1%;
         # longer-seq/no-remat: B2xS3072 70.3%, B1xS4096 71.2%;
-        # with selective remat: B4xS2048 every=3 62.8%, B2xS4096
-        # every=2 66.3% — B2xS2048 no-remat stays the sweet spot.
+        # selective remat: B4xS2048 every=3 62.8% — B2xS2048 no-remat is
+        # the sweet spot. The r3 ablation (tools/mfu_breakdown.py,
+        # PROFILE.json) then showed that at THIS config XLA's native
+        # attention beats the Pallas flash kernel by ~4 ms/step and the
+        # unchunked CE beats chunked-512 by ~9 ms/step (the [2,S,32k]
+        # logits fit fine): B2 73.7% vs 71.9%. Flash + chunked CE remain
+        # the long-sequence path (S>=4k: the S^2 score tensor and
+        # [B,S,V] logits stop fitting); here they are off on merit.
         cfg = GPTConfig(vocab_size=32768, hidden_size=2048, num_layers=24,
                         num_heads=16, max_seq_len=2048, dropout=0.0,
                         attn_dropout=0.0, dtype="bfloat16",
-                        loss_chunk_size=512)
-        batch, seq, steps = 2, 2048, 8  # B2 measured peak (72% MFU)
+                        use_flash_attention=False, loss_chunk_size=0)
+        batch, seq, steps = 2, 2048, 8  # B2 measured peak
     else:  # CI smoke fallback
         from paddle_tpu.models import gpt_tiny
         cfg = gpt_tiny()

@@ -159,13 +159,16 @@ class HostEmbedding(Layer):
                 ids, vmap_method="sequential")
 
         def lookup_fwd(ids, anchor):
-            return lookup(ids, anchor), ids
+            return lookup(ids, anchor), (ids, anchor)
 
-        def lookup_bwd(ids, g):
+        def lookup_bwd(res, g):
+            ids, anchor = res
             from jax.experimental import io_callback
             io_callback(host_push, None, ids, g, ordered=True)
+            # anchor cotangent must match the anchor's aval — it may be
+            # bf16 after model.to(dtype="bfloat16")
             return (np.zeros(ids.shape, jax.dtypes.float0),
-                    jnp.zeros((1,), jnp.float32))
+                    jnp.zeros_like(anchor))
 
         lookup.defvjp(lookup_fwd, lookup_bwd)
         self._lookup = lookup

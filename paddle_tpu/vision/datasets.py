@@ -12,11 +12,40 @@ from __future__ import annotations
 import gzip
 import os
 import struct
+import threading
 from typing import Callable, Optional
 
 import numpy as np
 
 from ..io.dataset import Dataset
+
+
+class _TarReader:
+    """Thread- and process-worker-safe random access into a tar archive:
+    reads are serialized under a lock (TarFile seeks on ONE file object),
+    and pickling drops the handle and reopens lazily in the worker (a
+    TarFile itself is unpicklable)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._open()
+
+    def _open(self):
+        import tarfile
+        self._lock = threading.Lock()
+        self._tar = tarfile.open(self.path)
+        self.members = {m.name: m for m in self._tar.getmembers()}
+
+    def read(self, name: str) -> bytes:
+        with self._lock:
+            return self._tar.extractfile(self.members[name]).read()
+
+    def __getstate__(self):
+        return {"path": self.path}
+
+    def __setstate__(self, state):
+        self.path = state["path"]
+        self._open()
 
 
 class MNIST(Dataset):
@@ -224,14 +253,11 @@ class Flowers(Dataset):
                 raise ValueError(
                     "Flowers needs label_file (imagelabels.mat) and "
                     "setid_file (setid.mat) together with data_file")
-            import tarfile
-
             import scipy.io as scio
             self._labels_mat = scio.loadmat(label_file)["labels"][0]
             self._indexes = scio.loadmat(setid_file)[
                 self._MODE_FLAG.get(mode.lower(), "valid")][0]
-            self._tar = tarfile.open(data_file)
-            self._members = {m.name: m for m in self._tar.getmembers()}
+            self._tar = _TarReader(data_file)
             return
         n = self._SPLIT_SIZES.get(mode, 60)
         # per-mode seeds: splits must be disjoint image sets
@@ -249,8 +275,7 @@ class Flowers(Dataset):
             from PIL import Image
             index = int(self._indexes[idx])
             name = "jpg/image_%05d.jpg" % index
-            raw = self._tar.extractfile(self._members[name]).read()
-            img = np.asarray(Image.open(_io.BytesIO(raw)))
+            img = np.asarray(Image.open(_io.BytesIO(self._tar.read(name))))
             label = np.array([self._labels_mat[index - 1]], "int64")
             if self.transform is not None:
                 img = self.transform(img)
@@ -285,13 +310,10 @@ class VOC2012(Dataset):
         self.transform = transform
         self._tar = None
         if data_file and os.path.isfile(data_file):
-            import tarfile
-            self._tar = tarfile.open(data_file)
-            self._members = {m.name: m for m in self._tar.getmembers()}
+            self._tar = _TarReader(data_file)
             flag = {"train": "train", "valid": "val",
                     "test": "val"}.get(mode, "train")
-            listing = self._tar.extractfile(
-                self._members[self._SET.format(flag)]).read()
+            listing = self._tar.read(self._SET.format(flag))
             self._names = [ln.strip().decode()
                            for ln in listing.splitlines() if ln.strip()]
             self._pairs = None
@@ -316,11 +338,10 @@ class VOC2012(Dataset):
 
             from PIL import Image
             name = self._names[idx]
-            img = np.asarray(Image.open(_io.BytesIO(self._tar.extractfile(
-                self._members[self._IMG.format(name)]).read())))
-            mask = np.asarray(Image.open(_io.BytesIO(self._tar.extractfile(
-                self._members[self._MASK.format(name)]).read())),
-                dtype="int64")
+            img = np.asarray(Image.open(_io.BytesIO(
+                self._tar.read(self._IMG.format(name)))))
+            mask = np.asarray(Image.open(_io.BytesIO(
+                self._tar.read(self._MASK.format(name)))), dtype="int64")
         else:
             img, mask = self._pairs[idx]
         if self.transform is not None:

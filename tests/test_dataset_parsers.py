@@ -176,3 +176,21 @@ def test_synthetic_fallbacks_still_serve():
     assert len(VOC2012(mode="valid")) == 8
     ds = Conll05st(seq_len=12, synthetic_size=5)
     assert len(ds) == 5 and ds[0][0].shape == (12,)
+
+
+def test_flowers_archive_threaded_and_picklable(flowers_fixture):
+    """Tar access must survive DataLoader workers: concurrent reads
+    (thread pool) and pickling (process pool)."""
+    import pickle
+    from concurrent.futures import ThreadPoolExecutor
+
+    from paddle_tpu.vision.datasets import Flowers
+
+    data, labels, setid = flowers_fixture
+    ds = Flowers(data_file=data, label_file=labels, setid_file=setid,
+                 mode="train")
+    with ThreadPoolExecutor(4) as ex:
+        out = list(ex.map(lambda i: ds[i % len(ds)][0].shape, range(32)))
+    assert all(s == (32, 32, 3) for s in out)
+    ds2 = pickle.loads(pickle.dumps(ds))
+    assert ds2[0][0].shape == (32, 32, 3)

@@ -162,3 +162,19 @@ def test_host_embedding_under_data_parallel_mesh():
              rng.integers(0, CLASSES, (16,)).astype(np.int64))
     losses = [float(step(batch)) for _ in range(4)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_host_embedding_survives_bf16_cast():
+    """model.to(bfloat16) casts the anchor param; the lookup's custom
+    vjp must return a matching-dtype cotangent (the bf16 recipe every
+    TPU bench uses)."""
+    pt.seed(0)
+    model = _Cls(HostEmbedding(VOCAB, DIM, lr=0.1, seed=3))
+    model.to(dtype="bfloat16")
+    step = TrainStep(model, optim.SGD(learning_rate=0.1),
+                     lambda m, b: m(b[0], labels=b[1]))
+    batch = _batches(n=1, seed=11)[0]
+    l0 = float(step(batch))
+    jax.effects_barrier()
+    l1 = float(step(batch))
+    assert np.isfinite(l0) and np.isfinite(l1)

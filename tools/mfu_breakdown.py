@@ -1,0 +1,126 @@
+"""Where does the non-MXU time in the GPT bench go?
+
+Ablation-based attribution of the single-chip GPT-1.3B train step
+(bench.py's config): measure the full step, then variants with one
+component removed, on the same multi-step scan harness. The deltas
+attribute wall time to attention, the chunked-CE head, and everything
+else; "theory" is the 6N+attention FLOP model at peak.
+
+Writes PROFILE.json — the evidence behind "XLA fusion is enough"
+(r2 verdict weak #7: the 72% MFU claim needed a breakdown of the
+other 28%).
+
+Usage: python tools/mfu_breakdown.py [--out PROFILE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def step_time_ms(cfg, batch, seq, steps=8, windows=3):
+    """Median per-step wall time of the scanned multi-step train loop
+    (bench.py's harness)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    import paddle_tpu.optimizer as optim
+    from bench_all import _to_bf16_except_norms
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import GPTForCausalLM
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    _to_bf16_except_norms(model)
+    step = TrainStep(model, optim.AdamW(learning_rate=1e-4),
+                     lambda m, b: m(b[0], labels=b[1]))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    xs = jnp.asarray(np.broadcast_to(ids, (steps,) + ids.shape).copy())
+    float(step.multi_step((xs, xs))[-1])  # compile + warm
+    times = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        float(step.multi_step((xs, xs))[-1])
+        times.append((time.perf_counter() - t0) / steps * 1e3)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    return float(np.median(times)), n_params
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="PROFILE.json")
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--seq", type=int, default=2048)
+    args = ap.parse_args()
+
+    from bench import _detect_peak
+    from paddle_tpu.models import GPTConfig
+
+    def cfg(**kw):
+        base = dict(vocab_size=32768, hidden_size=2048, num_layers=24,
+                    num_heads=16, max_seq_len=2048, dropout=0.0,
+                    attn_dropout=0.0, dtype="bfloat16",
+                    loss_chunk_size=512)
+        base.update(kw)
+        return GPTConfig(**base)
+
+    b, s = args.batch, args.seq
+    full_ms, n_params = step_time_ms(cfg(), b, s)
+    # flash off: XLA-native attention instead of the Pallas kernel
+    xla_attn_ms, _ = step_time_ms(cfg(use_flash_attention=False), b, s)
+    # unchunked CE: full [B,S,V] logits materialize
+    unchunked_ms, _ = step_time_ms(cfg(loss_chunk_size=0), b, s)
+    # bigger CE chunks: fewer scan iterations over the head
+    chunk1024_ms, _ = step_time_ms(cfg(loss_chunk_size=1024), b, s)
+
+    peak = _detect_peak() * 1e12
+    tokens = b * s
+    flops_tok = 6.0 * n_params + 12.0 * 24 * 2048 * s
+    theory_ms = tokens * flops_tok / peak * 1e3
+    mfu = theory_ms / full_ms
+
+    report = {
+        "config": {"params_b": round(n_params / 1e9, 3), "batch": b,
+                   "seq": s, "vocab": 32768,
+                   "hardware": "TPU v5e 1 chip (tunneled)"},
+        "step_ms": {
+            "full (flash attn + chunked CE 512)": round(full_ms, 2),
+            "xla attention instead of Pallas flash":
+                round(xla_attn_ms, 2),
+            "unchunked CE (full logits)": round(unchunked_ms, 2),
+            "chunked CE 1024": round(chunk1024_ms, 2),
+        },
+        "attribution_ms": {
+            "theory (6N+attn FLOPs at peak)": round(theory_ms, 2),
+            "non-MXU overhead (full - theory)":
+                round(full_ms - theory_ms, 2),
+            "pallas flash vs xla attention":
+                round(xla_attn_ms - full_ms, 2),
+            "chunked-CE cost vs unchunked":
+                round(full_ms - unchunked_ms, 2),
+        },
+        "mfu_pct": round(100 * mfu, 2),
+        "reading": (
+            "positive 'pallas flash vs xla' = the Pallas kernel saves "
+            "that much per step (negative = XLA attention is faster); "
+            "positive 'chunked-CE cost' = chunking costs that much per "
+            "step (it buys memory headroom for long sequences)"),
+    }
+    print(json.dumps(report, indent=2))
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+
+
+if __name__ == "__main__":
+    main()
