@@ -4,15 +4,21 @@ The fused-attention hot op (reference analog: the CUDA fusion
 paddle/fluid/operators/fused/multihead_matmul_op.cu — rebuilt here as a
 proper online-softmax flash kernel instead of a translated fusion).
 
-Forward: grid (B, H, Sq/BQ); K/V stream through VMEM in BK-blocks with the
-running (max, sumexp, acc) update; logsumexp is saved for backward.
-Backward: FlashAttention-2 split — one kernel recomputes p-blocks to build
-dK/dV (grid over K blocks), another builds dQ (grid over Q blocks); both
-use the saved logsumexp and delta = rowsum(dO * O).
+Forward: grid (B, H, Sq/BQ, Sk/BK); the K/V blocks stream through the
+LAST grid axis while running (max, sumexp, acc) state lives in VMEM
+scratch — the output block is revisited across the K axis and written on
+its final step. Backward: FlashAttention-2 split — one kernel recomputes
+p-blocks to build dK/dV (K blocks outer, Q blocks streaming), another
+builds dQ (Q outer, K streaming); both use the saved logsumexp and
+delta = rowsum(dO * O).
 
-All matmuls run on the MXU in fp32 accumulation
-(preferred_element_type=float32); causal runs skip fully-masked K blocks
-via a dynamic fori_loop bound.
+Only BLOCKS ever sit in VMEM (the r3 fix: the previous design mapped the
+full [S, D] counterpart operand per (batch, head) into VMEM and
+fori_loop'ed over it, capping S*D at the ~16 MB scoped-vmem budget —
+S=8192 x D=128 failed to compile), so sequence length is bounded by HBM,
+not VMEM. All matmuls run on the MXU in fp32 accumulation
+(preferred_element_type=float32); causal runs skip fully-masked blocks
+via pl.when on the block indices.
 """
 
 from __future__ import annotations
@@ -37,83 +43,89 @@ DEFAULT_BLOCK_Q = int(_os.environ.get("PT_FLASH_BLOCK_Q", 512))
 DEFAULT_BLOCK_K = int(_os.environ.get("PT_FLASH_BLOCK_K", 512))
 _NEG_INF = -1e30
 
-# batch/head grid axes have no cross-iteration state -> Mosaic may run
-# them in any order / pipelined; the block axis carries nothing either
-# (each q- or k-block writes its own output slice) but keeps "arbitrary"
-# so revisiting-order guarantees hold for the full-array K/V blocks.
+# batch/head/outer-block grid axes carry no cross-iteration state ->
+# Mosaic may pipeline them; the LAST axis streams the counterpart blocks
+# through scratch accumulators and must run in order ("arbitrary").
 _GRID_SEMANTICS = pltpu.CompilerParams(
-    dimension_semantics=("parallel", "parallel", "arbitrary"))
+    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal,
-                block_q, block_k, sk):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, acc_ref, m_ref, l_run_ref,
+                *, scale, causal, block_q, block_k, nk):
     qb = pl.program_id(2)
-    # operands stay in the input dtype (bf16 on the MXU at full rate);
-    # all accumulation is f32 via preferred_element_type
-    q = q_ref[0, 0]  # [BQ, D]
-    nk = sk // block_k
-    if causal:
-        # highest K block any row of this Q block can see
-        nk_dyn = jnp.minimum(((qb + 1) * block_q + block_k - 1) // block_k,
-                             nk)
-    else:
-        nk_dyn = nk
+    kb = pl.program_id(3)
 
-    q_pos = qb * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_run_ref[...] = jnp.zeros_like(l_run_ref)
 
-    def body(kb, carry):
-        acc, m_run, l_run = carry
-        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+    # causal: K blocks fully above the diagonal contribute nothing
+    relevant = (kb * block_k <= (qb + 1) * block_q - 1) if causal else True
+
+    @pl.when(relevant)
+    def _step():
+        # operands stay in the input dtype (bf16 on the MXU at full
+        # rate); all accumulation is f32 via preferred_element_type
+        q = q_ref[0, 0]  # [BQ, D]
+        k_blk = k_ref[0, 0]  # [BK, D]
+        v_blk = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [BQ, BK] f32
         if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_blk = jnp.max(s, axis=1)
+        m_run = m_ref[:, :1]  # [BQ, 1]
+        l_run = l_run_ref[:, :1]
+        m_blk = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_run, m_blk)
-        p = jnp.exp(s - m_new[:, None])
+        p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_run - m_new)
-        l_new = l_run * alpha + jnp.sum(p, axis=1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        l_new = l_run * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_run_ref[...] = jnp.broadcast_to(l_new, l_run_ref.shape)
 
-    d = q.shape[-1]
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m_run, l_run = jax.lax.fori_loop(0, nk_dyn, body, (acc0, m0, l0))
-    denom = jnp.maximum(l_run, 1e-30)
-    o_ref[0, 0] = (acc / denom[:, None]).astype(o_ref.dtype)
-    # logsumexp per row, stored [BQ, 1] (lane-1 layout keeps the block
-    # spec legal on TPU: last dim equals the array dim)
-    l_ref[0, 0] = (m_run + jnp.log(denom))[:, None]
+    @pl.when(kb == nk - 1)
+    def _finish():
+        denom = jnp.maximum(l_run_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        # logsumexp per row, stored [BQ, 1] (lane-1 layout keeps the
+        # block spec legal on TPU: last dim equals the array dim)
+        l_ref[0, 0] = m_ref[:, :1] + jnp.log(denom)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_q, block_k, sk):
+                   acc_ref, *, scale, causal, block_q, block_k, nk):
     qb = pl.program_id(2)
-    q = q_ref[0, 0]
-    do = do_ref[0, 0]
-    lse = lse_ref[0, 0]  # [BQ, 1]
-    delta = delta_ref[0, 0]  # [BQ, 1]
-    nk = sk // block_k
-    nk_dyn = jnp.minimum(((qb + 1) * block_q + block_k - 1) // block_k, nk)\
-        if causal else nk
-    q_pos = qb * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    kb = pl.program_id(3)
 
-    def body(kb, dq):
-        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    relevant = (kb * block_k <= (qb + 1) * block_q - 1) if causal else True
+
+    @pl.when(relevant)
+    def _step():
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # [BQ, 1]
+        delta = delta_ref[0, 0]  # [BQ, 1]
+        k_blk = k_ref[0, 0]
+        v_blk = v_ref[0, 0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
@@ -121,86 +133,140 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, nk_dyn,
-                           body, jnp.zeros_like(q, jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    @pl.when(kb == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, block_k, sq):
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+                    *, scale, causal, block_q, block_k, nq):
     kb = pl.program_id(2)
-    k_blk = k_ref[0, 0]  # [BK, D]
-    v_blk = v_ref[0, 0]
-    nq = sq // block_q
-    start_qb = (kb * block_k) // block_q if causal else 0
-    k_pos = kb * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
+    qb = pl.program_id(3)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :]
-        do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q), :]  # [BQ, 1]
-        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q), :]
+    @pl.when(qb == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    # causal: Q blocks fully above the diagonal see none of this K block
+    relevant = ((qb + 1) * block_q - 1 >= kb * block_k) if causal else True
+
+    @pl.when(relevant)
+    def _step():
+        k_blk = k_ref[0, 0]  # [BK, D]
+        v_blk = v_ref[0, 0]
+        q = q_ref[0, 0]  # [BQ, D]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # [BQ, 1]
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse)  # [BQ, BK]
-        dv = dv + jax.lax.dot_general(
+        dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        dk = dk + jax.lax.dot_general(
+        dk_acc_ref[...] = dk_acc_ref[...] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
-    dk0 = jnp.zeros_like(k_blk, jnp.float32)
-    dv0 = jnp.zeros_like(v_blk, jnp.float32)
-    start = start_qb if causal else 0
-    dk, dv = jax.lax.fori_loop(start, nq, body, (dk0, dv0))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(qb == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _spec_q(block_q, d):
-    return pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0),
+def _spec_outer(block, d):
+    """Block indexed by the OUTER block axis (grid dim 2), constant over
+    the streaming axis (grid dim 3)."""
+    return pl.BlockSpec((1, 1, block, d), lambda b, h, i, j: (b, h, i, 0),
                         memory_space=pltpu.VMEM)
 
 
-def _spec_full(s, d):
-    return pl.BlockSpec((1, 1, s, d), lambda b, h, i: (b, h, 0, 0),
+def _spec_inner(block, d, clamp=None):
+    """Block streamed by the INNER grid axis (grid dim 3). ``clamp(i, j)``
+    maps the stream index per outer block — causal kernels clamp masked
+    steps to the last/first relevant block, so Pallas sees a repeated
+    block index and skips the HBM re-fetch for steps pl.when guards off.
+    """
+    if clamp is None:
+        return pl.BlockSpec((1, 1, block, d),
+                            lambda b, h, i, j: (b, h, j, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec((1, 1, block, d),
+                        lambda b, h, i, j: (b, h, clamp(i, j), 0),
                         memory_space=pltpu.VMEM)
+
+
+def _spec_lane1_outer(block):
+    return pl.BlockSpec((1, 1, block, 1),
+                        lambda b, h, i, j: (b, h, i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _spec_lane1_inner(block, clamp=None):
+    if clamp is None:
+        return pl.BlockSpec((1, 1, block, 1),
+                            lambda b, h, i, j: (b, h, j, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec((1, 1, block, 1),
+                        lambda b, h, i, j: (b, h, clamp(i, j), 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _kv_clamp(causal, block_q, block_k):
+    """For Q-outer kernels: the last K block visible to Q block i."""
+    if not causal:
+        return None
+    return lambda i, j: jnp.minimum(
+        j, ((i + 1) * block_q - 1) // block_k)
+
+
+def _q_clamp(causal, block_q, block_k):
+    """For K-outer kernels: the first Q block that sees K block i."""
+    if not causal:
+        return None
+    return lambda i, j: jnp.maximum(j, (i * block_k) // block_q)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    grid = (b, h, sq // block_q)
+    nk = sk // block_k
+    grid = (b, h, sq // block_q, nk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k, sk=sk)
+                               block_q=block_q, block_k=block_k, nk=nk)
+    kvc = _kv_clamp(causal, block_q, block_k)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[_spec_q(block_q, d), _spec_full(sk, d), _spec_full(sk, d)],
+        in_specs=[_spec_outer(block_q, d), _spec_inner(block_k, d, kvc),
+                  _spec_inner(block_k, d, kvc)],
         out_specs=[
-            _spec_q(block_q, d),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, i: (b_, h_, i, 0),
-                         memory_space=pltpu.VMEM),
+            _spec_outer(block_q, d),
+            _spec_lane1_outer(block_q),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * sq * sk * d,
@@ -214,54 +280,45 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    nq, nk = sq // block_q, sk // block_k
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [B,H,Sq,1]
 
+    # dQ: Q blocks outer (parallel), K/V blocks stream on the last axis
+    kvc = _kv_clamp(causal, block_q, block_k)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, sk=sk),
-        grid=(b, h, sq // block_q),
+                          block_q=block_q, block_k=block_k, nk=nk),
+        grid=(b, h, nq, nk),
         in_specs=[
-            _spec_q(block_q, d), _spec_full(sk, d), _spec_full(sk, d),
-            _spec_q(block_q, d),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, i: (b_, h_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, i: (b_, h_, i, 0),
-                         memory_space=pltpu.VMEM),
+            _spec_outer(block_q, d), _spec_inner(block_k, d, kvc),
+            _spec_inner(block_k, d, kvc), _spec_outer(block_q, d),
+            _spec_lane1_outer(block_q), _spec_lane1_outer(block_q),
         ],
-        out_specs=_spec_q(block_q, d),
+        out_specs=_spec_outer(block_q, d),
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_GRID_SEMANTICS,
     )(q, k, v, do, lse, delta)
 
+    # dK/dV: K blocks outer (parallel), Q/dO/lse/delta stream
+    qc = _q_clamp(causal, block_q, block_k)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, sq=sq),
-        grid=(b, h, sk // block_k),
+                          block_q=block_q, block_k=block_k, nq=nq),
+        grid=(b, h, nk, nq),
         in_specs=[
-            _spec_full(sq, d),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i: (b_, h_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i: (b_, h_, i, 0),
-                         memory_space=pltpu.VMEM),
-            _spec_full(sq, d),
-            pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, i: (b_, h_, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, i: (b_, h_, 0, 0),
-                         memory_space=pltpu.VMEM),
+            _spec_inner(block_q, d, qc), _spec_outer(block_k, d),
+            _spec_outer(block_k, d), _spec_inner(block_q, d, qc),
+            _spec_lane1_inner(block_q, qc), _spec_lane1_inner(block_q, qc),
         ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i: (b_, h_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i: (b_, h_, i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        out_specs=[_spec_outer(block_k, d), _spec_outer(block_k, d)],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
         ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
         compiler_params=_GRID_SEMANTICS,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
