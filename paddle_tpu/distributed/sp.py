@@ -46,14 +46,173 @@ def _block_attn(q, k, v, scale, causal_mask=None):
     return out.astype(jnp.float32), m_safe, l
 
 
+def merge_attention_blocks(acc, lse_run, out_b, lse_b):
+    """Fold one block's NORMALIZED attention result (out_b, lse_b) into
+    the running (acc f32 normalized, lse_run): the logsumexp merge
+    out = acc*e^(lse_run-lse') + out_b*e^(lse_b-lse'). A fully-masked
+    block is lse_b = -inf (weight 0). Shapes: out [..., D], lse [...]."""
+    lse_new = jnp.logaddexp(lse_run, lse_b)
+    # guard -inf - -inf (no mass seen yet anywhere)
+    w_run = jnp.where(jnp.isneginf(lse_new), 0.0,
+                      jnp.exp(lse_run - lse_new))
+    w_b = jnp.where(jnp.isneginf(lse_new), 0.0, jnp.exp(lse_b - lse_new))
+    acc = acc * w_run[..., None] + \
+        out_b.astype(jnp.float32) * w_b[..., None]
+    return acc, lse_new
+
+
+def _ring_case(kv_idx, idx):
+    """0 = fully visible hop, 1 = diagonal (local causal), 2 = masked."""
+    return jnp.where(kv_idx < idx, 0, jnp.where(kv_idx == idx, 1, 2))
+
+
+def _ring_flash_forward(q, k, v, axis_name, causal, scale):
+    """Returns (normalized acc f32, global lse) — the flash residuals."""
+    from ..ops.pallas.flash_attention import flash_attention_lse
+
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, _ = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(k_cur, v_cur, kv_idx):
+        def full(_):
+            return flash_attention_lse(q, k_cur, v_cur, causal=False,
+                                       scale=scale)
+
+        def diag(_):
+            # same global offset on both sides: local causal mask IS the
+            # global one
+            return flash_attention_lse(q, k_cur, v_cur, causal=True,
+                                       scale=scale)
+
+        def skip(_):
+            return (jnp.zeros(q.shape, q.dtype),
+                    jnp.full((b, s_loc, h), -jnp.inf, jnp.float32))
+
+        if not causal:
+            return full(None)
+        return jax.lax.switch(_ring_case(kv_idx, idx),
+                              [full, diag, skip], None)
+
+    def body(carry, _):
+        k_cur, v_cur, kv_idx, acc, lse_run = carry
+        out_b, lse_b = hop(k_cur, v_cur, kv_idx)
+        acc, lse_run = merge_attention_blocks(acc, lse_run, out_b, lse_b)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, (kv_idx - 1) % n, acc, lse_run), None
+
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full((b, s_loc, h), -jnp.inf, jnp.float32)
+    (_, _, _, acc, lse_run), _ = jax.lax.scan(
+        body, (k, v, idx, acc0, lse0), None, length=n)
+    return acc, lse_run
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention_flash(q, k, v, axis_name, causal, scale):
+    """Ring attention whose per-hop block attention is the Pallas flash
+    kernel: no [S_loc, S_loc] score tensor ever materializes, and the
+    custom vjp keeps backward residuals at O(S_local) — only
+    (q, k, v, out, global lse) are saved; the backward RE-ROTATES K/V
+    around the ring and runs the flash backward per hop with the global
+    lse (plain autodiff through the forward scan would have stored every
+    rotated K/V shard, O(S_global) per device, defeating the point).
+    dK/dV partials travel around the ring with their shard and arrive
+    home after the full rotation."""
+    acc, _ = _ring_flash_forward(q, k, v, axis_name, causal, scale)
+    return acc.astype(q.dtype)
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale):
+    acc, lse = _ring_flash_forward(q, k, v, axis_name, causal, scale)
+    out = acc.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, res, do):
+    from ..ops.pallas.flash_attention import (DEFAULT_BLOCK_K,
+                                              DEFAULT_BLOCK_Q, _flash_bwd,
+                                              _resolve_blocks)
+
+    q, k, v, out, lse = res
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_loc = q.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    bq, bk = _resolve_blocks(s_loc, s_loc, DEFAULT_BLOCK_Q,
+                             DEFAULT_BLOCK_K)
+    # bhsd layouts for the kernels; lse [B,H,S,1]
+    qT = jnp.swapaxes(q, 1, 2)
+    outT = jnp.swapaxes(out, 1, 2)
+    doT = jnp.swapaxes(do, 1, 2)
+    lseT = jnp.swapaxes(lse, 1, 2)[..., None]
+
+    def hop_bwd(k_cur, v_cur, kv_idx):
+        kT = jnp.swapaxes(k_cur, 1, 2)
+        vT = jnp.swapaxes(v_cur, 1, 2)
+
+        def run(is_causal):
+            def f(_):
+                return _flash_bwd(qT, kT, vT, outT, lseT, doT, scale,
+                                  is_causal, bq, bk)
+            return f
+
+        def skip(_):
+            return (jnp.zeros_like(qT), jnp.zeros_like(kT),
+                    jnp.zeros_like(vT))
+
+        if not causal:
+            return run(False)(None)
+        return jax.lax.switch(_ring_case(kv_idx, idx),
+                              [run(False), run(True), skip], None)
+
+    def body(carry, _):
+        k_cur, v_cur, dk_t, dv_t, kv_idx, dq_acc = carry
+        dq_p, dk_b, dv_b = hop_bwd(k_cur, v_cur, kv_idx)
+        dq_acc = dq_acc + jnp.swapaxes(dq_p, 1, 2).astype(jnp.float32)
+        dk_t = dk_t + jnp.swapaxes(dk_b, 1, 2).astype(jnp.float32)
+        dv_t = dv_t + jnp.swapaxes(dv_b, 1, 2).astype(jnp.float32)
+        # the dK/dV partial buffers travel WITH their K/V shard
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_t, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_t, axis_name, perm)
+        return (k_nxt, v_nxt, dk_nxt, dv_nxt, (kv_idx - 1) % n,
+                dq_acc), None
+
+    carry0 = (k, v, jnp.zeros(k.shape, jnp.float32),
+              jnp.zeros(v.shape, jnp.float32), idx,
+              jnp.zeros(q.shape, jnp.float32))
+    (_, _, dk_f, dv_f, _, dq_f), _ = jax.lax.scan(body, carry0, None,
+                                                  length=n)
+    return (dq_f.astype(q.dtype), dk_f.astype(k.dtype),
+            dv_f.astype(v.dtype))
+
+
+_ring_attention_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
 def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None):
     """Blockwise ring attention inside shard_map.
 
     q,k,v: [B, S_local, H, D] — the local sequence shard. Rotates K/V
     around ``axis_name`` with ppermute; one hop per step overlaps with the
-    block matmuls (XLA schedules the permute concurrently).
+    block matmuls (XLA schedules the permute concurrently). On TPU each
+    hop runs the Pallas flash kernel with a logsumexp block merge
+    (``use_flash=None`` auto-detects; the jnp online-softmax path remains
+    for CPU/unsupported shapes).
     """
+    if use_flash is None:
+        from ..ops.pallas.flash_attention import flash_attention_supported
+        use_flash = flash_attention_supported(q.shape, k.shape)
+    if use_flash:
+        scale_f = float(scale if scale is not None
+                        else 1.0 / np.sqrt(q.shape[-1]))
+        return _ring_attention_flash(q, k, v, axis_name, causal, scale_f)
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape

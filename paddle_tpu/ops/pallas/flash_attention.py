@@ -277,12 +277,18 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     return out, lse
 
 
-def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
+def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
+               g_lse=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nq, nk = sq // block_q, sk // block_k
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [B,H,Sq,1]
+    if g_lse is not None:
+        # lse cotangent folds into delta: d lse/d s_j = p_j, so the lse
+        # contribution to ds is p * g_lse — i.e. ds = p*(dp - (delta -
+        # g_lse)). No kernel change needed.
+        delta = delta - g_lse.astype(jnp.float32)
 
     # dQ: Q blocks outer (parallel), K/V blocks stream on the last axis
     kvc = _kv_clamp(causal, block_q, block_k)
@@ -325,24 +331,44 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_bhsd(q, k, v, scale, causal, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
-    return out
+def _flash_attention_bhsd_lse(q, k, v, scale, causal, block_q, block_k):
+    """(out, lse) with lse DIFFERENTIABLE — the building block for
+    blockwise/ring merging, where gradients flow through the logsumexp
+    merge weights as well as the block outputs."""
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
+def _flash_lse_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
     out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
-    return out, (q, k, v, out, lse)
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
+def _flash_lse_vjp_bwd(scale, causal, block_q, block_k, res, g):
     q, k, v, out, lse = res
-    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q,
-                            block_k)
+    g_out, g_lse = g
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g_out, scale, causal,
+                            block_q, block_k, g_lse=g_lse)
     return dq, dk, dv
 
 
-_flash_attention_bhsd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash_attention_bhsd_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def flash_attention_lse(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None,
+                        block_q: int = DEFAULT_BLOCK_Q,
+                        block_k: int = DEFAULT_BLOCK_K):
+    """flash_attention that also returns the per-row logsumexp
+    ([B, S, H] f32), both differentiable. Layout [B, S, H, D]."""
+    b, sq, h, d = q.shape
+    block_q, block_k = _resolve_blocks(sq, k.shape[1], block_q, block_k)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    qT = jnp.swapaxes(q, 1, 2)
+    kT = jnp.swapaxes(k, 1, 2)
+    vT = jnp.swapaxes(v, 1, 2)
+    out, lse = _flash_attention_bhsd_lse(qT, kT, vT, float(scale),
+                                         bool(causal), block_q, block_k)
+    return jnp.swapaxes(out, 1, 2), jnp.swapaxes(lse[..., 0], 1, 2)
 
 
 def _resolve_blocks(sq, sk, block_q, block_k):
@@ -380,13 +406,9 @@ def flash_attention(q, k, v, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K):
     """Public entry, layout [B, S, H, D] (matching
-    scaled_dot_product_attention)."""
-    b, sq, h, d = q.shape
-    block_q, block_k = _resolve_blocks(sq, k.shape[1], block_q, block_k)
-    scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    qT = jnp.swapaxes(q, 1, 2)
-    kT = jnp.swapaxes(k, 1, 2)
-    vT = jnp.swapaxes(v, 1, 2)
-    out = _flash_attention_bhsd(qT, kT, vT, float(scale), bool(causal),
-                                block_q, block_k)
-    return jnp.swapaxes(out, 1, 2)
+    scaled_dot_product_attention). One vjp stack for both entries:
+    this is flash_attention_lse with the lse dropped (its unused
+    cotangent arrives as zeros, so delta is unchanged)."""
+    out, _ = flash_attention_lse(q, k, v, causal=causal, scale=scale,
+                                 block_q=block_q, block_k=block_k)
+    return out

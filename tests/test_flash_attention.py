@@ -149,3 +149,84 @@ def test_flash_multiblock_matches_reference(causal):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                    rtol=5e-3, atol=5e-3,
                                    err_msg=f"d{name} mismatch")
+
+
+def test_flash_lse_block_merge_matches_dense():
+    """flash_attention_lse + merge_attention_blocks over a K/V split
+    equals one dense attention — the ring-attention hop contract —
+    including gradients THROUGH the differentiable lse. (The causal
+    schedule is covered by test_ring_flash_matches_dense.)"""
+    from paddle_tpu.distributed.sp import merge_attention_blocks
+
+    b, s, h, d = 1, 512, 2, 64
+    q, k, v = _rand(b, s, h, d, seed=7)
+    qj, kj, vj = map(jnp.asarray, (q, k, v))
+    nblk = 4
+    blk = s // nblk
+
+    def merged(q_, k_, v_):
+        acc = jnp.zeros(q_.shape, jnp.float32)
+        lse = jnp.full((b, s, h), -jnp.inf, jnp.float32)
+        for i in range(nblk):
+            kb = k_[:, i * blk:(i + 1) * blk]
+            vb = v_[:, i * blk:(i + 1) * blk]
+            ob, lb = fa.flash_attention_lse(q_, kb, vb, causal=False)
+            acc, lse = merge_attention_blocks(acc, lse, ob, lb)
+        return acc.astype(q_.dtype)
+
+    out = merged(qj, kj, vj)
+    ref = scaled_dot_product_attention(qj, kj, vj, is_causal=False,
+                                       use_flash=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+    g_m = jax.grad(lambda a, b_, c: jnp.sum(merged(a, b_, c) ** 2),
+                   argnums=(0, 1, 2))(qj, kj, vj)
+    g_r = jax.grad(lambda a, b_, c: jnp.sum(scaled_dot_product_attention(
+        a, b_, c, is_causal=False, use_flash=False) ** 2),
+        argnums=(0, 1, 2))(qj, kj, vj)
+    for gm, gr, name in zip(g_m, g_r, "qkv"):
+        np.testing.assert_allclose(np.asarray(gm), np.asarray(gr),
+                                   rtol=5e-3, atol=5e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_dense(causal):
+    """Ring attention with the flash hop (use_flash=True) over a 4-way
+    sequence shard matches dense attention, fwd and grads."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.distributed.sp import ring_attention
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+    b, s, h, d = 1, 512, 2, 64
+    q, k, v = _rand(b, s, h, d, seed=9)
+    qj, kj, vj = map(jnp.asarray, (q, k, v))
+    spec = P(None, "sep")
+
+    def ring(q_, k_, v_):
+        return shard_map(
+            lambda a, b_, c: ring_attention(a, b_, c, causal=causal,
+                                            use_flash=True),
+            mesh=mesh, in_specs=spec, out_specs=spec,
+            check_vma=False)(q_, k_, v_)
+
+    out = ring(qj, kj, vj)
+    ref = scaled_dot_product_attention(qj, kj, vj, is_causal=causal,
+                                       use_flash=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+    g_m = jax.grad(lambda a, b_, c: jnp.sum(ring(a, b_, c) ** 2),
+                   argnums=(0, 1, 2))(qj, kj, vj)
+    g_r = jax.grad(lambda a, b_, c: jnp.sum(scaled_dot_product_attention(
+        a, b_, c, is_causal=causal, use_flash=False) ** 2),
+        argnums=(0, 1, 2))(qj, kj, vj)
+    for gm, gr, name in zip(g_m, g_r, "qkv"):
+        np.testing.assert_allclose(np.asarray(gm), np.asarray(gr),
+                                   rtol=5e-3, atol=5e-3,
+                                   err_msg=f"d{name} mismatch")
