@@ -95,18 +95,23 @@ def _ring_flash_forward(q, k, v, axis_name, causal, scale):
         return jax.lax.switch(_ring_case(kv_idx, idx),
                               [full, diag, skip], None)
 
-    def body(carry, _):
+    def body(carry, t):
         k_cur, v_cur, kv_idx, acc, lse_run = carry
         out_b, lse_b = hop(k_cur, v_cur, kv_idx)
         acc, lse_run = merge_attention_blocks(acc, lse_run, out_b, lse_b)
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        # the final hop's rotation feeds nobody: skip its comm volume
+        # (t is uniform across devices, so the cond's collectives agree)
+        k_nxt, v_nxt = jax.lax.cond(
+            t < n - 1,
+            lambda kv: (jax.lax.ppermute(kv[0], axis_name, perm),
+                        jax.lax.ppermute(kv[1], axis_name, perm)),
+            lambda kv: kv, (k_cur, v_cur))
         return (k_nxt, v_nxt, (kv_idx - 1) % n, acc, lse_run), None
 
     acc0 = jnp.zeros(q.shape, jnp.float32)
     lse0 = jnp.full((b, s_loc, h), -jnp.inf, jnp.float32)
     (_, _, _, acc, lse_run), _ = jax.lax.scan(
-        body, (k, v, idx, acc0, lse0), None, length=n)
+        body, (k, v, idx, acc0, lse0), jnp.arange(n))
     return acc, lse_run
 
 
@@ -148,6 +153,9 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, res, do):
     outT = jnp.swapaxes(out, 1, 2)
     doT = jnp.swapaxes(do, 1, 2)
     lseT = jnp.swapaxes(lse, 1, 2)[..., None]
+    # delta is hop-invariant: compute it once, not n times in the scan
+    deltaT = jnp.sum(doT.astype(jnp.float32) * outT.astype(jnp.float32),
+                     axis=-1, keepdims=True)
 
     def hop_bwd(k_cur, v_cur, kv_idx):
         kT = jnp.swapaxes(k_cur, 1, 2)
@@ -156,7 +164,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, res, do):
         def run(is_causal):
             def f(_):
                 return _flash_bwd(qT, kT, vT, outT, lseT, doT, scale,
-                                  is_causal, bq, bk)
+                                  is_causal, bq, bk, delta=deltaT)
             return f
 
         def skip(_):
@@ -168,25 +176,32 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, res, do):
         return jax.lax.switch(_ring_case(kv_idx, idx),
                               [run(False), run(True), skip], None)
 
-    def body(carry, _):
+    def body(carry, t):
         k_cur, v_cur, dk_t, dv_t, kv_idx, dq_acc = carry
         dq_p, dk_b, dv_b = hop_bwd(k_cur, v_cur, kv_idx)
         dq_acc = dq_acc + jnp.swapaxes(dq_p, 1, 2).astype(jnp.float32)
         dk_t = dk_t + jnp.swapaxes(dk_b, 1, 2).astype(jnp.float32)
         dv_t = dv_t + jnp.swapaxes(dv_b, 1, 2).astype(jnp.float32)
-        # the dK/dV partial buffers travel WITH their K/V shard
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        # the dK/dV partial buffers travel WITH their K/V shard and need
+        # the FULL n rotations to arrive home (device i holds shard
+        # (i - t) mod n; only after the n-th hop is every shard back at
+        # its owner). The K/V operands themselves are done after the
+        # last hop, so their final rotation is skipped.
         dk_nxt = jax.lax.ppermute(dk_t, axis_name, perm)
         dv_nxt = jax.lax.ppermute(dv_t, axis_name, perm)
+        k_nxt, v_nxt = jax.lax.cond(
+            t < n - 1,
+            lambda kv: (jax.lax.ppermute(kv[0], axis_name, perm),
+                        jax.lax.ppermute(kv[1], axis_name, perm)),
+            lambda kv: kv, (k_cur, v_cur))
         return (k_nxt, v_nxt, dk_nxt, dv_nxt, (kv_idx - 1) % n,
                 dq_acc), None
 
     carry0 = (k, v, jnp.zeros(k.shape, jnp.float32),
               jnp.zeros(v.shape, jnp.float32), idx,
               jnp.zeros(q.shape, jnp.float32))
-    (_, _, dk_f, dv_f, _, dq_f), _ = jax.lax.scan(body, carry0, None,
-                                                  length=n)
+    (_, _, dk_f, dv_f, _, dq_f), _ = jax.lax.scan(body, carry0,
+                                                  jnp.arange(n))
     return (dq_f.astype(q.dtype), dk_f.astype(k.dtype),
             dv_f.astype(v.dtype))
 
@@ -225,7 +240,7 @@ def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
         k_pos = kv_index * s_loc + jnp.arange(s_loc)
         return (q_pos[:, None] >= k_pos[None, :])[None, None]  # [1,1,Sq,Sk]
 
-    def body(carry, _):
+    def body(carry, t):
         k_cur, v_cur, kv_idx, acc, m_run, l_run = carry
         mask = causal_mask_for(kv_idx) if causal else None
         out_b, m_b, l_b = _block_attn(q, k_cur, v_cur, scale, mask)
@@ -236,9 +251,13 @@ def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
         l_new = l_run * alpha + l_b * beta
         acc = acc * alpha.transpose(0, 2, 1)[..., None] + \
             out_b * beta.transpose(0, 2, 1)[..., None]
-        # rotate kv to the next device
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        # rotate kv to the next device (the final hop's rotation feeds
+        # nobody; t is uniform so the cond's collectives agree)
+        k_nxt, v_nxt = jax.lax.cond(
+            t < n - 1,
+            lambda kv: (jax.lax.ppermute(kv[0], axis_name, perm),
+                        jax.lax.ppermute(kv[1], axis_name, perm)),
+            lambda kv: kv, (k_cur, v_cur))
         kv_nxt = (kv_idx - 1) % n
         return (k_nxt, v_nxt, kv_nxt, acc, m_new, l_new), None
 
@@ -247,7 +266,7 @@ def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
     l0 = jnp.zeros((b, h, s_loc), jnp.float32)
     carry0 = (k, v, idx, acc0, m0, l0)
     (kf, vf, _, acc, m_run, l_run), _ = jax.lax.scan(
-        body, carry0, None, length=n)
+        body, carry0, jnp.arange(n))
     denom = jnp.maximum(l_run, 1e-20).transpose(0, 2, 1)[..., None]
     return (acc / denom).astype(q.dtype)
 

@@ -278,12 +278,13 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 
 
 def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
-               g_lse=None):
+               g_lse=None, delta=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nq, nk = sq // block_q, sk // block_k
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # [B,H,Sq,1]
+    if delta is None:
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)  # [B,H,Sq,1]
     if g_lse is not None:
         # lse cotangent folds into delta: d lse/d s_j = p_j, so the lse
         # contribution to ds is p * g_lse — i.e. ds = p*(dp - (delta -
