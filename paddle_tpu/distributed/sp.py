@@ -7,8 +7,13 @@ mesh axis ("sep"):
 
 - ring_attention: K/V blocks rotate around the ring via
   lax.ppermute while each device holds its Q shard; online-softmax
-  (flash-style) accumulation keeps memory O(seq/N). Causal masking skips
-  no work but stays correct across blocks.
+  (flash-style) accumulation keeps memory O(seq/N). On TPU each hop is
+  the Pallas flash kernel with an O(S_local) custom-vjp backward.
+  Causal scheduling note: the lockstep ring leaves ~2x on the table for
+  causal runs (each scan step waits for whichever device drew a
+  fully-visible hop); a zigzag shard layout (half-shards from opposite
+  sequence ends per device) balances it and is the next optimization if
+  causal ring steps dominate a profile.
 - ulysses_attention: all_to_all exchanges seq-shards for head-shards so
   each device runs full-sequence attention on a head subset, then
   exchanges back (DeepSpeed-Ulysses pattern on the alltoall primitive).
