@@ -34,13 +34,18 @@ def _peak_flops() -> float:
     return _detect_peak() * 1e12
 
 
+# parameter-name tokens that stay fp32 under the bf16 recipe (norm
+# statistics); shared with tools/scale_proof.py's abstract variant
+BF16_KEEP_TOKENS = ("bn", "norm", "ln_")
+
+
 def _to_bf16_except_norms(model):
     """bf16 weights with fp32 norm params/buffers (the GPT bench recipe:
     MXU runs bf16; layernorm/batchnorm statistics stay fp32)."""
     import jax.numpy as jnp
     model.to(dtype="bfloat16")
     for name, p in model.named_parameters():
-        if any(t in name for t in ("bn", "norm", "ln_")):
+        if any(t in name for t in BF16_KEEP_TOKENS):
             p.value = p.value.astype(jnp.float32)
     for name, b in model.named_buffers():
         if b is not None and hasattr(b, "value") and \

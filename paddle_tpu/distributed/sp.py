@@ -373,13 +373,23 @@ def sequence_parallel_attention(q, k, v, mode: str = "ring",
             manual = set()
         if manual:
             # already inside a manual region (the pipeline's shard_map
-            # over "pp"): nest a partial-manual shard_map over sep (+mp)
-            # on the CONTEXT abstract mesh (pp stays manual outside),
-            # leaving dp/sharding to GSPMD inside the stage
-            names = {axis_name} | ({"mp"} if mp > 1 else set())
+            # over "pp"): nest a partial-manual shard_map over sep (+mp,
+            # + the batch axes) on the CONTEXT abstract mesh (pp stays
+            # manual outside). The batch axes join the manual set because
+            # a Pallas (flash) hop requires every mesh axis around it to
+            # be manual — attention is purely data-parallel in batch, so
+            # the split is semantically free.
+            amesh = jax.sharding.get_abstract_mesh()
+            # manual over EVERY remaining axis (degree-1 ones are free):
+            # Mosaic refuses to lower a Pallas call inside any auto-axis
+            # context. The batch dim stays OUT of the specs (replicated
+            # along dp/sharding in the manual region): marking an axis
+            # manual does not require splitting data over it, and a
+            # batch split would add a new divisibility precondition on
+            # the per-stage microbatch.
+            names = set(amesh.axis_names) - set(amesh.manual_axes)
             spec = P(None, axis_name, head_axis)
-            return shard_map(sharded,
-                             mesh=jax.sharding.get_abstract_mesh(),
+            return shard_map(sharded, mesh=amesh,
                              in_specs=spec, out_specs=spec,
                              check_vma=False,
                              axis_names=frozenset(names))(q, k, v)

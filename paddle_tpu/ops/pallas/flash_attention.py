@@ -392,7 +392,10 @@ def flash_attention_supported(q_shape, k_shape, backend: Optional[str] =
                               block_k=DEFAULT_BLOCK_K) -> bool:
     if backend is None:
         backend = jax.default_backend()
-    if backend not in ("tpu", "axon"):
+    if backend not in ("tpu", "axon") and \
+            _os.environ.get("PT_FLASH_FORCE", "0") != "1":
+        # PT_FLASH_FORCE=1: AOT compiles for a TPU topology run on CPU
+        # hosts, where default_backend() lies about the TARGET
         return False
     b, sq, h, d = q_shape
     sk = k_shape[1]

@@ -84,3 +84,26 @@ def test_abstract_pipeline_lower_tiny():
     compiled = lowered.compile()
     mem = compiled.memory_analysis()
     assert int(mem.temp_size_in_bytes) > 0
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _tpu_plugin_available(),
+                    reason="libtpu compile-only plugin unavailable")
+def test_10b_longctx_v4_64_aot_fits():
+    """Long-context at scale: the 10B model at S=32768 with ring-flash
+    sequence parallelism (sep=8) x mp x pp AOT-compiles for v4-64 and
+    fits per-core HBM (SCALE_PROOF_LONGCTX.json)."""
+    from scale_proof import run_longctx_proof
+
+    report = run_longctx_proof()
+    assert report["n_devices"] == 64
+    assert report["model"]["seq_len"] == 32768
+    assert report["fits"], report["per_device_gib"]
+
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "SCALE_PROOF_LONGCTX.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            committed = json.load(f)
+        assert committed["fits"] and committed["degrees"] == \
+            report["degrees"]

@@ -37,6 +37,21 @@ GIB = 1024 ** 3
 V4_HBM_PER_CORE = 16 * GIB
 
 
+def _mem_bytes(compiled):
+    """Per-device byte accounting from the compiled memory analysis.
+    Donated params+slots alias their outputs; live bytes per device are
+    arguments (params/slots/batch) + temps + non-aliased outputs +
+    code. ONE definition — both proofs must agree on "fits"."""
+    mem = compiled.memory_analysis()
+    arg_b = int(mem.argument_size_in_bytes)
+    out_b = int(mem.output_size_in_bytes)
+    temp_b = int(mem.temp_size_in_bytes)
+    alias_b = int(mem.alias_size_in_bytes)
+    code_b = int(mem.generated_code_size_in_bytes)
+    live = arg_b + temp_b + max(0, out_b - alias_b) + code_b
+    return arg_b, out_b, temp_b, alias_b, code_b, live
+
+
 def build_step(mp: int, pp: int, sharding: int, n_micro: int,
                devices, schedule: str = "1f1b"):
     """Abstract 10B hybrid train step over the given devices."""
@@ -84,15 +99,7 @@ def run_proof(topology_name: str = "v4:2x4x4", mp: int = 8, pp: int = 4,
     compiled = lowered.compile()
     t_compile = time.time() - t0
 
-    mem = compiled.memory_analysis()
-    arg_b = int(mem.argument_size_in_bytes)
-    out_b = int(mem.output_size_in_bytes)
-    temp_b = int(mem.temp_size_in_bytes)
-    alias_b = int(mem.alias_size_in_bytes)
-    code_b = int(mem.generated_code_size_in_bytes)
-    # donated params+slots alias their outputs; live bytes per device are
-    # arguments (params/slots/batch) + temps + non-aliased outputs + code
-    live = arg_b + temp_b + max(0, out_b - alias_b) + code_b
+    arg_b, out_b, temp_b, alias_b, code_b, live = _mem_bytes(compiled)
 
     # The chosen shardings ARE the input placements (GSPMD honors them):
     # record the per-group PartitionSpecs that were assigned.
@@ -140,6 +147,97 @@ def run_proof(topology_name: str = "v4:2x4x4", mp: int = 8, pp: int = 4,
     return report
 
 
+def run_longctx_proof(topology_name: str = "v4:2x4x4", mp: int = 2,
+                      pp: int = 4, sep: int = 8, dp: int = 1,
+                      seq: int = 32768, n_micro: int = 2,
+                      budget_bytes: int = V4_HBM_PER_CORE) -> dict:
+    """Long-context at scale: the 10B model with ring-flash sequence
+    parallelism (sep) composed with mp x pp x dp in ONE v4-64 mesh,
+    S=32k, AOT-compiled with per-core HBM fit asserted. Ring hops run
+    the Pallas flash kernel (PT_FLASH_FORCE=1: the compile host is CPU
+    but the target is TPU) with the O(S_local) custom-vjp backward."""
+    import numpy as np
+    from jax.experimental import topologies
+
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.distributed.topology import (
+        HybridCommunicateGroup, set_hybrid_communicate_group)
+    from paddle_tpu.models.gpt import ernie_10b
+    from paddle_tpu.models.gpt_pipeline import GPTPipelineTrainStep
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name=topology_name)
+    n_dev = len(topo.devices)
+    assert n_dev == mp * pp * sep * dp, (n_dev, mp, pp, sep, dp)
+    hcg = HybridCommunicateGroup(
+        mp_degree=mp, pp_degree=pp, sep_degree=sep, dp_degree=dp,
+        devices=topo.devices)
+    set_hybrid_communicate_group(hcg)
+    cfg = ernie_10b(dropout=0.0, attn_dropout=0.0, dtype="bfloat16",
+                    loss_chunk_size=512, seq_parallel_mode="ring")
+    cfg.max_seq_len = seq
+    step = GPTPipelineTrainStep(
+        cfg, optim.AdamW(learning_rate=1e-4), pp=pp, n_micro=n_micro,
+        hcg=hcg, zero_axis="sep", schedule="1f1b", remat=True,
+        abstract=True)
+
+    # the bf16 deployment recipe (bench_all's recipe + bf16 Adam slots):
+    # abstract mode makes the cast a ShapeDtypeStruct remap
+    import jax
+    import jax.numpy as jnp
+
+    from bench_all import BF16_KEEP_TOKENS
+
+    def bf16_struct(name, v):
+        if any(t in name for t in BF16_KEEP_TOKENS) or \
+                v.dtype != jnp.float32:
+            return v
+        return jax.ShapeDtypeStruct(v.shape, jnp.bfloat16,
+                                    sharding=v.sharding)
+
+    step.stacked = {kk: bf16_struct(kk, vv)
+                    for kk, vv in step.stacked.items()}
+    step.shared = {kk: bf16_struct(kk, vv)
+                   for kk, vv in step.shared.items()}
+    step.opt_state = step._abstract_opt_init(
+        {"stacked": step.stacked, "shared": step.shared})
+    step._zero_shard_slots("sep")  # re-derivation reset the ZeRO specs
+    batch = dp * n_micro
+    t0 = time.time()
+    prev_force = os.environ.get("PT_FLASH_FORCE")
+    os.environ["PT_FLASH_FORCE"] = "1"  # target is TPU, host is CPU
+    try:
+        compiled = step.lower(batch, seq).compile()
+    finally:
+        if prev_force is None:
+            os.environ.pop("PT_FLASH_FORCE", None)
+        else:
+            os.environ["PT_FLASH_FORCE"] = prev_force
+    t_compile = time.time() - t0
+    arg_b, out_b, temp_b, alias_b, code_b, live = _mem_bytes(compiled)
+    n_params = sum(
+        int(np.prod(v.shape))
+        for v in {**step.stacked, **step.shared}.values())
+    return {
+        "topology": topology_name, "n_devices": n_dev,
+        "degrees": {"mp": mp, "pp": pp, "sep": sep, "dp": dp},
+        "model": {"params_b": round(n_params / 1e9, 3),
+                  "seq_len": seq, "seq_parallel": "ring (flash hops)",
+                  "precision": "bf16 params + bf16 Adam slots, fp32 "
+                               "norms (the bench deployment recipe)",
+                  "remat": True,
+                  "loss_chunk_size": cfg.loss_chunk_size},
+        "batch": {"global_batch": batch, "n_micro": n_micro,
+                  "tokens_per_step": batch * seq},
+        "compile_s": round(t_compile, 1),
+        "per_device_gib": {"arguments": round(arg_b / GIB, 3),
+                           "temps": round(temp_b / GIB, 3),
+                           "live_estimate": round(live / GIB, 3)},
+        "hbm_budget_gib": round(budget_bytes / GIB, 2),
+        "fits": bool(live <= budget_bytes),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="SCALE_PROOF.json")
@@ -151,7 +249,21 @@ def main():
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--n-micro", type=int, default=8)
     ap.add_argument("--schedule", default="1f1b")
+    ap.add_argument("--longctx", action="store_true",
+                    help="run the S=32k ring-flash sep x mp x pp proof "
+                         "instead")
     args = ap.parse_args()
+
+    if args.longctx:
+        if args.out == "SCALE_PROOF.json":  # don't clobber the base proof
+            args.out = "SCALE_PROOF_LONGCTX.json"
+        report = run_longctx_proof(args.topology)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(json.dumps(report, indent=2))
+        assert report["fits"], report["per_device_gib"]
+        return
 
     report = run_proof(args.topology, args.mp, args.pp, args.sharding,
                        args.batch, args.seq, args.n_micro,
