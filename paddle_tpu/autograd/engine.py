@@ -114,10 +114,21 @@ def enable_grad():
 
 
 class GradNode:
-    """One recorded op: pullback + wiring to input tensors."""
+    """One recorded op: pullback + wiring to input tensors.
+
+    ``fwd_fn`` (set by dispatch) is the closed-over pure forward whose
+    jax.vjp produced ``vjp_fn``; under ``create_graph=True`` the engine
+    re-dispatches the pullback as a differentiable kernel built from it,
+    so the backward pass is itself taped (reference double-grad:
+    python/paddle/fluid/dygraph/base.py:440 plus the *_grad_grad kernels
+    in mul_op.cc / conv_op.h / activation_op.cu / batch_norm_op.cc — here
+    second order falls out of vjp-of-vjp, no per-op double-grad kernels).
+    ``taped_vjp`` marks nodes (PyLayer) whose vjp_fn can run in Tensor
+    mode via ``vjp_fn(cots, taped=True)``.
+    """
 
     __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_tree",
-                 "out_tensors", "_cotangents")
+                 "out_tensors", "_cotangents", "fwd_fn", "taped_vjp")
 
     def __init__(self, name: str, vjp_fn: Callable,
                  inputs: Sequence["Any"], out_avals: List[Any],
@@ -129,12 +140,15 @@ class GradNode:
         self.out_tree = out_tree    # treedef of the kernel's output
         self.out_tensors: List[Any] = []  # weak-ish refs for hooks
         self._cotangents: Optional[List[Any]] = None
+        self.fwd_fn: Optional[Callable] = None
+        self.taped_vjp = False
 
     def add_cotangent(self, index: int, value) -> None:
         if self._cotangents is None:
             self._cotangents = [None] * len(self.out_avals)
         cur = self._cotangents[index]
-        self._cotangents[index] = value if cur is None else cur + value
+        self._cotangents[index] = value if cur is None \
+            else _taped_add(cur, value)
 
     def materialize_cotangents(self) -> List[Any]:
         cots = self._cotangents or [None] * len(self.out_avals)
@@ -147,6 +161,17 @@ class GradNode:
             else:
                 out.append(np.zeros(aval.shape, jax.dtypes.float0))
         return out
+
+
+def _taped_add(cur, value):
+    """Accumulate two cotangents. Under create_graph one side may be a
+    taped Tensor: keep the Tensor operand on the left so the add goes
+    through taped dispatch (a raw jax.Array.__add__ would coerce the
+    Tensor via __jax_array__ and silently drop its history)."""
+    from ..tensor import Tensor as _T
+    if not isinstance(cur, _T) and isinstance(value, _T):
+        cur, value = value, cur
+    return cur + value
 
 
 def _toposort(roots: List[GradNode]) -> List[GradNode]:
@@ -169,11 +194,14 @@ def _toposort(roots: List[GradNode]) -> List[GradNode]:
 
 
 def backward(tensors, grad_tensors=None, retain_graph: bool = False,
-             grad_sink: Optional[Dict[int, Any]] = None) -> None:
+             grad_sink: Optional[Dict[int, Any]] = None,
+             create_graph: bool = False) -> None:
     """Run reverse-mode accumulation from ``tensors``.
 
     Matches reference semantics: Tensor.backward() seeds with ones for
     scalar outputs (python/paddle/fluid/dygraph/varbase_patch_methods.py:169).
+    With ``create_graph=True`` every pullback is re-dispatched as a taped
+    op, so the produced gradients are themselves differentiable.
     """
     from ..tensor import Tensor  # local import to avoid cycle
 
@@ -188,7 +216,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
     def _deposit(t, g):
         if grad_sink is not None:
             cur = grad_sink.get(id(t))
-            grad_sink[id(t)] = g if cur is None else cur + g
+            grad_sink[id(t)] = g if cur is None else _taped_add(cur, g)
         else:
             t._accumulate_grad(g)
 
@@ -197,40 +225,77 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
         if t.grad_node is None:
             # Leaf with requires-grad: d t/d t = seed directly.
             if not t.stop_gradient:
-                seed = _seed_for(t, g)
+                seed = _seed_for(t, g, keep_tensor=create_graph)
                 _deposit(t, seed)
             continue
-        seed = _seed_for(t, g)
+        seed = _seed_for(t, g, keep_tensor=create_graph)
         t.grad_node.add_cotangent(t._out_index, seed)
         roots.append(t.grad_node)
 
     order = _toposort(roots)
-    for node in reversed(order):
-        cots = node.materialize_cotangents()
-        if node.out_tree is not None:
-            arg = jax.tree_util.tree_unflatten(node.out_tree, cots)
-        else:
-            arg = cots[0] if len(cots) == 1 else tuple(cots)
-        in_grads = node.vjp_fn(arg)
-        for t, g in zip(node.inputs, in_grads):
-            if t is None or g is None:
-                continue
-            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
-                continue
-            for hook in t._grad_hooks:
-                res = hook(g)
-                if res is not None:
-                    g = res
-            if t.grad_node is not None and not t.is_leaf:
-                t.grad_node.add_cotangent(t._out_index, g)
-                if t._retain_grads:
+    # create_graph builds the double-grad graph regardless of the
+    # enclosing grad mode (reference dygraph does too): the re-dispatched
+    # pullbacks must record even inside a no_grad() block.
+    grad_mode = enable_grad() if create_graph else contextlib.nullcontext()
+    with grad_mode:
+        for node in reversed(order):
+            cots = node.materialize_cotangents()
+            if node.out_tree is not None:
+                arg = jax.tree_util.tree_unflatten(node.out_tree, cots)
+            else:
+                arg = cots[0] if len(cots) == 1 else tuple(cots)
+            if create_graph:
+                in_grads = _taped_pullback(node, arg)
+            else:
+                in_grads = node.vjp_fn(arg)
+            for t, g in zip(node.inputs, in_grads):
+                if t is None or g is None:
+                    continue
+                if getattr(g, "dtype", None) == jax.dtypes.float0:
+                    continue
+                for hook in t._grad_hooks:
+                    res = hook(g)
+                    if res is not None:
+                        g = res
+                if t.grad_node is not None and not t.is_leaf:
+                    t.grad_node.add_cotangent(t._out_index, g)
+                    if t._retain_grads:
+                        _deposit(t, g)
+                elif not t.stop_gradient:
                     _deposit(t, g)
-            elif not t.stop_gradient:
-                _deposit(t, g)
-        node._cotangents = None
-        if not retain_graph:
-            node.vjp_fn = _used_up
-            node.inputs = []
+            node._cotangents = None
+            if not retain_graph:
+                node.vjp_fn = _used_up
+                node.fwd_fn = None
+                node.inputs = []
+
+
+def _taped_pullback(node: GradNode, cot_tree):
+    """Run ``node``'s pullback through eager dispatch so the backward
+    computation is recorded on the tape (double-grad support).
+
+    The dispatched kernel re-derives the pullback from the node's closed
+    forward: grads = vjp(fwd)(cot). jax differentiates vjp-of-vjp, so
+    second (and higher) order falls out without per-op grad-grad kernels
+    (reference ships those by hand: mul_op.cc MulDoubleGrad et al.)."""
+    from .. import dispatch
+
+    if node.fwd_fn is not None:
+        fwd = node.fwd_fn
+
+        def kernel(cot, *primals):
+            _, pullback = jax.vjp(fwd, *primals)
+            return pullback(cot)
+
+        return dispatch.call_fn(kernel, node.name + "_grad", True,
+                                (cot_tree, *node.inputs), {})
+    if node.taped_vjp:
+        return node.vjp_fn(cot_tree, taped=True)
+    if node.vjp_fn is _used_up:
+        _used_up()
+    raise RuntimeError(
+        f"create_graph=True cannot differentiate through op "
+        f"'{node.name}': its GradNode records no re-traceable forward")
 
 
 def _used_up(*_a, **_k):
@@ -239,31 +304,55 @@ def _used_up(*_a, **_k):
         "pass retain_graph=True if needed.")
 
 
-def _seed_for(t, g):
+def _seed_for(t, g, keep_tensor: bool = False):
     import jax.numpy as jnp
     if g is None:
         return jnp.ones(t.shape, dtype=t.dtype)
     from ..tensor import Tensor
-    return g.value if isinstance(g, Tensor) else jax.numpy.asarray(g)
+    if isinstance(g, Tensor):
+        # Under create_graph keep the seed taped: grad_outputs may carry
+        # its own history (chained higher-order graphs).
+        return g if keep_tensor else g.value
+    return jax.numpy.asarray(g)
 
 
-def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
-         create_graph=False, allow_unused=False):
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
     """Functional-style paddle.grad over the eager tape (reference:
-    imperative/partial_grad_engine.cc). Returns grads w.r.t. ``inputs``
-    without touching .grad fields."""
+    imperative/partial_grad_engine.cc; create_graph arg
+    python/paddle/fluid/dygraph/base.py:411,440). Returns grads w.r.t.
+    ``inputs`` without touching .grad fields. With ``create_graph=True``
+    the returned grads are taped and can be differentiated again."""
     from ..tensor import Tensor
+
+    if not only_inputs:
+        raise NotImplementedError(
+            "only_inputs=False is not supported (the reference dygraph "
+            "engine rejects it too, dygraph/base.py:548)")
+    if retain_graph is None:
+        retain_graph = create_graph
 
     single = isinstance(inputs, Tensor)
     inputs_list = [inputs] if single else list(inputs)
+    ng_list = []
+    if no_grad_vars is not None:
+        ng_list = ([no_grad_vars] if isinstance(no_grad_vars, Tensor)
+                   else list(no_grad_vars))
+    # Capture ALL original flags before any mutation: a tensor listed in
+    # both inputs and no_grad_vars must restore to its pre-call state no
+    # matter the restore order.
     saved = [(t._retain_grads, t.stop_gradient) for t in inputs_list]
+    ng_saved = [t.stop_gradient for t in ng_list]
     for t in inputs_list:
         t._retain_grads = True
         t.stop_gradient = False
+    for t in ng_list:
+        t.stop_gradient = True
     sink: Dict[int, Any] = {}
     try:
         backward(outputs, grad_outputs, retain_graph=retain_graph,
-                 grad_sink=sink)
+                 grad_sink=sink, create_graph=create_graph)
         results = []
         for t in inputs_list:
             g = sink.get(id(t))
@@ -272,10 +361,14 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
                     raise RuntimeError(
                         f"Input tensor {t.name or t} was not used in graph")
                 results.append(None)
+            elif isinstance(g, Tensor):
+                results.append(g)
             else:
-                results.append(Tensor(g, stop_gradient=True))
+                results.append(Tensor(g, stop_gradient=not create_graph))
     finally:
         for t, (r, sg) in zip(inputs_list, saved):
             t._retain_grads = r
+            t.stop_gradient = sg
+        for t, sg in zip(ng_list, ng_saved):
             t.stop_gradient = sg
     return results[0] if single else results

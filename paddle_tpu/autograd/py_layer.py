@@ -63,15 +63,23 @@ class PyLayer(metaclass=PyLayerMeta):
             avals = [jax.ShapeDtypeStruct(o.shape, o.dtype)
                      for o in out_list]
 
-            def vjp_fn(cotangents):
+            def vjp_fn(cotangents, taped=False):
                 cots = cotangents if isinstance(cotangents, tuple) else \
                     (cotangents,)
-                cots_t = [Tensor(c) for c in cots]
+                # taped (create_graph) mode: incoming cotangents may be
+                # Tensors carrying history — keep them so the user's
+                # backward (paddle ops) records onto the tape and the
+                # produced grads stay differentiable.
+                cots_t = [c if isinstance(c, Tensor) else Tensor(c)
+                          for c in cots]
                 grads = cls.backward(ctx, *cots_t)
                 if not isinstance(grads, (tuple, list)):
                     grads = (grads,)
-                raw = [g.value if isinstance(g, Tensor) else g
-                       for g in grads]
+                if taped:
+                    raw = list(grads)
+                else:
+                    raw = [g.value if isinstance(g, Tensor) else g
+                           for g in grads]
                 # align with diff inputs (paddle: one grad per fwd input)
                 if len(raw) > len(diff_inputs):
                     pos = [i for i, a in enumerate(args)
@@ -85,6 +93,7 @@ class PyLayer(metaclass=PyLayerMeta):
 
             node = GradNode(cls.__name__, vjp_fn, diff_inputs, avals,
                             out_tree=None)
+            node.taped_vjp = True  # backward() may run it in Tensor mode
             # out_tree None -> engine passes tuple(cots) for multi-output
             for i, o in enumerate(out_list):
                 o.stop_gradient = False

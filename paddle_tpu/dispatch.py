@@ -112,6 +112,11 @@ def call_fn(fn: Callable, name: str, differentiable: bool, args, kwargs):
                  for o in out_leaves]
         node = GradNode(name, vjp_fn, [leaves[i] for i in diff_idx], avals,
                         out_tree)
+        # create_graph support: the engine re-dispatches vjp(closed).
+        # Marginal retention is just the closure object — raw_leaves is
+        # already pinned by vjp_fn's residuals (constants in its jaxpr),
+        # and backward() clears fwd_fn alongside vjp_fn.
+        node.fwd_fn = closed
         out = _wrap_outputs(out_raw, node, name)
 
     if get_flag("check_nan_inf"):

@@ -351,7 +351,8 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """reference: fluid/backward.py:1958 paddle.static.gradients."""
     from ..autograd.engine import grad as _grad
     outs = _grad(targets, inputs, grad_outputs=target_gradients,
-                 allow_unused=True)
+                 allow_unused=True,
+                 no_grad_vars=list(no_grad_set) if no_grad_set else None)
     return outs
 
 
