@@ -4,9 +4,14 @@ Reference parity: python/paddle/distributed/fleet/elastic.py
 (ElasticManager:87 — etcd-registered ranks, membership watch, launcher
 restart on scale events, ELASTIC_EXIT_CODE=101 contract:25; recovery is
 checkpoint-based). This environment ships no etcd, so the registry is
-pluggable: a file-based store (shared filesystem — the common TPU-pod
-setup) with the same watch/restart semantics; an etcd store can be
-registered when the client library is present.
+pluggable:
+
+- TcpMembershipStore: a network registry served by
+  ``MembershipServer`` (a tiny threaded TCP service any rank — usually
+  the launcher on node 0 — can host). Cross-host with NO shared
+  filesystem, the direct etcd analog.
+- FileMembershipStore: shared filesystem (GCS-fuse/NFS on TPU pods).
+- An etcd store can be registered when the client library is present.
 """
 
 from __future__ import annotations
@@ -87,6 +92,139 @@ class FileMembershipStore(MembershipStore):
             if now - meta.get("ts", 0) <= self.ttl_s:
                 out[int(fn[5:-5])] = meta
         return out
+
+
+class MembershipServer:
+    """Threaded TCP registry: the etcd analog for cross-host elastic
+    membership (reference registers ranks in etcd, fleet/elastic.py:87).
+    Line protocol, one JSON object per request/response:
+
+        {"op": "reg", "job": j, "rank": r, "meta": {...}}
+        {"op": "hb"|"dereg", "job": j, "rank": r}
+        {"op": "members", "job": j} -> {"ok": true, "members": {...}}
+
+    Liveness is server-side: entries older than ``ttl_s`` are pruned on
+    read, so a killed rank disappears without deregistering."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 ttl_s: float = 30.0):
+        self.ttl_s = ttl_s
+        self._jobs: Dict[str, Dict[int, Dict]] = {}
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        with conn, conn.makefile("rwb") as f:
+            for line in f:
+                try:
+                    req = json.loads(line)
+                    resp = self._handle(req)
+                except (json.JSONDecodeError, KeyError, TypeError,
+                        ValueError) as e:
+                    resp = {"ok": False, "error": str(e)}
+                f.write(json.dumps(resp).encode() + b"\n")
+                f.flush()
+
+    def _handle(self, req: Dict) -> Dict:
+        op, job = req["op"], req["job"]
+        with self._lock:
+            ranks = self._jobs.setdefault(job, {})
+            if op == "reg":
+                meta = dict(req.get("meta") or {}, ts=time.time())
+                ranks[int(req["rank"])] = meta
+            elif op == "hb":
+                r = int(req["rank"])
+                now = time.time()
+                entry = ranks.get(r)
+                if entry is not None and \
+                        now - entry.get("ts", 0) <= self.ttl_s:
+                    entry["ts"] = now
+                elif entry is not None:
+                    # etcd lease semantics: an expired rank cannot be
+                    # resurrected by a late heartbeat (a stalled zombie
+                    # would mask the relaunched rank under the same
+                    # key) — it must re-register.
+                    ranks.pop(r, None)
+            elif op == "dereg":
+                ranks.pop(int(req["rank"]), None)
+            elif op == "members":
+                now = time.time()
+                dead = [r for r, m in ranks.items()
+                        if now - m.get("ts", 0) > self.ttl_s]
+                for r in dead:
+                    ranks.pop(r, None)
+                return {"ok": True, "members": dict(ranks)}
+            else:
+                return {"ok": False, "error": f"unknown op {op!r}"}
+        return {"ok": True}
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class TcpMembershipStore(MembershipStore):
+    """Client of MembershipServer — no shared filesystem required. One
+    short-lived connection per call keeps the client usable across
+    fork/exec (the elastic relaunch path)."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 5.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self.timeout_s = timeout_s
+
+    def _call(self, req: Dict) -> Dict:
+        with socket.create_connection(self.addr,
+                                      timeout=self.timeout_s) as s, \
+                s.makefile("rwb") as f:
+            f.write(json.dumps(req).encode() + b"\n")
+            f.flush()
+            line = f.readline()
+        if not line:
+            raise ConnectionError("membership server closed connection")
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"membership server error: {resp.get('error')}")
+        return resp
+
+    def register(self, job_id: str, rank: int, meta: Dict) -> None:
+        meta = dict(meta, host=socket.gethostname())
+        self._call({"op": "reg", "job": job_id, "rank": rank,
+                    "meta": meta})
+
+    def heartbeat(self, job_id: str, rank: int) -> None:
+        self._call({"op": "hb", "job": job_id, "rank": rank})
+
+    def deregister(self, job_id: str, rank: int) -> None:
+        try:
+            self._call({"op": "dereg", "job": job_id, "rank": rank})
+        except (ConnectionError, OSError):
+            pass  # best effort: the TTL prunes us anyway
+
+    def members(self, job_id: str) -> Dict[int, Dict]:
+        got = self._call({"op": "members", "job": job_id})["members"]
+        return {int(r): m for r, m in got.items()}
 
 
 class ElasticManager:

@@ -125,12 +125,20 @@ class HostEmbedding(Layer):
         self._coherence = coherence
 
         def host_pull(ids: np.ndarray) -> np.ndarray:
+            # READ-ONLY under jax.pure_callback's contract: XLA may
+            # elide, cache, or re-execute this callback, so it must be
+            # idempotent. Joining a finished thread is a no-op and the
+            # cache is only peeked (eviction happens in prefetch()); a
+            # replay between pushes returns identical rows. Do NOT wrap
+            # a HostEmbedding forward in jax.checkpoint/remat — a replay
+            # AFTER the backward's push would read post-update rows that
+            # diverge from the saved forward activations.
             key = np.asarray(ids).tobytes()
-            t = threads.pop(key, None)
+            t = threads.get(key)
             if t is not None:
                 t.join()
             with coherence:
-                hit = cache.pop(key, None)
+                hit = cache.get(key)
                 if hit is not None:
                     return hit[1]
                 return table_ref.pull(
@@ -182,6 +190,16 @@ class HostEmbedding(Layer):
         if key in self._cache or key in self._prefetch_threads:
             return
         dim = self._dim
+        # The pull path is read-only (pure_callback purity), so ALL
+        # eviction lives here: drop finished prefetch threads and bound
+        # the peek cache FIFO-style.
+        with self._coherence:
+            for k in list(self._prefetch_threads):
+                t_old = self._prefetch_threads[k]
+                if not t_old.is_alive():
+                    self._prefetch_threads.pop(k, None)
+            while len(self._cache) > 8:
+                self._cache.pop(next(iter(self._cache)))
 
         def work():
             with self._coherence:

@@ -9,11 +9,16 @@ mesh axis ("sep"):
   lax.ppermute while each device holds its Q shard; online-softmax
   (flash-style) accumulation keeps memory O(seq/N). On TPU each hop is
   the Pallas flash kernel with an O(S_local) custom-vjp backward.
-  Causal scheduling note: the lockstep ring leaves ~2x on the table for
-  causal runs (each scan step waits for whichever device drew a
-  fully-visible hop); a zigzag shard layout (half-shards from opposite
-  sequence ends per device) balances it and is the next optimization if
-  causal ring steps dominate a profile.
+- zigzag causal schedule: the lockstep contiguous ring leaves ~2x on
+  the table for causal runs (each scan step waits for whichever device
+  drew a fully-visible hop). With the sequence split into 2n half-chunks
+  and device i holding chunks (i, 2n-1-i), EVERY hop does exactly two
+  half-chunk-pairs of work: the local hop is plain local-causal flash,
+  a hop from an earlier device attends full-q x first-half-k, a hop
+  from a later device attends second-half-q x full-k. ``ring_attention
+  (layout="zigzag")`` implements it; ``zigzag_permutation`` gives the
+  global reorder (applied once at the model boundary by models.gpt when
+  seq_parallel_mode="zigzag").
 - ulysses_attention: all_to_all exchanges seq-shards for head-shards so
   each device runs full-sequence attention on a head subset, then
   exchanges back (DeepSpeed-Ulysses pattern on the alltoall primitive).
@@ -69,6 +74,32 @@ def merge_attention_blocks(acc, lse_run, out_b, lse_b):
 def _ring_case(kv_idx, idx):
     """0 = fully visible hop, 1 = diagonal (local causal), 2 = masked."""
     return jnp.where(kv_idx < idx, 0, jnp.where(kv_idx == idx, 1, 2))
+
+
+def zigzag_permutation(seq_len: int, n: int):
+    """(perm, inv) index arrays for the zigzag layout over ``n`` ring
+    devices: ``x[:, perm]`` puts the sequence in zigzag order (device i's
+    contiguous shard holds original half-chunks i and 2n-1-i);
+    ``x[:, inv]`` undoes it. n=1 is the identity."""
+    if seq_len % (2 * n):
+        raise ValueError(f"seq_len {seq_len} must divide 2*n ({2 * n})")
+    c = seq_len // (2 * n)
+    parts = []
+    for i in range(n):
+        parts.append(np.arange(i * c, (i + 1) * c))
+        j = 2 * n - 1 - i
+        parts.append(np.arange(j * c, (j + 1) * c))
+    perm = np.concatenate(parts)
+    inv = np.argsort(perm)
+    return perm, inv
+
+
+def zigzag_positions(idx, n: int, s_loc: int):
+    """Global sequence positions of a device's zigzag-local rows
+    (traced-friendly in the device index ``idx``)."""
+    c = s_loc // 2
+    r = jnp.arange(c)
+    return jnp.concatenate([idx * c + r, (2 * n - 1 - idx) * c + r])
 
 
 def _ring_flash_forward(q, k, v, axis_name, causal, scale):
@@ -214,9 +245,166 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, res, do):
 _ring_attention_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
+def _zigzag_ring_flash_forward(q, k, v, axis_name, scale):
+    """Causal ring forward over zigzag-laid-out shards: every hop costs
+    exactly two half-chunk-pairs, so the lockstep scan is balanced (the
+    contiguous layout's ~2x causal wait disappears)."""
+    from ..ops.pallas.flash_attention import flash_attention_lse
+
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, _ = q.shape
+    c = s_loc // 2
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(k_cur, v_cur, kv_idx):
+        def earlier(_):
+            # kv from an earlier device: its first half-chunk is fully
+            # visible to all local rows, its second fully masked.
+            out_b, lse_b = flash_attention_lse(
+                q, k_cur[:, :c], v_cur[:, :c], causal=False, scale=scale)
+            return out_b, lse_b
+
+        def local(_):
+            # zigzag-local causal IS plain local causal: qa•ka and qb•kb
+            # sit on the global diagonal, qb•ka is fully visible,
+            # qa•kb fully masked — exactly the row>=col local mask.
+            return flash_attention_lse(q, k_cur, v_cur, causal=True,
+                                       scale=scale)
+
+        def later(_):
+            # kv from a later device: only local second-half rows see it
+            # (both its half-chunks precede chunk 2n-1-idx).
+            out_b, lse_b = flash_attention_lse(
+                q[:, c:], k_cur, v_cur, causal=False, scale=scale)
+            return (jnp.concatenate(
+                        [jnp.zeros((b, c, h, q.shape[-1]), q.dtype),
+                         out_b], axis=1),
+                    jnp.concatenate(
+                        [jnp.full((b, c, h), -jnp.inf, jnp.float32),
+                         lse_b], axis=1))
+
+        return jax.lax.switch(_ring_case(kv_idx, idx),
+                              [earlier, local, later], None)
+
+    def body(carry, t):
+        k_cur, v_cur, kv_idx, acc, lse_run = carry
+        out_b, lse_b = hop(k_cur, v_cur, kv_idx)
+        acc, lse_run = merge_attention_blocks(acc, lse_run, out_b, lse_b)
+        k_nxt, v_nxt = jax.lax.cond(
+            t < n - 1,
+            lambda kv: (jax.lax.ppermute(kv[0], axis_name, perm),
+                        jax.lax.ppermute(kv[1], axis_name, perm)),
+            lambda kv: kv, (k_cur, v_cur))
+        return (k_nxt, v_nxt, (kv_idx - 1) % n, acc, lse_run), None
+
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full((b, s_loc, h), -jnp.inf, jnp.float32)
+    (_, _, _, acc, lse_run), _ = jax.lax.scan(
+        body, (k, v, idx, acc0, lse0), jnp.arange(n))
+    return acc, lse_run
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _zigzag_ring_attention_flash(q, k, v, axis_name, scale):
+    """Balanced causal ring attention (zigzag layout) on the Pallas
+    flash kernel; same O(S_local) residual contract as
+    _ring_attention_flash."""
+    acc, _ = _zigzag_ring_flash_forward(q, k, v, axis_name, scale)
+    return acc.astype(q.dtype)
+
+
+def _zigzag_flash_vjp_fwd(q, k, v, axis_name, scale):
+    acc, lse = _zigzag_ring_flash_forward(q, k, v, axis_name, scale)
+    out = acc.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _zigzag_flash_vjp_bwd(axis_name, scale, res, do):
+    from ..ops.pallas.flash_attention import (DEFAULT_BLOCK_K,
+                                              DEFAULT_BLOCK_Q, _flash_bwd,
+                                              _resolve_blocks)
+
+    q, k, v, out, lse = res
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_loc = q.shape[1]
+    c = s_loc // 2
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # bhsd layouts; lse [B,H,S,1]
+    qT = jnp.swapaxes(q, 1, 2)
+    outT = jnp.swapaxes(out, 1, 2)
+    doT = jnp.swapaxes(do, 1, 2)
+    lseT = jnp.swapaxes(lse, 1, 2)[..., None]
+    deltaT = jnp.sum(doT.astype(jnp.float32) * outT.astype(jnp.float32),
+                     axis=-1, keepdims=True)
+
+    def hop_bwd(k_cur, v_cur, kv_idx):
+        kT = jnp.swapaxes(k_cur, 1, 2)
+        vT = jnp.swapaxes(v_cur, 1, 2)
+
+        def earlier(_):
+            bq, bk = _resolve_blocks(s_loc, c, DEFAULT_BLOCK_Q,
+                                     DEFAULT_BLOCK_K)
+            dq_p, dk_h, dv_h = _flash_bwd(
+                qT, kT[:, :, :c], vT[:, :, :c], outT, lseT, doT, scale,
+                False, bq, bk, delta=deltaT)
+            return (dq_p,
+                    jnp.concatenate([dk_h, jnp.zeros_like(dk_h)], axis=2),
+                    jnp.concatenate([dv_h, jnp.zeros_like(dv_h)], axis=2))
+
+        def local(_):
+            bq, bk = _resolve_blocks(s_loc, s_loc, DEFAULT_BLOCK_Q,
+                                     DEFAULT_BLOCK_K)
+            return _flash_bwd(qT, kT, vT, outT, lseT, doT, scale, True,
+                              bq, bk, delta=deltaT)
+
+        def later(_):
+            bq, bk = _resolve_blocks(c, s_loc, DEFAULT_BLOCK_Q,
+                                     DEFAULT_BLOCK_K)
+            dq_h, dk_b, dv_b = _flash_bwd(
+                qT[:, :, c:], kT, vT, outT[:, :, c:], lseT[:, :, c:],
+                doT[:, :, c:], scale, False, bq, bk,
+                delta=deltaT[:, :, c:])
+            dq_p = jnp.concatenate([jnp.zeros_like(dq_h), dq_h], axis=2)
+            return dq_p, dk_b, dv_b
+
+        return jax.lax.switch(_ring_case(kv_idx, idx),
+                              [earlier, local, later], None)
+
+    def body(carry, t):
+        k_cur, v_cur, dk_t, dv_t, kv_idx, dq_acc = carry
+        dq_p, dk_b, dv_b = hop_bwd(k_cur, v_cur, kv_idx)
+        dq_acc = dq_acc + jnp.swapaxes(dq_p, 1, 2).astype(jnp.float32)
+        dk_t = dk_t + jnp.swapaxes(dk_b, 1, 2).astype(jnp.float32)
+        dv_t = dv_t + jnp.swapaxes(dv_b, 1, 2).astype(jnp.float32)
+        dk_nxt = jax.lax.ppermute(dk_t, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_t, axis_name, perm)
+        k_nxt, v_nxt = jax.lax.cond(
+            t < n - 1,
+            lambda kv: (jax.lax.ppermute(kv[0], axis_name, perm),
+                        jax.lax.ppermute(kv[1], axis_name, perm)),
+            lambda kv: kv, (k_cur, v_cur))
+        return (k_nxt, v_nxt, dk_nxt, dv_nxt, (kv_idx - 1) % n,
+                dq_acc), None
+
+    carry0 = (k, v, jnp.zeros(k.shape, jnp.float32),
+              jnp.zeros(v.shape, jnp.float32), idx,
+              jnp.zeros(q.shape, jnp.float32))
+    (_, _, dk_f, dv_f, _, dq_f), _ = jax.lax.scan(body, carry0,
+                                                  jnp.arange(n))
+    return (dq_f.astype(q.dtype), dk_f.astype(k.dtype),
+            dv_f.astype(v.dtype))
+
+
+_zigzag_ring_attention_flash.defvjp(_zigzag_flash_vjp_fwd,
+                                    _zigzag_flash_vjp_bwd)
+
+
 def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
                    scale: Optional[float] = None,
-                   use_flash: Optional[bool] = None):
+                   use_flash: Optional[bool] = None,
+                   layout: str = "contiguous"):
     """Blockwise ring attention inside shard_map.
 
     q,k,v: [B, S_local, H, D] — the local sequence shard. Rotates K/V
@@ -225,13 +413,23 @@ def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
     hop runs the Pallas flash kernel with a logsumexp block merge
     (``use_flash=None`` auto-detects; the jnp online-softmax path remains
     for CPU/unsupported shapes).
+
+    ``layout="zigzag"`` (causal only): shards are in the zigzag order of
+    ``zigzag_permutation`` — balanced causal schedule, every hop does
+    equal work.
     """
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring layout {layout!r}")
+    zigzag = layout == "zigzag" and causal
     if use_flash is None:
         from ..ops.pallas.flash_attention import flash_attention_supported
         use_flash = flash_attention_supported(q.shape, k.shape)
     if use_flash:
         scale_f = float(scale if scale is not None
                         else 1.0 / np.sqrt(q.shape[-1]))
+        if zigzag:
+            return _zigzag_ring_attention_flash(q, k, v, axis_name,
+                                                scale_f)
         return _ring_attention_flash(q, k, v, axis_name, causal, scale_f)
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -239,10 +437,15 @@ def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    q_pos = idx * s_loc + jnp.arange(s_loc)  # global positions of q rows
+    if zigzag:
+        q_pos = zigzag_positions(idx, n, s_loc)
+        pos_of = lambda kv_index: zigzag_positions(kv_index, n, s_loc)  # noqa: E731
+    else:
+        q_pos = idx * s_loc + jnp.arange(s_loc)  # global positions
+        pos_of = lambda kv_index: kv_index * s_loc + jnp.arange(s_loc)  # noqa: E731
 
     def causal_mask_for(kv_index):
-        k_pos = kv_index * s_loc + jnp.arange(s_loc)
+        k_pos = pos_of(kv_index)
         return (q_pos[:, None] >= k_pos[None, :])[None, None]  # [1,1,Sq,Sk]
 
     def body(carry, t):
@@ -307,6 +510,31 @@ def ulysses_attention(q, k, v, axis_name: str = "sep",
     return head_to_seq(out)
 
 
+def ring_schedule_work(n: int, layout: str = "contiguous"):
+    """Analytic causal-ring work profile: work[t][i] = half-chunk-pair
+    units device i computes at hop t (full shard-pair = 4 units, local
+    causal = 2, masked = 0; zigzag hops = 2 by construction). The
+    lockstep scan's step time is max over i per hop; summing the maxes
+    gives the schedule's critical path — the measurement behind the
+    contiguous layout's ~2x causal imbalance and the zigzag fix.
+    Mirrors the hop case structure of ring_attention exactly."""
+    work = []
+    for t in range(n):
+        row = []
+        for i in range(n):
+            kv = (i - t) % n
+            if layout == "zigzag":
+                row.append(2)
+            elif kv < i:
+                row.append(4)
+            elif kv == i:
+                row.append(2)
+            else:
+                row.append(0)
+        work.append(row)
+    return work
+
+
 def _axis_bound(axis_name: str) -> bool:
     try:
         jax.lax.axis_size(axis_name)
@@ -332,6 +560,9 @@ def sequence_parallel_attention(q, k, v, mode: str = "ring",
     if _axis_bound(axis_name):
         if mode == "ring":
             return ring_attention(q, k, v, axis_name, causal)
+        if mode == "zigzag":
+            return ring_attention(q, k, v, axis_name, causal,
+                                  layout="zigzag")
         return ulysses_attention(q, k, v, axis_name, causal)
 
     from .topology import get_hybrid_communicate_group
@@ -365,6 +596,12 @@ def sequence_parallel_attention(q, k, v, mode: str = "ring",
             # (mp-local) head shard against the sequence shard.
             if mode == "ring":
                 return ring_attention(qq, kk, vv, axis_name, causal)
+            if mode == "zigzag":
+                # the caller (models.gpt boundary permutation) already
+                # laid the sequence out in zigzag order, so contiguous
+                # sep-sharding hands each device its zigzag shard
+                return ring_attention(qq, kk, vv, axis_name, causal,
+                                      layout="zigzag")
             return ulysses_attention(qq, kk, vv, axis_name, causal)
 
         try:
@@ -400,5 +637,15 @@ def sequence_parallel_attention(q, k, v, mode: str = "ring",
                          out_specs=spec, check_vma=False)(q, k, v)
 
     from ..ops.nn_functional import scaled_dot_product_attention
+    if mode == "zigzag" and sep > 1:
+        # The caller (models.gpt) hands zigzag-ordered tensors whenever
+        # sep > 1; the dense fallback (eager path) must un-permute
+        # before masking causally and re-permute the result, or the
+        # row>=col mask would apply to reordered tokens.
+        perm, inv = zigzag_permutation(q.shape[1], sep)
+        out = scaled_dot_product_attention(
+            q[:, inv], k[:, inv], v[:, inv], is_causal=causal,
+            use_flash=False)
+        return out[:, perm]
     return scaled_dot_product_attention(q, k, v, is_causal=causal,
                                         use_flash=False)

@@ -26,6 +26,156 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 _HYBRID_AXES = ("pp", "dp", "sharding", "sep", "mp")
 
 
+def build_device_array(shape: Tuple[int, ...], devices=None,
+                       topology_aware: Optional[bool] = None):
+    """Topology-aware device placement for a mesh of ``shape``.
+
+    The reference hand-tunes NCCL ring order for its hybrid groups
+    (platform/nccl_helper.h:190, sharding_optimizer.py:968); the TPU
+    analog is laying mesh axes onto the physical ICI torus. A naive
+    ``reshape(jax.devices())`` keeps enumeration order, which on a real
+    torus (e.g. v4-64) can put the innermost (mp) axis on non-adjacent
+    chips. ``mesh_utils.create_device_mesh`` solves the assignment so
+    later axes land on the tightest physical loops; on multi-slice
+    deployments ``create_hybrid_device_mesh`` puts the leading axes
+    (pp/dp) on DCN and the rest on ICI.
+
+    Returns (device_array, assignment_tag) where the tag records which
+    strategy was used: "hybrid_dcn", "topology_aware", or
+    "enumeration_order" (explicit devices= / non-TPU fallback).
+
+    ``topology_aware`` overrides the default policy (None = solve the
+    assignment only when the caller did not fix an explicit device
+    order): True forces the solver on an explicit TPU device list (the
+    AOT scale proof passes compile-only topology devices), False forces
+    plain reshape.
+    """
+    import math
+
+    explicit = devices is not None
+    devices = list(devices if devices is not None else jax.devices())
+    need = int(np.prod(shape))
+    devices = devices[:need]
+    if topology_aware is None:
+        topology_aware = not explicit
+    if not topology_aware or devices[-1].platform != "tpu":
+        # Explicit order is the caller's contract; non-TPU (the virtual
+        # CPU test mesh) has no physical topology to exploit.
+        return np.asarray(devices).reshape(shape), "enumeration_order"
+
+    from jax.experimental import mesh_utils
+
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    n_slices = len(slice_ids)
+    if n_slices > 1:
+        # Factor the slice count onto the leading (outermost) axes —
+        # those are dp/pp in the hybrid order, whose collectives
+        # tolerate DCN latency; mp/sep stay intra-slice on ICI.
+        dcn = [1] * len(shape)
+        remaining = n_slices
+        for i, dim in enumerate(shape):
+            f = math.gcd(dim, remaining)
+            dcn[i] = f
+            remaining //= f
+            if remaining == 1:
+                break
+        if remaining == 1:
+            try:
+                arr = mesh_utils.create_hybrid_device_mesh(
+                    tuple(s // d for s, d in zip(shape, dcn)), tuple(dcn),
+                    devices=devices)
+                return arr, "hybrid_dcn"
+            except (ValueError, AssertionError, NotImplementedError):
+                pass
+    try:
+        arr = mesh_utils.create_device_mesh(shape, devices=devices)
+        return arr, "topology_aware"
+    except (ValueError, AssertionError, NotImplementedError):
+        pass
+    arr = _solve_per_core_mesh(shape, devices)
+    if arr is not None:
+        return arr, "topology_aware"
+    return np.asarray(devices).reshape(shape), "enumeration_order"
+
+
+def _solve_per_core_mesh(shape: Tuple[int, ...], devices):
+    """create_device_mesh refuses per-TensorCore v4+ device lists (it
+    wants megacore, one device per chip) — but compile-only topologies
+    (jax.experimental.topologies) expose 2 cores/chip. Solve the
+    assignment at CHIP level with one representative core per chip, then
+    expand each chip into its cores along the innermost axis, so sibling
+    cores are always mp-neighbors (hop 0) and the chip-level solve fixes
+    the ICI layout. Returns None when the structure doesn't apply."""
+    from collections import defaultdict
+
+    from jax.experimental import mesh_utils
+
+    by_chip = defaultdict(list)
+    for d in devices:
+        coords = getattr(d, "coords", None)
+        if coords is None:
+            return None
+        by_chip[tuple(coords)].append(d)
+    counts = {len(v) for v in by_chip.values()}
+    if len(counts) != 1:
+        return None
+    cpc = counts.pop()
+    if cpc == 1 or shape[-1] % cpc != 0:
+        return None
+    for chip in by_chip.values():
+        chip.sort(key=lambda d: getattr(d, "core_on_chip", d.id))
+    chip_shape = shape[:-1] + (shape[-1] // cpc,)
+    reps = [chip[0] for chip in by_chip.values()]
+    try:
+        chip_mesh = mesh_utils.create_device_mesh(chip_shape, devices=reps)
+    except (ValueError, AssertionError, NotImplementedError):
+        return None
+    out = np.empty(shape, dtype=object)
+    flat_out = out.reshape(-1, shape[-1])
+    flat_chip = chip_mesh.reshape(-1, chip_shape[-1])
+    for row in range(flat_out.shape[0]):
+        for j in range(chip_shape[-1]):
+            cores = by_chip[tuple(flat_chip[row, j].coords)]
+            for k in range(cpc):
+                flat_out[row, j * cpc + k] = cores[k]
+    return out
+
+
+def mesh_axis_locality(dev_array: "np.ndarray", axis_names=None) -> Dict:
+    """Physical ICI locality per mesh axis: mean/max chip-torus hop
+    between consecutive devices along each axis (wrap link included for
+    rings longer than 2). Two TensorCores of one chip are hop 0. Returns
+    {} when devices carry no coords (CPU/virtual meshes)."""
+    devs = dev_array.ravel()
+    if not hasattr(devs[0], "coords") or devs[0].coords is None:
+        return {}
+    coords = np.asarray([d.coords for d in devs]).reshape(
+        dev_array.shape + (-1,))
+    bounds = coords.reshape(-1, coords.shape[-1]).max(axis=0) + 1
+
+    def hop(a, b):
+        d = np.abs(a - b)
+        return int(np.minimum(d, bounds - d).sum())  # torus distance
+
+    names = axis_names or [f"axis{i}" for i in range(dev_array.ndim)]
+    out = {}
+    for ax, name in enumerate(names):
+        n = dev_array.shape[ax]
+        if n == 1:
+            continue
+        lines = np.moveaxis(coords, ax, 0).reshape(n, -1, coords.shape[-1])
+        hops = []
+        for line_idx in range(lines.shape[1]):
+            line = lines[:, line_idx]
+            pairs = [(i, i + 1) for i in range(n - 1)]
+            if n > 2:
+                pairs.append((n - 1, 0))  # ring wrap link
+            hops.extend(hop(line[i], line[j]) for i, j in pairs)
+        out[name] = {"mean_hop": round(float(np.mean(hops)), 3),
+                     "max_hop": int(np.max(hops)), "size": n}
+    return out
+
+
 class CommunicateTopology:
     """N-d cartesian topology over ranks (device indices)."""
 
@@ -91,19 +241,20 @@ class HybridCommunicateGroup:
 
     def __init__(self, dp_degree: int = 1, mp_degree: int = 1,
                  pp_degree: int = 1, sharding_degree: int = 1,
-                 sep_degree: int = 1, devices=None):
-        devices = list(devices if devices is not None else jax.devices())
+                 sep_degree: int = 1, devices=None,
+                 topology_aware: Optional[bool] = None):
+        avail = list(devices) if devices is not None else jax.devices()
         need = dp_degree * mp_degree * pp_degree * sharding_degree * \
             sep_degree
-        if need > len(devices):
+        if need > len(avail):
             raise ValueError(
-                f"hybrid degrees {need} exceed device count {len(devices)}")
-        devices = devices[:need]
+                f"hybrid degrees {need} exceed device count {len(avail)}")
         self.dims = {"pp": pp_degree, "dp": dp_degree,
                      "sharding": sharding_degree, "sep": sep_degree,
                      "mp": mp_degree}
         shape = tuple(self.dims[a] for a in _HYBRID_AXES)
-        dev_array = np.asarray(devices).reshape(shape)
+        dev_array, self.mesh_assignment = build_device_array(
+            shape, avail if devices is not None else None, topology_aware)
         self.mesh = Mesh(dev_array, _HYBRID_AXES)
         self.topology = CommunicateTopology(
             ("pipe", "data", "sharding", "sep", "model"), shape)
@@ -181,10 +332,9 @@ def create_hybrid_communicate_group(dp_degree=1, mp_degree=1, pp_degree=1,
 
 
 def make_mesh(axis_shapes: Dict[str, int], devices=None) -> Mesh:
-    """Generic mesh builder for custom axis layouts."""
-    devices = list(devices if devices is not None else jax.devices())
+    """Generic mesh builder for custom axis layouts (topology-aware when
+    the caller does not fix an explicit device order)."""
     names = tuple(axis_shapes)
     shape = tuple(axis_shapes[n] for n in names)
-    need = int(np.prod(shape))
-    dev_array = np.asarray(devices[:need]).reshape(shape)
+    dev_array, _ = build_device_array(shape, devices)
     return Mesh(dev_array, names)

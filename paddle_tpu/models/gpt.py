@@ -51,7 +51,10 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     tie_word_embeddings: bool = True
     use_flash_attention: bool = True
-    seq_parallel_mode: Optional[str] = None  # None | "ring" | "ulysses"
+    # None | "ring" | "ulysses" | "zigzag" (balanced causal ring: the
+    # model permutes the sequence into the zigzag layout once at the
+    # embedding boundary and back after the final norm)
+    seq_parallel_mode: Optional[str] = None
     dtype: str = "float32"
     # MoE (beyond-reference): every `moe_every`-th block uses an
     # expert-parallel MoE FFN when moe_experts > 0
@@ -184,7 +187,8 @@ class GPTAttention(Layer):
                 k = F["concat"]([cache[0], k], axis=1)
                 v = F["concat"]([cache[1], v], axis=1)
             new_cache = (k, v)
-        if self.seq_mode in ("ring", "ulysses") and not use_cache:
+        if self.seq_mode in ("ring", "ulysses", "zigzag") and \
+                not use_cache:
             from ..distributed.sp import sequence_parallel_attention
             out = dispatch.call_fn(
                 lambda qq, kk, vv: sequence_parallel_attention(
@@ -312,6 +316,10 @@ class GPTModel(Layer):
         # shard activations: batch over dp(+sharding), seq over sep
         x = _constrain(x, ("dp", "sharding"), "sep", None)
         x = self.drop(x)
+        zig = (self.config.seq_parallel_mode == "zigzag" and
+               not use_cache and self._sep_degree() > 1)
+        if zig:
+            x = self._zigzag(x, s)
         if caches is None and use_cache:
             caches = [None] * len(self.h)
         new_caches = [] if use_cache else None
@@ -325,9 +333,32 @@ class GPTModel(Layer):
             else:
                 x = block(x)
         x = self.ln_f(x)
+        if zig:
+            x = self._zigzag(x, s, inverse=True)
         if use_cache:
             return x, new_caches
         return x
+
+    def _sep_degree(self) -> int:
+        from ..distributed.topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        return dict(hcg.mesh.shape).get("sep", 1) if hcg is not None else 1
+
+    def _zigzag(self, x, s, inverse=False):
+        """One boundary permutation puts the WHOLE block stack in the
+        zigzag sequence layout (every non-attention op is positionwise;
+        attention runs the balanced zigzag ring); the inverse after the
+        final norm restores the public order, so the LM loss shift is
+        untouched. Two S-gathers per step total instead of per-layer
+        re-layouts."""
+        import jax.numpy as jnp
+
+        from ..distributed.sp import zigzag_permutation
+        perm, inv = zigzag_permutation(s, self._sep_degree())
+        idxs = jnp.asarray(inv if inverse else perm)
+        x = dispatch.call_fn(lambda h: jnp.take(h, idxs, axis=1),
+                             "zigzag_permute", True, (x,), {})
+        return _constrain(x, ("dp", "sharding"), "sep", None)
 
 
 class GPTForCausalLM(Layer):

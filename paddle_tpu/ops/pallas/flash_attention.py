@@ -37,6 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 # for B8 H12 S1024 D64); auto-clamped to the sequence length.
 # PT_FLASH_BLOCK_Q/K override for shape-specific tuning (the analog of
 # the reference's per-kernel-key JIT selection, operators/jit/README).
+import contextlib as _contextlib
 import os as _os
 
 DEFAULT_BLOCK_Q = int(_os.environ.get("PT_FLASH_BLOCK_Q", 512))
@@ -387,15 +388,30 @@ def _resolve_blocks(sq, sk, block_q, block_k):
     return best(sq, block_q), best(sk, block_k)
 
 
+_FORCE_DEPTH = 0
+
+
+@_contextlib.contextmanager
+def force_flash_for_aot():
+    """Treat the flash kernel as supported while compiling FOR a TPU
+    topology ON a CPU host (jax.default_backend() reports the host, not
+    the compile target). Scoped — unlike a leftover env var, it cannot
+    leak into a real CPU/GPU execution and fail at Mosaic lowering.
+    Used by tools/scale_proof.py around its AOT lower+compile."""
+    global _FORCE_DEPTH
+    _FORCE_DEPTH += 1
+    try:
+        yield
+    finally:
+        _FORCE_DEPTH -= 1
+
+
 def flash_attention_supported(q_shape, k_shape, backend: Optional[str] =
                               None, block_q=DEFAULT_BLOCK_Q,
                               block_k=DEFAULT_BLOCK_K) -> bool:
     if backend is None:
         backend = jax.default_backend()
-    if backend not in ("tpu", "axon") and \
-            _os.environ.get("PT_FLASH_FORCE", "0") != "1":
-        # PT_FLASH_FORCE=1: AOT compiles for a TPU topology run on CPU
-        # hosts, where default_backend() lies about the TARGET
+    if backend not in ("tpu", "axon") and _FORCE_DEPTH == 0:
         return False
     b, sq, h, d = q_shape
     sk = k_shape[1]

@@ -314,17 +314,26 @@ def _parse_conll05_tar(data_file):
     import tarfile
 
     with tarfile.open(data_file) as tf:
-        words_names = sorted(n for n in tf.getnames()
-                             if n.endswith(".words.gz"))
-        props_names = sorted(n for n in tf.getnames()
-                             if n.endswith(".props.gz"))
-        if not words_names or len(words_names) != len(props_names):
+        def key_of(name, suffix):
+            # shared section key: basename minus the member suffix (e.g.
+            # "test.wsj" from "words/test.wsj/test.wsj.words.gz") — name
+            # order alone could zip mismatched sections if the tar
+            # carries extra or renamed members
+            return name.rsplit("/", 1)[-1][:-len(suffix)]
+
+        words_by = {key_of(n, ".words.gz"): n for n in tf.getnames()
+                    if n.endswith(".words.gz")}
+        props_by = {key_of(n, ".props.gz"): n for n in tf.getnames()
+                    if n.endswith(".props.gz")}
+        if not words_by or set(words_by) != set(props_by):
             raise ValueError(
                 f"{data_file} needs matching words.gz/props.gz members "
-                f"(got {len(words_names)}/{len(props_names)})")
+                f"(words sections {sorted(words_by)}, props sections "
+                f"{sorted(props_by)})")
         word_lines, prop_lines = [], []
-        # every section (e.g. test.wsj AND test.brown), paired by order
-        for wn, pn in zip(words_names, props_names):
+        # every section (e.g. test.wsj AND test.brown), paired by key
+        for sec in sorted(words_by):
+            wn, pn = words_by[sec], props_by[sec]
             with gzip.GzipFile(fileobj=tf.extractfile(wn)) as wf:
                 word_lines += [l.decode().strip() for l in wf]
                 word_lines.append("")  # section boundary = sentence end

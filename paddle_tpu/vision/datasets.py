@@ -311,8 +311,10 @@ class VOC2012(Dataset):
         self._tar = None
         if data_file and os.path.isfile(data_file):
             self._tar = _TarReader(data_file)
-            flag = {"train": "train", "valid": "val",
-                    "test": "val"}.get(mode, "train")
+            # reference MODE_FLAG_MAP (vision/datasets/voc2012.py:37):
+            # train -> trainval, test -> train, valid -> val
+            flag = {"train": "trainval", "valid": "val",
+                    "test": "train"}.get(mode, "trainval")
             listing = self._tar.read(self._SET.format(flag))
             self._names = [ln.strip().decode()
                            for ln in listing.splitlines() if ln.strip()]

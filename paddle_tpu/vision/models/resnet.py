@@ -1,5 +1,11 @@
 """ResNet family (reference: python/paddle/vision/models/resnet.py —
-resnet18/34/50/101/152 with BasicBlock/BottleneckBlock)."""
+resnet18/34/50/101/152 with BasicBlock/BottleneckBlock).
+
+``data_format="NHWC"`` runs the whole network channel-last — the fast
+layout on TPU (the MXU consumes NHWC convs without the per-conv
+transposes XLA inserts around NCHW) — while the public input/output
+contract stays NCHW: the input is transposed once at the model boundary.
+"""
 
 from __future__ import annotations
 
@@ -10,16 +16,17 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn1 = norm_layer(planes)
+                               bias_attr=False, data_format=data_format)
+        self.bn1 = norm_layer(planes, data_format=data_format)
         self.relu = nn.ReLU()
         self.conv2 = nn.Conv2D(planes, planes, 3, padding=1,
-                               bias_attr=False)
-        self.bn2 = norm_layer(planes)
+                               bias_attr=False, data_format=data_format)
+        self.bn2 = norm_layer(planes, data_format=data_format)
         self.downsample = downsample
         self.stride = stride
 
@@ -36,19 +43,23 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
+                               data_format=data_format)
+        self.bn1 = norm_layer(width, data_format=data_format)
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation,
                                stride=stride, groups=groups,
-                               dilation=dilation, bias_attr=False)
-        self.bn2 = norm_layer(width)
+                               dilation=dilation, bias_attr=False,
+                               data_format=data_format)
+        self.bn2 = norm_layer(width, data_format=data_format)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
-                               bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+                               bias_attr=False, data_format=data_format)
+        self.bn3 = norm_layer(planes * self.expansion,
+                              data_format=data_format)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
@@ -64,7 +75,7 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, data_format="NCHW"):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -75,17 +86,20 @@ class ResNet(nn.Layer):
         self.with_pool = with_pool
         self.inplanes = 64
         self.dilation = 1
+        self.data_format = data_format
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                               bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(self.inplanes)
+                               bias_attr=False, data_format=data_format)
+        self.bn1 = nn.BatchNorm2D(self.inplanes, data_format=data_format)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1,
+                                    data_format=data_format)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1),
+                                                data_format=data_format)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
@@ -94,17 +108,28 @@ class ResNet(nn.Layer):
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                nn.BatchNorm2D(planes * block.expansion))
+                          stride=stride, bias_attr=False,
+                          data_format=self.data_format),
+                nn.BatchNorm2D(planes * block.expansion,
+                               data_format=self.data_format))
         layers = [block(self.inplanes, planes, stride, downsample,
-                        self.groups, self.base_width)]
+                        self.groups, self.base_width,
+                        data_format=self.data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
-                                base_width=self.base_width))
+                                base_width=self.base_width,
+                                data_format=self.data_format))
         return nn.Sequential(*layers)
 
     def forward(self, x):
+        from ... import dispatch
+        F = dispatch.wrapped_ops
+        nhwc = self.data_format == "NHWC"
+        if nhwc:
+            # Public contract stays NCHW; one boundary transpose puts the
+            # whole network in the TPU-fast channel-last layout.
+            x = F["transpose"](x, [0, 2, 3, 1])
         x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
         x = self.layer1(x)
@@ -114,9 +139,15 @@ class ResNet(nn.Layer):
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
-            from ... import dispatch
+            if nhwc and not self.with_pool:
+                # un-pooled flatten order must match the NCHW contract
+                x = F["transpose"](x, [0, 3, 1, 2])
+                nhwc = False
             x = dispatch.wrapped_ops["flatten"](x, 1)
             x = self.fc(x)
+        elif nhwc:
+            # feature-extractor exit: restore the public NCHW layout
+            x = F["transpose"](x, [0, 3, 1, 2])
         return x
 
 

@@ -201,3 +201,62 @@ def test_elastic_crash_relaunch_resume(tmp_path):
     assert resumed["start"] >= 1
     np.testing.assert_allclose(resumed["losses"], base[resumed["start"]:],
                                rtol=1e-4, atol=1e-5)
+
+
+def test_tcp_membership_kill_and_rejoin(tmp_path):
+    """Cross-host elastic membership with REAL processes and NO shared
+    tmpdir: two worker processes register over TCP only; one is
+    SIGKILLed (no deregister), the TTL prunes it, and a relaunched
+    process rejoins (reference: etcd membership, fleet/elastic.py:87)."""
+    import signal
+    import time
+
+    from paddle_tpu.distributed.elastic import (MembershipServer,
+                                                TcpMembershipStore)
+
+    srv = MembershipServer(host="127.0.0.1", ttl_s=1.0)
+    ep = f"127.0.0.1:{srv.port}"
+    worker_code = (
+        "import os, sys, time\n"
+        "sys.path.insert(0, os.environ['PT_REPO'])\n"
+        "from paddle_tpu.distributed.elastic import TcpMembershipStore\n"
+        "st = TcpMembershipStore(os.environ['PT_MEMBER_EP'])\n"
+        "rank = int(os.environ['PT_RANK'])\n"
+        "st.register('jobK', rank, {'np': 2})\n"
+        "while True:\n"
+        "    st.heartbeat('jobK', rank)\n"
+        "    time.sleep(0.1)\n")
+
+    def spawn(rank):
+        # -c (not a file): the workers share NOTHING on disk, only the
+        # TCP endpoint
+        return subprocess.Popen(
+            [sys.executable, "-c", worker_code],
+            env=_worker_env({"PT_MEMBER_EP": ep, "PT_RANK": rank,
+                             "PT_REPO": REPO}))
+
+    st = TcpMembershipStore(ep)
+
+    def wait_members(expect, timeout=15.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if sorted(st.members("jobK")) == expect:
+                return True
+            time.sleep(0.2)
+        return False
+
+    p0 = p1 = None
+    try:
+        p0, p1 = spawn(0), spawn(1)
+        assert wait_members([0, 1]), st.members("jobK")
+        p1.send_signal(signal.SIGKILL)  # hard crash: no deregister runs
+        p1.wait()
+        assert wait_members([0]), "TTL did not prune the killed rank"
+        p1 = spawn(1)  # elastic relaunch
+        assert wait_members([0, 1]), "relaunched rank did not rejoin"
+    finally:
+        for p in (p0, p1):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+        srv.close()

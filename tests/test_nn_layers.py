@@ -266,3 +266,30 @@ def test_functional_call_jit_consistency():
     out_eager = net(pt.to_tensor(x)).numpy()
     np.testing.assert_allclose(np.asarray(out_jit), out_eager, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_resnet_nhwc_exit_layouts_match_nchw():
+    """NHWC internal layout keeps the public NCHW contract at every
+    exit: classifier, pooled features, and un-pooled features."""
+    import numpy as np
+
+    from paddle_tpu.vision.models import resnet18
+
+    x = pt.to_tensor(np.random.default_rng(0).standard_normal(
+        (2, 3, 64, 64)).astype(np.float32))
+    # (with_pool=False + a classifier head is shape-inconsistent in the
+    # reference model too: fc expects 512*expansion features)
+    for kwargs in ({"num_classes": 10},
+                   {"num_classes": 0},
+                   {"num_classes": 0, "with_pool": False}):
+        pt.seed(0)
+        a = resnet18(**kwargs)
+        pt.seed(0)
+        b = resnet18(data_format="NHWC", **kwargs)
+        b.set_state_dict(a.state_dict())
+        a.eval(); b.eval()
+        oa, ob = a(x), b(x)
+        assert tuple(oa.shape) == tuple(ob.shape), (kwargs, oa.shape,
+                                                    ob.shape)
+        np.testing.assert_allclose(oa.numpy(), ob.numpy(), rtol=2e-3,
+                                   atol=2e-3, err_msg=str(kwargs))

@@ -63,7 +63,7 @@ def build_step(mp: int, pp: int, sharding: int, n_micro: int,
 
     hcg = HybridCommunicateGroup(
         mp_degree=mp, pp_degree=pp, sharding_degree=sharding,
-        devices=devices)
+        devices=devices, topology_aware=True)
     set_hybrid_communicate_group(hcg)
     cfg = ernie_10b(dropout=0.0, attn_dropout=0.0, dtype="bfloat16",
                     loss_chunk_size=512)
@@ -88,6 +88,30 @@ def run_proof(topology_name: str = "v4:2x4x4", mp: int = 8, pp: int = 4,
 
     step, cfg = build_step(mp, pp, sharding, n_micro, topo.devices,
                            schedule)
+
+    # Physical axis assignment: the mesh solver must put mp (the
+    # highest-bandwidth collectives) on the tightest ICI loops. Record
+    # per-axis torus hop stats for the solved mesh vs the naive
+    # enumeration-order reshape it replaces, and assert the solve wins.
+    from paddle_tpu.distributed.topology import (get_hybrid_communicate_group,
+                                                 mesh_axis_locality)
+    import numpy as _np
+    hcg = get_hybrid_communicate_group()
+    axes = list(hcg.mesh.axis_names)
+    solved = mesh_axis_locality(hcg.mesh.devices, axes)
+    naive = mesh_axis_locality(
+        _np.asarray(list(topo.devices)).reshape(hcg.mesh.devices.shape),
+        axes)
+    mesh_assignment = {
+        "strategy": hcg.mesh_assignment,
+        "solved_axis_hops": solved,
+        "naive_reshape_axis_hops": naive,
+    }
+    if solved:
+        assert solved["mp"]["mean_hop"] <= naive["mp"]["mean_hop"], (
+            solved, naive)
+        assert solved["mp"]["max_hop"] <= 1, (
+            "mp axis must ride adjacent ICI links", solved)
     n_params = sum(
         int(np.prod(v.shape))
         for v in {**step.stacked, **step.shared}.values())
@@ -142,6 +166,7 @@ def run_proof(topology_name: str = "v4:2x4x4", mp: int = 8, pp: int = 4,
         "fits": bool(live <= budget_bytes),
         "note": "budget is the per-core share (32 GiB chip / 2 cores); "
                 "a megacore deployment sees 2x this budget per device",
+        "mesh_assignment": mesh_assignment,
         "shardings": shardings,
     }
     return report
@@ -154,8 +179,8 @@ def run_longctx_proof(topology_name: str = "v4:2x4x4", mp: int = 2,
     """Long-context at scale: the 10B model with ring-flash sequence
     parallelism (sep) composed with mp x pp x dp in ONE v4-64 mesh,
     S=32k, AOT-compiled with per-core HBM fit asserted. Ring hops run
-    the Pallas flash kernel (PT_FLASH_FORCE=1: the compile host is CPU
-    but the target is TPU) with the O(S_local) custom-vjp backward."""
+    the Pallas flash kernel (force_flash_for_aot: the compile host is
+    CPU but the target is TPU) with the O(S_local) custom-vjp backward."""
     import numpy as np
     from jax.experimental import topologies
 
@@ -171,7 +196,7 @@ def run_longctx_proof(topology_name: str = "v4:2x4x4", mp: int = 2,
     assert n_dev == mp * pp * sep * dp, (n_dev, mp, pp, sep, dp)
     hcg = HybridCommunicateGroup(
         mp_degree=mp, pp_degree=pp, sep_degree=sep, dp_degree=dp,
-        devices=topo.devices)
+        devices=topo.devices, topology_aware=True)
     set_hybrid_communicate_group(hcg)
     cfg = ernie_10b(dropout=0.0, attn_dropout=0.0, dtype="bfloat16",
                     loss_chunk_size=512, seq_parallel_mode="ring")
@@ -204,23 +229,22 @@ def run_longctx_proof(topology_name: str = "v4:2x4x4", mp: int = 2,
     step._zero_shard_slots("sep")  # re-derivation reset the ZeRO specs
     batch = dp * n_micro
     t0 = time.time()
-    prev_force = os.environ.get("PT_FLASH_FORCE")
-    os.environ["PT_FLASH_FORCE"] = "1"  # target is TPU, host is CPU
-    try:
+    from paddle_tpu.ops.pallas.flash_attention import force_flash_for_aot
+    with force_flash_for_aot():  # target is TPU, host is CPU
         compiled = step.lower(batch, seq).compile()
-    finally:
-        if prev_force is None:
-            os.environ.pop("PT_FLASH_FORCE", None)
-        else:
-            os.environ["PT_FLASH_FORCE"] = prev_force
     t_compile = time.time() - t0
     arg_b, out_b, temp_b, alias_b, code_b, live = _mem_bytes(compiled)
     n_params = sum(
         int(np.prod(v.shape))
         for v in {**step.stacked, **step.shared}.values())
+    from paddle_tpu.distributed.topology import mesh_axis_locality
     return {
         "topology": topology_name, "n_devices": n_dev,
         "degrees": {"mp": mp, "pp": pp, "sep": sep, "dp": dp},
+        "mesh_assignment": {
+            "strategy": hcg.mesh_assignment,
+            "solved_axis_hops": mesh_axis_locality(
+                hcg.mesh.devices, list(hcg.mesh.axis_names))},
         "model": {"params_b": round(n_params / 1e9, 3),
                   "seq_len": seq, "seq_parallel": "ring (flash hops)",
                   "precision": "bf16 params + bf16 Adam slots, fp32 "
