@@ -94,6 +94,30 @@ def zigzag_permutation(seq_len: int, n: int):
     return perm, inv
 
 
+def zigzag_chunk_order(n: int, inverse: bool = False):
+    """Chunk-level zigzag order over 2n half-chunks (chunk i of the
+    permuted layout = chunk order[i] of the original)."""
+    order = []
+    for i in range(n):
+        order.extend((i, 2 * n - 1 - i))
+    if inverse:
+        order = list(np.argsort(order))
+    return order
+
+
+def zigzag_reorder(x, n: int, axis: int = 1, inverse: bool = False):
+    """Apply the zigzag layout as SPLIT + CONCAT of 2n chunks instead of
+    a gather: static slices with shard-aligned boundaries lower to
+    collective-permutes under GSPMD, where a sequence-axis gather trips
+    the TPU SPMD partitioner (CHECK failure in spmd_partitioner_util)
+    inside partial-manual regions. n=1 is the identity."""
+    if n <= 1:
+        return x
+    chunks = jnp.split(x, 2 * n, axis=axis)
+    order = zigzag_chunk_order(n, inverse=inverse)
+    return jnp.concatenate([chunks[j] for j in order], axis=axis)
+
+
 def zigzag_positions(idx, n: int, s_loc: int):
     """Global sequence positions of a device's zigzag-local rows
     (traced-friendly in the device index ``idx``)."""
@@ -424,6 +448,15 @@ def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
     if use_flash is None:
         from ..ops.pallas.flash_attention import flash_attention_supported
         use_flash = flash_attention_supported(q.shape, k.shape)
+        if zigzag and use_flash:
+            # zigzag hops dispatch HALF-chunk kernels (q x k[:c] etc.):
+            # the half length must itself block-align or the jnp path
+            # takes over (e.g. S_local=384: 384 is a 128-multiple but
+            # 192 is not)
+            c = q.shape[1] // 2
+            half = (q.shape[0], c, *q.shape[2:])
+            use_flash = (q.shape[1] % 2 == 0 and
+                         flash_attention_supported(half, half))
     if use_flash:
         scale_f = float(scale if scale is not None
                         else 1.0 / np.sqrt(q.shape[-1]))

@@ -153,9 +153,15 @@ def mesh_axis_locality(dev_array: "np.ndarray", axis_names=None) -> Dict:
         dev_array.shape + (-1,))
     bounds = coords.reshape(-1, coords.shape[-1]).max(axis=0) + 1
 
-    def hop(a, b):
+    def hop(a, b, wrap_ok):
+        # Torus wraparound credit only in dimensions the LINE actually
+        # spans end-to-end: a mesh axis laid along a sub-block of a
+        # wider physical ring has no wrap link of its own, and counting
+        # one would understate the distance (and let the scale proof's
+        # max-hop assertion pass for a non-adjacent placement).
         d = np.abs(a - b)
-        return int(np.minimum(d, bounds - d).sum())  # torus distance
+        wrapped = np.where(wrap_ok, np.minimum(d, bounds - d), d)
+        return int(wrapped.sum())
 
     names = axis_names or [f"axis{i}" for i in range(dev_array.ndim)]
     out = {}
@@ -167,10 +173,14 @@ def mesh_axis_locality(dev_array: "np.ndarray", axis_names=None) -> Dict:
         hops = []
         for line_idx in range(lines.shape[1]):
             line = lines[:, line_idx]
+            wrap_ok = np.array([
+                len(set(line[:, dim])) == bounds[dim]
+                for dim in range(line.shape[1])])
             pairs = [(i, i + 1) for i in range(n - 1)]
             if n > 2:
                 pairs.append((n - 1, 0))  # ring wrap link
-            hops.extend(hop(line[i], line[j]) for i, j in pairs)
+            hops.extend(hop(line[i], line[j], wrap_ok)
+                        for i, j in pairs)
         out[name] = {"mean_hop": round(float(np.mean(hops)), 3),
                      "max_hop": int(np.max(hops)), "size": n}
     return out

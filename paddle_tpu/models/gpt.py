@@ -345,19 +345,19 @@ class GPTModel(Layer):
         return dict(hcg.mesh.shape).get("sep", 1) if hcg is not None else 1
 
     def _zigzag(self, x, s, inverse=False):
-        """One boundary permutation puts the WHOLE block stack in the
+        """One boundary re-layout puts the WHOLE block stack in the
         zigzag sequence layout (every non-attention op is positionwise;
         attention runs the balanced zigzag ring); the inverse after the
         final norm restores the public order, so the LM loss shift is
-        untouched. Two S-gathers per step total instead of per-layer
-        re-layouts."""
-        import jax.numpy as jnp
-
-        from ..distributed.sp import zigzag_permutation
-        perm, inv = zigzag_permutation(s, self._sep_degree())
-        idxs = jnp.asarray(inv if inverse else perm)
-        x = dispatch.call_fn(lambda h: jnp.take(h, idxs, axis=1),
-                             "zigzag_permute", True, (x,), {})
+        untouched. Chunk-level split+concat (not a gather — shard-
+        aligned slices lower to collective-permutes under GSPMD; a
+        sharded-S gather trips the TPU SPMD partitioner), two per step
+        instead of per-layer re-layouts."""
+        from ..distributed.sp import zigzag_reorder
+        n = self._sep_degree()
+        x = dispatch.call_fn(
+            lambda h: zigzag_reorder(h, n, axis=1, inverse=inverse),
+            "zigzag_permute", True, (x,), {})
         return _constrain(x, ("dp", "sharding"), "sep", None)
 
 

@@ -216,20 +216,55 @@ class GPTPipelineTrainStep:
 
     # -- functional pieces ----------------------------------------------------
 
+    def _zigzag_sep(self) -> int:
+        """sep degree when the config runs the balanced zigzag ring over
+        a sep axis in this mesh; 0 otherwise."""
+        sep = dict(self.mesh.shape).get("sep", 1)
+        if self.config.seq_parallel_mode != "zigzag" or sep <= 1:
+            return 0
+        return sep
+
     def _embed(self, shared, ids):
         model = self.model
+        b, s = ids.shape
+        sep = self._zigzag_sep()
+        import jax.numpy as jnp
+        if sep:
+            # Zigzag layout from the very first op: chunk-reorder the
+            # int ids (split+concat — a sequence-axis GATHER inside the
+            # manual-pp region trips the TPU SPMD partitioner), and
+            # feed the permuted positions as position ids. The whole
+            # block stack then runs in zigzag order (positionwise ops
+            # are invariant; attention runs the balanced ring);
+            # _head_loss un-permutes before the next-token shift.
+            from ..distributed.sp import (zigzag_permutation,
+                                          zigzag_reorder)
+            ids = zigzag_reorder(ids, sep, axis=1)
+            perm, _ = zigzag_permutation(s, sep)
         with bind_state(model, {"params": shared, "buffers": {}}), \
                 no_grad():
-            b, s = ids.shape
             import paddle_tpu.dispatch as dispatch
             F = dispatch.wrapped_ops
-            pos = F["arange"](s, dtype="int32")
-            pos = F["expand"](F["unsqueeze"](pos, 0), (b, s))
+            if sep:
+                pos = jnp.broadcast_to(
+                    jnp.asarray(perm, jnp.int32)[None, :], (b, s))
+                pos = Tensor(pos)
+            else:
+                pos = F["arange"](s, dtype="int32")
+                pos = F["expand"](F["unsqueeze"](pos, 0), (b, s))
             x = model.gpt.wte(Tensor(ids)) + model.gpt.wpe(pos)
             return x.value
 
     def _head_loss(self, shared, hidden, labels):
         model = self.model
+        sep = self._zigzag_sep()
+        if sep:
+            # Restore the public order before the next-token shift —
+            # chunk-level split+concat (shard-aligned slices lower to
+            # collective-permutes; a sharded-S gather trips the TPU
+            # SPMD partitioner).
+            from ..distributed.sp import zigzag_reorder
+            hidden = zigzag_reorder(hidden, sep, axis=1, inverse=True)
         with bind_state(model, {"params": shared, "buffers": {}}), \
                 no_grad():
             h = model.gpt.ln_f(Tensor(hidden))

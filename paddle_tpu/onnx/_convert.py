@@ -37,6 +37,33 @@ def _np(v) -> np.ndarray:
     return arr
 
 
+def _einsum_equation(lhs_ndim: int, rhs_ndim: int,
+                     dimension_numbers) -> str:
+    """dot_general dimension_numbers -> einsum equation. Output order
+    follows dot_general's contract: batch dims, then lhs free dims,
+    then rhs free dims."""
+    (lc, rc), (lb, rb) = dimension_numbers
+    letters = iter("abcdefghijklmnopqrstuvwxyz")
+    lhs = [None] * lhs_ndim
+    rhs = [None] * rhs_ndim
+    for li, ri in zip(lb, rb):
+        lhs[li] = rhs[ri] = next(letters)
+    for li, ri in zip(lc, rc):
+        lhs[li] = rhs[ri] = next(letters)
+    for i in range(lhs_ndim):
+        if lhs[i] is None:
+            lhs[i] = next(letters)
+    for i in range(rhs_ndim):
+        if rhs[i] is None:
+            rhs[i] = next(letters)
+    out = ([lhs[i] for i in lb] +
+           [lhs[i] for i in range(lhs_ndim) if i not in lb and
+            i not in lc] +
+           [rhs[i] for i in range(rhs_ndim) if i not in rb and
+            i not in rc])
+    return f"{''.join(lhs)},{''.join(rhs)}->{''.join(out)}"
+
+
 def convert_jaxpr(closed_jaxpr, input_names: List[str],
                   graph_name: str = "main",
                   opset_version: int = 13) -> bytes:
@@ -105,11 +132,15 @@ def convert_jaxpr(closed_jaxpr, input_names: List[str],
         if prim == "dot_general":
             ((lc, rc), (lb, rb)) = pp["dimension_numbers"]
             lhs, rhs = eqn.invars
-            if lb or rb or lc != (lhs.aval.ndim - 1,) or rc != (0,):
-                raise NotImplementedError(
-                    "onnx export supports plain matmul contractions "
-                    f"(got dimension_numbers={pp['dimension_numbers']})")
-            emit("MatMul", ins, outs)
+            if not lb and not rb and lc == (lhs.aval.ndim - 1,) and \
+                    rc == (0,):
+                emit("MatMul", ins, outs)
+            else:
+                # batched / general contraction (attention einsums):
+                # ONNX Einsum (opset 12+) takes the exact equation
+                eq = _einsum_equation(lhs.aval.ndim, rhs.aval.ndim,
+                                      pp["dimension_numbers"])
+                emit("Einsum", ins, outs, equation=eq)
         elif prim in ("add", "add_any"):
             emit("Add", ins, outs)
         elif prim == "sub":
@@ -203,8 +234,74 @@ def convert_jaxpr(closed_jaxpr, input_names: List[str],
         elif prim == "convert_element_type":
             onnx_dt = P.NP_TO_ONNX[np.dtype(pp["new_dtype"])]
             emit("Cast", ins, outs, to=onnx_dt)
-        elif prim == "stop_gradient":
+        elif prim in ("stop_gradient", "copy"):
             emit("Identity", ins, outs)
+        elif prim == "square":
+            emit("Mul", [ins[0], ins[0]], outs)
+        elif prim == "erfc":
+            emit("Erf", ins, [outs[0] + "_erf"])
+            one = add_const(np.asarray(1.0, eqn.invars[0].aval.dtype))
+            emit("Sub", [one, outs[0] + "_erf"], outs)
+        elif prim == "select_n":
+            # boolean select: select_n(pred, on_false, on_true);
+            # ONNX Where(cond, X, Y) = cond ? X : Y
+            if len(ins) != 3:
+                raise NotImplementedError(
+                    "onnx export: select_n with >2 cases")
+            emit("Where", [ins[0], ins[2], ins[1]], outs)
+        elif prim in ("eq", "lt", "gt", "le", "ge", "ne"):
+            op = {"eq": "Equal", "lt": "Less", "gt": "Greater",
+                  "le": "LessOrEqual", "ge": "GreaterOrEqual"}.get(prim)
+            if prim == "ne":
+                emit("Equal", ins, [outs[0] + "_eq"])
+                emit("Not", [outs[0] + "_eq"], outs)
+            else:
+                emit(op, ins, outs)
+        elif prim == "and":
+            emit("And", ins, outs)
+        elif prim == "or":
+            emit("Or", ins, outs)
+        elif prim == "not":
+            emit("Not", ins, outs)
+        elif prim == "concatenate":
+            emit("Concat", ins, outs, axis=int(pp["dimension"]))
+        elif prim == "slice":
+            starts = add_const(np.asarray(pp["start_indices"], np.int64))
+            ends = add_const(np.asarray(pp["limit_indices"], np.int64))
+            axes = add_const(np.arange(len(pp["start_indices"]),
+                                       dtype=np.int64))
+            strides = pp.get("strides") or \
+                (1,) * len(pp["start_indices"])
+            steps = add_const(np.asarray(strides, np.int64))
+            emit("Slice", [ins[0], starts, ends, axes, steps], outs)
+        elif prim == "iota":
+            # static shape: materialize as an initializer
+            vals = np.arange(pp["shape"][pp["dimension"]])
+            arr = np.broadcast_to(
+                vals.reshape([-1 if i == pp["dimension"] else 1
+                              for i in range(len(pp["shape"]))]),
+                pp["shape"]).astype(np.dtype(pp["dtype"]))
+            namer.names[eqn.outvars[0]] = add_const(arr)
+        elif prim == "gather":
+            dn = pp["dimension_numbers"]
+            operand, start = eqn.invars
+            idx_ndim = start.aval.ndim
+            take_axis0 = (
+                tuple(dn.collapsed_slice_dims) == (0,) and
+                tuple(dn.start_index_map) == (0,) and
+                not getattr(dn, "operand_batching_dims", ()) and
+                tuple(pp["slice_sizes"]) ==
+                (1,) + tuple(operand.aval.shape[1:]) and
+                start.aval.shape[-1] == 1)
+            if not take_axis0:
+                raise NotImplementedError(
+                    "onnx export: only axis-0 take/embedding-lookup "
+                    f"gathers are supported (got {dn})")
+            # drop the trailing index-vector dim, then Gather(axis=0)
+            idx_shape = add_const(np.asarray(start.aval.shape[:-1],
+                                             np.int64))
+            emit("Reshape", [ins[1], idx_shape], [outs[0] + "_idx"])
+            emit("Gather", [ins[0], outs[0] + "_idx"], outs, axis=0)
         else:
             raise NotImplementedError(
                 f"onnx export: unsupported primitive {prim!r}; supported "

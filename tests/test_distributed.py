@@ -370,3 +370,16 @@ def test_zigzag_eager_fallback_matches_dense_model():
     l_dense = float(dense(pt.to_tensor(ids), labels=pt.to_tensor(ids)))
     l_zig = float(zig(pt.to_tensor(ids), labels=pt.to_tensor(ids)))
     np.testing.assert_allclose(l_zig, l_dense, rtol=1e-4)
+
+
+def test_zigzag_reorder_matches_permutation():
+    from paddle_tpu.distributed.sp import (zigzag_permutation,
+                                           zigzag_reorder)
+
+    x = np.arange(2 * 32 * 3).reshape(2, 32, 3).astype(np.float32)
+    perm, inv = zigzag_permutation(32, 4)
+    np.testing.assert_array_equal(
+        np.asarray(zigzag_reorder(jnp.asarray(x), 4, axis=1)), x[:, perm])
+    np.testing.assert_array_equal(
+        np.asarray(zigzag_reorder(jnp.asarray(x[:, perm]), 4, axis=1,
+                                  inverse=True)), x)

@@ -129,3 +129,27 @@ def test_sequence_parallel_inside_pipeline_stage():
                               seed=7)
     got = [float(pp(ids, ids)) for _ in range(3)]
     np.testing.assert_allclose(want, got, rtol=5e-3, atol=5e-4)
+
+
+def test_zigzag_inside_pipeline_stage():
+    """pp x mp x sep with the balanced zigzag ring: the pipeline's
+    embed stage permutes into the zigzag layout, blocks run the
+    balanced causal ring, and the head un-permutes before the
+    next-token shift — losses match the dense model."""
+    from paddle_tpu.distributed.topology import (
+        get_hybrid_communicate_group)
+    from paddle_tpu.models.gpt_pipeline import GPTPipelineTrainStep
+
+    ids = (np.arange(2 * 256).reshape(2, 256) % 211).astype(np.int32)
+    want = _dense_losses(4, ids)
+
+    s = DistributedStrategy()
+    s.hybrid_configs = {"pp_degree": 2, "mp_degree": 2, "sep_degree": 2}
+    fleet.init(strategy=s)
+    hcg = get_hybrid_communicate_group()
+    pp = GPTPipelineTrainStep(_cfg_mp("zigzag", 4),
+                              optim.SGD(learning_rate=0.1),
+                              pp=2, n_micro=2, hcg=hcg, schedule="1f1b",
+                              seed=7)
+    got = [float(pp(ids, ids)) for _ in range(3)]
+    np.testing.assert_allclose(want, got, rtol=5e-3, atol=5e-4)

@@ -110,6 +110,50 @@ def _run_graph(nodes, env):
         elif op == "Conv":
             out = _np_conv(i[0], i[1], i[2] if len(i) > 2 else None,
                            attrs)
+        elif op == "Min":
+            out = np.minimum(i[0], i[1])
+        elif op == "Neg":
+            out = -i[0]
+        elif op == "Sqrt":
+            out = np.sqrt(i[0])
+        elif op == "Reciprocal":
+            out = 1.0 / i[0]
+        elif op == "Log":
+            out = np.log(i[0])
+        elif op == "Pow":
+            out = i[0] ** i[1]
+        elif op == "Squeeze":
+            out = np.squeeze(i[0], axis=tuple(i[1].tolist()))
+        elif op == "Einsum":
+            out = np.einsum(attrs["equation"], *i)
+        elif op == "Where":
+            out = np.where(i[0], i[1], i[2])
+        elif op == "Concat":
+            out = np.concatenate(i, axis=attrs["axis"])
+        elif op == "Slice":
+            starts, ends, axes, steps = (v.tolist() for v in i[1:5])
+            sl = [slice(None)] * i[0].ndim
+            for s, e, a, st in zip(starts, ends, axes, steps):
+                sl[a] = slice(s, e, st)
+            out = i[0][tuple(sl)]
+        elif op == "Gather":
+            out = np.take(i[0], i[1], axis=attrs.get("axis", 0))
+        elif op == "Equal":
+            out = i[0] == i[1]
+        elif op == "Less":
+            out = i[0] < i[1]
+        elif op == "Greater":
+            out = i[0] > i[1]
+        elif op == "LessOrEqual":
+            out = i[0] <= i[1]
+        elif op == "GreaterOrEqual":
+            out = i[0] >= i[1]
+        elif op == "Not":
+            out = ~i[0]
+        elif op == "And":
+            out = i[0] & i[1]
+        elif op == "Or":
+            out = i[0] | i[1]
         else:
             raise AssertionError(f"interpreter: unexpected op {op}")
         env[n["outputs"][-1]] = out
@@ -217,3 +261,51 @@ def test_export_unsupported_is_explicit(tmp_path):
     with pytest.raises(NotImplementedError, match="primitive"):
         export(Pooled(), str(tmp_path / "pool"),
                input_spec=[InputSpec([1, 1, 4, 4], "float32")])
+
+
+def test_export_bert_encoder_matches_eager(tmp_path):
+    """A real transformer: BERT-tiny embeddings (Gather), masked softmax
+    attention (Einsum + Where), LayerNorm (Sqrt/Reciprocal), plus a
+    slice+concat head — the r3 verdict's transformer-coverage gap.
+    Round-tripped through the numpy ONNX interpreter against eager."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.dispatch as dispatch
+    from paddle_tpu.models.bert import BertModel, bert_tiny
+
+    class BertHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bert = BertModel(bert_tiny())
+
+        def forward(self, input_ids, attention_mask):
+            seq, pooled = self.bert(input_ids,
+                                    attention_mask=attention_mask)
+            cls = seq[:, 0]  # Slice
+            return dispatch.wrapped_ops["concat"]([cls, pooled], axis=-1)
+
+    pt.seed(5)
+    model = BertHead()
+    model.eval()
+    path = export(model, str(tmp_path / "bert"),
+                  input_spec=[InputSpec([2, 16], "int32", "input_ids"),
+                              InputSpec([2, 16], "int32",
+                                        "attention_mask")])
+    nodes, inits, in_names, out_names = _parse_model(
+        open(path, "rb").read())
+    ops = {n["op"] for n in nodes}
+    assert {"Einsum", "Where", "Gather", "Concat", "Slice",
+            "Sqrt"} <= ops, ops
+
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 128, (2, 16)).astype(np.int32)
+    mask = np.ones((2, 16), np.int32)
+    mask[1, 10:] = 0  # ragged mask exercises the Where path for real
+    env = dict(inits)
+    env["input_ids"] = ids
+    env["attention_mask"] = mask
+    env = _run_graph(nodes, env)
+    got = env[out_names[0]]
+    ref = np.asarray(model(pt.Tensor(jnp.asarray(ids)),
+                           pt.Tensor(jnp.asarray(mask))).value)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

@@ -145,3 +145,27 @@ def test_mesh_locality_empty_on_cpu():
     arr, tag = build_device_array((2, 4), None)
     assert tag == "enumeration_order"  # virtual CPU: no topology
     assert mesh_axis_locality(arr, ["a", "b"]) == {}
+
+
+def test_mesh_locality_no_phantom_wrap():
+    """A mesh axis laid along a sub-range of a wider torus dimension has
+    no wraparound link of its own: the wrap pair must be charged the
+    absolute distance (regression: torus-wrap credit understated hops
+    and could let the mp-adjacency assertion pass wrongly)."""
+    from paddle_tpu.distributed.topology import mesh_axis_locality
+
+    class D:
+        def __init__(self, *c):
+            self.coords = list(c)
+
+    # x-dim bound is 8 (second row reaches 7); the first row's line runs
+    # x=0..5 only -> its wrap pair (5,0) is 5 hops, not min(5, 3)=3
+    row0 = [D(x, 0) for x in range(6)]
+    row1 = [D(x + 2, 1) for x in range(6)]
+    arr = np.asarray([row0, row1], dtype=object)
+    loc = mesh_axis_locality(arr, ["outer", "ring"])
+    assert loc["ring"]["max_hop"] == 5, loc
+    # a line spanning the FULL dimension keeps its genuine wrap link
+    full = np.asarray([[D(x, 0) for x in range(8)]], dtype=object)
+    loc2 = mesh_axis_locality(full, ["o", "ring"])
+    assert loc2["ring"]["max_hop"] == 1, loc2

@@ -24,7 +24,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 # The few concrete buffers built during model construction (position ids
 # etc.) should land on host — the TPU topology here is compile-only.
@@ -199,7 +203,7 @@ def run_longctx_proof(topology_name: str = "v4:2x4x4", mp: int = 2,
         devices=topo.devices, topology_aware=True)
     set_hybrid_communicate_group(hcg)
     cfg = ernie_10b(dropout=0.0, attn_dropout=0.0, dtype="bfloat16",
-                    loss_chunk_size=512, seq_parallel_mode="ring")
+                    loss_chunk_size=512, seq_parallel_mode="zigzag")
     cfg.max_seq_len = seq
     step = GPTPipelineTrainStep(
         cfg, optim.AdamW(learning_rate=1e-4), pp=pp, n_micro=n_micro,
@@ -246,7 +250,8 @@ def run_longctx_proof(topology_name: str = "v4:2x4x4", mp: int = 2,
             "solved_axis_hops": mesh_axis_locality(
                 hcg.mesh.devices, list(hcg.mesh.axis_names))},
         "model": {"params_b": round(n_params / 1e9, 3),
-                  "seq_len": seq, "seq_parallel": "ring (flash hops)",
+                  "seq_len": seq, "seq_parallel": "zigzag ring (balanced causal "
+                                  "schedule, flash hops)",
                   "precision": "bf16 params + bf16 Adam slots, fp32 "
                                "norms (the bench deployment recipe)",
                   "remat": True,
@@ -263,6 +268,12 @@ def run_longctx_proof(topology_name: str = "v4:2x4x4", mp: int = 2,
 
 
 def main():
+    # The env var alone is not enough on hosts whose sitecustomize pins
+    # the axon TPU plugin (it ignores JAX_PLATFORMS): force the host
+    # platform in-process so lowering sees backend=cpu and the flash
+    # auto-detect stays off outside the scoped force_flash_for_aot.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="SCALE_PROOF.json")
     ap.add_argument("--topology", default="v4:2x4x4")
