@@ -119,6 +119,23 @@ class BertForPretraining(Layer):
                 attention_mask=None):
         seq_out, pooled = self.bert(input_ids, token_type_ids,
                                     attention_mask=attention_mask)
+        if masked_positions is not None:
+            # The reference pretrain data format fixes
+            # max_predictions_per_seq masked slots per sequence: run the
+            # MLM transform + 30k-vocab head on those K positions only
+            # (the full-sequence head spends ~85% of its matmul + CE on
+            # positions that carry no label). ``labels`` may be the
+            # gathered [B, K] ids or the full [B, S] label tensor.
+            seq_out = F["take_along_axis"](
+                seq_out, F["unsqueeze"](masked_positions, -1), 1)
+            k = masked_positions.shape[1]
+            if labels is not None and k != input_ids.shape[1] and \
+                    tuple(labels.shape) == tuple(input_ids.shape):
+                # full [B, S] labels: gather to the masked slots. When
+                # K == S the shapes are ambiguous and labels are taken
+                # as ALREADY gathered (the reference masked_lm_ids
+                # form) — never double-gather.
+                labels = F["take_along_axis"](labels, masked_positions, 1)
         h = self.mlm_norm(F["gelu"](self.mlm_transform(seq_out)))
         mlm_logits = F["matmul"](
             h, self.bert.embeddings.word_embeddings.weight,

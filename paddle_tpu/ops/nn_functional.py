@@ -775,13 +775,26 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
 # when shapes/backend allow.
 # --------------------------------------------------------------------------
 
+# Below this key length XLA's fused attention beats the Pallas flash
+# kernel on TPU (measured: GPT-1.3B S=2048 and BERT S=512 favor XLA;
+# S>=4096 needs flash for memory and wins on time).
+_FLASH_MIN_SEQ = int(__import__("os").environ.get("PT_FLASH_MIN_SEQ",
+                                                  "4096"))
+
+
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, scale=None,
-                                 key=None, use_flash=True):
+                                 key=None, use_flash=None):
     """q,k,v: [batch, seq, heads, head_dim] (reference layout). Computes in
-    fp32 accumulation, returns q.dtype. Routes to the Pallas flash kernel
-    on TPU when the config allows (no mask/dropout, tile-aligned)."""
-    if (use_flash and attn_mask is None and
+    fp32 accumulation, returns q.dtype.
+
+    ``use_flash``: None (default) = auto — the Pallas flash kernel when
+    supported AND the key length >= PT_FLASH_MIN_SEQ (XLA's fused
+    attention wins below that); True = flash whenever supported;
+    False = never. Flash requires no mask and no active dropout."""
+    allowed = use_flash is True or (use_flash is None and
+                                    k.shape[1] >= _FLASH_MIN_SEQ)
+    if (allowed and attn_mask is None and
             (dropout_p == 0.0 or not training)):
         from .pallas.flash_attention import (flash_attention,
                                              flash_attention_supported)

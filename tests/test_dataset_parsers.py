@@ -77,11 +77,15 @@ def voc_fixture(tmp_path):
     path = tmp_path / "VOCtrainval.tar"
     rng = np.random.RandomState(0)
     with tarfile.open(path, "w") as tar:
-        names = {"train": ["a1", "a2", "a3"], "val": ["b1"]}
+        # the real archive ships train/val/trainval listings; the
+        # reference mode map reads trainval for 'train' and train for
+        # 'test' (voc2012.py:37)
+        names = {"train": ["a1", "a2"], "val": ["b1"],
+                 "trainval": ["a1", "a2", "b1"]}
         for split, ns in names.items():
             _add(tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/"
                  f"{split}.txt", ("\n".join(ns) + "\n").encode())
-        for n in names["train"] + names["val"]:
+        for n in names["trainval"]:
             _add(tar, f"VOCdevkit/VOC2012/JPEGImages/{n}.jpg",
                  _jpg_bytes(hash(n) % 100, size=(24, 20)))
             mask = rng.randint(0, 21, (24, 20)).astype("uint8")
@@ -94,13 +98,15 @@ def test_voc2012_real_archive(voc_fixture):
     from paddle_tpu.vision.datasets import VOC2012
 
     train = VOC2012(data_file=voc_fixture, mode="train")
-    assert len(train) == 3
+    assert len(train) == 3  # trainval (reference mode map)
     img, mask = train[0]
     assert img.shape == (24, 20, 3) and img.dtype == np.uint8
     assert mask.shape == (24, 20) and mask.dtype == np.int64
     assert mask.max() < 21
     val = VOC2012(data_file=voc_fixture, mode="valid")
     assert len(val) == 1
+    test = VOC2012(data_file=voc_fixture, mode="test")
+    assert len(test) == 2  # reference serves the train split for test
 
 
 @pytest.fixture

@@ -274,3 +274,58 @@ def test_zigzag_ring_flash_matches_dense():
         np.testing.assert_allclose(np.asarray(gm)[:, inv],
                                    np.asarray(gr), rtol=5e-3, atol=5e-3,
                                    err_msg=f"d{name} mismatch")
+
+
+def test_sdpa_flash_autoselect_heuristic(monkeypatch):
+    """use_flash tri-state: None = auto (flash only at long key
+    lengths), True = force, False = never. Regression: the GPT config
+    flag was silently ignored on the main path before r4."""
+    import paddle_tpu.ops.pallas.flash_attention as fa
+    from paddle_tpu.ops import nn_functional as NF
+
+    calls = []
+    monkeypatch.setattr(fa, "flash_attention_supported",
+                        lambda *a, **k: True)
+    monkeypatch.setattr(
+        fa, "flash_attention",
+        lambda q, k, v, causal=False, scale=None: calls.append(1) or q)
+
+    q = jnp.zeros((1, 512, 2, 64))
+    NF.scaled_dot_product_attention(q, q, q)  # auto, short: XLA path
+    assert not calls
+    NF.scaled_dot_product_attention(q, q, q, use_flash=True)  # forced
+    assert len(calls) == 1
+    long_q = jnp.zeros((1, 4096, 2, 64))
+    NF.scaled_dot_product_attention(long_q, long_q, long_q)  # auto, long
+    assert len(calls) == 2
+    NF.scaled_dot_product_attention(long_q, long_q, long_q,
+                                    use_flash=False)
+    assert len(calls) == 2
+
+
+def test_gpt_flash_flag_plumbs_to_attention(monkeypatch):
+    """GPTConfig(use_flash_attention=False) must actually bypass the
+    flash kernel even where the auto heuristic would pick it."""
+    import paddle_tpu as pt
+    import paddle_tpu.ops.pallas.flash_attention as fa
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    monkeypatch.setattr(fa, "flash_attention_supported",
+                        lambda *a, **k: True)
+
+    def boom(*a, **k):
+        raise AssertionError("flash kernel reached with flag off")
+
+    monkeypatch.setattr(fa, "flash_attention", boom)
+    monkeypatch.setenv("PT_FLASH_MIN_SEQ", "1")
+    # _FLASH_MIN_SEQ is read at import; patch the module constant too
+    from paddle_tpu.ops import nn_functional as NF
+    monkeypatch.setattr(NF, "_FLASH_MIN_SEQ", 1)
+
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=16, dropout=0.0,
+                    attn_dropout=0.0, use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    ids = np.zeros((1, 16), np.int32)
+    float(m(pt.to_tensor(ids), labels=pt.to_tensor(ids)))  # no boom

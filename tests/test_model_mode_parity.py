@@ -180,3 +180,36 @@ def test_rnn_model_eager_vs_trainstep():
     step = TrainStep(m2, optim.Adam(learning_rate=1e-3), loss_fn)
     fused = [float(step(b)) for b in batches]
     np.testing.assert_allclose(fused, eager, rtol=2e-4, atol=2e-5)
+
+
+def test_bert_masked_positions_head_matches_full():
+    """The gathered MLM head (reference max_predictions_per_seq data
+    format) computes the same loss as the full-sequence head when the
+    positions cover exactly the labeled slots."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.bert import BertForPretraining, bert_tiny
+
+    pt.seed(0)
+    m = BertForPretraining(bert_tiny())
+    rng = np.random.default_rng(0)
+    B, S, K = 2, 32, 5
+    ids = rng.integers(0, 128, (B, S)).astype(np.int32)
+    pos = np.stack([rng.choice(S, K, replace=False) for _ in range(B)]) \
+        .astype(np.int32)
+    labels_full = np.full((B, S), -100, np.int64)
+    for b in range(B):
+        labels_full[b, pos[b]] = ids[b, pos[b]]
+
+    l_full = float(m(pt.to_tensor(ids), labels=pt.to_tensor(labels_full)))
+    l_gath = float(m(pt.to_tensor(ids),
+                     masked_positions=pt.to_tensor(pos),
+                     labels=pt.to_tensor(labels_full)))
+    np.testing.assert_allclose(l_gath, l_full, rtol=1e-5)
+    # gathered labels [B, K] work too
+    l_gath2 = float(m(pt.to_tensor(ids),
+                      masked_positions=pt.to_tensor(pos),
+                      labels=pt.to_tensor(
+                          np.take_along_axis(labels_full, pos, 1))))
+    np.testing.assert_allclose(l_gath2, l_full, rtol=1e-5)

@@ -69,18 +69,27 @@ def test_cpu_smoke_gate_against_committed_baseline(tmp_path, ops):
     variance is real, silent O(n^2) regressions are what this catches.
     The TPU baseline is gated the same way by tools/op_benchmark_tpu.sh
     on chip-attached hosts (the driver-visible path)."""
-    r = subprocess.run(
-        [sys.executable, os.path.join(TOOLS, "op_benchmark.py"),
-         "--platform", "cpu", "--ops", ops, "--repeat", "10",
-         "--output", str(tmp_path / "pr")],
-        capture_output=True, text=True,
-        env={**os.environ, "JAX_PLATFORMS": "cpu"})
-    assert r.returncode == 0, r.stderr[-2000:]
-
     from check_op_benchmark_result import compare, load_logs_dir
+
+    def measure(out_dir):
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "op_benchmark.py"),
+             "--platform", "cpu", "--ops", ops, "--repeat", "10",
+             "--output", str(out_dir)],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stderr[-2000:]
+        return load_logs_dir(str(out_dir))
+
     dev = load_logs_dir(os.path.join(TOOLS, "op_baselines", "cpu_smoke"))
     dev = {k: v for k, v in dev.items() if k in ops.split(",")}
-    pr = load_logs_dir(str(tmp_path / "pr"))
-    failures, checked = compare(dev, pr, threshold=4.0)
+    failures, checked = compare(dev, measure(tmp_path / "pr"),
+                                threshold=4.0)
     assert checked == len(ops.split(","))
+    if failures:
+        # a transient host-load spike (e.g. a concurrent test lane) can
+        # blow even the 4x catastrophic threshold; a regression in the
+        # op itself reproduces on an immediate second measurement
+        failures, _ = compare(dev, measure(tmp_path / "pr2"),
+                              threshold=4.0)
     assert not failures, failures

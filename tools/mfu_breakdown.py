@@ -122,9 +122,12 @@ def resnet_step_time_ms(data_format="NCHW", batch=128, steps=16, windows=3,
     return dt / steps * 1e3
 
 
-def bert_step_time_ms(batch=32, seq=512, steps=8, windows=3):
+def bert_step_time_ms(batch=32, seq=512, steps=8, windows=3,
+                      max_preds=0):
     """BERT-base MLM pretrain step (bench_all's config) at a given
-    batch, on the same floor-subtracted scan harness."""
+    batch, on the same floor-subtracted scan harness. ``max_preds``>0
+    uses the gathered MLM head (reference max_predictions_per_seq data
+    format)."""
     import jax.numpy as jnp
 
     import paddle_tpu as pt
@@ -138,20 +141,31 @@ def bert_step_time_ms(batch=32, seq=512, steps=8, windows=3):
                     attention_probs_dropout_prob=0.0)
     model = BertForPretraining(cfg)
     _to_bf16_except_norms(model)
-    step = TrainStep(model, optim.AdamW(learning_rate=1e-4),
-                     lambda m, b: m(b[0], labels=b[1]))
+    if max_preds:
+        step = TrainStep(
+            model, optim.AdamW(learning_rate=1e-4),
+            lambda m, b: m(b[0], masked_positions=b[1], labels=b[2]))
+    else:
+        step = TrainStep(model, optim.AdamW(learning_rate=1e-4),
+                         lambda m, b: m(b[0], labels=b[1]))
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
-    labels = np.where(rng.random((batch, seq)) < 0.15, ids,
-                      -100).astype(np.int64)
-    xd, yd = jnp.asarray(ids), jnp.asarray(labels)
-    xs, ys = jnp.stack([xd] * steps), jnp.stack([yd] * steps)
-    run = lambda: float(step.multi_step((xs, ys))[-1])  # noqa: E731
+    if max_preds:
+        pos = np.stack([rng.choice(seq, max_preds, replace=False)
+                        for _ in range(batch)]).astype(np.int32)
+        labels = np.take_along_axis(ids, pos, 1).astype(np.int64)
+        batch_np = (ids, pos, labels)
+    else:
+        labels = np.where(rng.random((batch, seq)) < 0.15, ids,
+                          -100).astype(np.int64)
+        batch_np = (ids, labels)
+    staged = tuple(jnp.asarray(np.stack([a] * steps)) for a in batch_np)
+    run = lambda: float(step.multi_step(staged)[-1])  # noqa: E731
     run()
     dt, _ = _timed_windows(run, n_windows=windows, on_tpu=True)
-    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    flops_tok = 6.0 * n_params + 12.0 * cfg.num_hidden_layers * \
-        cfg.hidden_size * seq
+    from bench_all import bert_executed_flops_per_token
+    flops_tok = bert_executed_flops_per_token(model, cfg, seq,
+                                              max_preds or seq)
     return dt / steps * 1e3, flops_tok
 
 
@@ -163,16 +177,27 @@ def bert_main(args):
                          "dtype": "bfloat16",
                          "hardware": "TPU v5e 1 chip (tunneled)"},
               "variants": {}}
-    for b in (16, 32, 64, 128):
-        ms, flops_tok = bert_step_time_ms(batch=b)
+    cases = [(f"b{b}_s512_full_head", b, 0) for b in (16, 32, 64)]
+    cases += [(f"b{b}_s512_gathered_head", b, 76) for b in (16, 32, 64)]
+    for name, b, mp in cases:
+        try:
+            ms, flops_tok = bert_step_time_ms(batch=b, steps=16,
+                                              max_preds=mp)
+        except Exception as e:  # OOM at the top of the sweep, keep rest
+            report["variants"][name] = {
+                "error": f"{type(e).__name__}: {str(e)[:160]}"}
+            continue
         tok_s = b * 512 / (ms / 1e3)
-        report["variants"][f"b{b}_s512"] = {
+        report["variants"][name] = {
             "step_ms": round(ms, 2), "tokens_per_s": round(tok_s, 1),
             "mfu_pct": round(100 * tok_s * flops_tok / peak, 2)}
     report["reading"] = (
         "batch sweep at the reference pretrain phase-2 shape (S=512); "
         "floor-subtracted windows (the committed r3 39.6% carried ~9% "
-        "tunnel dispatch tax)")
+        "tunnel dispatch tax). MFU counts EXECUTED matmul+attention "
+        "FLOPs (no credit for embedding lookups or skipped head "
+        "positions): gathered_head raises tokens/s at ~equal MFU — the "
+        "h=768 encoder body is the efficiency ceiling on this chip.")
     print(json.dumps(report, indent=2))
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
