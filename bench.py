@@ -109,17 +109,17 @@ def main() -> None:
         # Measured sweep (v5e MFU): B1 67.5%, B2 72.3%, B3 70.1%;
         # longer-seq/no-remat: B2xS3072 70.3%, B1xS4096 71.2%;
         # selective remat: B4xS2048 every=3 62.8% — B2xS2048 no-remat is
-        # the sweet spot. The r3 ablation (tools/mfu_breakdown.py,
-        # PROFILE.json) then showed that at THIS config XLA's native
-        # attention beats the Pallas flash kernel by ~4 ms/step and the
-        # unchunked CE beats chunked-512 by ~9 ms/step (the [2,S,32k]
-        # logits fit fine): B2 73.7% vs 71.9%. Flash + chunked CE remain
-        # the long-sequence path (S>=4k: the S^2 score tensor and
-        # [B,S,V] logits stop fitting); here they are off on merit.
+        # the sweet spot. r4 correction: the use_flash_attention flag
+        # was silently ignored before r4, so EVERY number above (and
+        # the r2/r3 "XLA vs flash" ablation deltas, which were session
+        # noise) actually ran the Pallas flash kernel; with the flag
+        # live, the XLA-attention+full-logits program at this shape
+        # fails to even compile (remote-compile helper OOM). Flash is
+        # therefore explicit here — the truthfully-measured config.
         cfg = GPTConfig(vocab_size=32768, hidden_size=2048, num_layers=24,
                         num_heads=16, max_seq_len=2048, dropout=0.0,
                         attn_dropout=0.0, dtype="bfloat16",
-                        use_flash_attention=False, loss_chunk_size=0)
+                        use_flash_attention=True, loss_chunk_size=0)
         batch, seq, steps = 2, 2048, 8  # B2 measured peak
     else:  # CI smoke fallback
         from paddle_tpu.models import gpt_tiny

@@ -304,8 +304,15 @@ def bench_decode(on_tpu: bool) -> Dict:
                                          on_tpu=on_tpu)
             dt_full, _ = _timed_windows(lambda: run_n(new_toks),
                                         on_tpu=on_tpu)
-            per_tok = max(1e-9, dt_full - dt_short) / \
-                (new_toks - n_short)
+            if dt_full <= dt_short:  # tunnel stall inverted the pair
+                dt_short, _ = _timed_windows(lambda: run_n(n_short),
+                                             on_tpu=on_tpu)
+                dt_full, _ = _timed_windows(lambda: run_n(new_toks),
+                                            on_tpu=on_tpu)
+            assert dt_full > dt_short, (
+                "decode timing inverted twice (session too noisy to "
+                "report)", dt_full, dt_short)
+            per_tok = (dt_full - dt_short) / (new_toks - n_short)
         else:  # CPU smoke: sub-ms noise swamps the subtraction
             run_n(new_toks)
             dt, _ = _timed_windows(lambda: run_n(new_toks),

@@ -195,12 +195,12 @@ class GPTAttention(Layer):
                     qq, kk, vv, mode=self.seq_mode, causal=True),
                 "seq_parallel_attention", True, (q, k, v), {})
         else:
-            # config False disables flash outright; True defers to the
-            # seq-length auto heuristic (was silently ignored before r4)
+            # explicit both ways (the flag was silently ignored before
+            # r4 — every earlier benched config actually ran flash):
+            # True forces the flash kernel, False forces XLA attention
             out = F["scaled_dot_product_attention"](
                 q, k, v, is_causal=True, dropout_p=self.attn_dropout_p,
-                training=self.training,
-                use_flash=None if self.use_flash else False)
+                training=self.training, use_flash=bool(self.use_flash))
         out = F["reshape"](out, (b, s, self.num_heads * self.head_dim))
         out = self.out_proj(out)
         if use_cache:

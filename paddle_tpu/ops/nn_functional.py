@@ -775,9 +775,11 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
 # when shapes/backend allow.
 # --------------------------------------------------------------------------
 
-# Below this key length XLA's fused attention beats the Pallas flash
-# kernel on TPU (measured: GPT-1.3B S=2048 and BERT S=512 favor XLA;
-# S>=4096 needs flash for memory and wins on time).
+# Below this key length XLA's fused attention is competitive with the
+# Pallas flash kernel on TPU (measured: BERT S=512 XLA ~= flash,
+# PROFILE_BERT.json); at S>=4096 flash is REQUIRED — the S^2 scores
+# stop fitting (the GPT S=2048 XLA-vs-flash "measurement" was
+# invalidated in r4: see PROFILE.json r4_correction).
 _FLASH_MIN_SEQ = int(__import__("os").environ.get("PT_FLASH_MIN_SEQ",
                                                   "4096"))
 
@@ -794,7 +796,12 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     False = never. Flash requires no mask and no active dropout."""
     allowed = use_flash is True or (use_flash is None and
                                     k.shape[1] >= _FLASH_MIN_SEQ)
+    # the flash kernel's causal mask is diagonal-aligned: with sq != sk
+    # (a concatenated KV cache) it would mask from position 0 instead of
+    # offsetting by the cache length — the XLA path below applies the
+    # correct k=sk-sq shift, so causal cross-length stays off flash
     if (allowed and attn_mask is None and
+            (not is_causal or q.shape[1] == k.shape[1]) and
             (dropout_p == 0.0 or not training)):
         from .pallas.flash_attention import (flash_attention,
                                              flash_attention_supported)

@@ -1,0 +1,26 @@
+"""Shared NHWC-internal / NCHW-public boundary helpers for the vision
+zoo (ResNet/MobileNet/VGG data_format="NHWC"): the network runs
+channel-last (the TPU-fast layout) and transposes once at each model
+boundary so the public contract stays NCHW."""
+
+from ... import dispatch
+
+
+def boundary_in(x, data_format):
+    if data_format == "NHWC":
+        return dispatch.wrapped_ops["transpose"](x, [0, 2, 3, 1])
+    return x
+
+
+def boundary_out(x, data_format):
+    if data_format == "NHWC":
+        return dispatch.wrapped_ops["transpose"](x, [0, 3, 1, 2])
+    return x
+
+
+def flatten_nchw_order(x, data_format, spatial_is_1x1):
+    """Flatten to [N, C*H*W] in the NCHW order the classifier weights
+    expect; a 1x1 spatial map flattens identically in both layouts."""
+    if data_format == "NHWC" and not spatial_is_1x1:
+        x = dispatch.wrapped_ops["transpose"](x, [0, 3, 1, 2])
+    return dispatch.wrapped_ops["flatten"](x, 1)

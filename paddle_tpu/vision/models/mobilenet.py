@@ -1,16 +1,25 @@
 """MobileNet v1/v2 (reference: python/paddle/vision/models/mobilenetv1.py,
-mobilenetv2.py)."""
+mobilenetv2.py).
+
+``data_format="NHWC"`` runs the network channel-last (the TPU-fast
+layout, like ResNet's) while the public input/output contract stays
+NCHW: one transpose at each model boundary.
+"""
 
 from ... import nn
+from ._layout import (boundary_in as _nchw_boundary_in,
+                      boundary_out as _nchw_boundary_out)
+from ._layout import flatten_nchw_order
 
 
 class _ConvBNRelu(nn.Layer):
     def __init__(self, in_c, out_c, k, stride=1, padding=0, groups=1,
-                 relu6=False):
+                 relu6=False, data_format="NCHW"):
         super().__init__()
         self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
-                              groups=groups, bias_attr=False)
-        self.bn = nn.BatchNorm2D(out_c)
+                              groups=groups, bias_attr=False,
+                              data_format=data_format)
+        self.bn = nn.BatchNorm2D(out_c, data_format=data_format)
         self.act = nn.ReLU6() if relu6 else nn.ReLU()
 
     def forward(self, x):
@@ -18,20 +27,23 @@ class _ConvBNRelu(nn.Layer):
 
 
 class _DepthwiseSep(nn.Layer):
-    def __init__(self, in_c, out_c, stride):
+    def __init__(self, in_c, out_c, stride, data_format="NCHW"):
         super().__init__()
-        self.dw = _ConvBNRelu(in_c, in_c, 3, stride, 1, groups=in_c)
-        self.pw = _ConvBNRelu(in_c, out_c, 1)
+        self.dw = _ConvBNRelu(in_c, in_c, 3, stride, 1, groups=in_c,
+                              data_format=data_format)
+        self.pw = _ConvBNRelu(in_c, out_c, 1, data_format=data_format)
 
     def forward(self, x):
         return self.pw(self.dw(x))
 
 
 class MobileNetV1(nn.Layer):
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True,
+                 data_format="NCHW"):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
+        self.data_format = data_format
 
         def c(ch):
             return max(int(ch * scale), 8)
@@ -39,38 +51,46 @@ class MobileNetV1(nn.Layer):
         cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
                (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
             [(512, 1024, 2), (1024, 1024, 1)]
-        layers = [_ConvBNRelu(3, c(32), 3, 2, 1)]
+        layers = [_ConvBNRelu(3, c(32), 3, 2, 1, data_format=data_format)]
         for in_c, out_c, s in cfg:
-            layers.append(_DepthwiseSep(c(in_c), c(out_c), s))
+            layers.append(_DepthwiseSep(c(in_c), c(out_c), s,
+                                        data_format=data_format))
         self.features = nn.Sequential(*layers)
         if with_pool:
-            self.pool = nn.AdaptiveAvgPool2D(1)
+            self.pool = nn.AdaptiveAvgPool2D(1, data_format=data_format)
         if num_classes > 0:
             self.fc = nn.Linear(c(1024), num_classes)
 
     def forward(self, x):
+        x = _nchw_boundary_in(x, self.data_format)
         x = self.features(x)
         if self.with_pool:
             x = self.pool(x)
         if self.num_classes > 0:
             from ... import dispatch
-            x = dispatch.wrapped_ops["flatten"](x, 1)
+            x = flatten_nchw_order(x, self.data_format, self.with_pool)
             x = self.fc(x)
+        else:
+            x = _nchw_boundary_out(x, self.data_format)
         return x
 
 
 class _InvertedResidual(nn.Layer):
-    def __init__(self, in_c, out_c, stride, expand_ratio):
+    def __init__(self, in_c, out_c, stride, expand_ratio,
+                 data_format="NCHW"):
         super().__init__()
         hidden = int(round(in_c * expand_ratio))
         self.use_res = stride == 1 and in_c == out_c
         layers = []
         if expand_ratio != 1:
-            layers.append(_ConvBNRelu(in_c, hidden, 1, relu6=True))
+            layers.append(_ConvBNRelu(in_c, hidden, 1, relu6=True,
+                                      data_format=data_format))
         layers.append(_ConvBNRelu(hidden, hidden, 3, stride, 1,
-                                  groups=hidden, relu6=True))
-        layers.append(nn.Conv2D(hidden, out_c, 1, bias_attr=False))
-        layers.append(nn.BatchNorm2D(out_c))
+                                  groups=hidden, relu6=True,
+                                  data_format=data_format))
+        layers.append(nn.Conv2D(hidden, out_c, 1, bias_attr=False,
+                                data_format=data_format))
+        layers.append(nn.BatchNorm2D(out_c, data_format=data_format))
         self.conv = nn.Sequential(*layers)
 
     def forward(self, x):
@@ -79,10 +99,12 @@ class _InvertedResidual(nn.Layer):
 
 
 class MobileNetV2(nn.Layer):
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True,
+                 data_format="NCHW"):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
+        self.data_format = data_format
         cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
                (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
 
@@ -90,31 +112,36 @@ class MobileNetV2(nn.Layer):
             return max(int(ch * scale), 8)
 
         in_c = c(32)
-        layers = [_ConvBNRelu(3, in_c, 3, 2, 1, relu6=True)]
+        layers = [_ConvBNRelu(3, in_c, 3, 2, 1, relu6=True,
+                              data_format=data_format)]
         for t, ch, n, s in cfg:
             out_c = c(ch)
             for i in range(n):
                 layers.append(_InvertedResidual(in_c, out_c,
-                                                s if i == 0 else 1, t))
+                                                s if i == 0 else 1, t,
+                                                data_format=data_format))
                 in_c = out_c
         self.last_c = c(1280) if scale > 1.0 else 1280
-        layers.append(_ConvBNRelu(in_c, self.last_c, 1, relu6=True))
+        layers.append(_ConvBNRelu(in_c, self.last_c, 1, relu6=True,
+                                  data_format=data_format))
         self.features = nn.Sequential(*layers)
         if with_pool:
-            self.pool = nn.AdaptiveAvgPool2D(1)
+            self.pool = nn.AdaptiveAvgPool2D(1, data_format=data_format)
         if num_classes > 0:
             self.classifier = nn.Sequential(nn.Dropout(0.2),
                                             nn.Linear(self.last_c,
                                                       num_classes))
 
     def forward(self, x):
+        x = _nchw_boundary_in(x, self.data_format)
         x = self.features(x)
         if self.with_pool:
             x = self.pool(x)
         if self.num_classes > 0:
-            from ... import dispatch
-            x = dispatch.wrapped_ops["flatten"](x, 1)
+            x = flatten_nchw_order(x, self.data_format, self.with_pool)
             x = self.classifier(x)
+        else:
+            x = _nchw_boundary_out(x, self.data_format)
         return x
 
 

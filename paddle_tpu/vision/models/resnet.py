@@ -123,13 +123,8 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        from ... import dispatch
-        F = dispatch.wrapped_ops
-        nhwc = self.data_format == "NHWC"
-        if nhwc:
-            # Public contract stays NCHW; one boundary transpose puts the
-            # whole network in the TPU-fast channel-last layout.
-            x = F["transpose"](x, [0, 2, 3, 1])
+        from ._layout import boundary_in, boundary_out, flatten_nchw_order
+        x = boundary_in(x, self.data_format)
         x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
         x = self.layer1(x)
@@ -139,15 +134,10 @@ class ResNet(nn.Layer):
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
-            if nhwc and not self.with_pool:
-                # un-pooled flatten order must match the NCHW contract
-                x = F["transpose"](x, [0, 3, 1, 2])
-                nhwc = False
-            x = dispatch.wrapped_ops["flatten"](x, 1)
+            x = flatten_nchw_order(x, self.data_format, self.with_pool)
             x = self.fc(x)
-        elif nhwc:
-            # feature-extractor exit: restore the public NCHW layout
-            x = F["transpose"](x, [0, 3, 1, 2])
+        else:
+            x = boundary_out(x, self.data_format)
         return x
 
 

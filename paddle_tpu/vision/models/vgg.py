@@ -13,29 +13,33 @@ _CFGS = {
 }
 
 
-def _make_features(cfg, batch_norm=False):
+def _make_features(cfg, batch_norm=False, data_format="NCHW"):
     layers = []
     in_c = 3
     for v in cfg:
         if v == "M":
-            layers.append(nn.MaxPool2D(2, 2))
+            layers.append(nn.MaxPool2D(2, 2, data_format=data_format))
         else:
-            layers.append(nn.Conv2D(in_c, v, 3, padding=1))
+            layers.append(nn.Conv2D(in_c, v, 3, padding=1,
+                                    data_format=data_format))
             if batch_norm:
-                layers.append(nn.BatchNorm2D(v))
+                layers.append(nn.BatchNorm2D(v, data_format=data_format))
             layers.append(nn.ReLU())
             in_c = v
     return nn.Sequential(*layers)
 
 
 class VGG(nn.Layer):
-    def __init__(self, features, num_classes=1000, with_pool=True):
+    def __init__(self, features, num_classes=1000, with_pool=True,
+                 data_format="NCHW"):
         super().__init__()
         self.features = features
         self.with_pool = with_pool
         self.num_classes = num_classes
+        self.data_format = data_format
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+            self.avgpool = nn.AdaptiveAvgPool2D((7, 7),
+                                                data_format=data_format)
         if num_classes > 0:
             self.classifier = nn.Sequential(
                 nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(),
@@ -43,27 +47,39 @@ class VGG(nn.Layer):
                 nn.Linear(4096, num_classes))
 
     def forward(self, x):
+        from ._layout import boundary_in, boundary_out, flatten_nchw_order
+        x = boundary_in(x, self.data_format)
         x = self.features(x)
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
-            from ... import dispatch
-            x = dispatch.wrapped_ops["flatten"](x, 1)
+            # the 7x7 pooled map is NOT 1x1: flatten in NCHW order
+            x = flatten_nchw_order(x, self.data_format, False)
             x = self.classifier(x)
+        else:
+            x = boundary_out(x, self.data_format)
         return x
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_make_features(_CFGS["A"], batch_norm), **kwargs)
+    fmt = kwargs.get("data_format", "NCHW")
+    return VGG(_make_features(_CFGS["A"], batch_norm, fmt),
+               **kwargs)
 
 
 def vgg13(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_make_features(_CFGS["B"], batch_norm), **kwargs)
+    fmt = kwargs.get("data_format", "NCHW")
+    return VGG(_make_features(_CFGS["B"], batch_norm, fmt),
+               **kwargs)
 
 
 def vgg16(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_make_features(_CFGS["D"], batch_norm), **kwargs)
+    fmt = kwargs.get("data_format", "NCHW")
+    return VGG(_make_features(_CFGS["D"], batch_norm, fmt),
+               **kwargs)
 
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_make_features(_CFGS["E"], batch_norm), **kwargs)
+    fmt = kwargs.get("data_format", "NCHW")
+    return VGG(_make_features(_CFGS["E"], batch_norm, fmt),
+               **kwargs)

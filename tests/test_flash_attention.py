@@ -329,3 +329,34 @@ def test_gpt_flash_flag_plumbs_to_attention(monkeypatch):
     m = GPTForCausalLM(cfg)
     ids = np.zeros((1, 16), np.int32)
     float(m(pt.to_tensor(ids), labels=pt.to_tensor(ids)))  # no boom
+
+
+def test_sdpa_causal_kv_cache_never_uses_flash(monkeypatch):
+    """Causal attention with sq != sk (a concatenated KV cache) must not
+    route to the flash kernel: its diagonal-aligned causal mask has no
+    cache-length offset (regression: silent wrong outputs in the GPT
+    dynamic-cache path with use_flash forced)."""
+    import paddle_tpu.ops.pallas.flash_attention as fa
+    from paddle_tpu.ops import nn_functional as NF
+
+    monkeypatch.setattr(fa, "flash_attention_supported",
+                        lambda *a, **k: True)
+
+    def boom(*a, **k):
+        raise AssertionError("flash taken for causal sq != sk")
+
+    monkeypatch.setattr(fa, "flash_attention", boom)
+    q = jnp.zeros((1, 128, 2, 64))
+    kv = jnp.zeros((1, 256, 2, 64))
+    out = NF.scaled_dot_product_attention(q, kv, kv, is_causal=True,
+                                          use_flash=True)
+    assert out.shape == q.shape
+    # and the XLA path applies the cache offset: the first new token
+    # (global position 128) must see all 129 visible keys, not just 1
+    qv = jnp.ones((1, 1, 1, 4))
+    kvv = jnp.asarray(
+        np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1) *
+        jnp.ones((1, 8, 1, 4)))
+    got = NF.scaled_dot_product_attention(qv, kvv, kvv, is_causal=True,
+                                          use_flash=False)
+    assert float(got[0, 0, 0, 0]) > 0  # attends beyond position 0

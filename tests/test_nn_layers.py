@@ -293,3 +293,39 @@ def test_resnet_nhwc_exit_layouts_match_nchw():
                                                     ob.shape)
         np.testing.assert_allclose(oa.numpy(), ob.numpy(), rtol=2e-3,
                                    atol=2e-3, err_msg=str(kwargs))
+
+
+def test_mobilenet_nhwc_matches_nchw():
+    import numpy as np
+
+    from paddle_tpu.vision.models import mobilenet_v1, mobilenet_v2
+
+    x = pt.to_tensor(np.random.default_rng(1).standard_normal(
+        (2, 3, 64, 64)).astype(np.float32))
+    for ctor in (mobilenet_v1, mobilenet_v2):
+        pt.seed(0)
+        a = ctor(scale=0.25, num_classes=10)
+        pt.seed(0)
+        b = ctor(scale=0.25, num_classes=10, data_format="NHWC")
+        b.set_state_dict(a.state_dict())
+        a.eval(); b.eval()
+        oa, ob = a(x), b(x)
+        np.testing.assert_allclose(oa.numpy(), ob.numpy(), rtol=2e-3,
+                                   atol=2e-3, err_msg=ctor.__name__)
+
+
+def test_vgg_nhwc_matches_nchw():
+    import numpy as np
+
+    from paddle_tpu.vision.models import vgg11
+
+    x = pt.to_tensor(np.random.default_rng(2).standard_normal(
+        (1, 3, 32, 32)).astype(np.float32))
+    pt.seed(0)
+    a = vgg11(num_classes=0, with_pool=False)
+    pt.seed(0)
+    b = vgg11(num_classes=0, with_pool=False, data_format="NHWC")
+    b.set_state_dict(a.state_dict())
+    a.eval(); b.eval()
+    np.testing.assert_allclose(a(x).numpy(), b(x).numpy(), rtol=2e-3,
+                               atol=2e-3)
