@@ -309,9 +309,14 @@ def bench_decode(on_tpu: bool) -> Dict:
                                              on_tpu=on_tpu)
                 dt_full, _ = _timed_windows(lambda: run_n(new_toks),
                                             on_tpu=on_tpu)
-            assert dt_full > dt_short, (
-                "decode timing inverted twice (session too noisy to "
-                "report)", dt_full, dt_short)
+            if dt_full <= dt_short:
+                # twice-inverted: record this batch as unusable but keep
+                # the other batch sizes' completed measurements
+                out["by_batch"][str(b)] = {
+                    "error": "timing inverted twice (session too noisy)",
+                    "dt_full_s": round(dt_full, 4),
+                    "dt_short_s": round(dt_short, 4)}
+                continue
             per_tok = (dt_full - dt_short) / (new_toks - n_short)
         else:  # CPU smoke: sub-ms noise swamps the subtraction
             run_n(new_toks)
@@ -321,8 +326,9 @@ def bench_decode(on_tpu: bool) -> Dict:
         out["by_batch"][str(b)] = {
             "tokens_per_s": round(b / per_tok, 1),
             "ms_per_token": round(per_tok * 1e3, 3)}
-    best = max(v["tokens_per_s"] for v in out["by_batch"].values())
-    out["value"] = best
+    ok = [v["tokens_per_s"] for v in out["by_batch"].values()
+          if "tokens_per_s" in v]
+    out["value"] = max(ok) if ok else 0.0
     return out
 
 

@@ -113,22 +113,50 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--elastic", action="store_true",
                         help="restart failed jobs from checkpoints")
     parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("--membership", type=str, default=None,
+                        help="elastic membership registry: 'serve' hosts "
+                             "a TCP MembershipServer here (node 0) and "
+                             "exports PT_MEMBER_EP to workers; "
+                             "'host:port' points workers at a registry "
+                             "served elsewhere (the etcd analog — no "
+                             "shared filesystem needed)")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
+    member_srv = None
+    prev_member_ep = os.environ.get("PT_MEMBER_EP")
+    if args.membership == "serve":
+        from .elastic import MembershipServer
+        member_srv = MembershipServer()
+        os.environ["PT_MEMBER_EP"] = f"127.0.0.1:{member_srv.port}"
+        print(f"membership registry serving on port {member_srv.port}",
+              file=sys.stderr)
+    elif args.membership:
+        os.environ["PT_MEMBER_EP"] = args.membership
+
     entry = [args.training_script] + args.training_script_args
     restarts = 0
-    while True:
-        procs = launch_procs(entry, args.nproc, args.coordinator,
-                             args.log_dir)
-        code = watch_procs(procs)
-        if code == 0 or not args.elastic or restarts >= args.max_restarts:
-            return code
-        restarts += 1
-        print(f"elastic: restarting job (attempt {restarts}/"
-              f"{args.max_restarts})", file=sys.stderr)
-        time.sleep(2.0)
+    try:
+        while True:
+            procs = launch_procs(entry, args.nproc, args.coordinator,
+                                 args.log_dir)
+            code = watch_procs(procs)
+            if code == 0 or not args.elastic or \
+                    restarts >= args.max_restarts:
+                return code
+            restarts += 1
+            print(f"elastic: restarting job (attempt {restarts}/"
+                  f"{args.max_restarts})", file=sys.stderr)
+            time.sleep(2.0)
+    finally:
+        if member_srv is not None:
+            member_srv.close()
+        if args.membership:  # don't leak a dead endpoint to later
+            if prev_member_ep is None:  # in-process launch_main callers
+                os.environ.pop("PT_MEMBER_EP", None)
+            else:
+                os.environ["PT_MEMBER_EP"] = prev_member_ep
 
 
 if __name__ == "__main__":

@@ -61,25 +61,22 @@ class VGG(nn.Layer):
         return x
 
 
-def vgg11(pretrained=False, batch_norm=False, **kwargs):
+def _vgg(cfg_key, batch_norm, **kwargs):
     fmt = kwargs.get("data_format", "NCHW")
-    return VGG(_make_features(_CFGS["A"], batch_norm, fmt),
-               **kwargs)
+    return VGG(_make_features(_CFGS[cfg_key], batch_norm, fmt), **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("A", batch_norm, **kwargs)
 
 
 def vgg13(pretrained=False, batch_norm=False, **kwargs):
-    fmt = kwargs.get("data_format", "NCHW")
-    return VGG(_make_features(_CFGS["B"], batch_norm, fmt),
-               **kwargs)
+    return _vgg("B", batch_norm, **kwargs)
 
 
 def vgg16(pretrained=False, batch_norm=False, **kwargs):
-    fmt = kwargs.get("data_format", "NCHW")
-    return VGG(_make_features(_CFGS["D"], batch_norm, fmt),
-               **kwargs)
+    return _vgg("D", batch_norm, **kwargs)
 
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
-    fmt = kwargs.get("data_format", "NCHW")
-    return VGG(_make_features(_CFGS["E"], batch_norm, fmt),
-               **kwargs)
+    return _vgg("E", batch_norm, **kwargs)

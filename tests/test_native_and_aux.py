@@ -296,3 +296,26 @@ def test_tcp_membership_ttl_prunes_dead_rank():
         assert sorted(st.members("jobD")) == [0]
     finally:
         srv.close()
+
+
+def test_launcher_serves_membership_registry():
+    """--membership serve: the launcher hosts the TCP registry and
+    exports PT_MEMBER_EP; workers register over the wire only."""
+    from paddle_tpu.distributed.launch import main as launch_main
+
+    with tempfile.TemporaryDirectory() as d:
+        script = os.path.join(d, "worker.py")
+        with open(script, "w") as f:
+            f.write(
+                "import os, sys\n"
+                f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+                "from paddle_tpu.distributed.elastic import "
+                "TcpMembershipStore\n"
+                "st = TcpMembershipStore(os.environ['PT_MEMBER_EP'])\n"
+                "rank = int(os.environ['PT_PROCESS_ID'])\n"
+                "st.register('jobL', rank, {})\n"
+                "assert rank in st.members('jobL')\n")
+        code = launch_main(["--nproc", "2", "--coordinator",
+                            "127.0.0.1:29502", "--log_dir", d,
+                            "--membership", "serve", script])
+        assert code == 0, open(os.path.join(d, "workerlog.0")).read()

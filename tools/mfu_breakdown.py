@@ -213,7 +213,8 @@ def bert_main(args):
         "positions): gathered_head raises tokens/s at ~equal MFU — the "
         "h=768 encoder body is the efficiency ceiling on this chip.")
     V = report["variants"]
-    best_full = max((v for v in V.values() if "mfu_pct" in v),
+    best_full = max((v for k, v in V.items()
+                     if "full_head" in k and "mfu_pct" in v),
                     key=lambda v: v["mfu_pct"], default=None)
     body = V.get("b64_s512_body_only_no_head")
     gath = V.get("b64_s512_gathered_head")
