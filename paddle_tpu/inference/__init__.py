@@ -9,6 +9,7 @@ AOT compile of the exported program; precision switching is a dtype cast
 at load; zero-copy handles are device arrays.
 """
 
+from .fusion import fuse_conv_bn  # noqa: F401 (conv_bn_fuse_pass analog)
 from .predictor import (Config, DataType, PlaceType, PrecisionType,
                         Predictor, PredictorPool, Tensor,
                         Tensor as InferTensor, create_predictor,

@@ -334,10 +334,25 @@ class Executor:
 
 
 def save_inference_model(path_prefix: str, feed_vars, fetch_vars=None,
-                         executor=None, program=None, layer=None) -> None:
+                         executor=None, program=None, layer=None,
+                         optimize: bool = True) -> None:
     """reference: paddle.static.save_inference_model / fluid/io.py:1246.
-    Accepts either a prebuilt Program or (layer, input_specs)."""
+    Accepts either a prebuilt Program or (layer, input_specs).
+
+    ``optimize=True`` (default, matching the reference's inference
+    analysis passes) runs eval-graph fusions on a COPY of the layer
+    before tracing — currently conv+BN folding
+    (inference/fusion.py, the conv_bn_fuse_pass analog); the caller's
+    layer is never mutated."""
     if program is None:
+        if layer is not None and optimize and not layer.training:
+            from ..inference.fusion import find_foldable_pairs, fuse_conv_bn
+            if next(find_foldable_pairs(layer), None) is not None:
+                # pay the model deepcopy only when something will fold
+                import copy
+                folded = copy.deepcopy(layer)
+                fuse_conv_bn(folded)
+                layer = folded
         specs = [v if isinstance(v, InputSpec) else InputSpec.from_tensor(v)
                  for v in feed_vars]
         program = build_program(layer, specs)

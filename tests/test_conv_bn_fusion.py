@@ -1,0 +1,112 @@
+"""Conv+BN folding (the reference conv_bn_fuse_pass analog,
+paddle/fluid/framework/ir/conv_bn_fuse_pass.h): eval-graph algebra that
+removes every BatchNorm HBM pass from inference."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.inference import fuse_conv_bn
+
+
+def _warm_stats(m, x, steps=3):
+    m.train()
+    for _ in range(steps):
+        m(x)
+    m.eval()
+
+
+def test_fold_sequential_pair():
+    pt.seed(0)
+    m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8),
+                      nn.ReLU())
+    x = pt.to_tensor(np.random.default_rng(0).standard_normal(
+        (2, 3, 8, 8)).astype(np.float32))
+    _warm_stats(m, x)
+    ref = m(x).numpy()
+    assert fuse_conv_bn(m) == 1
+    np.testing.assert_allclose(m(x).numpy(), ref, rtol=2e-5, atol=2e-5)
+    assert not any(isinstance(s, nn.BatchNorm2D)
+                   for s in m._sub_layers.values())
+
+
+@pytest.mark.parametrize("family", ["resnet", "mobilenet_v2", "vgg_bn"])
+def test_fold_model_zoo_parity(family):
+    from paddle_tpu.vision.models import mobilenet_v2, resnet18, vgg11
+
+    pt.seed(0)
+    if family == "resnet":
+        m = resnet18(num_classes=10)
+    elif family == "mobilenet_v2":
+        m = mobilenet_v2(scale=0.25, num_classes=10)
+    else:
+        m = vgg11(batch_norm=True, num_classes=0, with_pool=False)
+    x = pt.to_tensor(np.random.default_rng(1).standard_normal(
+        (2, 3, 32, 32)).astype(np.float32))
+    _warm_stats(m, x)
+    ref = m(x).numpy()
+    n = fuse_conv_bn(m)
+    assert n > 0, family
+    np.testing.assert_allclose(m(x).numpy(), ref, rtol=5e-4, atol=5e-4,
+                               err_msg=family)
+
+
+def test_fold_refuses_train_mode():
+    m = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4))
+    m.train()
+    with pytest.raises(RuntimeError):
+        fuse_conv_bn(m)
+
+
+def test_save_inference_model_folds_a_copy(tmp_path):
+    """optimize=True folds on a copy: saved program output matches and
+    the caller's model keeps its BatchNorms."""
+    from paddle_tpu import static
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.vision.models import resnet18
+
+    pt.seed(0)
+    m = resnet18(num_classes=10)
+    x = np.random.default_rng(2).standard_normal(
+        (1, 3, 32, 32)).astype(np.float32)
+    _warm_stats(m, pt.to_tensor(x))
+    ref = m(pt.to_tensor(x)).numpy()
+
+    prefix = str(tmp_path / "r18")
+    static.save_inference_model(
+        prefix, [static.InputSpec((1, 3, 32, 32), "float32", "x")],
+        layer=m)
+    # caller's model untouched
+    assert any(isinstance(s, nn.BatchNorm2D) for s in
+               (sub for _, sub in m.named_sublayers())), \
+        "caller's model was mutated"
+    cfg = Config(prefix)
+    cfg.disable_gpu()
+    pred = create_predictor(cfg)
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=5e-4,
+                               atol=5e-4)
+
+
+def test_fold_skips_channel_mismatch():
+    """A bn whose feature count differs from the conv's output channels
+    (the pre-activation in!=out case) must not fold."""
+
+    class PreAct(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn1 = nn.BatchNorm2D(3)   # normalizes the INPUT
+            self.conv1 = nn.Conv2D(3, 8, 3, padding=1)
+
+        def forward(self, x):
+            return self.conv1(pt.nn.functional.relu(self.bn1(x)))
+
+    pt.seed(0)
+    m = PreAct()
+    x = pt.to_tensor(np.random.default_rng(3).standard_normal(
+        (2, 3, 8, 8)).astype(np.float32))
+    _warm_stats(m, x)
+    ref = m(x).numpy()
+    assert fuse_conv_bn(m) == 0  # channel guard refuses
+    np.testing.assert_allclose(m(x).numpy(), ref, rtol=1e-6)
