@@ -107,7 +107,8 @@ def fuse_conv_bn(model: Layer) -> int:
 def find_foldable_pairs(model: Layer):
     """Read-only scan for (parent, kind, conv, bn, bn_key) fold sites —
     lets callers (save_inference_model) check BEFORE paying a deepcopy."""
-    for layer in _walk(model):
+    # snapshot list: safe even if a caller folds (mutates) while iterating
+    for layer in model.sublayers(include_self=True):
         # pattern 1: adjacent pairs inside a Sequential
         if isinstance(layer, Sequential):
             subs = list(layer._sub_layers.items())
@@ -120,8 +121,32 @@ def find_foldable_pairs(model: Layer):
                 yield layer, "attr", conv, bn, bn_name
 
 
-def _walk(layer: Layer):
-    yield layer
-    for _, sub in layer._sub_layers.items():
-        if isinstance(sub, Layer):
-            yield from _walk(sub)
+def fold_preserves_outputs(original: Layer, folded: Layer, example_inputs,
+                           rtol: float = 3e-2) -> bool:
+    """Numerically compare ``original`` vs ``folded`` eval forwards on
+    ``example_inputs``. The name-based convN/bnN pairing cannot
+    structurally distinguish a pre-activation block (bn BEFORE conv,
+    equal channel counts) from the post-norm convention it assumes — a
+    wrong fold there is algebraically different, not subtly off, so a
+    loose tolerance separates legal fp32/bf16 rounding drift from
+    corruption. Used by save_inference_model to refuse a bad fold."""
+    import numpy as np
+
+    from ..tensor import Tensor
+
+    def run(m):
+        outs = m(*example_inputs)
+        leaves = outs if isinstance(outs, (tuple, list)) else [outs]
+        return [np.asarray((o.value if isinstance(o, Tensor) else o),
+                           dtype=np.float32) for o in leaves]
+
+    ref, got = run(original), run(folded)
+    if len(ref) != len(got):
+        return False
+    for r, g in zip(ref, got):
+        if r.shape != g.shape:
+            return False
+        denom = np.maximum(np.abs(r), 1.0)
+        if not np.all(np.abs(r - g) / denom <= rtol):
+            return False
+    return True

@@ -775,13 +775,14 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
 # when shapes/backend allow.
 # --------------------------------------------------------------------------
 
-# Below this key length XLA's fused attention is competitive with the
-# Pallas flash kernel on TPU (measured: BERT S=512 XLA ~= flash,
-# PROFILE_BERT.json); at S>=4096 flash is REQUIRED — the S^2 scores
-# stop fitting (the GPT S=2048 XLA-vs-flash "measurement" was
-# invalidated in r4: see PROFILE.json r4_correction).
+# Flash-vs-XLA crossover, measured on v5e with a scanned fwd+bwd sweep
+# (r4): XLA's fused attention wins at S<=256 (flash/xla step ratio
+# 0.71-0.81 at d=64), flash wins from S=512 up (1.17-1.41x across d=64
+# and d=128, causal and not; BERT-base body: 243->216.6 ms/step), and
+# at S>=2048 the XLA path can stop compiling outright — the S^2 scores
+# no longer fit (PROFILE.json r4_correction).
 _FLASH_MIN_SEQ = int(__import__("os").environ.get("PT_FLASH_MIN_SEQ",
-                                                  "4096"))
+                                                  "512"))
 
 
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,

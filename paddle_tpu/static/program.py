@@ -333,6 +333,22 @@ class Executor:
                                  trainer_kwargs)
 
 
+def _example_input(v) -> Tensor:
+    """A concrete random input for a feed var (InputSpec or Tensor) —
+    used to numerically verify optimization passes before export."""
+    if isinstance(v, Tensor):
+        return v
+    sds = v.to_sds() if isinstance(v, InputSpec) else \
+        InputSpec.from_tensor(v).to_sds()
+    rng = np.random.default_rng(0)
+    if np.issubdtype(np.dtype(sds.dtype), np.integer) or \
+            sds.dtype == jnp.bool_:
+        arr = np.zeros(sds.shape, dtype=sds.dtype)
+    else:
+        arr = rng.standard_normal(sds.shape).astype(sds.dtype)
+    return Tensor(jnp.asarray(arr))
+
+
 def save_inference_model(path_prefix: str, feed_vars, fetch_vars=None,
                          executor=None, program=None, layer=None,
                          optimize: bool = True) -> None:
@@ -346,13 +362,26 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars=None,
     layer is never mutated."""
     if program is None:
         if layer is not None and optimize and not layer.training:
-            from ..inference.fusion import find_foldable_pairs, fuse_conv_bn
+            from ..inference.fusion import (find_foldable_pairs,
+                                            fold_preserves_outputs,
+                                            fuse_conv_bn)
             if next(find_foldable_pairs(layer), None) is not None:
                 # pay the model deepcopy only when something will fold
                 import copy
                 folded = copy.deepcopy(layer)
                 fuse_conv_bn(folded)
-                layer = folded
+                # the name-based pairing can mis-fold a pre-activation
+                # block (bn before conv, equal channels): verify on a
+                # random example and keep the unfused model on mismatch
+                example = [_example_input(v) for v in feed_vars]
+                if fold_preserves_outputs(layer, folded, example):
+                    layer = folded
+                else:
+                    import warnings
+                    warnings.warn(
+                        "conv+BN folding changed the model's outputs "
+                        "(pre-activation topology?); exporting UNFUSED. "
+                        "Pass optimize=False to silence this check.")
         specs = [v if isinstance(v, InputSpec) else InputSpec.from_tensor(v)
                  for v in feed_vars]
         program = build_program(layer, specs)

@@ -232,7 +232,11 @@ def test_book_image_classification_cifar():
             opt.step()
             opt.clear_grad()
             losses.append(float(loss.numpy()))
-    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    # Average a window at each end: single-batch losses are noisy under
+    # shuffle=True and the global-RNG state depends on test ordering.
+    head = sum(losses[:5]) / 5
+    tail = sum(losses[-5:]) / 5
+    assert tail < head, (head, tail)
 
 
 def test_book_understand_sentiment_lstm():

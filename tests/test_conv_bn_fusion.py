@@ -110,3 +110,41 @@ def test_fold_skips_channel_mismatch():
     ref = m(x).numpy()
     assert fuse_conv_bn(m) == 0  # channel guard refuses
     np.testing.assert_allclose(m(x).numpy(), ref, rtol=1e-6)
+
+
+def test_save_inference_model_refuses_preact_misfold(tmp_path):
+    """The equal-channel pre-activation block (bn BEFORE conv, same
+    names the post-norm convention uses) cannot be distinguished
+    structurally — save_inference_model must catch the wrong fold by
+    numeric verification and export UNFUSED."""
+    from paddle_tpu import static
+    from paddle_tpu.inference import Config, create_predictor
+
+    class PreActSame(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn1 = nn.BatchNorm2D(8)   # normalizes the INPUT
+            self.conv1 = nn.Conv2D(8, 8, 3, padding=1)  # in == out
+
+        def forward(self, x):
+            return self.conv1(pt.nn.functional.relu(self.bn1(x)))
+
+    pt.seed(0)
+    m = PreActSame()
+    x = np.random.default_rng(4).standard_normal(
+        (2, 8, 8, 8)).astype(np.float32)
+    # train with a shifted input so running stats are far from identity
+    _warm_stats(m, pt.to_tensor(x * 3.0 + 1.0))
+    ref = m(pt.to_tensor(x)).numpy()
+
+    prefix = str(tmp_path / "preact")
+    with pytest.warns(UserWarning, match="UNFUSED"):
+        static.save_inference_model(
+            prefix, [static.InputSpec((2, 8, 8, 8), "float32", "x")],
+            layer=m)
+    cfg = Config(prefix)
+    cfg.disable_gpu()
+    pred = create_predictor(cfg)
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=5e-4,
+                               atol=5e-4)

@@ -290,17 +290,22 @@ def test_sdpa_flash_autoselect_heuristic(monkeypatch):
         fa, "flash_attention",
         lambda q, k, v, causal=False, scale=None: calls.append(1) or q)
 
-    q = jnp.zeros((1, 512, 2, 64))
+    q = jnp.zeros((1, 256, 2, 64))
     NF.scaled_dot_product_attention(q, q, q)  # auto, short: XLA path
     assert not calls
     NF.scaled_dot_product_attention(q, q, q, use_flash=True)  # forced
     assert len(calls) == 1
+    # measured r4 crossover: flash wins from S=512 up (BERT-base body
+    # 243 -> 216.6 ms/step), XLA wins at S<=256
+    mid_q = jnp.zeros((1, 512, 2, 64))
+    NF.scaled_dot_product_attention(mid_q, mid_q, mid_q)  # auto, >=512
+    assert len(calls) == 2
     long_q = jnp.zeros((1, 4096, 2, 64))
     NF.scaled_dot_product_attention(long_q, long_q, long_q)  # auto, long
-    assert len(calls) == 2
+    assert len(calls) == 3
     NF.scaled_dot_product_attention(long_q, long_q, long_q,
                                     use_flash=False)
-    assert len(calls) == 2
+    assert len(calls) == 3
 
 
 def test_gpt_flash_flag_plumbs_to_attention(monkeypatch):
