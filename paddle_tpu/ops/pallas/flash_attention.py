@@ -143,6 +143,55 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dq_acc_ref,
+                      *, scale, causal, block_q, block_k, nk):
+    """Single-pass backward for the nq == 1 case (the whole Q axis is
+    one block, e.g. S=512 at the default 512 block): grid (B, H, nk)
+    streams K blocks, dQ accumulates in scratch over the LAST grid axis
+    (the one revisiting Pallas TPU allows), dK/dV are per-block
+    outputs. Computes the score block and its exp ONCE per (q,k) pair
+    — the general two-kernel FlashAttention-2 backward recomputes them
+    in both passes (7 matmuls + 2 exps vs 5 matmuls + 1 exp here)."""
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]  # [BQ, 1]
+    delta = delta_ref[0, 0]  # [BQ, 1]
+    k_blk = k_ref[0, 0]
+    v_blk = v_ref[0, 0]
+    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse)  # [BQ, BK]
+    dv_ref[0, 0] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dk_ref[0, 0] = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+    dq_acc_ref[...] = dq_acc_ref[...] + jax.lax.dot_general(
+        ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kb == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
                     *, scale, causal, block_q, block_k, nq):
@@ -291,6 +340,42 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
         # contribution to ds is p * g_lse — i.e. ds = p*(dp - (delta -
         # g_lse)). No kernel change needed.
         delta = delta - g_lse.astype(jnp.float32)
+
+    if nq == 1:
+        # the whole Q axis is one block: a single fused pass computes
+        # dQ/dK/dV together (one score recompute instead of two).
+        # Measured v5e: neutral on the isolated scanned microbench but
+        # -14.5 ms (-6.7%) on the full BERT-base body step, where the
+        # halved launch count composes with XLA's surrounding schedule.
+        def spec_q(shape_d):
+            return pl.BlockSpec((1, 1, block_q, shape_d),
+                                lambda b_, h_, j: (b_, h_, 0, 0),
+                                memory_space=pltpu.VMEM)
+
+        def spec_k(shape_d):
+            return pl.BlockSpec((1, 1, block_k, shape_d),
+                                lambda b_, h_, j: (b_, h_, j, 0),
+                                memory_space=pltpu.VMEM)
+
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, scale=scale,
+                              causal=causal, block_q=block_q,
+                              block_k=block_k, nk=nk),
+            grid=(b, h, nk),
+            in_specs=[spec_q(d), spec_k(d), spec_k(d), spec_q(d),
+                      spec_q(1), spec_q(1)],
+            out_specs=[spec_q(d), spec_k(d), spec_k(d)],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+                jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+        )(q, k, v, do, lse, delta)
+        return dq, dk, dv
 
     # dQ: Q blocks outer (parallel), K/V blocks stream on the last axis
     kvc = _kv_clamp(causal, block_q, block_k)

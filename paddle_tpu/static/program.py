@@ -333,19 +333,23 @@ class Executor:
                                  trainer_kwargs)
 
 
-def _example_input(v) -> Tensor:
+def _example_input(v, rng) -> Tensor:
     """A concrete random input for a feed var (InputSpec or Tensor) —
-    used to numerically verify optimization passes before export."""
+    used to numerically verify optimization passes before export. The
+    caller passes ONE rng shared across feed vars so same-shape inputs
+    stay independent; integer feeds get small random ids (all-zeros
+    would probe a degenerate point, e.g. only embedding row 0)."""
     if isinstance(v, Tensor):
         return v
     sds = v.to_sds() if isinstance(v, InputSpec) else \
         InputSpec.from_tensor(v).to_sds()
-    rng = np.random.default_rng(0)
-    if np.issubdtype(np.dtype(sds.dtype), np.integer) or \
-            sds.dtype == jnp.bool_:
-        arr = np.zeros(sds.shape, dtype=sds.dtype)
+    npdtype = np.dtype(sds.dtype)
+    if npdtype == np.bool_:
+        arr = rng.integers(0, 2, sds.shape).astype(np.bool_)
+    elif np.issubdtype(npdtype, np.integer):
+        arr = rng.integers(0, 16, sds.shape).astype(npdtype)
     else:
-        arr = rng.standard_normal(sds.shape).astype(sds.dtype)
+        arr = rng.standard_normal(sds.shape).astype(npdtype)
     return Tensor(jnp.asarray(arr))
 
 
@@ -373,7 +377,8 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars=None,
                 # the name-based pairing can mis-fold a pre-activation
                 # block (bn before conv, equal channels): verify on a
                 # random example and keep the unfused model on mismatch
-                example = [_example_input(v) for v in feed_vars]
+                ex_rng = np.random.default_rng(0)
+                example = [_example_input(v, ex_rng) for v in feed_vars]
                 if fold_preserves_outputs(layer, folded, example):
                     layer = folded
                 else:

@@ -365,3 +365,31 @@ def test_sdpa_causal_kv_cache_never_uses_flash(monkeypatch):
     got = NF.scaled_dot_product_attention(qv, kvv, kvv, is_causal=True,
                                           use_flash=False)
     assert float(got[0, 0, 0, 0]) > 0  # attends beyond position 0
+
+
+def test_fused_single_qblock_backward_multi_kblock():
+    """The nq==1 fused backward with nk>1 (cross-attention: short Q,
+    long K): dQ must accumulate across the streamed K blocks and dK/dV
+    must land in the right per-block slots. Reachable in production
+    via q_len<=block <= k_len cross-attention."""
+    rng = np.random.default_rng(7)
+    b, h, d = 2, 2, 64
+    sq, sk = 128, 256  # block 128 -> nq=1, nk=2 through the fused path
+    q = jnp.asarray(rng.standard_normal((b, sq, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((b, sk, h, d)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((b, sk, h, d)).astype(np.float32))
+
+    def loss_flash(q_, k_, v_):
+        return jnp.sum(fa.flash_attention(
+            q_, k_, v_, block_q=128, block_k=128) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(scaled_dot_product_attention(
+            q_, k_, v_, use_flash=False) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=5e-3, atol=5e-3,
+                                   err_msg=f"d{name} mismatch")

