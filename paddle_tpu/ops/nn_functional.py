@@ -807,6 +807,14 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
         from .pallas.flash_attention import (flash_attention,
                                              flash_attention_supported)
         if flash_attention_supported(q.shape, k.shape):
+            # the [B,H,S,D] transpose round-trip costs ~13 ms/step on
+            # the BERT-base body (trace_attribution), but a packed
+            # no-transpose variant (heads as d-wide column blocks over
+            # [B,S,E]) measured SLOWER where it could lower at all:
+            # Mosaic rejects d=64 column blocks (last block dim must
+            # divide 128) and at d=128 the strided block DMA lost more
+            # than the transposes cost (GPT step 254.0 vs 251.7 ms) —
+            # so the transposing path stays.
             return flash_attention(q, k, v, causal=is_causal, scale=scale)
     b, sq, h, d = q.shape
     sk = k.shape[1]
