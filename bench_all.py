@@ -269,7 +269,10 @@ def bench_decode(on_tpu: bool) -> Dict:
                         num_layers=24, num_heads=16, max_seq_len=2048,
                         dropout=0.0, attn_dropout=0.0, dtype="bfloat16",
                         use_flash_attention=False, loss_chunk_size=0)
-        batches, prompt, new_toks = (1, 8, 32), 128, 64
+        # r4 sweep: decode is weights-bound and keeps scaling with
+        # batch (b32 4.6k -> b128 7.5k tok/s); b256's KV at S=192 still
+        # fits but prefill compile cost grows — 128 is the sweet spot
+        batches, prompt, new_toks = (1, 8, 32, 64, 128), 128, 64
     else:
         cfg = gpt_tiny()
         batches, prompt, new_toks = (1,), 8, 4
