@@ -775,12 +775,14 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
 # when shapes/backend allow.
 # --------------------------------------------------------------------------
 
-# Flash-vs-XLA crossover, measured on v5e with a scanned fwd+bwd sweep
-# (r4): XLA's fused attention wins at S<=256 (flash/xla step ratio
-# 0.71-0.81 at d=64), flash wins from S=512 up (1.17-1.41x across d=64
-# and d=128, causal and not; BERT-base body: 243->216.6 ms/step), and
-# at S>=2048 the XLA path can stop compiling outright — the S^2 scores
-# no longer fit (PROFILE.json r4_correction).
+# Flash-vs-XLA crossover, measured on v5e (r4): XLA's fused attention
+# wins at S<=256, flash wins from S=512 up — confirmed across d=64 and
+# d=128, causal and not, by a scanned fwd+bwd sweep (whose per-step
+# wall times amortize the tunnel dispatch floor equally into both
+# sides, so the winner's true margin is LARGER than the raw ratio) and
+# by the floor-subtracted full-model step (BERT-base body: 243 ->
+# 216.6 ms/step on flash). At S>=2048 the XLA path can stop compiling
+# outright — the S^2 scores no longer fit (PROFILE.json r4_correction).
 _FLASH_MIN_SEQ = int(__import__("os").environ.get("PT_FLASH_MIN_SEQ",
                                                   "512"))
 

@@ -66,8 +66,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, acc_ref, m_ref, l_run_ref,
     # (r4, measured: splitting the body into masked-diagonal vs
     # unmasked-fully-visible pl.when branches to skip the iota/where
     # chain on interior blocks made things WORSE — b8 s1024 d128 causal
-    # fwd+bwd 5.06 -> 8.39 ms; the extra branch breaks Mosaic's
-    # pipeline. The single masked body stays.)
+    # fwd+bwd 5.06 -> 8.39 ms scanned wall-clock, and both sides carry
+    # the same ~3 ms amortized dispatch floor so the true device-time
+    # regression is steeper; the extra branch breaks Mosaic's pipeline.
+    # The single masked body stays.)
     relevant = (kb * block_k <= (qb + 1) * block_q - 1) if causal else True
 
     @pl.when(relevant)
