@@ -14,6 +14,18 @@ from __future__ import annotations
 
 _activation_offload = False
 
+# The one named activation currently defined: the flash attention
+# kernel's out+lse backward residuals (tagged in
+# ops/pallas/flash_attention._flash_lse_vjp_fwd).
+ATTN_OUT_NAME = "attn_out"
+
+# Named activations that rematerialized blocks SAVE instead of
+# recomputing (selective checkpointing): e.g. (ATTN_OUT_NAME,) keeps
+# each attention mix's output — at long sequence the flash forward is
+# the block's most expensive piece, and its output is only [B, S, H]
+# per layer, so buying it back costs little memory.
+_remat_saved_names: tuple = ()
+
 
 def set_activation_offload(enabled: bool) -> None:
     global _activation_offload
@@ -24,19 +36,34 @@ def activation_offload_enabled() -> bool:
     return _activation_offload
 
 
+def set_remat_saved_names(names) -> None:
+    """Select named activations (see ``name_activation``) that
+    jax.checkpoint saves rather than recomputes inside remat blocks."""
+    global _remat_saved_names
+    _remat_saved_names = tuple(names)
+
+
+def remat_saved_names() -> tuple:
+    return _remat_saved_names
+
+
 def remat_policy():
     """The jax.checkpoint policy to use for rematerialized blocks (None
     = plain full-remat). With offload on, the named block inputs — the
     only residuals a fully-rematerialized block keeps — are staged to
     pinned host memory (the reference's recompute offload stashes
-    exactly these checkpoint inputs on host)."""
-    if not _activation_offload:
-        return None
+    exactly these checkpoint inputs on host). Named saved activations
+    (set_remat_saved_names) are kept on device in both modes."""
     import jax
-    return jax.checkpoint_policies.save_and_offload_only_these_names(
-        names_which_can_be_saved=[],
-        names_which_can_be_offloaded=["remat_block_in"],
-        offload_src="device", offload_dst="pinned_host")
+    if _activation_offload:
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=list(_remat_saved_names),
+            names_which_can_be_offloaded=["remat_block_in"],
+            offload_src="device", offload_dst="pinned_host")
+    if _remat_saved_names:
+        return jax.checkpoint_policies.save_only_these_names(
+            *_remat_saved_names)
+    return None
 
 
 def name_block_input(x):
@@ -46,3 +73,12 @@ def name_block_input(x):
         return x
     from jax.ad_checkpoint import checkpoint_name
     return checkpoint_name(x, "remat_block_in")
+
+
+def name_activation(x, name: str):
+    """Tag a named activation for selective remat saving (no-op unless
+    ``name`` is currently selected via set_remat_saved_names)."""
+    if name not in _remat_saved_names:
+        return x
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(x, name)

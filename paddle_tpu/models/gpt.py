@@ -81,6 +81,12 @@ class GPTConfig:
     # fewer recomputed FLOPs when HBM has headroom (selective
     # checkpointing; remat_every=1 = every block).
     remat_every: int = 1
+    # Selective remat: SAVE each attention mix's output so backward
+    # recompute skips the flash forward — the block's dominant
+    # recompute cost at long S — for only [B, S, H] of residual memory
+    # per layer. Process-global (sets core.offload's remat saved names
+    # at model build, consulted by the jax.checkpoint policy).
+    remat_save_attention: bool = False
 
     def __post_init__(self):
         if self.remat and self.remat_every < 1:
@@ -201,6 +207,10 @@ class GPTAttention(Layer):
             out = F["scaled_dot_product_attention"](
                 q, k, v, is_causal=True, dropout_p=self.attn_dropout_p,
                 training=self.training, use_flash=bool(self.use_flash))
+        # selective remat (config.remat_save_attention) is tagged at
+        # the flash kernel's vjp residuals (out AND lse — see
+        # pallas/flash_attention._flash_lse_vjp_fwd), not here: saving
+        # out alone would still recompute the flash forward for lse
         out = F["reshape"](out, (b, s, self.num_heads * self.head_dim))
         out = self.out_proj(out)
         if use_cache:
@@ -292,6 +302,14 @@ class GPTModel(Layer):
         super().__init__()
         self.config = config
         c = config
+        # last-BUILT-model-wins, like the offload switch (so an A/B
+        # sweep in one process flips it both ways). Set here, not in
+        # GPTConfig.__post_init__: merely constructing a config (a
+        # sweep list, a comparison default) must not change the remat
+        # behavior of other models at their trace time.
+        from ..core.offload import ATTN_OUT_NAME, set_remat_saved_names
+        set_remat_saved_names((ATTN_OUT_NAME,) if c.remat_save_attention
+                              else ())
         init = Normal(std=c.initializer_range)
         self.wte = VocabParallelEmbedding(c.vocab_size, c.hidden_size)
         self.wpe = Embedding(c.max_seq_len, c.hidden_size)

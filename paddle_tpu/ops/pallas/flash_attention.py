@@ -447,6 +447,15 @@ def _flash_attention_bhsd_lse(q, k, v, scale, causal, block_q, block_k):
 
 def _flash_lse_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
     out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    # selective-remat hook: when ATTN_OUT_NAME is an active saved name
+    # (core.offload.set_remat_saved_names, e.g. via
+    # GPTConfig.remat_save_attention), tag BOTH backward residuals this
+    # kernel produces — out alone is not enough, the FlashAttention-2
+    # backward also consumes lse, and an unsaved lse forces the whole
+    # flash forward to recompute under jax.checkpoint
+    from ...core.offload import ATTN_OUT_NAME, name_activation
+    out = name_activation(out, ATTN_OUT_NAME)
+    lse = name_activation(lse, ATTN_OUT_NAME)
     return (out, lse), (q, k, v, out, lse)
 
 

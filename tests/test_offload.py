@@ -110,3 +110,87 @@ def test_localsgd_offload_refuses():
         fleet.distributed_jit(m, optim.SGD(learning_rate=0.1),
                               lambda mm, b: mm(b[0], labels=b[1]),
                               strategy=s)
+
+
+def test_remat_save_attention_loss_parity(monkeypatch):
+    """remat_save_attention only changes WHAT jax.checkpoint saves (the
+    flash kernel's out+lse residuals instead of recomputing its
+    forward) — losses must match plain remat exactly. Runs the REAL
+    flash path via the Pallas interpreter + the AOT force gate so the
+    residual tagging actually executes on CPU."""
+    import functools
+
+    from paddle_tpu.core.offload import (remat_saved_names,
+                                         set_remat_saved_names)
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.setattr(fa.pl, "pallas_call",
+                        functools.partial(fa.pl.pallas_call,
+                                          interpret=True))
+
+    ids = IDS[:4]
+
+    def run(save_attn):
+        try:
+            pt.seed(0)
+            with fa.force_flash_for_aot():
+                m = GPTForCausalLM(gpt_tiny(
+                    remat=True, remat_save_attention=save_attn,
+                    use_flash_attention=True))
+                if save_attn:
+                    from paddle_tpu.core.offload import ATTN_OUT_NAME
+                    assert remat_saved_names() == (ATTN_OUT_NAME,)
+                step = TrainStep(m, optim.SGD(learning_rate=0.1),
+                                 lambda mm, b: mm(b[0], labels=b[1]))
+                return [float(step((ids, ids))) for _ in range(2)]
+        finally:
+            set_remat_saved_names(())
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+
+def test_remat_save_attention_residuals_actually_saved(monkeypatch):
+    """Structural guard against the feature degrading to a silent
+    no-op (e.g. the tag name drifting between the kernel and the
+    policy, or jax.checkpoint ceasing to see names inside the
+    custom_vjp fwd): the checkpointed flash computation must list a
+    named 'attn_out' SAVED residual when the policy selects it."""
+    import contextlib
+    import functools
+    import io
+
+    import jax.numpy as jnp
+    from jax import ad_checkpoint
+
+    from paddle_tpu.core.offload import (ATTN_OUT_NAME, remat_policy,
+                                         set_remat_saved_names)
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.setattr(fa.pl, "pallas_call",
+                        functools.partial(fa.pl.pallas_call,
+                                          interpret=True))
+    q = jnp.ones((1, 128, 2, 64), jnp.float32)
+
+    def attn_sum(q_):
+        return fa.flash_attention(q_, q_, q_).astype(jnp.float32).sum()
+
+    def residual_report(policy):
+        f = jax.checkpoint(attn_sum, policy=policy)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            ad_checkpoint.print_saved_residuals(f, q)
+        return buf.getvalue()
+
+    try:
+        set_remat_saved_names((ATTN_OUT_NAME,))
+        saved = residual_report(remat_policy())
+        assert f"named '{ATTN_OUT_NAME}'" in saved, saved
+        # and the flash output itself is saved alongside (the lse is
+        # the named one; out rides the same policy)
+        assert "flash_attention" in saved, saved
+    finally:
+        set_remat_saved_names(())
+    # with the names cleared the policy is None (full remat): nothing
+    # from inside the flash forward is saved
+    assert "named" not in residual_report(remat_policy())

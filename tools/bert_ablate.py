@@ -78,15 +78,6 @@ def main():
          f"{out['no_attention_mix_ms']:.0f} ms run near peak"
          + (f" ({mm['tflops_per_s']} TFLOP/s, trace_attribution)"
             if mm else "")),
-        ("device-trace ground truth (trace_attribution section): the "
-         "flash custom-calls take "
-         + (f"~{cc['ms_per_step']:.0f}" if cc else "~40")
-         + " ms/step of device time and the [B,H,S,D] transpose "
-         "round-trips around them "
-         + (f"~{fmt['ms_per_step']:.0f}" if fmt else "~25")
-         + " ms more ('data formatting') — S^2-score work at d=64 is "
-         "intrinsically cheap on FLOPs but expensive on bandwidth/VPU, "
-         "so it cannot reach matmul-class efficiency at this shape"),
         ("layernorm and gelu each cost ~16-18 ms fwd+bwd (deltas "
          "overlap under XLA fusion; not additive)"),
         ("an earlier wall-clock 'bare einsum floor' field was removed: "
@@ -94,6 +85,21 @@ def main():
          "swamped by the session-variable 90-120 ms dispatch floor; "
          "device truth lives in trace_attribution"),
     ]
+    if cc and fmt:
+        out["readings"].insert(1, (
+            f"device-trace ground truth (trace_attribution section): "
+            f"the flash custom-calls take ~{cc['ms_per_step']:.0f} "
+            f"ms/step of device time and the [B,H,S,D] transpose "
+            f"round-trips around them ~{fmt['ms_per_step']:.0f} ms "
+            f"more ('data formatting') — S^2-score work at d=64 is "
+            f"intrinsically cheap on FLOPs but expensive on "
+            f"bandwidth/VPU, so it cannot reach matmul-class "
+            f"efficiency at this shape"))
+    else:
+        out["readings"].insert(1, (
+            "no trace_attribution section present — run "
+            "tools/trace_attr.py --model bert --merge for the per-op "
+            "device-time ground truth"))
     report["attribution"] = out
     with open(path, "w") as f:
         json.dump(report, f, indent=2)
