@@ -27,6 +27,7 @@ from typing import Any, Callable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from ..core.offload import remat_policy as _remat_policy
 from ..nn.layer import Layer
 from ..nn.container import LayerList
 
@@ -123,7 +124,8 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, x_micro,
     n_micro = x_micro.shape[0]
     total_steps = n_micro + n_stages - 1
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    fn = jax.checkpoint(stage_fn, policy=_remat_policy()) \
+        if remat else stage_fn
 
     def body(carry, t):
         recv_buf, outputs = carry
@@ -195,7 +197,8 @@ def spmd_pipeline_1f1b(stage_fn: Callable, stage_params: Any, shared: Any,
     """
     n_stages = jax.lax.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    fn = jax.checkpoint(stage_fn, policy=_remat_policy()) \
+        if remat else stage_fn
     total_steps = n_micro + 2 * (n_stages - 1)
     cap = 2 * n_stages - 1  # circular activation-store slots
     fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]

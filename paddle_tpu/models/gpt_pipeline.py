@@ -305,7 +305,9 @@ class GPTPipelineTrainStep:
             h, _ = jax.lax.scan(body, x, blocks_local)
             return h
 
-        sfn = jax.checkpoint(stage_fn) if remat else stage_fn
+        from ..core.offload import remat_policy
+        sfn = jax.checkpoint(stage_fn, policy=remat_policy()) \
+            if remat else stage_fn
         hybrid = self.hybrid
         data_axes = self._data_axes
 
