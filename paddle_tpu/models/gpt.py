@@ -85,7 +85,12 @@ class GPTConfig:
     # recompute skips the flash forward — the block's dominant
     # recompute cost at long S — for only [B, S, H] of residual memory
     # per layer. Process-global (sets core.offload's remat saved names
-    # at model build, consulted by the jax.checkpoint policy).
+    # at model build, consulted by the jax.checkpoint policy). DENSE
+    # flash path only: ring/ulysses/zigzag sequence parallelism wraps
+    # its hops in its own custom_vjp, and jax.checkpoint's
+    # named-residual policy cannot see inside a custom_vjp — measured
+    # bit-identical compiled memory on the S=32k zigzag scale proof
+    # (SCALE_PROOF_LONGCTX.json variant_remat_save_attention).
     remat_save_attention: bool = False
 
     def __post_init__(self):
