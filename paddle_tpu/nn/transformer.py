@@ -43,6 +43,10 @@ class MultiHeadAttention(Layer):
         assert self.head_dim * num_heads == embed_dim
         self.dropout = dropout
         self.need_weights = need_weights
+        # separate q/k/v projections (reference parity). A compute-time
+        # fused [E,3E] matmul was measured NEUTRAL on the BERT-base
+        # body step (202.8 vs 202.6 ms, r4) — XLA already extracts the
+        # shared-operand read — so the simpler form stays.
         self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
         self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
         self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
