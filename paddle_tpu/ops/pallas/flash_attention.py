@@ -110,6 +110,36 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, acc_ref, m_ref, l_run_ref,
         l_ref[0, 0] = m_ref[:, :1] + jnp.log(denom)
 
 
+def _fwd_single_block_kernel(q_ref, k_ref, v_ref, o_ref, l_ref,
+                             *, scale, causal, block_q, block_k):
+    """Forward for the nk == 1 case (the whole K axis is one block,
+    e.g. S=512 at the default 512 block): a plain in-register softmax.
+    The streaming kernel's online-softmax machinery — running max,
+    alpha rescale of the accumulator, (BQ, 128) m/l scratch broadcasts
+    — exists to merge MULTIPLE K blocks and is pure overhead with one."""
+    qb = pl.program_id(2)
+    q = q_ref[0, 0]  # [BQ, D]
+    k_blk = k_ref[0, 0]
+    v_blk = v_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [BQ, BK] f32
+    if causal:
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    m = jnp.max(s, axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    denom = jnp.maximum(jnp.sum(p, axis=1, keepdims=True), 1e-30)
+    acc = jax.lax.dot_general(
+        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_ref[0, 0] = (acc / denom).astype(o_ref.dtype)
+    l_ref[0, 0] = m + jnp.log(denom)
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    acc_ref, *, scale, causal, block_q, block_k, nk):
     qb = pl.program_id(2)
@@ -292,6 +322,21 @@ def _spec_lane1_inner(block, clamp=None):
                         memory_space=pltpu.VMEM)
 
 
+def _spec3_indexed(block, d):
+    """3-dim-grid spec: block selected by the grid's third axis."""
+    return pl.BlockSpec((1, 1, block, d),
+                        lambda b, h, i: (b, h, i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _spec3_pinned(block, d):
+    """3-dim-grid spec: the same (b, h) block regardless of the third
+    grid axis (the single outer block of an nq==1/nk==1 kernel)."""
+    return pl.BlockSpec((1, 1, block, d),
+                        lambda b, h, i: (b, h, 0, 0),
+                        memory_space=pltpu.VMEM)
+
+
 def _kv_clamp(causal, block_q, block_k):
     """For Q-outer kernels: the last K block visible to Q block i."""
     if not causal:
@@ -311,6 +356,33 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nk = sk // block_k
+    if nk == 1:
+        # one K block: plain softmax kernel, no streaming axis — every
+        # grid dim is parallel and the online-softmax scratch vanishes
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_single_block_kernel, scale=scale,
+                              causal=causal, block_q=block_q,
+                              block_k=block_k),
+            grid=(b, h, sq // block_q),
+            in_specs=[_spec3_indexed(block_q, d),
+                      _spec3_pinned(block_k, d),
+                      _spec3_pinned(block_k, d)],
+            out_specs=[_spec3_indexed(block_q, d),
+                       _spec3_indexed(block_q, 1)],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+                jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+            ],
+            cost_estimate=pl.CostEstimate(
+                flops=4 * b * h * sq * sk * d,
+                bytes_accessed=(q.size + k.size + v.size) *
+                q.dtype.itemsize,
+                transcendentals=b * h * sq * sk),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "parallel")),
+        )(q, k, v)
+        return out, lse
     grid = (b, h, sq // block_q, nk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k, nk=nk)
@@ -362,24 +434,20 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
         # Measured v5e: neutral on the isolated scanned microbench but
         # -14.5 ms (-6.7%) on the full BERT-base body step, where the
         # halved launch count composes with XLA's surrounding schedule.
-        def spec_q(shape_d):
-            return pl.BlockSpec((1, 1, block_q, shape_d),
-                                lambda b_, h_, j: (b_, h_, 0, 0),
-                                memory_space=pltpu.VMEM)
-
-        def spec_k(shape_d):
-            return pl.BlockSpec((1, 1, block_k, shape_d),
-                                lambda b_, h_, j: (b_, h_, j, 0),
-                                memory_space=pltpu.VMEM)
-
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, scale=scale,
                               causal=causal, block_q=block_q,
                               block_k=block_k, nk=nk),
             grid=(b, h, nk),
-            in_specs=[spec_q(d), spec_k(d), spec_k(d), spec_q(d),
-                      spec_q(1), spec_q(1)],
-            out_specs=[spec_q(d), spec_k(d), spec_k(d)],
+            in_specs=[_spec3_pinned(block_q, d),
+                      _spec3_indexed(block_k, d),
+                      _spec3_indexed(block_k, d),
+                      _spec3_pinned(block_q, d),
+                      _spec3_pinned(block_q, 1),
+                      _spec3_pinned(block_q, 1)],
+            out_specs=[_spec3_pinned(block_q, d),
+                       _spec3_indexed(block_k, d),
+                       _spec3_indexed(block_k, d)],
             out_shape=[
                 jax.ShapeDtypeStruct(q.shape, q.dtype),
                 jax.ShapeDtypeStruct(k.shape, k.dtype),
