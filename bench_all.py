@@ -161,11 +161,12 @@ def bench_bert_base(on_tpu: bool) -> Dict:
                         attention_probs_dropout_prob=0.0)
         # r4 sweep (PROFILE_BERT.json, floor-subtracted, Pallas flash
         # attention after the S>=512 crossover fix + fused single-block
-        # backward, executed-FLOPs MFU): gathered head trains ~20% more
-        # tokens/s than full head at ~equal ~47% MFU — the h=768
-        # encoder's ceiling on this chip (attribution: the attention
-        # mix runs at ~10% of nominal at S=512/d=64 and costs half the
-        # step; the encoder matmuls run near peak)
+        # backward + plain-softmax single-block forward,
+        # executed-FLOPs MFU): gathered head trains ~20% more tokens/s
+        # than full head at ~equal ~49% MFU — the h=768 encoder's
+        # ceiling on this chip (attribution: the attention mix runs at
+        # ~10% of nominal at S=512/d=64 and costs ~half the step; the
+        # encoder matmuls run near peak)
         batch, seq, steps = 64, 512, 16
         # reference pretrain data format: max_predictions_per_seq
         # masked slots per sequence; the MLM head runs only on them
