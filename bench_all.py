@@ -159,14 +159,12 @@ def bench_bert_base(on_tpu: bool) -> Dict:
     if on_tpu:
         cfg = bert_base(hidden_dropout_prob=0.0,
                         attention_probs_dropout_prob=0.0)
-        # r4 sweep (PROFILE_BERT.json, floor-subtracted, Pallas flash
-        # attention after the S>=512 crossover fix + fused single-block
-        # backward + plain-softmax single-block forward,
-        # executed-FLOPs MFU): gathered head trains ~20% more tokens/s
-        # than full head at ~equal ~49% MFU — the h=768 encoder's
-        # ceiling on this chip (attribution: the attention mix runs at
-        # ~10% of nominal at S=512/d=64 and costs ~half the step; the
-        # encoder matmuls run near peak)
+        # r5 sweep (PROFILE_BERT.json, floor-subtracted, FOLDED
+        # layout-native Pallas attention — [B,S,E] column groups, no
+        # [B,H,S,D] transposes, lse-free fused recompute backward —
+        # executed-FLOPs MFU): b64 gathered-head 213.8k tokens/s at
+        # ~63.9% MFU (r4: 164.6k / 49.2% on the transposing kernel;
+        # the r4 "~50% h=768 ceiling" was the transpose tax, now gone)
         batch, seq, steps = 64, 512, 16
         # reference pretrain data format: max_predictions_per_seq
         # masked slots per sequence; the MLM head runs only on them

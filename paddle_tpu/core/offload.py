@@ -12,6 +12,8 @@ style; it is consulted at trace time by the remat wrappers
 
 from __future__ import annotations
 
+import contextlib as _contextlib
+
 _activation_offload = False
 
 # The one named activation currently defined: the flash attention
@@ -41,6 +43,22 @@ def set_remat_saved_names(names) -> None:
     jax.checkpoint saves rather than recomputes inside remat blocks."""
     global _remat_saved_names
     _remat_saved_names = tuple(names)
+
+
+@_contextlib.contextmanager
+def override_remat_saved_names(names):
+    """Scoped selection: a model that opted into selective remat wraps
+    its forward trace in this, so its choice never leaks into other
+    live models' traces (r4 advisor: GPTModel.__init__ used to clobber
+    the process global for models that never opted in). Nesting
+    restores the previous selection on exit."""
+    global _remat_saved_names
+    prev = _remat_saved_names
+    _remat_saved_names = tuple(names)
+    try:
+        yield
+    finally:
+        _remat_saved_names = prev
 
 
 def remat_saved_names() -> tuple:

@@ -217,3 +217,138 @@ class HostEmbedding(Layer):
         out = dispatch.call_fn(self._lookup, "host_embedding", True,
                                (ids, self.anchor), {})
         return out if isinstance(out, Tensor) else Tensor(out)
+
+
+class HeterPipelineTrainer:
+    """Split-brain heterogeneous training — the reference heter-PS
+    ORCHESTRATION (not just its table), TPU-native.
+
+    Reference: the CPU-side trainer runs the sparse stage against the
+    PS while the accelerator runs the dense net, exchanging only stage
+    activations (distributed/service/heter_client.cc:1 SendAndRecvAsync;
+    framework/fleet/heter_ps/hashtable.h:1 pull/push;
+    framework/fleet/box_wrapper.cc:1 BoxPS ads pipeline). Here:
+
+    - a CPU WORKER POOL (ThreadPoolExecutor) runs the sparse stage:
+      embedding pulls + per-slot layout forward, gradient scatter +
+      table push backward — against any pull/push_grad table
+      (DenseHostTable, distributed.ps.SparseTable over the socket PS,
+      or the native C++ server via ps.NativePSClient wrappers);
+    - the TPU runs ONE jitted dense stage: fwd + bwd + optimizer
+      update, returning the activation cotangent that feeds the CPU
+      backward;
+    - the stages PIPELINE: batch i+1's sparse forward is submitted to
+      the pool as soon as batch i's device step is dispatched (jax
+      dispatch is async), and sparse backwards drain on the pool —
+      the heter_section_worker microbatch overlap.
+
+    The sparse stage layout is the CTR convention: ids [B, n_slots] ->
+    rows [B, n_slots, dim] -> concat [B, n_slots*dim] feeding the dense
+    model; its backward is an exact reshape-scatter (no pooling
+    approximation), so training matches a monolithic model with the
+    same update rules (tests/test_heter_embedding.py parity)."""
+
+    def __init__(self, table, embedding_dim: int, dense_model,
+                 optimizer, loss_fn, pool_workers: int = 2):
+        import jax.numpy as jnp
+
+        from ..jit import functional_state
+        from ..nn.layer import bind_state
+
+        self.table = table
+        self.dim = embedding_dim
+        self.model = dense_model
+        self.optimizer = optimizer
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(max_workers=pool_workers)
+        state = functional_state(dense_model)
+        self._params = state["params"]
+        self._buffers = state["buffers"]
+        self._opt_state = optimizer.init(self._params)
+
+        def device_step(params, opt_state, acts, labels, lr):
+            def loss_of(p, a):
+                with bind_state(dense_model,
+                                {"params": p, "buffers": self._buffers}):
+                    return loss_fn(dense_model, Tensor(a),
+                                   Tensor(labels)).value
+            loss, (gp, ga) = jax.value_and_grad(
+                loss_of, argnums=(0, 1))(params, acts)
+            new_p, new_s = optimizer.apply_gradients(params, gp,
+                                                     opt_state, lr)
+            return new_p, new_s, loss, ga
+
+        self._device_step = jax.jit(device_step)
+
+    # -- sparse stage (CPU pool) ------------------------------------------
+    def _sparse_forward(self, ids: np.ndarray) -> np.ndarray:
+        b, slots = ids.shape
+        rows = self.table.pull(ids.reshape(-1))
+        return np.asarray(rows, np.float32).reshape(
+            b, slots * self.dim)
+
+    def _sparse_backward(self, ids: np.ndarray,
+                         d_acts: np.ndarray) -> None:
+        self.table.push_grad(
+            ids.reshape(-1),
+            np.asarray(d_acts, np.float32).reshape(-1, self.dim))
+
+    # -- pipeline driver ---------------------------------------------------
+    def run(self, batches, sync: bool = False) -> list:
+        """Train over ``batches`` (iterable of (ids [B, n_slots] int,
+        labels)); returns the per-batch losses.
+
+        ``sync=False`` (default, the reference async-PS semantics):
+        the pool computes batch i+1's pulls while the device step for
+        batch i is in flight, and gradient pushes drain asynchronously
+        — one-step bounded staleness on rows shared between adjacent
+        batches (the LAST push is joined before returning).
+        ``sync=True``: each push completes before the next pull — the
+        sync-PS lockstep; exact parity with a monolithic model."""
+        batches = list(batches)
+        losses = []
+        pending_bwd = []
+        fwd_fut = None
+        for i, (ids, labels) in enumerate(batches):
+            ids = np.asarray(ids)
+            acts_np = (fwd_fut.result() if fwd_fut is not None
+                       else self._sparse_forward(ids))
+            # get_lr() per step: an attached LR scheduler must drive the
+            # dense stage exactly as it would a monolithic TrainStep
+            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+            self._params, self._opt_state, loss, ga = self._device_step(
+                self._params, self._opt_state, jnp.asarray(acts_np),
+                jnp.asarray(labels), lr)
+            # device step dispatched (async): overlap the NEXT batch's
+            # sparse forward with it before blocking on ga
+            if not sync and i + 1 < len(batches):
+                nxt = np.asarray(batches[i + 1][0])
+                fwd_fut = self._pool.submit(self._sparse_forward, nxt)
+            bwd = self._pool.submit(self._sparse_backward, ids,
+                                    np.asarray(ga))
+            if sync:
+                bwd.result()
+            else:
+                pending_bwd.append(bwd)
+            losses.append(float(loss))
+        for f in pending_bwd:
+            f.result()
+        return losses
+
+    def shutdown(self) -> None:
+        """Join and release the CPU worker pool (also runs on __exit__
+        and best-effort on GC — a sweep constructing many trainers must
+        not leak 2 worker threads per instance)."""
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    def __del__(self):
+        try:
+            self._pool.shutdown(wait=False)
+        except Exception:
+            pass

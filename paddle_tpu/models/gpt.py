@@ -307,14 +307,14 @@ class GPTModel(Layer):
         super().__init__()
         self.config = config
         c = config
-        # last-BUILT-model-wins, like the offload switch (so an A/B
-        # sweep in one process flips it both ways). Set here, not in
-        # GPTConfig.__post_init__: merely constructing a config (a
-        # sweep list, a comparison default) must not change the remat
-        # behavior of other models at their trace time.
-        from ..core.offload import ATTN_OUT_NAME, set_remat_saved_names
-        set_remat_saved_names((ATTN_OUT_NAME,) if c.remat_save_attention
-                              else ())
+        # Selective remat is scoped to THIS model's forward trace
+        # (override_remat_saved_names around forward): a model that
+        # never opted in neither clears nor inherits another model's
+        # selection, and a direct set_remat_saved_names() call stays in
+        # force for models built with remat_save_attention=False.
+        from ..core.offload import ATTN_OUT_NAME
+        self._remat_names = ((ATTN_OUT_NAME,) if c.remat_save_attention
+                             else None)
         init = Normal(std=c.initializer_range)
         self.wte = VocabParallelEmbedding(c.vocab_size, c.hidden_size)
         self.wpe = Embedding(c.max_seq_len, c.hidden_size)
@@ -326,6 +326,15 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, position_ids=None, caches=None,
                 use_cache=False):
+        if self._remat_names is not None:
+            from ..core.offload import override_remat_saved_names
+            with override_remat_saved_names(self._remat_names):
+                return self._forward(input_ids, position_ids, caches,
+                                     use_cache)
+        return self._forward(input_ids, position_ids, caches, use_cache)
+
+    def _forward(self, input_ids, position_ids=None, caches=None,
+                 use_cache=False):
         use_cache = use_cache or caches is not None
         b, s = input_ids.shape
         if position_ids is None:

@@ -306,8 +306,9 @@ class GPTPipelineTrainStep:
             return h
 
         from ..core.offload import remat_policy
-        sfn = jax.checkpoint(stage_fn, policy=remat_policy()) \
-            if remat else stage_fn
+        with self._remat_scope():
+            sfn = jax.checkpoint(stage_fn, policy=remat_policy()) \
+                if remat else stage_fn
         hybrid = self.hybrid
         data_axes = self._data_axes
 
@@ -464,7 +465,22 @@ class GPTPipelineTrainStep:
         lr = jax.ShapeDtypeStruct(
             (), jnp.float32, sharding=NamedSharding(self.mesh, P()))
         params = {"stacked": self.stacked, "shared": self.shared}
-        return self._step.lower(params, self.opt_state, lr, ids, ids)
+        with self._remat_scope():
+            return self._step.lower(params, self.opt_state, lr, ids, ids)
+
+    def _remat_scope(self):
+        """The model's selective-remat selection, scoped (GPTModel
+        captures it per-model; the pipeline path never runs
+        GPTModel.forward, so the override must wrap every point that
+        consults core.offload at build or trace time: remat_policy()
+        in _build, spmd_pipeline_1f1b's policy evaluation, and the
+        flash kernel's name_activation tagging inside the step trace)."""
+        import contextlib
+        names = self.model.gpt._remat_names
+        if names is None:
+            return contextlib.nullcontext()
+        from ..core.offload import override_remat_saved_names
+        return override_remat_saved_names(names)
 
     def __call__(self, ids, labels) -> jax.Array:
         assert not self.abstract, \
@@ -478,8 +494,9 @@ class GPTPipelineTrainStep:
             bspec = NamedSharding(self.mesh, self._batch_pspec())
             ids = jax.device_put(ids, bspec)
             labels = jax.device_put(labels, bspec)
-        params, self.opt_state, loss = self._step(
-            params, self.opt_state, lr, ids, labels)
+        with self._remat_scope():
+            params, self.opt_state, loss = self._step(
+                params, self.opt_state, lr, ids, labels)
         self.stacked = params["stacked"]
         self.shared = params["shared"]
         return loss

@@ -808,15 +808,24 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             (dropout_p == 0.0 or not training)):
         from .pallas.flash_attention import (flash_attention,
                                              flash_attention_supported)
+        from .pallas.folded_attention import (folded_attention,
+                                              folded_attention_supported)
+        if folded_attention_supported(q.shape, k.shape, is_causal):
+            # single-K-block shapes (BERT S=512): the layout-native
+            # folded kernel reads the projection's [B,S,E] rows via
+            # 128-lane column groups — no [B,H,S,D] transpose (r4
+            # trace: ~27 ms/step of "data formatting" on the BERT-base
+            # body came from those round-trips; an r4 attempt at d-wide
+            # column blocks failed because Mosaic rejects 64-lane
+            # blocks — the fix is 2 heads per 128-lane group, split by
+            # in-kernel lane slices)
+            return folded_attention(q, k, v, causal=is_causal,
+                                    scale=scale)
         if flash_attention_supported(q.shape, k.shape):
-            # the [B,H,S,D] transpose round-trip costs ~13 ms/step on
-            # the BERT-base body (trace_attribution), but a packed
-            # no-transpose variant (heads as d-wide column blocks over
-            # [B,S,E]) measured SLOWER where it could lower at all:
-            # Mosaic rejects d=64 column blocks (last block dim must
-            # divide 128) and at d=128 the strided block DMA lost more
-            # than the transposes cost (GPT step 254.0 vs 251.7 ms) —
-            # so the transposing path stays.
+            # streaming shapes (GPT S>=2048): the transposing BHSD
+            # kernel; at d=128 the strided no-transpose block DMA
+            # measured as a wash (GPT step 254.0 vs 251.7 ms), so the
+            # transposes stay on this path
             return flash_attention(q, k, v, causal=is_causal, scale=scale)
     b, sq, h, d = q.shape
     sk = k.shape[1]

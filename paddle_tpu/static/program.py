@@ -375,11 +375,13 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars=None,
                 folded = copy.deepcopy(layer)
                 fuse_conv_bn(folded)
                 # the name-based pairing can mis-fold a pre-activation
-                # block (bn before conv, equal channels): verify on a
-                # random example and keep the unfused model on mismatch
-                ex_rng = np.random.default_rng(0)
-                example = [_example_input(v, ex_rng) for v in feed_vars]
-                if fold_preserves_outputs(layer, folded, example):
+                # block (bn before conv, equal channels): verify on
+                # three independent random examples (magnitude-scaled
+                # tolerance) and keep the unfused model on mismatch
+                examples = [
+                    [_example_input(v, np.random.default_rng(seed))
+                     for v in feed_vars] for seed in (0, 1, 2)]
+                if fold_preserves_outputs(layer, folded, examples):
                     layer = folded
                 else:
                     import warnings

@@ -367,11 +367,14 @@ def test_sdpa_causal_kv_cache_never_uses_flash(monkeypatch):
     assert float(got[0, 0, 0, 0]) > 0  # attends beyond position 0
 
 
-def test_fused_single_qblock_backward_multi_kblock():
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_single_qblock_backward_multi_kblock(causal):
     """The nq==1 fused backward with nk>1 (cross-attention: short Q,
     long K): dQ must accumulate across the streamed K blocks and dK/dV
-    must land in the right per-block slots. Reachable in production
-    via q_len<=block <= k_len cross-attention."""
+    must land in the right per-block slots — including the causal
+    branch, where the second K block is FULLY masked (its dk/dv must
+    come out exactly zero via the skip path, not garbage). Reachable
+    in production via q_len<=block <= k_len cross-attention."""
     rng = np.random.default_rng(7)
     b, h, d = 2, 2, 64
     sq, sk = 128, 256  # block 128 -> nq=1, nk=2 through the fused path
@@ -381,11 +384,15 @@ def test_fused_single_qblock_backward_multi_kblock():
 
     def loss_flash(q_, k_, v_):
         return jnp.sum(fa.flash_attention(
-            q_, k_, v_, block_q=128, block_k=128) ** 2)
+            q_, k_, v_, causal=causal, block_q=128, block_k=128) ** 2)
 
     def loss_ref(q_, k_, v_):
-        return jnp.sum(scaled_dot_product_attention(
-            q_, k_, v_, use_flash=False) ** 2)
+        # the flash causal mask is diagonal-aligned (q_pos >= k_pos,
+        # no cache offset) — mirror it for the reference
+        o = scaled_dot_product_attention(q_, k_, v_, use_flash=False,
+                                         attn_mask=_diag_mask(sq, sk)
+                                         if causal else None)
+        return jnp.sum(o ** 2)
 
     g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
@@ -393,3 +400,35 @@ def test_fused_single_qblock_backward_multi_kblock():
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                    rtol=5e-3, atol=5e-3,
                                    err_msg=f"d{name} mismatch")
+    if causal:
+        # K block 1 (positions 128..255) is fully masked: its dk/dv
+        # must be EXACT zeros (the pl.when skip writes them)
+        assert np.all(np.asarray(g_flash[1])[:, 128:] == 0.0)
+        assert np.all(np.asarray(g_flash[2])[:, 128:] == 0.0)
+
+
+def _diag_mask(sq, sk):
+    """Diagonal-aligned causal mask (the flash kernel's convention:
+    q_pos >= k_pos with no sk-sq cache offset)."""
+    qpos = jnp.arange(sq)[:, None]
+    kpos = jnp.arange(sk)[None, :]
+    return jnp.where(qpos >= kpos, 0.0, -jnp.inf)[None, None]
+
+
+def test_single_kblock_causal_forward_sq_gt_sk():
+    """nq>1/nk==1 causal single-K-block forward (the qb-offset mask
+    lines in _fwd_single_block_kernel): q longer than k, grid over Q
+    blocks, every block sees the one K block under the diagonal-aligned
+    mask."""
+    rng = np.random.default_rng(11)
+    b, h, d = 1, 2, 64
+    sq, sk = 256, 128  # block 128 -> nq=2, nk=1 single-block fwd path
+    q = jnp.asarray(rng.standard_normal((b, sq, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((b, sk, h, d)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((b, sk, h, d)).astype(np.float32))
+    out = fa.flash_attention(q, k, v, causal=True, block_q=128,
+                             block_k=128)
+    ref = scaled_dot_product_attention(q, k, v, use_flash=False,
+                                       attn_mask=_diag_mask(sq, sk))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)

@@ -1,0 +1,134 @@
+"""Folded (layout-native [B,S,E]) flash attention correctness in Pallas
+interpreter mode — the single-K-block no-transpose path BERT shapes
+route through (ops/pallas/folded_attention.py)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.nn_functional import scaled_dot_product_attention
+from paddle_tpu.ops.pallas import flash_attention as fa
+from paddle_tpu.ops.pallas import folded_attention as fo
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    orig = fo.pl.pallas_call
+    monkeypatch.setattr(fo.pl, "pallas_call",
+                        functools.partial(orig, interpret=True))
+    yield
+
+
+def _rand(b, s, h, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(
+        rng.standard_normal((b, s, h, d)).astype(np.float32))
+        for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("h,d", [(4, 64), (2, 128)])
+def test_folded_forward_matches_reference(causal, h, d):
+    q, k, v = _rand(2, 256, h, d)
+    ref = scaled_dot_product_attention(q, k, v, is_causal=causal,
+                                       use_flash=False)
+    out = fo.folded_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_folded_backward_matches_reference(causal):
+    q, k, v = _rand(1, 128, 2, 64, seed=3)
+
+    def loss_folded(q_, k_, v_):
+        return jnp.sum(fo.folded_attention(q_, k_, v_,
+                                           causal=causal) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(scaled_dot_product_attention(
+            q_, k_, v_, is_causal=causal, use_flash=False) ** 2)
+
+    g_fold = jax.grad(loss_folded, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_fold, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=5e-3, atol=5e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_folded_backward_odd_head_count():
+    """h=6, d=64 -> 3 column groups of 2 heads: the lane grouping must
+    not mix adjacent heads' gradients."""
+    q, k, v = _rand(1, 128, 6, 64, seed=5)
+
+    def loss_folded(q_, k_, v_):
+        return jnp.sum(fo.folded_attention(q_, k_, v_) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(scaled_dot_product_attention(
+            q_, k_, v_, use_flash=False) ** 2)
+
+    g_fold = jax.grad(loss_folded, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_fold, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_folded_bf16():
+    q, k, v = (t.astype(jnp.bfloat16) for t in _rand(2, 128, 4, 64))
+    out = fo.folded_attention(q, k, v)
+    ref = scaled_dot_product_attention(q, k, v, use_flash=False)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32),
+        np.asarray(ref, dtype=np.float32), rtol=3e-2, atol=3e-2)
+
+
+def test_supported_gate():
+    ok = fo.folded_attention_supported
+    # BERT-base pretrain shape (needs a TPU-family backend or the AOT
+    # force gate — exercise via the scoped context)
+    with fa.force_flash_for_aot():
+        assert ok((64, 512, 12, 64), (64, 512, 12, 64))
+        assert ok((8, 512, 16, 64), (8, 512, 16, 64), causal=True)
+        # cross-length, overlong, non-tiling head groups: all rejected
+        assert not ok((1, 512, 12, 64), (1, 256, 12, 64))
+        assert not ok((1, 2048, 12, 64), (1, 2048, 12, 64))
+        assert not ok((1, 512, 1, 64), (1, 512, 1, 64))  # E=64 < 128
+        assert not ok((1, 512, 3, 64), (1, 512, 3, 64))  # E=192
+        # causal past one 512-block: streaming kernel skips masked
+        # blocks, folded would pay full S^2
+        assert not ok((1, 1024, 8, 64), (1, 1024, 8, 64), causal=True)
+        assert ok((1, 1024, 8, 64), (1, 1024, 8, 64), causal=False)
+    assert not ok((64, 512, 12, 64), (64, 512, 12, 64), backend="cpu")
+
+
+def test_sdpa_routes_bert_shape_to_folded(monkeypatch):
+    """scaled_dot_product_attention must take the folded kernel for
+    single-block self-attention shapes (and stay off it for masked or
+    dropout calls)."""
+    import paddle_tpu.ops.nn_functional as NF
+
+    taken = {}
+
+    def fake_folded(q, k, v, causal=False, scale=None):
+        taken["folded"] = True
+        return q
+
+    monkeypatch.setattr(NF, "_FLASH_MIN_SEQ", 512)
+    import paddle_tpu.ops.pallas.folded_attention as fomod
+    monkeypatch.setattr(fomod, "folded_attention", fake_folded)
+    q = jnp.zeros((2, 512, 4, 64))
+    with fa.force_flash_for_aot():
+        out = NF.scaled_dot_product_attention(q, q, q)
+        assert taken.get("folded") and out.shape == q.shape
+        # an attn_mask must bypass the folded/flash path entirely
+        taken.clear()
+        mask = jnp.zeros((2, 1, 1, 512))
+        NF.scaled_dot_product_attention(q, q, q, attn_mask=mask)
+        assert "folded" not in taken

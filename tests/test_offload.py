@@ -132,22 +132,38 @@ def test_remat_save_attention_loss_parity(monkeypatch):
     ids = IDS[:4]
 
     def run(save_attn):
-        try:
-            pt.seed(0)
-            with fa.force_flash_for_aot():
-                m = GPTForCausalLM(gpt_tiny(
-                    remat=True, remat_save_attention=save_attn,
-                    use_flash_attention=True))
-                if save_attn:
-                    from paddle_tpu.core.offload import ATTN_OUT_NAME
-                    assert remat_saved_names() == (ATTN_OUT_NAME,)
-                step = TrainStep(m, optim.SGD(learning_rate=0.1),
-                                 lambda mm, b: mm(b[0], labels=b[1]))
-                return [float(step((ids, ids))) for _ in range(2)]
-        finally:
-            set_remat_saved_names(())
+        pt.seed(0)
+        with fa.force_flash_for_aot():
+            m = GPTForCausalLM(gpt_tiny(
+                remat=True, remat_save_attention=save_attn,
+                use_flash_attention=True))
+            from paddle_tpu.core.offload import ATTN_OUT_NAME
+            # scoped per-model (r4 advisor): construction captures the
+            # selection but must NOT touch the process global
+            assert m.gpt._remat_names == (
+                (ATTN_OUT_NAME,) if save_attn else None)
+            assert remat_saved_names() == ()
+            step = TrainStep(m, optim.SGD(learning_rate=0.1),
+                             lambda mm, b: mm(b[0], labels=b[1]))
+            return [float(step((ids, ids))) for _ in range(2)]
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+
+def test_model_build_leaves_external_remat_selection_alone():
+    """r4 advisor: constructing a GPTModel with
+    remat_save_attention=False used to clear a selection made by
+    another model or a direct set_remat_saved_names() call."""
+    from paddle_tpu.core.offload import (ATTN_OUT_NAME, remat_saved_names,
+                                         set_remat_saved_names)
+    try:
+        set_remat_saved_names((ATTN_OUT_NAME,))
+        GPTForCausalLM(gpt_tiny(remat=True, remat_save_attention=False))
+        assert remat_saved_names() == (ATTN_OUT_NAME,)
+        GPTForCausalLM(gpt_tiny(remat=True, remat_save_attention=True))
+        assert remat_saved_names() == (ATTN_OUT_NAME,)
+    finally:
+        set_remat_saved_names(())
 
 
 def test_remat_save_attention_residuals_actually_saved(monkeypatch):

