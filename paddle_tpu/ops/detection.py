@@ -28,13 +28,21 @@ def box_area(boxes):
         jnp.maximum(boxes[..., 3] - boxes[..., 1], 0)
 
 
-def iou_similarity(x, y):
-    """Pairwise IoU: x [N,4], y [M,4] → [N,M]."""
+def iou_similarity(x, y, box_normalized=True):
+    """Pairwise IoU: x [N,4], y [M,4] → [N,M]. ``box_normalized=False``
+    treats coordinates as pixel indices: widths/heights get the +1
+    offset (ref iou_similarity_op.h IOUSimilarityFunctor norm)."""
+    off = 0.0 if box_normalized else 1.0
     lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
     rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
-    wh = jnp.maximum(rb - lt, 0)
+    wh = jnp.maximum(rb - lt + off, 0)
     inter = wh[..., 0] * wh[..., 1]
-    union = box_area(x)[:, None] + box_area(y)[None, :] - inter
+
+    def area(b):
+        return (jnp.maximum(b[..., 2] - b[..., 0] + off, 0) *
+                jnp.maximum(b[..., 3] - b[..., 1] + off, 0))
+
+    union = area(x)[:, None] + area(y)[None, :] - inter
     return inter / jnp.maximum(union, 1e-10)
 
 
@@ -49,9 +57,16 @@ def box_clip(boxes, im_shape):
 
 
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode",
-              box_normalized=True):
+              box_normalized=True, axis=0):
     """Encode targets against priors or decode deltas back to boxes
-    (ref box_coder_op.h EncodeCenterSize/DecodeCenterSize)."""
+    (ref box_coder_op.h EncodeCenterSize/DecodeCenterSize).
+
+    Decode accepts deltas [P, 4] (one per prior) or [R, C, 4] with
+    ``axis`` selecting which dim the priors broadcast along (ref
+    box_coder_op.cc:69: axis=0 -> prior j for column j, axis=1 ->
+    prior i for row i)."""
+    if axis not in (0, 1):
+        raise ValueError(f"axis must be 0 or 1, got {axis}")
     norm = 0.0 if box_normalized else 1.0
     pw = prior_box[:, 2] - prior_box[:, 0] + norm
     ph = prior_box[:, 3] - prior_box[:, 1] + norm
@@ -73,6 +88,28 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode",
             jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10)),
         ], axis=-1)  # [T, P, 4]
         return out / var[None]
+    if target_box.ndim == 3:
+        # [R, C, 4] deltas; priors broadcast along the non-axis dim
+        bpw, bph = pw[None, :], ph[None, :]
+        if axis == 1:
+            bpw, bph = pw[:, None], ph[:, None]
+            pcx_b, pcy_b = pcx[:, None], pcy[:, None]
+            # per-prior variances ride the prior (row) axis; a shared
+            # [1, 4] variance broadcasts either way
+            var_b = var[:, None] if var.shape[0] > 1 else var[None]
+        else:
+            pcx_b, pcy_b = pcx[None, :], pcy[None, :]
+            # priors are the column axis here, which [P, 4] -> [1, P, 4]
+            # already aligns with
+            var_b = var[None]
+        d = target_box * var_b
+        w = jnp.exp(d[..., 2]) * bpw
+        h = jnp.exp(d[..., 3]) * bph
+        cx = d[..., 0] * bpw + pcx_b
+        cy = d[..., 1] * bph + pcy_b
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - norm, cy + h * 0.5 - norm],
+                         axis=-1)
     # decode: target_box [P, 4] deltas (one per prior)
     d = target_box * var
     w = jnp.exp(d[:, 2]) * pw
@@ -153,16 +190,25 @@ def anchor_generator(feature_h, feature_w, anchor_sizes, aspect_ratios,
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh,
-             downsample_ratio, clip_bbox=True, scale_x_y=1.0):
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
     """Decode one YOLOv3 head (ref yolo_box_op.h).
 
     x: [N, na*(5+classes), H, W]; img_size: [N, 2] (h, w).
+    With ``iou_aware`` (ref yolo_box_op.h:56 GetIoUIndex /
+    yolo_box_op.cc:169), x is [N, na*(6+classes), H, W]: the FIRST na
+    channels are per-anchor IoU predictions, and the confidence becomes
+    conf^(1-factor) * sigmoid(iou)^factor.
     Returns (boxes [N, na*H*W, 4] xyxy in image pixels,
              scores [N, na*H*W, classes]); boxes with conf < thresh are 0.
     """
     n, _, h, w = x.shape
     na = len(anchors) // 2
     an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    iou = None
+    if iou_aware:
+        iou = jax.nn.sigmoid(x[:, :na].astype(jnp.float32))  # [n,na,h,w]
+        x = x[:, na:]
     x = x.reshape(n, na, 5 + class_num, h, w)
     grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
     grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
@@ -174,6 +220,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
     bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
     bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
     conf = jax.nn.sigmoid(x[:, :, 4])
+    if iou_aware:
+        conf = (conf ** (1.0 - iou_aware_factor) *
+                iou ** iou_aware_factor)
     probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]  # [n,na,C,h,w]
     keep = conf >= conf_thresh
     img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
@@ -197,25 +246,36 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
 
 
 def nms(boxes, scores, iou_threshold=0.5, score_threshold=-jnp.inf,
-        max_out=None):
+        max_out=None, eta=1.0, normalized=True):
     """Single-class NMS, fixed-size (jittable): returns
     (indices [max_out] int32, valid [max_out] bool). Greedy suppression
-    via fori_loop over score-sorted candidates."""
+    via fori_loop over score-sorted candidates. ``eta`` < 1 is the
+    reference's adaptive-NMS decay (multiclass_nms_op.cc NMSFast: after
+    each kept box, threshold *= eta while threshold > 0.5);
+    ``normalized=False`` uses pixel-index IoU (+1 w/h offset)."""
     n = boxes.shape[0]
     max_out = n if max_out is None else int(max_out)
     order = jnp.argsort(-scores)
     b = boxes[order]
     s = scores[order]
-    iou = iou_similarity(b, b)
+    iou = iou_similarity(b, b, box_normalized=normalized)
     alive0 = s > score_threshold
 
-    def body(i, alive):
-        # if candidate i is alive, kill every lower-scored box with
-        # IoU > threshold
-        kill = (iou[i] > iou_threshold) & (jnp.arange(n) > i) & alive[i]
-        return alive & ~kill
+    def body(j, carry):
+        # candidate j (score order) is checked against every earlier
+        # KEPT box at the CURRENT threshold — which each keep may have
+        # decayed (reference NMSFast: keep, then thr *= eta while
+        # thr > 0.5)
+        alive, thr = carry
+        killed = jnp.any((iou[:, j] > thr) & (jnp.arange(n) < j) &
+                         alive)
+        alive_j = alive[j] & ~killed
+        alive = alive.at[j].set(alive_j)
+        thr = jnp.where(alive_j & (thr > 0.5), thr * eta, thr)
+        return alive, thr
 
-    alive = jax.lax.fori_loop(0, n, body, alive0)
+    alive, _ = jax.lax.fori_loop(
+        0, n, body, (alive0, jnp.float32(iou_threshold)))
     rank = jnp.cumsum(alive) - 1
     slot = jnp.where(alive, rank, max_out)
     idx_out = jnp.full((max_out,), -1, jnp.int32)
@@ -226,18 +286,21 @@ def nms(boxes, scores, iou_threshold=0.5, score_threshold=-jnp.inf,
 
 
 def multiclass_nms(boxes, scores, score_threshold=0.05, nms_top_k=64,
-                   keep_top_k=100, iou_threshold=0.5, background_label=-1):
+                   keep_top_k=100, iou_threshold=0.5, background_label=-1,
+                   nms_eta=1.0, normalized=True):
     """Per-class NMS + global keep_top_k (ref multiclass_nms_op.cc), one
     image. boxes [N,4], scores [C,N]. Returns fixed-size
     (out [keep_top_k, 6] rows = (class, score, x1, y1, x2, y2), count);
-    empty slots hold -1 class."""
+    empty slots hold -1 class. ``nms_eta``/``normalized`` follow the
+    reference NMSFast attrs (adaptive decay / pixel-index IoU)."""
     num_classes, n = scores.shape
     nms_top_k = min(int(nms_top_k), n)
 
     def per_class(c, cls_scores):
         top_s, top_i = jax.lax.top_k(cls_scores, nms_top_k)
         idx, valid = nms(boxes[top_i], top_s, iou_threshold,
-                         score_threshold, max_out=nms_top_k)
+                         score_threshold, max_out=nms_top_k,
+                         eta=nms_eta, normalized=normalized)
         sel = jnp.where(idx >= 0, top_i[jnp.clip(idx, 0)], 0)
         return (jnp.full((nms_top_k,), c, jnp.float32),
                 jnp.where(valid, top_s[jnp.clip(idx, 0)], -1.0),
@@ -355,11 +418,19 @@ def roi_pool(x, rois, output_size, spatial_scale=1.0):
     return jax.vmap(one_roi)(rois)
 
 
-def bipartite_match(dist):
-    """Greedy bipartite matching (ref bipartite_match_op.cc with
-    match_type='bipartite'): dist [N, M] similarity. Returns
-    (match_indices [M] int32 row matched to each column, -1 if none,
-    match_dist [M])."""
+def bipartite_match(dist, match_type="bipartite", dist_threshold=0.5):
+    """Greedy bipartite matching (ref bipartite_match_op.cc): dist
+    [N, M] similarity. Returns (match_indices [M] int32 row matched to
+    each column, -1 if none, match_dist [M]).
+
+    ``match_type='per_prediction'`` adds the reference's second pass
+    (ArgMaxMatch): every column the bipartite pass left unmatched takes
+    its argmax row when that similarity >= ``dist_threshold`` (rows may
+    be reused by multiple columns in this pass)."""
+    if match_type not in ("bipartite", "per_prediction"):
+        raise ValueError(
+            f"match_type must be 'bipartite' or 'per_prediction', got "
+            f"{match_type!r} (bipartite_match_op.cc)")
     n, m = dist.shape
     steps = min(n, m)
 
@@ -379,6 +450,12 @@ def bipartite_match(dist):
     val0 = jnp.zeros((m,), dist.dtype)
     _, idx, val = jax.lax.fori_loop(
         0, steps, body, (dist.astype(jnp.float32), idx0, val0))
+    if match_type == "per_prediction":
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_val = jnp.max(dist, axis=0).astype(val.dtype)
+        take = (idx < 0) & (best_val >= dist_threshold)
+        idx = jnp.where(take, best_row, idx)
+        val = jnp.where(take, best_val, val)
     return idx, val
 
 
@@ -449,22 +526,29 @@ def target_assign(x, match_indices, mismatch_value=0.0):
     return out, matched.astype(x.dtype).reshape(shape)
 
 
-def _assign_anchors(anchors, gts, positive_overlap, negative_overlap):
+def _assign_anchors(anchors, gts, positive_overlap, negative_overlap,
+                    valid=None):
     """Shared anchor-assignment core (rpn_target_assign_op.cc /
     retinanet_target_assign_op.cc): IoU-threshold labels (-1 ignore, 0
-    bg, 1 fg) with the every-gt's-best-anchor-is-positive rule. Returns
-    (labels, best_gt)."""
+    bg, 1 fg) with the every-gt's-best-anchor-is-positive rule.
+    ``valid`` masks anchors OUT of assignment entirely (the straddle
+    filter runs before assignment in the reference, so a gt's best
+    anchor is its best VALID anchor). Returns (labels, best_gt)."""
     n = len(anchors)
     if len(gts) == 0:
         return np.zeros(n, np.int32), np.zeros(n, np.int64)
     ious = np.asarray(iou_similarity(jnp.asarray(anchors),
                                      jnp.asarray(gts)))
+    if valid is not None:
+        ious = np.where(valid[:, None], ious, -1.0)
     best_gt = ious.argmax(1)
     best_iou = ious.max(1)
     labels = -np.ones(n, np.int32)
     labels[best_iou < negative_overlap] = 0
     labels[best_iou >= positive_overlap] = 1
     labels[ious.argmax(0)] = 1  # every gt's best anchor is positive
+    if valid is not None:
+        labels[~valid] = -1  # filtered anchors never train
     return labels, best_gt
 
 
@@ -484,14 +568,30 @@ def _encode_fg_targets(anchors, gts, best_gt, fg):
 def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_height=None,
                       im_width=None, rpn_batch_size_per_im=256,
                       rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
-                      rpn_negative_overlap=0.3, use_random=True, seed=0):
+                      rpn_negative_overlap=0.3, use_random=True, seed=0,
+                      rpn_straddle_thresh=0.0):
     """Sample RPN training anchors (rpn_target_assign_op.cc), host-side
     eager: returns (loc_index, score_index, tgt_bbox, tgt_label,
-    bbox_inside_weight) as numpy arrays."""
+    bbox_inside_weight) as numpy arrays. ``rpn_straddle_thresh`` >= 0
+    drops anchors that straddle the image boundary by more than the
+    threshold from sampling entirely (ref FilterStraddleAnchor:
+    keep iff x1 >= -thr, y1 >= -thr, x2 < W + thr, y2 < H + thr);
+    negative disables the filter (all anchors eligible)."""
     anchors = np.asarray(anchors, np.float32)
     gts = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
+    inside = None
+    if rpn_straddle_thresh >= 0 and im_height is not None and \
+            im_width is not None:
+        t = float(rpn_straddle_thresh)
+        inside = ((anchors[:, 0] >= -t) & (anchors[:, 1] >= -t) &
+                  (anchors[:, 2] < im_width + t) &
+                  (anchors[:, 3] < im_height + t))
+    # filter BEFORE assignment (ref FilterStraddleAnchor runs first):
+    # a border gt whose best anchor straddles must promote its best
+    # SURVIVING anchor, not lose its positive entirely
     labels, best_gt = _assign_anchors(anchors, gts, rpn_positive_overlap,
-                                      rpn_negative_overlap)
+                                      rpn_negative_overlap,
+                                      valid=inside)
     rng = np.random.default_rng(seed)
     fg_cap = int(rpn_batch_size_per_im * rpn_fg_fraction)
     fg = np.nonzero(labels == 1)[0]
@@ -518,29 +618,35 @@ def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_height=None,
 def generate_proposals(scores, bbox_deltas, im_shape, anchors,
                        variances=None, pre_nms_top_n=6000,
                        post_nms_top_n=1000, nms_thresh=0.5, min_size=0.1,
-                       eta=1.0):
-    """RPN proposal generation (generate_proposals_op.cc), jittable with
-    fixed output size: scores [A], bbox_deltas [A, 4], anchors [A, 4].
-    Returns (rois [post_nms_top_n, 4], roi_scores [post_nms_top_n],
-    valid mask)."""
+                       eta=1.0, pixel_offset=True):
+    """RPN proposal generation (generate_proposals_op.cc /
+    generate_proposals_v2_op.cc), jittable with fixed output size:
+    scores [A], bbox_deltas [A, 4], anchors [A, 4]. Returns
+    (rois [post_nms_top_n, 4], roi_scores [post_nms_top_n], valid).
+    ``eta`` is the adaptive-NMS decay; ``pixel_offset`` is the v2 attr
+    (True = pixel-index +1 convention in decode/clip/size — the v1
+    behavior; False = continuous coordinates)."""
+    off = 1.0 if pixel_offset else 0.0
     scores = jnp.asarray(scores).reshape(-1)
     deltas = jnp.asarray(bbox_deltas).reshape(-1, 4)
     anchors = jnp.asarray(anchors).reshape(-1, 4)
     k = min(int(pre_nms_top_n), scores.shape[0])
     top, idx = jax.lax.top_k(scores, k)
     boxes = box_coder(anchors[idx], variances, deltas[idx],
-                      code_type="decode", box_normalized=False)
+                      code_type="decode",
+                      box_normalized=not pixel_offset)
     h, w = im_shape[0], im_shape[1]
-    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, w - 1),
-                       jnp.clip(boxes[:, 1], 0, h - 1),
-                       jnp.clip(boxes[:, 2], 0, w - 1),
-                       jnp.clip(boxes[:, 3], 0, h - 1)], axis=1)
-    ws = boxes[:, 2] - boxes[:, 0] + 1
-    hs = boxes[:, 3] - boxes[:, 1] + 1
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, w - off),
+                       jnp.clip(boxes[:, 1], 0, h - off),
+                       jnp.clip(boxes[:, 2], 0, w - off),
+                       jnp.clip(boxes[:, 3], 0, h - off)], axis=1)
+    ws = boxes[:, 2] - boxes[:, 0] + off
+    hs = boxes[:, 3] - boxes[:, 1] + off
     keep_size = (ws >= min_size) & (hs >= min_size)
     cand_scores = jnp.where(keep_size, top, -jnp.inf)
     sel, valid = nms(boxes, cand_scores, iou_threshold=nms_thresh,
-                     max_out=int(post_nms_top_n))
+                     max_out=int(post_nms_top_n), eta=eta,
+                     normalized=not pixel_offset)
     rois = boxes[sel]
     roi_scores = cand_scores[sel]
     return rois, roi_scores, valid
@@ -593,14 +699,18 @@ def matrix_nms(boxes, scores, score_threshold=0.05, post_threshold=0.0,
 
 
 def distribute_fpn_proposals(rois, min_level=2, max_level=5,
-                             refer_level=4, refer_scale=224):
+                             refer_level=4, refer_scale=224,
+                             pixel_offset=True):
     """Assign RoIs to FPN levels (distribute_fpn_proposals_op.h):
     level = floor(refer_level + log2(sqrt(area)/refer_scale)). Host-side
-    eager (per-level counts are dynamic). Returns (rois_per_level list,
-    restore_index)."""
+    eager (per-level counts are dynamic). ``pixel_offset`` matches the
+    reference attr: True computes areas with the +1 pixel-index offset
+    (the v1 BBoxArea convention), False uses plain widths. Returns
+    (rois_per_level list, restore_index)."""
     r = np.asarray(rois, np.float32)
+    off = 1.0 if pixel_offset else 0.0
     scale = np.sqrt(np.maximum(
-        (r[:, 2] - r[:, 0]) * (r[:, 3] - r[:, 1]), 1e-9))
+        (r[:, 2] - r[:, 0] + off) * (r[:, 3] - r[:, 1] + off), 1e-9))
     lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-9))
     lvl = np.clip(lvl, min_level, max_level).astype(int)
     outs, order = [], []
@@ -692,10 +802,23 @@ def polygon_box_transform(x):
 
 
 def locality_aware_nms(boxes, scores, iou_threshold=0.5,
-                       score_threshold=0.0):
+                       score_threshold=0.0, nms_top_k=-1, keep_top_k=-1,
+                       nms_eta=1.0, normalized=True,
+                       background_label=-1):
     """Locality-aware NMS for quadrangle/box text detection (EAST
-    postprocess; reference incubate op): weighted-merge consecutive
-    overlapping boxes, then standard NMS. Host-side eager."""
+    postprocess; ref locality_aware_nms_op.cc): weighted-merge
+    consecutive overlapping boxes, then standard NMS. Host-side eager.
+
+    Attr parity with the reference maker: ``nms_top_k`` caps merged
+    candidates entering NMS, ``keep_top_k`` caps the output,
+    ``nms_eta``/``normalized`` follow NMSFast. ``background_label``
+    applies to the reference's [C, N] multi-score layout; this
+    single-class entry accepts it for signature parity (class 0 is the
+    only class, dropped entirely when background_label == 0)."""
+    off = 0.0 if normalized else 1.0
+    if background_label == 0:
+        return (np.zeros((0, 4), np.float32),
+                np.zeros((0,), np.float32))
     b = np.asarray(boxes, np.float32).reshape(-1, 4).copy()
     s = np.asarray(scores, np.float32).reshape(-1).copy()
     keep_b, keep_s = [], []
@@ -706,9 +829,10 @@ def locality_aware_nms(boxes, scores, iou_threshold=0.5,
             last = keep_b[-1]
             ix1 = max(last[0], b[i][0]); iy1 = max(last[1], b[i][1])
             ix2 = min(last[2], b[i][2]); iy2 = min(last[3], b[i][3])
-            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
-            ua = ((last[2] - last[0]) * (last[3] - last[1]) +
-                  (b[i][2] - b[i][0]) * (b[i][3] - b[i][1]) - inter)
+            inter = max(ix2 - ix1 + off, 0) * max(iy2 - iy1 + off, 0)
+            ua = ((last[2] - last[0] + off) * (last[3] - last[1] + off) +
+                  (b[i][2] - b[i][0] + off) *
+                  (b[i][3] - b[i][1] + off) - inter)
             if ua > 0 and inter / ua >= iou_threshold:
                 wsum = keep_s[-1] + s[i]
                 keep_b[-1] = (last * keep_s[-1] + b[i] * s[i]) / wsum
@@ -720,9 +844,15 @@ def locality_aware_nms(boxes, scores, iou_threshold=0.5,
         return np.zeros((0, 4), np.float32), np.zeros((0,), np.float32)
     kb = np.stack(keep_b)
     ks = np.asarray(keep_s)
+    if nms_top_k > 0 and len(kb) > nms_top_k:
+        top = np.argsort(-ks)[:nms_top_k]
+        kb, ks = kb[top], ks[top]
     sel, valid = nms(jnp.asarray(kb), jnp.asarray(ks),
-                     iou_threshold=iou_threshold, max_out=len(kb))
+                     iou_threshold=iou_threshold, max_out=len(kb),
+                     eta=nms_eta, normalized=normalized)
     sel = np.asarray(sel)[np.asarray(valid)]
+    if keep_top_k > 0:
+        sel = sel[:keep_top_k]
     return kb[sel], ks[sel]
 
 

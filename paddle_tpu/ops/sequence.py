@@ -23,10 +23,39 @@ def _expand_mask(mask, x):
     return mask.reshape(mask.shape + (1,) * (x.ndim - 2))
 
 
-def sequence_pad(x, lengths, pad_value=0.0):
+def sequence_pad(x, lengths, pad_value=0.0, padded_length=-1):
     """Force padding positions of an already-dense batch to ``pad_value``
-    (ref sequence_pad_op.cc semantics on the device representation)."""
-    mask = _expand_mask(_valid_mask(lengths, x.shape[1]), x)
+    (ref sequence_pad_op.cc semantics on the device representation).
+    ``padded_length`` fixes the output time dimension (the reference
+    attr; -1 = the batch's current max, i.e. x.shape[1]) — shorter
+    truncates is an error in the reference, so it must be >= every
+    length; longer right-pads with ``pad_value``."""
+    m = x.shape[1]
+    if padded_length >= 0 and padded_length != m:
+        if padded_length < m:
+            # dropping buffer columns is only legal when they are all
+            # padding; with concrete lengths enforce it like the
+            # reference (sequence_pad_op: padded_length must cover
+            # every sequence). Traced lengths cannot be checked at
+            # trace time — the caller guarantees it.
+            try:
+                max_len = int(np.max(np.asarray(lengths)))
+            except Exception:
+                max_len = None
+            if max_len is not None and padded_length < max_len:
+                raise ValueError(
+                    f"sequence_pad: padded_length={padded_length} is "
+                    f"shorter than the longest sequence ({max_len}) — "
+                    "the reference op rejects this (truncation is "
+                    "never implicit)")
+            x = x[:, :padded_length]
+            m = padded_length
+        else:
+            pad = [(0, 0), (0, padded_length - m)] + \
+                [(0, 0)] * (x.ndim - 2)
+            x = jnp.pad(x, pad)
+            m = padded_length
+    mask = _expand_mask(_valid_mask(lengths, m), x)
     return jnp.where(mask, x, jnp.asarray(pad_value, dtype=x.dtype))
 
 
@@ -191,23 +220,63 @@ def sequence_unpad(x, lengths):
     return RaggedTensor.from_padded(np.asarray(x), np.asarray(lengths))
 
 
-def sequence_conv(x, lengths, weight, context_length, context_start=None):
+def sequence_conv(x, lengths, weight, context_length, context_start=None,
+                  context_stride=1, padding_trainable=False,
+                  padding_data=None):
     """Context-window convolution over sequences (ref sequence_conv_op.h):
     each timestep concatenates ``context_length`` neighbouring frames
     (starting at ``context_start``, default -(ctx-1)//2) and matmuls with
-    ``weight [context_length*dim, out_dim]``. Padding frames are zeros."""
+    ``weight [context_length*dim, out_dim]``.
+
+    ``context_stride`` must be 1 — the reference op enforces the same
+    (sequence_conv_op.cc: "Currently, SequenceConvOp only supports
+    contextStride=1"). With ``padding_trainable`` the frames a window
+    reaches beyond the sequence boundary come from ``padding_data``
+    [up_pad + down_pad, dim] (learned rows, ref
+    context_project.h ContextProjectFunctor) instead of zeros: row
+    ``context_start + k`` (negative offsets index the up rows, overrun
+    past the end indexes the down rows)."""
+    if context_stride != 1:
+        raise ValueError(
+            "sequence_conv supports context_stride=1 only (the "
+            "reference enforces the same, sequence_conv_op.cc)")
     if context_start is None:
         context_start = -((context_length - 1) // 2)
+    up_pad = max(0, -context_start)
+    down_pad = max(0, context_start + context_length - 1)
+    if padding_trainable:
+        if padding_data is None:
+            raise ValueError("padding_trainable=True requires "
+                             "padding_data [up_pad + down_pad, dim]")
+        padding_data = jnp.asarray(padding_data)
     b, m, d = x.shape
     valid = _valid_mask(lengths, m)
     xz = jnp.where(valid[..., None], x, 0)
+    lens = jnp.asarray(lengths)[:, None]  # [b, 1]
     cols = []
     for k in range(context_length):
         shift = context_start + k
         idx = jnp.arange(m) + shift
-        ok = (idx >= 0) & (idx < m)
+        # in-sequence test is per ROW: a window can overrun the row's
+        # own length even inside the dense buffer
+        ok = (idx[None, :] >= 0) & (idx[None, :] < lens)
         col = jnp.take(xz, jnp.clip(idx, 0, m - 1), axis=1)
-        cols.append(jnp.where(ok[None, :, None], col, 0))
+        col = jnp.where(ok[..., None], col, 0)
+        if padding_trainable and shift != 0:
+            # ref context_project.h: input index idx < 0 reads learned
+            # up row (up_pad + idx); idx >= L reads learned down row
+            # (up_pad + idx - L)
+            n_rows = padding_data.shape[0]
+            below = idx[None, :] < 0  # [1, m]
+            over = idx[None, :] >= lens  # [b, m]
+            in_row = jnp.arange(m)[None, :] < lens
+            pu = padding_data[jnp.clip(up_pad + idx, 0, n_rows - 1)]
+            pd_row = jnp.clip(up_pad + (idx[None, :] - lens), 0,
+                              n_rows - 1)
+            pdv = padding_data[pd_row]  # [b, m, d]
+            col = jnp.where((below & in_row)[..., None], pu[None], col)
+            col = jnp.where((over & in_row)[..., None], pdv, col)
+        cols.append(col)
     im2col = jnp.concatenate(cols, axis=-1)  # [b, m, ctx*d]
     out = im2col.reshape(b * m, -1) @ weight
     out = out.reshape(b, m, -1)

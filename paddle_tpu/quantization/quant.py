@@ -316,6 +316,74 @@ class Int8Conv2D(Layer):
                                 tuple(args), {})
 
 
+class WeightOnlyInt8Linear(Layer):
+    """Weight-ONLY int8 linear for decode/serving, where weight
+    STREAMING is the bottleneck (PROFILE_DECODE.json roofline: at small
+    per-step batch the matmuls are bandwidth-bound on the weights, so
+    halving weight bytes approaches 2x tokens/s; activations carry
+    negligible traffic and stay bf16/f32 — the reference analog is
+    TensorRT's weight-only int8 engines, trt_int8_calibrator.cc
+    capability). No calibration needed: only weights quantize
+    (per-out-channel abs-max), the dot runs in the activation dtype and
+    the per-column scale applies to the OUTPUT (x @ deq(W) ==
+    (x @ W_q) * s — one [*, out] multiply XLA fuses into the dot
+    epilogue, keeping the int8->bf16 convert inside the dot's operand
+    read instead of materializing a dequantized copy)."""
+
+    def __init__(self, inner):
+        super().__init__()
+        q, s = quant_dequant(inner.weight, 8, axis=1)
+        self.register_buffer("weight_int8", Tensor(jnp.asarray(q)))
+        self.register_buffer("weight_scale",
+                             Tensor(jnp.asarray(s, dtype=jnp.float32)))
+        self.bias = getattr(inner, "bias", None)
+        self.in_features = inner.weight.shape[0]
+        self.out_features = inner.weight.shape[1]
+
+    def forward(self, x):
+        def kernel(xv, wq, ws, *maybe_bias):
+            qmax = 127.0
+            acc = jax.lax.dot_general(
+                xv, wq.astype(xv.dtype),
+                (((xv.ndim - 1,), (0,)), ((), ())))
+            out = acc * (ws.astype(xv.dtype) / qmax)
+            if maybe_bias:
+                out = out + maybe_bias[0].astype(out.dtype)
+            return out
+
+        args = [x, self.weight_int8, self.weight_scale]
+        if self.bias is not None:
+            args.append(self.bias)
+        return dispatch.call_fn(kernel, "weight_only_int8_linear", False,
+                                tuple(args), {})
+
+
+def convert_to_weight_only_int8(model: Layer, extra_types=()) -> int:
+    """Swap every [in, out]-weighted linear-like layer for a
+    WeightOnlyInt8Linear IN PLACE; returns the number converted. By
+    default covers nn.Linear plus the tensor-parallel linears (their
+    single-chip forward is the same x @ W (+ b)); embeddings and norms
+    stay float. For decode this halves the streamed weight bytes —
+    the dominant cost per generated token."""
+    from ..distributed.mp_layers import (ColumnParallelLinear,
+                                         RowParallelLinear)
+    types = (Linear, ColumnParallelLinear, RowParallelLinear,
+             *extra_types)
+    count = 0
+
+    def convert(layer: Layer) -> None:
+        nonlocal count
+        for name, sub in list(layer._sub_layers.items()):
+            if type(sub) in types:
+                layer._sub_layers[name] = WeightOnlyInt8Linear(sub)
+                count += 1
+            else:
+                convert(sub)
+
+    convert(model)
+    return count
+
+
 def convert_to_int8(model: Layer, ptq: "PTQ") -> Layer:
     """Swap calibrated Linear/Conv2D layers for int8-executing versions
     (reference: quantization_pass.py program rewrite). The model must

@@ -64,6 +64,8 @@ class BottleneckBlock(nn.Layer):
         self.downsample = downsample
 
     def forward(self, x):
+        if not self.training and self._try_fused_eval_gate(x):
+            return self._fused_eval(x)
         identity = x
         out = self.relu(self.bn1(self.conv1(x)))
         out = self.relu(self.bn2(self.conv2(out)))
@@ -71,6 +73,42 @@ class BottleneckBlock(nn.Layer):
         if self.downsample is not None:
             identity = self.downsample(x)
         return self.relu(out + identity)
+
+    def _try_fused_eval_gate(self, x) -> bool:
+        """Eval-only fused-block path (the conv_fusion_op kernel class):
+        one Pallas launch per block keeps the whole conv+BN+relu chain's
+        intermediates in VMEM — see ops/pallas/fused_conv_block.py."""
+        try:
+            from ...ops.pallas.fused_conv_block import (
+                fused_bottleneck_supported)
+            shape = tuple(x.shape)
+            return len(shape) == 4 and fused_bottleneck_supported(
+                self, shape, self._block_data_format())
+        except Exception:
+            return False
+
+    def _block_data_format(self) -> str:
+        return getattr(self.conv1, "_data_format", "NCHW")
+
+    def _fused_eval(self, x):
+        from ... import dispatch
+        from ...ops.pallas.fused_conv_block import (fused_bottleneck_eval,
+                                                    pack_bottleneck)
+        # fold/pack once per weight version (eval weights are frozen;
+        # a training step in between changes the param identities and
+        # invalidates the key)
+        key = (id(self.conv1.weight.value), id(self.conv2.weight.value),
+               id(self.conv3.weight.value), id(self.bn1._mean.value))
+        cached = getattr(self, "_fused_pack", None)
+        if cached is None or cached[0] != key:
+            self._fused_pack = (key, pack_bottleneck(self))
+        params = self._fused_pack[1]
+
+        def run(xv, *p):
+            return fused_bottleneck_eval(xv, *p)
+
+        return dispatch.call_fn(run, "fused_bottleneck_eval", True,
+                                (x, *params), {})
 
 
 class ResNet(nn.Layer):

@@ -34,7 +34,8 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
              iou_aware=False, iou_aware_factor=0.5):
     """reference: paddle.vision.ops.yolo_box (yolo_box_op.cc)."""
     return F["yolo_box"](x, img_size, anchors, class_num, conf_thresh,
-                         downsample_ratio, clip_bbox, scale_x_y)
+                         downsample_ratio, clip_bbox, scale_x_y,
+                         iou_aware, iou_aware_factor)
 
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,

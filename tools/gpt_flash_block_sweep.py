@@ -1,0 +1,45 @@
+"""Sweep the flash kernel's block sizes at the GPT-1.3B bench shape
+(B2 S2048 d128 causal) — r4 verdict item 9: convert the remaining
+non-MXU attribution into ms or prove it irreducible.
+
+Usage: python tools/gpt_flash_block_sweep.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    import paddle_tpu.ops.pallas.flash_attention as fa
+    from tools.mfu_breakdown import step_time_ms
+    from paddle_tpu.models import GPTConfig
+
+    def cfg():
+        return GPTConfig(vocab_size=32768, hidden_size=2048,
+                         num_layers=24, num_heads=16, max_seq_len=2048,
+                         dropout=0.0, attn_dropout=0.0,
+                         dtype="bfloat16", use_flash_attention=True,
+                         loss_chunk_size=0)
+
+    out = {}
+    for bq, bk in ((512, 512), (256, 512), (512, 256), (1024, 512),
+                   (256, 256), (1024, 1024)):
+        fa.DEFAULT_BLOCK_Q = bq
+        fa.DEFAULT_BLOCK_K = bk
+        try:
+            ms, _ = step_time_ms(cfg(), 2, 2048, steps=8, windows=3)
+            out[f"bq{bq}_bk{bk}"] = round(ms, 2)
+        except Exception as e:
+            out[f"bq{bq}_bk{bk}"] = f"{type(e).__name__}: {str(e)[:80]}"
+        print(f"bq{bq}_bk{bk}: {out[f'bq{bq}_bk{bk}']}", flush=True)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -119,6 +119,81 @@ def _gpt_step():
     return (lambda: float(step.multi_step((xs, xs))[-1])), steps
 
 
+def _decode_runs(int8=False):
+    """Two generate() lengths at the decode bench's best batch; the
+    category-wise DIFFERENCE isolates the decode loop (prefill + launch
+    cancel, as in bench_all's wall-clock subtraction)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from bench_all import _to_bf16_except_norms
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=32768, hidden_size=2048, num_layers=24,
+                    num_heads=16, max_seq_len=2048, dropout=0.0,
+                    attn_dropout=0.0, dtype="bfloat16",
+                    use_flash_attention=False, loss_chunk_size=0)
+    model = GPTForCausalLM(cfg)
+    _to_bf16_except_norms(model)
+    model.eval()
+    n_layers_converted = 0
+    if int8:
+        from paddle_tpu.quantization.quant import (
+            convert_to_weight_only_int8)
+        n_layers_converted = convert_to_weight_only_int8(model)
+    b, prompt = 128, 128
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                   (b, prompt)).astype(np.int32))
+
+    def run_n(n):
+        got = model.generate(pt.Tensor(ids), max_new_tokens=n,
+                             temperature=0.0, use_jit=True)
+        v = got.value if hasattr(got, "value") else got
+        np.asarray(v[:, -1])
+
+    n_params = sum(int(np.prod(p.shape))
+                   for p in model.parameters())
+    return run_n, b, n_params, n_layers_converted
+
+
+def decode_attribution(int8=False):
+    """Per-decode-step device attribution: trace generate(8) and
+    generate(64), subtract per category, divide by the 56 extra
+    steps."""
+    short_n, long_n = 8, 64
+    run_n, b, n_params, n_conv = _decode_runs(int8=int8)
+    run_n(short_n)
+    run_n(long_n)  # compile + warm both lengths
+    short = trace_and_aggregate(lambda: run_n(short_n), 1)
+    long_ = trace_and_aggregate(lambda: run_n(long_n), 1)
+    d = long_n - short_n
+    sc = {r["category"]: r for r in short["by_category"]}
+    lc = {r["category"]: r for r in long_["by_category"]}
+    zero = {"ms_per_step": 0.0, "gb_per_step": 0.0}
+    rows = []
+    # union of categories: one present only in the short trace carries
+    # a NEGATIVE correction that must not be dropped
+    for cat in {**sc, **lc}:
+        l = lc.get(cat, zero)
+        s = sc.get(cat, zero)
+        ms = (l["ms_per_step"] - s["ms_per_step"]) / d
+        gb = (l["gb_per_step"] - s["gb_per_step"]) / d
+        rows.append({"category": cat,
+                     "ms_per_decode_step": round(ms, 4),
+                     "gb_per_decode_step": round(gb, 4),
+                     "gb_per_s": round(gb / ms, 1) if ms > 1e-6
+                     else 0.0})
+    rows.sort(key=lambda r: -r["ms_per_decode_step"])
+    total = sum(r["ms_per_decode_step"] for r in rows)
+    return {"batch": b, "n_params": n_params,
+            "int8_layers_converted": n_conv,
+            "total_ms_per_decode_step": round(total, 3),
+            "by_category": rows}
+
+
 def trace_and_aggregate(run, steps, trace_dir=None):
     import jax
 
@@ -166,10 +241,42 @@ def trace_and_aggregate(run, steps, trace_dir=None):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet",
-                    choices=("resnet", "bert", "gpt"))
+                    choices=("resnet", "bert", "gpt", "decode"))
+    ap.add_argument("--int8", action="store_true",
+                    help="decode mode: weight-only int8 model")
     ap.add_argument("--merge", action="store_true",
                     help="merge into the matching PROFILE*.json")
     args = ap.parse_args()
+    if args.model == "decode":
+        report = decode_attribution(int8=args.int8)
+        # weights+KV streaming roofline (r4 verdict weak #4)
+        hbm_gbps = 819.0
+        wbytes = report["n_params"] * (1 if args.int8 else 2)
+        # KV per decode step: read the whole cache once (24 layers x
+        # 2 (k,v) x b x S_cur x 2048 x 2B); S grows 128->192 over the
+        # run, use the midpoint
+        kv = 24 * 2 * report["batch"] * 160 * 2048 * 2
+        floor_ms = (wbytes + kv) / hbm_gbps / 1e6
+        report["roofline"] = {
+            "hbm_gbps": hbm_gbps,
+            "weight_bytes": wbytes,
+            "kv_bytes_per_step_midpoint": kv,
+            "streaming_floor_ms_per_step": round(floor_ms, 3),
+            "measured_over_floor": round(
+                report["total_ms_per_decode_step"] / floor_ms, 2)
+            if floor_ms else None,
+        }
+        print(json.dumps(report, indent=1))
+        if args.merge:
+            path = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "PROFILE_DECODE.json")
+            full = json.load(open(path)) if os.path.exists(path) else {}
+            key = "int8_weight_only" if args.int8 else "bf16"
+            full[key] = report
+            with open(path, "w") as f:
+                json.dump(full, f, indent=2)
+                f.write("\n")
+        return
     run, steps = {"resnet": _resnet_step, "bert": _bert_step,
                   "gpt": _gpt_step}[args.model]()
     report = trace_and_aggregate(run, steps)
