@@ -184,8 +184,8 @@ def decode_attribution(int8=False):
         rows.append({"category": cat,
                      "ms_per_decode_step": round(ms, 4),
                      "gb_per_decode_step": round(gb, 4),
-                     "gb_per_s": round(gb / ms, 1) if ms > 1e-6
-                     else 0.0})
+                     "gb_per_s": round(gb / ms * 1e3, 1)
+                     if ms > 1e-6 else 0.0})
     rows.sort(key=lambda r: -r["ms_per_decode_step"])
     total = sum(r["ms_per_decode_step"] for r in rows)
     return {"batch": b, "n_params": n_params,
