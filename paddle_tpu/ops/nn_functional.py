@@ -785,6 +785,13 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
 # outright — the S^2 scores no longer fit (PROFILE.json r4_correction).
 _FLASH_MIN_SEQ = int(__import__("os").environ.get("PT_FLASH_MIN_SEQ",
                                                   "512"))
+# The FOLDED kernel has no transposes, so its crossover sits lower
+# than the streaming kernel's: measured v5e b64 h12 d64 fwd+bwd
+# scanned — S=256 folded 4.55 vs XLA 5.33 ms/iter (folded wins),
+# S=128 folded 3.68 vs XLA 2.95 (XLA wins; grid overhead dominates a
+# [128,128] score block)
+_FOLDED_MIN_SEQ = int(__import__("os").environ.get(
+    "PT_FOLDED_MIN_SEQ", "256"))
 
 
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
@@ -793,24 +800,30 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     """q,k,v: [batch, seq, heads, head_dim] (reference layout). Computes in
     fp32 accumulation, returns q.dtype.
 
-    ``use_flash``: None (default) = auto — the Pallas flash kernel when
-    supported AND the key length >= PT_FLASH_MIN_SEQ (XLA's fused
-    attention wins below that); True = flash whenever supported;
-    False = never. Flash requires no mask and no active dropout."""
+    ``use_flash``: None (default) = auto — the FOLDED layout-native
+    Pallas kernel from key length >= PT_FOLDED_MIN_SEQ (256) when its
+    shape gate admits, else the streaming flash kernel from
+    >= PT_FLASH_MIN_SEQ (512); XLA's fused attention wins below those
+    measured crossovers. True = a Pallas kernel whenever supported;
+    False = never. Both kernels require no mask and no active
+    dropout."""
     allowed = use_flash is True or (use_flash is None and
                                     k.shape[1] >= _FLASH_MIN_SEQ)
+    folded_allowed = use_flash is True or (
+        use_flash is None and k.shape[1] >= _FOLDED_MIN_SEQ)
     # the flash kernel's causal mask is diagonal-aligned: with sq != sk
     # (a concatenated KV cache) it would mask from position 0 instead of
     # offsetting by the cache length — the XLA path below applies the
     # correct k=sk-sq shift, so causal cross-length stays off flash
-    if (allowed and attn_mask is None and
+    if ((allowed or folded_allowed) and attn_mask is None and
             (not is_causal or q.shape[1] == k.shape[1]) and
             (dropout_p == 0.0 or not training)):
         from .pallas.flash_attention import (flash_attention,
                                              flash_attention_supported)
         from .pallas.folded_attention import (folded_attention,
                                               folded_attention_supported)
-        if folded_attention_supported(q.shape, k.shape, is_causal):
+        if folded_allowed and folded_attention_supported(q.shape, k.shape,
+                                                         is_causal):
             # single-K-block shapes (BERT S=512): the layout-native
             # folded kernel reads the projection's [B,S,E] rows via
             # 128-lane column groups — no [B,H,S,D] transpose (r4
@@ -821,11 +834,12 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             # in-kernel lane slices)
             return folded_attention(q, k, v, causal=is_causal,
                                     scale=scale)
-        if flash_attention_supported(q.shape, k.shape):
+        if allowed and flash_attention_supported(q.shape, k.shape):
             # streaming shapes (GPT S>=2048): the transposing BHSD
-            # kernel; at d=128 the strided no-transpose block DMA
-            # measured as a wash (GPT step 254.0 vs 251.7 ms), so the
-            # transposes stay on this path
+            # kernel (its own crossover stays at _FLASH_MIN_SEQ); at
+            # d=128 the strided no-transpose block DMA measured as a
+            # wash (GPT step 254.0 vs 251.7 ms), so the transposes
+            # stay on this path
             return flash_attention(q, k, v, causal=is_causal, scale=scale)
     b, sq, h, d = q.shape
     sk = k.shape[1]

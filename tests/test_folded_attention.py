@@ -132,3 +132,31 @@ def test_sdpa_routes_bert_shape_to_folded(monkeypatch):
         mask = jnp.zeros((2, 1, 1, 512))
         NF.scaled_dot_product_attention(q, q, q, attn_mask=mask)
         assert "folded" not in taken
+
+
+def test_folded_crossover_gate(monkeypatch):
+    """The folded kernel engages from S>=256 (measured crossover: wins
+    at 256, loses at 128 — no transposes, so lower than the streaming
+    kernel's 512 gate), while sub-512 shapes must NOT fall through to
+    the transposing flash path."""
+    import paddle_tpu.ops.nn_functional as NF
+    import paddle_tpu.ops.pallas.folded_attention as fomod
+    import paddle_tpu.ops.pallas.flash_attention as famod
+
+    taken = {}
+    monkeypatch.setattr(fomod, "folded_attention",
+                        lambda q, k, v, causal=False, scale=None:
+                        taken.setdefault("folded", True) and q)
+    monkeypatch.setattr(famod, "flash_attention",
+                        lambda *a, **k:
+                        (_ for _ in ()).throw(AssertionError(
+                            "transposing flash taken below its gate")))
+    with fa.force_flash_for_aot():
+        q256 = jnp.zeros((2, 256, 4, 64))
+        NF.scaled_dot_product_attention(q256, q256, q256)
+        assert taken.get("folded"), "folded not engaged at S=256"
+        # S=128: below the folded crossover -> plain XLA path
+        taken.clear()
+        q128 = jnp.zeros((2, 128, 4, 64))
+        out = NF.scaled_dot_product_attention(q128, q128, q128)
+        assert "folded" not in taken and out.shape == q128.shape
