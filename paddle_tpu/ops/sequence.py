@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _valid_mask(lengths, maxlen):
@@ -40,8 +41,8 @@ def sequence_pad(x, lengths, pad_value=0.0, padded_length=-1):
             # trace time — the caller guarantees it.
             try:
                 max_len = int(np.max(np.asarray(lengths)))
-            except Exception:
-                max_len = None
+            except (jax.errors.ConcretizationTypeError, TypeError):
+                max_len = None  # traced lengths: caller guarantees
             if max_len is not None and padded_length < max_len:
                 raise ValueError(
                     f"sequence_pad: padded_length={padded_length} is "
@@ -214,7 +215,6 @@ def sequence_concat(xs, lengths_list):
 
 def sequence_unpad(x, lengths):
     """Padded → host RaggedTensor (eager only; dynamic result shape)."""
-    import numpy as np
 
     from ..framework.ragged import RaggedTensor
     return RaggedTensor.from_padded(np.asarray(x), np.asarray(lengths))

@@ -381,3 +381,50 @@ def test_rpn_straddle_thresh():
         anchors, gts, im_height=16, im_width=16, use_random=False,
         rpn_straddle_thresh=-1.0)  # filter disabled
     assert 1 in np.concatenate([loc2, score2])
+
+
+def test_locality_aware_nms_caps_and_offsets():
+    b = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60],
+                  [80, 80, 90, 90]], np.float32)
+    s = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+    kb, ks = det.locality_aware_nms(b, s, iou_threshold=0.5)
+    assert len(kb) == 3  # boxes 0+1 merge (IoU ~0.68)
+    # keep_top_k caps the output, highest scores first
+    kb2, ks2 = det.locality_aware_nms(b, s, iou_threshold=0.5,
+                                      keep_top_k=1)
+    assert len(kb2) == 1 and ks2[0] == ks[0]
+    # nms_top_k caps candidates entering NMS
+    kb3, _ = det.locality_aware_nms(b, s, iou_threshold=0.5,
+                                    nms_top_k=2)
+    assert len(kb3) <= 2
+    # normalized=False uses pixel-index IoU: boxes 0/1 at +1 offsets
+    # still merge; API accepts the attr without error
+    kb4, _ = det.locality_aware_nms(b, s, iou_threshold=0.5,
+                                    normalized=False)
+    assert len(kb4) == 3
+
+
+def test_generate_proposals_pixel_offset_false():
+    rng = np.random.default_rng(5)
+    A = 16
+    scores = rng.random(A).astype(np.float32)
+    deltas = (rng.standard_normal((A, 4)) * 0.1).astype(np.float32)
+    # anchors decode PAST the image border so the clip bound (W-1 vs
+    # W) actually distinguishes the two offset conventions
+    anchors = np.stack([
+        rng.uniform(60, 90, A), rng.uniform(60, 90, A),
+        rng.uniform(120, 200, A), rng.uniform(120, 200, A)],
+        axis=1).astype(np.float32)
+    maxes = {}
+    for po in (True, False):
+        rois, rs, valid = det.generate_proposals(
+            scores, deltas, (120, 120), anchors, pre_nms_top_n=16,
+            post_nms_top_n=8, min_size=1.0, pixel_offset=po)
+        rois = np.asarray(rois)[np.asarray(valid)]
+        assert len(rois) > 0 and np.isfinite(rois).all()
+        hi = 120.0 - (1.0 if po else 0.0)
+        assert (rois >= 0).all() and (rois <= hi).all()
+        maxes[po] = rois.max()
+    # the clip bound differs by exactly the pixel offset
+    assert abs(maxes[True] - 119.0) < 1e-4
+    assert abs(maxes[False] - 120.0) < 1e-4

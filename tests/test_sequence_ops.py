@@ -168,3 +168,59 @@ def test_sequence_unpad(batch):
     r = seq.sequence_unpad(x, lengths)
     assert isinstance(r, RaggedTensor)
     np.testing.assert_array_equal(r.row(1), x[1, :5])
+
+
+def test_sequence_conv_padding_trainable():
+    """padding_trainable (ref context_project.h): windows reaching
+    beyond the sequence read LEARNED rows — up rows for idx<0, down
+    rows for idx>=L — instead of zeros. Numpy reference computed
+    per-window."""
+    rng = np.random.default_rng(7)
+    b, m, d, out_d = 2, 5, 3, 4
+    ctx, start = 3, -1  # up_pad=1, down_pad=1
+    lengths = np.array([5, 3])
+    x = rng.standard_normal((b, m, d)).astype(np.float32)
+    w = rng.standard_normal((ctx * d, out_d)).astype(np.float32)
+    pad = rng.standard_normal((2, d)).astype(np.float32)  # [up+down, d]
+
+    got = np.asarray(seq.sequence_conv(
+        jnp.asarray(x), jnp.asarray(lengths), jnp.asarray(w),
+        context_length=ctx, context_start=start,
+        padding_trainable=True, padding_data=jnp.asarray(pad)))
+
+    ref = np.zeros((b, m, out_d), np.float32)
+    for bi in range(b):
+        L = lengths[bi]
+        for t in range(L):
+            window = []
+            for k in range(ctx):
+                idx = t + start + k
+                if idx < 0:
+                    window.append(pad[1 + idx])  # up row (up_pad + idx)
+                elif idx >= L:
+                    window.append(pad[1 + (idx - L)])  # down row
+                else:
+                    window.append(x[bi, idx])
+            ref[bi, t] = np.concatenate(window) @ w
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    # context_stride != 1 matches the reference's hard error
+    with pytest.raises(ValueError):
+        seq.sequence_conv(jnp.asarray(x), jnp.asarray(lengths),
+                          jnp.asarray(w), context_length=ctx,
+                          context_stride=2)
+
+
+def test_sequence_pad_padded_length():
+    x = jnp.asarray(np.arange(2 * 4 * 2, dtype=np.float32)
+                    .reshape(2, 4, 2))
+    lengths = jnp.asarray([2, 3])
+    out = seq.sequence_pad(x, lengths, pad_value=-1.0, padded_length=6)
+    assert out.shape == (2, 6, 2)
+    assert float(out[0, 2, 0]) == -1.0 and float(out[1, 3, 0]) == -1.0
+    # shrinking below a real sequence length raises (reference error)
+    with pytest.raises(ValueError):
+        seq.sequence_pad(x, np.array([4, 3]), padded_length=3)
+    # shrinking that only drops padding columns is legal
+    out2 = seq.sequence_pad(x, np.array([2, 2]), pad_value=0.0,
+                            padded_length=2)
+    assert out2.shape == (2, 2, 2)
