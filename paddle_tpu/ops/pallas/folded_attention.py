@@ -197,7 +197,9 @@ def folded_attention_supported(q_shape, k_shape, causal: bool = False,
     self-attention with head groups that tile 128 lanes exactly.
     Causal is capped at S=512: the single block pays the full S^2 while
     the streaming kernel skips fully-masked blocks, so past one
-    512-block the skip outweighs the saved transposes."""
+    512-block the skip outweighs the saved transposes. AT the cap the
+    trade still favors folded (measured v5e b64 h12 d64 causal fwd+bwd
+    scanned: folded 5.68 vs streaming 6.62 ms/iter)."""
     from .flash_attention import _FORCE_DEPTH
     if backend is None:
         backend = jax.default_backend()
