@@ -195,11 +195,16 @@ def folded_attention_supported(q_shape, k_shape, causal: bool = False,
                                backend: Optional[str] = None) -> bool:
     """Gate for the [B, S, H, D]-layout entry: same-length single-block
     self-attention with head groups that tile 128 lanes exactly.
-    Causal is capped at S=512: the single block pays the full S^2 while
-    the streaming kernel skips fully-masked blocks, so past one
-    512-block the skip outweighs the saved transposes. AT the cap the
-    trade still favors folded (measured v5e b64 h12 d64 causal fwd+bwd
-    scanned: folded 5.68 vs streaming 6.62 ms/iter)."""
+
+    Causal caps: the single block pays the full S^2 while the
+    streaming kernel skips fully-masked blocks, but at d=64 the
+    streaming kernel's half-lane matmuls are inefficient enough that
+    folded wins anyway — measured v5e causal fwd+bwd scanned:
+    S=512 b64 h12 folded 5.68 vs streaming 6.62 ms/iter, S=1024 b8
+    h12 folded 4.33 vs 5.25 — so d=64 causal runs folded through the
+    whole single-block range. d=128's streaming kernel runs ~2x more
+    efficient (full-lane contractions), so its causal cap stays at
+    one 512-block (unmeasured beyond; conservative)."""
     from .flash_attention import _FORCE_DEPTH
     if backend is None:
         backend = jax.default_backend()
@@ -207,7 +212,7 @@ def folded_attention_supported(q_shape, k_shape, causal: bool = False,
         return False
     b, sq, h, d = q_shape
     sk = k_shape[1]
-    if causal and sq > 512:
+    if causal and sq > (MAX_SINGLE_BLOCK if d == 64 else 512):
         return False
     return (sq == sk and sq <= MAX_SINGLE_BLOCK and sq % 128 == 0 and
             d in (64, 128) and (h * d) % 128 == 0)
