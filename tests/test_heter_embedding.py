@@ -259,3 +259,29 @@ def test_heter_pipeline_split_brain_loss_parity():
     async_losses = trainer2.run([fixed] * 6, sync=False)
     assert np.isfinite(async_losses).all()
     assert async_losses[-1] < async_losses[0], async_losses
+
+
+def test_heter_pipeline_over_ps_sparse_table():
+    """The split-brain trainer over the PS-core SparseTable (rows
+    created on first access — the trillion-parameter pattern,
+    common_sparse_table.cc): learns, and only touched rows
+    materialize."""
+    from paddle_tpu.distributed.heter import HeterPipelineTrainer
+    from paddle_tpu.distributed.ps import SparseTable
+
+    n_slots = 8
+    table = SparseTable(emb_dim=DIM, lr=0.1)
+    pt.seed(0)
+    dense = _DenseNet(n_slots)
+    trainer = HeterPipelineTrainer(table, DIM, dense,
+                                   optim.SGD(learning_rate=0.1),
+                                   lambda m, a, l: m(a, labels=l))
+    rng = np.random.default_rng(31)
+    ids = rng.integers(0, 10_000_000, (8, n_slots)).astype(np.int64)
+    labels = rng.integers(0, CLASSES, (8,)).astype(np.int64)
+    losses = trainer.run([(ids, labels)] * 6, sync=True)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    # lazy materialization: only the ids actually touched have rows,
+    # out of a 10M-key space
+    assert len(table.rows) == len(np.unique(ids))
+    trainer.shutdown()
