@@ -1,0 +1,49 @@
+"""Version-compat shims over jax API churn.
+
+The codebase targets current jax spellings; the runtime container may
+carry an older release. Everything here degrades to a passthrough when
+the running jax already has the new API:
+
+- ``shard_map``: promoted to ``jax.shard_map`` (new) from
+  ``jax.experimental.shard_map`` (old), and the replication-check kwarg
+  renamed ``check_rep`` -> ``check_vma`` along the way; this wrapper
+  accepts either and translates to whatever the running jax expects.
+- ``axis_size``: ``jax.lax.axis_size`` (new); on older jax
+  ``lax.psum(1, axis)`` constant-folds to the same static int at trace
+  time.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # pre-promotion jax
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+_SM_PARAMS = frozenset(
+    inspect.signature(_shard_map_impl).parameters)
+
+
+def shard_map(f, *args, **kwargs):
+    if "check_vma" in kwargs and "check_vma" not in _SM_PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _SM_PARAMS:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    if "axis_names" in kwargs and "axis_names" not in _SM_PARAMS:
+        # new: axis_names = the MANUAL subset; old: auto = its complement
+        manual = set(kwargs.pop("axis_names"))
+        mesh_axes = getattr(kwargs.get("mesh"), "axis_names", ())
+        kwargs["auto"] = frozenset(a for a in mesh_axes
+                                   if a not in manual)
+    return _shard_map_impl(f, *args, **kwargs)
+
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)

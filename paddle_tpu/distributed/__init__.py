@@ -23,3 +23,9 @@ from . import fleet, mp_layers, pp, sp
 from .fleet_util import UtilBase, fleet_util
 from .heter import DenseHostTable, HostEmbedding
 from .localsgd import LocalSGDTrainStep
+from .fault_inject import (FaultInjector, InjectedFault, fault_point,
+                           get_injector)
+from .resilience import (HeartbeatMonitor, ResilientCheckpointManager,
+                         ResilientTrainer, RetryExhausted, RetryPolicy,
+                         call_with_retry, get_retry_policy,
+                         set_site_policy)

@@ -25,33 +25,57 @@ def _get_checkpointer(use_async: bool = False):
 
 
 def save_sharded(state: Dict[str, Any], path: str,
-                 use_async: bool = False) -> Optional[object]:
+                 use_async: bool = False, retry=None) -> Optional[object]:
     """Save a pytree of (possibly sharded) jax arrays. Returns the async
-    handle when use_async (call .wait_until_finished())."""
+    handle when use_async (call .wait_until_finished()). The write is
+    retried per ``retry`` (default: the "checkpoint.write" site policy)
+    — GCS/NFS targets throw transient OSErrors under preemption. With
+    ``use_async`` only the DISPATCH is covered: the background write's
+    own failure surfaces from wait_until_finished() un-retried, so
+    callers needing durability should catch there and re-save (or use
+    ResilientCheckpointManager, whose writes are synchronous and
+    checksummed)."""
+    from .fault_inject import fault_point
+    from .resilience import get_retry_policy
     path = os.path.abspath(path)
-    ckptr = _get_checkpointer(use_async)
-    ckptr.save(path, state, force=True)
+
+    def _do():
+        fault_point("checkpoint.write")
+        ckptr = _get_checkpointer(use_async)
+        ckptr.save(path, state, force=True)
+        return ckptr
+
+    policy = retry or get_retry_policy("checkpoint.write")
+    ckptr = policy.call(_do, site="checkpoint.write")
     if use_async:
         return ckptr
     return None
 
 
 def load_sharded(path: str, target: Optional[Dict[str, Any]] = None,
-                 shardings: Optional[Dict[str, Any]] = None
-                 ) -> Dict[str, Any]:
+                 shardings: Optional[Dict[str, Any]] = None,
+                 retry=None) -> Dict[str, Any]:
     """Restore a pytree; with ``target``/``shardings`` given, arrays are
     restored directly into those shardings (resharding on read — the
-    capability the reference lacks and recovers via re-merge scripts)."""
-    import orbax.checkpoint as ocp
+    capability the reference lacks and recovers via re-merge scripts).
+    Retried per the "checkpoint.read" site policy."""
+    from .fault_inject import fault_point
+    from .resilience import get_retry_policy
     path = os.path.abspath(path)
-    ckptr = _get_checkpointer(False)
-    if target is not None:
-        abstract = jax.tree_util.tree_map(
-            lambda v: jax.ShapeDtypeStruct(
-                v.shape, v.dtype,
-                sharding=getattr(v, "sharding", None)), target)
-        return ckptr.restore(path, target=abstract)
-    return ckptr.restore(path)
+
+    def _do():
+        fault_point("checkpoint.read")
+        ckptr = _get_checkpointer(False)
+        if target is not None:
+            abstract = jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(
+                    v.shape, v.dtype,
+                    sharding=getattr(v, "sharding", None)), target)
+            return ckptr.restore(path, target=abstract)
+        return ckptr.restore(path)
+
+    policy = retry or get_retry_policy("checkpoint.read")
+    return policy.call(_do, site="checkpoint.read")
 
 
 class CheckpointManager:

@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+from ..compat import axis_size as _compat_axis_size
 import jax.numpy as jnp
 from jax._src import core as _jax_core
 
@@ -60,6 +61,11 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group=None,
     """In-trace: psum/pmax/pmin over the group axis. Eager single-process:
     identity (the process holds the global array)."""
     x = _unwrap(tensor)
+    if not _in_trace():
+        # eager host path only — a fault inside a trace would bake the
+        # exception into the compiled program
+        from .fault_inject import fault_point
+        fault_point("collective.step")
     if _in_trace():
         axis = _axis(group)
         fn = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
@@ -159,7 +165,7 @@ def send(tensor, dst: int, group=None, use_calc_stream: bool = True):
     x = _unwrap(tensor)
     if _in_trace():
         axis = _axis(group or "pp")
-        n = jax.lax.axis_size(axis)
+        n = _compat_axis_size(axis)
         out = jax.lax.ppermute(x, axis,
                                [(i, (i + 1) % n) for i in range(n)])
         return _rewrap(tensor, out)
@@ -174,7 +180,7 @@ def p2p_shift(x, axis_name: str = "pp", shift: int = 1):
     """Shift values along a mesh axis (the pipeline hop primitive)."""
     if not _in_trace():
         return x
-    n = jax.lax.axis_size(axis_name)
+    n = _compat_axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(_unwrap(x), axis_name, perm)
 
@@ -183,6 +189,8 @@ def barrier(group=None):
     """Host-level sync point (reference barrier_op). In SPMD jit programs
     barriers are implicit in data dependencies; eager multi-host uses the
     coordination service."""
+    from .fault_inject import fault_point
+    fault_point("collective.step")
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("paddle_tpu_barrier")

@@ -61,6 +61,8 @@ class FileMembershipStore(MembershipStore):
             json.dump(meta, f)
 
     def heartbeat(self, job_id: str, rank: int) -> None:
+        from .fault_inject import fault_point
+        fault_point("membership.heartbeat")
         p = self._path(job_id, rank)
         if os.path.exists(p):
             with open(p) as f:
@@ -214,6 +216,8 @@ class TcpMembershipStore(MembershipStore):
                     "meta": meta})
 
     def heartbeat(self, job_id: str, rank: int) -> None:
+        from .fault_inject import fault_point
+        fault_point("membership.heartbeat")
         self._call({"op": "hb", "job": job_id, "rank": rank})
 
     def deregister(self, job_id: str, rank: int) -> None:
@@ -245,6 +249,7 @@ class ElasticManager:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_members: Optional[List[int]] = None
+        self.hb_failures = 0  # consecutive failed heartbeat rounds
 
     def start(self) -> None:
         self.store.register(self.job_id, self.rank, {"np": self.np})
@@ -258,15 +263,31 @@ class ElasticManager:
         self.store.deregister(self.job_id, self.rank)
 
     def _loop(self) -> None:
+        from .resilience import get_retry_policy
+        policy = get_retry_policy("membership.heartbeat")
         while not self._stop.is_set():
-            self.store.heartbeat(self.job_id, self.rank)
-            members = sorted(self.store.members(self.job_id))
+            try:
+                policy.call(self.store.heartbeat, self.job_id, self.rank,
+                            site="membership.heartbeat")
+                member_map = policy.call(
+                    self.store.members, self.job_id,
+                    site="membership.heartbeat")
+            except Exception:  # noqa: BLE001 - a flaky store must not
+                # kill the watch thread; the TTL decides liveness
+                self.hb_failures += 1
+                self._stop.wait(self.heartbeat_s)
+                continue
+            self.hb_failures = 0
+            members = sorted(member_map)
             if self._last_members is None:
                 self._last_members = members
             elif members != self._last_members:
                 self._last_members = members
                 if self.on_change:
-                    self.on_change(self.store.members(self.job_id))
+                    # hand over the map we just fetched — a second,
+                    # unretried store read here could throw and kill
+                    # the watch thread
+                    self.on_change(member_map)
             self._stop.wait(self.heartbeat_s)
 
     def healthy(self) -> bool:

@@ -1,0 +1,233 @@
+"""Deterministic fault injection for the resilience subsystem.
+
+Reference parity: the reference validates its fleet fault tolerance with
+chaos-style unittests (test_fleet_elastic_*, test_dist_fleet_* kill the
+trainer/PS process mid-run); TPU pods see the same failure classes in
+production — host preemption, slow ranks, torn checkpoint writes. This
+module makes every one of those paths testable ON CPU by raising (or
+silently corrupting, for torn-write simulation) at named sites threaded
+through the runtime:
+
+==================== =================================================
+site                 where it fires
+==================== =================================================
+checkpoint.write     resilience.ResilientCheckpointManager.save /
+                     checkpoint.save_sharded
+checkpoint.read      ...Manager.restore / checkpoint.load_sharded
+membership.heartbeat elastic.{File,Tcp}MembershipStore.heartbeat
+ps.push / ps.pull    ps.PSClient push/pull traffic
+heter.push/heter.pull heter.HeterPipelineTrainer sparse stage
+dataloader.fetch     io.dataloader worker batch assembly
+collective.step      collective.all_reduce / barrier (eager host path)
+trainer.step         resilience.ResilientTrainer per-step gate
+==================== =================================================
+
+Default-OFF: with no sites armed (the tier-1 default), ``fault_point``
+is a single module-bool check. Arm programmatically::
+
+    inj = get_injector()
+    inj.arm("checkpoint.write", at_calls=[2], mode="torn")
+    inj.arm("ps.push", probability=0.2, max_faults=3, seed=7)
+
+or from the environment (read once, at first ``get_injector()``)::
+
+    PT_FAULT_INJECT="checkpoint.write:at=2,mode=torn;ps.push:p=0.2,max=3"
+    PT_FAULT_SEED=7
+
+Schedules are deterministic: probabilistic firing draws from a
+per-site ``numpy`` Generator seeded at arm time, and ``at_calls`` fires
+on exact 1-based call indices — the same arming always yields the same
+fault sequence, so recovery tests are reproducible.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional
+
+import numpy as np
+
+# Fast-path gate: False whenever no injector exists or no site is armed,
+# so production fault_point() calls cost one global read.
+_ACTIVE = False
+_GLOBAL: Optional["FaultInjector"] = None
+_LOCK = threading.Lock()
+
+# Modes: "abort" raises InjectedFault at the site; "torn" asks the site
+# to complete a *corrupted* write and report success (only the
+# checkpoint-write site implements it; elsewhere it degrades to abort).
+MODE_ABORT = "abort"
+MODE_TORN = "torn"
+
+
+class InjectedFault(ConnectionError):
+    """Raised by an armed fault site. Subclasses ConnectionError so the
+    default RetryPolicy transient-set retries it — an injected fault is
+    a stand-in for exactly that class of failure."""
+
+    def __init__(self, site: str, index: int, mode: str = MODE_ABORT):
+        super().__init__(
+            f"injected fault at site {site!r} (call #{index}, {mode})")
+        self.site = site
+        self.index = index
+        self.mode = mode
+
+
+@dataclass
+class FaultSpec:
+    """Arming schedule for one site."""
+
+    probability: float = 0.0
+    at_calls: FrozenSet[int] = frozenset()  # 1-based call indices
+    max_faults: Optional[int] = None
+    mode: str = MODE_ABORT
+    exc: Optional[type] = None  # exception class; default InjectedFault
+    seed: int = 0
+    calls: int = 0
+    fired: int = 0
+    _rng: np.random.Generator = field(default=None, repr=False)
+
+    def __post_init__(self):
+        self._rng = np.random.default_rng(self.seed)
+
+    def should_fire(self) -> bool:
+        self.calls += 1
+        if self.max_faults is not None and self.fired >= self.max_faults:
+            return False
+        if self.calls in self.at_calls:
+            return True
+        if self.probability > 0.0 and \
+                self._rng.random() < self.probability:
+            return True
+        return False
+
+
+class FaultInjector:
+    """Registry of armed sites; ``fire`` is the hot entry point."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._specs: Dict[str, FaultSpec] = {}
+        self._lock = threading.Lock()
+        self.log: List[InjectedFault] = []
+
+    def arm(self, site: str, probability: float = 0.0, at_calls=(),
+            max_faults: Optional[int] = None, mode: str = MODE_ABORT,
+            exc: Optional[type] = None, seed: Optional[int] = None
+            ) -> "FaultInjector":
+        global _ACTIVE
+        with self._lock:
+            self._specs[site] = FaultSpec(
+                probability=probability,
+                at_calls=frozenset(int(c) for c in at_calls),
+                max_faults=max_faults, mode=mode, exc=exc,
+                seed=self.seed if seed is None else seed)
+        _ACTIVE = True
+        return self
+
+    def disarm(self, site: Optional[str] = None) -> None:
+        global _ACTIVE
+        with self._lock:
+            if site is None:
+                self._specs.clear()
+            else:
+                self._specs.pop(site, None)
+            if not self._specs:
+                _ACTIVE = False
+
+    def armed(self, site: str) -> bool:
+        return site in self._specs
+
+    def counts(self, site: str) -> Dict[str, int]:
+        spec = self._specs.get(site)
+        return {"calls": spec.calls, "fired": spec.fired} if spec else \
+            {"calls": 0, "fired": 0}
+
+    def fire(self, site: str,
+             modes: tuple = (MODE_ABORT,)) -> Optional[str]:
+        """Consult the site's schedule. Raises on an "abort" fault;
+        returns the mode string for non-abort modes the SITE declares
+        it implements via ``modes`` (e.g. the checkpoint-write site
+        passes ("abort", "torn")). A mode the site does NOT implement
+        degrades to abort rather than silently counting as fired
+        without any effect. Returns None when nothing fires."""
+        with self._lock:
+            spec = self._specs.get(site)
+            if spec is None or not spec.should_fire():
+                return None
+            spec.fired += 1
+            fault = InjectedFault(site, spec.calls, spec.mode)
+            self.log.append(fault)
+            if spec.mode == MODE_ABORT or spec.mode not in modes:
+                if spec.exc is not None:
+                    raise spec.exc(str(fault))
+                raise fault
+            return spec.mode
+
+    def configure_from_env(self, env=None) -> "FaultInjector":
+        """Parse ``PT_FAULT_INJECT``: ``site:k=v,k=v;site2:...`` with
+        keys p (probability), at (``|``-separated call indices), max,
+        mode, seed."""
+        env = os.environ if env is None else env
+        raw = env.get("PT_FAULT_INJECT", "").strip()
+        if not raw:
+            return self
+        for entry in raw.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            site, _, spec = entry.partition(":")
+            kw: Dict = {}
+            for kv in filter(None, spec.split(",")):
+                k, _, v = kv.partition("=")
+                k, v = k.strip(), v.strip()
+                if k == "p":
+                    kw["probability"] = float(v)
+                elif k == "at":
+                    kw["at_calls"] = [int(x) for x in v.split("|") if x]
+                elif k == "max":
+                    kw["max_faults"] = int(v)
+                elif k == "mode":
+                    kw["mode"] = v
+                elif k == "seed":
+                    kw["seed"] = int(v)
+            self.arm(site.strip(), **kw)
+        return self
+
+
+def get_injector() -> FaultInjector:
+    """The process-wide injector (created on first use; env-armed)."""
+    global _GLOBAL
+    with _LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = FaultInjector(
+                seed=int(os.environ.get("PT_FAULT_SEED", "0")))
+            _GLOBAL.configure_from_env()
+    return _GLOBAL
+
+
+def reset() -> None:
+    """Drop the global injector (tests)."""
+    global _GLOBAL, _ACTIVE
+    with _LOCK:
+        _GLOBAL = None
+        _ACTIVE = False
+
+
+def fault_point(site: str, modes: tuple = (MODE_ABORT,)
+                ) -> Optional[str]:
+    """Injection site hook. No-op (one bool read) unless a site is armed
+    anywhere in the process. ``modes`` declares which non-abort modes
+    this site implements; anything else raises InjectedFault (abort)."""
+    if not _ACTIVE:
+        return None
+    return get_injector().fire(site, modes)
+
+
+# A process launched with PT_FAULT_INJECT set must be armed without any
+# explicit get_injector() call (the sites only check the _ACTIVE fast
+# path); env set AFTER import requires calling get_injector() once.
+if os.environ.get("PT_FAULT_INJECT", "").strip():
+    get_injector()

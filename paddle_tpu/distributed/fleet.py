@@ -229,8 +229,15 @@ class ShardedTrainStep:
                                getattr(named_params.get(n), "pspec", None),
                                shard_axis if zero_stage >= 3 else None)
             for n, v in state["params"].items()}
-        self.buffer_shardings = {n: NamedSharding(mesh, P())
-                                 for n in state["buffers"]}
+        # buffers default replicated, but honor an explicit pspec (a
+        # weight-only-int8 buffer converted from a TP linear keeps its
+        # mp sharding)
+        named_buffers = dict(model.named_buffers())
+        self.buffer_shardings = {}
+        for n in state["buffers"]:
+            bspec = getattr(named_buffers.get(n), "pspec", None)
+            self.buffer_shardings[n] = NamedSharding(
+                mesh, bspec if bspec is not None else P())
         self.params = {n: jax.device_put(v, self.param_shardings[n])
                        for n, v in state["params"].items()}
         self.buffers = {n: jax.device_put(v, self.buffer_shardings[n])
@@ -351,7 +358,7 @@ class ShardedTrainStep:
             # DDP convention: global grad = MEAN of per-shard grads, so
             # train_fn must return a batch-mean loss; a sum-reduced loss
             # comes out scaled by 1/dp relative to the exact path.
-            from jax import shard_map as _shard_map
+            from ..compat import shard_map as _shard_map
             from .mp_layers import no_sharding_constraints
 
             def vag(params, buffers, key, batch):
@@ -485,6 +492,8 @@ class ShardedTrainStep:
         return cached_lr_device(self, self.optimizer)
 
     def __call__(self, batch):
+        from ..jit import effects_token_guard
+        effects_token_guard(self.mesh.devices.flat)
         batch_raw = jax.tree_util.tree_map(
             lambda t: t.value if isinstance(t, Tensor) else t, batch,
             is_leaf=lambda t: isinstance(t, Tensor))

@@ -282,6 +282,8 @@ class HeterPipelineTrainer:
 
     # -- sparse stage (CPU pool) ------------------------------------------
     def _sparse_forward(self, ids: np.ndarray) -> np.ndarray:
+        from .fault_inject import fault_point
+        fault_point("heter.pull")
         b, slots = ids.shape
         rows = self.table.pull(ids.reshape(-1))
         return np.asarray(rows, np.float32).reshape(
@@ -289,6 +291,8 @@ class HeterPipelineTrainer:
 
     def _sparse_backward(self, ids: np.ndarray,
                          d_acts: np.ndarray) -> None:
+        from .fault_inject import fault_point
+        fault_point("heter.push")
         self.table.push_grad(
             ids.reshape(-1),
             np.asarray(d_acts, np.float32).reshape(-1, self.dim))
@@ -330,6 +334,30 @@ class HeterPipelineTrainer:
                 bwd.result()
             else:
                 pending_bwd.append(bwd)
+                # fail fast: harvest pushes that already completed so a
+                # failed push aborts the epoch NOW, not at the final
+                # join after every remaining batch trained against a
+                # table that silently missed updates
+                still_pending = []
+                first_exc = None
+                for f in pending_bwd:
+                    if f.done():
+                        exc = f.exception()
+                        if exc is not None and first_exc is None:
+                            first_exc = exc
+                    else:
+                        still_pending.append(f)
+                if first_exc is not None:
+                    # join the in-flight pushes before unwinding — a
+                    # pool thread must not keep mutating the table
+                    # under the caller's error handling
+                    for f in still_pending:
+                        try:
+                            f.result()
+                        except Exception:
+                            pass  # the first failure is the one raised
+                    raise first_exc
+                pending_bwd = still_pending
             losses.append(float(loss))
         for f in pending_bwd:
             f.result()

@@ -475,25 +475,61 @@ class PSServer:
 class PSClient:
     """reference: service/brpc_ps_client.cc — connects to all servers;
     sparse keys shard by key %% n_servers, dense tables live on
-    table-hash-selected servers."""
+    table-hash-selected servers.
 
-    def __init__(self, endpoints: Sequence[str]):
+    Transport failures (ConnectionError/OSError — a restarted or
+    preempted server) drop the wedged socket and RECONNECT under the
+    per-site RetryPolicy ("ps.push"/"ps.pull"/"ps.call"), mirroring the
+    brpc client's retry config. Semantics under retry: pulls are
+    idempotent; pushes are at-least-once (a push whose ack was lost may
+    be applied twice) — the same contract as the reference's async PS.
+    Server-side errors (unknown table etc.) raise RuntimeError and are
+    never retried."""
+
+    def __init__(self, endpoints: Sequence[str], retry=None):
+        # connections are LAZY (first _call connects under the site's
+        # retry policy): constructing a client while one server is
+        # mid-restart must not fail un-retried
         self.endpoints = list(endpoints)
-        self._socks: List[socket.socket] = []
-        self._locks: List[threading.Lock] = []
-        for ep in self.endpoints:
-            host, _, port = ep.partition(":")
-            s = socket.create_connection((host, int(port)), timeout=30)
-            self._socks.append(s)
-            self._locks.append(threading.Lock())
+        self._retry = retry
+        self._socks: List[Optional[socket.socket]] = \
+            [None] * len(self.endpoints)
+        self._locks: List[threading.Lock] = \
+            [threading.Lock() for _ in self.endpoints]
 
-    def _call(self, server: int, msg: Dict) -> Dict:
-        with self._locks[server]:
-            _send_msg(self._socks[server], msg)
-            resp = _recv_msg(self._socks[server])
-        if not resp.get("ok"):
-            raise RuntimeError(resp.get("error"))
-        return resp
+    def _connect_locked(self, server: int) -> None:
+        host, _, port = self.endpoints[server].partition(":")
+        self._socks[server] = socket.create_connection(
+            (host, int(port)), timeout=30)
+
+    def _call(self, server: int, msg: Dict, site: str = "ps.call") -> Dict:
+        from .fault_inject import fault_point
+        from .resilience import get_retry_policy
+
+        def _once() -> Dict:
+            fault_point(site)
+            with self._locks[server]:
+                sock = self._socks[server]
+                try:
+                    if sock is None:
+                        self._connect_locked(server)
+                        sock = self._socks[server]
+                    _send_msg(sock, msg)
+                    resp = _recv_msg(sock)
+                except (ConnectionError, OSError):
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                    self._socks[server] = None  # reconnect on retry
+                    raise
+            if not resp.get("ok"):
+                raise RuntimeError(resp.get("error"))
+            return resp
+
+        policy = self._retry or get_retry_policy(site)
+        return policy.call(_once, site=site)
 
     def _dense_server(self, table: str) -> int:
         # stable across processes (built-in hash() is salted per process,
@@ -505,15 +541,17 @@ class PSClient:
     def push_dense_init(self, table: str, value: np.ndarray) -> None:
         self._call(self._dense_server(table),
                    {"cmd": PUSH_DENSE, "table": table, "grad": value,
-                    "init": True})
+                    "init": True}, site="ps.push")
 
     def pull_dense(self, table: str) -> np.ndarray:
         return self._call(self._dense_server(table),
-                          {"cmd": PULL_DENSE, "table": table})["value"]
+                          {"cmd": PULL_DENSE, "table": table},
+                          site="ps.pull")["value"]
 
     def push_dense_grad(self, table: str, grad: np.ndarray) -> None:
         self._call(self._dense_server(table),
-                   {"cmd": PUSH_DENSE, "table": table, "grad": grad})
+                   {"cmd": PUSH_DENSE, "table": table, "grad": grad},
+                   site="ps.push")
 
     def pull_sparse(self, table: str, keys: np.ndarray) -> np.ndarray:
         keys = np.asarray(keys, np.int64).ravel()
@@ -525,7 +563,8 @@ class PSClient:
             if not mask.any():
                 continue
             vals = self._call(srv, {"cmd": PULL_SPARSE, "table": table,
-                                    "keys": keys[mask].tolist()})["value"]
+                                    "keys": keys[mask].tolist()},
+                              site="ps.pull")["value"]
             results[srv] = vals
         dim = next(iter(results.values())).shape[1]
         full = np.zeros((keys.size, dim), np.float32)
@@ -544,7 +583,7 @@ class PSClient:
                 continue
             self._call(srv, {"cmd": PUSH_SPARSE, "table": table,
                              "keys": keys[mask].tolist(),
-                             "grad": grads[mask]})
+                             "grad": grads[mask]}, site="ps.push")
 
     def push_sparse_delta(self, table: str, keys: np.ndarray,
                           deltas: np.ndarray) -> None:
@@ -557,7 +596,7 @@ class PSClient:
                 continue
             self._call(srv, {"cmd": PUSH_SPARSE_DELTA, "table": table,
                              "keys": keys[mask].tolist(),
-                             "delta": deltas[mask]})
+                             "delta": deltas[mask]}, site="ps.push")
 
     # -- graph engine (reference: brpc client graph RPCs over
     #    common_graph_table.cc; nodes shard by id % n_servers) ---------
@@ -672,7 +711,8 @@ class PSClient:
         """Disconnect without stopping the servers (a trainer leaving a
         shared job)."""
         for s in self._socks:
-            s.close()
+            if s is not None:
+                s.close()
 
     def stop(self) -> None:
         for srv in range(len(self.endpoints)):
@@ -681,7 +721,8 @@ class PSClient:
             except Exception:
                 pass
         for s in self._socks:
-            s.close()
+            if s is not None:
+                s.close()
 
 
 class GeoCommunicator:

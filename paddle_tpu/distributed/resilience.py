@@ -1,0 +1,655 @@
+"""Fault-tolerant training runtime: retries, durable checkpoints,
+heartbeat-driven recovery.
+
+Reference parity: fleet/elastic.py treats failure as a first-class event
+— etcd membership with the ELASTIC_EXIT_CODE=101 restart contract and
+checkpoint-based recovery. This module is the piece our reproduction was
+missing: the primitives in ``elastic.py`` (membership stores) and
+``checkpoint.py`` (orbax save/load) wired into a loop that actually
+survives faults, testable on CPU via ``fault_inject``:
+
+- ``RetryPolicy`` — exponential backoff + seeded jitter + deadline,
+  with a per-site override registry (``set_site_policy`` /
+  ``PT_RETRY_SITES``). Applied to membership ops, checkpoint IO and PS
+  client traffic.
+- ``ResilientCheckpointManager`` — atomic tmp+rename checkpoint dirs,
+  per-shard crc32 manifest, keep-N rotation, and
+  ``restore_latest_valid()`` that SKIPS torn/partial/corrupt steps.
+- ``HeartbeatMonitor`` — membership register + heartbeat on a thread,
+  retried, with loss detection (the ElasticManager watch loop hardened
+  against flaky stores).
+- ``ResilientTrainer`` — runs a user step function under heartbeats,
+  checkpoints every N steps, and on an injected or real fault restores
+  the latest VALID checkpoint and replays — degrading gracefully
+  (log + continue) instead of hanging or corrupting state.
+
+Checkpoint layout (host-local; for multi-host sharded arrays layer this
+manager's manifest over ``checkpoint.save_sharded``'s orbax output)::
+
+    dir/step_00000020/
+        manifest.json        # {"shards": {f: {crc32,size}}, "structure"}
+        arr_0000.npy ...     # one shard per pytree leaf
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .fault_inject import MODE_TORN, fault_point
+
+log = logging.getLogger("paddle_tpu.resilience")
+
+_TRANSIENT = (ConnectionError, OSError, TimeoutError)
+# OSError subclasses that are deterministic, not transient: retrying a
+# missing path or a permission wall burns backoff time and masks the
+# real exception type behind RetryExhausted.
+_NEVER_RETRY = (FileNotFoundError, PermissionError, NotADirectoryError,
+                IsADirectoryError, FileExistsError)
+
+
+class RetryExhausted(RuntimeError):
+    """All attempts of a retried op failed; ``__cause__`` is the last
+    underlying error."""
+
+    def __init__(self, site: str, attempts: int, reason: str = ""):
+        msg = f"retry exhausted after {attempts} attempt(s)"
+        if site:
+            msg += f" at site {site!r}"
+        if reason:
+            msg += f" ({reason})"
+        super().__init__(msg)
+        self.site = site
+        self.attempts = attempts
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with seeded jitter and an optional deadline.
+
+    ``retry_on`` lists the exception classes considered transient —
+    everything else propagates immediately (a server-side KeyError is
+    not going to succeed on attempt 2). InjectedFault subclasses
+    ConnectionError, so armed fault sites exercise this path."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25
+    timeout_s: Optional[float] = None
+    retry_on: tuple = _TRANSIENT
+    seed: int = 0
+
+    def preview_delays(self) -> List[float]:
+        """The deterministic delay sequence this policy would sleep
+        (one entry per retry, i.e. max_attempts - 1 entries)."""
+        rng = np.random.default_rng(self.seed)
+        out = []
+        for attempt in range(max(0, self.max_attempts - 1)):
+            out.append(self._delay(attempt, rng))
+        return out
+
+    def _delay(self, attempt: int, rng) -> float:
+        d = min(self.base_delay_s * self.multiplier ** attempt,
+                self.max_delay_s)
+        return d * (1.0 + self.jitter * float(rng.random()))
+
+    def call(self, fn: Callable, *args, site: str = "",
+             on_retry: Optional[Callable] = None, **kwargs):
+        """Run ``fn(*args, **kwargs)``, retrying transient failures.
+        ``max_attempts`` below 1 (a PT_RETRY_SITES typo) is clamped to
+        1 — the op must run at least once, never silently no-op."""
+        attempts = max(1, self.max_attempts)
+        rng = np.random.default_rng(self.seed)
+        deadline = (time.monotonic() + self.timeout_s
+                    if self.timeout_s is not None else None)
+        for attempt in range(attempts):
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as e:
+                if isinstance(e, _NEVER_RETRY):
+                    raise  # deterministic: keep the original type
+                if attempt + 1 >= attempts:
+                    raise RetryExhausted(site, attempt + 1) from e
+                delay = self._delay(attempt, rng)
+                if deadline is not None and \
+                        time.monotonic() + delay > deadline:
+                    raise RetryExhausted(site, attempt + 1,
+                                         "deadline exceeded") from e
+                log.warning("retry %d/%d at %s after %s: sleeping %.3fs",
+                            attempt + 1, self.max_attempts - 1,
+                            site or "<op>", type(e).__name__, delay)
+                if on_retry is not None:
+                    on_retry(attempt + 1, e, delay)
+                time.sleep(delay)
+
+    @classmethod
+    def from_spec(cls, spec: str, **defaults) -> "RetryPolicy":
+        """Parse ``attempts=5,base=0.01,max_delay=1,mult=2,jitter=0,
+        timeout=3`` (the PT_RETRY_SITES value format)."""
+        kw: Dict[str, Any] = dict(defaults)
+        keymap = {"attempts": ("max_attempts", int),
+                  "base": ("base_delay_s", float),
+                  "max_delay": ("max_delay_s", float),
+                  "mult": ("multiplier", float),
+                  "jitter": ("jitter", float),
+                  "timeout": ("timeout_s", float),
+                  "seed": ("seed", int)}
+        for kv in filter(None, spec.split(",")):
+            k, _, v = kv.partition("=")
+            entry = keymap.get(k.strip())
+            if entry is None or not v:
+                # a PT_RETRY_SITES typo must not crash the first
+                # retried op deep inside a training step
+                log.warning("PT_RETRY_SITES: ignoring malformed entry "
+                            "%r (known keys: %s)", kv,
+                            ", ".join(sorted(keymap)))
+                continue
+            name, conv = entry
+            kw[name] = conv(v)
+        return cls(**kw)
+
+
+DEFAULT_RETRY = RetryPolicy()
+NO_RETRY = RetryPolicy(max_attempts=1)
+
+_site_policies: Dict[str, RetryPolicy] = {}
+_env_policies: Optional[Dict[str, RetryPolicy]] = None
+_policy_lock = threading.Lock()
+
+
+def set_site_policy(site: str, policy: Optional[RetryPolicy]) -> None:
+    """Override the retry policy for one site (None removes)."""
+    with _policy_lock:
+        if policy is None:
+            _site_policies.pop(site, None)
+        else:
+            _site_policies[site] = policy
+
+
+def clear_site_policies() -> None:
+    with _policy_lock:
+        _site_policies.clear()
+
+
+def _load_env_policies() -> Dict[str, RetryPolicy]:
+    global _env_policies
+    if _env_policies is None:
+        out: Dict[str, RetryPolicy] = {}
+        raw = os.environ.get("PT_RETRY_SITES", "").strip()
+        for entry in filter(None, (e.strip() for e in raw.split(";"))):
+            site, _, spec = entry.partition(":")
+            out[site.strip()] = RetryPolicy.from_spec(spec)
+        _env_policies = out
+    return _env_policies
+
+
+def get_retry_policy(site: str) -> RetryPolicy:
+    """Resolution order: programmatic override > PT_RETRY_SITES env >
+    DEFAULT_RETRY."""
+    with _policy_lock:
+        p = _site_policies.get(site)
+    if p is not None:
+        return p
+    return _load_env_policies().get(site, DEFAULT_RETRY)
+
+
+def call_with_retry(site: str, fn: Callable, *args, **kwargs):
+    return get_retry_policy(site).call(fn, *args, site=site, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Durable checkpoints
+# ---------------------------------------------------------------------------
+
+class CheckpointCorruptError(RuntimeError):
+    pass
+
+
+def _flatten_tree(obj, path: str, leaves: Dict[str, np.ndarray]):
+    """Encode a dict/list/tuple/array pytree into a JSON structure whose
+    leaves reference .npy shard names."""
+    if isinstance(obj, dict):
+        return {"kind": "dict",
+                "items": {str(k): _flatten_tree(v, f"{path}.{k}", leaves)
+                          for k, v in obj.items()}}
+    if isinstance(obj, (list, tuple)):
+        kind = "list" if isinstance(obj, list) else "tuple"
+        return {"kind": kind,
+                "items": [_flatten_tree(v, f"{path}[{i}]", leaves)
+                          for i, v in enumerate(obj)]}
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return {"kind": "scalar", "value": obj}
+    name = f"arr_{len(leaves):04d}.npy"
+    leaves[name] = np.asarray(obj)
+    return {"kind": "leaf", "shard": name}
+
+
+def _unflatten_tree(node, arrays: Dict[str, np.ndarray]):
+    kind = node["kind"]
+    if kind == "dict":
+        return {k: _unflatten_tree(v, arrays)
+                for k, v in node["items"].items()}
+    if kind == "list":
+        return [_unflatten_tree(v, arrays) for v in node["items"]]
+    if kind == "tuple":
+        return tuple(_unflatten_tree(v, arrays) for v in node["items"])
+    if kind == "scalar":
+        return node["value"]
+    return arrays[node["shard"]]
+
+
+def _crc32_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc
+
+
+class ResilientCheckpointManager:
+    """Step-indexed checkpoints with atomic writes, per-shard checksums
+    and keep-N rotation; restore skips anything that fails validation.
+
+    Writes go to a ``.tmp-*`` sibling and are renamed into place only
+    once every shard and the manifest are on disk, so a crash mid-write
+    never leaves a step directory that LOOKS complete. Torn writes that
+    did get renamed (simulated by the ``checkpoint.write`` fault site's
+    "torn" mode, or real bitrot) are caught at read time by the crc32
+    manifest and skipped by ``restore_latest_valid``."""
+
+    MANIFEST = "manifest.json"
+
+    def __init__(self, directory: str, keep_n: int = 3,
+                 retry: Optional[RetryPolicy] = None):
+        self.directory = os.path.abspath(directory)
+        self.keep_n = max(1, int(keep_n))
+        self.retry = retry
+        self.last_skipped: List[int] = []
+        self._seq = 0
+        # steps THIS manager wrote cleanly (no injected torn write):
+        # lets rotation skip re-checksumming multi-GB steps it just
+        # wrote; restore paths still always validate from disk
+        self._written_ok: set = set()
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- naming ------------------------------------------------------------
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:08d}")
+
+    def all_steps(self) -> List[int]:
+        out = []
+        for fn in os.listdir(self.directory):
+            if fn.startswith("step_"):
+                try:
+                    out.append(int(fn[5:]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- write -------------------------------------------------------------
+
+    def save(self, step: int, state: Any) -> str:
+        """Write ``state`` (nested dict/list/tuple of arrays + scalars)
+        as checkpoint ``step``. Retried per the "checkpoint.write" site
+        policy; returns the final directory path."""
+        policy = self.retry or get_retry_policy("checkpoint.write")
+        path = policy.call(self._write_once, step, state,
+                           site="checkpoint.write")
+        self._gc()
+        return path
+
+    def _write_once(self, step: int, state: Any) -> str:
+        self._seq += 1
+        tmp = os.path.join(
+            self.directory,
+            f".tmp-step_{step:08d}-{os.getpid()}-{self._seq}")
+        final = self._step_dir(step)
+        os.makedirs(tmp)
+        try:
+            leaves: Dict[str, np.ndarray] = {}
+            structure = _flatten_tree(state, "", leaves)
+            shards = {}
+            for name, arr in leaves.items():
+                p = os.path.join(tmp, name)
+                with open(p, "wb") as f:
+                    np.save(f, arr, allow_pickle=False)
+                shards[name] = {"crc32": _crc32_file(p),
+                                "size": os.path.getsize(p)}
+            manifest = {"format": 1, "step": int(step),
+                        "shards": shards, "structure": structure}
+            with open(os.path.join(tmp, self.MANIFEST), "w") as f:
+                json.dump(manifest, f)
+            mode = fault_point("checkpoint.write",
+                               modes=(MODE_TORN,))  # may raise (abort)
+            if mode == MODE_TORN and shards:
+                # simulate a write that was acknowledged but landed
+                # corrupt: truncate one shard AFTER its checksum was
+                # recorded, then publish the step anyway
+                victim = os.path.join(tmp, sorted(shards)[0])
+                with open(victim, "r+b") as f:
+                    f.truncate(max(0, os.path.getsize(victim) // 2))
+                self._written_ok.discard(step)
+            if os.path.exists(final):
+                shutil.rmtree(final)  # retry overwriting a torn step
+            os.rename(tmp, final)
+            if mode != MODE_TORN:
+                self._written_ok.add(step)
+            return final
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+
+    def _gc(self) -> None:
+        steps = self.all_steps()
+        doomed = steps[:-self.keep_n]
+        if doomed:
+            # rotation must never strand the run on corrupt-only steps:
+            # the newest VALID step survives even outside the window.
+            # Steps this manager wrote cleanly skip the disk re-read
+            # (a full crc pass per save would double checkpoint I/O).
+            newest_valid = next(
+                (s for s in reversed(steps)
+                 if s in self._written_ok or self.validate(s)), None)
+            for step in doomed:
+                if step == newest_valid:
+                    continue
+                self._written_ok.discard(step)
+                shutil.rmtree(self._step_dir(step), ignore_errors=True)
+        for fn in os.listdir(self.directory):
+            if fn.startswith(".tmp-"):
+                # stale tmp from a crashed writer in another life; a
+                # live writer's tmp dirs use our pid+seq so no clash
+                p = os.path.join(self.directory, fn)
+                if f"-{os.getpid()}-" not in fn:
+                    shutil.rmtree(p, ignore_errors=True)
+
+    # -- read --------------------------------------------------------------
+
+    def validate(self, step: int) -> bool:
+        """True iff the step's manifest parses and every shard matches
+        its recorded size + crc32."""
+        d = self._step_dir(step)
+        try:
+            with open(os.path.join(d, self.MANIFEST)) as f:
+                manifest = json.load(f)
+            for name, meta in manifest["shards"].items():
+                p = os.path.join(d, name)
+                if os.path.getsize(p) != meta["size"] or \
+                        _crc32_file(p) != meta["crc32"]:
+                    return False
+            return True
+        except (OSError, ValueError, KeyError):
+            return False
+
+    def restore(self, step: int) -> Any:
+        """Load checkpoint ``step``; raises CheckpointCorruptError when
+        validation fails."""
+        fault_point("checkpoint.read")
+        if not self.validate(step):
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} at {self._step_dir(step)} is "
+                "missing, partial, or fails its checksum manifest")
+        d = self._step_dir(step)
+        with open(os.path.join(d, self.MANIFEST)) as f:
+            manifest = json.load(f)
+        arrays = {name: np.load(os.path.join(d, name), allow_pickle=False)
+                  for name in manifest["shards"]}
+        return _unflatten_tree(manifest["structure"], arrays)
+
+    def restore_latest_valid(self) -> Optional[Tuple[int, Any]]:
+        """Walk steps newest-first, skipping corrupt/partial ones;
+        returns (step, state) or None. Skipped steps are recorded in
+        ``last_skipped``."""
+        self.last_skipped = []
+        policy = self.retry or get_retry_policy("checkpoint.read")
+        for step in reversed(self.all_steps()):
+            try:
+                state = policy.call(self.restore, step,
+                                    site="checkpoint.read")
+                return step, state
+            except (CheckpointCorruptError, RetryExhausted) as e:
+                self.last_skipped.append(step)
+                log.warning("skipping checkpoint step %d: %s", step, e)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats
+# ---------------------------------------------------------------------------
+
+class HeartbeatMonitor:
+    """Registers a rank with a MembershipStore and heartbeats it on a
+    daemon thread, retrying transient store failures. After
+    ``lost_after`` consecutive failed beats the rank is considered
+    disconnected: ``healthy()`` flips, ``on_lost`` fires (once per
+    outage) and the monitor keeps trying to re-register — the hardened
+    version of ElasticManager's bare loop, whose heartbeat exception
+    would silently kill the watch thread."""
+
+    def __init__(self, store, job_id: str, rank: int,
+                 interval_s: float = 1.0, meta: Optional[Dict] = None,
+                 retry: Optional[RetryPolicy] = None, lost_after: int = 3,
+                 on_lost: Optional[Callable[[], None]] = None):
+        self.store = store
+        self.job_id = job_id
+        self.rank = rank
+        self.interval_s = interval_s
+        self.meta = dict(meta or {})
+        self.retry = retry
+        self.lost_after = max(1, int(lost_after))
+        self.on_lost = on_lost
+        self.consecutive_failures = 0
+        self.beats = 0
+        self._lost_fired = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _policy(self) -> RetryPolicy:
+        return self.retry or get_retry_policy("membership.heartbeat")
+
+    def start(self) -> "HeartbeatMonitor":
+        self._policy().call(self.store.register, self.job_id, self.rank,
+                            self.meta, site="membership.register")
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._policy().call(self.store.heartbeat, self.job_id,
+                                    self.rank,
+                                    site="membership.heartbeat")
+            except Exception as e:  # noqa: BLE001 - the monitor thread
+                # must survive anything the store throws
+                self.consecutive_failures += 1
+                log.warning("heartbeat failed (%d consecutive): %s",
+                            self.consecutive_failures, e)
+                if self.consecutive_failures >= self.lost_after and \
+                        not self._lost_fired:
+                    self._lost_fired = True
+                    if self.on_lost is not None:
+                        try:
+                            self.on_lost()
+                        except Exception:
+                            log.exception("on_lost callback failed")
+                try:  # expired entries need a fresh register (lease
+                    # semantics: a late heartbeat cannot resurrect)
+                    self.store.register(self.job_id, self.rank, self.meta)
+                except Exception:
+                    pass
+            else:
+                self.beats += 1
+                self.consecutive_failures = 0
+                self._lost_fired = False
+            self._stop.wait(self.interval_s)
+
+    def healthy(self) -> bool:
+        return self.consecutive_failures < self.lost_after
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        try:
+            self.store.deregister(self.job_id, self.rank)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Resilient training loop
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TrainerEvent:
+    kind: str  # checkpoint | checkpoint_failed | step_fault | restore |
+    #            degraded | recovered
+    step: int
+    detail: str = ""
+
+
+class ResilientTrainer:
+    """Drives ``step_fn(state, batch) -> (state, loss)`` to completion
+    under faults.
+
+    Every ``checkpoint_every`` completed steps the full state (plus the
+    loss history, so a replayed run is indistinguishable) is written
+    through a ResilientCheckpointManager. When a step raises — an
+    injected fault, a preempted host's ConnectionError, anything short
+    of KeyboardInterrupt — the trainer restores the latest VALID
+    checkpoint and replays from that step. Because ``step_fn`` is
+    deterministic and restores are exact (npy round-trip), the final
+    params match a fault-free run bit-for-bit. A deterministic bug that
+    keeps faulting exhausts ``max_restores`` and surfaces.
+
+    Checkpoint-write failures degrade gracefully: logged, training
+    continues on the previous checkpoint's protection. An unhealthy
+    heartbeat is reported as a "degraded" event, not a crash."""
+
+    def __init__(self, step_fn: Callable, state: Any,
+                 checkpoint: ResilientCheckpointManager,
+                 checkpoint_every: int = 5, max_restores: int = 3,
+                 heartbeat: Optional[HeartbeatMonitor] = None,
+                 on_event: Optional[Callable[[TrainerEvent], None]] = None):
+        self.step_fn = step_fn
+        self.state = state
+        self.ckpt = checkpoint
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self.max_restores = int(max_restores)
+        self.heartbeat = heartbeat
+        self.on_event = on_event
+        self.events: List[TrainerEvent] = []
+        self.restores = 0
+        self.losses: List[float] = []
+
+    def _event(self, kind: str, step: int, detail: str = "") -> None:
+        ev = TrainerEvent(kind, step, detail)
+        self.events.append(ev)
+        log.info("trainer event %s at step %d: %s", kind, step, detail)
+        if self.on_event is not None:
+            self.on_event(ev)
+
+    def _payload(self) -> Dict[str, Any]:
+        return {"state": self.state,
+                "losses": np.asarray(self.losses, np.float64)}
+
+    def _save(self, step: int) -> None:
+        try:
+            self.ckpt.save(step, self._payload())
+            self._event("checkpoint", step)
+        except Exception as e:  # degrade: keep training on the older one
+            self._event("checkpoint_failed", step, repr(e))
+
+    def _latest_valid(self):
+        """restore_latest_valid + event trail for any corrupt steps it
+        skipped (shared by crash recovery and process-restart resume)."""
+        found = self.ckpt.restore_latest_valid()
+        for skipped in self.ckpt.last_skipped:
+            self._event("restore_skipped_corrupt", skipped)
+        return found
+
+    def _apply_payload(self, found) -> int:
+        step, payload = found
+        self.state = payload["state"]
+        self.losses = list(np.asarray(payload["losses"]).tolist())
+        return step
+
+    def _restore(self, initial_state) -> int:
+        """Roll back to the latest valid checkpoint (or the initial
+        state); returns the step index to resume from."""
+        found = self._latest_valid()
+        if found is None:
+            self.state = initial_state
+            self.losses = []
+            self._event("restore", 0, "no valid checkpoint; from init")
+            return 0
+        step = self._apply_payload(found)
+        self._event("restore", step)
+        return step
+
+    def run(self, batches) -> List[float]:
+        """Train over ``batches`` (a replayable sequence); returns the
+        per-step losses. ``self.state`` holds the final state."""
+        batches = list(batches)
+        initial_state = self.state
+        resumed = self._latest_valid()
+        if resumed is not None:
+            i = self._apply_payload(resumed)
+            self._event("resume", i)
+        else:
+            i = 0
+            self._save(0)
+        high_water = i  # furthest step ever completed this run
+        hb_healthy = True
+        while i < len(batches):
+            if self.heartbeat is not None:
+                now_healthy = self.heartbeat.healthy()
+                if hb_healthy and not now_healthy:
+                    self._event("degraded", i, "membership heartbeat lost")
+                elif not hb_healthy and now_healthy:
+                    self._event("recovered", i)
+                hb_healthy = now_healthy
+            try:
+                fault_point("trainer.step")
+                self.state, loss = self.step_fn(self.state, batches[i])
+                self.losses.append(float(loss))
+                i += 1
+                if i > high_water:
+                    # NEW territory reached: earlier faults were
+                    # transient, so the restore budget refills. A
+                    # deterministic bug keeps crashing at the same
+                    # step, never passes its high-water mark, and
+                    # still exhausts max_restores.
+                    high_water = i
+                    self.restores = 0
+                if i % self.checkpoint_every == 0:
+                    self._save(i)
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # noqa: BLE001 - every fault class
+                # funnels through checkpoint recovery
+                self._event("step_fault", i, repr(e))
+                self.restores += 1
+                if self.restores > self.max_restores:
+                    log.error("max_restores=%d exceeded; giving up",
+                              self.max_restores)
+                    raise
+                i = self._restore(initial_state)
+        return self.losses

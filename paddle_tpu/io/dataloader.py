@@ -59,14 +59,26 @@ def _worker_initializer(counter, num_workers, dataset, worker_init_fn):
 
 
 class _Fetcher:
-    """Picklable index->batch function for pool workers."""
+    """Picklable index->batch function for pool workers. Batch assembly
+    is a fault-injection site ("dataloader.fetch") and transient fetch
+    errors (a flaky network filesystem, an injected worker fault) are
+    retried per that site's policy; dataset bugs (TypeError/KeyError…)
+    are not transient and propagate on the first call."""
 
     def __init__(self, dataset, collate_fn):
         self.dataset = dataset
         self.collate_fn = collate_fn
 
     def __call__(self, indices):
-        return self.collate_fn([self.dataset[i] for i in indices])
+        from ..distributed.fault_inject import fault_point
+        from ..distributed.resilience import get_retry_policy
+
+        def _fetch():
+            fault_point("dataloader.fetch")
+            return self.collate_fn([self.dataset[i] for i in indices])
+
+        return get_retry_policy("dataloader.fetch").call(
+            _fetch, site="dataloader.fetch")
 
 
 class DataLoader:

@@ -32,6 +32,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+# older jax spells CompilerParams TPUCompilerParams
+_CompilerParams = getattr(pltpu, 'CompilerParams', None) or \
+    pltpu.TPUCompilerParams
 
 # 512-blocks measured fastest on TPU v5e (grad 4.2 ms vs 8.0 ms at 128
 # for B8 H12 S1024 D64); auto-clamped to the sequence length.
@@ -47,7 +50,7 @@ _NEG_INF = -1e30
 # batch/head/outer-block grid axes carry no cross-iteration state ->
 # Mosaic may pipeline them; the LAST axis streams the counterpart blocks
 # through scratch accumulators and must run in order ("arbitrary").
-_GRID_SEMANTICS = pltpu.CompilerParams(
+_GRID_SEMANTICS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 
@@ -399,7 +402,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
                 bytes_accessed=(q.size + k.size + v.size) *
                 q.dtype.itemsize,
                 transcendentals=b * h * sq * sk),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "parallel",
                                      "parallel")),
         )(q, k, v)
@@ -482,7 +485,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
                 bytes_accessed=(2 * q.size + 2 * do.size + 2 * k.size +
                                 2 * v.size) * q.dtype.itemsize,
                 transcendentals=b * h * sq * sk),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "parallel",
                                      "arbitrary")),
         )(q, k, v, do, lse, delta)

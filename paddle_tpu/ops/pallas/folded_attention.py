@@ -41,6 +41,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+# older jax spells CompilerParams TPUCompilerParams
+_CompilerParams = getattr(pltpu, 'CompilerParams', None) or \
+    pltpu.TPUCompilerParams
 
 # whole-S score blocks: [S, S] f32 intermediates in VMEM. 1024 keeps
 # the backward's live set (~4 x 4 MB) inside the scoped-vmem budget.
@@ -146,7 +149,7 @@ def _folded_fwd(q, k, v, head_dim, scale, causal):
             flops=4 * b * h * s * s * head_dim,
             bytes_accessed=4 * q.size * q.dtype.itemsize,
             transcendentals=b * h * s * s),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
     )(q, k, v)
 
@@ -182,7 +185,7 @@ def _folded_vjp_bwd(head_dim, scale, causal, res, g):
             flops=10 * b * h * s * s * head_dim,
             bytes_accessed=7 * q.size * q.dtype.itemsize,
             transcendentals=b * h * s * s),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
     )(q, k, v, g)
     return dq, dk, dv

@@ -52,6 +52,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+# older jax spells CompilerParams TPUCompilerParams
+_CompilerParams = getattr(pltpu, 'CompilerParams', None) or \
+    pltpu.TPUCompilerParams
 
 
 def _shift_rows(v, s, hw):
@@ -149,7 +152,7 @@ def fused_bottleneck_eval(x, w1, b1, w2, b2, w3, b3):
             flops=2 * n * hw * (c * m * 2 + 9 * m * m),
             bytes_accessed=2 * x.size * x.dtype.itemsize,
             transcendentals=0),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
             # stage-1 planes (two [3136, 256] bf16 in/out, double
             # buffered, plus the [3136, 64] chain intermediates) need

@@ -37,12 +37,28 @@ def sequence_pad(x, lengths, pad_value=0.0, padded_length=-1):
             # dropping buffer columns is only legal when they are all
             # padding; with concrete lengths enforce it like the
             # reference (sequence_pad_op: padded_length must cover
-            # every sequence). Traced lengths cannot be checked at
-            # trace time — the caller guarantees it.
+            # every sequence). With TRACED lengths the check cannot run
+            # at trace time, so it moves to run time: a debug callback
+            # re-checks max(lengths) on the host and FAILS the jitted
+            # computation (XlaRuntimeError) instead of silently
+            # truncating real timesteps.
             try:
                 max_len = int(np.max(np.asarray(lengths)))
             except (jax.errors.ConcretizationTypeError, TypeError):
-                max_len = None  # traced lengths: caller guarantees
+                max_len = None  # traced: deferred to the run-time check
+
+                def _runtime_cover_check(lv, _pl=padded_length):
+                    got = int(np.max(np.asarray(lv))) if np.size(lv) \
+                        else 0
+                    if got > _pl:
+                        raise ValueError(
+                            f"sequence_pad: padded_length={_pl} is "
+                            f"shorter than the longest sequence "
+                            f"({got}) — the reference op rejects this "
+                            "(truncation is never implicit)")
+
+                jax.debug.callback(_runtime_cover_check,
+                                   jnp.asarray(lengths))
             if max_len is not None and padded_length < max_len:
                 raise ValueError(
                     f"sequence_pad: padded_length={padded_length} is "

@@ -364,18 +364,53 @@ def convert_to_weight_only_int8(model: Layer, extra_types=()) -> int:
     default covers nn.Linear plus the tensor-parallel linears (their
     single-chip forward is the same x @ W (+ b)); embeddings and norms
     stay float. For decode this halves the streamed weight bytes —
-    the dominant cost per generated token."""
+    the dominant cost per generated token.
+
+    Tensor-parallel layers keep their sharding: the original weight
+    pspec is propagated onto the int8 buffer (quantization is
+    per-out-channel, so the layout is unchanged) and the per-column
+    scale gets the weight's axis-1 spec. Under mp_degree > 1 a warning
+    is still emitted — the converted layer no longer applies the
+    original layer's activation constraints (gather_output /
+    input_is_parallel plumbing), so verify the partitioner's choices."""
+    import warnings
+
+    from jax.sharding import PartitionSpec as P
+
     from ..distributed.mp_layers import (ColumnParallelLinear,
                                          RowParallelLinear)
+    from ..distributed.topology import get_hybrid_communicate_group
     types = (Linear, ColumnParallelLinear, RowParallelLinear,
              *extra_types)
+    hcg = get_hybrid_communicate_group()
+    mp_degree = hcg.get_model_parallel_world_size() if hcg else 1
     count = 0
 
     def convert(layer: Layer) -> None:
         nonlocal count
         for name, sub in list(layer._sub_layers.items()):
             if type(sub) in types:
-                layer._sub_layers[name] = WeightOnlyInt8Linear(sub)
+                pspec = getattr(sub.weight, "pspec", None)
+                if mp_degree > 1 and pspec is not None:
+                    warnings.warn(
+                        f"convert_to_weight_only_int8: converting "
+                        f"{type(sub).__name__} {name!r} under "
+                        f"mp_degree={mp_degree}; the weight pspec "
+                        f"{pspec} is propagated to the int8 buffer but "
+                        "the original layer's activation constraints "
+                        "are dropped — check the resulting sharding",
+                        UserWarning, stacklevel=3)
+                new = WeightOnlyInt8Linear(sub)
+                if pspec is not None:
+                    # quantized per-out-channel: same [in, out] layout,
+                    # so the weight spec carries over; the [out] scale
+                    # follows the weight's out axis
+                    new.weight_int8.pspec = pspec
+                    new.weight_int8.is_distributed = True
+                    out_axis = pspec[1] if len(pspec) > 1 else None
+                    new.weight_scale.pspec = P(out_axis)
+                    new.weight_scale.is_distributed = True
+                layer._sub_layers[name] = new
                 count += 1
             else:
                 convert(sub)

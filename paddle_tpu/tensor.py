@@ -22,7 +22,8 @@ from .core.dtype import convert_dtype
 class Tensor:
     __slots__ = ("value", "stop_gradient", "grad", "grad_node", "_out_index",
                  "name", "persistable", "_retain_grads", "_grad_hooks",
-                 "_inplace_version", "__weakref__")
+                 "_inplace_version", "is_distributed", "pspec",
+                 "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True,
                  name: Optional[str] = None):
@@ -319,9 +320,10 @@ class Parameter(Tensor):
     """Trainable tensor (reference: framework.py Parameter; dygraph params
     default to stop_gradient=False)."""
 
+    # is_distributed/pspec storage lives on Tensor so BUFFERS (e.g. an
+    # int8 weight after weight-only conversion) can carry sharding too
     __slots__ = ("trainable", "optimize_attr", "regularizer",
-                 "do_model_average", "need_clip", "is_distributed",
-                 "pspec")
+                 "do_model_average", "need_clip")
 
     def __init__(self, value, name: Optional[str] = None,
                  trainable: bool = True):

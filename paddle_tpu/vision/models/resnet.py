@@ -94,13 +94,18 @@ class BottleneckBlock(nn.Layer):
         from ... import dispatch
         from ...ops.pallas.fused_conv_block import (fused_bottleneck_eval,
                                                     pack_bottleneck)
-        # fold/pack once per weight version (eval weights are frozen;
-        # a training step in between changes the param identities and
-        # invalidates the key)
-        key = (id(self.conv1.weight.value), id(self.conv2.weight.value),
-               id(self.conv3.weight.value), id(self.bn1._mean.value))
+        # fold/pack once per weight version (eval weights are frozen; a
+        # training step or set_state_dict in between swaps the array
+        # objects and invalidates the key). The key holds the arrays
+        # THEMSELVES and compares by identity: keeping them alive means
+        # CPython can never reallocate a new array at a freed array's
+        # address, which an id()-tuple key was vulnerable to (stale pack
+        # served after a weight reload).
+        key = (self.conv1.weight.value, self.conv2.weight.value,
+               self.conv3.weight.value, self.bn1._mean.value)
         cached = getattr(self, "_fused_pack", None)
-        if cached is None or cached[0] != key:
+        if cached is None or len(cached[0]) != len(key) or \
+                any(a is not b for a, b in zip(cached[0], key)):
             self._fused_pack = (key, pack_bottleneck(self))
         params = self._fused_pack[1]
 

@@ -7,6 +7,11 @@ real TPU chip with the default environment.
 
 import os
 
+# Compile-only TPU topologies (scale-proof / longseq AOT tests) must not
+# probe the GCP metadata server: off-cloud, libtpu retries those fetches
+# for ~8 minutes before giving up, stalling the whole fast lane.
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
+
 # Must be set before the first backend initialization.
 _flag = "--xla_force_host_platform_device_count=8"
 if _flag not in os.environ.get("XLA_FLAGS", ""):

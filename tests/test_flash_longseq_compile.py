@@ -13,6 +13,10 @@ import pytest
 
 
 def _v5e_topology():
+    import os
+    # off-cloud, libtpu's GCP metadata probing stalls ~8 min (conftest
+    # sets this too; kept here for standalone runs)
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
     try:
         from jax.experimental import topologies
         return topologies.get_topology_desc(platform="tpu",

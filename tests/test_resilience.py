@@ -1,0 +1,768 @@
+"""Fault-tolerant training runtime (distributed/resilience.py +
+distributed/fault_inject.py): retry/backoff semantics, checksum-guarded
+checkpoints, fault-injected end-to-end recovery, and the injection
+sites threaded through ps/heter/elastic/dataloader — all on CPU.
+
+Reference parity: fleet/elastic.py's checkpoint-based recovery +
+ELASTIC_EXIT_CODE restart contract, validated the way the reference
+validates it (chaos-style fault injection), but in-process and
+deterministic."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer as optim
+from paddle_tpu.distributed import fault_inject as fi
+from paddle_tpu.distributed import resilience as rz
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    fi.reset()
+    rz.clear_site_policies()
+    rz._env_policies = None
+    yield
+    fi.reset()
+    rz.clear_site_policies()
+    rz._env_policies = None
+
+
+# -- RetryPolicy --------------------------------------------------------------
+
+def test_retry_backoff_deterministic_and_capped():
+    p = rz.RetryPolicy(max_attempts=6, base_delay_s=0.1, max_delay_s=0.5,
+                       multiplier=2.0, jitter=0.25, seed=3)
+    d1, d2 = p.preview_delays(), p.preview_delays()
+    assert d1 == d2  # seeded: same schedule every time
+    assert len(d1) == 5
+    base = [0.1, 0.2, 0.4, 0.5, 0.5]
+    for got, b in zip(d1, base):
+        assert b <= got <= b * 1.25  # jittered upward only, capped
+
+
+def test_retry_succeeds_after_transient_failures():
+    p = rz.RetryPolicy(max_attempts=4, base_delay_s=0.001)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("blip")
+        return "ok"
+
+    assert p.call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_exhausted_chains_cause():
+    p = rz.RetryPolicy(max_attempts=2, base_delay_s=0.001)
+    with pytest.raises(rz.RetryExhausted) as ei:
+        p.call(lambda: (_ for _ in ()).throw(OSError("down")), site="s")
+    assert isinstance(ei.value.__cause__, OSError)
+    assert ei.value.attempts == 2
+
+
+def test_non_transient_errors_not_retried():
+    p = rz.RetryPolicy(max_attempts=5, base_delay_s=0.001)
+    calls = []
+
+    def bug():
+        calls.append(1)
+        raise KeyError("not transient")
+
+    with pytest.raises(KeyError):
+        p.call(bug)
+    assert len(calls) == 1
+
+
+def test_site_policy_override_and_env(monkeypatch):
+    assert rz.get_retry_policy("nope") is rz.DEFAULT_RETRY
+    mine = rz.RetryPolicy(max_attempts=7)
+    rz.set_site_policy("ps.push", mine)
+    assert rz.get_retry_policy("ps.push") is mine
+    rz.set_site_policy("ps.push", None)
+    monkeypatch.setenv("PT_RETRY_SITES",
+                       "ps.push:attempts=5,base=0.01;x:attempts=1")
+    rz._env_policies = None  # re-read env
+    assert rz.get_retry_policy("ps.push").max_attempts == 5
+    assert rz.get_retry_policy("ps.push").base_delay_s == 0.01
+    assert rz.get_retry_policy("x").max_attempts == 1
+
+
+# -- FaultInjector -------------------------------------------------------------
+
+def test_fault_point_default_off_creates_nothing():
+    assert fi.fault_point("anything") is None
+    assert fi._GLOBAL is None  # no injector materialized
+
+
+def test_injector_at_calls_and_max_faults():
+    inj = fi.FaultInjector()
+    inj.arm("s", at_calls=[2, 4], max_faults=1)
+    fired = []
+    for i in range(1, 6):
+        try:
+            inj.fire("s")
+        except fi.InjectedFault as e:
+            fired.append((i, e.index))
+    assert fired == [(2, 2)]  # max_faults stops the second scheduled one
+    assert inj.counts("s") == {"calls": 5, "fired": 1}
+
+
+def test_injector_probability_seeded_deterministic():
+    def run(seed):
+        inj = fi.FaultInjector()
+        inj.arm("s", probability=0.5, seed=seed)
+        out = []
+        for i in range(20):
+            try:
+                inj.fire("s")
+                out.append(0)
+            except fi.InjectedFault:
+                out.append(1)
+        return out
+
+    assert run(7) == run(7)
+    assert sum(run(7)) > 0
+
+
+def test_injector_env_parsing():
+    inj = fi.FaultInjector().configure_from_env(
+        {"PT_FAULT_INJECT":
+         "a:p=0.5,seed=1;b:at=1|3,max=2,mode=torn"})
+    assert inj._specs["a"].probability == 0.5
+    assert inj._specs["a"].seed == 1
+    assert inj._specs["b"].at_calls == frozenset({1, 3})
+    assert inj._specs["b"].max_faults == 2
+    assert inj._specs["b"].mode == fi.MODE_TORN
+
+
+def test_injected_fault_is_transient_for_default_policy():
+    # InjectedFault subclasses ConnectionError on purpose: armed sites
+    # exercise the default retry path
+    assert issubclass(fi.InjectedFault, ConnectionError)
+
+
+# -- ResilientCheckpointManager ------------------------------------------------
+
+def _state(v=0.0):
+    return {"w": np.arange(6, dtype=np.float32).reshape(2, 3) + v,
+            "meta": {"lr": 0.1, "epoch": 3},
+            "hist": [np.ones(2, np.float32), 2.5]}
+
+
+def test_checkpoint_roundtrip_nested_pytree(tmp_path):
+    m = rz.ResilientCheckpointManager(str(tmp_path / "ck"))
+    m.save(5, _state())
+    got = m.restore(5)
+    np.testing.assert_array_equal(got["w"], _state()["w"])
+    assert got["meta"] == {"lr": 0.1, "epoch": 3}
+    assert isinstance(got["hist"], list) and got["hist"][1] == 2.5
+    np.testing.assert_array_equal(got["hist"][0], np.ones(2))
+
+
+def test_checkpoint_rotation_keeps_n(tmp_path):
+    m = rz.ResilientCheckpointManager(str(tmp_path / "ck"), keep_n=2)
+    for s in (1, 2, 3, 4):
+        m.save(s, _state(s))
+    assert m.all_steps() == [3, 4]
+    assert m.latest_step() == 4
+
+
+def test_corrupt_shard_detected_and_skipped(tmp_path):
+    m = rz.ResilientCheckpointManager(str(tmp_path / "ck"))
+    m.save(1, _state(1))
+    m.save(2, _state(2))
+    d = m._step_dir(2)
+    shard = sorted(f for f in os.listdir(d) if f.endswith(".npy"))[0]
+    with open(os.path.join(d, shard), "r+b") as f:
+        f.seek(40)
+        f.write(b"\xde\xad\xbe\xef")
+    assert not m.validate(2) and m.validate(1)
+    with pytest.raises(rz.CheckpointCorruptError):
+        m.restore(2)
+    step, got = m.restore_latest_valid()
+    assert step == 1 and m.last_skipped == [2]
+    np.testing.assert_array_equal(got["w"], _state(1)["w"])
+
+
+def test_partial_write_never_published(tmp_path):
+    """An aborted write (crash before rename) leaves NO step directory
+    and no stale tmp junk — atomicity of tmp+rename."""
+    m = rz.ResilientCheckpointManager(
+        str(tmp_path / "ck"),
+        retry=rz.RetryPolicy(max_attempts=2, base_delay_s=0.001))
+    fi.get_injector().arm("checkpoint.write", probability=1.0)
+    with pytest.raises(rz.RetryExhausted):
+        m.save(1, _state())
+    assert m.all_steps() == []
+    assert not [f for f in os.listdir(m.directory)
+                if f.startswith(".tmp-")]
+
+
+def test_torn_write_published_but_skipped(tmp_path):
+    """The "torn" fault mode publishes a checkpoint whose shard fails
+    its manifest crc — restore_latest_valid must skip it (the
+    acceptance scenario: corrupt partial write skipped via checksums)."""
+    m = rz.ResilientCheckpointManager(str(tmp_path / "ck"))
+    m.save(1, _state(1))
+    fi.get_injector().arm("checkpoint.write", at_calls=[1],
+                          mode=fi.MODE_TORN)
+    m.save(2, _state(2))  # reports success; actually torn
+    assert 2 in m.all_steps() and not m.validate(2)
+    step, _ = m.restore_latest_valid()
+    assert step == 1 and m.last_skipped == [2]
+
+
+def test_rotation_never_strands_corrupt_only_steps(tmp_path):
+    """GC keeps the newest VALID step alive even when corrupt newer
+    steps would otherwise rotate it out."""
+    m = rz.ResilientCheckpointManager(str(tmp_path / "ck"), keep_n=2)
+    m.save(1, _state(1))
+    m.save(2, _state(2))
+    fi.get_injector().arm("checkpoint.write", probability=1.0,
+                          mode=fi.MODE_TORN)
+    m.save(3, _state(3))
+    m.save(4, _state(4))
+    fi.reset()
+    assert 2 in m.all_steps()  # survived outside the keep-2 window
+    assert m.restore_latest_valid()[0] == 2
+
+
+# -- end-to-end recovery -------------------------------------------------------
+
+def _make_batches(n=12, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.standard_normal((4, 3)).astype(np.float32),
+             rng.standard_normal((4,)).astype(np.float32))
+            for _ in range(n)]
+
+
+def _sgd_step(state, batch):
+    """Deterministic linear-regression SGD step (pure numpy: bit-exact
+    replay)."""
+    x, y = batch
+    w, b = np.asarray(state["w"]), np.asarray(state["b"])
+    err = x @ w + b - y
+    loss = float((err ** 2).mean())
+    gw = 2.0 * x.T @ err / len(y)
+    gb = 2.0 * err.mean()
+    return {"w": w - 0.05 * gw, "b": b - 0.05 * gb}, loss
+
+
+def _init_state():
+    return {"w": np.zeros(3, np.float32), "b": np.float32(0.0)}
+
+
+def test_trainer_end_to_end_recovery_parity(tmp_path):
+    """THE acceptance scenario: armed fault at the checkpoint-write
+    site (a torn write that got published) plus a mid-epoch step crash.
+    The run finishes, resumes from the latest VALID checkpoint (the
+    torn one skipped via its checksum manifest), and the final params
+    match a fault-free run to numerical tolerance (here: exactly)."""
+    ref = rz.ResilientTrainer(
+        _sgd_step, _init_state(),
+        rz.ResilientCheckpointManager(str(tmp_path / "ref")),
+        checkpoint_every=4)
+    ref_losses = ref.run(_make_batches())
+
+    # write calls: #1 = initial save, #2 = step 4, #3 = step 8 (torn)
+    fi.get_injector().arm("checkpoint.write", at_calls=[3],
+                          mode=fi.MODE_TORN)
+    # step calls are 1-based per loop iteration: #10 = batch index 9
+    fi.get_injector().arm("trainer.step", at_calls=[10], max_faults=1)
+    t = rz.ResilientTrainer(
+        _sgd_step, _init_state(),
+        rz.ResilientCheckpointManager(str(tmp_path / "faulty")),
+        checkpoint_every=4)
+    losses = t.run(_make_batches())
+
+    kinds = [e.kind for e in t.events]
+    assert "step_fault" in kinds          # the injected crash happened
+    assert "restore_skipped_corrupt" in kinds  # torn step 8 skipped
+    assert "restore" in kinds             # resumed from valid step 4
+    restore = next(e for e in t.events if e.kind == "restore")
+    assert restore.step == 4
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(t.state["w"]),
+                               np.asarray(ref.state["w"]), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(t.state["b"]),
+                               np.asarray(ref.state["b"]), rtol=1e-12)
+
+
+def test_trainer_degrades_gracefully_when_saves_fail(tmp_path):
+    """Checkpoint-write failures must not kill training: log + continue
+    (the tentpole's graceful-degradation contract)."""
+    rz.set_site_policy("checkpoint.write",
+                       rz.RetryPolicy(max_attempts=2, base_delay_s=0.001))
+    fi.get_injector().arm("checkpoint.write", probability=1.0)
+    t = rz.ResilientTrainer(
+        _sgd_step, _init_state(),
+        rz.ResilientCheckpointManager(str(tmp_path / "ck")),
+        checkpoint_every=4)
+    losses = t.run(_make_batches())
+    ref = rz.ResilientTrainer(
+        _sgd_step, _init_state(),
+        rz.ResilientCheckpointManager(str(tmp_path / "ref")),
+        checkpoint_every=4)
+    np.testing.assert_allclose(losses, ref.run(_make_batches()),
+                               rtol=1e-12)
+    kinds = [e.kind for e in t.events]
+    assert "checkpoint_failed" in kinds and "checkpoint" not in kinds
+
+
+def test_trainer_resumes_across_instances(tmp_path):
+    """A NEW trainer pointed at the same directory resumes instead of
+    restarting — the elastic relaunch contract (exit 101 → new process
+    → checkpoint-based recovery)."""
+    ck = str(tmp_path / "ck")
+    t0 = rz.ResilientTrainer(
+        _sgd_step, _init_state(), rz.ResilientCheckpointManager(ck),
+        checkpoint_every=4)
+    ref_losses = t0.run(_make_batches())
+    t1 = rz.ResilientTrainer(
+        _sgd_step, _init_state(), rz.ResilientCheckpointManager(ck),
+        checkpoint_every=4)
+    losses = t1.run(_make_batches())
+    assert t1.events[0].kind == "resume" and t1.events[0].step == 12
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-12)
+
+
+def test_trainer_persistent_bug_exhausts_restores(tmp_path):
+    def bad_step(state, batch):
+        raise ValueError("deterministic bug")
+
+    t = rz.ResilientTrainer(
+        bad_step, _init_state(),
+        rz.ResilientCheckpointManager(str(tmp_path / "ck")),
+        checkpoint_every=4, max_restores=2)
+    with pytest.raises(ValueError, match="deterministic bug"):
+        t.run(_make_batches(n=3))
+    assert t.restores == 3  # 2 allowed + the one that re-raised
+
+
+# -- heartbeats ----------------------------------------------------------------
+
+class _FlakyStore:
+    """In-memory MembershipStore whose heartbeat fails on chosen beats."""
+
+    def __init__(self, fail_beats=()):
+        self.fail_beats = set(fail_beats)
+        self.hb_calls = 0
+        self.registers = 0
+
+    def register(self, job_id, rank, meta):
+        self.registers += 1
+
+    def heartbeat(self, job_id, rank):
+        self.hb_calls += 1
+        if self.hb_calls in self.fail_beats:
+            raise ConnectionError("store blip")
+
+    def deregister(self, job_id, rank):
+        pass
+
+    def members(self, job_id):
+        return {0: {}}
+
+
+def _wait_for(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def test_heartbeat_monitor_detects_loss_and_recovers():
+    lost = []
+    store = _FlakyStore(fail_beats={2, 3, 4})
+    mon = rz.HeartbeatMonitor(store, "job", 0, interval_s=0.005,
+                              retry=rz.NO_RETRY, lost_after=2,
+                              on_lost=lambda: lost.append(1))
+    mon.start()
+    try:
+        assert _wait_for(lambda: len(lost) == 1)  # beats 2+3 failed
+        assert _wait_for(lambda: mon.healthy() and mon.beats >= 3)
+        assert len(lost) == 1  # fired once per outage, not per beat
+        assert store.registers >= 2  # re-registered after expiry
+    finally:
+        mon.stop()
+
+
+def test_elastic_manager_watch_survives_flaky_store():
+    from paddle_tpu.distributed.elastic import ElasticManager
+    rz.set_site_policy("membership.heartbeat", rz.NO_RETRY)
+    store = _FlakyStore(fail_beats={1, 2})
+    em = ElasticManager("job", 0, 1, store, heartbeat_s=0.005)
+    em.start()
+    try:
+        assert _wait_for(lambda: store.hb_calls >= 5)
+        assert em._thread.is_alive()  # blips did not kill the watch
+        assert em.hb_failures == 0    # and the counter reset
+    finally:
+        em.stop()
+
+
+# -- PS client retry -----------------------------------------------------------
+
+def test_ps_client_retries_injected_push_fault():
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+
+    rz.set_site_policy("ps.push",
+                       rz.RetryPolicy(max_attempts=3, base_delay_s=0.001))
+    srv = PSServer()
+    srv.add_dense_table("w", (4,), optimizer="sgd", lr=0.1)
+    srv.start()
+    try:
+        client = PSClient([srv.endpoint])
+        client.push_dense_init("w", np.ones(4, np.float32))
+        fi.get_injector().arm("ps.push", at_calls=[1], max_faults=1)
+        client.push_dense_grad("w", np.full(4, 2.0, np.float32))
+        np.testing.assert_allclose(client.pull_dense("w"),
+                                   np.full(4, 0.8), rtol=1e-6)
+        c = fi.get_injector().counts("ps.push")
+        assert c["fired"] == 1 and c["calls"] >= 2  # failed + retried
+        client.stop()
+    finally:
+        srv.stop()
+
+
+def test_ps_client_reconnects_after_dead_socket():
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+
+    srv = PSServer()
+    srv.add_dense_table("w", (3,), lr=0.1)
+    srv.start()
+    try:
+        client = PSClient([srv.endpoint])
+        client.push_dense_init("w", np.ones(3, np.float32))
+        client._socks[0].close()  # simulate a dropped connection
+        np.testing.assert_allclose(client.pull_dense("w"), np.ones(3))
+        client.stop()
+    finally:
+        srv.stop()
+
+
+# -- heter pipeline fail-fast --------------------------------------------------
+
+class _FailingPushTable:
+    def __init__(self, dim, fail_on=1):
+        self.dim = dim
+        self.pulls = 0
+        self.pushes = 0
+        self.fail_on = fail_on
+
+    def pull(self, ids):
+        self.pulls += 1
+        return np.zeros((len(np.asarray(ids).reshape(-1)), self.dim),
+                        np.float32)
+
+    def push_grad(self, ids, grads):
+        self.pushes += 1
+        if self.pushes == self.fail_on:
+            raise RuntimeError("push exploded")
+
+
+class _TinyDense(nn.Layer):
+    def __init__(self, n_slots, dim, classes):
+        super().__init__()
+        self.fc = nn.Linear(n_slots * dim, classes)
+
+    def forward(self, acts, labels=None):
+        import paddle_tpu.dispatch as dispatch
+        F = dispatch.wrapped_ops
+        logits = self.fc(acts)
+        if labels is None:
+            return logits
+        return F["mean"](F["cross_entropy"](logits, labels))
+
+
+def test_heter_pipeline_async_push_failure_fails_fast():
+    """A failed gradient push must abort the epoch promptly (drained
+    every iteration), not at the end-of-epoch join after every batch
+    trained against a silently-stale table."""
+    from paddle_tpu.distributed.heter import HeterPipelineTrainer
+
+    dim, n_slots, classes, n_batches = 4, 3, 5, 8
+    table = _FailingPushTable(dim, fail_on=1)
+    pt.seed(0)
+    rng = np.random.default_rng(0)
+    batches = [(rng.integers(0, 50, (4, n_slots)).astype(np.int32),
+                rng.integers(0, classes, (4,)).astype(np.int64))
+               for _ in range(n_batches)]
+    trainer = HeterPipelineTrainer(table, dim,
+                                   _TinyDense(n_slots, dim, classes),
+                                   optim.SGD(learning_rate=0.1),
+                                   lambda m, a, l: m(a, labels=l))
+    try:
+        with pytest.raises(RuntimeError, match="push exploded"):
+            trainer.run(batches, sync=False)
+        # prompt abort: well before all batches were pulled/trained
+        assert table.pulls < n_batches
+    finally:
+        trainer.shutdown()
+
+
+# -- dataloader fetch site -----------------------------------------------------
+
+def test_dataloader_fetch_fault_retried():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class _DS(Dataset):
+        def __getitem__(self, i):
+            return np.full((2,), i, np.float32)
+
+        def __len__(self):
+            return 8
+
+    fi.get_injector().arm("dataloader.fetch", at_calls=[1], max_faults=1)
+    loader = DataLoader(_DS(), batch_size=2, shuffle=False)
+    batches = [np.asarray(b[0].value if hasattr(b[0], "value") else b[0])
+               for b in loader]
+    assert len(batches) == 4  # fault on batch 1 retried transparently
+    np.testing.assert_array_equal(batches[0][0], np.zeros(2))
+    c = fi.get_injector().counts("dataloader.fetch")
+    assert c["fired"] == 1 and c["calls"] >= 5
+
+
+# -- satellite regressions -----------------------------------------------------
+
+def test_resnet_fused_pack_cache_tracks_weight_reload(monkeypatch):
+    """resnet.py fused-eval pack cache: after set_state_dict the pack
+    must be refolded (the id()-keyed cache could serve a stale pack
+    when CPython reuses a freed array's address); identical weights
+    must still hit the cache."""
+    from paddle_tpu.ops.pallas import fused_conv_block as fc
+    from paddle_tpu.vision.models.resnet import BottleneckBlock
+
+    pt.seed(0)
+    blk = BottleneckBlock(16, 4, data_format="NHWC")
+    blk.eval()
+    packs = []
+
+    def fake_pack(block):
+        s = jnp.asarray(np.asarray(block.conv1.weight.value).sum(),
+                        jnp.float32)
+        packs.append(float(s))
+        return (s,)
+
+    monkeypatch.setattr(fc, "pack_bottleneck", fake_pack)
+    monkeypatch.setattr(fc, "fused_bottleneck_eval",
+                        lambda xv, p: xv * 0 + p)
+    x = pt.Tensor(jnp.ones((1, 2, 2, 16), jnp.float32))
+    out1 = np.asarray(blk._fused_eval(x).value)
+    np.asarray(blk._fused_eval(x).value)
+    assert len(packs) == 1  # unchanged weights: cache hit
+
+    sd = blk.state_dict()
+    new_sd = {}
+    for k, v in sd.items():
+        arr = np.asarray(v.value)
+        if k.endswith("conv1.weight"):
+            arr = arr + 1.0
+        new_sd[k] = arr
+    blk.set_state_dict(new_sd)
+    out2 = np.asarray(blk._fused_eval(x).value)
+    assert len(packs) == 2  # reload invalidated the pack
+    assert not np.allclose(out1, out2)
+
+
+def test_weight_only_int8_mp_guard_warns_and_propagates_pspec():
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.mp_layers import ColumnParallelLinear
+    from paddle_tpu.distributed.topology import (
+        HybridCommunicateGroup, get_hybrid_communicate_group,
+        set_hybrid_communicate_group)
+    from paddle_tpu.quantization.quant import (
+        WeightOnlyInt8Linear, convert_to_weight_only_int8)
+
+    class _M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.proj = ColumnParallelLinear(8, 16, gather_output=True)
+
+    prev = get_hybrid_communicate_group()
+    try:
+        set_hybrid_communicate_group(
+            HybridCommunicateGroup(dp_degree=1, mp_degree=2))
+        pt.seed(0)
+        m = _M()
+        with pytest.warns(UserWarning, match="mp_degree=2"):
+            n = convert_to_weight_only_int8(m)
+        assert n == 1
+        conv = m._sub_layers["proj"]
+        assert isinstance(conv, WeightOnlyInt8Linear)
+        assert conv.weight_int8.pspec == P(None, "mp")
+        assert conv.weight_scale.pspec == P("mp")
+        assert conv.weight_int8.is_distributed
+    finally:
+        set_hybrid_communicate_group(prev)
+
+
+def test_sequence_pad_traced_truncation_fails_loudly():
+    """jit-compiled sequence_pad with a too-small padded_length must
+    FAIL at run time (host callback check), not silently truncate —
+    the reference op never truncates implicitly."""
+    from paddle_tpu.ops import sequence as sq
+
+    x = jnp.arange(24.0).reshape(2, 6, 2)
+    f = jax.jit(lambda xx, ll: sq.sequence_pad(xx, ll, padded_length=3))
+    ok = f(x, jnp.array([3, 2]))  # covered: fine
+    assert np.asarray(ok).shape == (2, 3, 2)
+    with pytest.raises(Exception):  # XlaRuntimeError from the callback
+        jax.block_until_ready(f(x, jnp.array([5, 2])))
+
+
+def test_sequence_pad_concrete_truncation_still_raises():
+    from paddle_tpu.ops import sequence as sq
+
+    x = jnp.arange(24.0).reshape(2, 6, 2)
+    with pytest.raises(ValueError, match="never implicit"):
+        sq.sequence_pad(x, np.array([5, 2]), padded_length=3)
+
+
+# -- review-fix regressions ----------------------------------------------------
+
+def test_torn_mode_degrades_to_abort_at_unsupporting_site():
+    """A site that doesn't implement "torn" must abort, not silently
+    count a fired fault with no effect."""
+    inj = fi.FaultInjector()
+    inj.arm("s", at_calls=[1], mode=fi.MODE_TORN)
+    with pytest.raises(fi.InjectedFault):
+        inj.fire("s")  # default: only abort supported
+    inj.arm("s2", at_calls=[1], mode=fi.MODE_TORN)
+    assert inj.fire("s2", modes=(fi.MODE_TORN,)) == fi.MODE_TORN
+
+
+def test_retry_zero_attempts_still_runs_once():
+    """attempts=0 (a PT_RETRY_SITES typo) must not no-op the guarded
+    operation."""
+    p = rz.RetryPolicy(max_attempts=0, base_delay_s=0.001)
+    calls = []
+    assert p.call(lambda: calls.append(1) or "ran") == "ran"
+    assert calls == [1]
+
+
+def test_ps_client_connects_lazily_under_retry():
+    """Constructing a client while its server is still down must not
+    fail; the first call connects (retried) once the server is up."""
+    import socket as _socket
+
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+
+    with _socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    client = PSClient([f"127.0.0.1:{port}"])  # nothing listening: ok
+    srv = PSServer(port=port)
+    srv.add_dense_table("w", (2,), lr=0.1)
+    srv.start()
+    try:
+        client.push_dense_init("w", np.ones(2, np.float32))
+        np.testing.assert_allclose(client.pull_dense("w"), np.ones(2))
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_elastic_on_change_uses_fetched_map():
+    """The change callback must receive the already-fetched member map
+    (a second unretried store read could kill the watch thread)."""
+    from paddle_tpu.distributed.elastic import ElasticManager
+
+    class _Store(_FlakyStore):
+        def __init__(self):
+            super().__init__()
+            self.members_calls = 0
+            self._members = {0: {}}
+
+        def members(self, job_id):
+            self.members_calls += 1
+            return dict(self._members)
+
+    rz.set_site_policy("membership.heartbeat", rz.NO_RETRY)
+    store = _Store()
+    seen = []
+    em = ElasticManager("job", 0, 1, store, heartbeat_s=0.005,
+                        on_change=lambda m: seen.append(m))
+    em.start()
+    try:
+        assert _wait_for(lambda: store.members_calls >= 2)
+        before = store.members_calls
+        store._members = {0: {}, 1: {}}  # membership change
+        assert _wait_for(lambda: seen)
+        assert seen[0] == {0: {}, 1: {}}
+    finally:
+        em.stop()
+
+
+def test_deterministic_oserrors_not_retried():
+    """FileNotFoundError & co. are OSErrors but deterministic: they
+    must surface immediately with their original type, not burn
+    backoff and come back as RetryExhausted."""
+    p = rz.RetryPolicy(max_attempts=5, base_delay_s=0.001)
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError("/ckpt/does-not-exist")
+
+    with pytest.raises(FileNotFoundError):
+        p.call(missing)
+    assert calls == [1]
+
+
+def test_malformed_retry_spec_ignored_not_fatal():
+    p = rz.RetryPolicy.from_spec("atempts=9,base=0.01,attempts=4")
+    assert p.max_attempts == 4          # good keys still apply
+    assert p.base_delay_s == 0.01       # typo ignored, not KeyError
+
+
+def test_trainer_restore_budget_refills_on_progress(tmp_path):
+    """Independent transient faults spread across a long run must not
+    exhaust max_restores once each recovery makes fresh progress."""
+    fi.get_injector().arm("trainer.step", at_calls=[3, 10, 17, 24],
+                          max_faults=4)
+    t = rz.ResilientTrainer(
+        _sgd_step, _init_state(),
+        rz.ResilientCheckpointManager(str(tmp_path / "ck")),
+        checkpoint_every=2, max_restores=1)
+    losses = t.run(_make_batches(n=16))
+    ref = rz.ResilientTrainer(
+        _sgd_step, _init_state(),
+        rz.ResilientCheckpointManager(str(tmp_path / "ref")),
+        checkpoint_every=2)
+    np.testing.assert_allclose(losses, ref.run(_make_batches(n=16)),
+                               rtol=1e-12)
+    assert sum(e.kind == "step_fault" for e in t.events) >= 2
+
+
+def test_trainer_resume_reports_skipped_corrupt(tmp_path):
+    """A process-restart resume that skips a torn checkpoint must leave
+    the same event trail as crash recovery."""
+    ck = str(tmp_path / "ck")
+    t0 = rz.ResilientTrainer(
+        _sgd_step, _init_state(), rz.ResilientCheckpointManager(ck),
+        checkpoint_every=4)
+    t0.run(_make_batches())
+    d = t0.ckpt._step_dir(12)
+    shard = sorted(f for f in os.listdir(d) if f.endswith(".npy"))[0]
+    with open(os.path.join(d, shard), "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff\xff")
+    t1 = rz.ResilientTrainer(
+        _sgd_step, _init_state(), rz.ResilientCheckpointManager(ck),
+        checkpoint_every=4)
+    t1.run(_make_batches())
+    kinds = [e.kind for e in t1.events]
+    assert kinds[0] == "restore_skipped_corrupt"
+    assert "resume" in kinds

@@ -18,10 +18,35 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 
 def _tpu_plugin_available():
+    """Compile-only libtpu present AND able to SPMD-partition the
+    pipeline program's ingredients (older plugins reject the
+    PartitionId instruction axis_index lowers to — probe it cheaply
+    on a 2x2 topology before committing to the ~50 s 10B compile)."""
+    # compile-only topologies must not probe the GCP metadata server:
+    # off-cloud, libtpu retries those fetches for ~8 MINUTES before
+    # giving up (every curl 30x), stalling collection of this file
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
     try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
         from jax.experimental import topologies
-        topologies.get_topology_desc(platform="tpu",
-                                     topology_name="v4:2x2x1")
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from paddle_tpu.compat import shard_map
+
+        topo = topologies.get_topology_desc(platform="tpu",
+                                            topology_name="v4:2x2x1")
+        mesh = Mesh(np.asarray(list(topo.devices)).reshape(2, 2),
+                    ("x", "y"))
+
+        def probe(a):
+            return a + jax.lax.axis_index("x")
+
+        sm = shard_map(probe, mesh=mesh, in_specs=P("x", "y"),
+                       out_specs=P("x", "y"), check_vma=False)
+        jax.jit(sm).lower(
+            jax.ShapeDtypeStruct((2, 2), jnp.int32)).compile()
         return True
     except Exception:
         return False
@@ -58,6 +83,39 @@ def test_10b_v4_64_aot_fits():
             report["per_device_bytes"]["temps"], rtol=0.25)
 
 
+def _partial_manual_axis_index_supported():
+    """Old XLA SPMD partitioners reject the PartitionId instruction that
+    jax.lax.axis_index lowers to inside a partial-manual shard_map (the
+    hybrid pipeline's manual={"pp"} composition); probe cheaply."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from paddle_tpu.compat import shard_map
+
+        if len(jax.devices()) < 4:
+            return False
+        mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                    ("pp", "dp"))
+
+        def probe(a):
+            return a + jax.lax.axis_index("pp")
+
+        sm = shard_map(probe, mesh=mesh, in_specs=P("pp"),
+                       out_specs=P("pp"), check_vma=False,
+                       axis_names=frozenset({"pp"}))
+        jax.jit(sm).lower(
+            jax.ShapeDtypeStruct((2, 2), jnp.int32)).compile()
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _partial_manual_axis_index_supported(),
+                    reason="XLA too old to SPMD-partition axis_index "
+                           "inside partial-manual shard_map")
 def test_abstract_pipeline_lower_tiny():
     """The abstract=True path itself (no materialization) on the virtual
     CPU mesh: lower a tiny hybrid config and check input placements."""

@@ -32,6 +32,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 # The few concrete buffers built during model construction (position ids
 # etc.) should land on host — the TPU topology here is compile-only.
+# Off-cloud, libtpu's GCP metadata probing retries for ~8 minutes before
+# failing; compile-only use never needs it.
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
 if __name__ == "__main__":
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -56,6 +59,26 @@ def _mem_bytes(compiled):
     return arg_b, out_b, temp_b, alias_b, code_b, live
 
 
+def _restores_hcg(fn):
+    """run_proof sets the GLOBAL hybrid group to an abstract TPU
+    topology (build_step needs it set during lowering); restore the
+    caller's group afterwards — leaking a 64-device TPU mesh poisons
+    every later sharding-constraint in the process (observed as
+    cross-test-file failures)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from paddle_tpu.distributed.topology import (
+            get_hybrid_communicate_group, set_hybrid_communicate_group)
+        prev = get_hybrid_communicate_group()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            set_hybrid_communicate_group(prev)
+    return wrapper
+
+
 def build_step(mp: int, pp: int, sharding: int, n_micro: int,
                devices, schedule: str = "1f1b"):
     """Abstract 10B hybrid train step over the given devices."""
@@ -78,6 +101,7 @@ def build_step(mp: int, pp: int, sharding: int, n_micro: int,
     return step, cfg
 
 
+@_restores_hcg
 def run_proof(topology_name: str = "v4:2x4x4", mp: int = 8, pp: int = 4,
               sharding: int = 2, batch: int = 32, seq: int = 2048,
               n_micro: int = 8, budget_bytes: int = V4_HBM_PER_CORE,
@@ -176,6 +200,7 @@ def run_proof(topology_name: str = "v4:2x4x4", mp: int = 8, pp: int = 4,
     return report
 
 
+@_restores_hcg
 def run_longctx_proof(topology_name: str = "v4:2x4x4", mp: int = 2,
                       pp: int = 4, sep: int = 8, dp: int = 1,
                       seq: int = 32768, n_micro: int = 2,
