@@ -316,6 +316,17 @@ def bench_long_context(on_tpu: bool) -> Dict:
             "floor_ms_subtracted": round(_floor_ms(on_tpu), 1)}
 
 
+def _decode_1p3b_cfg():
+    """The shared GPT-1.3B decode-bench config (decode, paged_decode and
+    ragged_serving must measure the SAME model or their numbers stop
+    being comparable)."""
+    from paddle_tpu.models import GPTConfig
+    return GPTConfig(vocab_size=32768, hidden_size=2048,
+                     num_layers=24, num_heads=16, max_seq_len=2048,
+                     dropout=0.0, attn_dropout=0.0, dtype="bfloat16",
+                     use_flash_attention=False, loss_chunk_size=0)
+
+
 def bench_decode(on_tpu: bool) -> Dict:
     """Generation decode throughput: GPT-1.3B greedy decode through the
     jitted StaticKVCache scan (one launch for prefill + all decode
@@ -329,10 +340,7 @@ def bench_decode(on_tpu: bool) -> Dict:
     from paddle_tpu.models import GPTConfig, GPTForCausalLM, gpt_tiny
 
     if on_tpu:
-        cfg = GPTConfig(vocab_size=32768, hidden_size=2048,
-                        num_layers=24, num_heads=16, max_seq_len=2048,
-                        dropout=0.0, attn_dropout=0.0, dtype="bfloat16",
-                        use_flash_attention=False, loss_chunk_size=0)
+        cfg = _decode_1p3b_cfg()
         # r4 sweep: decode is weights-bound and keeps scaling with
         # batch (b32 4.6k -> b128 7.5k tok/s); b256's KV at S=192 still
         # fits but prefill compile cost grows — 128 is the sweet spot
@@ -402,7 +410,13 @@ def bench_decode(on_tpu: bool) -> Dict:
 
     # weight-only int8 decode (r4 verdict weak #4: the int8 path was
     # never wired where weight streaming dominates). Same harness at
-    # the best fp batch; weights stream at half the bytes.
+    # the best fp batch; weights stream at half the bytes. r6: the
+    # whole-program compile is retried through generate()'s CHUNKED
+    # path (per-block programs, models/gpt.py _generate_chunked) when
+    # it dies — the 1.3B int8 monolith reproducibly kills the dev
+    # tunnel's remote-compile transport (r5 BENCH_STAGED entry) — and
+    # if even that fails the sweep falls back to the 350M config
+    # (models.gpt_350m) so a MEASURED int8 number lands at some scale.
     try:
         from paddle_tpu.quantization.quant import (
             convert_to_weight_only_int8)
@@ -419,37 +433,246 @@ def bench_decode(on_tpu: bool) -> Dict:
                         sorted({8, best_b}))
         out["int8_weight_only"] = {"layers_converted": n_conv,
                                    "by_batch": {}}
-        for b8 in int8_batches:
-            ids = jnp.asarray(rng.integers(
-                0, cfg.vocab_size, (b8, prompt)).astype(np.int32))
+
+        def measure_int8(mdl, b8, label_extra=None):
+            ids8 = jnp.asarray(rng.integers(
+                0, mdl.config.vocab_size, (b8, prompt)).astype(np.int32))
+
+            def mk_run(mode):
+                def run8(n):
+                    got = mdl.generate(pt.Tensor(ids8), max_new_tokens=n,
+                                       temperature=0.0, use_jit=True,
+                                       compile_mode=mode)
+                    v = got.value if hasattr(got, "value") else got
+                    np.asarray(v[:, -1])
+                return run8
+
+            # whole-program scan first; if its compile dies (the 1.3B
+            # int8 monolith vs the remote-compile transport), fall back
+            # to the chunked per-block programs — slower launches, but
+            # a number instead of an error blob
+            run8, path = mk_run("whole"), "whole"
+            try:
+                run8(max(1, new_toks // 8))
+            except Exception:
+                run8, path = mk_run("chunked"), "chunked"
+                run8(max(1, new_toks // 8))
+            entry = {"compile_path": path}
+            if label_extra:
+                entry.update(label_extra)
             if on_tpu:
                 n_short = max(1, new_toks // 8)
-                run_n(n_short)
-                run_n(new_toks)
-                dt_short, _ = _timed_windows(lambda: run_n(n_short),
+                run8(new_toks)
+                dt_short, _ = _timed_windows(lambda: run8(n_short),
                                              on_tpu=on_tpu)
-                dt_full, _ = _timed_windows(lambda: run_n(new_toks),
+                dt_full, _ = _timed_windows(lambda: run8(new_toks),
                                             on_tpu=on_tpu)
                 if dt_full <= dt_short:
-                    out["int8_weight_only"]["by_batch"][str(b8)] = {
-                        "error": "timing inverted (session too noisy)"}
-                    continue
+                    entry["error"] = "timing inverted (session too noisy)"
+                    return entry
                 per_tok = (dt_full - dt_short) / (new_toks - n_short)
-                fp = out["by_batch"].get(str(b8), {}).get("tokens_per_s")
-                out["int8_weight_only"]["by_batch"][str(b8)] = {
+                # the fp sweep above ran the PRIMARY model; a scale
+                # fallback would make this a cross-model ratio
+                fp = (None if label_extra else
+                      out["by_batch"].get(str(b8), {}).get("tokens_per_s"))
+                entry.update({
                     "tokens_per_s": round(b8 / per_tok, 1),
                     "ms_per_token": round(per_tok * 1e3, 3),
                     "vs_bf16_same_batch": round(
-                        (b8 / per_tok) / fp, 3) if fp else None}
+                        (b8 / per_tok) / fp, 3) if fp else None})
             else:
-                run_n(new_toks)
-                dt, _ = _timed_windows(lambda: run_n(new_toks),
+                run8(new_toks)
+                dt, _ = _timed_windows(lambda: run8(new_toks),
                                        on_tpu=on_tpu)
-                out["int8_weight_only"]["by_batch"][str(b8)] = {
-                    "tokens_per_s": round(b8 * new_toks / dt, 1)}
+                entry["tokens_per_s"] = round(b8 * new_toks / dt, 1)
+            return entry
+
+        m350_cache = []  # built once, shared across batch sizes
+
+        def fallback_350m():
+            if not m350_cache:
+                from paddle_tpu.models import GPTForCausalLM, gpt_350m
+                m = GPTForCausalLM(gpt_350m(
+                    vocab_size=cfg.vocab_size, dropout=0.0,
+                    attn_dropout=0.0, dtype=cfg.dtype,
+                    use_flash_attention=False))
+                if on_tpu:
+                    _to_bf16_except_norms(m)
+                m.eval()
+                convert_to_weight_only_int8(m)
+                m350_cache.append(m)
+            return m350_cache[0]
+
+        for b8 in int8_batches:
+            try:
+                out["int8_weight_only"]["by_batch"][str(b8)] = \
+                    measure_int8(model, b8)
+            except Exception as e:
+                # both compile paths failed at THIS scale: measure the
+                # 350M config instead (the r5 verdict's explicit ask —
+                # "commit a measured GPT-350M-class int8 curve") and
+                # record the failure next to the stand-in number
+                err = f"{type(e).__name__}: {str(e)[:300]}"
+                try:
+                    out["int8_weight_only"]["by_batch"][str(b8)] = \
+                        measure_int8(fallback_350m(), b8, {
+                            "scale_fallback": "gpt_350m",
+                            "primary_scale_error": err})
+                except Exception as e2:
+                    out["int8_weight_only"]["by_batch"][str(b8)] = {
+                        "error": err,
+                        "fallback_error":
+                            f"{type(e2).__name__}: {str(e2)[:300]}"}
     except Exception as e:  # keep the fp sweep on any int8 failure
         out["int8_weight_only"] = {"error": f"{type(e).__name__}: {e}"}
     return out
+
+
+def bench_paged_decode(on_tpu: bool) -> Dict:
+    """Paged-vs-static decode step time (the tentpole's A/B): the SAME
+    model, prompts and scan harness, dense StaticKVCache vs the
+    block-paged PagedKVCache (ragged paged-attention kernel on TPU,
+    its reference on cpu) — plus the int8-KV variant, which halves the
+    KV bytes that dominate the b128 step (PROFILE_DECODE.json: 5.5 GB
+    of the 8.4 GB/step). Full-length equal-size sequences, so on-chip
+    this isolates the kernel/layout cost; the RAGGED win (skip unused
+    pages + mid-flight admission) is bench_ragged_serving's number."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, gpt_tiny
+
+    if on_tpu:
+        cfg = _decode_1p3b_cfg()
+        batch, prompt, new_toks, page = 128, 128, 64, 64
+    else:
+        cfg = gpt_tiny()
+        batch, prompt, new_toks, page = 2, 8, 8, 8
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        _to_bf16_except_norms(model)
+    model.eval()
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(
+        0, cfg.vocab_size, (batch, prompt)).astype(np.int32))
+
+    def run_n(n, mode):
+        got = model.generate(pt.Tensor(ids), max_new_tokens=n,
+                             temperature=0.0, use_jit=True,
+                             kv_cache=mode, page_size=page)
+        v = got.value if hasattr(got, "value") else got
+        np.asarray(v[:, -1])
+
+    out: Dict = {"metric": "gpt1p3b_paged_decode_ms_per_step_chip"
+                 if on_tpu else "gpt_tiny_paged_decode_cpu_smoke",
+                 "batch": batch, "prompt_len": prompt,
+                 "new_tokens": new_toks, "page_size": page,
+                 "floor_ms_subtracted": round(_floor_ms(on_tpu), 1),
+                 "by_mode": {}}
+    for mode in ("static", "paged", "paged_int8"):
+        if on_tpu:
+            n_short = max(1, new_toks // 8)
+            run_n(n_short, mode)
+            run_n(new_toks, mode)
+            dt_s, _ = _timed_windows(lambda: run_n(n_short, mode),
+                                     on_tpu=on_tpu)
+            dt_f, _ = _timed_windows(lambda: run_n(new_toks, mode),
+                                     on_tpu=on_tpu)
+            if dt_f <= dt_s:
+                dt_s, _ = _timed_windows(lambda: run_n(n_short, mode),
+                                         on_tpu=on_tpu)
+                dt_f, _ = _timed_windows(lambda: run_n(new_toks, mode),
+                                         on_tpu=on_tpu)
+            if dt_f <= dt_s:
+                out["by_mode"][mode] = {"error": "timing inverted twice"}
+                continue
+            per_step = (dt_f - dt_s) / (new_toks - n_short)
+        else:
+            run_n(new_toks, mode)
+            dt, _ = _timed_windows(lambda: run_n(new_toks, mode),
+                                   on_tpu=on_tpu)
+            per_step = dt / new_toks
+        out["by_mode"][mode] = {
+            "ms_per_step": round(per_step * 1e3, 3),
+            "tokens_per_s": round(batch / per_step, 1)}
+    st = out["by_mode"].get("static", {}).get("ms_per_step")
+    pg = out["by_mode"].get("paged", {}).get("ms_per_step")
+    if st and pg:
+        out["paged_vs_static"] = round(pg / st, 3)
+    return out
+
+
+def bench_ragged_serving(on_tpu: bool) -> Dict:
+    """Continuous-batching ragged serving throughput: a mixed-length
+    request stream through the fixed-slot paged decode engine
+    (inference/continuous_batching.py) — admission, eviction and page
+    recycling all on the hot path. tokens/s counts GENERATED tokens
+    only. This is the workload the paging opens: the dense scan cannot
+    admit a new request mid-flight at all."""
+    import paddle_tpu as pt
+    from paddle_tpu.inference import create_decode_engine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, gpt_tiny
+
+    if on_tpu:
+        cfg = _decode_1p3b_cfg()
+        slots, page, max_seq = 32, 64, 1024
+        lens = [64, 96, 128, 192, 256, 384, 512, 640]
+        n_req, new_toks = 64, 64
+    else:
+        cfg = gpt_tiny()
+        slots, page, max_seq = 2, 8, 64
+        lens = [5, 9, 13]
+        n_req, new_toks = 4, 8
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        _to_bf16_except_norms(model)
+    model.eval()
+    rng = np.random.default_rng(0)
+    eng = create_decode_engine(model, num_slots=slots, page_size=page,
+                               max_seq_len=max_seq)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            (lens[i % len(lens)],)).astype(np.int32)
+               for i in range(n_req)]
+    # warm THE MEASURED ENGINE's compiles (jitted prefill/decode are
+    # per-instance closures, so a throwaway engine would compile its
+    # own programs and discard them): run one short request per
+    # distinct prompt bucket + the shared decode step through `eng`
+    # itself, then let it drain — slots and pages all return to free
+    for p in prompts[:len(lens)]:
+        eng.submit(p, max_new_tokens=2)
+    eng.run()
+
+    steps_before = eng.steps
+    t0 = time.perf_counter()
+    rids = [eng.submit(p, max_new_tokens=new_toks) for p in prompts]
+    results = eng.run()
+    wall = time.perf_counter() - t0
+    # the engine's host-driven loop pays one launch+fetch round trip
+    # PER decode step and PER prefill (unlike the scanned decode's
+    # single launch) — subtract the floor per launch, not once, or the
+    # tunneled chip number measures the tunnel (the floor-subtraction
+    # convention every entry follows)
+    timed_steps = eng.steps - steps_before
+    n_launches = timed_steps + len(prompts)
+    dt = max(1e-9, wall - n_launches * _floor_ms(on_tpu) / 1e3)
+    # run() drains per call, so results holds exactly the timed batch
+    gen_tokens = sum(len(results[rid]) - len(p)
+                     for rid, p in zip(rids, prompts))
+    return {"metric": "gpt1p3b_ragged_serving_tokens_per_sec_chip"
+            if on_tpu else "gpt_tiny_ragged_serving_cpu_smoke",
+            "value": round(gen_tokens / dt, 1), "unit": "tokens/s",
+            "requests": n_req, "prompt_lens": lens,
+            "new_tokens_per_req": new_toks, "num_slots": slots,
+            "page_size": page, "decode_steps": timed_steps,
+            "generated_tokens": gen_tokens,
+            "floor_ms_subtracted": round(_floor_ms(on_tpu), 1),
+            "floor_subtracted_launches": n_launches,
+            "note": "mixed-length batch through admit/evict + page "
+                    "recycling; tokens/s counts generated tokens only"}
 
 
 def _serve_latency(prefix, example_inputs, n_runs: int,
@@ -468,7 +691,18 @@ def _serve_latency(prefix, example_inputs, n_runs: int,
       handle-pattern launches in flight, blocked once — the dispatch
       floor amortizes away exactly as in the decode scan, so this
       number moves when the framework changes, not when the tunnel
-      does. This is the serving-throughput figure to compare."""
+      does. This is the serving-throughput figure to compare;
+    - device_ms_per_req (r5 verdict item 5 — reconcile the two serving
+      numbers): per-request DEVICE execution time, measured as the
+      steady-state per-launch time of a long saturated pipeline (3x
+      the pipelined window, one block at the end). With launches
+      continuously in flight the device is the bottleneck, so elapsed
+      / N converges on device execution per request; the per-call
+      tunnel round trip overlaps and contributes only 1/N of one
+      floor. This is THE framework number; p50_above_floor still
+      carries the tunnel's per-call jitter (subtracting the p50 floor
+      leaves its variance), which is why it can sit ~9x above this —
+      see BENCH_STAGED.json conventions.serving_reconciliation."""
     from paddle_tpu.inference import Config, create_predictor
 
     import jax
@@ -499,14 +733,26 @@ def _serve_latency(prefix, example_inputs, n_runs: int,
         pred.run()
     jax.block_until_ready(pred._outputs)
     dt = time.perf_counter() - t0
+    # device execution per request: a 3x-longer saturated window so the
+    # single end-of-window block and the warmup launch are amortized to
+    # <1% — steady-state per-launch time == device time when the queue
+    # never drains
+    n_dev = 3 * n_pipe
+    t0 = time.perf_counter()
+    for _ in range(n_dev):
+        pred.run()
+    jax.block_until_ready(pred._outputs)
+    dt_dev = time.perf_counter() - t0
     return {"p50_wall_ms": round(float(np.percentile(lat, 50)), 3),
             "p99_wall_ms": round(float(np.percentile(lat, 99)), 3),
             "p50_above_floor_ms": round(max(
                 0.0, float(np.percentile(lat, 50)) - floor_ms), 3),
             "pipelined_requests_per_s": round(n_pipe / dt, 1),
             "pipelined_ms_per_req": round(dt / n_pipe * 1e3, 3),
+            "device_ms_per_req": round(dt_dev / n_dev * 1e3, 3),
             "floor_ms_subtracted": round(floor_ms, 3),
-            "runs": n_runs, "pipelined_runs": n_pipe}
+            "runs": n_runs, "pipelined_runs": n_pipe,
+            "device_window_runs": n_dev}
 
 
 def bench_inference(on_tpu: bool, workdir: str = "/tmp/pt_bench_infer"
@@ -581,6 +827,8 @@ def run_staged(on_tpu: bool) -> Dict:
                      ("bert_base", bench_bert_base),
                      ("long_context", bench_long_context),
                      ("decode", bench_decode),
+                     ("paged_decode", bench_paged_decode),
+                     ("ragged_serving", bench_ragged_serving),
                      ("inference", bench_inference)):
         t0 = time.time()
         try:

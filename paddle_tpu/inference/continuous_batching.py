@@ -1,0 +1,431 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+The serving-side half of the paged decode subsystem (the kernel half is
+`ops/pallas/paged_attention.py`, the model half `models/gpt.py`
+PagedKVCache): a fixed-slot decode batch that admits and evicts
+sequences MID-FLIGHT, recycling completed sequences' KV pages to newly
+admitted ones. This is what the paging buys beyond ragged bandwidth —
+the dense StaticKVCache path must run every co-batched request for the
+longest request's duration (or re-prefill), while here a finished slot
+is refilled on the next step without touching the other slots' compiled
+program.
+
+Design (TPU-native fixed shapes; paper basis: *Ragged Paged Attention*,
+PAPERS.md — the same pool/page-table layout its kernel consumes):
+
+- DEVICE state is fully static-shaped: per-layer page pools, one
+  ``page_table [num_slots, max_pages]``, ``seq_lens [num_slots]``, and
+  the per-slot current token. ONE compiled decode step serves the
+  engine's whole lifetime; prefill compiles once per prompt bucket.
+- HOST state is the scheduler: a free-list `PageAllocator`, the wait
+  queue, and per-slot request bookkeeping. Admission allocates
+  ceil(capacity/page) pages and runs a bucket-padded prefill whose
+  right padding is redirected to the pool's reserved scratch page
+  (models/gpt.py paged_kv_append valid_len), so padded prompts never
+  touch real pages; eviction returns the pages to the free list and
+  parks the slot on the scratch page at length 0 (an empty slot
+  attends nothing and produces defined zeros — see
+  paged_attention_reference), so a freed page can be handed to the
+  next request without any cross-slot read hazard.
+- Inactive slots still ride through the fixed-shape decode step (their
+  writes land on the scratch page and their lengths are reset on the
+  host); that is the fixed-slot contract that keeps the hot loop at
+  one compiled program.
+
+Reference analog: the inference engine's multi-stream serving loop
+(`inference/api/analysis_predictor.cc` + TensorRT's enqueue batching),
+rebuilt as a scheduler over one jitted step instead of a stream pool.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PageAllocator", "DecodeRequest", "ContinuousBatchingEngine",
+           "create_decode_engine"]
+
+
+class PageAllocator:
+    """Host-side free-list allocator over the shared page pool.
+
+    Pages are plain ints in [0, num_pages); the pool's reserved scratch
+    page (index num_pages in the device arrays) is never handed out.
+    `alloc` is all-or-nothing so a request that does not fit leaves the
+    free list untouched (no partial reservations to unwind)."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages))
+        self._owned: Dict[int, List[int]] = {}
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, owner: int, n: int) -> Optional[List[int]]:
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._owned.setdefault(owner, []).extend(pages)
+        return pages
+
+    def free(self, owner: int) -> int:
+        pages = self._owned.pop(owner, [])
+        for p in pages:
+            if p in self._free:  # double free = scheduler bug
+                raise RuntimeError(f"page {p} double-freed")
+        self._free.extend(pages)
+        return len(pages)
+
+    def check_no_leak(self) -> None:
+        if self._owned or len(self._free) != self.num_pages:
+            raise RuntimeError(
+                f"page leak: {sum(map(len, self._owned.values()))} owned "
+                f"by {sorted(self._owned)} with "
+                f"{len(self._free)}/{self.num_pages} free")
+
+
+@dataclasses.dataclass
+class DecodeRequest:
+    """One generation request in the engine."""
+    req_id: int
+    prompt: np.ndarray                # [len] int32
+    max_new_tokens: int
+    eos_token: Optional[int] = None
+    generated: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    done: bool = False
+
+    @property
+    def tokens(self) -> np.ndarray:
+        return np.concatenate([self.prompt,
+                               np.asarray(self.generated, np.int32)])
+
+
+class ContinuousBatchingEngine:
+    """Fixed-slot continuous batching over one jitted paged decode step.
+
+    ``num_pages`` sizes the shared pool; with
+    num_pages < num_slots * max_pages_per_seq the engine oversubscribes
+    slots against real memory and admission blocks on the free list —
+    the page-recycling regime the tests pin. Greedy decoding (the
+    deterministic serving mode; sampling belongs to generate())."""
+
+    def __init__(self, model, num_slots: int = 4, page_size: int = 64,
+                 max_seq_len: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 kv_int8: bool = False,
+                 prompt_buckets: Sequence[int] = ()):
+        import jax.numpy as jnp
+
+        from ..nn.layer import functional_state
+        from ..models.gpt import paged_cache_create
+
+        self.model = model
+        model.eval()
+        cfg = model.config
+        self.cfg = cfg
+        self.page_size = int(page_size)
+        self.num_slots = int(num_slots)
+        self.max_seq_len = int(max_seq_len or cfg.max_seq_len)
+        self.max_pages = -(-self.max_seq_len // self.page_size)
+        self.num_pages = int(num_pages if num_pages is not None
+                             else num_slots * self.max_pages)
+        self.kv_int8 = bool(kv_int8)
+        if not prompt_buckets:
+            bucket, prompt_buckets = self.page_size, []
+            while bucket < self.max_seq_len:
+                prompt_buckets.append(bucket)
+                bucket *= 2
+            prompt_buckets.append(self.max_seq_len)
+        self.prompt_buckets = sorted(set(int(x) for x in prompt_buckets))
+
+        self.allocator = PageAllocator(self.num_pages)
+        self._scratch = self.num_pages  # reserved page index
+        dt = functional_state(model)["params"]["gpt.wte.weight"].dtype
+        nh, hd, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
+        self._nl = nl
+        # one DISTINCT pool per layer (not nl references to one array:
+        # the jitted step donates the pool buffers, and donating the
+        # same buffer for two arguments is an error)
+        protos = [paged_cache_create(
+            1, self.num_pages, self.page_size, nh, hd, dt,
+            self.max_pages, quantized=self.kv_int8) for _ in range(nl)]
+        self._pools = {
+            "k": [p.k_pages for p in protos],
+            "v": [p.v_pages for p in protos],
+            "ks": [p.k_scale for p in protos],
+            "vs": [p.v_scale for p in protos],
+        }
+        # host-owned scheduler state
+        self._table = np.full((self.num_slots, self.max_pages),
+                              self._scratch, np.int32)
+        self._lens = np.zeros((self.num_slots,), np.int32)
+        self._cur = np.zeros((self.num_slots,), np.int32)
+        self._slots: List[Optional[DecodeRequest]] = \
+            [None] * self.num_slots
+        self._queue: List[DecodeRequest] = []
+        self._finished: Dict[int, DecodeRequest] = {}
+        self._next_id = 0
+        self._jnp = jnp
+        self._decode_jit = None
+        self._prefill_jit = None
+        self._state_cache = None
+        self.steps = 0
+
+    # -- request lifecycle -------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               eos_token: Optional[int] = None) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
+                f"max_seq_len {self.max_seq_len}")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1 (the prefill "
+                             "itself produces the first token)")
+        if len(prompt) > self.prompt_buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest "
+                f"prompt bucket {self.prompt_buckets[-1]}")
+        need = -(-(len(prompt) + max_new_tokens) // self.page_size)
+        if need > self.num_pages:
+            # would block the FIFO head forever — no amount of
+            # recycling frees pages that never existed
+            raise ValueError(
+                f"request needs {need} pages but the pool has only "
+                f"{self.num_pages}; raise num_pages or shrink the "
+                f"request")
+        req = DecodeRequest(self._next_id, prompt, int(max_new_tokens),
+                            eos_token)
+        self._next_id += 1
+        self._queue.append(req)
+        return req.req_id
+
+    def result(self, req_id: int, pop: bool = False
+               ) -> Optional[np.ndarray]:
+        req = (self._finished.pop(req_id, None) if pop
+               else self._finished.get(req_id))
+        return None if req is None else req.tokens
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    # -- jitted device programs -------------------------------------------
+
+    def _caches(self, pools, table, lens):
+        from ..models.gpt import PagedKVCache
+        return [PagedKVCache(pools["k"][i], pools["v"][i],
+                             pools["ks"][i], pools["vs"][i],
+                             table, lens) for i in range(self._nl)]
+
+    def _fresh_state(self, refresh: bool = False):
+        """Model functional state (params AND buffers — converted
+        layers hold int8 weights as buffers) for the jitted calls.
+        Re-read at every ADMISSION (refresh=True) so post-construction
+        weight mutation (set_state_dict, convert_to_weight_only_int8)
+        is served, not silently ignored — a structural change simply
+        retraces via the new argument pytree (the r5 stale-cache
+        lesson). The per-token decode step reuses the cached dict:
+        rebuilding hundreds of entries per generated token is pure
+        host overhead on the hot path."""
+        if refresh or self._state_cache is None:
+            from ..nn.layer import functional_state
+            self._state_cache = functional_state(self.model)
+        return self._state_cache
+
+    def _build_decode(self):
+        import jax
+
+        from ..autograd.engine import no_grad
+        from ..nn.layer import bind_state
+        from ..tensor import Tensor
+
+        def raw(t):
+            return t.value if isinstance(t, Tensor) else t
+
+        def step(state, pools, table, lens, tokens):
+            caches = self._caches(pools, table, lens)
+            with bind_state(self.model, state), no_grad():
+                logits, nc = self.model.forward(Tensor(tokens[:, None]),
+                                                caches=caches)
+            nxt = self._jnp.argmax(raw(logits)[:, -1], -1).astype(
+                self._jnp.int32)
+            new_pools = {
+                "k": [raw(c.k_pages) for c in nc],
+                "v": [raw(c.v_pages) for c in nc],
+                "ks": [raw(c.k_scale) if self.kv_int8 else None
+                       for c in nc],
+                "vs": [raw(c.v_scale) if self.kv_int8 else None
+                       for c in nc],
+            }
+            return nxt, new_pools, raw(nc[0].seq_lens)
+
+        # donate the pools: the append scatters then update the pool
+        # buffers IN PLACE instead of materializing a fresh copy of
+        # every per-layer pool each token (~GBs/step at serving scale,
+        # plus 2x peak KV memory); the engine always adopts the
+        # returned pools, so the donated buffers are never reused.
+        # (On CPU donation is ignored with a warning — harmless.)
+        return jax.jit(step, donate_argnums=(1,))
+
+    def _build_prefill(self):
+        """One jitted prefill; jax.jit's shape-keyed cache compiles it
+        once per prompt bucket (the bucket IS the ids shape)."""
+        import jax
+
+        from ..autograd.engine import no_grad
+        from ..nn.layer import bind_state
+        from ..tensor import Tensor
+
+        def raw(t):
+            return t.value if isinstance(t, Tensor) else t
+
+        def prefill(state, pools, trow, plen, ids):
+            caches = self._caches(
+                pools, trow, self._jnp.zeros((1,), self._jnp.int32))
+            with bind_state(self.model, state), no_grad():
+                logits, nc = self.model.forward(Tensor(ids), caches=caches,
+                                                prefill_lens=plen)
+            nxt = self._jnp.argmax(
+                raw(logits)[0, plen[0] - 1], -1).astype(self._jnp.int32)
+            new_pools = {
+                "k": [raw(c.k_pages) for c in nc],
+                "v": [raw(c.v_pages) for c in nc],
+                "ks": [raw(c.k_scale) if self.kv_int8 else None
+                       for c in nc],
+                "vs": [raw(c.v_scale) if self.kv_int8 else None
+                       for c in nc],
+            }
+            return nxt, new_pools
+
+        return jax.jit(prefill, donate_argnums=(1,))
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if n <= b:
+                return b
+        return self.prompt_buckets[-1]
+
+    def _admit(self) -> None:
+        jnp = self._jnp
+        for slot in range(self.num_slots):
+            if not self._queue or self._slots[slot] is not None:
+                continue
+            req = self._queue[0]
+            capacity = len(req.prompt) + req.max_new_tokens
+            need = -(-capacity // self.page_size)
+            pages = self.allocator.alloc(req.req_id, need)
+            if pages is None:
+                break  # FIFO: don't starve the head request
+            self._queue.pop(0)
+            row = np.full((self.max_pages,), self._scratch, np.int32)
+            row[:need] = pages
+            self._table[slot] = row
+            bucket = self._bucket(len(req.prompt))
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :len(req.prompt)] = req.prompt
+            if self._prefill_jit is None:
+                self._prefill_jit = self._build_prefill()
+            try:
+                nxt, pools = self._prefill_jit(
+                    self._fresh_state(refresh=True), self._pools,
+                    jnp.asarray(row[None]),
+                    jnp.asarray([len(req.prompt)], jnp.int32),
+                    jnp.asarray(ids))
+            except Exception:
+                # unwind the half-applied admission so a prefill
+                # failure (e.g. a remote-compile transport error on a
+                # new prompt bucket) is retryable instead of losing
+                # the request and leaking its pages: free the pages,
+                # park the slot, put the request back at the queue
+                # head, then surface the error. (If the failure hit
+                # AFTER execution began, the donated pool buffers may
+                # be gone with it — compile-time failures, the
+                # documented class, leave them untouched.)
+                self.allocator.free(req.req_id)
+                self._table[slot] = self._scratch
+                self._queue.insert(0, req)
+                raise
+            self._pools = pools
+            self._lens[slot] = len(req.prompt)
+            self._cur[slot] = int(nxt)
+            req.slot = slot
+            req.generated.append(int(nxt))
+            self._slots[slot] = req
+            self._maybe_finish(slot)
+
+    def _maybe_finish(self, slot: int) -> None:
+        req = self._slots[slot]
+        if req is None:
+            return
+        hit_eos = (req.eos_token is not None and req.generated and
+                   req.generated[-1] == req.eos_token)
+        if len(req.generated) >= req.max_new_tokens or hit_eos:
+            req.done = True
+            self._finished[req.req_id] = req
+            self.allocator.free(req.req_id)
+            self._table[slot] = self._scratch  # park on scratch page
+            self._lens[slot] = 0
+            self._cur[slot] = 0
+            self._slots[slot] = None
+
+    def step(self) -> int:
+        """Admit what fits, run ONE fixed-shape decode step, evict what
+        finished. Returns the number of still-active slots."""
+        jnp = self._jnp
+        self._admit()
+        if self.num_active == 0:
+            return 0
+        if self._decode_jit is None:
+            self._decode_jit = self._build_decode()
+        active = np.array([r is not None for r in self._slots])
+        nxt, pools, lens_new = self._decode_jit(
+            self._fresh_state(), self._pools,
+            jnp.asarray(self._table), jnp.asarray(self._lens),
+            jnp.asarray(self._cur))
+        self._pools = pools
+        nxt = np.asarray(nxt)
+        # inactive slots wrote to the scratch page; pin their length
+        # back to 0 (empty = attends nothing, defined zeros)
+        self._lens = np.where(active, np.asarray(lens_new), 0).astype(
+            np.int32)
+        self.steps += 1
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            req.generated.append(int(nxt[slot]))
+            self._cur[slot] = int(nxt[slot])
+            self._maybe_finish(slot)
+        return self.num_active
+
+    def run(self, max_steps: int = 100000) -> Dict[int, np.ndarray]:
+        """Drive until queue and slots drain; returns {req_id: tokens}
+        for everything finished so far and DRAINS the finished store
+        (a long-running engine must not accumulate past results —
+        callers polling step() themselves use result(id, pop=True))."""
+        steps = 0
+        while self._queue or self.num_active:
+            before = (len(self._queue), self.num_active)
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"engine did not drain in {max_steps} "
+                                   f"steps (state {before})")
+        self.allocator.check_no_leak()
+        out = {rid: req.tokens for rid, req in self._finished.items()}
+        self._finished.clear()
+        return out
+
+
+def create_decode_engine(model, **kwargs) -> ContinuousBatchingEngine:
+    """Serving-path entry (mirrors inference.create_predictor): build a
+    continuous-batching decode engine over a causal-LM layer."""
+    return ContinuousBatchingEngine(model, **kwargs)

@@ -1,4 +1,6 @@
 """Flagship model families (GPT/ERNIE-class LLMs, BERT)."""
 
 from .gpt import (GPTAttention, GPTBlock, GPTConfig, GPTForCausalLM, GPTMLP,
-                  GPTModel, ernie_10b, gpt_125m, gpt_1p3b, gpt_tiny)
+                  GPTModel, PagedKVCache, StaticKVCache, ernie_10b,
+                  gpt_125m, gpt_1p3b, gpt_350m, gpt_tiny,
+                  paged_cache_create, paged_kv_append)

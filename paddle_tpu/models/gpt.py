@@ -115,6 +115,16 @@ def gpt_125m(**kw):
     return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
 
 
+def gpt_350m(**kw):
+    """GPT-3 350M (BASELINE.md family): the largest decode config whose
+    weight-only-int8 generate program compiles under the dev tunnel's
+    remote-compile transport limit (the 1.3B int8 compile reproducibly
+    kills it — BENCH_STAGED.json r5 int8_weight_only); bench_all's int8
+    decode falls back here when 1.3B fails even on the chunked path."""
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                     max_seq_len=2048, **kw)
+
+
 def gpt_1p3b(**kw):
     return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
                      max_seq_len=2048, **kw)
@@ -137,6 +147,117 @@ class StaticKVCache(NamedTuple):
     k: Any
     v: Any
     pos: Any
+
+
+class PagedKVCache(NamedTuple):
+    """Block-paged per-layer KV cache for ragged fixed-shape decode.
+
+    KV lives in a pool of fixed-size pages (``k_pages``/``v_pages``:
+    [num_pages + 1, page_size, H, D]; the LAST page is a reserved
+    scratch page that masked/inactive writes land on, so recycled pages
+    are never touched by slots that don't own them). ``page_table``
+    ([B, max_pages] int32) maps each sequence's logical page index to a
+    pool page; ``seq_lens`` ([B] int32) is each sequence's valid
+    length. All shapes are static, so prefill + decode compile into one
+    scanned program exactly like StaticKVCache — but attention walks
+    only ceil(len/page) pages per sequence (ops/pallas/
+    paged_attention.py), and a host-side allocator can hand pages from
+    completed sequences to newly admitted ones mid-flight
+    (inference/continuous_batching.py). int8 mode stores pages as int8
+    with per-(position, head) abs-max scales (``k_scale``/``v_scale``:
+    [num_pages + 1, page_size, H]; quantization/quant.py quantize_kv),
+    halving the dominant decode HBM category."""
+
+    k_pages: Any
+    v_pages: Any
+    k_scale: Any  # None when pages are float
+    v_scale: Any
+    page_table: Any
+    seq_lens: Any
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[1]
+
+
+def paged_cache_create(batch: int, num_pages: int, page_size: int,
+                       num_heads: int, head_dim: int, dtype,
+                       max_pages_per_seq: int, quantized: bool = False,
+                       page_table=None, seq_lens=None) -> PagedKVCache:
+    """Zero-filled pool (+1 reserved scratch page) with an optional
+    pre-assigned page table; the default table hands sequence ``i``
+    pages ``[i*mp, (i+1)*mp)`` contiguously (the single-request
+    generate() layout — the continuous-batching engine supplies its
+    allocator-managed table instead)."""
+    kv_dtype = jnp.int8 if quantized else dtype
+    shape = (num_pages + 1, page_size, num_heads, head_dim)
+    k_pages = jnp.zeros(shape, kv_dtype)
+    v_pages = jnp.zeros(shape, kv_dtype)
+    if quantized:
+        k_scale = jnp.zeros(shape[:3], jnp.float32)
+        v_scale = jnp.zeros(shape[:3], jnp.float32)
+    else:
+        k_scale = v_scale = None
+    if page_table is None:
+        page_table = jnp.arange(
+            batch * max_pages_per_seq,
+            dtype=jnp.int32).reshape(batch, max_pages_per_seq)
+    if seq_lens is None:
+        seq_lens = jnp.zeros((batch,), jnp.int32)
+    return PagedKVCache(k_pages, v_pages, k_scale, v_scale,
+                        page_table, seq_lens)
+
+
+def paged_kv_append(cache: PagedKVCache, k, v, valid_len=None):
+    """Write ``s`` new tokens per sequence at positions seq_lens ..
+    seq_lens+s-1 through the page table (one scatter per pool — fixed
+    shapes, jit/scan-safe) and advance the lengths.
+
+    ``valid_len`` ([B] int32, optional): ragged prefill — only the
+    first valid_len[i] of the s tokens are real; the rest (right
+    padding) are redirected to the reserved scratch page and the
+    length advances by valid_len, so padded prompts never pollute a
+    sequence's pages."""
+    b, s = k.shape[:2]
+    page = cache.page_size
+    mp = cache.page_table.shape[1]
+    scratch = cache.k_pages.shape[0] - 1
+    pos = cache.seq_lens[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+    if valid_len is None:
+        valid = None
+        new_lens = cache.seq_lens + s
+    else:
+        valid = jnp.arange(s, dtype=jnp.int32)[None] < valid_len[:, None]
+        new_lens = cache.seq_lens + valid_len.astype(jnp.int32)
+    pidx = jnp.clip(pos // page, 0, mp - 1)
+    off = pos % page
+    pages = jnp.take_along_axis(cache.page_table, pidx, axis=1)
+    # over-capacity positions (pos beyond the table's mp*page) go to
+    # the scratch page instead of silently overwriting the last real
+    # page; lengths clamp below so attention never reads past what was
+    # actually stored. In-tree callers size pools so this never fires
+    # (generate: total = prompt + max_new; engine: admission checks
+    # capacity) — this bounds the public-API failure mode.
+    overflow = pos >= mp * page
+    pages = jnp.where(overflow, scratch, pages)
+    off = jnp.where(overflow, 0, off)
+    if valid is not None:
+        pages = jnp.where(valid, pages, scratch)
+        off = jnp.where(valid, off, 0)
+    new_lens = jnp.minimum(new_lens, mp * page)
+
+    def put(pool, scales, val):
+        if scales is None:
+            return pool.at[pages, off].set(val.astype(pool.dtype)), None
+        from ..quantization.quant import quantize_kv
+        qv, sc = quantize_kv(val)
+        return (pool.at[pages, off].set(qv),
+                scales.at[pages, off].set(sc))
+
+    k_pages, k_scale = put(cache.k_pages, cache.k_scale, k)
+    v_pages, v_scale = put(cache.v_pages, cache.v_scale, v)
+    return PagedKVCache(k_pages, v_pages, k_scale, v_scale,
+                        cache.page_table, new_lens)
 
 
 def _remat_block(block, x):
@@ -180,7 +301,7 @@ class GPTAttention(Layer):
         self.attn_dropout_p = c.attn_dropout
         self.use_flash = c.use_flash_attention
 
-    def forward(self, x, cache=None, use_cache=False):
+    def forward(self, x, cache=None, use_cache=False, prefill_len=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)  # [b, s, 3h] sharded over mp on last dim
         qkv = F["reshape"](qkv, (b, s, 3, self.num_heads, self.head_dim))
@@ -188,6 +309,10 @@ class GPTAttention(Layer):
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
         new_cache = None
+        if use_cache and isinstance(cache, PagedKVCache):
+            # Ragged paged decode path: append through the page table,
+            # attend over only the pages each sequence owns.
+            return self._decode_paged(q, k, v, cache, b, s, prefill_len)
         if use_cache and isinstance(cache, StaticKVCache):
             # Fixed-shape decode path (scan/jit-able): write the new k/v
             # at pos into the preallocated buffers and attend over the
@@ -254,6 +379,59 @@ class GPTAttention(Layer):
         out = self.out_proj(out)
         return out, StaticKVCache(k_buf, v_buf, cache.pos + s)
 
+    def _decode_paged(self, q, k, v, cache, b, s, prefill_len=None):
+        """Paged decode/prefill: k/v append through the page table
+        (ragged right-padding redirected to the scratch page), then
+
+        - s == 1 (decode): the ragged paged-attention op — the Pallas
+          page-walk kernel on TPU, its dense-gather reference on the
+          CPU fast lane (ops/pallas/paged_attention.py);
+        - s > 1 with ``prefill_len`` (scheduler/generate prefill, which
+          guarantees a FRESH slot — seq_lens == 0 before the chunk):
+          dense causal attention over THIS chunk's k/v only. Causal +
+          right padding means valid tokens attend exactly their own
+          prefix; padded tokens' outputs are discarded by the caller
+          and their KV never reaches a real page.
+        - s > 1 without ``prefill_len`` (public forward() continuation
+          against a possibly NON-empty cache): the reference paged
+          attention with per-sequence q_offsets — it attends the full
+          stored prefix plus the chunk, so multi-chunk appends are
+          correct instead of silently chunk-local.
+
+        Prefill attends the un-quantized k/v even in int8 mode (exact,
+        and free — the dense path already has them in registers);
+        decode reads back the quantized pages, which is the lossy step
+        the int8 parity tests bound."""
+        old_lens = cache.seq_lens
+        if prefill_len is None:
+            new_cache = dispatch.call_fn(
+                lambda c, kk, vv: tuple(paged_kv_append(c, kk, vv)),
+                "paged_kv_append", True, (cache, k, v), {})
+        else:
+            new_cache = dispatch.call_fn(
+                lambda c, kk, vv, pl_: tuple(paged_kv_append(
+                    c, kk, vv, valid_len=pl_)),
+                "paged_kv_append", True, (cache, k, v, prefill_len), {})
+        new_cache = PagedKVCache(*new_cache)
+        if s == 1:
+            out = F["paged_attention"](
+                q, new_cache.k_pages, new_cache.v_pages,
+                new_cache.page_table, new_cache.seq_lens,
+                k_scale=new_cache.k_scale, v_scale=new_cache.v_scale)
+        elif prefill_len is not None:
+            out = F["scaled_dot_product_attention"](
+                q, k, v, is_causal=True, dropout_p=0.0,
+                training=False, use_flash=bool(self.use_flash))
+        else:
+            out = F["paged_attention"](
+                q, new_cache.k_pages, new_cache.v_pages,
+                new_cache.page_table, new_cache.seq_lens,
+                k_scale=new_cache.k_scale, v_scale=new_cache.v_scale,
+                q_offsets=old_lens)
+        out = F["reshape"](out, (b, s, self.num_heads * self.head_dim))
+        out = self.out_proj(out)
+        return out, new_cache
+
 
 class GPTMLP(Layer):
     def __init__(self, config: GPTConfig):
@@ -291,9 +469,10 @@ class GPTBlock(Layer):
             self.mlp = GPTMLP(config)
         self.dropout = Dropout(config.dropout)
 
-    def forward(self, x, cache=None, use_cache=False):
+    def forward(self, x, cache=None, use_cache=False, prefill_len=None):
         if use_cache:
-            a, new_cache = self.attn(self.ln_1(x), cache, use_cache=True)
+            a, new_cache = self.attn(self.ln_1(x), cache, use_cache=True,
+                                     prefill_len=prefill_len)
             x = x + self.dropout(a)
             x = x + self.dropout(self.mlp(self.ln_2(x)))
             return x, new_cache
@@ -325,16 +504,17 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                use_cache=False):
+                use_cache=False, prefill_lens=None):
         if self._remat_names is not None:
             from ..core.offload import override_remat_saved_names
             with override_remat_saved_names(self._remat_names):
                 return self._forward(input_ids, position_ids, caches,
-                                     use_cache)
-        return self._forward(input_ids, position_ids, caches, use_cache)
+                                     use_cache, prefill_lens)
+        return self._forward(input_ids, position_ids, caches, use_cache,
+                             prefill_lens)
 
     def _forward(self, input_ids, position_ids=None, caches=None,
-                 use_cache=False):
+                 use_cache=False, prefill_lens=None):
         use_cache = use_cache or caches is not None
         b, s = input_ids.shape
         if position_ids is None:
@@ -342,11 +522,20 @@ class GPTModel(Layer):
             offset = 0
             if caches is not None and caches[0] is not None:
                 c0 = caches[0]
-                offset = c0.pos if isinstance(c0, StaticKVCache) \
-                    else c0[0].shape[1]
+                if isinstance(c0, StaticKVCache):
+                    offset = c0.pos
+                elif isinstance(c0, PagedKVCache):
+                    # ragged: each sequence continues from ITS length
+                    lens = c0.seq_lens
+                    offset = F["unsqueeze"](
+                        lens if isinstance(lens, Tensor) else Tensor(lens),
+                        1)
+                else:
+                    offset = c0[0].shape[1]
                 position_ids = position_ids + offset
-            position_ids = F["expand"](
-                F["unsqueeze"](position_ids, 0), (b, s))
+            if len(position_ids.shape) == 1:
+                position_ids = F["expand"](
+                    F["unsqueeze"](position_ids, 0), (b, s))
         x = self.wte(input_ids) + self.wpe(position_ids)
         # shard activations: batch over dp(+sharding), seq over sep
         x = _constrain(x, ("dp", "sharding"), "sep", None)
@@ -360,7 +549,8 @@ class GPTModel(Layer):
         new_caches = [] if use_cache else None
         for i, block in enumerate(self.h):
             if use_cache:
-                x, nc = block(x, caches[i], use_cache=True)
+                x, nc = block(x, caches[i], use_cache=True,
+                              prefill_len=prefill_lens)
                 new_caches.append(nc)
             elif self.config.remat and not hasattr(block.mlp, "aux_loss") \
                     and i % self.config.remat_every == 0:
@@ -478,9 +668,10 @@ class GPTForCausalLM(Layer):
                                 (hidden, labels, *params), {})
 
     def forward(self, input_ids, labels=None, position_ids=None,
-                caches=None):
+                caches=None, prefill_lens=None):
         if caches is not None:
-            hidden, new_caches = self.gpt(input_ids, position_ids, caches)
+            hidden, new_caches = self.gpt(input_ids, position_ids, caches,
+                                          prefill_lens=prefill_lens)
             return self.logits(hidden), new_caches
         hidden = self.gpt(input_ids, position_ids)
         if labels is None:
@@ -505,18 +696,51 @@ class GPTForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens: int = 20,
                  temperature: float = 1.0, top_k: Optional[int] = None,
-                 key=None, use_jit: bool = False):
+                 key=None, use_jit: bool = False,
+                 kv_cache: str = "static", page_size: int = 64,
+                 compile_mode: str = "whole"):
         """Greedy/top-k sampling with kv cache. ``use_jit`` compiles the
         WHOLE generation (prefill + lax.scan decode over a StaticKVCache)
         into one device launch — the serving hot path; the eager loop
-        stays as the debuggable reference."""
+        stays as the debuggable reference.
+
+        ``kv_cache``: "static" (dense preallocated buffers), "paged"
+        (block-paged pool + page table — the ragged decode path,
+        identical greedy tokens, pinned in tests/test_paged_attention),
+        or "paged_int8" (int8 KV pages, half the streamed KV bytes).
+        Paged modes require ``use_jit``. ``compile_mode``: "whole" (one
+        program) or "chunked" — compile ONE per-block decode function
+        (the uniform blocks share it) plus small embed/head programs,
+        for models whose whole-generate compile exceeds the remote-
+        compile transport (the 1.3B int8 failure in BENCH_STAGED.json);
+        slower to launch, but every component program is ~num_layers x
+        smaller."""
         import jax
         from ..core.rng import next_key
         from ..tensor import Tensor
 
+        if kv_cache not in ("static", "paged", "paged_int8"):
+            raise ValueError(f"unknown kv_cache mode {kv_cache!r}")
+        if compile_mode not in ("whole", "chunked"):
+            raise ValueError(f"unknown compile_mode {compile_mode!r}")
+        if kv_cache != "static" and not use_jit:
+            raise ValueError("paged kv_cache requires use_jit=True")
+        if compile_mode == "chunked" and not use_jit:
+            raise ValueError("compile_mode='chunked' requires "
+                             "use_jit=True (it IS a compile strategy)")
+        if kv_cache != "static" and compile_mode == "chunked":
+            raise ValueError(
+                "compile_mode='chunked' decodes over the dense "
+                "StaticKVCache only (its per-block programs exist to "
+                "shrink compiles, not to change the cache layout)")
+        if use_jit and compile_mode == "chunked" and max_new_tokens > 0:
+            return self._generate_chunked(input_ids, max_new_tokens,
+                                          temperature, top_k, key)
         if use_jit and max_new_tokens > 0:
             return self._generate_jit(input_ids, max_new_tokens,
-                                      temperature, top_k, key)
+                                      temperature, top_k, key,
+                                      kv_cache=kv_cache,
+                                      page_size=page_size)
         if max_new_tokens <= 0:
             return input_ids
         self.eval()
@@ -548,11 +772,12 @@ class GPTForCausalLM(Layer):
         return F["concat"](out_ids, axis=1)
 
     def _generate_jit(self, input_ids, max_new_tokens, temperature, top_k,
-                      key):
+                      key, kv_cache: str = "static", page_size: int = 64):
         """One-launch generation: prefill writes the prompt's KV into
-        preallocated buffers, then lax.scan runs fixed-shape decode steps
-        (TPU-native replacement for the reference inference engine's
-        decoder loop — no Python between tokens)."""
+        preallocated buffers (dense or block-paged), then lax.scan runs
+        fixed-shape decode steps (TPU-native replacement for the
+        reference inference engine's decoder loop — no Python between
+        tokens)."""
         import jax
 
         from ..autograd.engine import no_grad
@@ -572,16 +797,43 @@ class GPTForCausalLM(Layer):
         if key_raw is None:
             key_raw = next_key()
         temp, tk = float(temperature), top_k
+        pages_per_seq = -(-total // page_size)
 
         def raw(t):
             return t.value if isinstance(t, Tensor) else t
 
+        def raw_cache(c):
+            if isinstance(c, StaticKVCache):
+                return StaticKVCache(raw(c.k), raw(c.v), raw(c.pos))
+            return PagedKVCache(*[None if f is None else raw(f)
+                                  for f in c])
+
+        def make_caches():
+            if kv_cache == "static":
+                return [StaticKVCache(jnp.zeros((b, total, nh, hd), dt),
+                                      jnp.zeros((b, total, nh, hd), dt),
+                                      jnp.asarray(0, jnp.int32))
+                        for _ in range(nl)]
+            return [paged_cache_create(
+                b, b * pages_per_seq, page_size, nh, hd, dt,
+                pages_per_seq, quantized=(kv_cache == "paged_int8"))
+                for _ in range(nl)]
+
         def fwd(params, ids, caches):
+            # paged prefill chunks (s > 1) pass an explicit full-length
+            # prefill_lens: generate() always starts from a FRESH pool,
+            # so the chunk-local dense fast path applies (forward()
+            # without it assumes a possibly non-empty cache and takes
+            # the general full-prefix path)
+            plens = None
+            if kv_cache != "static" and ids.shape[1] > 1:
+                plens = jnp.full((ids.shape[0],), ids.shape[1],
+                                 jnp.int32)
             with bind_state(self, {"params": params, "buffers": {}}), \
                     no_grad():
-                logits, nc = self.forward(Tensor(ids), caches=caches)
-            return raw(logits), [
-                StaticKVCache(raw(c.k), raw(c.v), raw(c.pos)) for c in nc]
+                logits, nc = self.forward(Tensor(ids), caches=caches,
+                                          prefill_lens=plens)
+            return raw(logits), [raw_cache(c) for c in nc]
 
         def sample(last, k):  # last: [B, V]
             if temp == 0.0:
@@ -595,10 +847,7 @@ class GPTForCausalLM(Layer):
                 jnp.int32), k
 
         def run(params, ids, k):
-            caches = [StaticKVCache(jnp.zeros((b, total, nh, hd), dt),
-                                    jnp.zeros((b, total, nh, hd), dt),
-                                    jnp.asarray(0, jnp.int32))
-                      for _ in range(nl)]
+            caches = make_caches()
             logits, caches = fwd(params, ids, caches)  # prefill
             nxt, k = sample(logits[:, -1], k)
 
@@ -615,7 +864,7 @@ class GPTForCausalLM(Layer):
                 [toks, last[None]], axis=0).swapaxes(0, 1)  # [B, N]
             return jnp.concatenate([ids, all_new], axis=1)
 
-        sig = (b, s, max_new_tokens, temp, tk)
+        sig = (b, s, max_new_tokens, temp, tk, kv_cache, page_size)
         cache = getattr(self, "_gen_jit_cache", None)
         if cache is None:
             cache = self._gen_jit_cache = {}
@@ -623,3 +872,144 @@ class GPTForCausalLM(Layer):
             cache[sig] = jax.jit(run)
         out = cache[sig](state["params"], ids_raw, key_raw)
         return Tensor(out)
+
+    def _generate_chunked(self, input_ids, max_new_tokens, temperature,
+                          top_k, key):
+        """Chunked-compile generation: instead of one whole-program
+        compile (prefill + scanned decode — the program whose int8
+        1.3B variant reproducibly kills the dev tunnel's remote-compile
+        transport, BENCH_STAGED.json r5), compile THREE small programs:
+        embed, ONE per-block step (the uniform blocks share the
+        compiled function — per-layer params are just different
+        arguments), and the LM head. Each program is ~num_layers x
+        smaller than the monolith; compiles are wrapped in a transient-
+        error RetryPolicy (distributed/resilience.py). The price is a
+        Python-level launch per layer per token — this path exists to
+        GET a measured number past a compile-transport limit, not to
+        win the latency race. Greedy/top-k token stream matches
+        use_jit=True bit-for-bit at temperature 0 (tested)."""
+        import jax
+
+        from ..autograd.engine import no_grad
+        from ..core.rng import next_key
+        from ..distributed.resilience import RetryPolicy
+        from ..nn.layer import bind_state, functional_state
+
+        self.eval()
+        cfg = self.config
+        if cfg.moe_experts > 0:
+            raise ValueError("chunked compile supports dense blocks only")
+        ids_raw = input_ids.value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        b, s = ids_raw.shape
+        total = s + max_new_tokens
+        nh, hd, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
+        state = functional_state(self)
+        dt = state["params"]["gpt.wte.weight"].dtype
+        temp, tk = float(temperature), top_k
+        key_raw = key.value if isinstance(key, Tensor) else key
+        if key_raw is None:
+            key_raw = next_key()
+        # transport errors only: deterministic compile failures (JAX
+        # RuntimeErrors — including the reproducible 1.3B broken-pipe
+        # this path works around by SHRINKING programs) propagate
+        # immediately instead of burning 3 multi-minute attempts
+        retry = RetryPolicy(max_attempts=3, base_delay_s=0.5,
+                            retry_on=(ConnectionError, OSError))
+
+        def raw(t):
+            return t.value if isinstance(t, Tensor) else t
+
+        blk0 = self.gpt.h[0]
+        # params AND buffers: converted layers (WeightOnlyInt8Linear)
+        # carry their quantized weights as buffers — binding params
+        # alone would run every layer on blk0's closed-over buffers
+        pnames = [n for n, _ in blk0.named_parameters()]
+        bnames = [n for n, _ in blk0.named_buffers()]
+        n_p = len(pnames)
+
+        def layer_vals(blk):
+            ps = dict(blk.named_parameters())
+            bs = dict(blk.named_buffers())
+            return ([raw(ps[n]) for n in pnames] +
+                    [None if bs[n] is None else raw(bs[n])
+                     for n in bnames])
+
+        layer_params = [layer_vals(blk) for blk in self.gpt.h]
+
+        # jit objects are cached on the model (state/params flow in as
+        # ARGUMENTS): repeated calls — e.g. bench timing windows — hit
+        # the per-shape compile cache instead of rebuilding the jits
+        # and recompiling every window through the very transport this
+        # path exists to spare
+        cache = getattr(self, "_chunked_jit_cache", None)
+        if cache is None:
+            cache = self._chunked_jit_cache = {}
+        # the parameter-name tuple keys STRUCTURE: an in-place layer
+        # swap (e.g. convert_to_weight_only_int8) changes the names,
+        # so the cached closure over the old structure is not reused
+        # against new-layout params (the r5 stale-pack-cache lesson)
+        sig = (temp, tk, tuple(pnames), tuple(bnames))
+        if sig not in cache:
+            def embed_fn(st, ids, pos0):
+                with bind_state(self, st), no_grad():
+                    pos = pos0 + jnp.arange(ids.shape[1],
+                                            dtype=jnp.int32)[None]
+                    pos = jnp.broadcast_to(pos, ids.shape)
+                    x = self.gpt.wte(Tensor(ids)) + \
+                        self.gpt.wpe(Tensor(pos))
+                return raw(x)
+
+            def block_fn(x, k_buf, v_buf, pos, *vals):
+                st = {"params": dict(zip(pnames, vals[:n_p])),
+                      "buffers": dict(zip(bnames, vals[n_p:]))}
+                with bind_state(blk0, st), no_grad():
+                    out, nc = blk0(Tensor(x),
+                                   StaticKVCache(k_buf, v_buf, pos),
+                                   use_cache=True)
+                return raw(out), raw(nc.k), raw(nc.v)
+
+            def head_fn(st, x):
+                with bind_state(self, st), no_grad():
+                    lg = self.logits(self.gpt.ln_f(Tensor(x)))
+                return raw(lg)[:, -1]
+
+            def sample_fn(last, k):
+                if temp == 0.0:
+                    return jnp.argmax(last, -1).astype(jnp.int32), k
+                scaled = last.astype(jnp.float32) / temp
+                if tk is not None:
+                    kth = jax.lax.top_k(scaled, tk)[0][:, -1:]
+                    scaled = jnp.where(scaled < kth, -1e10, scaled)
+                k, sub = jax.random.split(k)
+                return jax.random.categorical(sub, scaled, -1).astype(
+                    jnp.int32), k
+
+            cache[sig] = tuple(
+                jax.jit(f) for f in (embed_fn, block_fn, head_fn,
+                                     sample_fn))
+        embed_j, block_j, head_j, sample_j = cache[sig]
+        kvs = [(jnp.zeros((b, total, nh, hd), dt),
+                jnp.zeros((b, total, nh, hd), dt)) for _ in range(nl)]
+
+        def run_stack(ids, pos):
+            x = retry.call(embed_j, state, ids, pos,
+                           site="jit.compile.embed")
+            for i in range(nl):
+                x, kb, vb = retry.call(
+                    block_j, x, kvs[i][0], kvs[i][1], pos,
+                    *layer_params[i], site="jit.compile.block")
+                kvs[i] = (kb, vb)
+            return retry.call(head_j, state, x, site="jit.compile.head")
+
+        pos = jnp.asarray(0, jnp.int32)
+        last = run_stack(ids_raw, pos)
+        pos = pos + s
+        nxt, key_raw = sample_j(last, key_raw)
+        out = [ids_raw, nxt[:, None]]
+        for _ in range(max_new_tokens - 1):
+            last = run_stack(nxt[:, None], pos)
+            pos = pos + 1
+            nxt, key_raw = sample_j(last, key_raw)
+            out.append(nxt[:, None])
+        return Tensor(jnp.concatenate(out, axis=1))

@@ -864,6 +864,23 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     return jnp.swapaxes(out, 1, 2)
 
 
+def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
+                    k_scale=None, v_scale=None, scale=None,
+                    q_offsets=None):
+    """Ragged paged attention over a block-paged KV pool (the decode
+    analog of scaled_dot_product_attention's kernel selection): the
+    Pallas page-walk kernel on TPU when the shape gate admits
+    (single-token decode, lane-tiling head groups), the dense-gather
+    pure-JAX reference everywhere else — both implement identical
+    semantics (ops/pallas/paged_attention.py). q: [B, Sq, H, D];
+    pages: [P, page, H, D] float or int8 (+ [P, page, H] scales);
+    page_table: [B, max_pages] int32; seq_lens: [B] int32."""
+    from .pallas.paged_attention import paged_attention as _impl
+    return _impl(q, k_pages, v_pages, page_table, seq_lens,
+                 k_scale=k_scale, v_scale=v_scale, scale=scale,
+                 q_offsets=q_offsets)
+
+
 # --------------------------------------------------------------------------
 # losses (reference: nn/functional/loss.py, operators/*entropy*, bce, etc.)
 # --------------------------------------------------------------------------

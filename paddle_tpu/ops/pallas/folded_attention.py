@@ -205,9 +205,18 @@ def folded_attention_supported(q_shape, k_shape, causal: bool = False,
     folded wins anyway — measured v5e causal fwd+bwd scanned:
     S=512 b64 h12 folded 5.68 vs streaming 6.62 ms/iter, S=1024 b8
     h12 folded 4.33 vs 5.25 — so d=64 causal runs folded through the
-    whole single-block range. d=128's streaming kernel runs ~2x more
-    efficient (full-lane contractions), so its causal cap stays at
-    one 512-block (unmeasured beyond; conservative)."""
+    whole single-block range. d=128 causal caps at one 256-block
+    (r6, tools/folded_crossover_sweep.py -> FOLDED_CROSSOVER.json,
+    replacing r5's unmeasured-conservative 512): calibrating the
+    streaming kernel's non-MXU cost from those d=64 measurements and
+    halving only its MAC term for full-lane d=128 puts folded at
+    ~1.6x streaming's time at S=512 and ~1.5x at S=1024 — the 2x
+    causal-pair skip dominates once streaming's contractions are
+    full-lane — while S=256 stays folded because streaming is below
+    its own measured XLA crossover there (_FLASH_MIN_SEQ). The sweep
+    tool re-derives the cap from on-chip data when a chip is
+    reachable; FOLDED_CROSSOVER.json records on_chip_pending until
+    then."""
     from .flash_attention import _FORCE_DEPTH
     if backend is None:
         backend = jax.default_backend()
@@ -215,7 +224,7 @@ def folded_attention_supported(q_shape, k_shape, causal: bool = False,
         return False
     b, sq, h, d = q_shape
     sk = k_shape[1]
-    if causal and sq > (MAX_SINGLE_BLOCK if d == 64 else 512):
+    if causal and sq > (MAX_SINGLE_BLOCK if d == 64 else 256):
         return False
     return (sq == sk and sq <= MAX_SINGLE_BLOCK and sq % 128 == 0 and
             d in (64, 128) and (h * d) % 128 == 0)

@@ -316,6 +316,32 @@ class Int8Conv2D(Layer):
                                 tuple(args), {})
 
 
+def quantize_kv(x, eps: float = 1e-8):
+    """Symmetric int8 quantization for KV-cache tokens: per-(token,
+    head) abs-max over the head_dim axis — the finest granularity that
+    stays outside the attention contractions, so one scale multiply per
+    page row recovers the values (deq = q * s / 127, the same
+    convention as quantize_int8/dequantize_int8 above). Returns
+    ``(int8 values [..., H, D], float32 scales [..., H])``. Used by the
+    paged KV cache (models/gpt.py PagedKVCache int8 mode), where
+    halving KV bytes directly halves the dominant decode-step HBM
+    category (PROFILE_DECODE.json: 5.5 GB/step of KV at b128)."""
+    raw = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    s = jnp.maximum(jnp.max(jnp.abs(raw.astype(jnp.float32)), axis=-1),
+                    eps)
+    q = jnp.clip(jnp.round(raw.astype(jnp.float32) / s[..., None] * 127.0),
+                 -127, 127).astype(jnp.int8)
+    return q, s.astype(jnp.float32)
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Inverse of quantize_kv: deq = q * scale / 127."""
+    raw = q.value if isinstance(q, Tensor) else jnp.asarray(q)
+    s = scale.value if isinstance(scale, Tensor) else jnp.asarray(scale)
+    return (raw.astype(jnp.float32) *
+            (s.astype(jnp.float32) / 127.0)[..., None]).astype(dtype)
+
+
 class WeightOnlyInt8Linear(Layer):
     """Weight-ONLY int8 linear for decode/serving, where weight
     STREAMING is the bottleneck (PROFILE_DECODE.json roofline: at small

@@ -102,13 +102,17 @@ def test_supported_gate():
         assert not ok((1, 512, 1, 64), (1, 512, 1, 64))  # E=64 < 128
         assert not ok((1, 512, 3, 64), (1, 512, 3, 64))  # E=192
         # d=64 causal runs folded through the whole single-block
-        # range (measured wins at 512 AND 1024); d=128 causal stays
-        # capped at one 512-block (efficient streaming kernel there)
+        # range (measured wins at 512 AND 1024); d=128 causal caps at
+        # one 256-block (r6 calibrated cost model, FOLDED_CROSSOVER
+        # .json: full-lane streaming's causal-pair skip wins from 512)
         assert ok((1, 1024, 8, 64), (1, 1024, 8, 64), causal=True)
         assert ok((1, 1024, 8, 64), (1, 1024, 8, 64), causal=False)
         assert not ok((1, 1024, 8, 128), (1, 1024, 8, 128),
                       causal=True)
-        assert ok((1, 512, 8, 128), (1, 512, 8, 128), causal=True)
+        assert not ok((1, 512, 8, 128), (1, 512, 8, 128), causal=True)
+        assert ok((1, 256, 8, 128), (1, 256, 8, 128), causal=True)
+        # non-causal d=128 keeps the full single-block range
+        assert ok((1, 512, 8, 128), (1, 512, 8, 128), causal=False)
     assert not ok((64, 512, 12, 64), (64, 512, 12, 64), backend="cpu")
 
 

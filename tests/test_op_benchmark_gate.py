@@ -62,6 +62,55 @@ def test_compare_flags_regressions(tmp_path):
     assert r.returncode == 8
 
 
+def test_pending_cases_are_tracked_and_cpu_gated(tmp_path):
+    """Pending-tier ops (benchable, but baselines not yet complete on
+    every platform — today: paged_attention, whose tpu_v5e number needs
+    a chip-attached host) must be (1) real registered dispatch entries,
+    (2) runnable through the harness and gated against a committed
+    cpu_smoke_pending baseline, and (3) accounted for in
+    op_baselines/PENDING.json with the missing platform named — no
+    silently unbaselined op."""
+    from check_op_benchmark_result import compare, load_logs_dir
+    from op_benchmark import default_cases, pending_cases
+
+    import paddle_tpu.dispatch as dispatch
+
+    pend = pending_cases()
+    assert pend, "drop this test when the pending tier empties"
+    assert not set(pend) & set(default_cases())
+    with open(os.path.join(TOOLS, "op_baselines", "PENDING.json")) as f:
+        tracked = json.load(f)
+    assert set(tracked) == set(pend)
+    for name, meta in tracked.items():
+        assert name in dispatch.wrapped_ops, name
+        assert meta["missing"] and meta["why_missing"], name
+
+    dev = load_logs_dir(
+        os.path.join(TOOLS, "op_baselines", "cpu_smoke_pending"))
+    assert set(dev) == set(pend)
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "op_benchmark.py"),
+         "--platform", "cpu", "--ops", ",".join(sorted(pend)),
+         "--repeat", "10", "--output", str(tmp_path / "pr")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    failures, checked = compare(dev, load_logs_dir(str(tmp_path / "pr")),
+                                threshold=4.0)
+    assert checked == len(pend)
+    if failures:  # transient host-load spike: reproduce before failing
+        r2 = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "op_benchmark.py"),
+             "--platform", "cpu", "--ops", ",".join(sorted(pend)),
+             "--repeat", "10", "--output", str(tmp_path / "pr2")],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        failures, _ = compare(dev, load_logs_dir(str(tmp_path / "pr2")),
+                              threshold=4.0)
+    assert not failures, failures
+
+
 @pytest.mark.parametrize("ops", ["add,matmul,softmax,layer_norm"])
 def test_cpu_smoke_gate_against_committed_baseline(tmp_path, ops):
     """Re-measure a subset on this host and gate against the committed

@@ -54,6 +54,27 @@ def default_cases():
     }
 
 
+def pending_cases():
+    """Ops benchable through this harness whose baseline set is not yet
+    complete on every platform (tools/op_baselines/PENDING.json records
+    which platform is missing and why). Kept OUT of default_cases() so
+    test_op_benchmark_gate's completeness check over the committed
+    baseline dirs stays exact; the gate covers these via the
+    *_pending baseline dirs instead."""
+    def paged():
+        # decode-shaped ragged paged attention: 8 sequences, 16-token
+        # pages, ragged lengths spanning 1..8 pages (the kernel-contract
+        # shape class; on cpu the dense-gather reference runs)
+        n_pages, page, h, d = 65, 16, 8, 64
+        kp = _f32(n_pages, page, h, d)
+        vp = _f32(n_pages, page, h, d)
+        table = np.arange(8 * 8, dtype=np.int32).reshape(8, 8)
+        lens = np.asarray([128, 112, 96, 80, 64, 48, 32, 16], np.int32)
+        return (_f32(8, 1, h, d), kp, vp, table, lens)
+
+    return {"paged_attention": paged}
+
+
 def bench_op(name: str, make_args, repeat: int) -> dict:
     import jax
 
@@ -148,7 +169,8 @@ def main() -> int:
     import paddle_tpu  # noqa: F401 - registers ops
 
     cases = default_cases()
-    if args.ops:
+    if args.ops:  # pending cases run only when asked for by name
+        cases.update(pending_cases())
         wanted = args.ops.split(",")
         missing = [w for w in wanted if w not in cases]
         if missing:
