@@ -45,10 +45,10 @@ def __getattr__(name):
     import importlib
     if name in ("nn", "optimizer", "amp", "io", "static", "jit",
                 "distributed", "metric", "vision", "models", "hapi",
-                "framework", "inference", "autograd", "ops", "profiler",
-                "quantization", "sparsity", "text", "native", "distribution",
-                "utils", "fft", "linalg", "regularizer", "device", "hub",
-                "onnx", "incubate", "sysconfig"):
+                "framework", "inference", "serving", "autograd", "ops",
+                "profiler", "quantization", "sparsity", "text", "native",
+                "distribution", "utils", "fft", "linalg", "regularizer",
+                "device", "hub", "onnx", "incubate", "sysconfig"):
         return importlib.import_module(f".{name}", __name__)
     if name == "ParamAttr":  # lazy: avoids eager-importing all of nn
         from .nn.initializer import ParamAttr as _PA
@@ -68,9 +68,9 @@ def __dir__():
     return sorted(set(globals()) | {
         "nn", "optimizer", "amp", "io", "static", "jit", "distributed",
         "metric", "vision", "models", "hapi", "framework", "inference",
-        "autograd", "ops", "quantization", "sparsity", "text", "native",
-        "distribution", "utils", "fft", "linalg", "regularizer", "device",
-        "hub", "onnx", "incubate", "sysconfig"})
+        "serving", "autograd", "ops", "quantization", "sparsity", "text",
+        "native", "distribution", "utils", "fft", "linalg", "regularizer",
+        "device", "hub", "onnx", "incubate", "sysconfig"})
 
 
 def Model(*args, **kwargs):

@@ -20,6 +20,12 @@ heter.push/heter.pull heter.HeterPipelineTrainer sparse stage
 dataloader.fetch     io.dataloader worker batch assembly
 collective.step      collective.all_reduce / barrier (eager host path)
 trainer.step         resilience.ResilientTrainer per-step gate
+serving.request      serving/server.py per-request front-end handling
+                     (clients receive a retryable typed error reply)
+serving.prefill      inference/continuous_batching engine admission
+                     prefill (retried per the serving.prefill policy;
+                     exhausted retries FAIL the request with a typed
+                     reply instead of wedging the queue)
 ==================== =================================================
 
 Default-OFF: with no sites armed (the tier-1 default), ``fault_point``

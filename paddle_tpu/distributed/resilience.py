@@ -163,6 +163,18 @@ class RetryPolicy:
 DEFAULT_RETRY = RetryPolicy()
 NO_RETRY = RetryPolicy(max_attempts=1)
 
+# Per-site BUILT-IN defaults (overridable via set_site_policy /
+# PT_RETRY_SITES like any site). serving.prefill sits on the serving
+# admission path (serving/server.py + inference/continuous_batching):
+# a transient prefill failure should be retried promptly — a queued
+# client is waiting on its TTFT — and give up fast enough that the
+# engine's per-request attempt budget (max_prefill_attempts) can fail
+# the request with a typed reply instead of wedging admission.
+_BUILTIN_SITE_POLICIES: Dict[str, "RetryPolicy"] = {
+    "serving.prefill": RetryPolicy(max_attempts=3, base_delay_s=0.02,
+                                   max_delay_s=0.25),
+}
+
 _site_policies: Dict[str, RetryPolicy] = {}
 _env_policies: Optional[Dict[str, RetryPolicy]] = None
 _policy_lock = threading.Lock()
@@ -196,12 +208,15 @@ def _load_env_policies() -> Dict[str, RetryPolicy]:
 
 def get_retry_policy(site: str) -> RetryPolicy:
     """Resolution order: programmatic override > PT_RETRY_SITES env >
-    DEFAULT_RETRY."""
+    built-in site default > DEFAULT_RETRY."""
     with _policy_lock:
         p = _site_policies.get(site)
     if p is not None:
         return p
-    return _load_env_policies().get(site, DEFAULT_RETRY)
+    env = _load_env_policies().get(site)
+    if env is not None:
+        return env
+    return _BUILTIN_SITE_POLICIES.get(site, DEFAULT_RETRY)
 
 
 def call_with_retry(site: str, fn: Callable, *args, **kwargs):

@@ -301,7 +301,8 @@ class GPTAttention(Layer):
         self.attn_dropout_p = c.attn_dropout
         self.use_flash = c.use_flash_attention
 
-    def forward(self, x, cache=None, use_cache=False, prefill_len=None):
+    def forward(self, x, cache=None, use_cache=False, prefill_len=None,
+                prefill_chained=False):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)  # [b, s, 3h] sharded over mp on last dim
         qkv = F["reshape"](qkv, (b, s, 3, self.num_heads, self.head_dim))
@@ -312,7 +313,8 @@ class GPTAttention(Layer):
         if use_cache and isinstance(cache, PagedKVCache):
             # Ragged paged decode path: append through the page table,
             # attend over only the pages each sequence owns.
-            return self._decode_paged(q, k, v, cache, b, s, prefill_len)
+            return self._decode_paged(q, k, v, cache, b, s, prefill_len,
+                                      prefill_chained)
         if use_cache and isinstance(cache, StaticKVCache):
             # Fixed-shape decode path (scan/jit-able): write the new k/v
             # at pos into the preallocated buffers and attend over the
@@ -379,7 +381,8 @@ class GPTAttention(Layer):
         out = self.out_proj(out)
         return out, StaticKVCache(k_buf, v_buf, cache.pos + s)
 
-    def _decode_paged(self, q, k, v, cache, b, s, prefill_len=None):
+    def _decode_paged(self, q, k, v, cache, b, s, prefill_len=None,
+                      prefill_chained=False):
         """Paged decode/prefill: k/v append through the page table
         (ragged right-padding redirected to the scratch page), then
 
@@ -392,6 +395,15 @@ class GPTAttention(Layer):
           right padding means valid tokens attend exactly their own
           prefix; padded tokens' outputs are discarded by the caller
           and their KV never reaches a real page.
+        - s > 1 with ``prefill_len`` AND ``prefill_chained`` (the
+          prefix-cache suffix prefill, serving/prefix_cache.py): the
+          slot STARTS at seq_lens > 0 — page-table entries below that
+          length are shared, already-populated prefix pages — so the
+          ragged right-padded chunk is appended via valid_len and
+          attends the stored prefix PLUS itself through the reference
+          paged attention with q_offsets = old seq_lens. Right-padded
+          query rows produce garbage that the caller discards; their
+          KV lands on the scratch page, never on a shared page.
         - s > 1 without ``prefill_len`` (public forward() continuation
           against a possibly NON-empty cache): the reference paged
           attention with per-sequence q_offsets — it attends the full
@@ -401,7 +413,9 @@ class GPTAttention(Layer):
         Prefill attends the un-quantized k/v even in int8 mode (exact,
         and free — the dense path already has them in registers);
         decode reads back the quantized pages, which is the lossy step
-        the int8 parity tests bound."""
+        the int8 parity tests bound. The chained prefill reads the
+        prefix back from pages, so in int8 mode its prefix keys are
+        the quantized ones — the same values decode would have read."""
         old_lens = cache.seq_lens
         if prefill_len is None:
             new_cache = dispatch.call_fn(
@@ -418,7 +432,7 @@ class GPTAttention(Layer):
                 q, new_cache.k_pages, new_cache.v_pages,
                 new_cache.page_table, new_cache.seq_lens,
                 k_scale=new_cache.k_scale, v_scale=new_cache.v_scale)
-        elif prefill_len is not None:
+        elif prefill_len is not None and not prefill_chained:
             out = F["scaled_dot_product_attention"](
                 q, k, v, is_causal=True, dropout_p=0.0,
                 training=False, use_flash=bool(self.use_flash))
@@ -469,10 +483,12 @@ class GPTBlock(Layer):
             self.mlp = GPTMLP(config)
         self.dropout = Dropout(config.dropout)
 
-    def forward(self, x, cache=None, use_cache=False, prefill_len=None):
+    def forward(self, x, cache=None, use_cache=False, prefill_len=None,
+                prefill_chained=False):
         if use_cache:
             a, new_cache = self.attn(self.ln_1(x), cache, use_cache=True,
-                                     prefill_len=prefill_len)
+                                     prefill_len=prefill_len,
+                                     prefill_chained=prefill_chained)
             x = x + self.dropout(a)
             x = x + self.dropout(self.mlp(self.ln_2(x)))
             return x, new_cache
@@ -504,17 +520,20 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                use_cache=False, prefill_lens=None):
+                use_cache=False, prefill_lens=None,
+                prefill_chained=False):
         if self._remat_names is not None:
             from ..core.offload import override_remat_saved_names
             with override_remat_saved_names(self._remat_names):
                 return self._forward(input_ids, position_ids, caches,
-                                     use_cache, prefill_lens)
+                                     use_cache, prefill_lens,
+                                     prefill_chained)
         return self._forward(input_ids, position_ids, caches, use_cache,
-                             prefill_lens)
+                             prefill_lens, prefill_chained)
 
     def _forward(self, input_ids, position_ids=None, caches=None,
-                 use_cache=False, prefill_lens=None):
+                 use_cache=False, prefill_lens=None,
+                 prefill_chained=False):
         use_cache = use_cache or caches is not None
         b, s = input_ids.shape
         if position_ids is None:
@@ -550,7 +569,8 @@ class GPTModel(Layer):
         for i, block in enumerate(self.h):
             if use_cache:
                 x, nc = block(x, caches[i], use_cache=True,
-                              prefill_len=prefill_lens)
+                              prefill_len=prefill_lens,
+                              prefill_chained=prefill_chained)
                 new_caches.append(nc)
             elif self.config.remat and not hasattr(block.mlp, "aux_loss") \
                     and i % self.config.remat_every == 0:
@@ -668,10 +688,11 @@ class GPTForCausalLM(Layer):
                                 (hidden, labels, *params), {})
 
     def forward(self, input_ids, labels=None, position_ids=None,
-                caches=None, prefill_lens=None):
+                caches=None, prefill_lens=None, prefill_chained=False):
         if caches is not None:
             hidden, new_caches = self.gpt(input_ids, position_ids, caches,
-                                          prefill_lens=prefill_lens)
+                                          prefill_lens=prefill_lens,
+                                          prefill_chained=prefill_chained)
             return self.logits(hidden), new_caches
         hidden = self.gpt(input_ids, position_ids)
         if labels is None:

@@ -1,0 +1,47 @@
+"""paddle_tpu.serving — production serving layer over the decode engine.
+
+Turns `inference.ContinuousBatchingEngine` (paged KV + fixed-slot
+continuous batching, PR r6) into a servable system:
+
+- ``server``: threaded socket front-end with a newline-JSON protocol,
+  request lifecycle (queued → prefill → decoding → done/evicted),
+  incremental token streaming, health/stats endpoints, and graceful
+  drain (stop admitting, finish in-flight, return pages,
+  ``check_no_leak``).
+- ``scheduler``: SLO-aware admission replacing head-of-queue FIFO —
+  priority classes, max-queue-delay promotion, overload shedding with
+  a typed ``ServerOverloaded`` reply, and bounded fairness so long
+  prompts can't starve short ones (and vice versa).
+- ``prefix_cache``: refcounted sharing of immutable FULL KV pages
+  keyed by a rolling hash of their token block; admission reuses
+  cached pages for matching prompt prefixes (skipping that prefill
+  compute entirely), divergence is handled by writing the suffix into
+  fresh private pages (shared pages are never mutated), LRU eviction
+  fires only at refcount 0, and greedy outputs stay bit-identical to
+  the uncached path (tests/test_serving.py).
+- ``metrics``: per-request TTFT / TPOT / queue-delay histograms and
+  cache-hit / shed counters in core.monitor's StatRegistry, with a
+  Prometheus-style text export.
+
+Reference analog: the framework's standalone inference engine + C
+serving API (SURVEY §1 rows 7/12), reproduced TPU-natively as a Python
+serving subsystem over one jitted decode step rather than a C ABI.
+Paper basis: *Ragged Paged Attention* (PAPERS.md) — page-granular KV
+management is what makes cross-request prefix sharing possible.
+"""
+
+from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .prefix_cache import PrefixCache  # noqa: F401
+from .scheduler import (Priority, ServerOverloaded, SLOConfig,  # noqa: F401
+                        SLOScheduler)
+
+
+def __getattr__(name):
+    # server.py is lazy so `python -m paddle_tpu.serving.server` does
+    # not execute the module twice (runpy re-runs what the package
+    # __init__ already imported)
+    if name in ("ServingServer", "client_request"):
+        from . import server
+        return getattr(server, name)
+    raise AttributeError(
+        f"module 'paddle_tpu.serving' has no attribute {name!r}")

@@ -1,0 +1,201 @@
+"""Per-request serving observability.
+
+Aggregates the engine's `RequestStats` records (admit time, prefill
+ms, first-token time, tokens emitted — continuous_batching.py) into
+TTFT / TPOT / queue-delay histograms plus cache-hit and shed counters,
+and exports both as a Prometheus-style text page. Counters live in
+core/monitor.py's process-global ``StatRegistry`` (the reference's
+StatValue/StatRegistry monitor), so any other subsystem's stats ride
+the same export.
+
+This is the fix for the "which number is the framework" ambiguity
+(VERDICT weak #5) at per-request granularity: TTFT (submit → first
+token, queueing included) and TPOT (steady decode cadence) are
+separate distributions instead of one blended wall-clock figure.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Optional, Sequence
+
+from ..core.monitor import GLOBAL_STATS, StatRegistry
+
+__all__ = ["Histogram", "ServingMetrics"]
+
+# log-ish spaced latency buckets (ms): sub-ms CPU-smoke prefills up to
+# multi-second chip TTFTs land in distinct buckets
+DEFAULT_MS_BUCKETS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
+                      250, 500, 1000, 2500, 5000, 10000)
+
+
+class Histogram:
+    """Fixed-bucket latency histogram with quantiles over a bounded
+    uniform RESERVOIR of all observations (replace-with-probability
+    n/i, so late traffic keeps entering the sample and quantiles track
+    a live regression instead of freezing on warm-up-era values); the
+    buckets stay exact forever."""
+
+    def __init__(self, name: str,
+                 buckets: Sequence[float] = DEFAULT_MS_BUCKETS,
+                 max_samples: int = 65536):
+        self.name = name
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self.total = 0
+        self.sum = 0.0
+        self._samples: List[float] = []
+        self._max_samples = int(max_samples)
+        self._resv_rng = random.Random(0)  # deterministic reservoir
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.counts[bisect_left(self.buckets, v)] += 1
+            self.total += 1
+            self.sum += v
+            if len(self._samples) < self._max_samples:
+                self._samples.append(v)
+            else:
+                j = self._resv_rng.randrange(self.total)
+                if j < self._max_samples:
+                    self._samples[j] = v
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Exact percentile over the retained samples (None if empty)."""
+        with self._lock:
+            if not self._samples:
+                return None
+            s = sorted(self._samples)
+            idx = min(len(s) - 1, max(0, round(p / 100 * (len(s) - 1))))
+            return s[idx]
+
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        with self._lock:
+            n = self.total
+            mean = self.sum / n if n else None
+        return {"count": n, "mean": mean,
+                "p50": self.percentile(50), "p90": self.percentile(90),
+                "p99": self.percentile(99)}
+
+    def prometheus_lines(self) -> List[str]:
+        """Cumulative-bucket text exposition (histogram type)."""
+        name = self.name.replace(".", "_")
+        out = [f"# TYPE {name} histogram"]
+        with self._lock:
+            acc = 0
+            for le, c in zip(self.buckets, self.counts):
+                acc += c
+                out.append(f'{name}_bucket{{le="{le:g}"}} {acc}')
+            acc += self.counts[-1]
+            out.append(f'{name}_bucket{{le="+Inf"}} {acc}')
+            out.append(f"{name}_sum {self.sum:g}")
+            out.append(f"{name}_count {self.total}")
+        return out
+
+
+class ServingMetrics:
+    """The serving layer's stat surface.
+
+    ``observe_request`` consumes a finished `DecodeRequest` (any
+    terminal state) from the engine's ``on_complete`` hook; counters
+    land in the shared StatRegistry under ``serving.*`` names so
+    ``GLOBAL_STATS.snapshot()`` sees them too."""
+
+    COUNTERS = ("requests_total", "tokens_generated_total",
+                "cache_hit_pages_total", "cache_miss_pages_total",
+                "cache_hit_requests_total", "shed_total",
+                "rejected_total", "evicted_total", "failed_total",
+                "prefill_retries_total", "engine_errors_total")
+
+    def __init__(self, registry: Optional[StatRegistry] = None,
+                 prefix: str = "serving"):
+        self.registry = registry if registry is not None else GLOBAL_STATS
+        self.prefix = prefix
+        self.ttft_ms = Histogram(f"{prefix}.ttft_ms")
+        self.tpot_ms = Histogram(f"{prefix}.tpot_ms")
+        self.queue_delay_ms = Histogram(f"{prefix}.queue_delay_ms")
+        self.prefill_ms = Histogram(f"{prefix}.prefill_ms")
+        self.e2e_ms = Histogram(f"{prefix}.e2e_ms")
+
+    def counter(self, name: str):
+        return self.registry.get(f"{self.prefix}.{name}")
+
+    def reset(self) -> None:
+        """Zero the serving counters (tests); histograms are rebuilt."""
+        for c in self.COUNTERS:
+            self.counter(c).reset()
+        for h in ("ttft_ms", "tpot_ms", "queue_delay_ms", "prefill_ms",
+                  "e2e_ms"):
+            setattr(self, h, Histogram(f"{self.prefix}.{h}"))
+
+    # -- ingestion ---------------------------------------------------------
+
+    def observe_request(self, req) -> None:
+        """Terminal-state hook (engine ``on_complete``)."""
+        st = req.stats
+        self.counter("requests_total").add()
+        if req.state == "shed":
+            self.counter("shed_total").add()
+            return
+        if req.state == "evicted":
+            self.counter("evicted_total").add()
+            return
+        if req.state == "failed":
+            self.counter("failed_total").add()
+            if st.prefill_attempts:
+                self.counter("prefill_retries_total").add(
+                    st.prefill_attempts - 1)
+            return
+        self.counter("tokens_generated_total").add(st.tokens_out)
+        if st.cache_enabled:
+            # hit/miss accounting only when a prefix cache exists — a
+            # cache-less deployment must not read as a 0%-hit cache
+            if st.cached_pages:
+                self.counter("cache_hit_requests_total").add()
+                self.counter("cache_hit_pages_total").add(
+                    st.cached_pages)
+            self.counter("cache_miss_pages_total").add(
+                max(0, st.prompt_pages - st.cached_pages))
+        if st.prefill_attempts > 1:
+            self.counter("prefill_retries_total").add(
+                st.prefill_attempts - 1)
+        if st.ttft_s is not None:
+            self.ttft_ms.observe(st.ttft_s * 1e3)
+        if st.tpot_s is not None:
+            self.tpot_ms.observe(st.tpot_s * 1e3)
+        if st.queue_delay_s is not None:
+            self.queue_delay_ms.observe(st.queue_delay_s * 1e3)
+        if st.prefill_ms:
+            self.prefill_ms.observe(st.prefill_ms)
+        if st.finish_t and st.submit_t:
+            self.e2e_ms.observe((st.finish_t - st.submit_t) * 1e3)
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        counters = {c: self.counter(c).get() for c in self.COUNTERS}
+        return {
+            "counters": counters,
+            "ttft_ms": self.ttft_ms.snapshot(),
+            "tpot_ms": self.tpot_ms.snapshot(),
+            "queue_delay_ms": self.queue_delay_ms.snapshot(),
+            "prefill_ms": self.prefill_ms.snapshot(),
+            "e2e_ms": self.e2e_ms.snapshot(),
+        }
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition: serving histograms + every
+        counter in the shared registry (``.`` → ``_``)."""
+        lines: List[str] = []
+        for h in (self.ttft_ms, self.tpot_ms, self.queue_delay_ms,
+                  self.prefill_ms, self.e2e_ms):
+            lines.extend(h.prometheus_lines())
+        for name, val in sorted(self.registry.snapshot().items()):
+            pname = name.replace(".", "_")
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {val}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,303 @@
+"""Refcounted cross-request KV prefix cache over the page allocator.
+
+The page-granular KV layout (*Ragged Paged Attention*, PAPERS.md) makes
+prompt prefixes shareable for free: a FULL page of KV is an immutable
+function of (model weights, the token block it covers, and every token
+before it). This module keys such pages by a rolling hash of their
+token block chained through the prefix, so two requests whose prompts
+share a prefix share the physical pages — the shared-system-prompt
+serving workload then skips that prefill compute entirely (the engine
+prefills only the suffix via models/gpt.py ``prefill_chained``).
+
+Invariants the tests pin (tests/test_serving.py):
+
+- Only FULL pages strictly inside the prompt are ever shared; the
+  shareable block count for a prompt of length L is ``(L - 1) //
+  page_size``, so at least one suffix token always remains to prefill
+  (its logits produce the first generated token, and a fully-cached
+  prompt would otherwise have no forward pass to produce them).
+- Shared pages are IMMUTABLE: divergence past the shared prefix is a
+  write into fresh private pages (the copy-on-write of this design —
+  the diverging request never touches the shared page, it writes its
+  own), and decode appends always land at positions past the prompt,
+  hence past every shared page.
+- Entries are refcounted (one ref per active request per chain entry,
+  plus one per child entry); LRU eviction considers ONLY entries with
+  refcount 0 and no children, so a chain is torn down leaf-first and
+  never under an active request.
+- Ownership is explicit in the `PageAllocator` books: cached pages
+  belong to ``("prefix", key)`` owners, so ``check_no_leak`` still
+  audits every page — `clear()` (engine close) returns everything and
+  the allocator must come out whole.
+
+Reference analog: no fluid-era equivalent (the inference engine caches
+whole programs, not KV); this is the serving-layer capability the
+paged pool was built to unlock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, Hashable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["PrefixCache"]
+
+
+def _block_hash(parent: Optional[bytes], block: np.ndarray) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    if parent is not None:
+        h.update(parent)
+    h.update(np.ascontiguousarray(block, np.int32).tobytes())
+    return h.digest()
+
+
+@dataclasses.dataclass
+class _Entry:
+    key: bytes
+    parent: Optional[bytes]
+    page: int
+    tokens: np.ndarray            # the block's tokens (collision guard)
+    refcount: int = 0             # active requests holding this entry
+    children: int = 0             # child entries chaining off this one
+    last_used: int = 0            # LRU tick
+
+
+class PrefixCache:
+    """Host-side refcounted prefix-page cache.
+
+    Single-threaded by design: every method runs on the engine thread
+    (the server serializes engine access), matching the allocator's
+    model. ``page_size`` must equal the engine's."""
+
+    def __init__(self, page_size: int, max_pages: Optional[int] = None):
+        self.page_size = int(page_size)
+        # optional soft cap on cached pages; None = bounded only by
+        # pool pressure (evict_until)
+        self.max_pages = max_pages
+        self._entries: Dict[bytes, _Entry] = {}
+        self._tick = 0
+        # lifetime counters (serving/metrics.py scrapes these through
+        # the engine's RequestStats; kept here too for direct audits)
+        self.hit_pages = 0
+        self.miss_pages = 0
+        self.inserted_pages = 0
+        self.evicted_pages = 0
+
+    # -- keys --------------------------------------------------------------
+
+    def _shareable_blocks(self, prompt: np.ndarray) -> int:
+        # full pages strictly before the last prompt token: guarantees
+        # a non-empty suffix prefill (see module docstring)
+        return max(0, (len(prompt) - 1) // self.page_size)
+
+    def _chain_keys(self, prompt: np.ndarray
+                    ) -> List[Tuple[bytes, Optional[bytes], np.ndarray]]:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        out: List[Tuple[bytes, Optional[bytes], np.ndarray]] = []
+        parent: Optional[bytes] = None
+        for i in range(self._shareable_blocks(prompt)):
+            block = prompt[i * self.page_size:(i + 1) * self.page_size]
+            key = _block_hash(parent, block)
+            out.append((key, parent, block))
+            parent = key
+        return out
+
+    # -- lookup / refcounts ------------------------------------------------
+
+    def match(self, prompt, memo=None
+              ) -> Tuple[Tuple[bytes, ...], List[int]]:
+        """Longest cached prefix for ``prompt``: (chain keys, pages).
+        Pure — no refcounts move (admission calls ``acquire`` once it
+        commits; ``_fits`` probes freely). ``memo`` (typically the
+        DecodeRequest) caches the chain hashes across calls — the
+        prompt is immutable, and per-step admission probes must cost
+        dict lookups, not O(prompt) re-hashing."""
+        chain = getattr(memo, "_pfx_chain", None) if memo is not None \
+            else None
+        if chain is None:
+            chain = self._chain_keys(prompt)
+            if memo is not None:
+                memo._pfx_chain = chain
+        keys: List[bytes] = []
+        pages: List[int] = []
+        for key, _parent, block in chain:
+            ent = self._entries.get(key)
+            if ent is None or not np.array_equal(ent.tokens, block):
+                break  # miss (or hash collision — treated as a miss)
+            keys.append(key)
+            pages.append(ent.page)
+        return tuple(keys), pages
+
+    def acquire(self, keys: Sequence[bytes]) -> None:
+        """Pin a matched chain for an admitting request (one ref per
+        entry). Hit/miss stats are counted once, at ``insert`` (an
+        admission that later unwinds releases without skewing them)."""
+        self._tick += 1
+        for k in keys:
+            ent = self._entries[k]
+            ent.refcount += 1
+            ent.last_used = self._tick
+
+    def release(self, keys: Sequence[bytes]) -> None:
+        for k in keys:
+            ent = self._entries.get(k)
+            if ent is None:
+                continue  # entry force-cleared (close() teardown)
+            ent.refcount -= 1
+            if ent.refcount < 0:
+                raise RuntimeError(
+                    f"prefix-cache refcount underflow on {k.hex()}")
+
+    # -- insertion ---------------------------------------------------------
+
+    def insert(self, prompt, row: np.ndarray, allocator, owner: Hashable,
+               page_size: int, matched_keys: Sequence[bytes]
+               ) -> Tuple[bytes, ...]:
+        """Adopt the freshly-prefilled full prompt pages of ``row``
+        into the cache (ownership transfer ``owner`` → cache) and
+        return the request's full chain keys (matched + new), each
+        holding one reference for the request.
+
+        ``row`` is the slot's page-table row: entry i is the physical
+        page of token block i, so the new blocks' pages are read
+        straight out of it."""
+        if page_size != self.page_size:
+            raise ValueError(
+                f"engine page_size {page_size} != cache page_size "
+                f"{self.page_size}")
+        chain = self._chain_keys(prompt)
+        keys: List[bytes] = list(matched_keys)
+        self.hit_pages += len(matched_keys)
+        self.miss_pages += max(0, len(chain) - len(matched_keys))
+        for i in range(len(matched_keys), len(chain)):
+            key, parent, block = chain[i]
+            ent = self._entries.get(key)
+            if ent is not None and np.array_equal(ent.tokens, block):
+                # already cached (defensive: cannot happen on the
+                # single-threaded admission path, where match() ran
+                # moments ago) — take a reference, keep our private
+                # copy with the request (freed when it finishes)
+                ent.refcount += 1
+                ent.last_used = self._tick
+                keys.append(key)
+                continue
+            if ent is not None:
+                break  # hash collision with different tokens: stop
+            if self.max_pages is not None and \
+                    self.total_pages() >= self.max_pages and \
+                    not self._evict_one(allocator):
+                break  # soft cap reached and nothing evictable
+            page = int(row[i])
+            allocator.transfer(owner, ("prefix", key), [page])
+            self._tick += 1
+            self._entries[key] = _Entry(key, parent, page,
+                                        np.array(block, np.int32),
+                                        refcount=1, last_used=self._tick)
+            if parent is not None:
+                self._entries[parent].children += 1
+            self.inserted_pages += 1
+            keys.append(key)
+        return tuple(keys)
+
+    # -- eviction ----------------------------------------------------------
+
+    def _evictable(self) -> List[_Entry]:
+        return [e for e in self._entries.values()
+                if e.refcount == 0 and e.children == 0]
+
+    def evictable_pages(self, excluding: Sequence[bytes] = ()) -> int:
+        """Pages reclaimable RIGHT NOW plus transitively (a refcount-0
+        parent becomes evictable once its refcount-0 leaves go): every
+        entry not pinned by some active request at or below it.
+        ``excluding`` marks entries the CALLER is about to pin (its own
+        prefix match) — counting those as evictable would make
+        admission-fit checks optimistic about pages that the admission
+        itself takes off the table."""
+        pinned: set = set()
+        for start in list(excluding):
+            k: Optional[bytes] = start
+            while k is not None and k not in pinned and \
+                    k in self._entries:
+                pinned.add(k)
+                k = self._entries[k].parent
+        for e in self._entries.values():
+            if e.refcount > 0:
+                k = e.key
+                while k is not None and k not in pinned:
+                    pinned.add(k)
+                    k = self._entries[k].parent
+        return len(self._entries) - len(pinned)
+
+    def _evict_one(self, allocator) -> bool:
+        cands = self._evictable()
+        if not cands:
+            return False
+        victim = min(cands, key=lambda e: e.last_used)
+        allocator.free(("prefix", victim.key))
+        if victim.parent is not None:
+            self._entries[victim.parent].children -= 1
+        del self._entries[victim.key]
+        self.evicted_pages += 1
+        return True
+
+    def evict_until(self, allocator, need_free: int) -> bool:
+        """LRU-evict refcount-0 leaves until the allocator has
+        ``need_free`` free pages (True) or nothing evictable remains
+        (False)."""
+        while allocator.free_count < need_free:
+            if not self._evict_one(allocator):
+                return False
+        return True
+
+    def clear(self, allocator) -> None:
+        """Return every cached page to the allocator (engine close()).
+        Active references must already be gone — a nonzero refcount
+        here is a lifecycle bug, not cache pressure."""
+        busy = [e for e in self._entries.values() if e.refcount > 0]
+        if busy:
+            raise RuntimeError(
+                f"prefix-cache clear with {len(busy)} entries still "
+                f"referenced (refcounts "
+                f"{[e.refcount for e in busy[:8]]}) — release requests "
+                f"before close()")
+        for ent in self._entries.values():
+            allocator.free(("prefix", ent.key))
+        self.evicted_pages += len(self._entries)
+        self._entries.clear()
+
+    # -- audits ------------------------------------------------------------
+
+    def total_pages(self) -> int:
+        return len(self._entries)
+
+    def hit_rate(self) -> Optional[float]:
+        seen = self.hit_pages + self.miss_pages
+        return self.hit_pages / seen if seen else None
+
+    def check_consistent(self, allocator) -> None:
+        """Drained-engine audit: every page the allocator still sees as
+        owned must be a cache page, and the books must balance —
+        free + cached == pool size. The with-cache analog of
+        ``PageAllocator.check_no_leak``."""
+        owners = allocator.owners()
+        cache_owned = 0
+        for owner, pages in owners.items():
+            if not (isinstance(owner, tuple) and len(owner) == 2
+                    and owner[0] == "prefix"):
+                raise RuntimeError(
+                    f"page leak past drain: owner {owner!r} still holds "
+                    f"{list(pages)}")
+            ent = self._entries.get(owner[1])
+            if ent is None or tuple(pages) != (ent.page,):
+                raise RuntimeError(
+                    f"prefix-cache books diverge from allocator for "
+                    f"owner {owner!r}: allocator={list(pages)}, "
+                    f"entry={ent}")
+            cache_owned += len(pages)
+        if allocator.free_count + cache_owned != allocator.num_pages:
+            raise RuntimeError(
+                f"page accounting broken: {allocator.free_count} free + "
+                f"{cache_owned} cached != pool {allocator.num_pages}")

@@ -1,0 +1,135 @@
+"""SLO-aware admission scheduling for the serving layer.
+
+Replaces the engine's built-in blocking FIFO (admit the head or admit
+nothing) with a policy that knows about service classes:
+
+- **Priority classes** (`Priority`): INTERACTIVE > NORMAL > BATCH.
+  Higher classes are admitted first when several requests fit.
+- **Max-queue-delay promotion**: a request that has waited longer than
+  ``promote_after_s`` gains one effective priority level per elapsed
+  interval (capped at INTERACTIVE), so BATCH work cannot wait forever
+  behind a steady INTERACTIVE stream.
+- **Bounded fairness**: admitting a later request over an earlier one
+  increments the earlier request's ``bypass_count``; once any request
+  has been bypassed ``max_bypass`` times it becomes the only admissible
+  candidate until it fits. Long prompts therefore cannot starve short
+  ones (short ones keep flowing while the long one's pages free up),
+  and short ones cannot starve the long head indefinitely (the bypass
+  bound eventually reserves the free list for it).
+- **Overload shedding**: requests queued past ``shed_after_s`` (and,
+  at submit time, beyond ``max_queue`` depth) are rejected with the
+  typed `ServerOverloaded` — the server turns it into a structured
+  error reply instead of an ever-growing queue of doomed work.
+
+The scheduler is duck-typed against the engine
+(``select(queue, fits, now)`` / ``shed(queue, now)``), so the engine
+stays importable without the serving package.
+
+Reference analog: the multi-stream priority scheduling of the
+reference's serving stack, rebuilt host-side over one jitted step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, List, Optional
+
+__all__ = ["Priority", "SLOConfig", "SLOScheduler", "ServerOverloaded"]
+
+
+class Priority(enum.IntEnum):
+    BATCH = 0
+    NORMAL = 1
+    INTERACTIVE = 2
+
+
+class ServerOverloaded(RuntimeError):
+    """Typed admission rejection: the queue is past its SLO. Carries a
+    client-actionable retry hint; the server serializes it as
+    ``{"error": "ServerOverloaded", "reason": ..., "retry_after_ms":
+    ...}``."""
+
+    def __init__(self, reason: str, retry_after_ms: int = 1000):
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after_ms = int(retry_after_ms)
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    # one effective priority level gained per this many seconds queued
+    promote_after_s: float = 1.0
+    # queued longer than this -> shed with ServerOverloaded (None = never)
+    shed_after_s: Optional[float] = 30.0
+    # submit-time depth bound (None = unbounded); checked by the server
+    max_queue: Optional[int] = None
+    # how many times a queued request may be jumped before it becomes
+    # the mandatory next admission
+    max_bypass: int = 4
+    retry_after_ms: int = 1000
+
+
+class SLOScheduler:
+    """Admission policy over the engine's wait queue.
+
+    ``select`` returns the queue INDEX to admit next (or None to admit
+    nothing this step); ``shed`` returns the requests to reject. Both
+    run on the engine thread; ``check_admission`` is the submit-time
+    depth gate and may run on server connection threads (it only reads
+    the depth it is handed)."""
+
+    def __init__(self, config: Optional[SLOConfig] = None):
+        self.cfg = config or SLOConfig()
+
+    # -- submit-time gate --------------------------------------------------
+
+    def check_admission(self, queued: int) -> None:
+        cfg = self.cfg
+        if cfg.max_queue is not None and queued >= cfg.max_queue:
+            raise ServerOverloaded(
+                f"queue depth {queued} at max_queue {cfg.max_queue}",
+                retry_after_ms=cfg.retry_after_ms)
+
+    # -- engine hooks ------------------------------------------------------
+
+    def effective_priority(self, req, now: float) -> int:
+        waited = max(0.0, now - req.stats.submit_t)
+        promo = int(waited / self.cfg.promote_after_s) \
+            if self.cfg.promote_after_s > 0 else 0
+        return min(int(Priority.INTERACTIVE), req.priority + promo)
+
+    def select(self, queue: List, fits: Callable[[object], bool],
+               now: float) -> Optional[int]:
+        if not queue:
+            return None
+        cfg = self.cfg
+        # fairness bound: a request bypassed too often is the only
+        # admissible candidate until it fits
+        starved = [r for r in queue
+                   if r.bypass_count >= cfg.max_bypass]
+        pool = starved if starved else list(queue)
+        # stable order: effective priority desc, then arrival
+        pool.sort(key=lambda r: (-self.effective_priority(r, now),
+                                 r.stats.submit_t))
+        for cand in pool:
+            if fits(cand):
+                return queue.index(cand)
+        return None
+
+    def note_admitted(self, req, queue: List, now: float) -> None:
+        """Called by the engine AFTER an admission COMMITS: charge one
+        bypass to every earlier-arrived request still queued. Charging
+        here (not in ``select``) keeps a failed/unwound admission from
+        accumulating phantom bypasses that would flip the queue into
+        starved-only mode without any real jump having happened."""
+        for other in queue:
+            if other.stats.submit_t < req.stats.submit_t:
+                other.bypass_count += 1
+
+    def shed(self, queue: List, now: float) -> List:
+        if self.cfg.shed_after_s is None:
+            return []
+        limit = self.cfg.shed_after_s
+        return [r for r in queue
+                if now - r.stats.submit_t > limit]

@@ -1,0 +1,563 @@
+"""Threaded socket front-end over the continuous-batching engine.
+
+Newline-JSON protocol (one JSON object per line, both directions):
+
+    -> {"op": "generate", "prompt": [1, 2, 3], "max_new_tokens": 8,
+        "priority": "interactive", "stream": true, "eos": 7}
+    <- {"rid": 0, "token": 17, "done": false}          # per token (stream)
+    <- {"rid": 0, "done": true, "tokens": [...], "stats": {...}}
+    -> {"op": "health"}
+    <- {"status": "ok", "active": 1, "queued": 0, "free_pages": 9, ...}
+    -> {"op": "stats"}     # metrics snapshot (JSON)
+    -> {"op": "metrics"}   # Prometheus text page (in "text")
+    -> {"op": "drain"}     # stop admitting, finish in-flight, close
+
+Typed failures are structured replies, never hangs: an overloaded
+queue answers ``{"error": "ServerOverloaded", "retry_after_ms": ...}``
+(serving/scheduler.py), a prefill whose retries exhausted answers
+``{"error": "PrefillFailed"}``, a drain answers in-flight requests
+normally and rejects new ones with ``{"error": "ServerDraining"}``.
+
+Threading model: the ENGINE THREAD exclusively owns the engine (it is
+not thread-safe) — connection threads parse requests and hand them
+over through an inbox queue; per-token streaming flows back through
+per-request outbox queues, so a slow client can never stall the decode
+step. Graceful drain: stop admitting, finish in-flight work, return
+every page, `engine.close()` (which asserts ``check_no_leak``).
+
+Fault sites (distributed/fault_inject.py): ``serving.request`` fires
+in the connection thread per request (clients get a retryable typed
+error); ``serving.prefill`` fires inside engine admission and is
+retried per the ``serving.prefill`` resilience policy.
+
+Run it: ``python -m paddle_tpu.serving.server --model gpt_125m``.
+
+Reference analog: the C serving API / AnalysisPredictor server loop
+(SURVEY §1 rows 7/12), TPU-native over one jitted decode step.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as queue_mod
+import socket
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .metrics import ServingMetrics
+from .prefix_cache import PrefixCache
+from .scheduler import Priority, ServerOverloaded, SLOScheduler
+
+__all__ = ["ServingServer", "client_request"]
+
+_PRIORITIES = {"batch": Priority.BATCH, "normal": Priority.NORMAL,
+               "interactive": Priority.INTERACTIVE}
+
+
+class _Pending:
+    """Engine-side record of one in-flight client request."""
+
+    __slots__ = ("outbox", "stream")
+
+    def __init__(self, stream: bool):
+        self.outbox: "queue_mod.Queue[Optional[Dict]]" = queue_mod.Queue()
+        self.stream = stream
+
+
+class ServingServer:
+    """In-process serving front-end (tests construct it directly; the
+    CLI entry below wraps it).
+
+    ``engine_kwargs`` pass through to `create_decode_engine`
+    (num_slots, page_size, num_pages, ...). ``prefix_cache=True``
+    builds a `PrefixCache` sized to the engine's page_size;
+    ``scheduler=None`` defaults to an `SLOScheduler` with stock
+    SLOConfig. ``prefill_retry=None`` resolves the ``serving.prefill``
+    site policy from distributed/resilience.py."""
+
+    def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
+                 scheduler=None, prefix_cache: bool = True,
+                 metrics: Optional[ServingMetrics] = None,
+                 prefill_retry="site", max_new_tokens_cap: int = 512,
+                 poll_interval_s: float = 0.02,
+                 max_engine_errors: int = 32, **engine_kwargs):
+        from ..inference import create_decode_engine
+        from ..distributed.resilience import get_retry_policy
+
+        self.host = host
+        self._requested_port = port
+        self.scheduler = scheduler if scheduler is not None \
+            else SLOScheduler()
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        page_size = int(engine_kwargs.get("page_size", 64))
+        self.prefix_cache = PrefixCache(page_size) if prefix_cache \
+            else None
+        if prefill_retry == "site":
+            prefill_retry = get_retry_policy("serving.prefill")
+        self.engine = create_decode_engine(
+            model, scheduler=self.scheduler,
+            prefix_cache=self.prefix_cache,
+            prefill_retry=prefill_retry,
+            on_complete=self._on_complete, **engine_kwargs)
+        self.max_new_tokens_cap = int(max_new_tokens_cap)
+        self.poll_interval_s = float(poll_interval_s)
+        self.max_engine_errors = int(max_engine_errors)
+        self._consec_errors = 0
+
+        self._inbox: "queue_mod.Queue[tuple]" = queue_mod.Queue()
+        self._admission_lock = threading.Lock()
+        self._pending: Dict[int, _Pending] = {}  # engine thread only
+        self._wake = threading.Event()
+        self._engine_done = threading.Event()
+        self._draining = False
+        self._stopping = False
+        self._started = False
+        self._listen_sock: Optional[socket.socket] = None
+        self._threads = []
+        self._conn_threads = []
+        self._conns = []
+        self._conns_lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.port: Optional[int] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> int:
+        """Bind, listen, and start the accept + engine threads.
+        Returns the bound port (OS-assigned when constructed with
+        port=0)."""
+        if self._started:
+            return self.port
+        self._listen_sock = socket.socket(socket.AF_INET,
+                                          socket.SOCK_STREAM)
+        self._listen_sock.setsockopt(socket.SOL_SOCKET,
+                                     socket.SO_REUSEADDR, 1)
+        self._listen_sock.bind((self.host, self._requested_port))
+        self._listen_sock.listen(64)
+        self.port = self._listen_sock.getsockname()[1]
+        self._started = True
+        for name, fn in (("engine", self._engine_loop),
+                         ("accept", self._accept_loop)):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"pt-serving-{name}")
+            t.start()
+            self._threads.append(t)
+        return self.port
+
+    def drain(self) -> None:
+        """Stop admitting new requests; in-flight and already-queued
+        work finishes normally."""
+        self._draining = True
+        self._wake.set()
+
+    def stop(self, timeout_s: float = 60.0) -> None:
+        """Graceful shutdown: drain, finish in-flight, return pages
+        (engine.close() asserts check_no_leak), close sockets."""
+        self._draining = True
+        self._stopping = True
+        self._wake.set()
+        for t in self._threads:
+            if t is threading.current_thread():
+                continue
+            t.join(timeout=timeout_s)
+        if self._listen_sock is not None:
+            try:
+                self._listen_sock.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+            threads = list(self._conn_threads)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in threads:
+            t.join(timeout=5.0)
+
+    def __enter__(self) -> "ServingServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- engine thread -----------------------------------------------------
+
+    def _engine_loop(self) -> None:
+        eng = self.engine
+        while True:
+            self._drain_inbox()
+            has_work = eng.num_queued or eng.num_active
+            if has_work:
+                try:
+                    before = eng.num_queued + eng.num_active
+                    eng.step()
+                    after = eng.num_queued + eng.num_active
+                    self._consec_errors = 0
+                    if after and after == before and not eng.num_active:
+                        # queued but nothing admissible and nothing
+                        # decoding: don't hot-spin on the free list
+                        time.sleep(self.poll_interval_s)
+                except Exception:
+                    # a failed prefill already unwound inside the
+                    # engine (request requeued, or FAILED with a typed
+                    # reply via on_complete) — the serving loop must
+                    # outlive it either way. A PERSISTENT step failure
+                    # (decode jit broken, pools consumed) must not
+                    # wedge clients forever: past the consecutive-error
+                    # cap, fail everything typed and stop admitting.
+                    self.metrics.counter("engine_errors_total").add()
+                    self._consec_errors += 1
+                    if self._consec_errors >= self.max_engine_errors:
+                        self._fail_engine()
+                    time.sleep(self.poll_interval_s)
+                continue
+            if self._stopping and self._inbox.empty():
+                try:
+                    eng.close()
+                finally:
+                    # unblock any conn thread still waiting on a
+                    # pending outbox (evicted replies already sent by
+                    # close() -> on_complete)
+                    for p in self._pending.values():
+                        p.outbox.put(None)
+                    self._pending.clear()
+                    self._engine_done.set()
+                return
+            self._wake.wait(timeout=self.poll_interval_s)
+            self._wake.clear()
+
+    def _fail_engine(self) -> None:
+        """Terminal engine failure (engine thread): every in-flight and
+        inboxed client gets a typed EngineFailed reply, the engine's
+        pages are torn down best-effort, and the server stops admitting
+        (health keeps answering with status "draining")."""
+        self._draining = True
+        err = {"error": "EngineFailed",
+               "reason": f"decode engine failed "
+                         f"{self._consec_errors} consecutive steps; "
+                         f"server stopped admitting"}
+        try:
+            self.engine.close()  # sends ServerEvicted via on_complete
+        except Exception:
+            pass
+        for p in self._pending.values():
+            p.outbox.put(dict(err))
+            p.outbox.put(None)
+        self._pending.clear()
+        while True:
+            try:
+                _payload, p = self._inbox.get_nowait()
+            except queue_mod.Empty:
+                break
+            p.outbox.put(dict(err))
+            p.outbox.put(None)
+
+    def _drain_inbox(self) -> None:
+        while True:
+            try:
+                payload, pending = self._inbox.get_nowait()
+            except queue_mod.Empty:
+                return
+
+            def on_token(rid, tok, done, _p=pending):
+                if _p.stream:
+                    _p.outbox.put({"rid": rid, "token": int(tok),
+                                   "done": bool(done)})
+
+            try:
+                rid = self.engine.submit(
+                    np.asarray(payload["prompt"], np.int32),
+                    max_new_tokens=payload["max_new_tokens"],
+                    eos_token=payload.get("eos"),
+                    priority=payload.get("priority", Priority.NORMAL),
+                    on_token=on_token)
+            except Exception as e:
+                # broad on purpose: this runs on the ENGINE thread, and
+                # one malformed payload (e.g. prompt [null] -> numpy
+                # TypeError) must cost that client a BadRequest, never
+                # the thread every other client depends on
+                pending.outbox.put({"error": "BadRequest",
+                                    "reason": f"{type(e).__name__}: {e}"})
+                pending.outbox.put(None)
+                continue
+            self._pending[rid] = pending
+
+    def _on_complete(self, req) -> None:
+        """Engine callback: terminal state for a request (any state)."""
+        self.metrics.observe_request(req)
+        # the reply below is the server's result delivery — drop the
+        # engine's retained copy or a long-lived server accumulates
+        # every DecodeRequest (and its outbox closure) ever finished
+        self.engine.result(req.req_id, pop=True)
+        pending = self._pending.pop(req.req_id, None)
+        if pending is None:
+            return  # engine used without the server front-end
+        if req.state == "done":
+            msg: Dict[str, Any] = {
+                "rid": req.req_id, "done": True,
+                "tokens": [int(t) for t in req.tokens],
+                "generated": [int(t) for t in req.generated],
+                "stats": _json_stats(req.stats)}
+        elif req.state == "shed":
+            cfg = getattr(self.scheduler, "cfg", None)
+            msg = {"rid": req.req_id, "error": "ServerOverloaded",
+                   "reason": "queued past SLO shed_after_s",
+                   "retry_after_ms": getattr(cfg, "retry_after_ms", 1000)}
+        elif req.state == "failed":
+            msg = {"rid": req.req_id, "error": "PrefillFailed",
+                   "attempts": req.stats.prefill_attempts}
+        else:  # evicted (drain/close)
+            msg = {"rid": req.req_id, "error": "ServerEvicted",
+                   "reason": "server shutting down"}
+        pending.outbox.put(msg)
+        pending.outbox.put(None)
+
+    # -- connection threads ------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                self._listen_sock.settimeout(0.2)
+                conn, _addr = self._listen_sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True, name="pt-serving-conn")
+            with self._conns_lock:
+                self._conns.append(conn)
+                # prune finished threads so a long-lived server doesn't
+                # accumulate one Thread object per connection ever seen
+                self._conn_threads = [x for x in self._conn_threads
+                                      if x.is_alive()]
+                self._conn_threads.append(t)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("r", encoding="utf-8")
+        wfile = conn.makefile("w", encoding="utf-8")
+
+        def send(obj: Dict) -> None:
+            wfile.write(json.dumps(obj) + "\n")
+            wfile.flush()
+
+        try:
+            for line in rfile:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError as e:
+                    send({"error": "BadRequest", "reason": str(e)})
+                    continue
+                try:
+                    self._handle(msg, send)
+                except ServerOverloaded as e:
+                    # submit-gate rejections get their own counter:
+                    # engine-side sheds count under requests_total +
+                    # shed_total, and mixing the two would let
+                    # shed/requests ratios exceed 100%
+                    self.metrics.counter("rejected_total").add()
+                    send({"error": "ServerOverloaded",
+                          "reason": e.reason,
+                          "retry_after_ms": e.retry_after_ms})
+                except Exception as e:  # typed reply, never a hang
+                    send({"error": type(e).__name__, "reason": str(e)})
+        except (OSError, ValueError):
+            pass  # client went away / socket torn down by stop()
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conns_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    def _handle(self, msg: Dict, send) -> None:
+        from ..distributed.fault_inject import InjectedFault, fault_point
+
+        op = msg.get("op", "generate")
+        if op == "health":
+            send(self._health())
+            return
+        if op == "stats":
+            send({"stats": self.metrics.snapshot(),
+                  "prefix_cache": self._cache_stats()})
+            return
+        if op == "metrics":
+            send({"text": self.metrics.prometheus_text()})
+            return
+        if op == "drain":
+            self.drain()
+            send({"ok": True, "status": "draining"})
+            return
+        if op != "generate":
+            send({"error": "BadRequest", "reason": f"unknown op {op!r}"})
+            return
+        if self._draining:
+            send({"error": "ServerDraining",
+                  "reason": "server is draining; not admitting"})
+            return
+        try:
+            # per-request fault site: a transient front-end failure is
+            # a retryable typed reply, not a dropped connection
+            fault_point("serving.request")
+        except InjectedFault as e:
+            send({"error": "TransientServerError", "reason": str(e),
+                  "retryable": True})
+            return
+        prompt = msg.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            send({"error": "BadRequest",
+                  "reason": "prompt must be a non-empty token list"})
+            return
+        mnt = int(msg.get("max_new_tokens", 16))
+        if mnt < 1 or mnt > self.max_new_tokens_cap:
+            send({"error": "BadRequest",
+                  "reason": f"max_new_tokens must be in [1, "
+                            f"{self.max_new_tokens_cap}]"})
+            return
+        prio = msg.get("priority", "normal")
+        if prio not in _PRIORITIES:
+            send({"error": "BadRequest",
+                  "reason": f"priority must be one of "
+                            f"{sorted(_PRIORITIES)}"})
+            return
+        pending = _Pending(stream=bool(msg.get("stream", False)))
+        with self._admission_lock:
+            # submit-time overload gate, atomic with the enqueue so
+            # concurrent connections can't all slip under the depth
+            # bound (raises ServerOverloaded -> typed reply upstream)
+            check = getattr(self.scheduler, "check_admission", None)
+            if check is not None:
+                check(self.engine.num_queued + self._inbox.qsize())
+            self._inbox.put(({"prompt": prompt, "max_new_tokens": mnt,
+                              "eos": msg.get("eos"),
+                              "priority": int(_PRIORITIES[prio])},
+                             pending))
+        self._wake.set()
+        while True:
+            try:
+                out = pending.outbox.get(timeout=1.0)
+            except queue_mod.Empty:
+                if self._engine_done.is_set():
+                    # closes the submit-vs-shutdown race: the engine
+                    # thread has fully EXITED (mere stop() intent is
+                    # not enough — graceful shutdown still finishes
+                    # in-flight work and delivers real results), so
+                    # this request can never complete; answer instead
+                    # of hanging
+                    send({"error": "ServerEvicted",
+                          "reason": "server shutting down"})
+                    return
+                continue
+            if out is None:
+                return
+            send(out)
+
+    # -- introspection -----------------------------------------------------
+
+    def _health(self) -> Dict:
+        return {"status": "draining" if self._draining else "ok",
+                "active": self.engine.num_active,
+                "queued": self.engine.num_queued,
+                "free_pages": self.engine.free_pages,
+                "num_pages": self.engine.num_pages,
+                "steps": self.engine.steps,
+                "uptime_s": round(time.monotonic() - self._t0, 3)}
+
+    def _cache_stats(self) -> Optional[Dict]:
+        pc = self.prefix_cache
+        if pc is None:
+            return None
+        return {"pages": pc.total_pages(), "hit_pages": pc.hit_pages,
+                "miss_pages": pc.miss_pages,
+                "inserted_pages": pc.inserted_pages,
+                "evicted_pages": pc.evicted_pages,
+                "hit_rate": pc.hit_rate()}
+
+
+def _json_stats(stats) -> Dict:
+    out = stats.to_dict()
+    return {k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in out.items() if v is not None}
+
+
+def client_request(host: str, port: int, payload: Dict,
+                   timeout_s: float = 120.0, on_token=None) -> Dict:
+    """Minimal blocking client: send one request, collect streamed
+    tokens through ``on_token(token)``, return the final reply."""
+    with socket.create_connection((host, port), timeout=timeout_s) as s:
+        rfile = s.makefile("r", encoding="utf-8")
+        wfile = s.makefile("w", encoding="utf-8")
+        wfile.write(json.dumps(payload) + "\n")
+        wfile.flush()
+        for line in rfile:
+            msg = json.loads(line)
+            if "token" in msg:  # streamed chunk (its "done" flag marks
+                if on_token is not None:  # the LAST token, not the
+                    on_token(msg["token"])  # final summary message)
+                continue
+            return msg  # final reply: summary, admin reply, or error
+    raise ConnectionError("server closed the connection mid-request")
+
+
+def _build_model(name: str):
+    import paddle_tpu as pt
+    from ..models.gpt import (GPTForCausalLM, gpt_125m, gpt_1p3b,
+                              gpt_350m, gpt_tiny)
+    configs = {"gpt_tiny": gpt_tiny, "gpt_125m": gpt_125m,
+               "gpt_350m": gpt_350m, "gpt_1p3b": gpt_1p3b}
+    if name not in configs:
+        raise SystemExit(f"unknown --model {name!r}; choose from "
+                         f"{sorted(configs)}")
+    pt.seed(0)
+    model = GPTForCausalLM(configs[name]())
+    model.eval()
+    return model
+
+
+def main(argv=None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="paddle_tpu serving front-end (newline-JSON)")
+    parser.add_argument("--model", default="gpt_125m")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8765)
+    parser.add_argument("--num-slots", type=int, default=4)
+    parser.add_argument("--page-size", type=int, default=64)
+    parser.add_argument("--no-prefix-cache", action="store_true")
+    args = parser.parse_args(argv)
+
+    model = _build_model(args.model)
+    server = ServingServer(model, host=args.host, port=args.port,
+                           prefix_cache=not args.no_prefix_cache,
+                           num_slots=args.num_slots,
+                           page_size=args.page_size)
+    port = server.start()
+    print(f"[paddle_tpu.serving] listening on {args.host}:{port} "
+          f"(model {args.model}); newline-JSON, see module docstring",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("[paddle_tpu.serving] draining ...", flush=True)
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

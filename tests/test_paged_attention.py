@@ -340,12 +340,12 @@ class TestContinuousBatching:
         def boom(*a, **k):
             raise ConnectionError("transport down")
 
-        eng._prefill_jit = boom
+        eng._prefill_jits = {False: boom}
         with pytest.raises(ConnectionError):
             eng.step()
         assert eng.allocator.free_count == eng.num_pages
         assert len(eng._queue) == 1 and eng._queue[0].req_id == r
-        eng._prefill_jit = None  # transport recovers -> rebuild
+        eng._prefill_jits = {}  # transport recovers -> rebuild
         out = eng.run()
         ref = m.generate(pt.Tensor(prompt[None]), max_new_tokens=4,
                          temperature=0.0, use_jit=True)

@@ -1,0 +1,642 @@
+"""Serving layer (paddle_tpu/serving): prefix cache, SLO scheduler,
+socket server, per-request observability, fault robustness.
+
+The two contracts the suite pins (ISSUE r7 acceptance):
+
+- greedy outputs with prefix caching are BIT-IDENTICAL to the uncached
+  engine for the same request stream, and `PageAllocator.check_no_leak`
+  passes after drain in every serving test;
+- with ``serving.prefill`` faults armed the server retries transients,
+  sheds on overload with a typed reply, and drains cleanly — no leaked
+  pages, no hung clients.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.monitor import StatRegistry
+from paddle_tpu.distributed import fault_inject as fi
+from paddle_tpu.inference import PageAllocator, create_decode_engine
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+from paddle_tpu.serving import (Priority, PrefixCache, ServerOverloaded,
+                                ServingMetrics, ServingServer, SLOConfig,
+                                SLOScheduler, client_request)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+def _engine(m, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("num_pages", 12)
+    return create_decode_engine(m, **kw)
+
+
+def _shared_prefix_prompts(shared_len=19, tails=(3, 5, 7, 9)):
+    shared = (np.arange(shared_len, dtype=np.int32) * 5) % 100
+    return [np.concatenate([shared,
+                            (np.arange(t, dtype=np.int32) + 3 * t) % 100])
+            for t in tails]
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache: unit semantics (no model)
+# ---------------------------------------------------------------------------
+
+class TestPrefixCacheUnit:
+    def test_shareable_blocks_never_cover_whole_prompt(self):
+        pc = PrefixCache(8)
+        # 16 tokens = 2 full pages, but the last token must stay in
+        # the suffix -> only 1 shareable block
+        assert pc._shareable_blocks(np.arange(16)) == 1
+        assert pc._shareable_blocks(np.arange(17)) == 2
+        assert pc._shareable_blocks(np.arange(8)) == 0
+        assert pc._shareable_blocks(np.arange(9)) == 1
+
+    def test_match_insert_refcount_evict_cycle(self):
+        pc = PrefixCache(4)
+        alloc = PageAllocator(8)
+        prompt = np.arange(11, dtype=np.int32)  # 2 shareable blocks
+        assert pc.match(prompt) == ((), [])
+        pages = alloc.alloc("req0", 3)
+        row = np.array(pages + [99], dtype=np.int32)
+        keys = pc.insert(prompt, row, alloc, "req0", 4, ())
+        assert len(keys) == 2 and pc.total_pages() == 2
+        # the two full pages now belong to the cache, not the request
+        assert sum(len(v) for k, v in alloc.owners().items()
+                   if k == "req0") == 1
+        mk, mp = pc.match(prompt)
+        assert mk == keys and mp == [int(row[0]), int(row[1])]
+        # referenced entries are not evictable
+        assert pc.evictable_pages() == 0
+        assert not pc.evict_until(alloc, alloc.num_pages)
+        pc.release(keys)
+        assert pc.evictable_pages() == 2
+        # leaf-first LRU teardown
+        assert pc.evict_until(alloc, alloc.free_count + 2)
+        assert pc.total_pages() == 0
+        alloc.free("req0")
+        alloc.check_no_leak()
+
+    def test_divergent_prompt_shares_only_common_blocks(self):
+        pc = PrefixCache(4)
+        alloc = PageAllocator(8)
+        a = np.arange(11, dtype=np.int32)
+        b = np.concatenate([a[:4], a[4:] + 50])  # diverges in block 1
+        row = np.array(alloc.alloc("a", 3) + [99], dtype=np.int32)
+        keys = pc.insert(a, row, alloc, "a", 4, ())
+        mk, mp = pc.match(b)
+        assert len(mk) == 1 and mp == [int(row[0])]
+        pc.release(keys)
+        pc.clear(alloc)
+        alloc.free("a")
+        alloc.check_no_leak()
+
+    def test_clear_refuses_referenced_entries(self):
+        pc = PrefixCache(4)
+        alloc = PageAllocator(4)
+        row = np.array(alloc.alloc("a", 2) + [0, 0], dtype=np.int32)
+        pc.insert(np.arange(9, dtype=np.int32), row, alloc, "a", 4, ())
+        with pytest.raises(RuntimeError, match="still referenced"):
+            pc.clear(alloc)
+
+    def test_allocator_transfer_bookkeeping(self):
+        alloc = PageAllocator(4)
+        pages = alloc.alloc(1, 3)
+        alloc.transfer(1, ("prefix", b"k"), pages[:2])
+        assert alloc.owners()[("prefix", b"k")] == tuple(pages[:2])
+        with pytest.raises(RuntimeError, match="not owned"):
+            alloc.transfer(1, 2, [pages[0]])
+        alloc.free(1)
+        alloc.free(("prefix", b"k"))
+        alloc.check_no_leak()
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache through the engine: the bit-identical contract
+# ---------------------------------------------------------------------------
+
+class TestPrefixCacheEngine:
+    def test_cached_outputs_bit_identical_to_uncached(self, model):
+        """Same request stream, prefix cache on vs off: greedy tokens
+        must match bit for bit (the acceptance pin). More requests
+        than slots so recycling and mid-flight admission are live."""
+        prompts = _shared_prefix_prompts()
+        eng0 = _engine(model)
+        out0 = None
+        rids0 = [eng0.submit(p, max_new_tokens=12) for p in prompts]
+        out0 = eng0.run()
+        eng0.close()
+        pc = PrefixCache(8)
+        eng1 = _engine(model, prefix_cache=pc)
+        rids1 = [eng1.submit(p, max_new_tokens=12) for p in prompts]
+        out1 = eng1.run()
+        assert pc.hit_pages > 0  # the shared prefix was actually reused
+        for r0, r1 in zip(rids0, rids1):
+            np.testing.assert_array_equal(out0[r0], out1[r1])
+        eng1.close()
+        eng1.allocator.check_no_leak()
+
+    def test_cache_survives_batches_and_skips_prefill_pages(self, model):
+        """Second wave with the same system prompt hits the cache
+        (pages survive request completion at refcount 0) and still
+        matches the per-sequence dense reference."""
+        pc = PrefixCache(8)
+        eng = _engine(model, prefix_cache=pc)
+        prompts = _shared_prefix_prompts(tails=(3, 6))
+        r0 = [eng.submit(p, max_new_tokens=10) for p in prompts]
+        eng.run()
+        hits_before = pc.hit_pages
+        prompts2 = _shared_prefix_prompts(tails=(4, 8))
+        r2 = [eng.submit(p, max_new_tokens=10) for p in prompts2]
+        out = eng.run()
+        assert pc.hit_pages > hits_before
+        for p, rid in zip(prompts2, r2):
+            ref = model.generate(pt.Tensor(p[None]), max_new_tokens=10,
+                                 temperature=0.0, use_jit=True,
+                                 kv_cache="paged", page_size=8)
+            np.testing.assert_array_equal(out[rid],
+                                          np.asarray(ref.value)[0])
+        stats = eng.result(r0[0])  # drained store popped by run()
+        assert stats is None
+        eng.close()
+        eng.allocator.check_no_leak()
+
+    def test_page_size_mismatch_rejected_at_construction(self, model):
+        with pytest.raises(ValueError, match="page_size"):
+            _engine(model, prefix_cache=PrefixCache(16))  # engine is 8
+
+    def test_cache_eviction_under_page_pressure(self, model):
+        """A pool too small to keep the cache AND serve a new request:
+        refcount-0 entries are LRU-evicted so admission proceeds;
+        outputs stay correct."""
+        pc = PrefixCache(8)
+        eng = _engine(model, num_pages=6, prefix_cache=pc)
+        a = (np.arange(17, dtype=np.int32) * 3) % 100
+        ra = eng.submit(a, max_new_tokens=8)   # needs 4 pages, caches 2
+        eng.run()
+        assert pc.total_pages() == 2
+        b = (np.arange(20, dtype=np.int32) * 7 + 1) % 100
+        # 20 + 15 = 35 tokens -> 5 pages, but only 4 are free: the
+        # cache must LRU-evict to admit
+        rb = eng.submit(b, max_new_tokens=15)
+        out = eng.run()
+        assert pc.evicted_pages >= 1
+        ref = model.generate(pt.Tensor(b[None]), max_new_tokens=15,
+                             temperature=0.0, use_jit=True)
+        np.testing.assert_array_equal(out[rb], np.asarray(ref.value)[0])
+        assert ra != rb
+        eng.close()
+        eng.allocator.check_no_leak()
+
+
+# ---------------------------------------------------------------------------
+# Engine lifecycle: streaming, stats, close()
+# ---------------------------------------------------------------------------
+
+class TestEngineLifecycle:
+    def test_streaming_matches_final_sequence(self, model):
+        """Satellite: streamed token sequence == final returned
+        sequence for greedy decode, ragged batch with a MID-FLIGHT
+        admit; the last streamed token carries done=True."""
+        eng = _engine(model)
+        streamed = {}
+        flags = {}
+
+        def cb(rid, tok, done):
+            streamed.setdefault(rid, []).append(tok)
+            flags.setdefault(rid, []).append(done)
+
+        r0 = eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=10,
+                        on_token=cb)
+        eng.step()
+        eng.step()
+        # mid-flight admission while r0 is decoding
+        r1 = eng.submit((np.arange(9, dtype=np.int32) * 3) % 100,
+                        max_new_tokens=6, on_token=cb)
+        out = eng.run()
+        for rid in (r0, r1):
+            gen = out[rid][len(out[rid]) -
+                           len(streamed[rid]):]
+            np.testing.assert_array_equal(np.asarray(streamed[rid]), gen)
+            assert flags[rid][-1] is True
+            assert not any(flags[rid][:-1])
+        eng.close()
+
+    def test_per_request_stats_record(self, model):
+        """Satellite: admit time, prefill ms, first-token time and
+        tokens emitted are exposed on completion."""
+        done = []
+        eng = _engine(model, on_complete=done.append)
+        eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=4)
+        eng.run()
+        (req,) = done
+        st = req.stats
+        assert req.state == "done" and st.tokens_out == 4
+        assert st.submit_t <= st.admit_t <= st.first_token_t \
+            <= st.finish_t
+        assert st.prefill_ms > 0 and st.prefill_attempts == 1
+        d = st.to_dict()
+        assert d["ttft_s"] >= 0 and d["queue_delay_s"] >= 0
+        assert d["tpot_s"] >= 0 and d["prompt_len"] == 5
+        eng.close()
+
+    def test_close_mid_flight_evicts_and_frees(self, model):
+        """Satellite: close() evicts active slots, returns their
+        pages, and passes check_no_leak — the early-exit path that
+        used to leak engine state."""
+        done = []
+        eng = _engine(model, on_complete=done.append)
+        eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=30)
+        eng.submit(np.arange(9, dtype=np.int32), max_new_tokens=30)
+        eng.submit(np.arange(60, dtype=np.int32), max_new_tokens=30)
+        eng.step()
+        assert eng.num_active > 0
+        eng.close()  # asserts check_no_leak internally
+        assert eng.num_active == 0 and eng.num_queued == 0
+        states = {r.state for r in done}
+        assert states == {"evicted"}
+        assert len(done) == 3
+
+
+# ---------------------------------------------------------------------------
+# SLO scheduler
+# ---------------------------------------------------------------------------
+
+class _FakeReq:
+    def __init__(self, rid, submit_t, priority=Priority.NORMAL):
+        from paddle_tpu.inference.continuous_batching import RequestStats
+        self.req_id = rid
+        self.priority = int(priority)
+        self.stats = RequestStats(submit_t=submit_t)
+        self.bypass_count = 0
+        self.state = "queued"
+        self.done = False
+
+
+class TestSLOScheduler:
+    def test_priority_order_and_promotion(self):
+        s = SLOScheduler(SLOConfig(promote_after_s=1.0))
+        now = 100.0
+        batch_old = _FakeReq(0, now - 2.5, Priority.BATCH)
+        inter_new = _FakeReq(1, now - 0.1, Priority.INTERACTIVE)
+        norm_new = _FakeReq(2, now - 0.1, Priority.NORMAL)
+        q = [batch_old, norm_new, inter_new]
+        # aged BATCH promoted to INTERACTIVE ties with the interactive
+        # request; earlier arrival wins
+        assert s.effective_priority(batch_old, now) == Priority.INTERACTIVE
+        assert s.select(q, lambda r: True, now) == 0
+        # without aging, interactive wins over normal
+        q2 = [norm_new, inter_new]
+        assert s.select(q2, lambda r: True, now) == 1
+
+    def test_bounded_fairness_blocks_bypass(self):
+        s = SLOScheduler(SLOConfig(max_bypass=2, promote_after_s=1e9))
+        now = 10.0
+        big = _FakeReq(0, now - 1.0)          # never fits (yet)
+        fits = lambda r: r is not big          # noqa: E731
+        q = [big, _FakeReq(1, now), _FakeReq(2, now), _FakeReq(3, now)]
+        # admission COMMITS charge the bypass (note_admitted), exactly
+        # as the engine drives it
+        idx = s.select(q, fits, now)
+        assert idx == 1
+        s.note_admitted(q.pop(idx), q, now)    # bypass 1
+        idx = s.select(q, fits, now)
+        assert idx == 1
+        s.note_admitted(q.pop(idx), q, now)    # bypass 2
+        # big now at max_bypass: nothing else may jump it
+        assert s.select(q, fits, now) is None
+        assert s.select(q, lambda r: True, now) == 0
+
+    def test_failed_admission_charges_no_bypass(self):
+        """select() alone must NOT move bypass_count — an admission
+        that later unwinds would otherwise flip the queue into
+        starved-only mode with no real jump having happened."""
+        s = SLOScheduler(SLOConfig(max_bypass=2, promote_after_s=1e9))
+        now = 10.0
+        big = _FakeReq(0, now - 1.0)
+        q = [big, _FakeReq(1, now)]
+        for _ in range(10):
+            assert s.select(q, lambda r: r is not big, now) == 1
+        assert big.bypass_count == 0
+
+    def test_shed_and_admission_check(self):
+        s = SLOScheduler(SLOConfig(shed_after_s=5.0, max_queue=2))
+        now = 50.0
+        fresh, stale = _FakeReq(0, now - 1), _FakeReq(1, now - 9)
+        assert s.shed([fresh, stale], now) == [stale]
+        s.check_admission(1)
+        with pytest.raises(ServerOverloaded) as ei:
+            s.check_admission(2)
+        assert ei.value.retry_after_ms > 0
+
+    def test_engine_shed_marks_state(self, model):
+        done = []
+        sched = SLOScheduler(SLOConfig(shed_after_s=0.0))
+        eng = _engine(model, scheduler=sched, on_complete=done.append)
+        eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=4)
+        time.sleep(0.01)
+        eng.run()
+        assert [r.state for r in done] == ["shed"]
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Socket server (CI fast-lane smoke: in-process loopback, 3 clients)
+# ---------------------------------------------------------------------------
+
+class TestServer:
+    def _serve(self, model, **kw):
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("max_seq_len", 96)
+        kw.setdefault("num_pages", 12)
+        # fresh registry: counters must not bleed across tests through
+        # the process-global StatRegistry
+        kw.setdefault("metrics", ServingMetrics(registry=StatRegistry()))
+        return ServingServer(model, **kw)
+
+    def test_three_concurrent_clients_end_to_end(self, model):
+        srv = self._serve(model)
+        port = srv.start()
+        results = {}
+
+        def client(i):
+            toks = []
+            rep = client_request("127.0.0.1", port, {
+                "op": "generate", "prompt": list(range(1, 6 + i)),
+                "max_new_tokens": 6, "stream": True,
+                "priority": "interactive"}, on_token=toks.append)
+            results[i] = (rep, toks)
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+        assert len(results) == 3
+        for i, (rep, toks) in results.items():
+            assert "error" not in rep, rep
+            assert rep["generated"] == toks
+            assert rep["stats"]["tokens_out"] == 6
+        h = client_request("127.0.0.1", port, {"op": "health"})
+        assert h["status"] == "ok" and h["free_pages"] == 12
+        st = client_request("127.0.0.1", port, {"op": "stats"})
+        assert st["stats"]["counters"]["requests_total"] == 3
+        assert st["stats"]["counters"]["tokens_generated_total"] == 18
+        mx = client_request("127.0.0.1", port, {"op": "metrics"})
+        assert "serving_ttft_ms_bucket" in mx["text"]
+        assert "serving_requests_total 3" in mx["text"]
+        # the reply IS the delivery: the engine must not retain
+        # finished requests for the server's lifetime
+        assert not srv.engine._finished
+        srv.stop()  # graceful drain; close() asserts check_no_leak
+        srv.engine.allocator.check_no_leak()
+
+    def test_bad_requests_get_typed_replies(self, model):
+        srv = self._serve(model)
+        port = srv.start()
+        cases = [
+            ({"op": "generate", "prompt": []}, "BadRequest"),
+            ({"op": "generate", "prompt": [1], "max_new_tokens": 0},
+             "BadRequest"),
+            ({"op": "generate", "prompt": [1], "priority": "vip"},
+             "BadRequest"),
+            ({"op": "nope"}, "BadRequest"),
+            ({"op": "generate", "prompt": [1] * 95,
+              "max_new_tokens": 50}, "BadRequest"),  # > max_seq_len
+            # non-integer prompt elements die in np.asarray on the
+            # ENGINE thread — must cost this client a BadRequest, not
+            # the thread every other client depends on
+            ({"op": "generate", "prompt": [None],
+              "max_new_tokens": 2}, "BadRequest"),
+        ]
+        for payload, err in cases:
+            rep = client_request("127.0.0.1", port, payload)
+            assert rep.get("error") == err, (payload, rep)
+        # the engine thread survived all of the above
+        rep = client_request("127.0.0.1", port, {
+            "op": "generate", "prompt": [1, 2, 3], "max_new_tokens": 2})
+        assert "error" not in rep and len(rep["generated"]) == 2
+        srv.stop()
+
+    def test_drain_rejects_new_finishes_inflight(self, model):
+        srv = self._serve(model)
+        port = srv.start()
+        got = {}
+
+        def slow_client():
+            got["rep"] = client_request("127.0.0.1", port, {
+                "op": "generate", "prompt": [1, 2, 3],
+                "max_new_tokens": 12})
+
+        t = threading.Thread(target=slow_client)
+        t.start()
+        time.sleep(0.05)
+        rep = client_request("127.0.0.1", port, {"op": "drain"})
+        assert rep.get("status") == "draining"
+        rep2 = client_request("127.0.0.1", port, {
+            "op": "generate", "prompt": [4], "max_new_tokens": 2})
+        assert rep2.get("error") == "ServerDraining"
+        t.join(timeout=180)
+        assert "error" not in got["rep"], got["rep"]
+        assert len(got["rep"]["generated"]) == 12
+        srv.stop()
+        srv.engine.allocator.check_no_leak()
+
+    def test_persistent_engine_failure_escalates_typed(self, model):
+        """A decode step that fails every time must not wedge clients:
+        past max_engine_errors the server fails everything with a
+        typed reply and stops admitting."""
+        srv = self._serve(model, max_engine_errors=2)
+        port = srv.start()
+
+        def boom():
+            raise RuntimeError("decode jit broken")
+
+        srv.engine.step = boom
+        rep = client_request("127.0.0.1", port, {
+            "op": "generate", "prompt": [1, 2, 3],
+            "max_new_tokens": 4}, timeout_s=60)
+        assert rep.get("error") in ("EngineFailed", "ServerEvicted"), rep
+        h = client_request("127.0.0.1", port, {"op": "health"})
+        assert h["status"] == "draining"
+        rep2 = client_request("127.0.0.1", port, {
+            "op": "generate", "prompt": [4], "max_new_tokens": 2})
+        assert rep2.get("error") == "ServerDraining"
+        srv.stop()
+        srv.engine.allocator.check_no_leak()
+
+    def test_overload_sheds_with_typed_reply(self, model):
+        srv = self._serve(
+            model, scheduler=SLOScheduler(SLOConfig(max_queue=1)))
+        port = srv.start()
+        outcomes = []
+        lock = threading.Lock()
+
+        def client(i):
+            rep = client_request("127.0.0.1", port, {
+                "op": "generate", "prompt": list(range(1, 30)),
+                "max_new_tokens": 12})
+            with lock:
+                outcomes.append(rep)
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+        shed = [r for r in outcomes
+                if r.get("error") == "ServerOverloaded"]
+        ok = [r for r in outcomes if "error" not in r]
+        assert len(outcomes) == 6
+        assert shed, outcomes  # at least one typed overload reply
+        assert ok              # and the system still served work
+        assert all("retry_after_ms" in r for r in shed)
+        srv.stop()
+        srv.engine.allocator.check_no_leak()
+
+
+# ---------------------------------------------------------------------------
+# Fault injection: serving.request / serving.prefill (acceptance)
+# ---------------------------------------------------------------------------
+
+class TestServingFaults:
+    def test_prefill_transient_retried_bit_identical(self, model):
+        """One injected transient at serving.prefill: the site policy
+        retries it invisibly; output matches the fault-free run."""
+        from paddle_tpu.distributed.resilience import get_retry_policy
+        prompt = np.arange(5, dtype=np.int32)
+        eng0 = _engine(model)
+        r0 = eng0.submit(prompt, max_new_tokens=6)
+        ref = eng0.run()[r0]
+        eng0.close()
+
+        fi.get_injector().arm("serving.prefill", at_calls=[1])
+        eng = _engine(
+            model, prefill_retry=get_retry_policy("serving.prefill"))
+        r = eng.submit(prompt, max_new_tokens=6)
+        out = eng.run()
+        assert fi.get_injector().counts("serving.prefill")["fired"] == 1
+        np.testing.assert_array_equal(out[r], ref)
+        eng.close()
+
+    def test_prefill_persistent_fault_fails_request_typed(self, model):
+        """Every prefill attempt faults: after max_prefill_attempts
+        admission rounds the request FAILS (typed, observable) instead
+        of wedging the queue; pages all return."""
+        from paddle_tpu.distributed.resilience import RetryPolicy
+        fi.get_injector().arm("serving.prefill", probability=1.0)
+        done = []
+        eng = _engine(model, on_complete=done.append,
+                      prefill_retry=RetryPolicy(max_attempts=2,
+                                                base_delay_s=0.0),
+                      max_prefill_attempts=2)
+        eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=4)
+        for _ in range(4):
+            try:
+                eng.step()
+            except Exception:
+                pass
+            if done:
+                break
+        assert [r.state for r in done] == ["failed"]
+        assert done[0].stats.prefill_attempts == 2
+        eng.close()
+        eng.allocator.check_no_leak()
+
+    def test_server_under_prefill_faults_no_hung_clients(self, model):
+        """Acceptance: faults armed on serving.prefill AND
+        serving.request, six concurrent clients — every client gets a
+        terminal reply (success or typed error), the server drains
+        clean, zero pages leak."""
+        fi.get_injector().arm("serving.prefill", probability=0.5,
+                              max_faults=3, seed=7)
+        fi.get_injector().arm("serving.request", at_calls=[2])
+        srv = ServingServer(model, num_slots=2, page_size=8,
+                            max_seq_len=96, num_pages=12,
+                            metrics=ServingMetrics(
+                                registry=StatRegistry()))
+        port = srv.start()
+        outcomes = []
+        lock = threading.Lock()
+
+        def client(i):
+            rep = client_request("127.0.0.1", port, {
+                "op": "generate", "prompt": list(range(1, 7 + i)),
+                "max_new_tokens": 5}, timeout_s=180)
+            with lock:
+                outcomes.append(rep)
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=240)
+        assert len(outcomes) == 6  # nobody hung
+        ok = [r for r in outcomes if "error" not in r]
+        typed = [r for r in outcomes if "error" in r]
+        assert len(ok) >= 4  # transients retried; most work finishes
+        for r in typed:
+            assert r["error"] in ("TransientServerError",
+                                  "PrefillFailed")
+        srv.stop()
+        srv.engine.allocator.check_no_leak()
+
+
+# ---------------------------------------------------------------------------
+# Load test (slow lane): 64 mixed requests, 50% shared system prompt
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_shared_prefix_load_64_requests(model):
+    """64 mixed-length requests, half sharing a 24-token system
+    prompt: prefix-cache hit rate > 0, every request completes, zero
+    page leaks after drain + close."""
+    pc = PrefixCache(8)
+    metrics = ServingMetrics(registry=StatRegistry())
+    done = []
+    eng = create_decode_engine(
+        model, num_slots=4, page_size=8, max_seq_len=96, num_pages=36,
+        prefix_cache=pc, scheduler=SLOScheduler(),
+        on_complete=lambda r: (metrics.observe_request(r),
+                               done.append(r)))
+    rng = np.random.default_rng(0)
+    system = (np.arange(24, dtype=np.int32) * 11) % 100
+    reqs = []
+    for i in range(64):
+        tail = rng.integers(0, 100, rng.integers(2, 30)).astype(np.int32)
+        prompt = np.concatenate([system, tail]) if i % 2 == 0 else tail
+        rid = eng.submit(prompt, max_new_tokens=int(rng.integers(2, 10)),
+                         priority=int(rng.integers(0, 3)))
+        reqs.append((rid, prompt))
+    out = eng.run(max_steps=500000)
+    assert len(out) == 64 and len(done) == 64
+    assert all(r.state == "done" for r in done)
+    assert pc.hit_rate() is not None and pc.hit_rate() > 0
+    assert metrics.counter("cache_hit_pages_total").get() > 0
+    snap = metrics.ttft_ms.snapshot()
+    assert snap["count"] == 64 and snap["p50"] is not None
+    eng.close()
+    eng.allocator.check_no_leak()
