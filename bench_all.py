@@ -785,6 +785,199 @@ def bench_serving_prefix(on_tpu: bool) -> Dict:
     return out
 
 
+def bench_speculative_decode(on_tpu: bool) -> Dict:
+    """Speculative-decoding A/B (r8 tentpole artifact): the SAME
+    request stream through the continuous-batching engine vanilla vs
+    draft-and-verify at k in {2, 4, 8}, draft = n-gram prompt lookup
+    (no second model) and a small draft model. Greedy outputs are
+    bit-identical by contract (tests/test_speculative.py pins it), so
+    the entire delta is engine steps saved: each verify step emits
+    1..k+1 tokens for ONE weight/KV stream pass. Reported per mode:
+    generated tokens/s, measured acceptance rate, decode tokens per
+    verify step, and engine steps vs the vanilla baseline."""
+    import paddle_tpu as pt
+    from paddle_tpu.inference import (ModelDraft, SpeculativeConfig,
+                                      create_decode_engine)
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+    if on_tpu:
+        cfg = _decode_1p3b_cfg()
+        slots, page, max_seq = 16, 64, 1024
+        lens = [64, 128, 256, 384]
+        n_req, new_toks = 16, 64
+        draft_cfg = gpt_tiny(vocab_size=cfg.vocab_size, dtype=cfg.dtype,
+                             use_flash_attention=False, max_seq_len=256)
+    else:
+        cfg = gpt_tiny()
+        slots, page, max_seq = 4, 8, 128
+        lens = [14, 20, 26, 32]
+        n_req, new_toks = 8, 24
+        draft_cfg = None  # self-draft: gpt_tiny drafting for gpt_tiny
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        _to_bf16_except_norms(model)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            (lens[i % len(lens)],)).astype(np.int32)
+               for i in range(n_req)]
+
+    if draft_cfg is not None:
+        pt.seed(0)
+        draft_model = GPTForCausalLM(draft_cfg)
+        if on_tpu:
+            _to_bf16_except_norms(draft_model)
+        draft_model.eval()
+    else:
+        draft_model = model
+
+    def run_mode(spec) -> Dict:
+        done = []
+        eng = create_decode_engine(
+            model, num_slots=slots, page_size=page, max_seq_len=max_seq,
+            speculative=spec, on_complete=done.append)
+        # warm the measured engine's compiles (prefill buckets +
+        # decode/verify + any draft jit), then drain before timing
+        for p in prompts[:len(lens)]:
+            eng.submit(p, max_new_tokens=2)
+        eng.run()
+        done.clear()
+        steps_before = eng.steps
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, max_new_tokens=new_toks) for p in prompts]
+        try:
+            results = eng.run()
+        finally:
+            eng.close()
+        wall = time.perf_counter() - t0
+        timed_steps = eng.steps - steps_before
+        launches = timed_steps + len(prompts)
+        dt = max(1e-9, wall - launches * _floor_ms(on_tpu) / 1e3)
+        gen = sum(len(results[r]) - len(p)
+                  for r, p in zip(rids, prompts))
+        out = {"tokens_per_s": round(gen / dt, 1),
+               "engine_steps": timed_steps,
+               "generated_tokens": gen}
+        drafted = sum(r.stats.spec_drafted for r in done)
+        accepted = sum(r.stats.spec_accepted for r in done)
+        vsteps = sum(r.stats.spec_steps for r in done)
+        if vsteps:
+            out["acceptance_rate"] = round(accepted / max(1, drafted), 4)
+            out["tokens_per_step"] = round(
+                sum(r.stats.tokens_out - 1 for r in done) / vsteps, 3)
+        return out
+
+    vanilla = run_mode(None)
+    by_mode: Dict = {}
+    for label, draft in (("ngram", "ngram"), ("draft_model",
+                                              draft_model)):
+        for k in (2, 4, 8):
+            spec = SpeculativeConfig(k=k, draft=draft, draft_window=64)
+            entry = run_mode(spec)
+            if vanilla["tokens_per_s"]:
+                entry["vs_vanilla"] = round(
+                    entry["tokens_per_s"] / vanilla["tokens_per_s"], 3)
+            by_mode[f"{label}_k{k}"] = entry
+    return {"metric": "gpt1p3b_speculative_decode_chip" if on_tpu
+            else "gpt_tiny_speculative_decode_cpu_smoke",
+            "requests": n_req, "prompt_lens": lens,
+            "new_tokens_per_req": new_toks, "num_slots": slots,
+            "page_size": page,
+            "draft_model": ("gpt_tiny" if on_tpu else
+                            "gpt_tiny (self-draft)"),
+            "floor_ms_subtracted": round(_floor_ms(on_tpu), 1),
+            "vanilla": vanilla, "by_mode": by_mode,
+            "note": "greedy outputs bit-identical across all modes "
+                    "(pinned); n-gram acceptance on a RANDOM-weight "
+                    "cpu_smoke model is ~0 by construction (its greedy "
+                    "stream is aperiodic — prompt lookup pays off on "
+                    "trained models' self-repeating text), so the "
+                    "draft_model rows carry the amortization result"}
+
+
+def bench_compile_cache(on_tpu: bool) -> Dict:
+    """Persistent-compile-cache A/B (VERDICT weak #3 follow-up): the
+    same generate program compiled COLD (empty cache dir) vs WARM
+    (jit + jax in-memory caches cleared; executable re-read from the
+    PADDLE_TPU_COMPILE_CACHE dir). On the tunneled dev runtime a warm
+    hit also never touches the remote-compile transport — the exact
+    component the staged 1.3B int8 whole-program compile reproducibly
+    kills — so the chip retry of that compile goes through this path."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.core import compile_cache as cc
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.quantization.quant import convert_to_weight_only_int8
+
+    cache_dir = tempfile.mkdtemp(prefix="pt_compile_cache_")
+    prev = cc.compile_cache_dir()
+    cc.enable_compile_cache(cache_dir)
+    try:
+        if on_tpu:
+            cfg, prompt, new_toks = _decode_1p3b_cfg(), 128, 8
+        else:
+            cfg, prompt, new_toks = gpt_tiny(), 8, 4
+
+        rng = np.random.default_rng(0)
+
+        def build():
+            pt.seed(0)
+            m = GPTForCausalLM(cfg)
+            if on_tpu:
+                _to_bf16_except_norms(m)
+            m.eval()
+            convert_to_weight_only_int8(m)
+            return m
+
+        def compile_once(m):
+            ids = jnp.asarray(rng.integers(
+                0, cfg.vocab_size, (1, prompt)).astype(np.int32))
+            t0 = time.perf_counter()
+            got = m.generate(pt.Tensor(ids), max_new_tokens=new_toks,
+                             temperature=0.0, use_jit=True)
+            np.asarray((got.value if hasattr(got, "value") else got)[0])
+            return time.perf_counter() - t0
+
+        t_cold = compile_once(build())
+        n_files = sum(len(fs) for _, _, fs in __import__("os").walk(
+            cache_dir))
+        # drop every in-memory layer (model-held jit objects die with
+        # the model; jax.clear_caches drops the executable cache) so
+        # the second compile can only be served by the DISK cache
+        jax.clear_caches()
+        t_warm = compile_once(build())
+        return {"metric": "gpt1p3b_int8_compile_cache_chip" if on_tpu
+                else "gpt_tiny_int8_compile_cache_cpu_smoke",
+                "env_var": cc.ENV_VAR,
+                "config": "weight-only-int8 whole-program jitted "
+                          "generate (prefill + scanned decode)",
+                "cold_first_call_s": round(t_cold, 3),
+                "warm_first_call_s": round(t_warm, 3),
+                "speedup": round(t_cold / max(t_warm, 1e-9), 2),
+                "cache_files_written": n_files,
+                "note": "first-call wall time = trace + compile + one "
+                        "short generate; warm run re-reads the "
+                        "executable from the cache dir instead of "
+                        "recompiling (and, on the tunneled runtime, "
+                        "instead of crossing the remote-compile "
+                        "transport)"}
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        # leave the process as we found it: detach jax from the
+        # deleted temp dir (config AND memoized cache object), then
+        # re-attach any previously configured cache
+        cc.disable_compile_cache()
+        if prev is not None:
+            cc.enable_compile_cache(prev)
+
+
 def bench_moe_dispatch(on_tpu: bool) -> Dict:
     """MoE dispatch microbench (VERDICT "do this" #4b): forward
     tokens/s for a 4-expert capacity-dispatch GPT (top-2, every block
@@ -1009,6 +1202,8 @@ def run_staged(on_tpu: bool) -> Dict:
                      ("paged_decode", bench_paged_decode),
                      ("ragged_serving", bench_ragged_serving),
                      ("serving_prefix", bench_serving_prefix),
+                     ("speculative_decode", bench_speculative_decode),
+                     ("compile_cache", bench_compile_cache),
                      ("moe_dispatch", bench_moe_dispatch),
                      ("inference", bench_inference)):
         t0 = time.time()
@@ -1023,7 +1218,11 @@ def run_staged(on_tpu: bool) -> Dict:
 
 def main() -> None:
     from bench import _probe_backend
+    from paddle_tpu.core.compile_cache import enable_compile_cache
 
+    # env-gated persistent compile cache: a re-run of the sweep with
+    # PADDLE_TPU_COMPILE_CACHE set skips every unchanged compile
+    enable_compile_cache()
     timeout_s = float(os.environ.get("PT_BENCH_TPU_TIMEOUT", "600"))
     want_tpu = os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu")
     use_tpu = want_tpu and _probe_backend(timeout_s)

@@ -1,0 +1,101 @@
+"""Env-gated JAX persistent compilation cache.
+
+``PADDLE_TPU_COMPILE_CACHE=<dir>`` points every process at a shared
+on-disk cache of compiled XLA executables: a restarted serving engine
+(or a bench re-run) re-reads its prefill/decode/verify programs
+instead of recompiling them — and, on the tunneled dev runtime, a
+cached compile never touches the remote-compile transport at all,
+which is the workaround lane for the 1.3B int8 whole-program compile
+that reproducibly kills that transport (BENCH_STAGED.json decode/
+int8_weight_only, VERDICT weak #3).
+
+Call sites: `ContinuousBatchingEngine.__init__` (the serving engine's
+construction path) and `bench_all.main` (the staged sweep). Explicit
+``enable_compile_cache(path)`` wins over the env var; with neither,
+this is a no-op — the cache is strictly opt-in because a shared dir
+across incompatible jax/backend versions is the user's call to make.
+
+The min-entry-size / min-compile-time thresholds are dropped to zero
+so CPU-smoke-scale programs cache too (the defaults only persist
+multi-second compiles); older jax spellings of those knobs are
+tolerated by skipping what the installed version lacks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["enable_compile_cache", "disable_compile_cache",
+           "compile_cache_dir", "ENV_VAR"]
+
+ENV_VAR = "PADDLE_TPU_COMPILE_CACHE"
+
+_enabled_dir: Optional[str] = None
+
+
+def compile_cache_dir() -> Optional[str]:
+    """The directory the cache was enabled with (None = off)."""
+    return _enabled_dir
+
+
+def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Idempotently point jax's persistent compilation cache at
+    ``path`` (default: $PADDLE_TPU_COMPILE_CACHE; unset/empty = no-op).
+    Returns the active cache dir, or None when disabled."""
+    global _enabled_dir
+    if path is None:
+        path = os.environ.get(ENV_VAR, "").strip() or None
+    if path is None:
+        return _enabled_dir
+    path = os.path.abspath(path)
+    if _enabled_dir == path:
+        return _enabled_dir
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # jax memoizes "no cache configured" at the FIRST compile of the
+    # process; enabling after any jit has run needs the memo dropped
+    # or the new dir is silently ignored
+    try:
+        from jax._src import compilation_cache as _jcc
+        _jcc.reset_cache()
+    except Exception:
+        pass
+    for flag, val in (
+            # persist everything: the engine's CPU-lane programs are
+            # small and fast to compile but still worth skipping, and
+            # the flags exist precisely to opt into that
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            # newer jax gates non-TPU backends behind an explicit
+            # enable; older versions don't have the flag
+            ("jax_persistent_cache_enable_xla_caches", "all")):
+        try:
+            jax.config.update(flag, val)
+        except (AttributeError, ValueError):
+            pass
+    _enabled_dir = path
+    return _enabled_dir
+
+
+def disable_compile_cache() -> None:
+    """Fully detach jax from the enabled cache dir: config reset AND
+    the memoized cache object dropped, so later compiles neither read
+    from nor write to a dir that may be gone (bench A/B hygiene — a
+    dangling config pointing at a deleted temp dir would warn on every
+    compile for the rest of the process). ``enable_compile_cache``
+    re-attaches."""
+    global _enabled_dir
+    if _enabled_dir is None:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        from jax._src import compilation_cache as _jcc
+        _jcc.reset_cache()
+    except Exception:
+        pass
+    _enabled_dir = None

@@ -26,6 +26,10 @@ serving.prefill      inference/continuous_batching engine admission
                      prefill (retried per the serving.prefill policy;
                      exhausted retries FAIL the request with a typed
                      reply instead of wedging the queue)
+serving.verify       inference/continuous_batching speculative
+                     draft-and-verify step (retried per the
+                     serving.verify policy; fires BEFORE the donating
+                     jit runs, so a retry never sees consumed buffers)
 ==================== =================================================
 
 Default-OFF: with no sites armed (the tier-1 default), ``fault_point``

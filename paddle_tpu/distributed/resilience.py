@@ -173,6 +173,13 @@ NO_RETRY = RetryPolicy(max_attempts=1)
 _BUILTIN_SITE_POLICIES: Dict[str, "RetryPolicy"] = {
     "serving.prefill": RetryPolicy(max_attempts=3, base_delay_s=0.02,
                                    max_delay_s=0.25),
+    # serving.verify guards the speculative draft-and-verify step
+    # (inference/continuous_batching._spec_step): same regime as
+    # prefill — every active slot's clients are waiting on the step,
+    # so retry transients promptly and give up fast (a persistent
+    # failure escalates through the server's engine-error cap)
+    "serving.verify": RetryPolicy(max_attempts=3, base_delay_s=0.02,
+                                  max_delay_s=0.25),
 }
 
 _site_policies: Dict[str, RetryPolicy] = {}

@@ -78,23 +78,85 @@ class PageAllocator:
     `alloc` is all-or-nothing so a request that does not fit leaves the
     free list untouched (no partial reservations to unwind). Owners are
     arbitrary hashables: requests own by req_id (int), the prefix cache
-    owns by ("prefix", key) tuples."""
+    owns by ("prefix", key) tuples.
+
+    Reservations (the speculative-decoding discipline): ``reserve``
+    claims CAPACITY without binding physical pages; ``alloc_reserved``
+    later converts reservation into pages (guaranteed to succeed), and
+    ``release_pages(..., rereserve=True)`` converts pages back into
+    reservation. ``free_count`` excludes reserved capacity, so
+    admission-fit checks and the prefix cache's eviction pressure see
+    only genuinely available pages. This is what lets a speculative
+    slot grow its page set token-by-token and RETURN wholly-unused
+    pages on rejection rollback while its future growth stays
+    deadlock-free (capacity was committed at admission)."""
 
     def __init__(self, num_pages: int):
         self.num_pages = num_pages
         self._free: List[int] = list(range(num_pages))
         self._owned: Dict[Hashable, List[int]] = {}
+        self._reserved: Dict[Hashable, int] = {}
 
     @property
     def free_count(self) -> int:
-        return len(self._free)
+        return len(self._free) - self.reserved_total
+
+    @property
+    def reserved_total(self) -> int:
+        return sum(self._reserved.values())
 
     def alloc(self, owner: Hashable, n: int) -> Optional[List[int]]:
-        if n > len(self._free):
+        if n > self.free_count:
             return None
         pages = [self._free.pop() for _ in range(n)]
         self._owned.setdefault(owner, []).extend(pages)
         return pages
+
+    def reserve(self, owner: Hashable, n: int) -> bool:
+        """All-or-nothing capacity claim (no physical pages bound)."""
+        if n > self.free_count:
+            return False
+        if n:
+            self._reserved[owner] = self._reserved.get(owner, 0) + n
+        return True
+
+    def reserved(self, owner: Hashable) -> int:
+        return self._reserved.get(owner, 0)
+
+    def alloc_reserved(self, owner: Hashable, n: int) -> List[int]:
+        """Convert ``n`` pages of ``owner``'s reservation into physical
+        pages. Never fails: reserve() bounded the claim against the
+        free list, and only alloc/alloc_reserved consume it."""
+        held = self._reserved.get(owner, 0)
+        if n > held:
+            raise RuntimeError(
+                f"{owner!r} asked for {n} reserved pages but holds a "
+                f"reservation of {held}")
+        pages = [self._free.pop() for _ in range(n)]
+        self._owned.setdefault(owner, []).extend(pages)
+        if held == n:
+            self._reserved.pop(owner, None)
+        else:
+            self._reserved[owner] = held - n
+        return pages
+
+    def release_pages(self, owner: Hashable, pages: Sequence[int],
+                      rereserve: bool = False) -> None:
+        """Return SPECIFIC pages to the free list (rollback of rejected
+        speculation). ``rereserve`` converts them back into reservation
+        so the owner's growth guarantee is preserved."""
+        held = self._owned.get(owner, [])
+        for p in pages:
+            if p not in held:
+                raise RuntimeError(
+                    f"release of page {p} not owned by {owner!r}")
+            held.remove(p)
+            self._free.append(p)
+        if not held:
+            self._owned.pop(owner, None)
+        if rereserve and pages:
+            self._reserved[owner] = (self._reserved.get(owner, 0) +
+                                     len(pages))
 
     def free(self, owner: Hashable) -> int:
         pages = self._owned.pop(owner, [])
@@ -102,6 +164,7 @@ class PageAllocator:
             if p in self._free:  # double free = scheduler bug
                 raise RuntimeError(f"page {p} double-freed")
         self._free.extend(pages)
+        self._reserved.pop(owner, None)
         return len(pages)
 
     def transfer(self, owner: Hashable, new_owner: Hashable,
@@ -125,10 +188,13 @@ class PageAllocator:
         return {k: tuple(v) for k, v in self._owned.items()}
 
     def check_no_leak(self) -> None:
-        if self._owned or len(self._free) != self.num_pages:
+        if self._owned or self._reserved or \
+                len(self._free) != self.num_pages:
             raise RuntimeError(
                 f"page leak: {sum(map(len, self._owned.values()))} owned "
-                f"by {sorted(self._owned, key=str)} with "
+                f"by {sorted(self._owned, key=str)}, "
+                f"{self.reserved_total} reserved by "
+                f"{sorted(self._reserved, key=str)} with "
                 f"{len(self._free)}/{self.num_pages} free")
 
 
@@ -153,6 +219,25 @@ class RequestStats:
     prompt_pages: int = 0          # shareable full pages in the prompt
     cache_enabled: bool = False    # a prefix cache was configured
     prefill_attempts: int = 0      # 1 = first try succeeded
+    spec_steps: int = 0            # verify steps this request rode
+    spec_drafted: int = 0          # draft tokens offered to verify
+    spec_accepted: int = 0         # draft tokens accepted
+
+    @property
+    def acceptance_rate(self) -> Optional[float]:
+        """Accepted / drafted over the request's verify steps."""
+        if self.spec_drafted:
+            return self.spec_accepted / self.spec_drafted
+        return None
+
+    @property
+    def tokens_per_step(self) -> Optional[float]:
+        """Decode tokens emitted per verify step (the speculative win:
+        > 1 means the weight/KV stream amortized). The prefill-produced
+        first token is excluded — it predates any verify step."""
+        if self.spec_steps and self.tokens_out > 1:
+            return (self.tokens_out - 1) / self.spec_steps
+        return None
 
     @property
     def queue_delay_s(self) -> Optional[float]:
@@ -180,6 +265,8 @@ class RequestStats:
         out["queue_delay_s"] = self.queue_delay_s
         out["ttft_s"] = self.ttft_s
         out["tpot_s"] = self.tpot_s
+        out["acceptance_rate"] = self.acceptance_rate
+        out["tokens_per_step"] = self.tokens_per_step
         return out
 
 
@@ -224,12 +311,18 @@ class ContinuousBatchingEngine:
                  prefill_retry=None,
                  on_complete: Optional[Callable[["DecodeRequest"],
                                                 None]] = None,
-                 max_prefill_attempts: int = 3):
+                 max_prefill_attempts: int = 3,
+                 speculative=None, verify_retry="site"):
         import jax.numpy as jnp
 
+        from ..core.compile_cache import enable_compile_cache
         from ..nn.layer import functional_state
         from ..models.gpt import paged_cache_create
 
+        # env-gated persistent compile cache (PADDLE_TPU_COMPILE_CACHE):
+        # the engine's prefill-per-bucket + decode/verify programs are
+        # exactly the compiles a restarted server pays again cold
+        enable_compile_cache()
         self.model = model
         model.eval()
         cfg = model.config
@@ -294,6 +387,24 @@ class ContinuousBatchingEngine:
         self._prefill_retry = prefill_retry
         self._on_complete = on_complete
         self.max_prefill_attempts = int(max_prefill_attempts)
+        # speculative decoding (inference/speculative.py): draft k
+        # tokens per step, verify all k+1 in ONE forward, emit the
+        # longest accepted prefix + 1. Greedy stays bit-identical to
+        # the vanilla engine; OFF by default.
+        self._spec_cfg = None
+        self._spec_draft = None
+        self._verify_jit = None
+        self._spec_key = None
+        if speculative is not None:
+            from .speculative import as_spec_config
+            self._spec_cfg = as_spec_config(speculative)
+            self._spec_draft = self._spec_cfg.build_draft()
+            if verify_retry == "site":
+                from ..distributed.resilience import get_retry_policy
+                verify_retry = get_retry_policy("serving.verify")
+            self._verify_retry = verify_retry
+        else:
+            self._verify_retry = None
 
     # -- request lifecycle -------------------------------------------------
 
@@ -375,6 +486,7 @@ class ContinuousBatchingEngine:
         import jax
 
         from ..autograd.engine import no_grad
+        from ..nn.decode import sample_token
         from ..nn.layer import bind_state
         from ..tensor import Tensor
 
@@ -386,8 +498,10 @@ class ContinuousBatchingEngine:
             with bind_state(self.model, state), no_grad():
                 logits, nc = self.model.forward(Tensor(tokens[:, None]),
                                                 caches=caches)
-            nxt = self._jnp.argmax(raw(logits)[:, -1], -1).astype(
-                self._jnp.int32)
+            # greedy serving mode through the ONE shared sampler
+            # (nn/decode.py) — the same call generate() and the
+            # speculative verify make
+            nxt, _ = sample_token(raw(logits)[:, -1], 0.0)
             new_pools = {
                 "k": [raw(c.k_pages) for c in nc],
                 "v": [raw(c.v_pages) for c in nc],
@@ -417,6 +531,7 @@ class ContinuousBatchingEngine:
         import jax
 
         from ..autograd.engine import no_grad
+        from ..nn.decode import sample_token
         from ..nn.layer import bind_state
         from ..tensor import Tensor
 
@@ -429,8 +544,8 @@ class ContinuousBatchingEngine:
                 logits, nc = self.model.forward(
                     Tensor(ids), caches=caches, prefill_lens=plen,
                     prefill_chained=chained)
-            nxt = self._jnp.argmax(
-                raw(logits)[0, plen[0] - 1], -1).astype(self._jnp.int32)
+            nxt, _ = sample_token(raw(logits)[:1, plen[0] - 1], 0.0)
+            nxt = nxt[0]
             new_pools = {
                 "k": [raw(c.k_pages) for c in nc],
                 "v": [raw(c.v_pages) for c in nc],
@@ -447,6 +562,49 @@ class ContinuousBatchingEngine:
         if self._prefill_jits.get(chained) is None:
             self._prefill_jits[chained] = self._build_prefill(chained)
         return self._prefill_jits[chained]
+
+    def _build_verify(self):
+        """ONE jitted speculative verify step for the engine's whole
+        lifetime (fixed [num_slots, k+1] shape): append the pending
+        token + k drafts through the page tables (ragged per-slot
+        valid counts park the tail on the scratch page), score all
+        k+1 positions via models/gpt.py ``verify_step`` (the chained-
+        prefill q_offsets paged-attention path), and compute the
+        accept/resample decisions with nn/decode.py's shared sampler
+        math. Lengths stay host-owned: the host rolls back past the
+        longest accepted prefix, so rejected positions are simply
+        never attended again."""
+        import jax
+
+        from ..autograd.engine import no_grad
+        from ..nn.decode import speculative_verify_tokens
+        from ..nn.layer import bind_state
+        from ..tensor import Tensor
+
+        temp = float(self._spec_cfg.temperature)
+        tk = self._spec_cfg.top_k
+
+        def raw(t):
+            return t.value if isinstance(t, Tensor) else t
+
+        def verify(state, pools, table, lens, tokens, valid, key):
+            caches = self._caches(pools, table, lens)
+            with bind_state(self.model, state), no_grad():
+                logits, nc = self.model.verify_step(Tensor(tokens),
+                                                    caches, valid)
+            accept, resid, full, _ = speculative_verify_tokens(
+                raw(logits), tokens[:, 1:], temp, tk, key)
+            new_pools = {
+                "k": [raw(c.k_pages) for c in nc],
+                "v": [raw(c.v_pages) for c in nc],
+                "ks": [raw(c.k_scale) if self.kv_int8 else None
+                       for c in nc],
+                "vs": [raw(c.v_scale) if self.kv_int8 else None
+                       for c in nc],
+            }
+            return accept, resid, full, new_pools
+
+        return jax.jit(verify, donate_argnums=(1,))
 
     # -- scheduler ---------------------------------------------------------
 
@@ -551,10 +709,26 @@ class ContinuousBatchingEngine:
         capacity = len(req.prompt) + req.max_new_tokens
         need = -(-capacity // self.page_size)
         private_need = need - len(shared)
-        pages = self.allocator.alloc(req.req_id, private_need)
+
+        def grab():
+            if self._spec_cfg is None:
+                return self.allocator.alloc(req.req_id, private_need)
+            # speculative mode binds only the prefill-covering pages
+            # and RESERVES the rest of the capacity: decode grows the
+            # page set on demand (_ensure_pages) and rollback returns
+            # wholly-unused pages (_rollback_pages) without ever
+            # risking a mid-decode allocation failure
+            prefill_need = (-(-len(req.prompt) // self.page_size)
+                            - len(shared))
+            if not self.allocator.reserve(req.req_id, private_need):
+                return None
+            return self.allocator.alloc_reserved(req.req_id,
+                                                 prefill_need)
+
+        pages = grab()
         if pages is None and cache is not None:
             if cache.evict_until(self.allocator, private_need):
-                pages = self.allocator.alloc(req.req_id, private_need)
+                pages = grab()
         if pages is None:
             if cache is not None:
                 cache.release(keys)
@@ -569,7 +743,7 @@ class ContinuousBatchingEngine:
         req.state = "prefill"
         row = np.full((self.max_pages,), self._scratch, np.int32)
         row[:len(shared)] = shared
-        row[len(shared):need] = pages
+        row[len(shared):len(shared) + len(pages)] = pages
         self._table[slot] = row
         suffix = req.prompt[cached_len:]
         bucket = self._bucket(len(suffix))
@@ -681,13 +855,156 @@ class ContinuousBatchingEngine:
             self._slots[slot] = None
             self._notify_complete(req)
 
+    # -- speculative decoding ----------------------------------------------
+
+    def _ensure_pages(self, slot: int, req: DecodeRequest,
+                      need_len: int) -> None:
+        """Grow the slot's page set to cover positions [0, need_len)
+        out of the request's reservation (guaranteed: capacity was
+        committed at admission). Speculative mode only — vanilla
+        admission binds every page up front."""
+        row = self._table[slot]
+        want = -(-need_len // self.page_size)
+        missing = [j for j in range(want) if row[j] == self._scratch]
+        if not missing:
+            return
+        pages = self.allocator.alloc_reserved(req.req_id, len(missing))
+        for j, p in zip(missing, pages):
+            row[j] = p
+
+    def _rollback_pages(self, slot: int, req: DecodeRequest,
+                        new_len: int) -> int:
+        """Rejection rollback: pages whose EVERY position sits at or
+        beyond the accepted length hold only rejected-draft KV —
+        return them to the allocator (capacity goes back into the
+        request's reservation, so later growth still cannot fail).
+        The page containing position ``new_len`` (the next append
+        target) is kept even when partially stale: stale positions are
+        never attended (host seq_lens were rewound) and the next
+        append overwrites them. Shared prefix pages sit strictly below
+        ``new_len`` and are never touched."""
+        row = self._table[slot]
+        keep = -(-(new_len + 1) // self.page_size)
+        victims = [int(row[j]) for j in range(keep, self.max_pages)
+                   if row[j] != self._scratch]
+        if victims:
+            self.allocator.release_pages(req.req_id, victims,
+                                         rereserve=True)
+            row[keep:] = self._scratch
+        return len(victims)
+
+    def _spec_step(self) -> int:
+        """One draft-and-verify step over every active slot: propose k
+        tokens per slot (host/draft-model), score all k+1 positions in
+        ONE target forward, emit each slot's longest accepted prefix
+        plus its correction/bonus token, rewind ``seq_lens`` past the
+        rejections and return wholly-unused pages. Greedy emission is
+        bit-identical to the vanilla per-token loop (pinned)."""
+        import jax
+
+        jnp = self._jnp
+        cfg = self._spec_cfg
+        k = cfg.k
+        vocab = self.cfg.vocab_size
+        active = [i for i, r in enumerate(self._slots) if r is not None]
+        hist = [None if r is None else r.tokens for r in self._slots]
+        drafts = np.asarray(self._spec_draft.propose(hist, k), np.int32)
+        if drafts.shape != (self.num_slots, k):
+            raise ValueError(
+                f"draft source returned shape {drafts.shape}, expected "
+                f"{(self.num_slots, k)}")
+        # defensive clip: a draft over a larger vocab must not feed the
+        # target an out-of-range id (wrong guesses are free, OOB isn't)
+        drafts = np.clip(drafts, 0, vocab - 1).astype(np.int32)
+        tokens = np.zeros((self.num_slots, k + 1), np.int32)
+        tokens[:, 0] = self._cur
+        tokens[:, 1:] = drafts
+        valid = np.zeros((self.num_slots,), np.int32)
+        old_lens = self._lens.copy()
+        for i in active:
+            req = self._slots[i]
+            rem = req.max_new_tokens - len(req.generated)
+            k_eff = min(k, rem - 1)  # emit at most rem tokens
+            valid[i] = 1 + k_eff
+            self._ensure_pages(i, req, int(old_lens[i]) + int(valid[i]))
+        if self._verify_jit is None:
+            self._verify_jit = self._build_verify()
+        if cfg.temperature and self._spec_key is None:
+            self._spec_key = jax.random.PRNGKey(cfg.seed)
+        if cfg.temperature:
+            self._spec_key, key = jax.random.split(self._spec_key)
+        else:
+            key = jax.random.PRNGKey(0)  # unused on the greedy path
+
+        def run_verify():
+            from ..distributed.fault_inject import fault_point
+            # donated-buffer guard — same contract as serving.prefill:
+            # a retry must never feed the jit consumed pools
+            k0 = self._pools["k"][0]
+            if getattr(k0, "is_deleted", None) is not None \
+                    and k0.is_deleted():
+                raise RuntimeError(
+                    "KV pool buffers were consumed by a failed donating "
+                    "verify; engine state is unrecoverable — rebuild "
+                    "the engine")
+            fault_point("serving.verify")
+            return self._verify_jit(
+                self._fresh_state(), self._pools,
+                jnp.asarray(self._table), jnp.asarray(self._lens),
+                jnp.asarray(tokens), jnp.asarray(valid), key)
+
+        if self._verify_retry is not None:
+            accept, resid, full, pools = self._verify_retry.call(
+                run_verify, site="serving.verify")
+        else:
+            accept, resid, full, pools = run_verify()
+        self._pools = pools
+        accept = np.asarray(accept)
+        resid = np.asarray(resid)
+        full = np.asarray(full)
+        self.steps += 1
+        for i in active:
+            req = self._slots[i]
+            k_eff = int(valid[i]) - 1
+            n = 0
+            while n < k_eff and accept[i, n]:
+                n += 1
+            req.stats.spec_steps += 1
+            req.stats.spec_drafted += k_eff
+            req.stats.spec_accepted += n
+            nxt = int(resid[i, n]) if n < k_eff else int(full[i, k_eff])
+            emitted = [int(t) for t in tokens[i, 1:1 + n]] + [nxt]
+            finished = False
+            for tok in emitted:
+                req.generated.append(tok)
+                req.stats.tokens_out = len(req.generated)
+                self._cur[i] = tok
+                self._emit_token(req, tok)
+                if self._finish_due(req):
+                    finished = True
+                    break  # EOS inside the accepted run: stop emitting
+            if finished:
+                # _maybe_finish frees the slot wholesale (pages AND
+                # remaining reservation) — no rollback bookkeeping
+                self._maybe_finish(i)
+                continue
+            # KV now validly covers cur + the n accepted drafts; the
+            # last emitted token's KV is written by the NEXT step
+            new_len = int(old_lens[i]) + n + 1
+            self._lens[i] = new_len
+            self._rollback_pages(i, req, new_len)
+        return self.num_active
+
     def step(self) -> int:
-        """Admit what fits, run ONE fixed-shape decode step, evict what
-        finished. Returns the number of still-active slots."""
+        """Admit what fits, run ONE fixed-shape decode step (or one
+        draft-and-verify speculative step), evict what finished.
+        Returns the number of still-active slots."""
         jnp = self._jnp
         self._admit()
         if self.num_active == 0:
             return 0
+        if self._spec_cfg is not None:
+            return self._spec_step()
         if self._decode_jit is None:
             self._decode_jit = self._build_decode()
         active = np.array([r is not None for r in self._slots])

@@ -715,6 +715,34 @@ class GPTForCausalLM(Layer):
                     loss = loss + a
         return loss
 
+    def verify_step(self, input_ids, caches, valid_len):
+        """Speculative-decoding verify forward over paged slots.
+
+        ``input_ids``: [B, s] = ``[cur, d_0, .., d_{s-2}]`` per
+        sequence — the pending token plus ``s-1`` draft tokens.
+        ``caches``: per-layer PagedKVCache whose ``seq_lens`` are the
+        PRE-verify lengths. ``valid_len``: [B] int32, how many of the
+        ``s`` tokens are real for each sequence (ragged draft windows;
+        0 parks an inactive slot — its writes land on the reserved
+        scratch page).
+
+        One forward scores ALL ``s`` positions: the chunk is appended
+        through ``paged_kv_append`` (valid_len redirects the ragged
+        tail to the scratch page, so rejected-draft KV never lands
+        outside the sequence's own pages) and attends the stored
+        prefix plus itself through the chained-prefill paged-attention
+        path (``q_offsets`` = old seq_lens). Position ``j``'s logits
+        are therefore exactly the vanilla decode logits after
+        ``cur, d_0..d_{j-1}`` — the bit-identical greedy contract the
+        speculative engine pins. Returns ``(logits [B, s, V],
+        new_caches)``; the caller keeps host-side lengths and rolls
+        back past the longest accepted prefix (rejected positions are
+        simply never attended and are overwritten by the next
+        append)."""
+        return self.forward(input_ids, caches=caches,
+                            prefill_lens=valid_len,
+                            prefill_chained=True)
+
     def generate(self, input_ids, max_new_tokens: int = 20,
                  temperature: float = 1.0, top_k: Optional[int] = None,
                  key=None, use_jit: bool = False,
@@ -857,15 +885,8 @@ class GPTForCausalLM(Layer):
             return raw(logits), [raw_cache(c) for c in nc]
 
         def sample(last, k):  # last: [B, V]
-            if temp == 0.0:
-                return jnp.argmax(last, -1).astype(jnp.int32), k
-            scaled = last.astype(jnp.float32) / temp
-            if tk is not None:
-                kth = jax.lax.top_k(scaled, tk)[0][:, -1:]
-                scaled = jnp.where(scaled < kth, -1e10, scaled)
-            k, sub = jax.random.split(k)
-            return jax.random.categorical(sub, scaled, axis=-1).astype(
-                jnp.int32), k
+            from ..nn.decode import sample_token
+            return sample_token(last, temp, tk, k)
 
         def run(params, ids, k):
             caches = make_caches()
@@ -996,15 +1017,8 @@ class GPTForCausalLM(Layer):
                 return raw(lg)[:, -1]
 
             def sample_fn(last, k):
-                if temp == 0.0:
-                    return jnp.argmax(last, -1).astype(jnp.int32), k
-                scaled = last.astype(jnp.float32) / temp
-                if tk is not None:
-                    kth = jax.lax.top_k(scaled, tk)[0][:, -1:]
-                    scaled = jnp.where(scaled < kth, -1e10, scaled)
-                k, sub = jax.random.split(k)
-                return jax.random.categorical(sub, scaled, -1).astype(
-                    jnp.int32), k
+                from ..nn.decode import sample_token
+                return sample_token(last, temp, tk, k)
 
             cache[sig] = tuple(
                 jax.jit(f) for f in (embed_fn, block_fn, head_fn,

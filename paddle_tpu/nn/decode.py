@@ -26,7 +26,97 @@ from .layer import Layer
 
 F = dispatch.wrapped_ops
 
-__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+__all__ = ["BeamSearchDecoder", "dynamic_decode", "sample_token",
+           "speculative_verify_tokens"]
+
+
+# ---------------------------------------------------------------------------
+# Shared autoregressive sampler (jit-safe, pure JAX)
+# ---------------------------------------------------------------------------
+
+def sample_token(last, temperature: float = 0.0, top_k=None, key=None):
+    """ONE sampling semantics for every decode path: greedy argmax at
+    ``temperature == 0``, temperature/top-k categorical otherwise.
+
+    ``last``: [B, V] final-position logits; returns ``(tokens [B]
+    int32, new_key)``. The jitted whole-generate scan, the chunked
+    per-block generate, the continuous-batching engine's prefill and
+    decode steps, and the speculative verify step all call THIS
+    function, so their token streams provably share one sampler
+    (previously the same four lines lived in three places).
+    ``temperature``/``top_k`` must be Python statics under jit; ``key``
+    is unused (and may be None) on the greedy path."""
+    import jax
+
+    if temperature == 0.0:
+        return jnp.argmax(last, -1).astype(jnp.int32), key
+    scaled = last.astype(jnp.float32) / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled < kth, -1e10, scaled)
+    key, sub = jax.random.split(key)
+    return jax.random.categorical(sub, scaled, axis=-1).astype(
+        jnp.int32), key
+
+
+def speculative_verify_tokens(logits, drafts, temperature: float = 0.0,
+                              top_k=None, key=None):
+    """Per-position accept/replace decisions for speculative decoding.
+
+    ``logits``: [B, s, V] target-model logits over the verify chunk
+    ``[cur, d_0, .., d_{s-2}]`` — position ``j`` scores the token that
+    follows ``cur, d_0..d_{j-1}``. ``drafts``: [B, s-1] the draft
+    tokens ``d_0..d_{s-2}``. Returns ``(accept [B, s-1] bool,
+    resampled [B, s-1] int32, full [B, s] int32, key)``:
+
+    - ``full[:, j]``: the token the target itself would emit at
+      position ``j`` (``sample_token`` semantics — argmax when greedy),
+      i.e. exactly the vanilla decode token given that prefix;
+    - ``accept[:, j]``: whether draft ``d_j`` survives at position
+      ``j`` — greedy: exact match against ``full``; temperature: a
+      uniform draw under the target probability of ``d_j`` (the
+      deterministic-draft acceptance rule, q = point mass);
+    - ``resampled[:, j]``: the replacement token if ``j`` is the FIRST
+      rejection — greedy: the argmax correction (== ``full``);
+      temperature: a sample from the residual distribution (target
+      probabilities with the rejected draft token's mass removed and
+      renormalized), which keeps the emitted stream distributed
+      exactly as the target model.
+
+    The caller takes ``n`` = length of the leading all-accepted prefix
+    (over its per-sequence valid draft count) and emits
+    ``drafts[:n] + (resampled[n] if n < valid else full[valid])``."""
+    import jax
+
+    b, s, _ = logits.shape
+    if temperature == 0.0:
+        full = jnp.argmax(logits, -1).astype(jnp.int32)
+        accept = drafts.astype(jnp.int32) == full[:, :-1]
+        return accept, full[:, :-1], full, key
+    scaled = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, -1e10, scaled)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    key, k_acc, k_resid, k_full = jax.random.split(key, 4)
+    # full-distribution samples at every position (sample_token
+    # semantics, batched over positions)
+    full = jax.random.categorical(
+        k_full, scaled.reshape(b * s, -1), axis=-1).reshape(
+        b, s).astype(jnp.int32)
+    d32 = drafts.astype(jnp.int32)
+    p_draft = jnp.take_along_axis(probs[:, :-1], d32[..., None],
+                                  axis=-1)[..., 0]
+    u = jax.random.uniform(k_acc, (b, s - 1))
+    accept = u < p_draft
+    # residual: remove the rejected draft's mass, renormalize (in the
+    # log domain: mask the draft token out and re-sample)
+    masked = scaled[:, :-1].at[
+        jnp.arange(b)[:, None], jnp.arange(s - 1)[None], d32].set(-1e10)
+    resampled = jax.random.categorical(
+        k_resid, masked.reshape(b * (s - 1), -1), axis=-1).reshape(
+        b, s - 1).astype(jnp.int32)
+    return accept, resampled, full, key
 
 
 class BeamSearchDecoder:

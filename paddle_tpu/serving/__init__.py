@@ -21,7 +21,14 @@ continuous batching, PR r6) into a servable system:
   the uncached path (tests/test_serving.py).
 - ``metrics``: per-request TTFT / TPOT / queue-delay histograms and
   cache-hit / shed counters in core.monitor's StatRegistry, with a
-  Prometheus-style text export.
+  Prometheus-style text export — plus speculative-decoding
+  acceptance-rate and tokens-per-step histograms (r8).
+
+Speculative decoding (r8): pass ``--speculate K`` (CLI) or
+``speculative=SpeculativeConfig(k=K, draft=...)`` (engine kwargs) to
+decode via draft-and-verify — greedy outputs stay bit-identical to
+the vanilla engine while accepted drafts amortize the per-token
+weight/KV stream (inference/speculative.py).
 
 Reference analog: the framework's standalone inference engine + C
 serving API (SURVEY §1 rows 7/12), reproduced TPU-natively as a Python

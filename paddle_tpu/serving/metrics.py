@@ -30,6 +30,14 @@ __all__ = ["Histogram", "ServingMetrics"]
 DEFAULT_MS_BUCKETS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
                       250, 500, 1000, 2500, 5000, 10000)
 
+# speculative-decoding distributions: acceptance rate is a ratio in
+# [0, 1]; tokens-per-step lives in [1, k+1] (1 = speculation bought
+# nothing, k+1 = every draft accepted)
+RATIO_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
+                 0.95, 1.0)
+TOKENS_PER_STEP_BUCKETS = (1.0, 1.25, 1.5, 2.0, 2.5, 3.0, 4.0, 5.0,
+                           6.0, 8.0, 12.0, 16.0)
+
 
 class Histogram:
     """Fixed-bucket latency histogram with quantiles over a bounded
@@ -109,7 +117,8 @@ class ServingMetrics:
                 "cache_hit_pages_total", "cache_miss_pages_total",
                 "cache_hit_requests_total", "shed_total",
                 "rejected_total", "evicted_total", "failed_total",
-                "prefill_retries_total", "engine_errors_total")
+                "prefill_retries_total", "engine_errors_total",
+                "spec_drafted_total", "spec_accepted_total")
 
     def __init__(self, registry: Optional[StatRegistry] = None,
                  prefix: str = "serving"):
@@ -120,6 +129,13 @@ class ServingMetrics:
         self.queue_delay_ms = Histogram(f"{prefix}.queue_delay_ms")
         self.prefill_ms = Histogram(f"{prefix}.prefill_ms")
         self.e2e_ms = Histogram(f"{prefix}.e2e_ms")
+        # speculative decoding: per-request acceptance rate and decode
+        # tokens per verify step (both ride the Prometheus export)
+        self.spec_accept_rate = Histogram(
+            f"{prefix}.spec_accept_rate", buckets=RATIO_BUCKETS)
+        self.spec_tokens_per_step = Histogram(
+            f"{prefix}.spec_tokens_per_step",
+            buckets=TOKENS_PER_STEP_BUCKETS)
 
     def counter(self, name: str):
         return self.registry.get(f"{self.prefix}.{name}")
@@ -131,6 +147,11 @@ class ServingMetrics:
         for h in ("ttft_ms", "tpot_ms", "queue_delay_ms", "prefill_ms",
                   "e2e_ms"):
             setattr(self, h, Histogram(f"{self.prefix}.{h}"))
+        self.spec_accept_rate = Histogram(
+            f"{self.prefix}.spec_accept_rate", buckets=RATIO_BUCKETS)
+        self.spec_tokens_per_step = Histogram(
+            f"{self.prefix}.spec_tokens_per_step",
+            buckets=TOKENS_PER_STEP_BUCKETS)
 
     # -- ingestion ---------------------------------------------------------
 
@@ -173,6 +194,13 @@ class ServingMetrics:
             self.prefill_ms.observe(st.prefill_ms)
         if st.finish_t and st.submit_t:
             self.e2e_ms.observe((st.finish_t - st.submit_t) * 1e3)
+        if st.spec_steps:
+            self.counter("spec_drafted_total").add(st.spec_drafted)
+            self.counter("spec_accepted_total").add(st.spec_accepted)
+            if st.acceptance_rate is not None:
+                self.spec_accept_rate.observe(st.acceptance_rate)
+            if st.tokens_per_step is not None:
+                self.spec_tokens_per_step.observe(st.tokens_per_step)
 
     # -- export ------------------------------------------------------------
 
@@ -185,6 +213,9 @@ class ServingMetrics:
             "queue_delay_ms": self.queue_delay_ms.snapshot(),
             "prefill_ms": self.prefill_ms.snapshot(),
             "e2e_ms": self.e2e_ms.snapshot(),
+            "spec_accept_rate": self.spec_accept_rate.snapshot(),
+            "spec_tokens_per_step":
+                self.spec_tokens_per_step.snapshot(),
         }
 
     def prometheus_text(self) -> str:
@@ -192,7 +223,8 @@ class ServingMetrics:
         counter in the shared registry (``.`` → ``_``)."""
         lines: List[str] = []
         for h in (self.ttft_ms, self.tpot_ms, self.queue_delay_ms,
-                  self.prefill_ms, self.e2e_ms):
+                  self.prefill_ms, self.e2e_ms, self.spec_accept_rate,
+                  self.spec_tokens_per_step):
             lines.extend(h.prometheus_lines())
         for name, val in sorted(self.registry.snapshot().items()):
             pname = name.replace(".", "_")

@@ -31,6 +31,13 @@ error); ``serving.prefill`` fires inside engine admission and is
 retried per the ``serving.prefill`` resilience policy.
 
 Run it: ``python -m paddle_tpu.serving.server --model gpt_125m``.
+Speculative decoding: ``--speculate 4`` (n-gram/prompt-lookup draft,
+no second model) or ``--speculate 4 --draft-model gpt_tiny`` (a small
+model drafts; its greedy guesses are verified in one multi-token
+forward, so greedy outputs stay bit-identical to the vanilla engine
+while each accepted draft amortizes the weight/KV stream). Per-request
+acceptance rate and tokens-per-step land in the ``stats`` reply and
+the Prometheus ``metrics`` page.
 
 Reference analog: the C serving API / AnalysisPredictor server loop
 (SURVEY §1 rows 7/12), TPU-native over one jitted decode step.
@@ -540,13 +547,33 @@ def main(argv=None) -> None:
     parser.add_argument("--num-slots", type=int, default=4)
     parser.add_argument("--page-size", type=int, default=64)
     parser.add_argument("--no-prefix-cache", action="store_true")
+    parser.add_argument(
+        "--speculate", type=int, default=0, metavar="K",
+        help="draft K tokens per decode step and verify them in one "
+             "forward (0 = off); greedy outputs stay bit-identical")
+    parser.add_argument(
+        "--draft-model", default="ngram",
+        help="draft source for --speculate: 'ngram' (prompt lookup, "
+             "no second model) or a model name (e.g. gpt_tiny)")
+    parser.add_argument(
+        "--draft-window", type=int, default=64,
+        help="context window of a --draft-model draft")
     args = parser.parse_args(argv)
 
     model = _build_model(args.model)
+    speculative = None
+    if args.speculate > 0:
+        from ..inference import SpeculativeConfig
+        draft = args.draft_model
+        if draft != "ngram":
+            draft = _build_model(draft)
+        speculative = SpeculativeConfig(k=args.speculate, draft=draft,
+                                        draft_window=args.draft_window)
     server = ServingServer(model, host=args.host, port=args.port,
                            prefix_cache=not args.no_prefix_cache,
                            num_slots=args.num_slots,
-                           page_size=args.page_size)
+                           page_size=args.page_size,
+                           speculative=speculative)
     port = server.start()
     print(f"[paddle_tpu.serving] listening on {args.host}:{port} "
           f"(model {args.model}); newline-JSON, see module docstring",
