@@ -30,6 +30,17 @@ serving.verify       inference/continuous_batching speculative
                      draft-and-verify step (retried per the
                      serving.verify policy; fires BEFORE the donating
                      jit runs, so a retry never sees consumed buffers)
+engine.step          inference/continuous_batching engine step, FIRST
+                     thing — before admission and the donating jit, so
+                     host/device state is untouched; persistent firing
+                     drives the server's engine-resurrection path
+alloc.page           inference/continuous_batching PageAllocator
+                     alloc/reserve (before any free-list mutation);
+                     admission unwinds and requeues the request
+net.recv             serving/server.py connection reader and the
+                     supervisor's failover-router backend reader —
+                     the connection dies like a torn socket; keyed
+                     requests are resubmitted to a live replica
 ==================== =================================================
 
 Default-OFF: with no sites armed (the tier-1 default), ``fault_point``
@@ -58,6 +69,32 @@ from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional
 
 import numpy as np
+
+# The canonical fault-site registry: every site string passed to
+# fault_point() anywhere in the tree MUST have an entry here (enforced
+# by the registry-audit test), and every entry must carry a one-line
+# docstring plus a retry disposition in distributed/resilience.py —
+# either a get_retry_policy entry (_BUILTIN_SITE_POLICIES / default)
+# or an explicit NO_RETRY_SITES marker explaining who owns recovery.
+FAULT_SITES: Dict[str, str] = {
+    "checkpoint.write": "durable checkpoint save (manager + sharded)",
+    "checkpoint.read": "checkpoint restore / load_sharded",
+    "membership.heartbeat": "elastic membership store heartbeat",
+    "ps.push": "parameter-server gradient push",
+    "ps.pull": "parameter-server weight pull",
+    "ps.call": "parameter-server control-plane RPC (barrier/stop/...)",
+    "heter.push": "heterogeneous sparse-stage gradient push",
+    "heter.pull": "heterogeneous sparse-stage embedding pull",
+    "dataloader.fetch": "dataloader worker batch assembly",
+    "collective.step": "eager-host collective op (all_reduce/barrier)",
+    "trainer.step": "ResilientTrainer per-step gate",
+    "serving.request": "serving front-end per-request handling",
+    "serving.prefill": "decode-engine admission prefill",
+    "serving.verify": "speculative draft-and-verify step",
+    "engine.step": "decode-engine step (pre-admission, pre-jit)",
+    "alloc.page": "page-allocator alloc/reserve (pre-mutation)",
+    "net.recv": "connection receive (server + failover router)",
+}
 
 # Fast-path gate: False whenever no injector exists or no site is armed,
 # so production fault_point() calls cost one global read.
@@ -168,12 +205,19 @@ class FaultInjector:
             if spec is None or not spec.should_fire():
                 return None
             spec.fired += 1
-            fault = InjectedFault(site, spec.calls, spec.mode)
-            self.log.append(fault)
+            # the logged instance is NEVER the raised one: a raised
+            # exception carries __traceback__, and retaining it here
+            # would pin every frame on the faulting call stack (and
+            # everything those frames reference — sockets, buffers,
+            # engine state) for the injector's lifetime. A half-open
+            # connection whose fd hides in a logged traceback is a
+            # hang, not a chaos test.
+            logged = InjectedFault(site, spec.calls, spec.mode)
+            self.log.append(logged)
             if spec.mode == MODE_ABORT or spec.mode not in modes:
                 if spec.exc is not None:
-                    raise spec.exc(str(fault))
-                raise fault
+                    raise spec.exc(str(logged))
+                raise InjectedFault(site, spec.calls, spec.mode)
             return spec.mode
 
     def configure_from_env(self, env=None) -> "FaultInjector":

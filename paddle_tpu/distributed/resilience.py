@@ -180,6 +180,42 @@ _BUILTIN_SITE_POLICIES: Dict[str, "RetryPolicy"] = {
     # failure escalates through the server's engine-error cap)
     "serving.verify": RetryPolicy(max_attempts=3, base_delay_s=0.02,
                                   max_delay_s=0.25),
+    # the IO-bound training sites ride the stock policy; listing them
+    # explicitly is what the fault-site registry audit pins — a new
+    # fault site must declare its retry disposition here or in
+    # NO_RETRY_SITES, never implicitly
+    "checkpoint.write": DEFAULT_RETRY,
+    "checkpoint.read": DEFAULT_RETRY,
+    "membership.heartbeat": DEFAULT_RETRY,
+    "ps.push": DEFAULT_RETRY,
+    "ps.pull": DEFAULT_RETRY,
+    "ps.call": DEFAULT_RETRY,
+    "dataloader.fetch": DEFAULT_RETRY,
+}
+
+# Sites that are DELIBERATELY not retried in place: recovery is owned
+# by a higher layer, and an in-place retry would duplicate (or fight)
+# it. The registry-audit test requires every fault_inject.FAULT_SITES
+# entry to appear either in _BUILTIN_SITE_POLICIES or here.
+NO_RETRY_SITES: Dict[str, str] = {
+    "trainer.step": "recovery is checkpoint restore + replay "
+                    "(ResilientTrainer), not an in-place retry",
+    "collective.step": "a failed collective desyncs the group; the "
+                       "trainer-level restore owns recovery",
+    "heter.push": "async PS semantics: errors drain per-iteration and "
+                  "degrade the batch, they are not replayed",
+    "heter.pull": "async PS semantics: errors drain per-iteration and "
+                  "degrade the batch, they are not replayed",
+    "serving.request": "client-facing: the server answers a retryable "
+                       "typed reply and the CLIENT owns the retry",
+    "engine.step": "the serving loop counts consecutive failures; "
+                   "recovery is engine resurrection + in-flight "
+                   "replay (serving/server.py), not a per-step retry",
+    "alloc.page": "admission unwinds and requeues the request; the "
+                  "next engine step retries admission naturally",
+    "net.recv": "connection-level: the failover router resubmits "
+                "keyed requests to a live replica "
+                "(serving/supervisor.py)",
 }
 
 _site_policies: Dict[str, RetryPolicy] = {}

@@ -106,6 +106,11 @@ class PageAllocator:
         return sum(self._reserved.values())
 
     def alloc(self, owner: Hashable, n: int) -> Optional[List[int]]:
+        from ..distributed.fault_inject import fault_point
+        # chaos site: a transient allocation failure (the host-side
+        # analog of an HBM allocator hiccup). Admission treats it like
+        # a no-fit and requeues — never a leak, never a wedge.
+        fault_point("alloc.page")
         if n > self.free_count:
             return None
         pages = [self._free.pop() for _ in range(n)]
@@ -114,6 +119,8 @@ class PageAllocator:
 
     def reserve(self, owner: Hashable, n: int) -> bool:
         """All-or-nothing capacity claim (no physical pages bound)."""
+        from ..distributed.fault_inject import fault_point
+        fault_point("alloc.page")  # same chaos regime as alloc()
         if n > self.free_count:
             return False
         if n:
@@ -281,11 +288,18 @@ class DecodeRequest:
     generated: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
     done: bool = False
-    state: str = "queued"  # queued|prefill|decoding|done|evicted|shed|failed
+    # queued|prefill|decoding|done|evicted|shed|failed|deadline|stalled
+    state: str = "queued"
     stats: RequestStats = dataclasses.field(default_factory=RequestStats)
     on_token: Optional[Callable[[int, int, bool], None]] = None
     cache_keys: Tuple[Hashable, ...] = ()   # prefix-cache chain refs held
     bypass_count: int = 0             # times a later request jumped us
+    # absolute time.monotonic() deadline (None = no deadline); carried
+    # from the protocol's deadline_ms through admission, decode steps
+    # and eviction so an expired request never holds pages
+    deadline_t: Optional[float] = None
+    # last time a token was delivered (stall watchdog input)
+    last_emit_t: float = 0.0
 
     @property
     def tokens(self) -> np.ndarray:
@@ -312,7 +326,8 @@ class ContinuousBatchingEngine:
                  on_complete: Optional[Callable[["DecodeRequest"],
                                                 None]] = None,
                  max_prefill_attempts: int = 3,
-                 speculative=None, verify_retry="site"):
+                 speculative=None, verify_retry="site",
+                 stall_timeout_s: Optional[float] = None):
         import jax.numpy as jnp
 
         from ..core.compile_cache import enable_compile_cache
@@ -387,6 +402,16 @@ class ContinuousBatchingEngine:
         self._prefill_retry = prefill_retry
         self._on_complete = on_complete
         self.max_prefill_attempts = int(max_prefill_attempts)
+        # stall watchdog: a slot that delivers no token for this long
+        # is evicted with the typed "stalled" state instead of holding
+        # its pages forever (None = off). Healthy engines emit a token
+        # per active slot per step, so a stall only ever means the
+        # step itself is failing or pathologically slow.
+        self.stall_timeout_s = (None if stall_timeout_s is None
+                                else float(stall_timeout_s))
+        # EMA of decode-step wall time: the deadline admission gate's
+        # estimate of whether a request can still finish in time
+        self.step_ema_s: Optional[float] = None
         # speculative decoding (inference/speculative.py): draft k
         # tokens per step, verify all k+1 in ONE forward, emit the
         # longest accepted prefix + 1. Greedy stays bit-identical to
@@ -410,8 +435,8 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt, max_new_tokens: int,
                eos_token: Optional[int] = None, priority: int = 1,
-               on_token: Optional[Callable[[int, int, bool], None]] = None
-               ) -> int:
+               on_token: Optional[Callable[[int, int, bool], None]] = None,
+               deadline_t: Optional[float] = None) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) + max_new_tokens > self.max_seq_len:
             raise ValueError(
@@ -434,7 +459,9 @@ class ContinuousBatchingEngine:
                 f"request")
         req = DecodeRequest(self._next_id, prompt, int(max_new_tokens),
                             eos_token, priority=int(priority),
-                            on_token=on_token)
+                            on_token=on_token,
+                            deadline_t=(None if deadline_t is None
+                                        else float(deadline_t)))
         req.stats.submit_t = time.monotonic()
         req.stats.prompt_len = len(prompt)
         self._next_id += 1
@@ -675,26 +702,156 @@ class ContinuousBatchingEngine:
         # the completion notification; callbacks run on the engine
         # thread and must not raise — the server's callback catches
         # its own socket errors
+        req.last_emit_t = time.monotonic()
         if req.on_token is not None:
             req.on_token(req.req_id, tok, self._finish_due(req))
+
+    # -- typed mid-flight eviction (deadline / stall / replay) -------------
+
+    def _evict_slot(self, slot: int, state: str) -> DecodeRequest:
+        """Tear one active slot down with a typed terminal ``state``:
+        return its pages AND any outstanding speculative reservation
+        (`PageAllocator.free` drops both — the same unwinding the
+        rejection-rollback machinery relies on), drop the prefix-cache
+        pins, park the slot on the scratch page, and notify."""
+        req = self._slots[slot]
+        self.allocator.free(req.req_id)
+        if self._prefix_cache is not None and req.cache_keys:
+            self._prefix_cache.release(req.cache_keys)
+            req.cache_keys = ()
+        req.state = state
+        req.done = True
+        req.stats.finish_t = time.monotonic()
+        req.stats.tokens_out = len(req.generated)
+        self._table[slot] = self._scratch
+        self._lens[slot] = 0
+        self._cur[slot] = 0
+        self._slots[slot] = None
+        self._notify_complete(req)
+        return req
+
+    def _terminate_queued(self, req: DecodeRequest, state: str) -> None:
+        self._queue.remove(req)
+        req.state = state
+        req.done = True
+        req.stats.finish_t = time.monotonic()
+        self._notify_complete(req)
+
+    def _deadline_hopeless(self, req: DecodeRequest, now: float) -> bool:
+        """Admission gate: True when the request provably cannot finish
+        before its deadline — already expired, or even the BEST-case
+        remaining work times the observed step cadence overshoots it.
+        Best-case, not expected: ``max_new_tokens`` is a cap (an
+        ``eos_token`` can legally end the generation after one token)
+        and a speculative step emits up to k+1 tokens — overestimating
+        here would shed feasible work. Without an EMA yet (cold engine)
+        only hard expiry counts: guessing would shed work a fast engine
+        could still serve."""
+        if req.deadline_t is None:
+            return False
+        if now >= req.deadline_t:
+            return True
+        if self.step_ema_s is not None:
+            need = 1 if req.eos_token is not None else req.max_new_tokens
+            per_step = 1 if self._spec_cfg is None else self._spec_cfg.k + 1
+            steps = -(-need // per_step)
+            return now + steps * self.step_ema_s > req.deadline_t
+        return False
+
+    def expire_deadlines(self, now: Optional[float] = None
+                         ) -> List[DecodeRequest]:
+        """Terminate everything past its deadline with the typed
+        "deadline" state: queued requests are shed before prefill,
+        active slots are evicted mid-flight with their pages (and any
+        speculative reservation) returned. Runs at the top of every
+        step and is safe to call from the serving loop even when the
+        step itself is failing (host state only)."""
+        now = time.monotonic() if now is None else now
+        expired: List[DecodeRequest] = []
+        for req in [r for r in self._queue
+                    if r.deadline_t is not None and now >= r.deadline_t]:
+            self._terminate_queued(req, "deadline")
+            expired.append(req)
+        for slot, req in enumerate(self._slots):
+            if req is not None and req.deadline_t is not None \
+                    and now >= req.deadline_t:
+                expired.append(self._evict_slot(slot, "deadline"))
+        return expired
+
+    def evict_stalled(self, now: Optional[float] = None
+                      ) -> List[DecodeRequest]:
+        """Stall watchdog: evict active slots that have delivered no
+        token for ``stall_timeout_s`` with the typed "stalled" state
+        instead of holding pages forever. No-op when the watchdog is
+        off. Like `expire_deadlines` this touches host state only, so
+        the serving loop calls it even mid engine failure."""
+        if self.stall_timeout_s is None:
+            return []
+        now = time.monotonic() if now is None else now
+        out: List[DecodeRequest] = []
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            last = max(req.last_emit_t, req.stats.admit_t)
+            if now - last > self.stall_timeout_s:
+                out.append(self._evict_slot(slot, "stalled"))
+        return out
+
+    def dump_inflight(self) -> List[DecodeRequest]:
+        """Snapshot every request the engine still owes an answer for
+        (active slots + wait queue) in submission order — the engine-
+        resurrection input: each request's prompt plus already-emitted
+        tokens is everything needed to rebuild its KV state on a fresh
+        engine via a chained greedy prefill (bit-identical continuation
+        is the paged design's recovery dividend). Does NOT release
+        anything; callers tear down via close()."""
+        live = [r for r in self._slots if r is not None]
+        return sorted(live + list(self._queue), key=lambda r: r.req_id)
 
     def _admit(self) -> None:
         self._shed_overloaded()
         for slot in range(self.num_slots):
             if self._slots[slot] is not None:
                 continue
-            req = self._select_next()
-            if req is None:
+            while True:
+                req = self._select_next()
+                if req is None:
+                    return
+                if self._deadline_hopeless(req, time.monotonic()):
+                    # never admit a request that can't finish: prefill
+                    # compute spent on it is pure waste and its pages
+                    # would be clawed back next step anyway
+                    req.state = "deadline"
+                    req.done = True
+                    req.stats.finish_t = time.monotonic()
+                    self._notify_complete(req)
+                    continue
                 break
-            if not self._admit_into(slot, req):
-                break
+            committed = self._admit_into(slot, req)
+            if committed is False:
+                return
+            if committed is None:
+                # deadline expired mid-prefill: the admission was
+                # unwound typed and the slot is free again. No queue
+                # jump happened, so fall through WITHOUT the fairness
+                # charge — phantom bypass charges from a stream of
+                # deadline-tight requests could otherwise starve the
+                # queue (note_admitted is for COMMITTED admissions
+                # only). The next step's _admit refills the slot.
+                continue
             # fairness accounting happens only on COMMITTED admissions
             # (a failed/unwound admission must not charge bypasses)
             note = getattr(self._scheduler, "note_admitted", None)
             if note is not None:
                 note(req, self._queue, time.monotonic())
 
-    def _admit_into(self, slot: int, req: DecodeRequest) -> bool:
+    def _admit_into(self, slot: int, req: DecodeRequest
+                    ) -> Optional[bool]:
+        """Admit ``req`` into ``slot``. Returns True on a committed
+        admission, False when it doesn't fit (stop admitting this
+        step), None when the deadline expired mid-prefill and the
+        admission was unwound typed (slot is free again; caller must
+        not charge fairness accounting)."""
         jnp = self._jnp
         cache = self._prefix_cache
         keys: Tuple[Hashable, ...] = ()
@@ -725,10 +882,19 @@ class ContinuousBatchingEngine:
             return self.allocator.alloc_reserved(req.req_id,
                                                  prefill_need)
 
-        pages = grab()
-        if pages is None and cache is not None:
-            if cache.evict_until(self.allocator, private_need):
-                pages = grab()
+        from ..distributed.fault_inject import InjectedFault
+        try:
+            pages = grab()
+            if pages is None and cache is not None:
+                if cache.evict_until(self.allocator, private_need):
+                    pages = grab()
+        except InjectedFault:
+            # armed alloc.page site: a transient allocation failure is
+            # the same outcome as not fitting — unwind and requeue;
+            # the next step retries admission (alloc/reserve raise
+            # BEFORE mutating the free list, so there is nothing to
+            # roll back)
+            pages = None
         if pages is None:
             if cache is not None:
                 cache.release(keys)
@@ -811,6 +977,21 @@ class ContinuousBatchingEngine:
         now = time.monotonic()
         req.stats.prefill_ms = (now - t0) * 1e3
         req.stats.prefill_attempts += 1
+        if req.deadline_t is not None and now >= req.deadline_t:
+            # deadline expired MID-PREFILL: the forward pass is paid
+            # for, but delivering a token past the deadline breaks the
+            # contract — unwind the admission typed instead (pools were
+            # adopted above, so device state stays coherent)
+            self.allocator.free(req.req_id)
+            if cache is not None:
+                cache.release(keys)
+                req.cache_keys = ()
+            self._table[slot] = self._scratch
+            req.state = "deadline"
+            req.done = True
+            req.stats.finish_t = now
+            self._notify_complete(req)
+            return None
         req.stats.first_token_t = now
         self._lens[slot] = len(req.prompt)
         self._cur[slot] = int(nxt)
@@ -998,13 +1179,34 @@ class ContinuousBatchingEngine:
     def step(self) -> int:
         """Admit what fits, run ONE fixed-shape decode step (or one
         draft-and-verify speculative step), evict what finished.
-        Returns the number of still-active slots."""
-        jnp = self._jnp
+        Returns the number of still-active slots. The ``engine.step``
+        fault site fires FIRST — before admission and before the
+        donating jit — so an injected step failure leaves host and
+        device state exactly as the previous step left them (the
+        precondition for the serving layer's resurrection replay)."""
+        from ..distributed.fault_inject import fault_point
+        fault_point("engine.step")
+        self.expire_deadlines()
+        self.evict_stalled()
         self._admit()
         if self.num_active == 0:
             return 0
-        if self._spec_cfg is not None:
-            return self._spec_step()
+        t0 = time.monotonic()
+        try:
+            if self._spec_cfg is not None:
+                return self._spec_step()
+            return self._decode_step()
+        finally:
+            # skip the first step: its wall time is dominated by the
+            # one-off decode/prefill compiles and would poison the
+            # deadline gate's estimate for the engine's whole warmup
+            if self.steps > 1:
+                dt = time.monotonic() - t0
+                self.step_ema_s = dt if self.step_ema_s is None else \
+                    0.8 * self.step_ema_s + 0.2 * dt
+
+    def _decode_step(self) -> int:
+        jnp = self._jnp
         if self._decode_jit is None:
             self._decode_jit = self._build_decode()
         active = np.array([r is not None for r in self._slots])
@@ -1060,28 +1262,11 @@ class ContinuousBatchingEngine:
         — the graceful-drain endpoint bench/tests call on every exit
         path (a drained `run()` followed by close() is the clean
         shutdown; close() mid-flight is the hard stop)."""
-        now = time.monotonic()
         for slot, req in enumerate(self._slots):
-            if req is None:
-                continue
-            self.allocator.free(req.req_id)
-            if self._prefix_cache is not None and req.cache_keys:
-                self._prefix_cache.release(req.cache_keys)
-                req.cache_keys = ()
-            req.state = "evicted"
-            req.done = True
-            req.stats.finish_t = now
-            self._table[slot] = self._scratch
-            self._lens[slot] = 0
-            self._cur[slot] = 0
-            self._slots[slot] = None
-            self._notify_complete(req)
-        for req in self._queue:
-            req.state = "evicted"
-            req.done = True
-            req.stats.finish_t = now
-            self._notify_complete(req)
-        self._queue.clear()
+            if req is not None:
+                self._evict_slot(slot, "evicted")
+        for req in list(self._queue):
+            self._terminate_queued(req, "evicted")
         if self._prefix_cache is not None:
             self._prefix_cache.clear(self.allocator)
         self.allocator.check_no_leak()

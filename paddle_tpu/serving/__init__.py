@@ -22,7 +22,18 @@ continuous batching, PR r6) into a servable system:
 - ``metrics``: per-request TTFT / TPOT / queue-delay histograms and
   cache-hit / shed counters in core.monitor's StatRegistry, with a
   Prometheus-style text export — plus speculative-decoding
-  acceptance-rate and tokens-per-step histograms (r8).
+  acceptance-rate and tokens-per-step histograms (r8), engine
+  occupancy gauges and resurrection/replay counters (r9).
+- ``supervisor``: crash-safe serving above the process boundary (r9)
+  — N supervised replica processes with health-probed backoff
+  restarts, fronted by a failover router that resubmits idempotent
+  (keyed) requests from a dead replica to a live one. Below the
+  process boundary, the server resurrects a persistently-failing
+  engine and REPLAYS in-flight requests from their token history
+  (greedy continuations bit-identical to the uninterrupted run), and
+  a per-request ``deadline_ms`` budget is enforced at every lifecycle
+  stage with typed ``DeadlineExceeded`` replies. The seeded chaos
+  harness driving all of it lives in tools/chaos_serving.py.
 
 Speculative decoding (r8): pass ``--speculate K`` (CLI) or
 ``speculative=SpeculativeConfig(k=K, draft=...)`` (engine kwargs) to
@@ -44,11 +55,14 @@ from .scheduler import (Priority, ServerOverloaded, SLOConfig,  # noqa: F401
 
 
 def __getattr__(name):
-    # server.py is lazy so `python -m paddle_tpu.serving.server` does
-    # not execute the module twice (runpy re-runs what the package
-    # __init__ already imported)
+    # server.py / supervisor.py are lazy so `python -m
+    # paddle_tpu.serving.<mod>` does not execute the module twice
+    # (runpy re-runs what the package __init__ already imported)
     if name in ("ServingServer", "client_request"):
         from . import server
         return getattr(server, name)
+    if name in ("Supervisor", "FailoverRouter", "Replica"):
+        from . import supervisor
+        return getattr(supervisor, name)
     raise AttributeError(
         f"module 'paddle_tpu.serving' has no attribute {name!r}")

@@ -118,12 +118,24 @@ class ServingMetrics:
                 "cache_hit_requests_total", "shed_total",
                 "rejected_total", "evicted_total", "failed_total",
                 "prefill_retries_total", "engine_errors_total",
-                "spec_drafted_total", "spec_accepted_total")
+                "spec_drafted_total", "spec_accepted_total",
+                # crash-safe serving (r9): resurrection + typed-evict
+                # accounting
+                "engine_restarts_total", "replayed_requests_total",
+                "engine_teardown_leaks_total",
+                "engine_resurrect_failures_total",
+                "deadline_exceeded_total", "stalled_total",
+                "net_recv_drops_total")
 
     def __init__(self, registry: Optional[StatRegistry] = None,
                  prefix: str = "serving"):
         self.registry = registry if registry is not None else GLOBAL_STATS
         self.prefix = prefix
+        # live gauge source (engine occupancy): a callable returning
+        # {name: value}, sampled at scrape time — the server wires
+        # in-flight slots / free vs reserved pages / prefix-cache
+        # residency through this
+        self._gauge_fn = None
         self.ttft_ms = Histogram(f"{prefix}.ttft_ms")
         self.tpot_ms = Histogram(f"{prefix}.tpot_ms")
         self.queue_delay_ms = Histogram(f"{prefix}.queue_delay_ms")
@@ -155,6 +167,21 @@ class ServingMetrics:
 
     # -- ingestion ---------------------------------------------------------
 
+    def set_gauge_fn(self, fn) -> None:
+        """Install the occupancy-gauge source (None disables)."""
+        self._gauge_fn = fn
+
+    def gauges(self) -> Dict[str, float]:
+        """Sample the gauge source (empty when unset or failing — a
+        scrape must never die because the engine is mid-swap)."""
+        if self._gauge_fn is None:
+            return {}
+        try:
+            return {str(k): float(v)
+                    for k, v in self._gauge_fn().items()}
+        except Exception:
+            return {}
+
     def observe_request(self, req) -> None:
         """Terminal-state hook (engine ``on_complete``)."""
         st = req.stats
@@ -164,6 +191,15 @@ class ServingMetrics:
             return
         if req.state == "evicted":
             self.counter("evicted_total").add()
+            return
+        if req.state == "deadline":
+            self.counter("deadline_exceeded_total").add()
+            # streamed tokens delivered before expiry still count
+            self.counter("tokens_generated_total").add(st.tokens_out)
+            return
+        if req.state == "stalled":
+            self.counter("stalled_total").add()
+            self.counter("tokens_generated_total").add(st.tokens_out)
             return
         if req.state == "failed":
             self.counter("failed_total").add()
@@ -208,6 +244,7 @@ class ServingMetrics:
         counters = {c: self.counter(c).get() for c in self.COUNTERS}
         return {
             "counters": counters,
+            "gauges": self.gauges(),
             "ttft_ms": self.ttft_ms.snapshot(),
             "tpot_ms": self.tpot_ms.snapshot(),
             "queue_delay_ms": self.queue_delay_ms.snapshot(),
@@ -221,11 +258,20 @@ class ServingMetrics:
     def prometheus_text(self) -> str:
         """Prometheus text exposition: serving histograms + every
         counter in the shared registry (``.`` → ``_``)."""
+        # materialize the declared counters so a FRESH server exports
+        # them at 0 (Prometheus convention: absent-until-first-event
+        # counters break rate() and alerting on the scrape side)
+        for c in self.COUNTERS:
+            self.counter(c)
         lines: List[str] = []
         for h in (self.ttft_ms, self.tpot_ms, self.queue_delay_ms,
                   self.prefill_ms, self.e2e_ms, self.spec_accept_rate,
                   self.spec_tokens_per_step):
             lines.extend(h.prometheus_lines())
+        for name, val in sorted(self.gauges().items()):
+            gname = f"{self.prefix}_{name}".replace(".", "_")
+            lines.append(f"# TYPE {gname} gauge")
+            lines.append(f"{gname} {val:g}")
         for name, val in sorted(self.registry.snapshot().items()):
             pname = name.replace(".", "_")
             lines.append(f"# TYPE {pname} counter")

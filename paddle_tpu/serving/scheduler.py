@@ -109,9 +109,16 @@ class SLOScheduler:
         starved = [r for r in queue
                    if r.bypass_count >= cfg.max_bypass]
         pool = starved if starved else list(queue)
-        # stable order: effective priority desc, then arrival
-        pool.sort(key=lambda r: (-self.effective_priority(r, now),
-                                 r.stats.submit_t))
+        # stable order: effective priority desc, then earliest deadline
+        # (requests without one sort last within their class), then
+        # arrival — EDF inside a class so a tight deadline_ms is spent
+        # queueing as little as possible
+        pool.sort(key=lambda r: (
+            -self.effective_priority(r, now),
+            getattr(r, "deadline_t", None)
+            if getattr(r, "deadline_t", None) is not None
+            else float("inf"),
+            r.stats.submit_t))
         for cand in pool:
             if fits(cand):
                 return queue.index(cand)

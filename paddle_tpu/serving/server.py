@@ -3,7 +3,8 @@
 Newline-JSON protocol (one JSON object per line, both directions):
 
     -> {"op": "generate", "prompt": [1, 2, 3], "max_new_tokens": 8,
-        "priority": "interactive", "stream": true, "eos": 7}
+        "priority": "interactive", "stream": true, "eos": 7,
+        "deadline_ms": 5000, "key": "req-42"}
     <- {"rid": 0, "token": 17, "done": false}          # per token (stream)
     <- {"rid": 0, "done": true, "tokens": [...], "stats": {...}}
     -> {"op": "health"}
@@ -11,12 +12,35 @@ Newline-JSON protocol (one JSON object per line, both directions):
     -> {"op": "stats"}     # metrics snapshot (JSON)
     -> {"op": "metrics"}   # Prometheus text page (in "text")
     -> {"op": "drain"}     # stop admitting, finish in-flight, close
+    -> {"op": "leak_check"}  # engine-thread page-accounting audit
+
+``deadline_ms`` is a completion budget measured from arrival: a
+request that cannot finish in time is never admitted (shed from the
+queue), and one already decoding is evicted mid-flight with its pages
+(and any speculative reservation) returned — either way the client
+gets a typed ``{"error": "DeadlineExceeded"}``, never a hang.
+``key`` marks the request idempotent for the failover router
+(serving/supervisor.py): greedy decoding is deterministic, so a keyed
+request that dies with its replica is safely resubmitted to another.
 
 Typed failures are structured replies, never hangs: an overloaded
 queue answers ``{"error": "ServerOverloaded", "retry_after_ms": ...}``
 (serving/scheduler.py), a prefill whose retries exhausted answers
 ``{"error": "PrefillFailed"}``, a drain answers in-flight requests
-normally and rejects new ones with ``{"error": "ServerDraining"}``.
+normally and rejects new ones with ``{"error": "ServerDraining"}``, a
+slot that stops emitting answers ``{"error": "RequestStalled"}``
+(``stall_timeout_s`` watchdog), an expired budget answers
+``{"error": "DeadlineExceeded"}``.
+
+Engine resurrection: when ``max_engine_errors`` consecutive step
+failures mark the engine dead, the server does NOT fail its clients —
+it tears the engine down (pages returned and audited), rebuilds it
+(a PADDLE_TPU_COMPILE_CACHE dir makes the re-compiles cache reads),
+and replays every in-flight request from its prompt + already-emitted
+tokens as one chained greedy prefill. Greedy continuations are
+bit-identical to the uninterrupted run, so clients just see a pause.
+Only after ``max_engine_restarts`` resurrections does the server fail
+typed (EngineFailed) and stop admitting.
 
 Threading model: the ENGINE THREAD exclusively owns the engine (it is
 not thread-safe) — connection threads parse requests and hand them
@@ -28,7 +52,11 @@ every page, `engine.close()` (which asserts ``check_no_leak``).
 Fault sites (distributed/fault_inject.py): ``serving.request`` fires
 in the connection thread per request (clients get a retryable typed
 error); ``serving.prefill`` fires inside engine admission and is
-retried per the ``serving.prefill`` resilience policy.
+retried per the ``serving.prefill`` resilience policy; ``engine.step``
+fires at the top of the decode step (persistent firing drives the
+resurrection path); ``alloc.page`` fires in the page allocator
+(admission requeues); ``net.recv`` tears the connection down like a
+half-open socket (the failover router resubmits keyed requests).
 
 Run it: ``python -m paddle_tpu.serving.server --model gpt_125m``.
 Speculative decoding: ``--speculate 4`` (n-gram/prompt-lookup draft,
@@ -60,6 +88,21 @@ from .scheduler import Priority, ServerOverloaded, SLOScheduler
 
 __all__ = ["ServingServer", "client_request"]
 
+import os as _os
+import sys as _sys
+
+# PT_SERVING_DEBUG=1: engine-thread request-lifecycle tracing on
+# stderr (submits, completions, resurrection snapshots/replays). The
+# chaos harness's postmortems lean on this — it is how a request that
+# vanishes between layers is localized.
+_DEBUG = bool(_os.environ.get("PT_SERVING_DEBUG"))
+
+
+def _dbg(msg: str) -> None:
+    if _DEBUG:
+        print(f"[pt-serving-dbg {time.monotonic():.3f}] {msg}",
+              file=_sys.stderr, flush=True)
+
 _PRIORITIES = {"batch": Priority.BATCH, "normal": Priority.NORMAL,
                "interactive": Priority.INTERACTIVE}
 
@@ -90,8 +133,8 @@ class ServingServer:
                  metrics: Optional[ServingMetrics] = None,
                  prefill_retry="site", max_new_tokens_cap: int = 512,
                  poll_interval_s: float = 0.02,
-                 max_engine_errors: int = 32, **engine_kwargs):
-        from ..inference import create_decode_engine
+                 max_engine_errors: int = 32,
+                 max_engine_restarts: int = 2, **engine_kwargs):
         from ..distributed.resilience import get_retry_policy
 
         self.host = host
@@ -99,20 +142,44 @@ class ServingServer:
         self.scheduler = scheduler if scheduler is not None \
             else SLOScheduler()
         self.metrics = metrics if metrics is not None else ServingMetrics()
-        page_size = int(engine_kwargs.get("page_size", 64))
-        self.prefix_cache = PrefixCache(page_size) if prefix_cache \
-            else None
+        self._use_prefix_cache = bool(prefix_cache)
+        self._page_size = int(engine_kwargs.get("page_size", 64))
         if prefill_retry == "site":
             prefill_retry = get_retry_policy("serving.prefill")
-        self.engine = create_decode_engine(
-            model, scheduler=self.scheduler,
-            prefix_cache=self.prefix_cache,
-            prefill_retry=prefill_retry,
-            on_complete=self._on_complete, **engine_kwargs)
+        # everything a rebuild needs, captured once: engine resurrection
+        # constructs a bit-equivalent engine from these after a terminal
+        # step failure (fresh allocator, fresh pools, fresh prefix
+        # cache — the old one's books die with the old allocator)
+        self._model = model
+        self._prefill_retry = prefill_retry
+        self._engine_kwargs = dict(engine_kwargs)
+        pb = self._engine_kwargs.get("prompt_buckets")
+        if pb:
+            # resurrection replays prompt + already-emitted tokens as
+            # ONE chained prefill, so every length up to max_seq_len
+            # must be representable as a prompt — a custom bucket
+            # ladder that stops short would turn a transparent replay
+            # into ReplayFailed. Extend it; prefill jits retrace per
+            # shape lazily, so the extra bucket costs nothing until a
+            # replay (or a long prompt) first uses it.
+            msl = int(self._engine_kwargs.get("max_seq_len")
+                      or model.config.max_seq_len)
+            self._engine_kwargs["prompt_buckets"] = sorted(
+                set(int(x) for x in pb) | {msl})
+        self.prefix_cache: Optional[PrefixCache] = None
+        self.engine = self._build_engine()
         self.max_new_tokens_cap = int(max_new_tokens_cap)
         self.poll_interval_s = float(poll_interval_s)
         self.max_engine_errors = int(max_engine_errors)
+        self.max_engine_restarts = int(max_engine_restarts)
         self._consec_errors = 0
+        self._restarts = 0
+        # replay ledger: new req_id -> (original prompt, tokens already
+        # delivered before the crash, the original request's stats);
+        # _on_complete stitches the full sequence — and the telemetry —
+        # back together for the final reply
+        self._replay: Dict[int, tuple] = {}
+        self.metrics.set_gauge_fn(self._gauges)
 
         self._inbox: "queue_mod.Queue[tuple]" = queue_mod.Queue()
         self._admission_lock = threading.Lock()
@@ -129,6 +196,23 @@ class ServingServer:
         self._conns_lock = threading.Lock()
         self._t0 = time.monotonic()
         self.port: Optional[int] = None
+
+    def _build_engine(self):
+        """(Re)build the decode engine from the captured construction
+        recipe. The prefix cache is rebuilt too: its books reference
+        pages in the engine's allocator, so a cache may never outlive
+        its engine. A PADDLE_TPU_COMPILE_CACHE dir (core/compile_cache,
+        enabled inside the engine constructor) turns the rebuilt
+        engine's prefill/decode/verify compiles into cache reads — the
+        warm-resurrection lane."""
+        from ..inference import create_decode_engine
+        self.prefix_cache = (PrefixCache(self._page_size)
+                             if self._use_prefix_cache else None)
+        return create_decode_engine(
+            self._model, scheduler=self.scheduler,
+            prefix_cache=self.prefix_cache,
+            prefill_retry=self._prefill_retry,
+            on_complete=self._on_complete, **self._engine_kwargs)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -178,6 +262,20 @@ class ServingServer:
         with self._conns_lock:
             conns = list(self._conns)
             threads = list(self._conn_threads)
+        # let conn threads FLUSH first: the engine thread has exited,
+        # so every pending outbox resolves (result or ServerEvicted)
+        # within one poll tick — tearing the sockets down before that
+        # relay races the final reply and a graceful client sees EOF
+        # mid-request instead of its typed answer. Clients that close
+        # after the reply release their conn thread immediately; idle
+        # keep-alive readers hold readline open, so the wait is
+        # bounded and stragglers are force-closed below.
+        flush_deadline = time.monotonic() + 5.0
+        for t in threads:
+            if t is threading.current_thread():
+                continue
+            t.join(timeout=max(0.0,
+                               flush_deadline - time.monotonic()))
         for c in conns:
             try:
                 c.shutdown(socket.SHUT_RDWR)
@@ -188,6 +286,8 @@ class ServingServer:
             except OSError:
                 pass
         for t in threads:
+            if t is threading.current_thread():
+                continue
             t.join(timeout=5.0)
 
     def __enter__(self) -> "ServingServer":
@@ -200,8 +300,24 @@ class ServingServer:
     # -- engine thread -----------------------------------------------------
 
     def _engine_loop(self) -> None:
-        eng = self.engine
+        """Engine-thread entry: the no-hang contract is STRUCTURAL —
+        whatever escapes the serving loop below (it should handle
+        everything itself) becomes a typed EngineFailed broadcast plus
+        ``_engine_done``, never a silently dead thread with clients
+        spinning on their outboxes forever."""
+        try:
+            self._engine_loop_inner()
+        except Exception:
+            try:
+                self._fail_engine()
+            finally:
+                self._engine_done.set()
+
+    def _engine_loop_inner(self) -> None:
         while True:
+            # re-read self.engine every iteration: resurrection swaps
+            # the instance mid-loop
+            eng = self.engine
             self._drain_inbox()
             has_work = eng.num_queued or eng.num_active
             if has_work:
@@ -221,11 +337,38 @@ class ServingServer:
                     # outlive it either way. A PERSISTENT step failure
                     # (decode jit broken, pools consumed) must not
                     # wedge clients forever: past the consecutive-error
-                    # cap, fail everything typed and stop admitting.
+                    # cap the engine is RESURRECTED — torn down, pages
+                    # audited, rebuilt, and every in-flight request
+                    # replayed from its token history (clients see a
+                    # pause, not an error); only when restarts are
+                    # exhausted too does the server fail typed and
+                    # stop admitting.
                     self.metrics.counter("engine_errors_total").add()
                     self._consec_errors += 1
+                    # a failing step never reaches its own deadline /
+                    # stall sweeps — run them here so a broken engine
+                    # still sheds doomed work typed instead of letting
+                    # requests ride the outage into a hang
+                    try:
+                        self.engine.expire_deadlines()
+                        self.engine.evict_stalled()
+                    except Exception:
+                        pass
                     if self._consec_errors >= self.max_engine_errors:
-                        self._fail_engine()
+                        if self._restarts < self.max_engine_restarts:
+                            try:
+                                self._resurrect_engine()
+                            except Exception:
+                                # the rebuild/replay failed too —
+                                # almost certainly the same root cause
+                                # that broke the engine. Terminal and
+                                # TYPED, never a dead thread.
+                                self.metrics.counter(
+                                    "engine_resurrect_failures_total"
+                                ).add()
+                                self._fail_engine()
+                        else:
+                            self._fail_engine()
                     time.sleep(self.poll_interval_s)
                 continue
             if self._stopping and self._inbox.empty():
@@ -242,6 +385,80 @@ class ServingServer:
                 return
             self._wake.wait(timeout=self.poll_interval_s)
             self._wake.clear()
+
+    def _resurrect_engine(self) -> None:
+        """Terminal engine-step failure, recoverable edition (engine
+        thread): snapshot every request the dead engine still owes an
+        answer for, tear the engine down (pages returned and audited by
+        ``close()``), rebuild it from the captured recipe, and REPLAY
+        each in-flight request — its prompt plus already-emitted tokens
+        resubmitted as one chained greedy prefill, so the continuation
+        is bit-identical to the uninterrupted run and the client sees a
+        pause instead of an error. Requests still in the server inbox
+        are untouched: the next ``_drain_inbox`` submits them to the
+        new engine."""
+        self._restarts += 1
+        self.metrics.counter("engine_restarts_total").add()
+        old = self.engine
+        snapshot = old.dump_inflight()
+        _dbg(f"resurrect: snapshot rids="
+             f"{[(r.req_id, len(r.prompt), len(r.generated), r.state) for r in snapshot]} "
+             f"pending={sorted(self._pending)} "
+             f"inbox={self._inbox.qsize()}")
+        # detach the completion hook BEFORE close(): teardown evictions
+        # are an implementation detail of the restart, not terminal
+        # replies the clients should see
+        old.set_on_complete(None)
+        try:
+            old.close()
+        except Exception:
+            # a torn allocator is possible when the failure hit
+            # half-applied host state; the old engine (and its pools)
+            # are dropped wholesale either way — count it, don't die
+            self.metrics.counter("engine_teardown_leaks_total").add()
+        self.engine = self._build_engine()
+        for req in snapshot:
+            pending = self._pending.pop(req.req_id, None)
+            # compose across repeated resurrections: the snapshot's
+            # prompt may itself be a replay prompt
+            prior = self._replay.pop(req.req_id, None)
+            if prior is not None:
+                orig_prompt, pre, orig_stats = prior
+                pre = list(pre) + [int(t) for t in req.generated]
+            else:
+                orig_prompt = [int(t) for t in req.prompt]
+                pre = [int(t) for t in req.generated]
+                orig_stats = req.stats
+            replay_prompt = np.concatenate(
+                [req.prompt, np.asarray(req.generated, np.int32)])
+            remaining = req.max_new_tokens - len(req.generated)
+            on_token = None
+            if pending is not None and pending.stream:
+                def on_token(rid, tok, done, _p=pending):
+                    _p.outbox.put({"rid": rid, "token": int(tok),
+                                   "done": bool(done)})
+            try:
+                new_rid = self.engine.submit(
+                    replay_prompt, max_new_tokens=remaining,
+                    eos_token=req.eos_token, priority=req.priority,
+                    deadline_t=req.deadline_t, on_token=on_token)
+            except Exception as e:
+                _dbg(f"replay FAILED old_rid={req.req_id}: "
+                     f"{type(e).__name__}: {e}")
+                if pending is not None:
+                    pending.outbox.put(
+                        {"error": "ReplayFailed",
+                         "reason": f"{type(e).__name__}: {e}"})
+                    pending.outbox.put(None)
+                continue
+            self.metrics.counter("replayed_requests_total").add()
+            _dbg(f"replay old_rid={req.req_id} -> new_rid={new_rid} "
+                 f"pending={'yes' if pending is not None else 'NO'}")
+            self._replay[new_rid] = (orig_prompt, pre, orig_stats)
+            if pending is not None:
+                self._pending[new_rid] = pending
+        self._consec_errors = 0
+        self._wake.set()
 
     def _fail_engine(self) -> None:
         """Terminal engine failure (engine thread): every in-flight and
@@ -275,6 +492,13 @@ class ServingServer:
                 payload, pending = self._inbox.get_nowait()
             except queue_mod.Empty:
                 return
+            if payload.get("ctl") == "leak_check":
+                # page-accounting audit, answered ON the engine thread
+                # so it never races a step's allocator mutations (the
+                # chaos harness's per-replica invariant probe)
+                pending.outbox.put(self._leak_check())
+                pending.outbox.put(None)
+                continue
 
             def on_token(rid, tok, done, _p=pending):
                 if _p.stream:
@@ -287,6 +511,7 @@ class ServingServer:
                     max_new_tokens=payload["max_new_tokens"],
                     eos_token=payload.get("eos"),
                     priority=payload.get("priority", Priority.NORMAL),
+                    deadline_t=payload.get("deadline_t"),
                     on_token=on_token)
             except Exception as e:
                 # broad on purpose: this runs on the ENGINE thread, and
@@ -297,24 +522,68 @@ class ServingServer:
                                     "reason": f"{type(e).__name__}: {e}"})
                 pending.outbox.put(None)
                 continue
+            _dbg(f"inbox submit rid={rid} plen={len(payload['prompt'])}")
             self._pending[rid] = pending
 
     def _on_complete(self, req) -> None:
         """Engine callback: terminal state for a request (any state)."""
+        replay = self._replay.pop(req.req_id, None)
+        if replay is not None:
+            # telemetry must describe the request the CLIENT
+            # experienced — one generation from the original submit,
+            # every pre-crash token included — not the
+            # post-resurrection slice (which would undercount
+            # tokens_generated_total and report replay-relative
+            # latencies)
+            orig_prompt, pre, orig_stats = replay
+            st = req.stats
+            st.tokens_out = len(req.generated) + len(pre)
+            st.prompt_len = len(orig_prompt)
+            st.submit_t = orig_stats.submit_t
+            if orig_stats.admit_t:
+                st.admit_t = orig_stats.admit_t
+            if orig_stats.first_token_t:
+                st.first_token_t = orig_stats.first_token_t
+            if orig_stats.prefill_ms:
+                st.prefill_ms = orig_stats.prefill_ms
         self.metrics.observe_request(req)
         # the reply below is the server's result delivery — drop the
         # engine's retained copy or a long-lived server accumulates
         # every DecodeRequest (and its outbox closure) ever finished
         self.engine.result(req.req_id, pop=True)
         pending = self._pending.pop(req.req_id, None)
+        _dbg(f"on_complete rid={req.req_id} state={req.state} "
+             f"plen={len(req.prompt)} gen={len(req.generated)} "
+             f"pending={'yes' if pending is not None else 'LOST'}")
         if pending is None:
             return  # engine used without the server front-end
         if req.state == "done":
+            tokens = [int(t) for t in req.tokens]
+            generated = [int(t) for t in req.generated]
+            stats = _json_stats(req.stats)
+            if replay is not None:
+                # a resurrected engine served the tail of this request;
+                # the reply must read as ONE uninterrupted generation:
+                # original prompt, pre-crash tokens stitched back in
+                # front of the replayed continuation
+                orig_prompt, pre, _orig_stats = replay
+                generated = list(pre) + generated
+                tokens = list(orig_prompt) + generated
+                stats["tokens_out"] = len(generated)
+                stats["replayed"] = True
             msg: Dict[str, Any] = {
                 "rid": req.req_id, "done": True,
-                "tokens": [int(t) for t in req.tokens],
-                "generated": [int(t) for t in req.generated],
-                "stats": _json_stats(req.stats)}
+                "tokens": tokens, "generated": generated,
+                "stats": stats}
+        elif req.state == "deadline":
+            msg = {"rid": req.req_id, "error": "DeadlineExceeded",
+                   "reason": "deadline_ms elapsed before completion",
+                   "tokens_out": int(req.stats.tokens_out)}
+        elif req.state == "stalled":
+            msg = {"rid": req.req_id, "error": "RequestStalled",
+                   "reason": f"no token for "
+                             f"{self.engine.stall_timeout_s}s; evicted",
+                   "tokens_out": int(req.stats.tokens_out)}
         elif req.state == "shed":
             cfg = getattr(self.scheduler, "cfg", None)
             msg = {"rid": req.req_id, "error": "ServerOverloaded",
@@ -359,8 +628,29 @@ class ServingServer:
             wfile.write(json.dumps(obj) + "\n")
             wfile.flush()
 
+        from ..distributed.fault_inject import InjectedFault, fault_point
+
         try:
             for line in rfile:
+                try:
+                    # chaos site: a torn receive. The connection dies
+                    # exactly like a real half-open TCP teardown — the
+                    # failover router (serving/supervisor.py) resubmits
+                    # keyed requests to a live replica; unkeyed clients
+                    # see a clean close, never a hang.
+                    fault_point("net.recv")
+                except InjectedFault:
+                    self.metrics.counter("net_recv_drops_total").add()
+                    # the peer must see the teardown NOW: shutdown()
+                    # sends the FIN even while rfile/wfile still hold
+                    # references to the socket (close() alone defers
+                    # to their refcounts — a GC-timing hang, not a
+                    # torn connection)
+                    try:
+                        conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    break
                 line = line.strip()
                 if not line:
                     continue
@@ -411,6 +701,14 @@ class ServingServer:
             self.drain()
             send({"ok": True, "status": "draining"})
             return
+        if op == "leak_check":
+            # answered on the ENGINE thread via the inbox so the audit
+            # can't race a step; same outbox plumbing as generate
+            pending = _Pending(stream=False)
+            self._inbox.put(({"ctl": "leak_check"}, pending))
+            self._wake.set()
+            self._await_outbox(pending, send)
+            return
         if op != "generate":
             send({"error": "BadRequest", "reason": f"unknown op {op!r}"})
             return
@@ -443,6 +741,20 @@ class ServingServer:
                   "reason": f"priority must be one of "
                             f"{sorted(_PRIORITIES)}"})
             return
+        deadline_t = None
+        if msg.get("deadline_ms") is not None:
+            dl = msg["deadline_ms"]
+            # bool is an int subclass: "deadline_ms": true must be a
+            # BadRequest, not a surprise 1 ms budget
+            if isinstance(dl, bool) or \
+                    not isinstance(dl, (int, float)) or dl <= 0:
+                send({"error": "BadRequest",
+                      "reason": "deadline_ms must be a positive "
+                                "number of milliseconds"})
+                return
+            # the budget starts at ARRIVAL: queueing, prefill, decode
+            # and any engine resurrection all spend from it
+            deadline_t = time.monotonic() + float(dl) / 1e3
         pending = _Pending(stream=bool(msg.get("stream", False)))
         with self._admission_lock:
             # submit-time overload gate, atomic with the enqueue so
@@ -453,20 +765,24 @@ class ServingServer:
                 check(self.engine.num_queued + self._inbox.qsize())
             self._inbox.put(({"prompt": prompt, "max_new_tokens": mnt,
                               "eos": msg.get("eos"),
-                              "priority": int(_PRIORITIES[prio])},
+                              "priority": int(_PRIORITIES[prio]),
+                              "deadline_t": deadline_t},
                              pending))
         self._wake.set()
+        self._await_outbox(pending, send)
+
+    def _await_outbox(self, pending: _Pending, send) -> None:
+        """Relay one request's outbox to the client until the None
+        sentinel. Closes the submit-vs-shutdown race: if the engine
+        thread has fully EXITED (mere stop() intent is not enough —
+        graceful shutdown still finishes in-flight work and delivers
+        real results), the request can never complete, so answer a
+        typed ServerEvicted instead of hanging."""
         while True:
             try:
                 out = pending.outbox.get(timeout=1.0)
             except queue_mod.Empty:
                 if self._engine_done.is_set():
-                    # closes the submit-vs-shutdown race: the engine
-                    # thread has fully EXITED (mere stop() intent is
-                    # not enough — graceful shutdown still finishes
-                    # in-flight work and delivers real results), so
-                    # this request can never complete; answer instead
-                    # of hanging
                     send({"error": "ServerEvicted",
                           "reason": "server shutting down"})
                     return
@@ -478,13 +794,75 @@ class ServingServer:
     # -- introspection -----------------------------------------------------
 
     def _health(self) -> Dict:
+        eng = self.engine
+        pc = self.prefix_cache
+
+        def racy(fn, fallback=-1):
+            # conn-thread reads of dicts the engine thread mutates
+            # (allocator reservations, prefix-cache books) can hit
+            # "dictionary changed size during iteration" under load. A
+            # health probe must degrade to a stale/-1 number — a typed
+            # RuntimeError reply here reads as a failed probe to the
+            # supervisor, which would kill a healthy replica after
+            # max_probe_failures of them.
+            for _ in range(3):
+                try:
+                    return fn()
+                except RuntimeError:
+                    continue
+            return fallback
+
         return {"status": "draining" if self._draining else "ok",
-                "active": self.engine.num_active,
-                "queued": self.engine.num_queued,
-                "free_pages": self.engine.free_pages,
-                "num_pages": self.engine.num_pages,
-                "steps": self.engine.steps,
+                "active": eng.num_active,
+                "queued": eng.num_queued,
+                "free_pages": eng.free_pages,
+                "reserved_pages": racy(
+                    lambda: eng.allocator.reserved_total),
+                "cached_pages": racy(
+                    lambda: pc.total_pages()) if pc is not None else 0,
+                "num_pages": eng.num_pages,
+                "steps": eng.steps,
+                "engine_restarts": self._restarts,
+                "step_ema_ms": (None if eng.step_ema_s is None
+                                else round(eng.step_ema_s * 1e3, 3)),
                 "uptime_s": round(time.monotonic() - self._t0, 3)}
+
+    def _gauges(self) -> Dict[str, float]:
+        """Engine-occupancy gauge source for the Prometheus page
+        (serving/metrics.py): live reads of host-side ints — benign
+        against the engine thread, same as the health op."""
+        eng = self.engine
+        pc = self.prefix_cache
+        return {"inflight_slots": eng.num_active,
+                "queued_requests": eng.num_queued,
+                "free_pages": eng.free_pages,
+                "reserved_pages": eng.allocator.reserved_total,
+                "prefix_cache_pages":
+                    pc.total_pages() if pc is not None else 0,
+                "num_pages": eng.num_pages}
+
+    def _leak_check(self) -> Dict:
+        """Engine-thread page audit: with no in-flight work, the
+        allocator must balance (cache-less: everything free; cached:
+        free + cache-owned == pool, no other owners)."""
+        eng = self.engine
+        if eng.num_active or eng.num_queued:
+            return {"ok": False, "busy": True,
+                    "active": eng.num_active, "queued": eng.num_queued}
+        try:
+            if self.prefix_cache is not None:
+                self.prefix_cache.check_consistent(eng.allocator)
+            else:
+                eng.allocator.check_no_leak()
+        except Exception as e:
+            return {"ok": False, "busy": False,
+                    "error": type(e).__name__, "reason": str(e)}
+        return {"ok": True, "busy": False,
+                "free_pages": eng.free_pages,
+                "reserved_pages": eng.allocator.reserved_total,
+                "cached_pages": (self.prefix_cache.total_pages()
+                                 if self.prefix_cache is not None else 0),
+                "num_pages": eng.num_pages}
 
     def _cache_stats(self) -> Optional[Dict]:
         pc = self.prefix_cache
@@ -546,7 +924,21 @@ def main(argv=None) -> None:
     parser.add_argument("--port", type=int, default=8765)
     parser.add_argument("--num-slots", type=int, default=4)
     parser.add_argument("--page-size", type=int, default=64)
+    parser.add_argument("--num-pages", type=int, default=None)
+    parser.add_argument("--max-seq-len", type=int, default=None)
     parser.add_argument("--no-prefix-cache", action="store_true")
+    parser.add_argument(
+        "--max-engine-errors", type=int, default=32,
+        help="consecutive engine-step failures before the engine is "
+             "resurrected (torn down, rebuilt, in-flight replayed)")
+    parser.add_argument(
+        "--max-engine-restarts", type=int, default=2,
+        help="engine resurrections before the server gives up and "
+             "fails everything with a typed EngineFailed")
+    parser.add_argument(
+        "--stall-timeout-s", type=float, default=None,
+        help="evict a slot that emits no token for this long with a "
+             "typed RequestStalled reply (default: watchdog off)")
     parser.add_argument(
         "--speculate", type=int, default=0, metavar="K",
         help="draft K tokens per decode step and verify them in one "
@@ -569,11 +961,19 @@ def main(argv=None) -> None:
             draft = _build_model(draft)
         speculative = SpeculativeConfig(k=args.speculate, draft=draft,
                                         draft_window=args.draft_window)
+    engine_kwargs = {}
+    if args.num_pages is not None:
+        engine_kwargs["num_pages"] = args.num_pages
+    if args.max_seq_len is not None:
+        engine_kwargs["max_seq_len"] = args.max_seq_len
     server = ServingServer(model, host=args.host, port=args.port,
                            prefix_cache=not args.no_prefix_cache,
                            num_slots=args.num_slots,
                            page_size=args.page_size,
-                           speculative=speculative)
+                           max_engine_errors=args.max_engine_errors,
+                           max_engine_restarts=args.max_engine_restarts,
+                           stall_timeout_s=args.stall_timeout_s,
+                           speculative=speculative, **engine_kwargs)
     port = server.start()
     print(f"[paddle_tpu.serving] listening on {args.host}:{port} "
           f"(model {args.model}); newline-JSON, see module docstring",

@@ -1,0 +1,673 @@
+"""Replica supervision and failover routing for the serving layer.
+
+One `ServingServer` process is one fault domain: engine resurrection
+(server.py) survives anything below the socket, but a SIGKILL, an OOM
+or a wedged interpreter takes the whole replica with it. This module
+is the layer above: a `Supervisor` that spawns N server PROCESSES,
+health-probes them over the wire, restarts crashed replicas with
+exponential backoff, and a `FailoverRouter` that fronts them on one
+port — a request whose replica dies mid-flight is resubmitted to a
+live replica when it is idempotent (carries a ``key``), so the client
+sees a pause instead of a torn connection.
+
+Idempotency contract: greedy decoding is deterministic (the serving
+suite pins bit-identical outputs across prefix caching, speculation
+and engine resurrection), so resubmitting a keyed request re-derives
+exactly the tokens the dead replica would have produced. The router
+counts the token messages it already relayed and suppresses that many
+from the resubmitted stream — the client's stream continues seamlessly.
+Unkeyed requests get a typed retryable ``ReplicaFailed`` instead (the
+router must not guess at idempotency).
+
+Fault sites (distributed/fault_inject.py): ``net.recv`` fires in the
+router's backend reader — an armed schedule makes the router treat the
+backend as dead and exercise the failover path; the same site inside a
+replica's server tears the backend connection for real.
+
+Run it::
+
+    python -m paddle_tpu.serving.supervisor --replicas 2 \
+        --model gpt_125m --port 8770
+
+Reference analog: the fleet elastic controller (ELASTIC_EXIT_CODE
+restart contract, PR 1) applied to the serving tier — supervision as
+an external process loop, recovery as resubmission over a
+deterministic engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["Replica", "Supervisor", "FailoverRouter"]
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _rpc(host: str, port: int, payload: Dict, timeout_s: float) -> Dict:
+    """One request/one reply over a fresh connection (health probes,
+    admin ops). Raises OSError family on a dead backend."""
+    with socket.create_connection((host, port),
+                                  timeout=timeout_s) as s:
+        f = s.makefile("rw", encoding="utf-8")
+        f.write(json.dumps(payload) + "\n")
+        f.flush()
+        line = f.readline()
+        if not line:
+            raise ConnectionError("backend closed without replying")
+        return json.loads(line)
+
+
+class Replica:
+    """One supervised server process."""
+
+    def __init__(self, idx: int, host: str):
+        self.idx = idx
+        self.host = host
+        self.port: Optional[int] = None
+        self.proc: Optional[subprocess.Popen] = None
+        self.ready = False
+        self.restarts = 0           # respawns after a death
+        self.consec_deaths = 0      # resets on a healthy probe
+        self.probe_failures = 0
+        self.next_spawn_t: Optional[float] = None  # backoff gate
+        self.spawn_t: Optional[float] = None       # warmup clock
+        self.log_path: Optional[str] = None
+        self._log_file = None
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def close_log(self) -> None:
+        if self._log_file is not None:
+            try:
+                self._log_file.close()
+            except OSError:
+                pass
+            self._log_file = None
+
+
+class Supervisor:
+    """Spawn, probe, and resurrect N serving replicas.
+
+    ``server_args`` are appended to every replica's command line
+    (e.g. ``["--page-size", "8", "--stall-timeout-s", "30"]``);
+    ``replica_env`` overlays the inherited environment — chaos runs
+    arm PT_FAULT_INJECT there, CPU test runs pin JAX_PLATFORMS=cpu.
+    A dead replica respawns after ``backoff_base_s * 2**consec_deaths``
+    (capped at ``backoff_max_s``) on a FRESH port; a ready replica that
+    fails ``max_probe_failures`` consecutive health probes is killed
+    and treated as dead (half-alive processes hold no traffic)."""
+
+    def __init__(self, model: str = "gpt_125m", replicas: int = 2,
+                 host: str = "127.0.0.1",
+                 server_args: Sequence[str] = (),
+                 replica_env: Optional[Dict[str, str]] = None,
+                 probe_interval_s: float = 0.5,
+                 probe_timeout_s: float = 5.0,
+                 max_probe_failures: int = 3,
+                 backoff_base_s: float = 0.5,
+                 backoff_max_s: float = 10.0,
+                 ready_timeout_s: float = 300.0,
+                 log_dir: Optional[str] = None):
+        self.model = model
+        self.host = host
+        self.server_args = list(server_args)
+        self.replica_env = dict(replica_env or {})
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.max_probe_failures = int(max_probe_failures)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.ready_timeout_s = float(ready_timeout_s)
+        if log_dir is None:
+            self.log_dir = tempfile.mkdtemp(
+                prefix="pt-serving-supervisor-")
+        else:
+            self.log_dir = log_dir
+            os.makedirs(log_dir, exist_ok=True)
+        self.replicas: List[Replica] = [Replica(i, host)
+                                        for i in range(int(replicas))]
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, wait_ready: bool = True) -> None:
+        for rep in self.replicas:
+            self._spawn(rep)
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="pt-supervisor-monitor")
+        self._monitor.start()
+        if wait_ready:
+            self.wait_ready()
+
+    def wait_ready(self, min_ready: Optional[int] = None) -> None:
+        """Block until ``min_ready`` replicas (default: all) answer a
+        health probe; raises with the laggards' log paths on timeout
+        (the logs hold the subprocess traceback)."""
+        want = len(self.replicas) if min_ready is None else min_ready
+        deadline = time.monotonic() + self.ready_timeout_s
+        while time.monotonic() < deadline:
+            if sum(r.ready for r in self.replicas) >= want:
+                return
+            if self._stop.is_set():
+                raise RuntimeError("supervisor stopped while waiting")
+            time.sleep(0.1)
+        lag = [(r.idx, r.log_path) for r in self.replicas
+               if not r.ready]
+        raise RuntimeError(
+            f"replicas not ready after {self.ready_timeout_s}s: {lag}")
+
+    def stop(self, drain: bool = True, grace_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=grace_s)
+        for rep in self.replicas:
+            if rep.alive() and drain:
+                try:
+                    _rpc(self.host, rep.port, {"op": "drain"},
+                         timeout_s=2.0)
+                except Exception:
+                    pass
+        for rep in self.replicas:
+            if rep.alive():
+                rep.proc.terminate()
+        deadline = time.monotonic() + grace_s
+        for rep in self.replicas:
+            if rep.proc is None:
+                continue
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                rep.proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                rep.proc.kill()
+                rep.proc.wait(timeout=5.0)
+            rep.close_log()
+
+    def __enter__(self) -> "Supervisor":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- chaos hooks -------------------------------------------------------
+
+    def kill_replica(self, idx: int,
+                     sig: int = signal.SIGKILL) -> None:
+        """Chaos entry: deliver ``sig`` to one replica process (the
+        monitor notices the death and respawns it with backoff)."""
+        rep = self.replicas[idx]
+        if rep.alive():
+            rep.proc.send_signal(sig)
+
+    @property
+    def restarts_total(self) -> int:
+        return sum(r.restarts for r in self.replicas)
+
+    def live(self) -> List[Replica]:
+        return [r for r in self.replicas if r.ready and r.alive()]
+
+    # -- internals ---------------------------------------------------------
+
+    def _spawn(self, rep: Replica) -> None:
+        rep.port = _free_port(self.host)
+        rep.ready = False
+        rep.probe_failures = 0
+        rep.next_spawn_t = None
+        rep.spawn_t = time.monotonic()
+        rep.close_log()
+        rep.log_path = os.path.join(self.log_dir,
+                                    f"replica{rep.idx}.log")
+        rep._log_file = open(rep.log_path, "ab")
+        cmd = [sys.executable, "-m", "paddle_tpu.serving.server",
+               "--model", self.model, "--host", self.host,
+               "--port", str(rep.port)] + self.server_args
+        env = dict(os.environ)
+        env.update(self.replica_env)
+        rep.proc = subprocess.Popen(cmd, stdout=rep._log_file,
+                                    stderr=subprocess.STDOUT, env=env)
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            for rep in self.replicas:
+                if self._stop.is_set():
+                    return
+                if rep.proc is None or rep.next_spawn_t is not None:
+                    # awaiting backoffed respawn
+                    if rep.next_spawn_t is not None and \
+                            time.monotonic() >= rep.next_spawn_t:
+                        rep.restarts += 1
+                        self._spawn(rep)
+                    continue
+                if not rep.alive():
+                    self._mark_dead(rep)
+                    continue
+                try:
+                    h = _rpc(self.host, rep.port, {"op": "health"},
+                             timeout_s=self.probe_timeout_s)
+                    ok = "status" in h
+                except Exception:
+                    ok = False
+                if ok:
+                    rep.ready = True
+                    rep.probe_failures = 0
+                    rep.consec_deaths = 0
+                else:
+                    rep.probe_failures += 1
+                    stuck_warmup = (
+                        not rep.ready and rep.spawn_t is not None
+                        and time.monotonic() - rep.spawn_t
+                        > self.ready_timeout_s)
+                    if (rep.ready and
+                            rep.probe_failures
+                            >= self.max_probe_failures) or stuck_warmup:
+                        # half-alive (was ready, socket went
+                        # unresponsive) OR wedged during startup (alive
+                        # but never answered a probe within
+                        # ready_timeout_s — e.g. a hung compile). Both
+                        # are permanent capacity loss unless the
+                        # supervisor reclaims them: kill and let the
+                        # respawn path own recovery
+                        try:
+                            rep.proc.kill()
+                        except OSError:
+                            pass
+                        self._mark_dead(rep)
+            self._stop.wait(timeout=self.probe_interval_s)
+
+    def _mark_dead(self, rep: Replica) -> None:
+        rep.ready = False
+        rep.consec_deaths += 1
+        backoff = min(self.backoff_max_s,
+                      self.backoff_base_s
+                      * 2 ** (rep.consec_deaths - 1))
+        rep.next_spawn_t = time.monotonic() + backoff
+        rep.close_log()
+
+
+class _BackendLost(ConnectionError):
+    """Router-internal: the backend replica died mid-request."""
+
+
+class _ClientLost(ConnectionError):
+    """Router-internal: the ROUTER'S OWN client socket died mid-relay.
+    Must never be confused with `_BackendLost`: failing over would burn
+    healthy replicas generating into a dead socket and corrupt the
+    replica-failure metrics."""
+
+
+class FailoverRouter:
+    """One client-facing port over N supervised replicas.
+
+    Per-request routing: round-robin over ready replicas. A backend
+    that dies mid-request (connection error, or an armed ``net.recv``
+    schedule) costs an unkeyed request a typed retryable
+    ``ReplicaFailed``; a KEYED request is resubmitted to another live
+    replica, with already-relayed streamed tokens suppressed from the
+    resubmission (greedy determinism makes the resubmitted stream a
+    superset-in-order of what was already sent). ``health`` is
+    answered by the router itself with per-replica state; other admin
+    ops go to the first live replica."""
+
+    def __init__(self, supervisor: Supervisor, host: str = "127.0.0.1",
+                 port: int = 0, max_failover: int = 3,
+                 backend_timeout_s: float = 300.0,
+                 no_replica_wait_s: float = 60.0):
+        self.sup = supervisor
+        self.host = host
+        self._requested_port = port
+        self.max_failover = int(max_failover)
+        self.backend_timeout_s = float(backend_timeout_s)
+        self.no_replica_wait_s = float(no_replica_wait_s)
+        self.port: Optional[int] = None
+        self.failovers_total = 0
+        self.replica_failures_total = 0
+        # optional routing-event hook: trace({"t": ..., "ev": ...,
+        # ...}) — the chaos harness uses it for postmortems
+        self.trace = None
+        self._rr = 0
+        self._stopping = False
+        self._sock: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+
+    def start(self) -> int:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self._requested_port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="pt-router-accept")
+        t.start()
+        self._threads.append(t)
+        return self.port
+
+    def stop(self) -> None:
+        self._stopping = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        for t in list(self._threads):
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+
+    def __enter__(self) -> "FailoverRouter":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- internals ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                self._sock.settimeout(0.2)
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True, name="pt-router-conn")
+            with self._lock:
+                self._threads = [x for x in self._threads
+                                 if x.is_alive()]
+                self._threads.append(t)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("r", encoding="utf-8")
+        wfile = conn.makefile("w", encoding="utf-8")
+
+        def send(obj: Dict) -> None:
+            wfile.write(json.dumps(obj) + "\n")
+            wfile.flush()
+
+        try:
+            for line in rfile:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError as e:
+                    send({"error": "BadRequest", "reason": str(e)})
+                    continue
+                try:
+                    self._handle(msg, send)
+                except Exception as e:  # typed reply, never a hang
+                    send({"error": type(e).__name__, "reason": str(e)})
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, msg: Dict, send) -> None:
+        op = msg.get("op", "generate")
+        if op == "health":
+            send({"status": "ok" if self.sup.live() else "degraded",
+                  "live": len(self.sup.live()),
+                  "failovers_total": self.failovers_total,
+                  "replicas": [{"idx": r.idx, "port": r.port,
+                                "ready": r.ready, "alive": r.alive(),
+                                "restarts": r.restarts}
+                               for r in self.sup.replicas]})
+            return
+        if op != "generate":
+            # admin op: first live replica answers (replica-targeted
+            # audits talk to replica ports directly)
+            rep = self._pick(set())
+            if rep is None:
+                send({"error": "NoReplicaAvailable", "retryable": True})
+                return
+            try:
+                send(_rpc(self.sup.host, rep.port, msg,
+                          timeout_s=self.backend_timeout_s))
+            except Exception as e:
+                send({"error": "ReplicaFailed", "retryable": True,
+                      "reason": f"{type(e).__name__}: {e}"})
+            return
+        self._route_generate(msg, send)
+
+    def _pick(self, exclude: set) -> Optional[Replica]:
+        live = [r for r in self.sup.live() if r.idx not in exclude]
+        if not live:
+            return None
+        with self._lock:
+            self._rr += 1
+            return live[self._rr % len(live)]
+
+    def _route_generate(self, msg: Dict, send) -> None:
+        keyed = msg.get("key") is not None
+        # token messages already sent to the client — MUTABLE so a
+        # _BackendLost raised mid-stream still preserves the relay
+        # progress the next attempt must suppress
+        progress = {"relayed": 0}
+        attempts = 0
+        tried: set = set()
+        arrival = time.monotonic()
+        wait_deadline = arrival + self.no_replica_wait_s
+        # deadline_ms is a budget FROM ARRIVAL covering the whole
+        # request: each forward (first try included — time can pass
+        # waiting for a live replica) carries only the REMAINING
+        # budget, or a failed-over request would restart its clock on
+        # every replica and overshoot the contract by up to
+        # max_failover * deadline_ms
+        budget_ms = msg.get("deadline_ms")
+        if isinstance(budget_ms, bool) or \
+                not isinstance(budget_ms, (int, float)):
+            budget_ms = None  # malformed: backend answers BadRequest
+        def trace(ev: str, **kw) -> None:
+            if self.trace is not None:
+                kw.update(ev=ev, key=msg.get("key"),
+                          t=round(time.monotonic(), 3))
+                try:
+                    self.trace(kw)
+                except Exception:
+                    pass
+
+        while True:
+            rep = self._pick(tried)
+            trace("pick", rep=None if rep is None else rep.idx,
+                  attempts=attempts)
+            if rep is None:
+                # every replica tried/dead: wait for the supervisor to
+                # resurrect one (fresh respawns are fair game again)
+                if time.monotonic() >= wait_deadline:
+                    self.replica_failures_total += 1
+                    send({"error": "NoReplicaAvailable",
+                          "retryable": True,
+                          "reason": "no live replica within "
+                                    f"{self.no_replica_wait_s}s"})
+                    return
+                tried.clear()
+                time.sleep(0.2)
+                continue
+            fwd = msg
+            if budget_ms is not None and budget_ms > 0:
+                remaining = budget_ms \
+                    - (time.monotonic() - arrival) * 1e3
+                if remaining <= 0:
+                    send({"error": "DeadlineExceeded",
+                          "reason": "deadline_ms elapsed before "
+                                    "completion",
+                          "tokens_out": progress["relayed"]})
+                    return
+                fwd = dict(msg)
+                fwd["deadline_ms"] = remaining
+            try:
+                self._forward(rep, fwd, send, progress)
+                trace("done", rep=rep.idx,
+                      relayed=progress["relayed"])
+                return
+            except _ClientLost as e:
+                # OUR client hung up mid-relay; the replica is fine.
+                # Abort quietly — no failover, no replica-failure
+                # metrics, nothing left to deliver the reply to.
+                trace("client_lost", rep=rep.idx, err=str(e))
+                return
+            except _BackendLost as e:
+                trace("backend_lost", rep=rep.idx, err=str(e))
+                attempts += 1
+                tried.add(rep.idx)
+                if not keyed:
+                    self.replica_failures_total += 1
+                    send({"error": "ReplicaFailed", "retryable": True,
+                          "reason": f"replica {rep.idx} lost "
+                                    f"mid-request ({e}); resubmit "
+                                    f"with a 'key' for transparent "
+                                    f"failover"})
+                    return
+                if attempts > self.max_failover:
+                    self.replica_failures_total += 1
+                    send({"error": "ReplicaFailed", "retryable": True,
+                          "reason": f"{attempts} replicas lost "
+                                    f"mid-request"})
+                    return
+                self.failovers_total += 1
+
+    def _forward(self, rep: Replica, msg: Dict, send,
+                 progress: Dict[str, int]) -> None:
+        """Proxy one request to ``rep``; stream token messages through,
+        suppressing the first ``progress["relayed"]`` (already
+        delivered by a prior attempt — bit-identical by greedy
+        determinism), advancing the count IN PLACE so progress
+        survives a mid-stream `_BackendLost`. Raises `_BackendLost` if
+        the backend dies before the final reply, `_ClientLost` if the
+        router's own client can no longer be written to."""
+        from ..distributed.fault_inject import (InjectedFault,
+                                                fault_point)
+
+        def to_client(reply: Dict) -> None:
+            # client-side send failures get their own exception class
+            # so the backend-loss handler below can't mistake a dead
+            # CLIENT for a dead REPLICA and fail over for nothing
+            try:
+                send(reply)
+            except Exception as e:
+                raise _ClientLost(f"{type(e).__name__}: {e}")
+
+        seen = 0
+        try:
+            with socket.create_connection(
+                    (self.sup.host, rep.port),
+                    timeout=self.backend_timeout_s) as s:
+                f = s.makefile("rw", encoding="utf-8")
+                f.write(json.dumps(msg) + "\n")
+                f.flush()
+                while True:
+                    fault_point("net.recv")
+                    line = f.readline()
+                    if not line:
+                        raise _BackendLost(
+                            f"replica {rep.idx} closed mid-request")
+                    try:
+                        reply = json.loads(line)
+                    except json.JSONDecodeError:
+                        raise _BackendLost(
+                            f"replica {rep.idx} sent torn JSON")
+                    if "token" in reply:
+                        seen += 1
+                        if seen > progress["relayed"]:
+                            to_client(reply)
+                            progress["relayed"] = seen
+                        continue
+                    # final reply (result or typed error)
+                    to_client(reply)
+                    return
+        except InjectedFault as e:
+            raise _BackendLost(f"injected net.recv ({e})")
+        except (OSError, ValueError) as e:
+            if isinstance(e, (_BackendLost, _ClientLost)):
+                raise
+            raise _BackendLost(f"{type(e).__name__}: {e}")
+
+
+def main(argv=None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="paddle_tpu serving supervisor: N replica server "
+                    "processes + health-probed restarts + failover "
+                    "router on one port")
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--model", default="gpt_125m")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8770,
+                        help="router (client-facing) port")
+    parser.add_argument("--probe-interval-s", type=float, default=0.5)
+    parser.add_argument("--backoff-base-s", type=float, default=0.5)
+    parser.add_argument("--log-dir", default=None)
+    parser.add_argument(
+        "server_args", nargs="*",
+        help="extra args passed to every replica's "
+             "`python -m paddle_tpu.serving.server` (e.g. "
+             "--page-size 64 --stall-timeout-s 30)")
+    args = parser.parse_args(argv)
+
+    def _sigterm(signum, frame):
+        # `kill`, docker stop, systemd stop all speak SIGTERM; the
+        # default handler would take the supervisor down WITHOUT the
+        # cleanup below and orphan the whole replica tree. Route it
+        # through the same path as ^C.
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
+    sup = Supervisor(model=args.model, replicas=args.replicas,
+                     host=args.host, server_args=args.server_args,
+                     probe_interval_s=args.probe_interval_s,
+                     backoff_base_s=args.backoff_base_s,
+                     log_dir=args.log_dir)
+    print(f"[paddle_tpu.supervisor] spawning {args.replicas} replicas "
+          f"of {args.model} (logs: {sup.log_dir}) ...", flush=True)
+    router = None
+    try:
+        sup.start(wait_ready=True)
+        router = FailoverRouter(sup, host=args.host, port=args.port)
+        port = router.start()
+        print(f"[paddle_tpu.supervisor] router on {args.host}:{port}; "
+              f"replicas "
+              f"{[(r.idx, r.port) for r in sup.replicas]}", flush=True)
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("[paddle_tpu.supervisor] stopping ...", flush=True)
+    finally:
+        # every exit path — ^C, SIGTERM, a bound --port (OSError from
+        # router.start), a replica that never came ready — must tear
+        # down whatever was spawned; N orphaned replica processes are
+        # never an acceptable residue
+        if router is not None:
+            router.stop()
+        sup.stop()
+
+
+if __name__ == "__main__":
+    main()

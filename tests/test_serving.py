@@ -466,8 +466,12 @@ class TestServer:
     def test_persistent_engine_failure_escalates_typed(self, model):
         """A decode step that fails every time must not wedge clients:
         past max_engine_errors the server fails everything with a
-        typed reply and stops admitting."""
-        srv = self._serve(model, max_engine_errors=2)
+        typed reply and stops admitting. max_engine_restarts=0 turns
+        resurrection OFF so this pins the terminal escalation path
+        (the resurrection path is pinned in
+        tests/test_crash_safe_serving.py)."""
+        srv = self._serve(model, max_engine_errors=2,
+                          max_engine_restarts=0)
         port = srv.start()
 
         def boom():

@@ -1,0 +1,349 @@
+"""Seeded chaos harness for the crash-safe serving stack (r9).
+
+Drives a real workload through the full serving topology — failover
+router → supervised replica processes → SLO scheduler → paged decode
+engine — while a DETERMINISTIC fault schedule (distributed/
+fault_inject.py, seeded) fires at every layer below the client:
+
+- ``engine.step`` bursts inside each replica push the server past
+  ``max_engine_errors`` and force an engine RESURRECTION with
+  in-flight replay (serving/server.py);
+- ``alloc.page`` makes page allocation transiently fail (admission
+  unwinds and requeues);
+- ``net.recv`` tears connections both inside the replicas (server
+  reader) and inside the router's backend reader (failover path);
+- one replica is SIGKILLed mid-run; the supervisor restarts it with
+  backoff while the router resubmits its keyed in-flight requests to
+  the survivor.
+
+The three invariants asserted (the r9 acceptance contract):
+
+1. **Termination** — every request ends in a full result or a TYPED
+   error reply; a hang (no reply within the timeout) fails the run.
+2. **Zero leaks** — after drain, every replica's ``leak_check`` op
+   (engine-thread page-accounting audit) comes back clean.
+3. **Bit-identical recovery** — every SUCCESSFUL greedy completion,
+   including those that rode an engine resurrection or a router
+   failover, equals the fault-free reference output computed in-proc
+   before any fault is armed.
+
+Usage (CPU fast lane)::
+
+    python tools/chaos_serving.py --replicas 2 --requests 12 --seed 0
+
+Exit code 0 = all invariants held; the JSON report lands on stdout.
+Tests load this file as a module and call ``run_chaos`` directly
+(tests/test_crash_safe_serving.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# default replica fault schedule: an engine.step burst long enough to
+# breach --max-engine-errors 3 (forcing one resurrection per replica
+# process), scattered transient allocation failures, and a couple of
+# torn server-side receives. Deterministic per PT_FAULT_SEED.
+DEFAULT_REPLICA_FAULTS = ("engine.step:at=4|5|6,max=3;"
+                          "alloc.page:p=0.05,max=3;"
+                          "net.recv:p=0.02,max=2")
+
+
+@dataclasses.dataclass
+class ChaosReport:
+    """Outcome of one seeded chaos run."""
+
+    requests: int = 0
+    completed: int = 0            # full results
+    typed_errors: int = 0         # DeadlineExceeded / ReplicaFailed / ...
+    hangs: int = 0                # no reply within timeout (INVARIANT 1)
+    mismatches: int = 0           # greedy output != reference (INV. 3)
+    leak_failures: int = 0        # replica leak_check not ok (INV. 2)
+    error_kinds: Dict[str, int] = dataclasses.field(default_factory=dict)
+    details: List[Dict] = dataclasses.field(default_factory=list)
+    engine_restarts: int = 0      # scraped from surviving replicas
+    replayed_requests: int = 0
+    supervisor_restarts: int = 0  # replica process respawns
+    router_failovers: int = 0
+    replicas_checked: int = 0
+    wall_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return (self.hangs == 0 and self.mismatches == 0
+                and self.leak_failures == 0
+                and self.completed + self.typed_errors == self.requests)
+
+    def to_dict(self) -> Dict:
+        out = dataclasses.asdict(self)
+        out["ok"] = self.ok
+        return out
+
+
+def _reference_outputs(model_name: str, prompts, max_new,
+                       page_size: int, max_seq_len: int):
+    """Fault-free greedy outputs, computed in-process BEFORE any fault
+    is armed — the bit-identity oracle for every replayed/failed-over
+    request (batching never changes greedy outputs; the serving suite
+    pins that)."""
+    from paddle_tpu.inference import create_decode_engine
+    from paddle_tpu.serving.server import _build_model
+
+    model = _build_model(model_name)
+    eng = create_decode_engine(model, num_slots=2, page_size=page_size,
+                               max_seq_len=max_seq_len)
+    rids = [eng.submit(p, mnt) for p, mnt in zip(prompts, max_new)]
+    results = eng.run()
+    eng.close()
+    return [[int(t) for t in results[r][len(p):]]
+            for r, p in zip(rids, prompts)]
+
+
+def _scrape_counters(host: str, port: int) -> Dict[str, float]:
+    from paddle_tpu.serving.supervisor import _rpc
+    try:
+        snap = _rpc(host, port, {"op": "stats"}, timeout_s=10.0)
+        return dict(snap["stats"]["counters"])
+    except Exception:
+        return {}
+
+
+def run_chaos(replicas: int = 2, requests: int = 12, seed: int = 0,
+              model: str = "gpt_tiny", page_size: int = 8,
+              max_seq_len: int = 96, num_slots: int = 2,
+              max_new_tokens: int = 6,
+              replica_faults: Optional[str] = DEFAULT_REPLICA_FAULTS,
+              router_fault_p: float = 0.08,
+              router_fault_max: int = 3,
+              kill_replica: bool = True,
+              deadline_doomed: int = 2,
+              unkeyed: int = 2,
+              request_timeout_s: float = 300.0,
+              drain_timeout_s: float = 120.0,
+              platform: str = "cpu",
+              log_dir: Optional[str] = None) -> ChaosReport:
+    """One seeded chaos run; see module docstring for the invariants.
+
+    ``deadline_doomed`` requests carry a 1 ms deadline (guaranteed
+    typed DeadlineExceeded), ``unkeyed`` requests omit the idempotency
+    key (a mid-request replica loss costs them a typed ReplicaFailed
+    instead of transparent failover) — both are TYPED outcomes, so
+    invariant 1 still covers them."""
+    import numpy as np
+
+    from paddle_tpu.distributed import fault_inject as fi
+    from paddle_tpu.serving.server import client_request
+    from paddle_tpu.serving.supervisor import (FailoverRouter,
+                                               Supervisor, _rpc)
+
+    t_start = time.monotonic()
+    rng = np.random.default_rng(seed)
+    prompts = [np.asarray(rng.integers(1, 100,
+                                       size=int(rng.integers(4, 20))),
+                          np.int32)
+               for _ in range(requests)]
+    max_new = [max_new_tokens] * requests
+
+    # the oracle MUST precede any arming: it runs in this process
+    expected = _reference_outputs(model, prompts, max_new,
+                                  page_size, max_seq_len)
+
+    log_dir = log_dir or tempfile.mkdtemp(prefix="pt-chaos-")
+    compile_cache = os.path.join(log_dir, "compile_cache")
+    replica_env = {
+        # CPU fast lane: the chaos contract is about control flow, not
+        # the accelerator; replicas must not fight over a TPU
+        "JAX_PLATFORMS": platform,
+        "TPU_SKIP_MDS_QUERY": "true",
+        # warm resurrections/restarts: rebuilt engines re-read their
+        # prefill/decode programs instead of recompiling
+        "PADDLE_TPU_COMPILE_CACHE": compile_cache,
+        "PT_FAULT_SEED": str(seed),
+    }
+    if replica_faults:
+        replica_env["PT_FAULT_INJECT"] = replica_faults
+
+    server_args = ["--page-size", str(page_size),
+                   "--max-seq-len", str(max_seq_len),
+                   "--num-slots", str(num_slots),
+                   "--max-engine-errors", "3",
+                   "--stall-timeout-s", "120"]
+    sup = Supervisor(model=model, replicas=replicas,
+                     server_args=server_args, replica_env=replica_env,
+                     probe_interval_s=0.3, backoff_base_s=0.5,
+                     log_dir=log_dir)
+    report = ChaosReport(requests=requests)
+    outcomes: List[Optional[Dict]] = [None] * requests
+    route_trace: List[Dict] = []
+    try:
+        sup.start(wait_ready=True)
+        router = FailoverRouter(sup, max_failover=replicas + 2)
+        router.trace = route_trace.append
+        rport = router.start()
+        if router_fault_p > 0:
+            # router-side net.recv: armed in THIS process, after the
+            # oracle ran (fault_point is process-global)
+            fi.get_injector().arm("net.recv", probability=router_fault_p,
+                                  max_faults=router_fault_max,
+                                  seed=seed + 1)
+
+        first_result = threading.Event()
+
+        def client(i: int) -> None:
+            payload = {"op": "generate",
+                       "prompt": [int(t) for t in prompts[i]],
+                       "max_new_tokens": max_new[i],
+                       "stream": bool(i % 2)}
+            if i >= unkeyed:
+                payload["key"] = f"chaos-{seed}-{i}"
+            if i < deadline_doomed:
+                payload["deadline_ms"] = 1
+            else:
+                # enforced WELL before the client transport timeout:
+                # whatever goes wrong below the socket, the reply is a
+                # typed DeadlineExceeded, never a client-side timeout
+                payload["deadline_ms"] = int(request_timeout_s * 500)
+            t0 = time.monotonic()
+            try:
+                outcomes[i] = client_request("127.0.0.1", rport, payload,
+                                             timeout_s=request_timeout_s)
+            except Exception as e:
+                outcomes[i] = {"_transport_error":
+                               f"{type(e).__name__}: {e}"}
+            outcomes[i]["_elapsed_s"] = round(time.monotonic() - t0, 2)
+            outcomes[i]["_i"] = i
+            first_result.set()
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(requests)]
+        for t in threads:
+            t.start()
+        if kill_replica:
+            # SIGKILL one replica mid-run, once traffic is flowing
+            first_result.wait(timeout=request_timeout_s)
+            time.sleep(0.5)
+            sup.kill_replica(0)
+        for t in threads:
+            t.join(timeout=request_timeout_s)
+
+        # -- invariant 1: termination, typed ------------------------------
+        for i, out in enumerate(outcomes):
+            if isinstance(out, dict):
+                report.details.append(
+                    {"i": i, "elapsed_s": out.get("_elapsed_s"),
+                     "kind": out.get("error")
+                     or out.get("_transport_error", "ok")})
+            if out is None or not isinstance(out, dict):
+                report.hangs += 1
+                continue
+            if "_transport_error" in out:
+                # the router owns typed delivery; a torn ROUTER client
+                # connection counts as a hang-class failure
+                report.hangs += 1
+                kind = out["_transport_error"].split(":")[0]
+                report.error_kinds[kind] = \
+                    report.error_kinds.get(kind, 0) + 1
+                continue
+            if out.get("error"):
+                report.typed_errors += 1
+                kind = out["error"]
+                report.error_kinds[kind] = \
+                    report.error_kinds.get(kind, 0) + 1
+                continue
+            report.completed += 1
+            # -- invariant 3: bit-identical greedy output --------------
+            if out.get("generated") != expected[i]:
+                report.mismatches += 1
+
+        # -- invariant 2: zero leaks on every replica after drain ----------
+        fi.get_injector().disarm("net.recv")
+        deadline = time.monotonic() + drain_timeout_s
+        sup.wait_ready()  # the killed replica must be back first
+        for rep in sup.replicas:
+            try:
+                _rpc(sup.host, rep.port, {"op": "drain"}, timeout_s=10.0)
+            except Exception:
+                report.leak_failures += 1
+                continue
+            ok = False
+            while time.monotonic() < deadline:
+                try:
+                    chk = _rpc(sup.host, rep.port, {"op": "leak_check"},
+                               timeout_s=10.0)
+                except Exception:
+                    time.sleep(0.5)
+                    continue
+                if chk.get("ok"):
+                    ok = True
+                    break
+                if not chk.get("busy"):
+                    break  # audit FAILED (not just in-flight work)
+                time.sleep(0.5)
+            if ok:
+                report.replicas_checked += 1
+            else:
+                report.leak_failures += 1
+            counters = _scrape_counters(sup.host, rep.port)
+            report.engine_restarts += \
+                int(counters.get("engine_restarts_total", 0))
+            report.replayed_requests += \
+                int(counters.get("replayed_requests_total", 0))
+        report.supervisor_restarts = sup.restarts_total
+        report.router_failovers = router.failovers_total
+        router.stop()
+    finally:
+        try:
+            fi.get_injector().disarm("net.recv")
+        except Exception:
+            pass
+        sup.stop()
+    report.wall_s = round(time.monotonic() - t_start, 3)
+    if not report.ok:
+        # postmortem breadcrumbs: the router's routing history and the
+        # replica log locations (subprocess tracebacks live there)
+        report.details.append({"route_trace": route_trace,
+                               "log_dir": log_dir})
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="seeded chaos run against the crash-safe serving "
+                    "stack; exit 0 iff all three invariants held")
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--requests", type=int, default=12)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--model", default="gpt_tiny")
+    parser.add_argument("--no-kill", action="store_true",
+                        help="skip the replica SIGKILL")
+    parser.add_argument("--faults", default=DEFAULT_REPLICA_FAULTS,
+                        help="PT_FAULT_INJECT schedule for replicas "
+                             "('' = none)")
+    parser.add_argument("--platform", default="cpu")
+    parser.add_argument("--log-dir", default=None)
+    args = parser.parse_args(argv)
+
+    report = run_chaos(replicas=args.replicas, requests=args.requests,
+                       seed=args.seed, model=args.model,
+                       replica_faults=args.faults or None,
+                       kill_replica=not args.no_kill,
+                       platform=args.platform, log_dir=args.log_dir)
+    print(json.dumps(report.to_dict(), indent=2))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
