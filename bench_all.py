@@ -678,6 +678,152 @@ def bench_ragged_serving(on_tpu: bool) -> Dict:
                     "recycling; tokens/s counts generated tokens only"}
 
 
+# ONE set of workload constants, interpolated into both the subprocess
+# payload and the result-dict metadata below — the BENCH_STAGED entry
+# must describe the workload that was actually measured
+_MESH_DECODE_CPU = {"lens": [5, 9, 13], "n_req": 4, "new_toks": 8,
+                    "num_slots": 2, "page_size": 8, "devices": 8}
+
+_MESH_DECODE_PAYLOAD = """
+import time
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu.core.cpu_mesh import emit_result
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+from paddle_tpu.inference import create_decode_engine
+from paddle_tpu.distributed.topology import make_serving_mesh
+
+pt.seed(0)
+model = GPTForCausalLM(gpt_tiny())
+model.eval()
+rng = np.random.default_rng(0)
+lens, n_req, new_toks = {lens}, {n_req}, {new_toks}
+prompts = [rng.integers(0, 1024, (lens[i % len(lens)],)).astype(
+    np.int32) for i in range(n_req)]
+
+
+def run(mp):
+    mesh = None if mp == 1 else make_serving_mesh(mp)
+    eng = create_decode_engine(model, num_slots={num_slots},
+                               page_size={page_size},
+                               max_seq_len=64, mesh=mesh)
+    for p in prompts[:len(lens)]:  # warm THIS engine's compiles
+        eng.submit(p, max_new_tokens=2)
+    eng.run()
+    steps0 = eng.steps
+    t0 = time.perf_counter()
+    rids = [eng.submit(p, max_new_tokens=new_toks) for p in prompts]
+    try:
+        results = eng.run()
+    finally:
+        eng.close()
+    wall = time.perf_counter() - t0
+    gen = sum(len(results[r]) - len(p) for r, p in zip(rids, prompts))
+    return {"tokens_per_s": round(gen / wall, 1),
+            "decode_steps": eng.steps - steps0,
+            "generated_tokens": gen,
+            "tokens": {str(r): [int(t) for t in results[r]]
+                       for r in rids}}
+
+
+by_mp = {str(mp): run(mp) for mp in (1, 2, 4)}
+base = by_mp["1"].pop("tokens")
+bit_identical = all(v.pop("tokens") == base
+                    for k, v in by_mp.items() if k != "1")
+emit_result({"by_model_parallel": by_mp,
+             "bit_identical": bit_identical})
+"""
+
+
+def bench_mesh_decode(on_tpu: bool) -> Dict:
+    """Tensor-parallel serving (r10) A/B: the mesh-sharded engine
+    (weights per their mp_layers pspecs, KV pools head-sharded,
+    paged attention under shard_map) vs the single-device engine on
+    the SAME ragged request stream as bench_ragged_serving. On the CPU
+    lane the mesh is a cold-subprocess 8-fake-device host platform
+    (core/cpu_mesh.py) — it measures GSPMD overhead and pins
+    bit-identical outputs, NOT a speedup (N fake devices time-share
+    one CPU; the tensor-parallel win is HBM capacity + per-chip
+    bandwidth, which only a real multi-chip session can show). On
+    chip, the mesh spans the session's real devices."""
+    if not on_tpu:
+        from paddle_tpu.core.cpu_mesh import run_cpu_mesh_json
+        w = _MESH_DECODE_CPU
+        payload = _MESH_DECODE_PAYLOAD
+        for k in ("lens", "n_req", "new_toks", "num_slots",
+                  "page_size"):
+            payload = payload.replace("{%s}" % k, repr(w[k]))
+        res = run_cpu_mesh_json(payload, device_count=w["devices"],
+                                timeout_s=900.0)
+        return {"metric": "gpt_tiny_mesh_decode_cpu_smoke",
+                "unit": "tokens/s", "requests": w["n_req"],
+                "prompt_lens": w["lens"],
+                "new_tokens_per_req": w["new_toks"],
+                "num_slots": w["num_slots"],
+                "page_size": w["page_size"],
+                "host_platform_devices": w["devices"],
+                "by_model_parallel": res["by_model_parallel"],
+                "bit_identical": res["bit_identical"],
+                "note": "cpu_smoke of the real GSPMD path in a cold "
+                        "subprocess; fake devices time-share one CPU "
+                        "so tokens/s measures collective/partition "
+                        "overhead, not the capacity win — chip A/B "
+                        "pending"}
+    # chip path: shard over the session's real devices
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.topology import make_serving_mesh
+    from paddle_tpu.inference import create_decode_engine
+    from paddle_tpu.models import GPTForCausalLM
+
+    cfg = _decode_1p3b_cfg()
+    ndev = len(jax.devices())
+    mp = 1
+    while mp * 2 <= ndev and cfg.num_heads % (mp * 2) == 0 and \
+            cfg.vocab_size % (mp * 2) == 0:
+        mp *= 2
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    _to_bf16_except_norms(model)
+    model.eval()
+    rng = np.random.default_rng(0)
+    lens = [64, 96, 128, 192, 256, 384, 512, 640]
+    n_req, new_toks = 64, 64
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            (lens[i % len(lens)],)).astype(np.int32)
+               for i in range(n_req)]
+    out: Dict = {"metric": "gpt1p3b_mesh_decode_tokens_per_sec_chip",
+                 "unit": "tokens/s", "devices": ndev,
+                 "by_model_parallel": {}}
+    for deg in sorted({1, mp}):
+        mesh = None if deg == 1 else make_serving_mesh(deg)
+        eng = create_decode_engine(model, num_slots=32, page_size=64,
+                                   max_seq_len=1024, mesh=mesh)
+        for p in prompts[:len(lens)]:
+            eng.submit(p, max_new_tokens=2)
+        eng.run()
+        steps0 = eng.steps
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, max_new_tokens=new_toks)
+                for p in prompts]
+        try:
+            results = eng.run()
+        finally:
+            eng.close()
+        wall = time.perf_counter() - t0
+        timed_steps = eng.steps - steps0
+        n_launches = timed_steps + len(prompts)
+        dt = max(1e-9, wall - n_launches * _floor_ms(on_tpu) / 1e3)
+        gen = sum(len(results[r]) - len(p)
+                  for r, p in zip(rids, prompts))
+        out["by_model_parallel"][str(deg)] = {
+            "tokens_per_s": round(gen / dt, 1),
+            "decode_steps": timed_steps,
+            "floor_ms_subtracted": round(_floor_ms(on_tpu), 1)}
+    return out
+
+
 def bench_serving_prefix(on_tpu: bool) -> Dict:
     """Serving-layer A/B (r7 tentpole artifact): a shared-system-prompt
     request stream through the full serving stack — SLO scheduler +
@@ -1201,6 +1347,7 @@ def run_staged(on_tpu: bool) -> Dict:
                      ("decode", bench_decode),
                      ("paged_decode", bench_paged_decode),
                      ("ragged_serving", bench_ragged_serving),
+                     ("mesh_decode", bench_mesh_decode),
                      ("serving_prefix", bench_serving_prefix),
                      ("speculative_decode", bench_speculative_decode),
                      ("compile_cache", bench_compile_cache),

@@ -1,0 +1,113 @@
+"""Multi-device CPU subprocess harness for mesh tests and benches.
+
+The tensor-parallel serving stack (r10) is validated on a CPU
+host-platform mesh: ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+turns one CPU into N fake XLA devices, which exercises the full GSPMD
+path — NamedSharding placement, shard_map dispatch, collective
+insertion — with bit-exact arithmetic and no TPU in the loop.
+
+The flag only takes effect BEFORE the first backend initialization, so
+a process that already imported jax cannot flip its device count. This
+module is the clean-room answer: run the mesh payload in a FRESH
+subprocess with the flag (and ``JAX_PLATFORMS=cpu``) pinned in its
+environment. That keeps single-device callers (bench_all's main
+process, a user REPL, any test file that assumes one device) untouched
+— the PR-1 lesson that leaked multi-device state poisons every later
+test in the process.
+
+The tier-1 suite's own conftest already forces an 8-device host
+platform for everything under ``tests/``, so test code MAY build
+serving meshes in-process there; the subprocess runner is for (a)
+payloads that must not inherit the parent's jax state, (b) bench
+entries driven from arbitrary environments, and (c) pinning that the
+flag-plumbing itself works from a cold start.
+
+Protocol: the payload prints its result as one JSON document on a
+sentinel-marked line (``emit_result`` below, importable in the child);
+``run_cpu_mesh_json`` returns the parsed object and raises with the
+child's full output on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Dict, Optional
+
+__all__ = ["DEVICE_FLAG", "cpu_mesh_env", "run_cpu_mesh_subprocess",
+           "run_cpu_mesh_json", "emit_result", "RESULT_SENTINEL"]
+
+DEVICE_FLAG = "--xla_force_host_platform_device_count"
+RESULT_SENTINEL = "CPU_MESH_RESULT:"
+
+
+def cpu_mesh_env(device_count: int = 8,
+                 extra_env: Optional[Dict[str, str]] = None
+                 ) -> Dict[str, str]:
+    """Child environment: inherited env with the host-platform device
+    flag appended to XLA_FLAGS (any existing device-count flag is
+    dropped — last-one-wins is backend-dependent, explicit is safer),
+    ``JAX_PLATFORMS=cpu`` pinned, and the repo root on PYTHONPATH so a
+    bare ``python -c`` child can import paddle_tpu."""
+    env = dict(os.environ)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith(DEVICE_FLAG)]
+    flags.append(f"{DEVICE_FLAG}={int(device_count)}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    pp = env.get("PYTHONPATH", "")
+    if repo_root not in pp.split(os.pathsep):
+        env["PYTHONPATH"] = (repo_root + os.pathsep + pp) if pp \
+            else repo_root
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
+def run_cpu_mesh_subprocess(source: str, device_count: int = 8,
+                            extra_env: Optional[Dict[str, str]] = None,
+                            timeout_s: float = 600.0
+                            ) -> "subprocess.CompletedProcess":
+    """Execute ``source`` (python code) in a fresh interpreter under an
+    N-fake-device CPU host platform. Raises RuntimeError with the
+    child's combined output when it exits non-zero (subprocess
+    tracebacks must surface in the pytest report, not vanish)."""
+    proc = subprocess.run(
+        [sys.executable, "-c", source],
+        env=cpu_mesh_env(device_count, extra_env),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=timeout_s)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"cpu-mesh subprocess failed (rc={proc.returncode}):\n"
+            f"{proc.stdout}")
+    return proc
+
+
+def run_cpu_mesh_json(source: str, device_count: int = 8,
+                      extra_env: Optional[Dict[str, str]] = None,
+                      timeout_s: float = 600.0) -> Any:
+    """`run_cpu_mesh_subprocess` + parse the child's ``emit_result``
+    payload (the LAST sentinel line wins, so stray child logging above
+    it is harmless)."""
+    proc = run_cpu_mesh_subprocess(source, device_count, extra_env,
+                                   timeout_s)
+    payload = None
+    for line in proc.stdout.splitlines():
+        if line.startswith(RESULT_SENTINEL):
+            payload = line[len(RESULT_SENTINEL):].strip()
+    if payload is None:
+        raise RuntimeError(
+            f"cpu-mesh subprocess printed no {RESULT_SENTINEL!r} line:"
+            f"\n{proc.stdout}")
+    return json.loads(payload)
+
+
+def emit_result(obj: Any) -> None:
+    """Child-side half of the protocol: print ``obj`` as the sentinel
+    line `run_cpu_mesh_json` parses."""
+    print(RESULT_SENTINEL, json.dumps(obj), flush=True)

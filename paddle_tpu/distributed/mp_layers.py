@@ -42,21 +42,31 @@ def _act_spec(ndim, hidden_axis=None):
 
 
 import contextlib as _contextlib
+import threading as _threading
 
-_constraints_disabled = False
+# THREAD-LOCAL, not a module global: jit traces run on the calling
+# thread, and one process may trace a serving-mesh engine (which
+# disables these constraints) and a fleet/training step (which needs
+# them) concurrently — a shared flag's save/restore would race and
+# leak the wrong state into the other thread's trace.
+_constraints_state = _threading.local()
+
+
+def _constraints_disabled() -> bool:
+    return getattr(_constraints_state, "disabled", False)
 
 
 @_contextlib.contextmanager
 def no_sharding_constraints():
     """Disable activation constraints (for computations running on a mesh
-    other than the global hybrid mesh, e.g. the pipeline pp x dp mesh)."""
-    global _constraints_disabled
-    prev = _constraints_disabled
-    _constraints_disabled = True
+    other than the global hybrid mesh, e.g. the pipeline pp x dp mesh).
+    Per-thread: only the calling thread's traces are affected."""
+    prev = _constraints_disabled()
+    _constraints_state.disabled = True
     try:
         yield
     finally:
-        _constraints_disabled = prev
+        _constraints_state.disabled = prev
 
 
 def _constrain(x, *spec):
@@ -69,7 +79,7 @@ def _constrain(x, *spec):
     and is rejected in the backward pass."""
     hcg = get_hybrid_communicate_group()
     from jax._src import core as _jax_core
-    if hcg is None or _constraints_disabled or \
+    if hcg is None or _constraints_disabled() or \
             _jax_core.trace_state_clean():
         return x
     raw = x.value if isinstance(x, Tensor) else x

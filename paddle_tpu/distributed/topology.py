@@ -348,3 +348,76 @@ def make_mesh(axis_shapes: Dict[str, int], devices=None) -> Mesh:
     shape = tuple(axis_shapes[n] for n in names)
     dev_array, _ = build_device_array(shape, devices)
     return Mesh(dev_array, names)
+
+
+# The serving mesh's user-facing "model" axis IS the fleet's mp axis:
+# naming it "mp" lets the GPT weight PartitionSpecs that mp_layers.py
+# already annotates (P(None, "mp") column, P("mp", None) row/vocab)
+# apply to the decode engine verbatim — one pspec convention for
+# training and serving instead of a parallel serving-only one.
+SERVING_MODEL_AXIS = "mp"
+
+
+def make_serving_mesh(model_parallel: int, devices=None) -> Mesh:
+    """1-D tensor-parallel mesh for the decode engine / serving stack:
+    ``model_parallel`` devices along the :data:`SERVING_MODEL_AXIS`
+    axis. The same ``make_mesh`` path the fleet side uses, so a
+    deployment that trains on an mp mesh serves on the identical
+    layout (topology-aware placement included). ``model_parallel=1``
+    is the graceful-degradation mesh: every sharding it produces is
+    replicated, and engine outputs match the mesh-less path."""
+    mp = int(model_parallel)
+    if mp < 1:
+        raise ValueError(f"model_parallel must be >= 1, got {mp}")
+    avail = len(devices) if devices is not None else len(jax.devices())
+    if mp > avail:
+        raise ValueError(
+            f"serving mesh model={mp} exceeds device count {avail}")
+    return make_mesh({SERVING_MODEL_AXIS: mp}, devices=devices)
+
+
+def parse_mesh_spec(spec) -> int:
+    """Parse the serving CLI's ``--mesh`` value to a model-parallel
+    degree: ``"model=N"`` (the documented form), ``"mp=N"`` (the
+    underlying axis name), or a bare ``"N"``. Raises ValueError on
+    anything else — the CLI surfaces it as a typed argument error, not
+    a confusing mesh-construction failure later."""
+    s = str(spec).strip()
+    if "=" in s:
+        key, _, val = s.partition("=")
+        if key.strip() not in ("model", SERVING_MODEL_AXIS):
+            raise ValueError(
+                f"--mesh axis must be 'model' (or "
+                f"{SERVING_MODEL_AXIS!r}), got {key.strip()!r}")
+        s = val.strip()
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"--mesh expects 'model=N' or a bare integer, got {spec!r}")
+    if n < 1:
+        raise ValueError(f"--mesh model={n} must be >= 1")
+    return n
+
+
+def filter_pspec(pspec, mesh: Mesh) -> PartitionSpec:
+    """Project a PartitionSpec onto ``mesh``: axis names the mesh does
+    not carry are dropped (that dimension replicates). The hybrid-mesh
+    pspecs name up to five axes (dp/mp/pp/sharding/sep); a serving
+    mesh carries only ``mp``, and a weight annotated P(None, "mp")
+    must mean "shard on mp, ignore the rest" there rather than fail."""
+    if pspec is None:
+        return PartitionSpec()
+    axes = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in axes)
+            if not kept:
+                return None
+            return kept if len(kept) > 1 else kept[0]
+        return entry if entry in axes else None
+
+    return PartitionSpec(*(keep(e) for e in tuple(pspec)))

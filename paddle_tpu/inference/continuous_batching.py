@@ -59,6 +59,7 @@ rebuilt as a scheduler over one jitted step instead of a stream pool.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from typing import (Any, Callable, Dict, Hashable, List, Optional,
@@ -327,7 +328,8 @@ class ContinuousBatchingEngine:
                                                 None]] = None,
                  max_prefill_attempts: int = 3,
                  speculative=None, verify_retry="site",
-                 stall_timeout_s: Optional[float] = None):
+                 stall_timeout_s: Optional[float] = None,
+                 mesh=None):
         import jax.numpy as jnp
 
         from ..core.compile_cache import enable_compile_cache
@@ -342,6 +344,53 @@ class ContinuousBatchingEngine:
         model.eval()
         cfg = model.config
         self.cfg = cfg
+        # tensor-parallel serving (mesh=None = single-device, the
+        # byte-for-byte pre-r10 behavior): weights shard per their
+        # mp_layers pspecs, KV pools shard over heads, page table and
+        # seq_lens stay replicated host state, and the one compiled
+        # decode/verify/prefill step runs under GSPMD with the paged-
+        # attention op head-sharded via shard_map. The allocator and
+        # every host-side page-accounting invariant are untouched: a
+        # page is a page on every shard.
+        self.mesh = mesh
+        self._mesh_axis = None
+        self._kv_sharding = None
+        self._state_shardings = None
+        # identity cache for sharded weights: (kind, name) -> (source
+        # array, its device_put result). An unchanged leaf transfers to
+        # the mesh ONCE per engine lifetime; per-admission state
+        # refreshes then cost dict lookups, not host->mesh copies.
+        self._shard_cache: Dict = {}
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..distributed.topology import SERVING_MODEL_AXIS
+            axis = SERVING_MODEL_AXIS
+            if axis not in mesh.axis_names:
+                raise ValueError(
+                    f"serving mesh must carry a {axis!r} axis "
+                    f"(distributed.topology.make_serving_mesh); got "
+                    f"axes {mesh.axis_names}")
+            extra = [a for a in mesh.axis_names
+                     if a != axis and mesh.shape[a] != 1]
+            if extra:
+                raise ValueError(
+                    f"serving mesh axes {extra} must have size 1 "
+                    f"(only {axis!r} shards the decode engine)")
+            n = int(mesh.shape[axis])
+            if cfg.num_heads % n:
+                raise ValueError(
+                    f"num_heads {cfg.num_heads} not divisible by mesh "
+                    f"{axis}={n}")
+            if cfg.vocab_size % n:
+                raise ValueError(
+                    f"vocab_size {cfg.vocab_size} not divisible by "
+                    f"mesh {axis}={n} (VocabParallelEmbedding shards "
+                    f"the vocab dim)")
+            self._mesh_axis = axis
+            # one spec serves pools ([P+1, page, H, D]) and scales
+            # ([P+1, page, H]): dim 2 is the head dim in both
+            self._kv_sharding = NamedSharding(
+                mesh, PartitionSpec(None, None, axis))
         self.page_size = int(page_size)
         self.num_slots = int(num_slots)
         self.max_seq_len = int(max_seq_len or cfg.max_seq_len)
@@ -367,7 +416,8 @@ class ContinuousBatchingEngine:
         # same buffer for two arguments is an error)
         protos = [paged_cache_create(
             1, self.num_pages, self.page_size, nh, hd, dt,
-            self.max_pages, quantized=self.kv_int8) for _ in range(nl)]
+            self.max_pages, quantized=self.kv_int8,
+            kv_sharding=self._kv_sharding) for _ in range(nl)]
         self._pools = {
             "k": [p.k_pages for p in protos],
             "v": [p.v_pages for p in protos],
@@ -506,8 +556,125 @@ class ContinuousBatchingEngine:
         host overhead on the hot path."""
         if refresh or self._state_cache is None:
             from ..nn.layer import functional_state
-            self._state_cache = functional_state(self.model)
+            self._state_cache = self._shard_state(
+                functional_state(self.model))
         return self._state_cache
+
+    def _shard_state(self, state):
+        """Place the functional state on the serving mesh per each
+        weight's mp_layers pspec (mesh=None: passthrough). Transfers
+        are identity-cached, so only leaves that actually changed since
+        the last refresh (set_state_dict, int8 conversion) move; a
+        structural change (new buffer names) recomputes the sharding
+        tree — the same retrace-don't-stale contract `_fresh_state`
+        documents."""
+        if self.mesh is None:
+            return state
+        import jax
+
+        from ..nn.layer import functional_state_shardings
+        if self._state_shardings is None:
+            self._state_shardings = functional_state_shardings(
+                self.model, self.mesh)
+        out: Dict[str, Dict] = {}
+        missed: List = []  # (kind, name, val, sharding)
+        for kind in ("params", "buffers"):
+            grp = {}
+            for name, val in state[kind].items():
+                hit = self._shard_cache.get((kind, name))
+                if hit is not None and hit[0] is val:
+                    grp[name] = hit[1]
+                    continue
+                sh = self._state_shardings[kind].get(name)
+                if sh is None:  # structural change: new leaf appeared
+                    self._state_shardings = functional_state_shardings(
+                        self.model, self.mesh)
+                    sh = self._state_shardings[kind][name]
+                missed.append((kind, name, val, sh))
+                grp[name] = None  # filled from the batched transfer
+            out[kind] = grp
+        if missed:
+            # ONE batched transfer for every cache miss: on engine
+            # build/resurrection all leaves miss, and per-leaf
+            # device_put dispatch is serial host overhead
+            puts = jax.device_put([v for _, _, v, _ in missed],
+                                  [s for _, _, _, s in missed])
+            for (kind, name, val, _), put in zip(missed, puts):
+                self._shard_cache[(kind, name)] = (val, put)
+                out[kind][name] = put
+        # prune leaves that vanished from the state (e.g. fp32 params
+        # replaced by int8 buffers when convert_to_weight_only_int8
+        # swaps layers): a stale entry pins BOTH the host array and its
+        # on-mesh copy for the engine lifetime — roughly a full dead
+        # model of HBM on exactly the deployments mesh= targets
+        live = {(k, n) for k in ("params", "buffers") for n in out[k]}
+        for stale in [k for k in self._shard_cache if k not in live]:
+            del self._shard_cache[stale]
+        return out
+
+    def _head_ctx(self):
+        """Trace-time mesh routing for the jitted programs: under a
+        mesh, every `paged_attention` call inside the traced body
+        dispatches head-sharded via shard_map (each device runs the
+        standard kernel-selection path on its H/N-head slice), and the
+        mp_layers ACTIVATION constraints are disabled — they pin to the
+        global hybrid (training) mesh, which is a different device set
+        than the serving mesh whenever a fleet group is live in the
+        process (the PR-1 leaked-mesh failure mode: "incompatible
+        devices" at trace time). The serving mesh carries only mp, so
+        GSPMD infers the activation layouts from the weight and KV-pool
+        shardings instead.
+
+        mesh=None traces ALSO disable the constraints: the single-device
+        engine never wants hybrid-mesh activation constraints either,
+        and a live fleet group in the same process (training + serving,
+        or a group leaked by an earlier test module) otherwise pins the
+        decode traces to the training mesh — observed as WRONG decode
+        outputs, not a trace error. In a clean process hcg is None and
+        _constrain is already a no-op, so single-device behavior is
+        unchanged."""
+        from ..distributed.mp_layers import no_sharding_constraints
+        if self.mesh is None:
+            return no_sharding_constraints()
+        from ..ops.pallas.paged_attention import head_sharding
+        ctx = contextlib.ExitStack()
+        ctx.enter_context(head_sharding(self.mesh, self._mesh_axis))
+        ctx.enter_context(no_sharding_constraints())
+        return ctx
+
+    def _constrain_pools(self, pools):
+        """Pin the returned pools to the engine's KV sharding (heads
+        over the model axis; scales drop the trailing head-dim axis).
+        Without this GSPMD is free to pick a different output layout,
+        which would make the next step's donated inputs mismatch the
+        compiled program and ping-pong the jit cache."""
+        if self.mesh is None:
+            return pools
+        import jax
+        # ONE definition of the KV layout: the same sharding the pools
+        # were created under in __init__ (heads over the model axis —
+        # P(None, None, mp) hits dim 2, the head dim of both the 4-D
+        # pools and the 3-D scale pools)
+        spec = self._kv_sharding
+
+        def pin(xs):
+            return [None if x is None
+                    else jax.lax.with_sharding_constraint(x, spec)
+                    for x in xs]
+
+        return {"k": pin(pools["k"]), "v": pin(pools["v"]),
+                "ks": pin(pools["ks"]), "vs": pin(pools["vs"])}
+
+    def mesh_info(self) -> Optional[Dict[str, Any]]:
+        """Mesh observability record (server stats / Prometheus):
+        None when single-device, else axis sizes + device count."""
+        if self.mesh is None:
+            return None
+        return {"axes": {str(a): int(self.mesh.shape[a])
+                         for a in self.mesh.axis_names},
+                "model_parallel": int(self.mesh.shape[self._mesh_axis]),
+                "devices": int(self.mesh.size),
+                "model_axis": self._mesh_axis}
 
     def _build_decode(self):
         import jax
@@ -522,7 +689,8 @@ class ContinuousBatchingEngine:
 
         def step(state, pools, table, lens, tokens):
             caches = self._caches(pools, table, lens)
-            with bind_state(self.model, state), no_grad():
+            with self._head_ctx(), bind_state(self.model, state), \
+                    no_grad():
                 logits, nc = self.model.forward(Tensor(tokens[:, None]),
                                                 caches=caches)
             # greedy serving mode through the ONE shared sampler
@@ -537,7 +705,8 @@ class ContinuousBatchingEngine:
                 "vs": [raw(c.v_scale) if self.kv_int8 else None
                        for c in nc],
             }
-            return nxt, new_pools, raw(nc[0].seq_lens)
+            return nxt, self._constrain_pools(new_pools), \
+                raw(nc[0].seq_lens)
 
         # donate the pools: the append scatters then update the pool
         # buffers IN PLACE instead of materializing a fresh copy of
@@ -567,7 +736,8 @@ class ContinuousBatchingEngine:
 
         def prefill(state, pools, trow, slens, plen, ids):
             caches = self._caches(pools, trow, slens)
-            with bind_state(self.model, state), no_grad():
+            with self._head_ctx(), bind_state(self.model, state), \
+                    no_grad():
                 logits, nc = self.model.forward(
                     Tensor(ids), caches=caches, prefill_lens=plen,
                     prefill_chained=chained)
@@ -581,7 +751,7 @@ class ContinuousBatchingEngine:
                 "vs": [raw(c.v_scale) if self.kv_int8 else None
                        for c in nc],
             }
-            return nxt, new_pools
+            return nxt, self._constrain_pools(new_pools)
 
         return jax.jit(prefill, donate_argnums=(1,))
 
@@ -616,7 +786,8 @@ class ContinuousBatchingEngine:
 
         def verify(state, pools, table, lens, tokens, valid, key):
             caches = self._caches(pools, table, lens)
-            with bind_state(self.model, state), no_grad():
+            with self._head_ctx(), bind_state(self.model, state), \
+                    no_grad():
                 logits, nc = self.model.verify_step(Tensor(tokens),
                                                     caches, valid)
             accept, resid, full, _ = speculative_verify_tokens(
@@ -629,7 +800,7 @@ class ContinuousBatchingEngine:
                 "vs": [raw(c.v_scale) if self.kv_int8 else None
                        for c in nc],
             }
-            return accept, resid, full, new_pools
+            return accept, resid, full, self._constrain_pools(new_pools)
 
         return jax.jit(verify, donate_argnums=(1,))
 

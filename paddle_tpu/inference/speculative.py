@@ -131,6 +131,11 @@ class ModelDraft:
         def draft(state, ctx, lens):
             import jax.numpy as jnp
 
+            # single-device trace guard (same as GPT _generate_jit): a
+            # live fleet group's hybrid-mesh activation constraints
+            # must not reach the draft program
+            from ..distributed.mp_layers import no_sharding_constraints
+
             b = ctx.shape[0]
 
             def body(carry, _):
@@ -150,7 +155,9 @@ class ModelDraft:
                 c = c.at[jnp.arange(b), pos].set(nxt)
                 return (c, jnp.minimum(l + 1, w)), nxt
 
-            _, toks = jax.lax.scan(body, (ctx, lens), None, length=k)
+            with no_sharding_constraints():
+                _, toks = jax.lax.scan(body, (ctx, lens), None,
+                                       length=k)
             return toks.swapaxes(0, 1)  # [B, k]
 
         return jax.jit(draft)

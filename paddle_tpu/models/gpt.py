@@ -15,6 +15,7 @@ parameter annotated with its hybrid-mesh PartitionSpec (dp×mp×pp×sp).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, NamedTuple, Optional
 
@@ -180,22 +181,54 @@ class PagedKVCache(NamedTuple):
         return self.k_pages.shape[1]
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_zeros_fn(sharding):
+    """One jitted zeros-under-out_shardings program per output sharding
+    (shape/dtype static, so jax's jit cache dedups repeated layers): a
+    mesh engine creates 2*num_layers identically-shaped pools per
+    build/resurrection, which must not each pay their own trace. Every
+    EXECUTION still returns a fresh buffer — callers donate the pools,
+    so the executable is shared, never the arrays."""
+    import jax
+    return jax.jit(jnp.zeros, static_argnums=(0, 1),
+                   out_shardings=sharding)
+
+
 def paged_cache_create(batch: int, num_pages: int, page_size: int,
                        num_heads: int, head_dim: int, dtype,
                        max_pages_per_seq: int, quantized: bool = False,
-                       page_table=None, seq_lens=None) -> PagedKVCache:
+                       page_table=None, seq_lens=None,
+                       kv_sharding=None) -> PagedKVCache:
     """Zero-filled pool (+1 reserved scratch page) with an optional
     pre-assigned page table; the default table hands sequence ``i``
     pages ``[i*mp, (i+1)*mp)`` contiguously (the single-request
     generate() layout — the continuous-batching engine supplies its
-    allocator-managed table instead)."""
+    allocator-managed table instead).
+
+    ``kv_sharding``: an optional NamedSharding for the KV pools (the
+    mesh-sharded engine passes heads-over-``mp``). The pools are
+    created DIRECTLY under it via jit out_shardings — a serving-scale
+    pool is sized for the whole mesh's HBM, so materializing it
+    replicated first and resharding after would OOM the very
+    deployments the mesh exists for. Scale pools (one rank lower)
+    derive their sharding by dropping the trailing head-dim axis."""
     kv_dtype = jnp.int8 if quantized else dtype
     shape = (num_pages + 1, page_size, num_heads, head_dim)
-    k_pages = jnp.zeros(shape, kv_dtype)
-    v_pages = jnp.zeros(shape, kv_dtype)
+    if kv_sharding is None:
+        zeros = jnp.zeros
+        scale_zeros = jnp.zeros
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec3 = PartitionSpec(*tuple(kv_sharding.spec)[:3])
+        scale_sharding = NamedSharding(kv_sharding.mesh, spec3)
+        zeros = _sharded_zeros_fn(kv_sharding)
+        scale_zeros = _sharded_zeros_fn(scale_sharding)
+
+    k_pages = zeros(shape, kv_dtype)
+    v_pages = zeros(shape, kv_dtype)
     if quantized:
-        k_scale = jnp.zeros(shape[:3], jnp.float32)
-        v_scale = jnp.zeros(shape[:3], jnp.float32)
+        k_scale = scale_zeros(shape[:3], jnp.float32)
+        v_scale = scale_zeros(shape[:3], jnp.float32)
     else:
         k_scale = v_scale = None
     if page_table is None:
@@ -889,22 +922,33 @@ class GPTForCausalLM(Layer):
             return sample_token(last, temp, tk, k)
 
         def run(params, ids, k):
-            caches = make_caches()
-            logits, caches = fwd(params, ids, caches)  # prefill
-            nxt, k = sample(logits[:, -1], k)
+            # single-device program: hybrid-mesh activation constraints
+            # must not leak into this trace. With a fleet group live in
+            # the process they hand the GSPMD partitioner mp/dp
+            # annotations with no in_shardings to anchor them, and it
+            # has been observed to insert an all-reduce over mp on the
+            # REPLICATED token output — emitted ids came back exactly
+            # mp-times too large while the scan carry stayed correct.
+            from ..distributed.mp_layers import no_sharding_constraints
+            with no_sharding_constraints():
+                caches = make_caches()
+                logits, caches = fwd(params, ids, caches)  # prefill
+                nxt, k = sample(logits[:, -1], k)
 
-            def body(carry, _):
-                cur, cs, kk = carry
-                lg, cs = fwd(params, cur[:, None], cs)
-                nxt2, kk = sample(lg[:, -1], kk)
-                return (nxt2, cs, kk), cur
+                def body(carry, _):
+                    cur, cs, kk = carry
+                    lg, cs = fwd(params, cur[:, None], cs)
+                    nxt2, kk = sample(lg[:, -1], kk)
+                    return (nxt2, cs, kk), cur
 
-            (last, _, _), toks = jax.lax.scan(
-                body, (nxt, caches, k), None, length=max_new_tokens - 1)
-            # toks: [N-1, B] tokens fed at each step; `last` is token N
-            all_new = jnp.concatenate(
-                [toks, last[None]], axis=0).swapaxes(0, 1)  # [B, N]
-            return jnp.concatenate([ids, all_new], axis=1)
+                (last, _, _), toks = jax.lax.scan(
+                    body, (nxt, caches, k), None,
+                    length=max_new_tokens - 1)
+                # toks: [N-1, B] tokens fed at each step; `last` is
+                # token N
+                all_new = jnp.concatenate(
+                    [toks, last[None]], axis=0).swapaxes(0, 1)  # [B, N]
+                return jnp.concatenate([ids, all_new], axis=1)
 
         sig = (b, s, max_new_tokens, temp, tk, kv_cache, page_size)
         cache = getattr(self, "_gen_jit_cache", None)
@@ -993,8 +1037,14 @@ class GPTForCausalLM(Layer):
         # against new-layout params (the r5 stale-pack-cache lesson)
         sig = (temp, tk, tuple(pnames), tuple(bnames))
         if sig not in cache:
+            # same single-device-trace guard as _generate_jit: a live
+            # fleet group's activation constraints must not reach these
+            # per-block programs
+            from ..distributed.mp_layers import no_sharding_constraints
+
             def embed_fn(st, ids, pos0):
-                with bind_state(self, st), no_grad():
+                with bind_state(self, st), no_grad(), \
+                        no_sharding_constraints():
                     pos = pos0 + jnp.arange(ids.shape[1],
                                             dtype=jnp.int32)[None]
                     pos = jnp.broadcast_to(pos, ids.shape)
@@ -1005,14 +1055,16 @@ class GPTForCausalLM(Layer):
             def block_fn(x, k_buf, v_buf, pos, *vals):
                 st = {"params": dict(zip(pnames, vals[:n_p])),
                       "buffers": dict(zip(bnames, vals[n_p:]))}
-                with bind_state(blk0, st), no_grad():
+                with bind_state(blk0, st), no_grad(), \
+                        no_sharding_constraints():
                     out, nc = blk0(Tensor(x),
                                    StaticKVCache(k_buf, v_buf, pos),
                                    use_cache=True)
                 return raw(out), raw(nc.k), raw(nc.v)
 
             def head_fn(st, x):
-                with bind_state(self, st), no_grad():
+                with bind_state(self, st), no_grad(), \
+                        no_sharding_constraints():
                     lg = self.logits(self.gpt.ln_f(Tensor(x)))
                 return raw(lg)[:, -1]
 

@@ -339,6 +339,29 @@ def functional_state(layer: Layer, trainable_only: bool = False
     return {"params": params, "buffers": buffers}
 
 
+def functional_state_shardings(layer: Layer, mesh) -> Dict[str, Any]:
+    """NamedSharding tree matching :func:`functional_state`'s structure,
+    from each Parameter/buffer's ``.pspec`` annotation (mp_layers.py
+    sets these) projected onto ``mesh`` via ``filter_pspec`` —
+    unannotated leaves replicate. The decode engine feeds this to
+    ``jax.device_put`` so GSPMD serves the model tensor-parallel with
+    the exact layout the fleet side trains it in."""
+    from jax.sharding import NamedSharding
+
+    from ..distributed.topology import filter_pspec
+
+    def sh(obj):
+        return NamedSharding(mesh,
+                             filter_pspec(getattr(obj, "pspec", None),
+                                          mesh))
+
+    params = {n: sh(p) for n, p in layer.named_parameters()
+              if p is not None}
+    buffers = {n: sh(b) for n, b in layer.named_buffers()
+               if b is not None}
+    return {"params": params, "buffers": buffers}
+
+
 @contextlib.contextmanager
 def bind_state(layer: Layer, state: Dict[str, Any]):
     """Temporarily substitute raw values (possibly tracers) into the layer's

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Optional, Sequence, Tuple, Union
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -879,6 +881,39 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
     return _impl(q, k_pages, v_pages, page_table, seq_lens,
                  k_scale=k_scale, v_scale=v_scale, scale=scale,
                  q_offsets=q_offsets)
+
+
+def paged_attention_head_sharded(q, k_pages, v_pages, page_table,
+                                 seq_lens, k_scale=None, v_scale=None,
+                                 scale=None, q_offsets=None, mesh=None,
+                                 axis=None):
+    """Tensor-parallel ragged paged attention: q and the KV pools are
+    sharded over heads along ``mesh[axis]`` and each device runs the
+    standard kernel-selection path on its slice (attention is
+    head-local, so there are no collectives and per-head arithmetic is
+    bit-identical to the single-device op). ``mesh=None`` builds a
+    serving mesh over min(2, device_count) devices — the benchable
+    default (tools/op_benchmark.py pending case); the mesh-sharded
+    decode engine passes its own."""
+    from .pallas.paged_attention import \
+        paged_attention_head_sharded as _impl
+    if mesh is None:
+        import jax as _jax
+        mesh = _default_serving_mesh(min(2, _jax.device_count()))
+    return _impl(q, k_pages, v_pages, page_table, seq_lens, mesh,
+                 axis=axis, k_scale=k_scale, v_scale=v_scale,
+                 scale=scale, q_offsets=q_offsets)
+
+
+@functools.lru_cache(maxsize=None)
+def _default_serving_mesh(model_parallel: int):
+    """Memoized benchable-default mesh for
+    :func:`paged_attention_head_sharded` — the op is registered in the
+    dispatch registry and callable eagerly in a loop; mesh/device-array
+    construction per call would be pure overhead for an identical
+    result."""
+    from ..distributed.topology import make_serving_mesh
+    return make_serving_mesh(model_parallel)
 
 
 # --------------------------------------------------------------------------

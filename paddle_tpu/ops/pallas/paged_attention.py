@@ -41,6 +41,7 @@ contract (the "CanBeUsed" runtime-selection pattern of
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 from typing import Optional
@@ -248,6 +249,108 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens,
 
 
 # --------------------------------------------------------------------------
+# Head sharding (tensor-parallel serving over a `model` mesh axis)
+# --------------------------------------------------------------------------
+
+# Trace-time routing state for the mesh-sharded decode engine
+# (inference/continuous_batching.py `mesh=`): while a (mesh, axis) pair
+# is active, the public entry runs head-sharded under shard_map. The
+# head dimension is embarrassingly parallel in attention — every head
+# attends its own K/V columns — so the per-device body is exactly the
+# single-device kernel on 1/N of the heads, with no collectives and
+# therefore BIT-IDENTICAL per-head arithmetic (the property the
+# mesh-vs-single-device greedy pins lean on). THREAD-LOCAL: jit traces
+# run on the calling thread, and one process may trace a mesh engine
+# and a single-device engine concurrently (two server threads); a
+# process-global switch would reroute the other thread's trace.
+import threading as _threading
+
+_HEAD_SHARDING = _threading.local()
+
+
+def _default_axis() -> str:
+    # topology.SERVING_MODEL_AXIS is the single source of truth for
+    # the serving mesh's axis name; imported lazily (ops.pallas must
+    # not pull the distributed package at module import)
+    from ...distributed.topology import SERVING_MODEL_AXIS
+    return SERVING_MODEL_AXIS
+
+
+@contextlib.contextmanager
+def head_sharding(mesh, axis: Optional[str] = None):
+    """Route `paged_attention` through the head-sharded shard_map
+    dispatch for the duration (a trace-time switch: wrap the jit-traced
+    call, not the runtime one). ``axis=None`` = the serving model axis
+    (topology.SERVING_MODEL_AXIS)."""
+    prev = getattr(_HEAD_SHARDING, "value", None)
+    _HEAD_SHARDING.value = (mesh, axis or _default_axis())
+    try:
+        yield
+    finally:
+        _HEAD_SHARDING.value = prev
+
+
+def get_head_sharding() -> Optional[tuple]:
+    return getattr(_HEAD_SHARDING, "value", None)
+
+
+def paged_attention_head_sharded(q, k_pages, v_pages, page_table,
+                                 seq_lens, mesh,
+                                 axis: Optional[str] = None,
+                                 k_scale=None, v_scale=None,
+                                 scale: Optional[float] = None,
+                                 q_offsets=None):
+    """Ragged paged attention with heads sharded over ``mesh[axis]``.
+
+    shard_map over the head dim of q and the KV pools (page table,
+    seq_lens and q_offsets replicate — they are host scheduler state);
+    each device runs the standard kernel-selection path on its own
+    H/N-head slice, so on TPU every shard dispatches the Mosaic
+    page-walk kernel and on CPU the dense-gather reference. No
+    inter-device communication: attention is head-local. Requires
+    ``num_heads % mesh.shape[axis] == 0``."""
+    from ...compat import shard_map
+
+    if axis is None:
+        axis = _default_axis()
+    b, sq, h, d = q.shape
+    n = mesh.shape[axis]
+    if h % n != 0:
+        raise ValueError(
+            f"num_heads {h} not divisible by mesh axis {axis!r}={n}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    scale = float(scale)
+    p4 = jax.sharding.PartitionSpec(None, None, axis)
+    p3 = jax.sharding.PartitionSpec(None, None, axis)
+    rep = jax.sharding.PartitionSpec()
+    args = [q, k_pages, v_pages, page_table, seq_lens]
+    specs = [p4, p4, p4, rep, rep]
+    has_scale = k_scale is not None
+    if has_scale:
+        args += [k_scale, v_scale]
+        specs += [p3, p3]
+    has_qo = q_offsets is not None
+    if has_qo:
+        args += [q_offsets]
+        specs += [rep]
+
+    def local(*a):
+        it = iter(a)
+        qq, kp, vp, pt, sl = (next(it) for _ in range(5))
+        ks = next(it) if has_scale else None
+        vs = next(it) if has_scale else None
+        qo = next(it) if has_qo else None
+        return _paged_attention_local(qq, kp, vp, pt, sl, k_scale=ks,
+                                      v_scale=vs, scale=scale,
+                                      q_offsets=qo)
+
+    fn = shard_map(local, mesh=mesh, in_specs=tuple(specs),
+                   out_specs=p4, check_rep=False)
+    return fn(*args)
+
+
+# --------------------------------------------------------------------------
 # Public entry — runtime kernel selection
 # --------------------------------------------------------------------------
 
@@ -267,15 +370,14 @@ def paged_attention_supported(q_shape, kp_shape,
             page % 8 == 0)
 
 
-def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
-                    k_scale=None, v_scale=None,
-                    scale: Optional[float] = None, q_offsets=None):
-    """Ragged paged attention over a block-paged KV pool.
-
-    q: [B, Sq, H, D]; k_pages/v_pages: [P, page, H, D] (float or int8
-    with k_scale/v_scale [P, page, H]); page_table: [B, max_pages]
-    int32; seq_lens: [B] int32 lengths INCLUDING the already-appended
-    query tokens. Returns [B, Sq, H, D]."""
+def _paged_attention_local(q, k_pages, v_pages, page_table, seq_lens,
+                           k_scale=None, v_scale=None,
+                           scale: Optional[float] = None,
+                           q_offsets=None):
+    """Single-device kernel selection (the pre-mesh public entry): the
+    Mosaic page-walk kernel where the shape gate admits, the
+    dense-gather reference elsewhere. Also the per-shard body of the
+    head-sharded dispatch."""
     b, sq, h, d = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
@@ -291,3 +393,29 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
         q, k_pages, v_pages, page_table, seq_lens,
         k_scale=k_scale, v_scale=v_scale, scale=scale,
         q_offsets=q_offsets)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
+                    k_scale=None, v_scale=None,
+                    scale: Optional[float] = None, q_offsets=None):
+    """Ragged paged attention over a block-paged KV pool.
+
+    q: [B, Sq, H, D]; k_pages/v_pages: [P, page, H, D] (float or int8
+    with k_scale/v_scale [P, page, H]); page_table: [B, max_pages]
+    int32; seq_lens: [B] int32 lengths INCLUDING the already-appended
+    query tokens. Returns [B, Sq, H, D].
+
+    Under an active :func:`head_sharding` context (the mesh-sharded
+    decode engine wraps its jit traces in one) the call runs
+    head-sharded via shard_map; otherwise single-device kernel
+    selection."""
+    hs = get_head_sharding()
+    if hs is not None:
+        mesh, axis = hs
+        return paged_attention_head_sharded(
+            q, k_pages, v_pages, page_table, seq_lens, mesh, axis=axis,
+            k_scale=k_scale, v_scale=v_scale, scale=scale,
+            q_offsets=q_offsets)
+    return _paged_attention_local(
+        q, k_pages, v_pages, page_table, seq_lens, k_scale=k_scale,
+        v_scale=v_scale, scale=scale, q_offsets=q_offsets)

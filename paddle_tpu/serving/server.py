@@ -796,6 +796,7 @@ class ServingServer:
     def _health(self) -> Dict:
         eng = self.engine
         pc = self.prefix_cache
+        mesh_info = getattr(eng, "mesh_info", lambda: None)()
 
         def racy(fn, fallback=-1):
             # conn-thread reads of dicts the engine thread mutates
@@ -822,6 +823,11 @@ class ServingServer:
                     lambda: pc.total_pages()) if pc is not None else 0,
                 "num_pages": eng.num_pages,
                 "steps": eng.steps,
+                # tensor-parallel serving (r10): None = single-device,
+                # else {"axes": {...}, "model_parallel": N, ...} — the
+                # supervisor and dashboards see the replica's mesh
+                # layout without a separate query
+                "mesh": mesh_info,
                 "engine_restarts": self._restarts,
                 "step_ema_ms": (None if eng.step_ema_s is None
                                 else round(eng.step_ema_s * 1e3, 3)),
@@ -833,13 +839,25 @@ class ServingServer:
         against the engine thread, same as the health op."""
         eng = self.engine
         pc = self.prefix_cache
-        return {"inflight_slots": eng.num_active,
-                "queued_requests": eng.num_queued,
-                "free_pages": eng.free_pages,
-                "reserved_pages": eng.allocator.reserved_total,
-                "prefix_cache_pages":
-                    pc.total_pages() if pc is not None else 0,
-                "num_pages": eng.num_pages}
+        g = {"inflight_slots": eng.num_active,
+             "queued_requests": eng.num_queued,
+             "free_pages": eng.free_pages,
+             "reserved_pages": eng.allocator.reserved_total,
+             "prefix_cache_pages":
+                 pc.total_pages() if pc is not None else 0,
+             "num_pages": eng.num_pages}
+        mi = getattr(eng, "mesh_info", lambda: None)()
+        if mi is not None:
+            # tensor-parallel serving (r10): mesh layout on the scrape
+            # page. mesh_collective_bytes is a STUB pinned at 0 —
+            # per-step collective traffic needs the on-chip profiler
+            # (xprof collective stats); CPU host-platform meshes have
+            # no transport counters. Chip-pending, same convention as
+            # the BENCH_STAGED cpu_smoke markers.
+            g["mesh_model_parallel"] = mi["model_parallel"]
+            g["mesh_devices"] = mi["devices"]
+            g["mesh_collective_bytes"] = 0.0
+        return g
 
     def _leak_check(self) -> Dict:
         """Engine-thread page audit: with no in-flight work, the
@@ -950,6 +968,13 @@ def main(argv=None) -> None:
     parser.add_argument(
         "--draft-window", type=int, default=64,
         help="context window of a --draft-model draft")
+    parser.add_argument(
+        "--mesh", default=None, metavar="model=N",
+        help="tensor-parallel serving mesh: shard weights and KV "
+             "pages over N devices along the model axis "
+             "(distributed/topology.py make_serving_mesh). Greedy "
+             "outputs stay bit-identical to the single-device engine; "
+             "omit for the single-device default")
     args = parser.parse_args(argv)
 
     model = _build_model(args.model)
@@ -966,6 +991,18 @@ def main(argv=None) -> None:
         engine_kwargs["num_pages"] = args.num_pages
     if args.max_seq_len is not None:
         engine_kwargs["max_seq_len"] = args.max_seq_len
+    mesh_desc = "single-device"
+    if args.mesh is not None:
+        from ..distributed.topology import (make_serving_mesh,
+                                            parse_mesh_spec)
+        try:
+            mp = parse_mesh_spec(args.mesh)
+            # mesh= rides in engine_kwargs, so the resurrection recipe
+            # (ServingServer._build_engine) rebuilds onto the SAME mesh
+            engine_kwargs["mesh"] = make_serving_mesh(mp)
+        except ValueError as e:
+            raise SystemExit(f"--mesh: {e}")
+        mesh_desc = f"mesh model={mp}"
     server = ServingServer(model, host=args.host, port=args.port,
                            prefix_cache=not args.no_prefix_cache,
                            num_slots=args.num_slots,
@@ -976,8 +1013,8 @@ def main(argv=None) -> None:
                            speculative=speculative, **engine_kwargs)
     port = server.start()
     print(f"[paddle_tpu.serving] listening on {args.host}:{port} "
-          f"(model {args.model}); newline-JSON, see module docstring",
-          flush=True)
+          f"(model {args.model}, {mesh_desc}); newline-JSON, see "
+          f"module docstring", flush=True)
     try:
         while True:
             time.sleep(3600)

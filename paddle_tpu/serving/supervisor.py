@@ -625,6 +625,12 @@ def main(argv=None) -> None:
     parser.add_argument("--backoff-base-s", type=float, default=0.5)
     parser.add_argument("--log-dir", default=None)
     parser.add_argument(
+        "--mesh", default=None, metavar="model=N",
+        help="tensor-parallel mesh per replica, threaded to every "
+             "replica's server as its --mesh (each replica shards over "
+             "its OWN process-local devices — replicas stay "
+             "independent fault domains)")
+    parser.add_argument(
         "server_args", nargs="*",
         help="extra args passed to every replica's "
              "`python -m paddle_tpu.serving.server` (e.g. "
@@ -640,8 +646,40 @@ def main(argv=None) -> None:
 
     signal.signal(signal.SIGTERM, _sigterm)
 
+    server_args = list(args.server_args)
+    if args.mesh is not None:
+        # validate HERE so a typo fails the supervisor loudly instead
+        # of crash-looping N replicas through spawn/backoff until
+        # wait_ready's ready_timeout_s finally raises
+        from ..distributed.topology import parse_mesh_spec
+        try:
+            mp_degree = parse_mesh_spec(args.mesh)
+        except ValueError as e:
+            raise SystemExit(f"--mesh: {e}")
+        # device-count probe in a SUBPROCESS with the replicas' exact
+        # (inherited) environment: importing jax here would initialize
+        # a backend in the supervisor parent — on exclusive-access
+        # accelerators that could starve the very replicas it spawns.
+        # An inconclusive probe proceeds; the replica surfaces the real
+        # error and wait_ready points at its log.
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(len(jax.devices()))"],
+                capture_output=True, text=True, timeout=120)
+            ndev = int(probe.stdout.strip().splitlines()[-1]) \
+                if probe.returncode == 0 else None
+        except Exception:
+            ndev = None
+        if ndev is not None and mp_degree > ndev:
+            raise SystemExit(
+                f"--mesh model={mp_degree} exceeds the {ndev} "
+                f"device(s) a replica will see; lower the degree or "
+                f"raise the device count (e.g. XLA_FLAGS="
+                f"--xla_force_host_platform_device_count=N for CPU)")
+        server_args += ["--mesh", args.mesh]
     sup = Supervisor(model=args.model, replicas=args.replicas,
-                     host=args.host, server_args=args.server_args,
+                     host=args.host, server_args=server_args,
                      probe_interval_s=args.probe_interval_s,
                      backoff_base_s=args.backoff_base_s,
                      log_dir=args.log_dir)

@@ -31,3 +31,41 @@ import pytest  # noqa: E402
 def rng():
     import numpy as np
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="module")
+def module_compile_cache(tmp_path_factory):
+    """Module-scoped persistent compile cache (core/compile_cache.py)
+    for engine-heavy test files: their tests build fresh engines over
+    the same gpt_tiny program shapes, so without a cache each file
+    pays the same XLA compiles dozens of times — most of its tier-1
+    wall cost. Module scope means one fresh temp-dir cache per
+    requesting file (pytest caches per-module), hermetic and fully
+    detached on teardown. OPT-IN via a module-level autouse fixture —
+    never autouse here: compile-cache unit tests assert the disabled
+    default, and cheap files don't need the toggle."""
+    from paddle_tpu.core.compile_cache import (disable_compile_cache,
+                                               enable_compile_cache)
+    old = os.environ.get("PADDLE_TPU_COMPILE_CACHE")
+    path = str(tmp_path_factory.mktemp("module_compile_cache"))
+    os.environ["PADDLE_TPU_COMPILE_CACHE"] = path
+    enable_compile_cache(path)
+    yield path
+    disable_compile_cache()
+    if old is None:
+        os.environ.pop("PADDLE_TPU_COMPILE_CACHE", None)
+    else:
+        os.environ["PADDLE_TPU_COMPILE_CACHE"] = old
+
+
+@pytest.fixture
+def cpu_mesh_json():
+    """Run a mesh payload in a FRESH subprocess pinned to an N-device
+    CPU host platform (core/cpu_mesh.py): the child prints its result
+    via ``emit_result``; the fixture returns the parsed object. For
+    mesh tests that must not share jax state with this process — the
+    in-process suite is already 8 fake devices (see module top), but a
+    cold subprocess also pins that the XLA_FLAGS plumbing itself works
+    outside the conftest's environment (bench_all, production CLIs)."""
+    from paddle_tpu.core.cpu_mesh import run_cpu_mesh_json
+    return run_cpu_mesh_json

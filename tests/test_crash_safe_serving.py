@@ -46,6 +46,14 @@ def _clean_injector():
     fi.reset()
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _compile_cache(module_compile_cache):
+    """Engine-heavy file: reuse XLA compiles across tests (see
+    conftest.module_compile_cache) — most of this file's tier-1 wall
+    cost is repeated compiles of the same gpt_tiny shapes."""
+    yield
+
+
 @pytest.fixture(scope="module")
 def model():
     pt.seed(0)

@@ -21,6 +21,14 @@ from paddle_tpu.ops.pallas import flash_attention as fa
 from paddle_tpu.ops.pallas import paged_attention as pa
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _compile_cache(module_compile_cache):
+    """Engine-heavy file: reuse XLA compiles across tests (see
+    conftest.module_compile_cache) — the decode-parity and engine
+    tests recompile the same gpt_tiny generate/prefill programs."""
+    yield
+
+
 def _rand_pool(rng, n_pages, page, h, d, dtype=np.float32):
     k = rng.standard_normal((n_pages, page, h, d)).astype(dtype)
     v = rng.standard_normal((n_pages, page, h, d)).astype(dtype)

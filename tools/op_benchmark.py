@@ -72,7 +72,12 @@ def pending_cases():
         lens = np.asarray([128, 112, 96, 80, 64, 48, 32, 16], np.int32)
         return (_f32(8, 1, h, d), kp, vp, table, lens)
 
-    return {"paged_attention": paged}
+    # the SAME shape class dispatched head-sharded over a serving mesh
+    # (min(2, device_count) — the op's benchable default), so the r10
+    # fusion work (ROADMAP item 3) lands against a tensor-parallel
+    # baseline too, not just the single-device kernel
+    return {"paged_attention": paged,
+            "paged_attention_head_sharded": paged}
 
 
 def bench_op(name: str, make_args, repeat: int) -> dict:
