@@ -824,6 +824,181 @@ def bench_mesh_decode(on_tpu: bool) -> Dict:
     return out
 
 
+def bench_chunked_prefill(on_tpu: bool) -> Dict:
+    """Chunked-prefill A/B (r11 tentpole artifact): an ADVERSARIAL
+    arrival trace — steady short INTERACTIVE streams decoding while
+    long BATCH prompts arrive mid-flight — through the same engine
+    with chunked prefill on vs off. Whole-prefill admission runs the
+    long prompt's entire suffix synchronously inside one step, so
+    every in-flight stream sees one giant inter-token gap (the
+    TTFT-vs-TPOT head-of-line stall); chunked admission trickles the
+    prefill in page-aligned chunks between decode steps. Reported:
+    short-stream TPOT p99 (the headline — this is a SCHEDULING
+    property, so the A/B is real on the CPU lane, not chip-pending),
+    TTFT p50/p99 for both classes, and bit_identical across modes
+    (greedy outputs must not change with the schedule). The arrival
+    trace is step-indexed (submissions keyed to completion counts),
+    so both modes see the same schedule."""
+    import paddle_tpu as pt
+    from paddle_tpu.inference import create_decode_engine
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import Priority, SLOScheduler
+
+    if on_tpu:
+        cfg = _decode_1p3b_cfg()
+        slots, page, max_seq = 16, 64, 2048
+        chunk = 256
+        short_len, short_new, n_short = 32, 32, 48
+        long_len, long_new, n_long = 1536, 8, 3
+        inject_at = (8, 20, 32)   # short completions triggering a long
+        concurrency = slots - 1
+    else:
+        cfg = gpt_tiny()
+        slots, page, max_seq = 4, 8, 128
+        chunk = 16
+        short_len, short_new, n_short = 6, 16, 18
+        long_len, long_new, n_long = 96, 4, 2
+        inject_at = (4, 10)
+        concurrency = 3
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        _to_bf16_except_norms(model)
+    model.eval()
+    rng = np.random.default_rng(0)
+    shorts = [rng.integers(0, cfg.vocab_size,
+                           (short_len,)).astype(np.int32)
+              for _ in range(n_short)]
+    longs = [rng.integers(0, cfg.vocab_size,
+                          (long_len,)).astype(np.int32)
+             for _ in range(n_long)]
+
+    def run_trace(chunk_tokens):
+        from paddle_tpu.serving import SLOConfig
+        # shed_after_s=None: the default 30s shed could terminate a
+        # queued long prompt on the chip config — a shed/failed request
+        # never enters the result store this driver polls, which would
+        # wedge the drain loop (it is also not the property under test)
+        eng = create_decode_engine(
+            model, num_slots=slots, page_size=page,
+            max_seq_len=max_seq,
+            scheduler=SLOScheduler(SLOConfig(shed_after_s=None)),
+            prefill_chunk_tokens=chunk_tokens)
+        # warm THIS engine's compiles: one request per distinct
+        # prefill shape (short bucket / long bucket or chunk bucket)
+        # plus the shared decode step, then drain
+        eng.submit(shorts[0][:short_len], max_new_tokens=2)
+        eng.submit(longs[0][:long_len], max_new_tokens=2)
+        eng.run()
+        tok_t: Dict[int, list] = {}
+        submit_t: Dict[int, float] = {}
+
+        def on_token(rid, tok, done):
+            tok_t.setdefault(rid, []).append(time.perf_counter())
+
+        short_rids, long_rids = [], []
+
+        def submit_short(i):
+            rid = eng.submit(shorts[i], max_new_tokens=short_new,
+                             priority=int(Priority.INTERACTIVE),
+                             on_token=on_token)
+            submit_t[rid] = time.perf_counter()
+            short_rids.append(rid)
+
+        def submit_long(j):
+            rid = eng.submit(longs[j], max_new_tokens=long_new,
+                             priority=int(Priority.BATCH),
+                             on_token=on_token)
+            submit_t[rid] = time.perf_counter()
+            long_rids.append(rid)
+
+        t0 = time.perf_counter()
+        for i in range(concurrency):
+            submit_short(i)
+        next_short, next_long = concurrency, 0
+        outputs: Dict[int, list] = {}
+        done_shorts = 0
+        steps = 0
+        while len(outputs) < n_short + n_long:
+            eng.step()
+            steps += 1
+            if steps > 100000:  # engine.run()'s own drain bound
+                raise RuntimeError(
+                    f"trace did not drain: {len(outputs)} of "
+                    f"{n_short + n_long} finished")
+            for rid in short_rids + long_rids:
+                if rid in outputs:
+                    continue
+                res = eng.result(rid, pop=True)
+                if res is None:
+                    continue
+                outputs[rid] = [int(t) for t in res]
+                if rid in short_rids:
+                    done_shorts += 1
+                    # steady stream: a finished short is replaced
+                    if next_short < n_short:
+                        submit_short(next_short)
+                        next_short += 1
+                    # adversarial arrivals keyed to the completion
+                    # count, so both modes see the same trace
+                    while next_long < n_long and \
+                            next_long < len(inject_at) and \
+                            done_shorts >= inject_at[next_long]:
+                        submit_long(next_long)
+                        next_long += 1
+        wall = time.perf_counter() - t0
+        eng.close()  # every exit path returns the pages (r7 contract)
+
+        def pctl(vals, p):
+            # np.percentile for consistency with _serve_latency's
+            # wall-latency stats
+            return float(np.percentile(vals, p))
+
+        gaps = []
+        for rid in short_rids:
+            ts = tok_t.get(rid, [])
+            gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+        ttft_s = [tok_t[r][0] - submit_t[r]
+                  for r in short_rids if tok_t.get(r)]
+        ttft_l = [tok_t[r][0] - submit_t[r]
+                  for r in long_rids if tok_t.get(r)]
+        ordered = [outputs[r] for r in short_rids + long_rids]
+        return {
+            "short_tpot_p50_ms": round(pctl(gaps, 50) * 1e3, 3),
+            "short_tpot_p99_ms": round(pctl(gaps, 99) * 1e3, 3),
+            "short_tpot_max_ms": round(max(gaps) * 1e3, 3),
+            "short_ttft_p50_ms": round(pctl(ttft_s, 50) * 1e3, 3),
+            "short_ttft_p99_ms": round(pctl(ttft_s, 99) * 1e3, 3),
+            "long_ttft_p50_ms": round(pctl(ttft_l, 50) * 1e3, 3),
+            "wall_s": round(wall, 3),
+        }, ordered
+
+    whole, out_whole = run_trace(None)
+    chunked, out_chunked = run_trace(chunk)
+    bit_identical = out_whole == out_chunked
+    better = chunked["short_tpot_p99_ms"] < whole["short_tpot_p99_ms"]
+    return {"metric": "gpt1p3b_chunked_prefill_tpot_chip" if on_tpu
+            else "gpt_tiny_chunked_prefill_cpu_smoke",
+            "unit": "ms", "num_slots": slots, "page_size": page,
+            "prefill_chunk_tokens": chunk,
+            "short": {"len": short_len, "new": short_new,
+                      "count": n_short, "concurrency": concurrency},
+            "long": {"len": long_len, "new": long_new,
+                     "count": n_long, "inject_at": list(inject_at)},
+            "whole_prefill": whole, "chunked_prefill": chunked,
+            "bit_identical": bit_identical,
+            "tpot_p99_improved": better,
+            "note": "scheduling A/B on one engine config: short "
+                    "INTERACTIVE streams decode while long BATCH "
+                    "prompts arrive mid-flight; chunked admission "
+                    "interleaves page-aligned prefill chunks between "
+                    "decode steps instead of stalling every stream "
+                    "behind one whole suffix prefill. TPOT p99 is the "
+                    "headline; greedy outputs pinned bit-identical "
+                    "across modes"}
+
+
 def bench_serving_prefix(on_tpu: bool) -> Dict:
     """Serving-layer A/B (r7 tentpole artifact): a shared-system-prompt
     request stream through the full serving stack — SLO scheduler +
@@ -1347,6 +1522,7 @@ def run_staged(on_tpu: bool) -> Dict:
                      ("decode", bench_decode),
                      ("paged_decode", bench_paged_decode),
                      ("ragged_serving", bench_ragged_serving),
+                     ("chunked_prefill", bench_chunked_prefill),
                      ("mesh_decode", bench_mesh_decode),
                      ("serving_prefix", bench_serving_prefix),
                      ("speculative_decode", bench_speculative_decode),

@@ -50,7 +50,14 @@ each defaults OFF so the bare engine behaves exactly as before):
 
 Request lifecycle: queued → prefill → decoding → done, with the
 off-ramps evicted (close()), shed (scheduler overload) and failed
-(prefill attempts exhausted).
+(prefill attempts exhausted). Chunked-prefill engines
+(``prefill_chunk_tokens``) replace the prefill stage with
+prefill_partial: admission binds pages without prefilling, and each
+step() advances at most one half-prefilled slot by one page-aligned
+chunk through the chained-prefill jit BEFORE the decode step — so
+in-flight decode streams keep ticking while a long prompt trickles in
+(the TTFT-vs-TPOT head-of-line fix; greedy outputs stay bit-identical
+to whole prefill).
 
 Reference analog: the inference engine's multi-stream serving loop
 (`inference/api/analysis_predictor.cc` + TensorRT's enqueue batching),
@@ -227,6 +234,7 @@ class RequestStats:
     prompt_pages: int = 0          # shareable full pages in the prompt
     cache_enabled: bool = False    # a prefix cache was configured
     prefill_attempts: int = 0      # 1 = first try succeeded
+    prefill_chunks: int = 0        # prefill launches (1 = whole prefill)
     spec_steps: int = 0            # verify steps this request rode
     spec_drafted: int = 0          # draft tokens offered to verify
     spec_accepted: int = 0         # draft tokens accepted
@@ -289,8 +297,19 @@ class DecodeRequest:
     generated: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
     done: bool = False
-    # queued|prefill|decoding|done|evicted|shed|failed|deadline|stalled
+    # queued|prefill|prefill_partial|decoding|done|evicted|shed|failed
+    # |deadline|stalled — prefill_partial is the chunked-prefill stage:
+    # the slot holds pages and a PARTIAL prompt KV (prefill_done_len
+    # tokens stored); it rides decode steps masked to the scratch page
+    # until its last chunk lands
     state: str = "queued"
+    # chunked prefill: prompt tokens whose KV is already stored
+    # (prefix-cache hits count — shared pages and prior chunks are the
+    # same "already stored" case); meaningful in prefill_partial
+    prefill_done_len: int = 0
+    # consecutive engine steps this request's next prefill chunk was
+    # deferred by higher-class decode work (scheduler starvation bound)
+    chunk_deferrals: int = 0
     stats: RequestStats = dataclasses.field(default_factory=RequestStats)
     on_token: Optional[Callable[[int, int, bool], None]] = None
     cache_keys: Tuple[Hashable, ...] = ()   # prefix-cache chain refs held
@@ -329,7 +348,8 @@ class ContinuousBatchingEngine:
                  max_prefill_attempts: int = 3,
                  speculative=None, verify_retry="site",
                  stall_timeout_s: Optional[float] = None,
-                 mesh=None):
+                 mesh=None,
+                 prefill_chunk_tokens: Optional[int] = None):
         import jax.numpy as jnp
 
         from ..core.compile_cache import enable_compile_cache
@@ -459,9 +479,43 @@ class ContinuousBatchingEngine:
         # step itself is failing or pathologically slow.
         self.stall_timeout_s = (None if stall_timeout_s is None
                                 else float(stall_timeout_s))
-        # EMA of decode-step wall time: the deadline admission gate's
-        # estimate of whether a request can still finish in time
-        self.step_ema_s: Optional[float] = None
+        # chunked prefill (r11): None = whole-prefill admission (the
+        # byte-for-byte pre-r11 behavior). A positive multiple of
+        # page_size makes admission bind pages WITHOUT prefilling and
+        # each step() advance at most one half-prefilled slot by one
+        # page-aligned chunk of this many tokens (one fixed chunk
+        # bucket -> one prefill compile) before the decode step — so
+        # in-flight streams keep ticking while a long prompt trickles
+        # in instead of stalling behind its whole suffix prefill.
+        self.prefill_chunk_tokens: Optional[int] = None
+        if prefill_chunk_tokens is not None:
+            c = int(prefill_chunk_tokens)
+            if c < self.page_size or c % self.page_size:
+                raise ValueError(
+                    f"prefill_chunk_tokens {c} must be a positive "
+                    f"multiple of page_size {self.page_size} (chunks "
+                    f"are page-aligned so every chunk boundary lands "
+                    f"on a page boundary)")
+            self.prefill_chunk_tokens = c
+        # split EMAs (r11): the deadline admission gate's estimates.
+        # decode_ema_s tracks ONLY the decode/verify jit call;
+        # prefill_chunk_ema_s tracks one fixed-bucket prefill chunk
+        # (constant-cost by construction), so a prefill-heavy step
+        # can't poison the per-token estimate short requests are
+        # gated on. step_ema_s remains as a back-compat alias.
+        self.decode_ema_s: Optional[float] = None
+        self.prefill_chunk_ema_s: Optional[float] = None
+        # chunk-EMA warmup guard (the analog of decode's skip-first-
+        # step rule): the first launch of each chunk-jit variant
+        # (fresh / chained) is compile-dominated — recording it would
+        # make _deadline_hopeless estimate seconds per chunk and shed
+        # every deadline-carrying long prompt until the EMA decayed
+        self._chunk_warm = {False: False, True: False}
+        # engine-wide last-chunk-progress timestamp: the stall
+        # watchdog's liveness signal for half-prefilled slots WAITING
+        # their turn for the single per-step chunk budget (see
+        # evict_stalled)
+        self._last_chunk_t = 0.0
         # speculative decoding (inference/speculative.py): draft k
         # tokens per step, verify all k+1 in ONE forward, emit the
         # longest accepted prefix + 1. Greedy stays bit-identical to
@@ -535,6 +589,30 @@ class ContinuousBatchingEngine:
     @property
     def free_pages(self) -> int:
         return self.allocator.free_count
+
+    @property
+    def step_ema_s(self) -> Optional[float]:
+        """Back-compat alias: r11 split the old blended step EMA into
+        ``decode_ema_s`` (decode/verify jit only) and
+        ``prefill_chunk_ema_s`` (one fixed-bucket prefill chunk)."""
+        return self.decode_ema_s
+
+    @step_ema_s.setter
+    def step_ema_s(self, value: Optional[float]) -> None:
+        self.decode_ema_s = value
+
+    @property
+    def prefill_debt_tokens(self) -> int:
+        """Outstanding prefill work in tokens: the un-stored prompt
+        suffix of every half-prefilled slot plus every queued prompt
+        (an upper bound — future prefix-cache hits may shrink it).
+        The serving layer exports this as the
+        ``serving_prefill_debt_tokens`` gauge."""
+        debt = sum(len(r.prompt) - r.prefill_done_len
+                   for r in self._slots
+                   if r is not None and r.state == "prefill_partial")
+        debt += sum(len(r.prompt) for r in self._queue)
+        return debt
 
     # -- jitted device programs -------------------------------------------
 
@@ -804,6 +882,52 @@ class ContinuousBatchingEngine:
 
         return jax.jit(verify, donate_argnums=(1,))
 
+    def _unwind_prefill_failure(self, slot: int, req: DecodeRequest
+                                ) -> None:
+        """Shared unwind for a FAILED prefill launch — the whole
+        prefill at admission or any chunk of a chunked prefill: free
+        the pages and any speculative reservation, drop the
+        prefix-cache pins, park the slot, and requeue at the head for
+        a from-scratch retry — or FAIL typed once max_prefill_attempts
+        accumulated, so a persistent fault can't wedge the queue head
+        forever. A strict superset of what the whole-prefill path
+        needs (its slot was never committed: lens/cur are still 0 and
+        the _slots entry still None — re-clearing them is a no-op), so
+        both leak-critical paths stay in sync by construction."""
+        self.allocator.free(req.req_id)
+        if self._prefix_cache is not None and req.cache_keys:
+            self._prefix_cache.release(req.cache_keys)
+        req.cache_keys = ()
+        req.prefill_done_len = 0
+        self._table[slot] = self._scratch
+        self._lens[slot] = 0
+        self._cur[slot] = 0
+        self._slots[slot] = None
+        req.slot = None
+        req.stats.prefill_attempts += 1
+        if req.stats.prefill_attempts >= self.max_prefill_attempts:
+            req.state = "failed"
+            req.done = True
+            req.stats.finish_t = time.monotonic()
+            self._notify_complete(req)
+        else:
+            req.state = "queued"
+            self._queue.insert(0, req)
+
+    def _check_pools_live(self, what: str) -> None:
+        """Donated-buffer guard shared by every retrying jit call site
+        (prefill, chunk prefill, verify): if an earlier attempt failed
+        AFTER execution began, the donated pools are gone — a retry
+        would feed the jit dead buffers. Surface a terminal
+        (non-transient) error instead of a confusing backend one."""
+        k0 = self._pools["k"][0]
+        if getattr(k0, "is_deleted", None) is not None \
+                and k0.is_deleted():
+            raise RuntimeError(
+                f"KV pool buffers were consumed by a failed donating "
+                f"{what}; engine state is unrecoverable — rebuild "
+                f"the engine")
+
     # -- scheduler ---------------------------------------------------------
 
     def _bucket(self, n: int) -> int:
@@ -830,15 +954,53 @@ class ContinuousBatchingEngine:
             avail += self._prefix_cache.evictable_pages(excluding=keys)
         return need <= avail
 
+    def _partial_debt_by_class(self) -> Dict[int, int]:
+        """In-flight prefill debt (un-stored suffix tokens of admitted
+        half-prefilled slots) per priority class — the chunk-budget
+        admission gate's input."""
+        out: Dict[int, int] = {}
+        for r in self._slots:
+            if r is not None and r.state == "prefill_partial":
+                rem = len(r.prompt) - r.prefill_done_len
+                out[r.priority] = out.get(r.priority, 0) + rem
+        return out
+
+    def _debt_allows(self, req: DecodeRequest) -> bool:
+        """Per-class prefill-debt admission gate (chunked mode with an
+        SLO scheduler carrying ``max_prefill_debt_tokens``): don't turn
+        every slot into half-prefilled work of one class — a stream of
+        long BATCH prompts is admitted only while the class's in-flight
+        debt stays under the cap. A class with ZERO in-flight debt is
+        always admissible (the cap bounds concurrency, it must never
+        lock a class out entirely)."""
+        if self.prefill_chunk_tokens is None:
+            return True
+        cfg = getattr(self._scheduler, "cfg", None)
+        cap = getattr(cfg, "max_prefill_debt_tokens", None)
+        if cap is None:
+            return True
+        cur = self._partial_debt_by_class().get(req.priority, 0)
+        if cur == 0:
+            return True
+        add = len(req.prompt)
+        if self._prefix_cache is not None:
+            _keys, shared = self._prefix_cache.match(req.prompt,
+                                                     memo=req)
+            add -= len(shared) * self.page_size
+        return cur + add <= cap
+
+    def _admissible(self, req: DecodeRequest) -> bool:
+        return self._fits(req) and self._debt_allows(req)
+
     def _select_next(self) -> Optional[DecodeRequest]:
         if not self._queue:
             return None
         if self._scheduler is not None:
-            idx = self._scheduler.select(self._queue, self._fits,
+            idx = self._scheduler.select(self._queue, self._admissible,
                                          time.monotonic())
             return self._queue.pop(idx) if idx is not None else None
         # built-in FIFO: head or nothing (don't starve the head)
-        if self._fits(self._queue[0]):
+        if self._admissible(self._queue[0]):
             return self._queue.pop(0)
         return None
 
@@ -888,8 +1050,13 @@ class ContinuousBatchingEngine:
         req = self._slots[slot]
         self.allocator.free(req.req_id)
         if self._prefix_cache is not None and req.cache_keys:
+            # for a half-prefilled slot these are the matched chain
+            # pins acquired at admission (insert() never ran); for a
+            # decoding slot, the full inserted chain — release() is
+            # the right unwind for both
             self._prefix_cache.release(req.cache_keys)
             req.cache_keys = ()
+        req.prefill_done_len = 0
         req.state = state
         req.done = True
         req.stats.finish_t = time.monotonic()
@@ -917,16 +1084,35 @@ class ContinuousBatchingEngine:
         and a speculative step emits up to k+1 tokens — overestimating
         here would shed feasible work. Without an EMA yet (cold engine)
         only hard expiry counts: guessing would shed work a fast engine
-        could still serve."""
+        could still serve.
+
+        Chunked mode additionally counts the queued prompt's REMAINING
+        prefill chunks (after its actual memoized prefix-cache match)
+        at the per-chunk EMA — sound because the fixed chunk bucket
+        makes every chunk the same compiled program, so its cost is a
+        constant the EMA tracks, unlike whole prefills whose cost
+        scales with prompt length (which is why the unchunked gate
+        never charged prefill time at all)."""
         if req.deadline_t is None:
             return False
         if now >= req.deadline_t:
             return True
-        if self.step_ema_s is not None:
+        if self.decode_ema_s is not None:
             need = 1 if req.eos_token is not None else req.max_new_tokens
             per_step = 1 if self._spec_cfg is None else self._spec_cfg.k + 1
             steps = -(-need // per_step)
-            return now + steps * self.step_ema_s > req.deadline_t
+            est = steps * self.decode_ema_s
+            if self.prefill_chunk_tokens is not None and \
+                    self.prefill_chunk_ema_s is not None:
+                cached = 0
+                if self._prefix_cache is not None:
+                    _keys, shared = self._prefix_cache.match(req.prompt,
+                                                             memo=req)
+                    cached = len(shared) * self.page_size
+                chunks = -(-(len(req.prompt) - cached)
+                           // self.prefill_chunk_tokens)
+                est += chunks * self.prefill_chunk_ema_s
+            return now + est > req.deadline_t
         return False
 
     def expire_deadlines(self, now: Optional[float] = None
@@ -964,6 +1150,14 @@ class ContinuousBatchingEngine:
             if req is None:
                 continue
             last = max(req.last_emit_t, req.stats.admit_t)
+            if req.state == "prefill_partial":
+                # a half-prefilled slot may be healthily WAITING its
+                # turn for the single per-step chunk budget while
+                # another slot's chunks land — engine-wide chunk
+                # progress is its liveness signal. A broken step stops
+                # landing chunks ANYWHERE, so the timestamp goes stale
+                # and the waiting slot still stalls out typed.
+                last = max(last, self._last_chunk_t)
             if now - last > self.stall_timeout_s:
                 out.append(self._evict_slot(slot, "stalled"))
         return out
@@ -1082,6 +1276,21 @@ class ContinuousBatchingEngine:
         row[:len(shared)] = shared
         row[len(shared):len(shared) + len(pages)] = pages
         self._table[slot] = row
+        if self.prefill_chunk_tokens is not None:
+            # chunked admission (r11): bind the pages, store NOTHING
+            # yet — the suffix is enqueued as page-aligned chunks that
+            # _advance_prefill_chunk trickles in across decode steps.
+            # The slot's stored length is exactly the prefix-cache hit
+            # (shared pages already hold valid KV); matched cache pins
+            # stay on req.cache_keys so every eviction path releases
+            # them, and insert() runs only when the LAST chunk lands.
+            req.state = "prefill_partial"
+            req.prefill_done_len = cached_len
+            req.slot = slot
+            self._lens[slot] = cached_len
+            self._cur[slot] = 0
+            self._slots[slot] = req
+            return True
         suffix = req.prompt[cached_len:]
         bucket = self._bucket(len(suffix))
         ids = np.zeros((1, bucket), np.int32)
@@ -1091,17 +1300,7 @@ class ContinuousBatchingEngine:
 
         def run_prefill():
             from ..distributed.fault_inject import fault_point
-            # donated-buffer guard: if an earlier attempt failed AFTER
-            # execution began, the donated pools are gone — a retry
-            # would feed the jit dead buffers. Surface a terminal
-            # (non-transient) error instead of a confusing backend one.
-            k0 = self._pools["k"][0]
-            if getattr(k0, "is_deleted", None) is not None \
-                    and k0.is_deleted():
-                raise RuntimeError(
-                    "KV pool buffers were consumed by a failed donating "
-                    "prefill; engine state is unrecoverable — rebuild "
-                    "the engine")
+            self._check_pools_live("prefill")
             fault_point("serving.prefill")
             return jit(self._fresh_state(refresh=True), self._pools,
                        jnp.asarray(row[None]),
@@ -1121,33 +1320,17 @@ class ContinuousBatchingEngine:
             # (e.g. a remote-compile transport error on a new prompt
             # bucket, or an exhausted serving.prefill retry) is
             # retryable instead of losing the request and leaking its
-            # pages: free the pages, drop the prefix-cache pins, park
-            # the slot, put the request back at the queue head, then
-            # surface the error. After max_prefill_attempts admission
-            # rounds the request is FAILED instead of requeued, so a
-            # persistent fault can't wedge the queue head forever.
-            # (If the failure hit AFTER execution began, the donated
-            # pool buffers may be gone with it — compile-time
-            # failures, the documented class, leave them untouched.)
-            self.allocator.free(req.req_id)
-            if cache is not None:
-                cache.release(keys)
-                req.cache_keys = ()
-            self._table[slot] = self._scratch
-            req.stats.prefill_attempts += 1
-            if req.stats.prefill_attempts >= self.max_prefill_attempts:
-                req.state = "failed"
-                req.done = True
-                req.stats.finish_t = time.monotonic()
-                self._notify_complete(req)
-            else:
-                req.state = "queued"
-                self._queue.insert(0, req)
+            # pages, then surface the error. (If the failure hit AFTER
+            # execution began, the donated pool buffers may be gone
+            # with it — compile-time failures, the documented class,
+            # leave them untouched.)
+            self._unwind_prefill_failure(slot, req)
             raise
         self._pools = pools
         now = time.monotonic()
         req.stats.prefill_ms = (now - t0) * 1e3
         req.stats.prefill_attempts += 1
+        req.stats.prefill_chunks = 1  # whole prefill = one launch
         if req.deadline_t is not None and now >= req.deadline_t:
             # deadline expired MID-PREFILL: the forward pass is paid
             # for, but delivering a token past the deadline breaks the
@@ -1178,6 +1361,132 @@ class ContinuousBatchingEngine:
                 req.prompt, row, self.allocator, req.req_id,
                 self.page_size, keys)
         self._slots[slot] = req
+        self._emit_token(req, int(nxt))
+        self._maybe_finish(slot)
+        return True
+
+    # -- chunked prefill (r11) ---------------------------------------------
+
+    def _select_chunk_slot(self, partial: List[Tuple[int, DecodeRequest]]
+                           ) -> Optional[int]:
+        """Which half-prefilled slot gets this step's chunk budget.
+        With a scheduler exposing ``select_chunk`` (serving/
+        scheduler.py's chunk-budget policy: INTERACTIVE decode preempts
+        lower-class prefill chunks, bounded deferrals), defer to it;
+        the built-in policy advances the oldest admission (FIFO by
+        req_id). When nothing is decoding there is nothing to preempt,
+        so the scheduler contract requires a pick — the engine would
+        otherwise spin without progress."""
+        sel = getattr(self._scheduler, "select_chunk", None)
+        if sel is not None:
+            decoding = [r for r in self._slots
+                        if r is not None and r.state == "decoding"]
+            return sel(partial, decoding, time.monotonic())
+        return min(partial, key=lambda sr: sr[1].req_id)[0]
+
+    def _advance_prefill_chunk(self) -> bool:
+        """Spend this step's prefill budget: advance AT MOST ONE
+        half-prefilled slot by one page-aligned chunk of
+        ``prefill_chunk_tokens`` tokens through the chained-prefill jit
+        (``cached_len`` = tokens stored so far — shared prefix pages
+        and prior chunks are the same "already stored" case, so the
+        chunk attends everything before it through the paged-attention
+        q_offsets path). The chunk ids are ALWAYS padded to the one
+        fixed chunk bucket, so the engine pays one prefill compile per
+        chained-ness, not one per suffix length. The final chunk's
+        logits produce the first generated token, exactly like a whole
+        prefill. Returns True when a chunk ran."""
+        partial = [(i, r) for i, r in enumerate(self._slots)
+                   if r is not None and r.state == "prefill_partial"]
+        if not partial:
+            return False
+        slot = self._select_chunk_slot(partial)
+        if slot is None:
+            return False  # scheduler deferred: decode preempts
+        jnp = self._jnp
+        req = self._slots[slot]
+        cache = self._prefix_cache
+        chunk = self.prefill_chunk_tokens
+        done = req.prefill_done_len
+        suffix = req.prompt[done:done + chunk]
+        final = done + len(suffix) == len(req.prompt)
+        ids = np.zeros((1, chunk), np.int32)
+        ids[0, :len(suffix)] = suffix
+        # chunk 1 of an uncached prompt keeps the exact dense fresh-
+        # prefill program (chained=False), so a suffix that fits in one
+        # chunk is byte-for-byte the whole-prefill admission
+        chained = done > 0
+        jit = self._get_prefill(chained)
+        row = self._table[slot]
+
+        def run_chunk():
+            from ..distributed.fault_inject import fault_point
+            self._check_pools_live("prefill")
+            fault_point("serving.prefill")
+            return jit(self._fresh_state(refresh=True), self._pools,
+                       jnp.asarray(row[None]),
+                       jnp.asarray([done], jnp.int32),
+                       jnp.asarray([len(suffix)], jnp.int32),
+                       jnp.asarray(ids))
+
+        t0 = time.monotonic()
+        try:
+            if self._prefill_retry is not None:
+                nxt, pools = self._prefill_retry.call(
+                    run_chunk, site="serving.prefill")
+            else:
+                nxt, pools = run_chunk()
+        except Exception:
+            # unwind the WHOLE half-prefilled admission (not just this
+            # chunk) — shared with the whole-prefill failure path
+            self._unwind_prefill_failure(slot, req)
+            raise
+        self._pools = pools
+        now = time.monotonic()
+        req.stats.prefill_ms += (now - t0) * 1e3
+        req.stats.prefill_chunks += 1
+        if self._chunk_warm[chained]:
+            dt = now - t0
+            self.prefill_chunk_ema_s = dt \
+                if self.prefill_chunk_ema_s is None \
+                else 0.8 * self.prefill_chunk_ema_s + 0.2 * dt
+        else:
+            # first launch of this variant: compile-dominated, skip
+            self._chunk_warm[chained] = True
+        req.prefill_done_len = done + len(suffix)
+        self._lens[slot] = req.prefill_done_len
+        # chunk progress is liveness for the stall watchdog: a long
+        # prompt legitimately emits nothing while its chunks land, but
+        # a slot whose chunks stopped landing (step failures) still
+        # stalls out and is evicted typed. The engine-wide timestamp
+        # additionally protects OTHER half-prefilled slots waiting
+        # their turn for the per-step chunk budget.
+        req.last_emit_t = now
+        self._last_chunk_t = now
+        req.chunk_deferrals = 0
+        if req.deadline_t is not None and now >= req.deadline_t:
+            # expired mid-prefill: the chunk is paid for, but delivering
+            # a token past the deadline breaks the contract — evict
+            # typed (pages, reservations and cache pins all return)
+            self._evict_slot(slot, "deadline")
+            return True
+        if not final:
+            return True
+        # last chunk: its logits ARE the whole prefill's logits — emit
+        # the first token and promote the slot to the decode batch
+        req.stats.prefill_attempts += 1
+        req.stats.first_token_t = now
+        self._cur[slot] = int(nxt)
+        req.state = "decoding"
+        req.generated.append(int(nxt))
+        req.stats.tokens_out = 1
+        if cache is not None:
+            # the slot's full prompt pages now hold valid KV — hand
+            # them to the cache (ownership transfer; the matched keys
+            # from admission are the already-acquired chain head)
+            req.cache_keys = cache.insert(
+                req.prompt, row, self.allocator, req.req_id,
+                self.page_size, req.cache_keys)
         self._emit_token(req, int(nxt))
         self._maybe_finish(slot)
         return True
@@ -1258,8 +1567,14 @@ class ContinuousBatchingEngine:
         cfg = self._spec_cfg
         k = cfg.k
         vocab = self.cfg.vocab_size
-        active = [i for i, r in enumerate(self._slots) if r is not None]
-        hist = [None if r is None else r.tokens for r in self._slots]
+        # half-prefilled slots (chunked mode) are NOT verified: their
+        # valid count stays 0, parking their writes on the scratch page
+        # exactly like empty slots, and the draft source sees no
+        # history for them
+        active = [i for i, r in enumerate(self._slots)
+                  if r is not None and r.state == "decoding"]
+        hist = [None if (r is None or r.state != "decoding")
+                else r.tokens for r in self._slots]
         drafts = np.asarray(self._spec_draft.propose(hist, k), np.int32)
         if drafts.shape != (self.num_slots, k):
             raise ValueError(
@@ -1290,15 +1605,7 @@ class ContinuousBatchingEngine:
 
         def run_verify():
             from ..distributed.fault_inject import fault_point
-            # donated-buffer guard — same contract as serving.prefill:
-            # a retry must never feed the jit consumed pools
-            k0 = self._pools["k"][0]
-            if getattr(k0, "is_deleted", None) is not None \
-                    and k0.is_deleted():
-                raise RuntimeError(
-                    "KV pool buffers were consumed by a failed donating "
-                    "verify; engine state is unrecoverable — rebuild "
-                    "the engine")
+            self._check_pools_live("verify")
             fault_point("serving.verify")
             return self._verify_jit(
                 self._fresh_state(), self._pools,
@@ -1348,13 +1655,15 @@ class ContinuousBatchingEngine:
         return self.num_active
 
     def step(self) -> int:
-        """Admit what fits, run ONE fixed-shape decode step (or one
-        draft-and-verify speculative step), evict what finished.
-        Returns the number of still-active slots. The ``engine.step``
-        fault site fires FIRST — before admission and before the
-        donating jit — so an injected step failure leaves host and
-        device state exactly as the previous step left them (the
-        precondition for the serving layer's resurrection replay)."""
+        """Admit what fits, spend the chunked-prefill budget (at most
+        one slot's next chunk), run ONE fixed-shape decode step (or one
+        draft-and-verify speculative step) for every slot past prefill,
+        evict what finished. Returns the number of still-active slots.
+        The ``engine.step`` fault site fires FIRST — before admission
+        and before the donating jit — so an injected step failure
+        leaves host and device state exactly as the previous step left
+        them (the precondition for the serving layer's resurrection
+        replay)."""
         from ..distributed.fault_inject import fault_point
         fault_point("engine.step")
         self.expire_deadlines()
@@ -1362,6 +1671,14 @@ class ContinuousBatchingEngine:
         self._admit()
         if self.num_active == 0:
             return 0
+        if self.prefill_chunk_tokens is not None:
+            self._advance_prefill_chunk()
+        if not any(r is not None and r.state == "decoding"
+                   for r in self._slots):
+            # everything active is still mid-prefill (chunked mode):
+            # no decode step to run; the next step() advances the next
+            # chunk. num_active keeps run() looping.
+            return self.num_active
         t0 = time.monotonic()
         try:
             if self._spec_cfg is not None:
@@ -1370,30 +1687,48 @@ class ContinuousBatchingEngine:
         finally:
             # skip the first step: its wall time is dominated by the
             # one-off decode/prefill compiles and would poison the
-            # deadline gate's estimate for the engine's whole warmup
+            # deadline gate's estimate for the engine's whole warmup.
+            # Only the decode/verify call is timed — chunk prefills
+            # have their own EMA (_advance_prefill_chunk), so a
+            # prefill-heavy step can't poison the per-token estimate.
             if self.steps > 1:
                 dt = time.monotonic() - t0
-                self.step_ema_s = dt if self.step_ema_s is None else \
-                    0.8 * self.step_ema_s + 0.2 * dt
+                self.decode_ema_s = dt if self.decode_ema_s is None \
+                    else 0.8 * self.decode_ema_s + 0.2 * dt
 
     def _decode_step(self) -> int:
         jnp = self._jnp
         if self._decode_jit is None:
             self._decode_jit = self._build_decode()
-        active = np.array([r is not None for r in self._slots])
+        decoding = np.array([r is not None and r.state == "decoding"
+                             for r in self._slots])
+        table, lens = self._table, self._lens
+        if any(r is not None and r.state == "prefill_partial"
+               for r in self._slots):
+            # half-prefilled slots ride the fixed-shape step MASKED to
+            # the scratch page at length 0: their pages hold a partial
+            # prompt whose next position the NEXT chunk owns — the
+            # decode append must not touch it (writes land on scratch,
+            # attention over an empty slot is defined zeros). Host
+            # lens/table keep the real values; only the device call
+            # sees the mask.
+            table = np.where(decoding[:, None], table,
+                             self._scratch).astype(np.int32)
+            lens = np.where(decoding, lens, 0).astype(np.int32)
         nxt, pools, lens_new = self._decode_jit(
             self._fresh_state(), self._pools,
-            jnp.asarray(self._table), jnp.asarray(self._lens),
+            jnp.asarray(table), jnp.asarray(lens),
             jnp.asarray(self._cur))
         self._pools = pools
         nxt = np.asarray(nxt)
-        # inactive slots wrote to the scratch page; pin their length
-        # back to 0 (empty = attends nothing, defined zeros)
-        self._lens = np.where(active, np.asarray(lens_new), 0).astype(
-            np.int32)
+        # non-decoding slots wrote to the scratch page; keep their host
+        # length (0 for empty slots, prefill_done_len for half-
+        # prefilled ones)
+        self._lens = np.where(decoding, np.asarray(lens_new),
+                              self._lens).astype(np.int32)
         self.steps += 1
         for slot, req in enumerate(self._slots):
-            if req is None:
+            if req is None or req.state != "decoding":
                 continue
             tok = int(nxt[slot])
             req.generated.append(tok)

@@ -429,14 +429,18 @@ class GPTAttention(Layer):
           prefix; padded tokens' outputs are discarded by the caller
           and their KV never reaches a real page.
         - s > 1 with ``prefill_len`` AND ``prefill_chained`` (the
-          prefix-cache suffix prefill, serving/prefix_cache.py): the
-          slot STARTS at seq_lens > 0 — page-table entries below that
-          length are shared, already-populated prefix pages — so the
-          ragged right-padded chunk is appended via valid_len and
-          attends the stored prefix PLUS itself through the reference
-          paged attention with q_offsets = old seq_lens. Right-padded
-          query rows produce garbage that the caller discards; their
-          KV lands on the scratch page, never on a shared page.
+          prefix-cache suffix prefill, serving/prefix_cache.py, AND
+          every non-first chunk of the engine's chunked prefill,
+          inference/continuous_batching.py ``prefill_chunk_tokens``):
+          the slot STARTS at seq_lens > 0 — page-table entries below
+          that length hold already-populated KV, whether shared prefix
+          pages or this request's own prior chunks (the same "already
+          stored" case) — so the ragged right-padded chunk is appended
+          via valid_len and attends the stored prefix PLUS itself
+          through the reference paged attention with q_offsets = old
+          seq_lens. Right-padded query rows produce garbage that the
+          caller discards; their KV lands on the scratch page, never
+          on a shared page.
         - s > 1 without ``prefill_len`` (public forward() continuation
           against a possibly NON-empty cache): the reference paged
           attention with per-sequence q_offsets — it attends the full

@@ -38,6 +38,12 @@ RATIO_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
 TOKENS_PER_STEP_BUCKETS = (1.0, 1.25, 1.5, 2.0, 2.5, 3.0, 4.0, 5.0,
                            6.0, 8.0, 12.0, 16.0)
 
+# chunked prefill (r11): per-request prefill launch counts. 1 = whole
+# prefill (or a prompt that fits one chunk); an 8k prompt at a
+# 256-token chunk lands at 32.
+CHUNK_COUNT_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
+                       24.0, 32.0, 48.0, 64.0)
+
 
 class Histogram:
     """Fixed-bucket latency histogram with quantiles over a bounded
@@ -125,7 +131,15 @@ class ServingMetrics:
                 "engine_teardown_leaks_total",
                 "engine_resurrect_failures_total",
                 "deadline_exceeded_total", "stalled_total",
-                "net_recv_drops_total")
+                "net_recv_drops_total",
+                # chunked prefill (r11): prefill launches across every
+                # terminal state (a deadline-evicted half-prefill's
+                # chunks were still compute spent). NOT named
+                # prefill_chunks_total: OpenMetrics reserves the
+                # _total suffix for counter families, which would
+                # collide with the serving_prefill_chunks HISTOGRAM
+                # family on strict parsers.
+                "prefill_chunk_launches_total")
 
     def __init__(self, registry: Optional[StatRegistry] = None,
                  prefix: str = "serving"):
@@ -148,6 +162,13 @@ class ServingMetrics:
         self.spec_tokens_per_step = Histogram(
             f"{prefix}.spec_tokens_per_step",
             buckets=TOKENS_PER_STEP_BUCKETS)
+        # chunked prefill (r11): launches per request and per-chunk
+        # latency (total prefill_ms / chunks — the fixed chunk bucket
+        # makes the mean representative)
+        self.prefill_chunks = Histogram(
+            f"{prefix}.prefill_chunks", buckets=CHUNK_COUNT_BUCKETS)
+        self.prefill_chunk_ms = Histogram(
+            f"{prefix}.prefill_chunk_ms")
 
     def counter(self, name: str):
         return self.registry.get(f"{self.prefix}.{name}")
@@ -164,6 +185,11 @@ class ServingMetrics:
         self.spec_tokens_per_step = Histogram(
             f"{self.prefix}.spec_tokens_per_step",
             buckets=TOKENS_PER_STEP_BUCKETS)
+        self.prefill_chunks = Histogram(
+            f"{self.prefix}.prefill_chunks",
+            buckets=CHUNK_COUNT_BUCKETS)
+        self.prefill_chunk_ms = Histogram(
+            f"{self.prefix}.prefill_chunk_ms")
 
     # -- ingestion ---------------------------------------------------------
 
@@ -186,6 +212,13 @@ class ServingMetrics:
         """Terminal-state hook (engine ``on_complete``)."""
         st = req.stats
         self.counter("requests_total").add()
+        if st.prefill_chunks:
+            # counted for EVERY terminal state: chunks launched for a
+            # later-evicted request were still compute spent (the
+            # chunk histograms below stay done-requests-only so they
+            # describe complete prefills)
+            self.counter("prefill_chunk_launches_total").add(
+                st.prefill_chunks)
         if req.state == "shed":
             self.counter("shed_total").add()
             return
@@ -228,6 +261,11 @@ class ServingMetrics:
             self.queue_delay_ms.observe(st.queue_delay_s * 1e3)
         if st.prefill_ms:
             self.prefill_ms.observe(st.prefill_ms)
+        if st.prefill_chunks:
+            self.prefill_chunks.observe(st.prefill_chunks)
+            if st.prefill_ms:
+                self.prefill_chunk_ms.observe(
+                    st.prefill_ms / st.prefill_chunks)
         if st.finish_t and st.submit_t:
             self.e2e_ms.observe((st.finish_t - st.submit_t) * 1e3)
         if st.spec_steps:
@@ -253,6 +291,8 @@ class ServingMetrics:
             "spec_accept_rate": self.spec_accept_rate.snapshot(),
             "spec_tokens_per_step":
                 self.spec_tokens_per_step.snapshot(),
+            "prefill_chunks": self.prefill_chunks.snapshot(),
+            "prefill_chunk_ms": self.prefill_chunk_ms.snapshot(),
         }
 
     def prometheus_text(self) -> str:
@@ -266,7 +306,8 @@ class ServingMetrics:
         lines: List[str] = []
         for h in (self.ttft_ms, self.tpot_ms, self.queue_delay_ms,
                   self.prefill_ms, self.e2e_ms, self.spec_accept_rate,
-                  self.spec_tokens_per_step):
+                  self.spec_tokens_per_step, self.prefill_chunks,
+                  self.prefill_chunk_ms):
             lines.extend(h.prometheus_lines())
         for name, val in sorted(self.gauges().items()):
             gname = f"{self.prefix}_{name}".replace(".", "_")

@@ -20,6 +20,15 @@ nothing) with a policy that knows about service classes:
   at submit time, beyond ``max_queue`` depth) are rejected with the
   typed `ServerOverloaded` — the server turns it into a structured
   error reply instead of an ever-growing queue of doomed work.
+- **Chunk-budget policy** (r11 chunked prefill): ``select_chunk``
+  decides whether the engine's per-step prefill budget (one chunk of
+  one half-prefilled slot) runs or yields — INTERACTIVE decode steps
+  preempt lower-class prefill chunks so a BATCH 8k-prompt can't dent
+  interactive TPOT, bounded by ``max_chunk_deferrals`` so the prefill
+  still finishes. ``max_prefill_debt_tokens`` caps each class's
+  in-flight half-prefilled debt at admission (the engine's
+  ``_debt_allows`` gate), so a stream of long prompts can't turn every
+  slot into prefill work at once.
 
 The scheduler is duck-typed against the engine
 (``select(queue, fits, now)`` / ``shed(queue, now)``), so the engine
@@ -68,6 +77,15 @@ class SLOConfig:
     # the mandatory next admission
     max_bypass: int = 4
     retry_after_ms: int = 1000
+    # chunked prefill (r11): consecutive engine steps a lower-class
+    # prefill chunk may be deferred by higher-class decode before it
+    # runs anyway (the starvation bound of decode-preempts-prefill)
+    max_chunk_deferrals: int = 4
+    # per-class cap on in-flight half-prefilled debt (tokens) at
+    # admission; None = unbounded. A class with zero in-flight debt is
+    # always admissible (the cap bounds concurrency, never locks a
+    # class out).
+    max_prefill_debt_tokens: Optional[int] = None
 
 
 class SLOScheduler:
@@ -133,6 +151,45 @@ class SLOScheduler:
         for other in queue:
             if other.stats.submit_t < req.stats.submit_t:
                 other.bypass_count += 1
+
+    def select_chunk(self, partial: List, decoding: List,
+                     now: float) -> Optional[int]:
+        """Chunk-budget policy (r11 chunked prefill), called by the
+        engine once per step: ``partial`` is [(slot, request)] for
+        every half-prefilled slot, ``decoding`` the requests past
+        prefill. Returns the slot whose next chunk should run, or None
+        to yield this step's budget to pure decode.
+
+        INTERACTIVE decode preempts lower-class prefill chunks (the
+        step stays a pure decode step, so interactive TPOT never pays
+        for a BATCH prompt's prefill), but only ``max_chunk_deferrals``
+        times in a row — then the chunk runs regardless, so the long
+        prompt still finishes (the bypass-bound idea applied to the
+        prefill budget). With nothing decoding there is nothing to
+        protect: the top-ranked chunk always runs (the engine relies
+        on this for drain progress)."""
+        if not partial:
+            return None
+        ranked = sorted(partial, key=lambda sr: (
+            -self.effective_priority(sr[1], now),
+            getattr(sr[1], "deadline_t", None)
+            if getattr(sr[1], "deadline_t", None) is not None
+            else float("inf"),
+            sr[1].stats.submit_t))
+        slot, req = ranked[0]
+        if not decoding:
+            req.chunk_deferrals = 0
+            return slot
+        top_decode = max(self.effective_priority(r, now)
+                         for r in decoding)
+        if self.effective_priority(req, now) >= top_decode:
+            req.chunk_deferrals = 0
+            return slot
+        req.chunk_deferrals += 1
+        if req.chunk_deferrals > self.cfg.max_chunk_deferrals:
+            req.chunk_deferrals = 0
+            return slot
+        return None
 
     def shed(self, queue: List, now: float) -> List:
         if self.cfg.shed_after_s is None:

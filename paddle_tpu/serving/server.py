@@ -59,6 +59,12 @@ resurrection path); ``alloc.page`` fires in the page allocator
 half-open socket (the failover router resubmits keyed requests).
 
 Run it: ``python -m paddle_tpu.serving.server --model gpt_125m``.
+Chunked prefill: ``--prefill-chunk 256`` admits long prompts without
+stalling in-flight streams — each engine step prefills at most one
+page-aligned 256-token chunk of one admitted prompt before the decode
+step (the TTFT-vs-TPOT head-of-line fix; greedy outputs stay
+bit-identical to whole prefill, and the ``serving_prefill_debt_tokens``
+gauge tracks the outstanding work).
 Speculative decoding: ``--speculate 4`` (n-gram/prompt-lookup draft,
 no second model) or ``--speculate 4 --draft-model gpt_tiny`` (a small
 model drafts; its greedy guesses are verified in one multi-token
@@ -829,8 +835,17 @@ class ServingServer:
                 # layout without a separate query
                 "mesh": mesh_info,
                 "engine_restarts": self._restarts,
-                "step_ema_ms": (None if eng.step_ema_s is None
-                                else round(eng.step_ema_s * 1e3, 3)),
+                # r11 split the EMAs: step_ema_ms stays as the decode
+                # alias for existing probes/dashboards
+                "step_ema_ms": (None if eng.decode_ema_s is None
+                                else round(eng.decode_ema_s * 1e3, 3)),
+                "prefill_chunk_ema_ms": (
+                    None if eng.prefill_chunk_ema_s is None
+                    else round(eng.prefill_chunk_ema_s * 1e3, 3)),
+                # chunked prefill: outstanding prefill tokens (half-
+                # prefilled slots + queue) and the configured chunk
+                "prefill_debt_tokens": eng.prefill_debt_tokens,
+                "prefill_chunk_tokens": eng.prefill_chunk_tokens,
                 "uptime_s": round(time.monotonic() - self._t0, 3)}
 
     def _gauges(self) -> Dict[str, float]:
@@ -845,7 +860,11 @@ class ServingServer:
              "reserved_pages": eng.allocator.reserved_total,
              "prefix_cache_pages":
                  pc.total_pages() if pc is not None else 0,
-             "num_pages": eng.num_pages}
+             "num_pages": eng.num_pages,
+             # chunked prefill (r11): un-stored prompt tokens across
+             # half-prefilled slots + the queue — the head-of-line
+             # pressure a dashboard watches against TPOT
+             "prefill_debt_tokens": eng.prefill_debt_tokens}
         mi = getattr(eng, "mesh_info", lambda: None)()
         if mi is not None:
             # tensor-parallel serving (r10): mesh layout on the scrape
@@ -958,6 +977,15 @@ def main(argv=None) -> None:
         help="evict a slot that emits no token for this long with a "
              "typed RequestStalled reply (default: watchdog off)")
     parser.add_argument(
+        "--prefill-chunk", type=int, default=None, metavar="TOKENS",
+        help="chunked prefill: admit long prompts without stalling "
+             "in-flight streams by prefilling at most this many "
+             "page-aligned tokens per decode step (must be a multiple "
+             "of --page-size; default: whole-prompt prefill). Greedy "
+             "outputs stay bit-identical; smaller chunks protect "
+             "interactive TPOT, larger chunks finish batch prefills "
+             "sooner")
+    parser.add_argument(
         "--speculate", type=int, default=0, metavar="K",
         help="draft K tokens per decode step and verify them in one "
              "forward (0 = off); greedy outputs stay bit-identical")
@@ -991,6 +1019,10 @@ def main(argv=None) -> None:
         engine_kwargs["num_pages"] = args.num_pages
     if args.max_seq_len is not None:
         engine_kwargs["max_seq_len"] = args.max_seq_len
+    if args.prefill_chunk is not None:
+        # rides in engine_kwargs, so the resurrection recipe rebuilds
+        # a chunked engine too
+        engine_kwargs["prefill_chunk_tokens"] = args.prefill_chunk
     mesh_desc = "single-device"
     if args.mesh is not None:
         from ..distributed.topology import (make_serving_mesh,

@@ -631,6 +631,12 @@ def main(argv=None) -> None:
              "its OWN process-local devices — replicas stay "
              "independent fault domains)")
     parser.add_argument(
+        "--prefill-chunk", type=int, default=None, metavar="TOKENS",
+        help="chunked prefill per replica, threaded to every "
+             "replica's server as its --prefill-chunk (page-aligned "
+             "tokens prefilled per decode step; default: whole-prompt "
+             "prefill)")
+    parser.add_argument(
         "server_args", nargs="*",
         help="extra args passed to every replica's "
              "`python -m paddle_tpu.serving.server` (e.g. "
@@ -678,6 +684,8 @@ def main(argv=None) -> None:
                 f"raise the device count (e.g. XLA_FLAGS="
                 f"--xla_force_host_platform_device_count=N for CPU)")
         server_args += ["--mesh", args.mesh]
+    if args.prefill_chunk is not None:
+        server_args += ["--prefill-chunk", str(args.prefill_chunk)]
     sup = Supervisor(model=args.model, replicas=args.replicas,
                      host=args.host, server_args=server_args,
                      probe_interval_s=args.probe_interval_s,

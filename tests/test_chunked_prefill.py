@@ -1,0 +1,580 @@
+"""Chunked prefill (r11): page-sized prefill chunks interleaved into
+the decode loop (inference/continuous_batching.py
+``prefill_chunk_tokens``).
+
+The contracts pinned here (ISSUE r11 acceptance):
+
+- chunked greedy output is BIT-IDENTICAL to whole-prefill for the same
+  request stream — across prefix cache on/off, speculative on/off,
+  int8 KV pages, and mesh= engines (chunking is a SCHEDULE, it must
+  never change tokens);
+- every exit path of a HALF-PREFILLED slot (deadline expiry, stall
+  eviction, chunk-prefill failure, close()) returns all pages AND
+  speculative reservations — zero leaks;
+- resurrection replay of a request killed mid-chunked-prefill is
+  bit-identical to the uninterrupted run;
+- the deadline gate's estimates survive the split: decode_ema_s times
+  only the decode/verify jit, prefill_chunk_ema_s one fixed-bucket
+  chunk, and _deadline_hopeless counts a queued long prompt's
+  remaining chunks.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.monitor import StatRegistry
+from paddle_tpu.distributed import fault_inject as fi
+from paddle_tpu.inference import SpeculativeConfig, create_decode_engine
+from paddle_tpu.inference.continuous_batching import (DecodeRequest,
+                                                      RequestStats)
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+from paddle_tpu.serving import (Priority, PrefixCache, ServingMetrics,
+                                ServingServer, SLOConfig, SLOScheduler,
+                                client_request)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _compile_cache(module_compile_cache):
+    """Engine-heavy file: reuse XLA compiles across tests (see
+    conftest.module_compile_cache) — most of this file's tier-1 wall
+    cost is repeated compiles of the same gpt_tiny shapes."""
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+# gpt_tiny max_seq_len is 128: long enough for multi-chunk prompts
+ENGINE_KW = dict(num_slots=3, page_size=8, max_seq_len=128)
+
+
+def _engine(m, **kw):
+    merged = dict(ENGINE_KW)
+    merged.update(kw)
+    return create_decode_engine(m, **merged)
+
+
+def _prompts(rng=None, lens=(5, 21, 40, 13, 33)):
+    rng = rng or np.random.default_rng(0)
+    return [rng.integers(0, 1024, (n,)).astype(np.int32) for n in lens]
+
+
+def _run_stream(m, prompts, max_new=10, **kw):
+    eng = _engine(m, **kw)
+    rids = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    out = eng.run()
+    res = [out[r] for r in rids]
+    eng.close()
+    if kw.get("prefix_cache") is None:
+        eng.allocator.check_no_leak()
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: bit-identity across chunked vs whole prefill
+# ---------------------------------------------------------------------------
+
+class TestChunkedBitIdentity:
+    def test_plain_bit_identical_across_chunk_sizes(self, model):
+        """The acceptance pin: same stream, chunked (several sizes,
+        aligned and not with prompt lengths) vs whole — greedy tokens
+        match bit for bit. More requests than slots so recycling and
+        mid-flight admission are live."""
+        prompts = _prompts()
+        whole = _run_stream(model, prompts)
+        # one page-sized chunk and one that is NOT a divisor of the
+        # prompt lengths (ragged final chunks) — a third size adds an
+        # engine run without a new boundary class
+        for chunk in (8, 16):
+            chunked = _run_stream(model, prompts,
+                                  prefill_chunk_tokens=chunk)
+            for a, b in zip(whole, chunked):
+                np.testing.assert_array_equal(a, b)
+
+    def test_single_chunk_matches_whole_prefill_exactly(self, model):
+        """A suffix that fits one chunk takes the same fresh dense
+        prefill program as whole-prefill admission (chained=False) —
+        the degenerate case is byte-for-byte, not just bit-identical
+        tokens."""
+        prompts = _prompts(lens=(5, 9, 13))
+        whole = _run_stream(model, prompts)
+        chunked = _run_stream(model, prompts, prefill_chunk_tokens=16)
+        for a, b in zip(whole, chunked):
+            np.testing.assert_array_equal(a, b)
+
+    def test_prefix_cache_bit_identical(self, model):
+        """Chunked + prefix cache vs whole + no cache: shared prefix
+        pages and prior chunks are the same "already stored" case, so
+        crossing them must not change tokens. The cache must actually
+        hit (insert runs at the LAST chunk)."""
+        shared = (np.arange(19, dtype=np.int32) * 5) % 100
+        prompts = [np.concatenate(
+            [shared, (np.arange(t, dtype=np.int32) + 3 * t) % 100])
+            for t in (3, 6, 9, 26)]
+        whole = _run_stream(model, prompts, max_new=12)
+        pc = PrefixCache(8)
+        eng = _engine(model, prefix_cache=pc, prefill_chunk_tokens=16)
+        rids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        out = eng.run()
+        assert pc.hit_pages > 0
+        for r, ref in zip(rids, whole):
+            np.testing.assert_array_equal(out[r], ref)
+        eng.close()
+        eng.allocator.check_no_leak()
+
+    def test_chunk_boundary_against_shared_prefix(self, model):
+        """Chunk-boundary/prefix-cache interaction pin: the cached
+        prefix length (page-aligned, NOT chunk-aligned) shifts every
+        later chunk boundary — e.g. an 8-token hit with 16-token chunks
+        ends the first chained chunk mid of what a fresh prefill would
+        have made its first chunk, and the suffix ends mid-shared-block
+        of the longer prompt that seeded the cache. Tokens must still
+        match the uncached whole-prefill engine."""
+        base = (np.arange(40, dtype=np.int32) * 7) % 100
+        # second prompt shares 11 tokens: one full page cached (8),
+        # divergence INSIDE the second block
+        prompts = [base,
+                   np.concatenate([base[:11],
+                                   (np.arange(13, dtype=np.int32)
+                                    + 50) % 100]),
+                   base[:33]]  # re-hits several cached blocks
+        whole = _run_stream(model, prompts, max_new=8)
+        pc = PrefixCache(8)
+        eng = _engine(model, prefix_cache=pc, prefill_chunk_tokens=16)
+        outs = []
+        for p in prompts:  # sequential so later prompts hit the cache
+            rid = eng.submit(p, max_new_tokens=8)
+            outs.append(eng.run()[rid])
+        assert pc.hit_pages > 0
+        for got, ref in zip(outs, whole):
+            np.testing.assert_array_equal(got, ref)
+        eng.close()
+
+    def test_speculative_bit_identical(self, model):
+        prompts = _prompts(lens=(5, 21, 40))
+        whole = _run_stream(model, prompts,
+                            speculative=SpeculativeConfig(k=3))
+        chunked = _run_stream(model, prompts,
+                              speculative=SpeculativeConfig(k=3),
+                              prefill_chunk_tokens=16)
+        for a, b in zip(whole, chunked):
+            np.testing.assert_array_equal(a, b)
+
+    def test_int8_bit_identical(self, model):
+        prompts = _prompts(lens=(5, 21, 40))
+        whole = _run_stream(model, prompts, kv_int8=True)
+        chunked = _run_stream(model, prompts, kv_int8=True,
+                              prefill_chunk_tokens=16)
+        for a, b in zip(whole, chunked):
+            np.testing.assert_array_equal(a, b)
+
+    def test_mesh_bit_identical(self, model):
+        """2-way serving mesh (the in-process suite is 8 fake CPU
+        devices): chunked-vs-whole on the mesh AND chunked mesh vs
+        chunked single-device."""
+        from paddle_tpu.distributed.topology import make_serving_mesh
+        mesh = make_serving_mesh(2)
+        prompts = _prompts(lens=(5, 21, 40))
+        whole = _run_stream(model, prompts, max_new=6, mesh=mesh)
+        chunked = _run_stream(model, prompts, max_new=6, mesh=mesh,
+                              prefill_chunk_tokens=16)
+        # (mesh==single-device is already pinned for the unchunked
+        # engine in test_mesh_serving; chunked==whole on the mesh plus
+        # chunked==whole single-device above closes the square)
+        for a, b in zip(whole, chunked):
+            np.testing.assert_array_equal(a, b)
+
+    def test_invalid_chunk_size_rejected(self, model):
+        with pytest.raises(ValueError, match="multiple of page_size"):
+            _engine(model, prefill_chunk_tokens=12)  # page_size is 8
+        with pytest.raises(ValueError, match="multiple of page_size"):
+            _engine(model, prefill_chunk_tokens=0)
+
+
+# ---------------------------------------------------------------------------
+# Half-prefilled slot lifecycle: every exit path returns everything
+# ---------------------------------------------------------------------------
+
+class TestHalfPrefilledLifecycle:
+    def _partial_engine(self, model, **kw):
+        """One step in: the long prompt is admitted and exactly one
+        chunk has landed (state prefill_partial)."""
+        done = []
+        eng = _engine(model, prefill_chunk_tokens=16,
+                      on_complete=done.append, **kw)
+        long_p = (np.arange(96, dtype=np.int32) * 3) % 100
+        rid = eng.submit(long_p, max_new_tokens=4)
+        eng.step()
+        req = next(r for r in eng._slots if r is not None)
+        assert req.req_id == rid
+        assert req.state == "prefill_partial"
+        assert 0 < req.prefill_done_len < len(long_p)
+        return eng, req, done
+
+    def test_deadline_expiry_returns_pages(self, model):
+        eng, req, done = self._partial_engine(model)
+        req.deadline_t = time.monotonic() - 1.0
+        expired = eng.expire_deadlines()
+        assert [r.req_id for r in expired] == [req.req_id]
+        assert req.state == "deadline" and req.done
+        assert req.stats.tokens_out == 0
+        assert done and done[0] is req
+        eng.allocator.check_no_leak()
+
+    def test_deadline_expiry_spec_reservations_returned(self, model):
+        """Speculative admission binds prefill pages and RESERVES the
+        decode capacity — a half-prefilled eviction must drop both."""
+        eng, req, _done = self._partial_engine(
+            model, speculative=SpeculativeConfig(k=3))
+        assert eng.allocator.reserved(req.req_id) > 0
+        req.deadline_t = time.monotonic() - 1.0
+        eng.expire_deadlines()
+        assert req.state == "deadline"
+        assert eng.allocator.reserved_total == 0
+        eng.allocator.check_no_leak()
+
+    def test_stall_eviction_half_prefilled(self, model):
+        """A half-prefilled slot whose chunks stopped landing (broken
+        step) stalls out typed; chunk progress itself refreshes the
+        watchdog, so a healthy multi-chunk prefill never trips it."""
+        eng, req, done = self._partial_engine(model,
+                                              stall_timeout_s=30.0)
+        # healthy: the chunk that just landed counts as liveness
+        assert eng.evict_stalled() == []
+        out = eng.evict_stalled(now=req.last_emit_t + 31.0)
+        assert [r.req_id for r in out] == [req.req_id]
+        assert req.state == "stalled"
+        eng.allocator.check_no_leak()
+
+    def test_waiting_partial_not_stalled_while_chunks_land(self, model):
+        """Two half-prefilled slots share the ONE per-step chunk
+        budget: the slot waiting its turn emits nothing for as long as
+        the first slot's chunks take, but engine-wide chunk progress
+        counts as its liveness — it must NOT be evicted as stalled
+        while the engine is healthy, and MUST once chunks stop landing
+        anywhere."""
+        eng = _engine(model, prefill_chunk_tokens=16,
+                      stall_timeout_s=30.0)
+        long_p = (np.arange(96, dtype=np.int32) * 3) % 100
+        eng.submit(long_p, max_new_tokens=4)
+        rid_b = eng.submit((long_p + 1) % 100, max_new_tokens=4)
+        eng.step()  # both admitted; only the FIRST slot gets a chunk
+        b = next(r for r in eng._slots
+                 if r is not None and r.req_id == rid_b)
+        assert b.state == "prefill_partial" and b.prefill_done_len == 0
+        # b was admitted "long ago" but a chunk just landed engine-wide
+        b.stats.admit_t -= 100.0
+        assert eng.evict_stalled() == []
+        # chunks stopped landing anywhere: now b stalls out typed
+        out = eng.evict_stalled(now=eng._last_chunk_t + 31.0)
+        assert rid_b in [r.req_id for r in out]
+        eng.close()
+        eng.allocator.check_no_leak()
+
+    def test_close_mid_prefill(self, model):
+        eng, req, _done = self._partial_engine(model)
+        eng.close()  # asserts check_no_leak itself
+        assert req.state == "evicted"
+
+    def test_deadline_with_prefix_cache_pins_released(self, model):
+        """Half-prefilled eviction releases the MATCHED chain pins
+        acquired at admission (insert never ran), so the cached entries
+        become evictable again — and the books balance."""
+        pc = PrefixCache(8)
+        eng = _engine(model, prefix_cache=pc, prefill_chunk_tokens=16)
+        seed = (np.arange(40, dtype=np.int32) * 3) % 100
+        eng.submit(seed, max_new_tokens=2)
+        eng.run()  # populate the cache
+        assert pc.total_pages() > 0
+        rid = eng.submit(np.concatenate([seed, seed[:30] + 1]),
+                         max_new_tokens=4)
+        eng.step()
+        req = next(r for r in eng._slots if r is not None)
+        assert req.req_id == rid and req.state == "prefill_partial"
+        assert req.cache_keys  # matched pins held
+        req.deadline_t = time.monotonic() - 1.0
+        eng.expire_deadlines()
+        assert req.state == "deadline" and req.cache_keys == ()
+        assert pc.evictable_pages() == pc.total_pages()
+        pc.check_consistent(eng.allocator)
+        eng.close()
+
+    def test_chunk_failure_unwinds_and_fails_typed(self, model):
+        """A persistent serving.prefill fault mid-chunks: each failed
+        chunk unwinds the WHOLE half-prefilled admission (pages, pins,
+        slot) and requeues; after max_prefill_attempts the request
+        fails typed — never a wedge, never a leak."""
+        done = []
+        eng = _engine(model, prefill_chunk_tokens=16,
+                      max_prefill_attempts=3, on_complete=done.append)
+        long_p = (np.arange(96, dtype=np.int32) * 3) % 100
+        eng.submit(long_p, max_new_tokens=4)
+        fi.get_injector().arm("serving.prefill", probability=1.0)
+        for _ in range(3):
+            with pytest.raises(fi.InjectedFault):
+                eng.step()
+        assert done and done[0].state == "failed"
+        assert done[0].stats.prefill_attempts == 3
+        assert eng.num_active == 0 and eng.num_queued == 0
+        eng.allocator.check_no_leak()
+
+    def test_failure_after_progress_restarts_from_scratch(self, model):
+        """A fault on a LATER chunk unwinds everything: the retry
+        re-prefills from token 0 and the output still matches the
+        clean run (no half-stored state survives the unwind)."""
+        long_p = (np.arange(70, dtype=np.int32) * 3) % 100
+        ref = _run_stream(model, [long_p], max_new=6)[0]
+        eng = _engine(model, prefill_chunk_tokens=16)
+        rid = eng.submit(long_p, max_new_tokens=6)
+        eng.step()  # chunk 1 lands
+        # arm() restarts the site's call count: the NEXT chunk is call 1
+        fi.get_injector().arm("serving.prefill", at_calls=[1])
+        with pytest.raises(fi.InjectedFault):
+            eng.step()  # chunk 2 faults -> full unwind + requeue
+        req_states = [r for r in eng._slots if r is not None]
+        assert req_states == [] and eng.num_queued == 1
+        out = eng.run()
+        np.testing.assert_array_equal(out[rid], ref)
+        eng.close()
+        eng.allocator.check_no_leak()
+
+
+# ---------------------------------------------------------------------------
+# Resurrection: replay of a request killed mid-chunked-prefill
+# ---------------------------------------------------------------------------
+
+class TestResurrectionMidChunk:
+    def test_replay_mid_chunked_prefill_bit_identical(self, model):
+        """engine.step dies right after the long prompt's first chunks
+        landed; resurrection rebuilds a CHUNKED engine (the recipe
+        carries prefill_chunk_tokens) and replays from the prompt —
+        the client sees one uninterrupted bit-identical generation."""
+        kw = dict(num_slots=2, page_size=8, max_seq_len=128,
+                  prefill_chunk_tokens=16)
+        long_p = [int(x) for x in (np.arange(60) * 3) % 100]
+        short_p = [int(x) for x in (np.arange(7) * 5) % 100]
+
+        def serve(arm):
+            fi.reset()
+            if arm:
+                # steps 2 and 3: the long prompt is mid-chunks (its
+                # prefill needs 4 chunks), the short already decoding
+                fi.get_injector().arm("engine.step", at_calls=[2, 3])
+            met = ServingMetrics(registry=StatRegistry())
+            srv = ServingServer(model, metrics=met, max_engine_errors=2,
+                                prefix_cache=False, **kw)
+            port = srv.start()
+            try:
+                out = {}
+                import threading
+                def req(name, prompt):
+                    out[name] = client_request(
+                        "127.0.0.1", port,
+                        {"op": "generate", "prompt": prompt,
+                         "max_new_tokens": 8})
+                ts = [threading.Thread(target=req, args=a)
+                      for a in (("short", short_p), ("long", long_p))]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=120)
+                restarts = met.counter("engine_restarts_total").get()
+                replays = met.counter("replayed_requests_total").get()
+            finally:
+                srv.stop()
+            return out, restarts, replays
+
+        clean, r0, _ = serve(arm=False)
+        crashed, r1, replayed = serve(arm=True)
+        assert r0 == 0 and r1 == 1 and replayed >= 1
+        for name in ("short", "long"):
+            assert clean[name].get("done") and crashed[name].get("done")
+            assert crashed[name]["tokens"] == clean[name]["tokens"]
+
+
+# ---------------------------------------------------------------------------
+# Split EMAs + chunk-aware deadline gate (satellite)
+# ---------------------------------------------------------------------------
+
+class TestSplitEmas:
+    def test_both_emas_populate_and_alias(self, model):
+        eng = _engine(model, prefill_chunk_tokens=16)
+        eng.submit((np.arange(40, dtype=np.int32) * 3) % 100,
+                   max_new_tokens=6)
+        eng.run()
+        assert eng.decode_ema_s is not None
+        assert eng.prefill_chunk_ema_s is not None
+        # back-compat alias both ways (server health + old tests)
+        assert eng.step_ema_s == eng.decode_ema_s
+        eng.step_ema_s = 0.123
+        assert eng.decode_ema_s == 0.123
+        eng.close()
+
+    def test_chunk_ema_skips_compile_dominated_first_launches(self,
+                                                              model):
+        """The first launch of each chunk-jit variant (fresh/chained)
+        is compile-dominated and must NOT seed prefill_chunk_ema_s —
+        a poisoned per-chunk estimate would make the deadline gate
+        shed every feasible long prompt for the engine's whole warmup
+        (the same rule decode's EMA already follows)."""
+        eng = _engine(model, prefill_chunk_tokens=16)
+        eng.submit((np.arange(96, dtype=np.int32) * 3) % 100,
+                   max_new_tokens=2)
+        eng.step()  # chunk 1: fresh-variant compile — skipped
+        assert eng.prefill_chunk_ema_s is None
+        eng.step()  # chunk 2: chained-variant compile — skipped
+        assert eng.prefill_chunk_ema_s is None
+        eng.step()  # chunk 3: warm chained launch — recorded
+        assert eng.prefill_chunk_ema_s is not None
+        # and the recorded sample is a warm launch, not seconds of
+        # compile (generous bound: a gpt_tiny chunk is milliseconds)
+        assert eng.prefill_chunk_ema_s < 1.0
+        eng.run()
+        eng.close()
+
+    def test_hopeless_gate_counts_remaining_chunks(self, model):
+        """A queued long prompt that provably cannot prefill AND
+        decode before its deadline is shed at admission; a short one
+        under the same deadline is admitted — the per-chunk estimate
+        no longer lets one long prefill poison every short request
+        (nor vice versa)."""
+        done = []
+        eng = _engine(model, prefill_chunk_tokens=16,
+                      on_complete=done.append)
+        eng.decode_ema_s = 0.01
+        eng.prefill_chunk_ema_s = 0.05
+        now = time.monotonic()
+        long_p = (np.arange(96, dtype=np.int32) * 3) % 100
+        # 6 chunks * 50ms + 4 steps * 10ms = 340ms > 250ms -> hopeless.
+        # WITHOUT chunk counting the estimate would be 40ms and this
+        # doomed prefill would be admitted (the pre-r11 bug class).
+        eng.submit(long_p, max_new_tokens=4, deadline_t=now + 0.25)
+        # same estimates, one chunk: 90ms — admitted (generous real
+        # deadline so wall-clock compile time can't expire it mid-run)
+        rid_s = eng.submit(np.arange(9, dtype=np.int32),
+                           max_new_tokens=4, deadline_t=now + 30.0)
+        eng.step()
+        assert [r.state for r in done] == ["deadline"]
+        assert eng.num_active == 1
+        out = eng.run()
+        assert len(out[rid_s]) == 9 + 4
+        eng.close()
+        eng.allocator.check_no_leak()
+
+
+# ---------------------------------------------------------------------------
+# Chunk-budget policy + prefill debt (scheduler satellite)
+# ---------------------------------------------------------------------------
+
+def _mk_req(rid, priority, submit_t):
+    r = DecodeRequest(rid, np.arange(8, dtype=np.int32), 4,
+                      priority=int(priority))
+    r.stats = RequestStats(submit_t=submit_t)
+    return r
+
+
+class TestChunkPolicy:
+    def test_interactive_decode_preempts_batch_chunk(self):
+        sched = SLOScheduler(SLOConfig(promote_after_s=1e9,
+                                       max_chunk_deferrals=3))
+        batch = _mk_req(0, Priority.BATCH, submit_t=0.0)
+        inter = _mk_req(1, Priority.INTERACTIVE, submit_t=0.0)
+        # deferred while interactive work decodes ...
+        for _ in range(3):
+            assert sched.select_chunk([(0, batch)], [inter], 0.0) is None
+        # ... but the starvation bound forces the chunk through
+        assert sched.select_chunk([(0, batch)], [inter], 0.0) == 0
+        assert batch.chunk_deferrals == 0  # reset on grant
+
+    def test_equal_or_higher_class_chunk_runs_immediately(self):
+        sched = SLOScheduler(SLOConfig(promote_after_s=1e9))
+        inter = _mk_req(0, Priority.INTERACTIVE, submit_t=0.0)
+        batch = _mk_req(1, Priority.BATCH, submit_t=0.0)
+        assert sched.select_chunk([(2, inter)], [batch], 0.0) == 2
+        # nothing decoding: nothing to protect, top chunk runs
+        assert sched.select_chunk([(2, batch)], [], 0.0) == 2
+
+    def test_ranking_prefers_higher_class_partial(self):
+        sched = SLOScheduler(SLOConfig(promote_after_s=1e9))
+        batch = _mk_req(0, Priority.BATCH, submit_t=0.0)
+        inter = _mk_req(1, Priority.INTERACTIVE, submit_t=1.0)
+        assert sched.select_chunk([(0, batch), (1, inter)], [], 0.0) == 1
+
+
+class TestPrefillDebt:
+    def test_debt_gauge_and_per_class_cap(self, model):
+        """With max_prefill_debt_tokens, a second long BATCH prompt
+        stays QUEUED while the first one's half-prefilled debt is
+        outstanding (slots are not all turned into prefill work), yet
+        both finish with correct outputs."""
+        sched = SLOScheduler(SLOConfig(promote_after_s=1e9,
+                                       shed_after_s=None,
+                                       max_prefill_debt_tokens=100))
+        eng = _engine(model, scheduler=sched, prefill_chunk_tokens=16)
+        rng = np.random.default_rng(3)
+        a = rng.integers(0, 1024, (96,)).astype(np.int32)
+        b = rng.integers(0, 1024, (96,)).astype(np.int32)
+        ra = eng.submit(a, max_new_tokens=4, priority=Priority.BATCH)
+        rb = eng.submit(b, max_new_tokens=4, priority=Priority.BATCH)
+        assert eng.prefill_debt_tokens == 192
+        eng.step()
+        partial = [r for r in eng._slots if r is not None]
+        assert [r.req_id for r in partial] == [ra]
+        assert eng.num_queued == 1  # b gated on a's outstanding debt
+        assert eng.prefill_debt_tokens < 192
+        out = eng.run()
+        assert eng.prefill_debt_tokens == 0
+        ref = _run_stream(model, [a, b], max_new=4)
+        np.testing.assert_array_equal(out[ra], ref[0])
+        np.testing.assert_array_equal(out[rb], ref[1])
+        eng.close()
+        eng.allocator.check_no_leak()
+
+
+# ---------------------------------------------------------------------------
+# Server integration: CLI kwarg, stats, debt gauge on the wire
+# ---------------------------------------------------------------------------
+
+class TestServerChunked:
+    def test_server_chunked_request_and_observability(self, model):
+        met = ServingMetrics(registry=StatRegistry())
+        srv = ServingServer(model, metrics=met, num_slots=2,
+                            page_size=8, max_seq_len=128,
+                            prefill_chunk_tokens=16)
+        port = srv.start()
+        try:
+            prompt = [int(x) for x in (np.arange(60) * 3) % 100]
+            r = client_request("127.0.0.1", port,
+                               {"op": "generate", "prompt": prompt,
+                                "max_new_tokens": 6})
+            assert r.get("done")
+            assert r["stats"]["prefill_chunks"] == 4  # ceil(60/16)
+            h = client_request("127.0.0.1", port, {"op": "health"})
+            assert h["prefill_chunk_tokens"] == 16
+            assert h["prefill_debt_tokens"] == 0
+            assert h["prefill_chunk_ema_ms"] is not None
+            m = client_request("127.0.0.1", port, {"op": "metrics"})
+            assert "serving_prefill_debt_tokens" in m["text"]
+            assert "serving_prefill_chunks_bucket" in m["text"]
+            assert "serving_prefill_chunk_launches_total" in m["text"]
+            lc = client_request("127.0.0.1", port, {"op": "leak_check"})
+            assert lc["ok"]
+        finally:
+            srv.stop()
+        assert met.prefill_chunks.total == 1
+        assert met.counter("prefill_chunk_launches_total").get() == 4

@@ -60,7 +60,12 @@ def pending_cases():
     which platform is missing and why). Kept OUT of default_cases() so
     test_op_benchmark_gate's completeness check over the committed
     baseline dirs stays exact; the gate covers these via the
-    *_pending baseline dirs instead."""
+    *_pending baseline dirs instead.
+
+    A case whose name is not itself a registered op (a named SHAPE
+    CLASS of one, e.g. prefill_chunk_step) carries the op on its
+    builder's ``op_name`` attribute — bench_op and the gate test
+    resolve through it."""
     def paged():
         # decode-shaped ragged paged attention: 8 sequences, 16-token
         # pages, ragged lengths spanning 1..8 pages (the kernel-contract
@@ -72,12 +77,34 @@ def pending_cases():
         lens = np.asarray([128, 112, 96, 80, 64, 48, 32, 16], np.int32)
         return (_f32(8, 1, h, d), kp, vp, table, lens)
 
-    # the SAME shape class dispatched head-sharded over a serving mesh
-    # (min(2, device_count) — the op's benchable default), so the r10
-    # fusion work (ROADMAP item 3) lands against a tensor-parallel
-    # baseline too, not just the single-device kernel
+    def prefill_chunk():
+        # one chained prefill chunk (r11 chunked prefill / chained
+        # suffix prefill hot shape): a 64-token chunk appended at
+        # position 128 attends the stored 128-token prefix plus itself
+        # through the q_offsets path — seq_lens is the POST-append
+        # length, q_offsets the chunk's first absolute position. The
+        # r11+ fusion work (ROADMAP item 3) must land against this
+        # mixed prefill+decode shape class, not just s=1 decode.
+        n_pages, page, h, d = 65, 16, 8, 64
+        done, chunk = 128, 64
+        kp = _f32(n_pages, page, h, d)
+        vp = _f32(n_pages, page, h, d)
+        table = np.arange(12, dtype=np.int32).reshape(1, 12)
+        lens = np.asarray([done + chunk], np.int32)
+        q_offsets = np.asarray([done], np.int32)
+        # positional tail (k_scale, v_scale, scale) stays None-static
+        return (_f32(1, chunk, h, d), kp, vp, table, lens,
+                None, None, None, q_offsets)
+
+    prefill_chunk.op_name = "paged_attention"
+
+    # paged twice: the SAME decode shape class dispatched head-sharded
+    # over a serving mesh (min(2, device_count) — the op's benchable
+    # default), so the r10 fusion work (ROADMAP item 3) lands against
+    # a tensor-parallel baseline too, not just the single-device kernel
     return {"paged_attention": paged,
-            "paged_attention_head_sharded": paged}
+            "paged_attention_head_sharded": paged,
+            "prefill_chunk_step": prefill_chunk}
 
 
 def bench_op(name: str, make_args, repeat: int) -> dict:
@@ -85,7 +112,9 @@ def bench_op(name: str, make_args, repeat: int) -> dict:
 
     from paddle_tpu.ops.registry import get_op
 
-    fn = get_op(name).fn
+    # a case may be a named shape class of another op (see
+    # pending_cases): the builder's op_name attribute wins
+    fn = get_op(getattr(make_args, "op_name", name)).fn
     full_args = make_args()
     # only array(-list) args are traced; shapes/perm tuples stay static
     is_arr = [isinstance(a, np.ndarray) or
