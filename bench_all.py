@@ -678,6 +678,94 @@ def bench_ragged_serving(on_tpu: bool) -> Dict:
                     "recycling; tokens/s counts generated tokens only"}
 
 
+def bench_fused_decode(on_tpu: bool) -> Dict:
+    """Fused decode hot path A/B (r13, ROADMAP item 3): the
+    ragged_serving request stream through the SAME engine twice —
+    ``fused_step=True`` (attention + out-projection folded into one
+    kernel per layer, sampling streamed through the lm_head so the
+    [B, vocab] logits never hit HBM) vs ``False`` (the pre-r13
+    programs). Reports tokens/s for both, programs-per-step from the
+    dispatch launch counter (ops traced into each step program — the
+    count the fusion exists to shrink), and the bit_identical flag
+    over the full greedy token streams."""
+    import paddle_tpu as pt
+    from paddle_tpu.inference import create_decode_engine
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+    if on_tpu:
+        cfg = _decode_1p3b_cfg()
+        slots, page, max_seq = 32, 64, 1024
+        lens = [64, 96, 128, 192, 256, 384, 512, 640]
+        n_req, new_toks = 64, 64
+    else:
+        cfg = gpt_tiny()
+        slots, page, max_seq = 2, 8, 64
+        lens = [5, 9, 13]
+        n_req, new_toks = 4, 8
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        _to_bf16_except_norms(model)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            (lens[i % len(lens)],)).astype(np.int32)
+               for i in range(n_req)]
+
+    def run_mode(fused: bool) -> Dict:
+        eng = create_decode_engine(model, num_slots=slots,
+                                   page_size=page, max_seq_len=max_seq,
+                                   fused_step=fused)
+        # warm THE MEASURED ENGINE's compiles (per-instance closures;
+        # see bench_ragged_serving) — one request per distinct bucket
+        for p in prompts[:len(lens)]:
+            eng.submit(p, max_new_tokens=2)
+        eng.run()
+        steps_before = eng.steps
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, max_new_tokens=new_toks) for p in prompts]
+        try:
+            results = eng.run()
+        finally:
+            eng.close()
+        wall = time.perf_counter() - t0
+        timed_steps = eng.steps - steps_before
+        n_launches = timed_steps + len(prompts)
+        dt = max(1e-9, wall - n_launches * _floor_ms(on_tpu) / 1e3)
+        gen = sum(len(results[rid]) - len(p)
+                  for rid, p in zip(rids, prompts))
+        return {"tokens_per_s": round(gen / dt, 1),
+                "decode_steps": timed_steps,
+                "programs_per_step": dict(eng.step_programs),
+                "tokens": {rid: results[rid].tolist() for rid in rids}}
+
+    fused = run_mode(True)
+    unfused = run_mode(False)
+    bit_identical = fused.pop("tokens") == unfused.pop("tokens")
+    fp = fused["programs_per_step"].get("decode")
+    up = unfused["programs_per_step"].get("decode")
+    return {"metric": "gpt1p3b_fused_decode_ab_chip" if on_tpu
+            else "gpt_tiny_fused_decode_ab_cpu_smoke",
+            "unit": "tokens/s (A/B) + programs/step",
+            "fused": fused, "unfused": unfused,
+            "bit_identical": bool(bit_identical),
+            "decode_programs_fused": fp,
+            "decode_programs_unfused": up,
+            "decode_programs_reduction": (
+                None if not (fp and up)
+                else round(1.0 - fp / up, 3)),
+            "requests": n_req, "prompt_lens": lens,
+            "new_tokens_per_req": new_toks, "num_slots": slots,
+            "page_size": page,
+            "note": "programs_per_step counts ops traced into each "
+                    "step program (dispatch.count_op_calls); the HBM "
+                    "round-trip win (no [B,vocab] logits, fused "
+                    "epilogue) needs the chip's Mosaic kernels — on "
+                    "cpu both modes run the pure-JAX references, so "
+                    "tokens/s measures host overhead, not the fusion"}
+
+
 # ONE set of workload constants, interpolated into both the subprocess
 # payload and the result-dict metadata below — the BENCH_STAGED entry
 # must describe the workload that was actually measured
@@ -1522,6 +1610,7 @@ def run_staged(on_tpu: bool) -> Dict:
                      ("decode", bench_decode),
                      ("paged_decode", bench_paged_decode),
                      ("ragged_serving", bench_ragged_serving),
+                     ("fused_decode", bench_fused_decode),
                      ("chunked_prefill", bench_chunked_prefill),
                      ("mesh_decode", bench_mesh_decode),
                      ("serving_prefix", bench_serving_prefix),

@@ -15,7 +15,9 @@ unwrap→call→rewrap.
 
 from __future__ import annotations
 
+import contextlib
 import inspect
+import threading
 import time
 from functools import partial
 from typing import Any, Callable, Dict
@@ -49,6 +51,41 @@ from jax._src import core as _jax_core
 
 _no_constraints_cm = None
 
+# -- trace-time op/launch counter (fused decode hot path, r13) --------------
+#
+# Every dispatch-op call inside an active `count_op_calls()` scope bumps
+# the counter. A jit executes its COMPILED program without re-entering
+# dispatch, so wrapping a jit call counts exactly the ops traced into
+# the program on a (re)trace and zero on a cache hit — which makes the
+# count a per-program "kernel ops" figure: the launch-counter currency
+# the fused-decode A/B and the `serving_step_programs` gauge report.
+# THREAD-LOCAL: the serving engine traces on its own engine thread
+# while other threads keep dispatching eagerly.
+
+_OP_COUNTER = threading.local()
+
+
+class OpCallCounter:
+    """Mutable counter handle yielded by :func:`count_op_calls`."""
+
+    __slots__ = ("count",)
+
+    def __init__(self):
+        self.count = 0
+
+
+@contextlib.contextmanager
+def count_op_calls():
+    """Count dispatch-op calls on this thread for the duration (nested
+    scopes shadow, outer scope resumes unchanged)."""
+    prev = getattr(_OP_COUNTER, "counter", None)
+    c = OpCallCounter()
+    _OP_COUNTER.counter = c
+    try:
+        yield c
+    finally:
+        _OP_COUNTER.counter = prev
+
 
 def _no_sharding_constraints():
     global _no_constraints_cm
@@ -59,6 +96,9 @@ def _no_sharding_constraints():
 
 
 def call_fn(fn: Callable, name: str, differentiable: bool, args, kwargs):
+    _c = getattr(_OP_COUNTER, "counter", None)
+    if _c is not None:
+        _c.count += 1
     leaves, treedef = _flatten(args, kwargs)
     tensor_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
     if not tensor_idx:

@@ -349,7 +349,8 @@ class ContinuousBatchingEngine:
                  speculative=None, verify_retry="site",
                  stall_timeout_s: Optional[float] = None,
                  mesh=None,
-                 prefill_chunk_tokens: Optional[int] = None):
+                 prefill_chunk_tokens: Optional[int] = None,
+                 fused_step: bool = True):
         import jax.numpy as jnp
 
         from ..core.compile_cache import enable_compile_cache
@@ -516,6 +517,27 @@ class ContinuousBatchingEngine:
         # their turn for the single per-step chunk budget (see
         # evict_stalled)
         self._last_chunk_t = 0.0
+        # fused decode hot path (r13): True (the default) traces the
+        # decode/prefill/verify programs through the fused kernels —
+        # attention + out-projection folded into ONE op per layer
+        # (models/gpt.py fused_decode -> ops paged_attention_fused)
+        # and sampling streamed through the lm_head from the final
+        # hidden row (nn/decode.py fused_sample_token), so the
+        # [B, vocab] logits tensor never materializes in HBM. Greedy
+        # outputs are BIT-IDENTICAL either way where the fused
+        # REFERENCES run (the CPU lane — pinned); on TPU the Mosaic
+        # fused kernels mimic the unfused lowering's precision but
+        # cross-mode bit-parity there is chip-pending validation
+        # (ops/pallas/paged_attention.py paged_attention_fused).
+        # False is byte-for-byte the pre-r13 trace — the same
+        # escape-hatch pattern as mesh=None / prefill_chunk_tokens=None.
+        self.fused_step = bool(fused_step)
+        # traced-program op counts per jitted step kind (the launch
+        # counter: dispatch.count_op_calls around each jit call counts
+        # the ops traced into the program on a (re)trace, zero on the
+        # compiled fast path) — the fused_decode A/B's currency and
+        # the serving_step_programs gauge's source
+        self.step_programs: Dict[str, int] = {}
         # speculative decoding (inference/speculative.py): draft k
         # tokens per step, verify all k+1 in ONE forward, emit the
         # longest accepted prefix + 1. Greedy stays bit-identical to
@@ -720,6 +742,38 @@ class ContinuousBatchingEngine:
         ctx.enter_context(no_sharding_constraints())
         return ctx
 
+    def _fuse_ctx(self):
+        """Trace-time fused-kernel routing (r13): under ``fused_step``
+        the traced body's paged-attention calls fold their epilogue
+        into `paged_attention_fused` (models/gpt.py fused_decode);
+        fused_step=False returns a null context so the trace is
+        byte-for-byte the pre-r13 program."""
+        if not self.fused_step:
+            return contextlib.nullcontext()
+        from ..models.gpt import fused_decode
+        return fused_decode()
+
+    def _fused_head(self):
+        """``(weight, transpose_y, bias)`` of a streamable lm_head, or
+        None when fusion is off or the model's head is not a plain fp
+        matmul (callers then keep the exact unfused logits path).
+        Evaluated INSIDE the traced body under bind_state, so the
+        weights are the jit's ARGUMENTS, never closure constants, and
+        a post-construction conversion (int8) re-decides at the
+        retrace the new state pytree forces."""
+        if not self.fused_step:
+            return None
+        if not hasattr(self.model, "decode_hidden"):
+            return None
+        hp = getattr(self.model, "head_params", None)
+        return None if hp is None else hp()
+
+    def _record_programs(self, kind: str, count: int) -> None:
+        """Record a (re)trace's program op count; the compiled fast
+        path counts zero and keeps the last traced figure."""
+        if count:
+            self.step_programs[kind] = count
+
     def _constrain_pools(self, pools):
         """Pin the returned pools to the engine's KV sharding (heads
         over the model axis; scales drop the trailing head-dim axis).
@@ -767,14 +821,27 @@ class ContinuousBatchingEngine:
 
         def step(state, pools, table, lens, tokens):
             caches = self._caches(pools, table, lens)
-            with self._head_ctx(), bind_state(self.model, state), \
-                    no_grad():
-                logits, nc = self.model.forward(Tensor(tokens[:, None]),
-                                                caches=caches)
-            # greedy serving mode through the ONE shared sampler
-            # (nn/decode.py) — the same call generate() and the
-            # speculative verify make
-            nxt, _ = sample_token(raw(logits)[:, -1], 0.0)
+            with self._head_ctx(), self._fuse_ctx(), \
+                    bind_state(self.model, state), no_grad():
+                hp = self._fused_head()
+                if hp is not None:
+                    # fused hot path (r13): hidden -> streaming lm_head
+                    # argmax; the [B, vocab] logits never materialize
+                    from ..nn.decode import fused_sample_token
+                    hidden, nc = self.model.decode_hidden(
+                        Tensor(tokens[:, None]), caches)
+                    w, ty, bias = hp
+                    nxt, _ = fused_sample_token(
+                        raw(hidden)[:, -1], raw(w), 0.0,
+                        transpose_y=ty,
+                        bias=None if bias is None else raw(bias))
+                else:
+                    logits, nc = self.model.forward(
+                        Tensor(tokens[:, None]), caches=caches)
+                    # greedy serving mode through the ONE shared
+                    # sampler (nn/decode.py) — the same call generate()
+                    # and the speculative verify make
+                    nxt, _ = sample_token(raw(logits)[:, -1], 0.0)
             new_pools = {
                 "k": [raw(c.k_pages) for c in nc],
                 "v": [raw(c.v_pages) for c in nc],
@@ -814,12 +881,28 @@ class ContinuousBatchingEngine:
 
         def prefill(state, pools, trow, slens, plen, ids):
             caches = self._caches(pools, trow, slens)
-            with self._head_ctx(), bind_state(self.model, state), \
-                    no_grad():
-                logits, nc = self.model.forward(
-                    Tensor(ids), caches=caches, prefill_lens=plen,
-                    prefill_chained=chained)
-            nxt, _ = sample_token(raw(logits)[:1, plen[0] - 1], 0.0)
+            with self._head_ctx(), self._fuse_ctx(), \
+                    bind_state(self.model, state), no_grad():
+                hp = self._fused_head()
+                if hp is not None:
+                    # fused (r13): sample the first token straight from
+                    # the last VALID hidden row — the [1, bucket, vocab]
+                    # prefill logits tensor never materializes
+                    from ..nn.decode import fused_sample_token
+                    hidden, nc = self.model.decode_hidden(
+                        Tensor(ids), caches, prefill_lens=plen,
+                        prefill_chained=chained)
+                    w, ty, bias = hp
+                    nxt, _ = fused_sample_token(
+                        raw(hidden)[:1, plen[0] - 1], raw(w), 0.0,
+                        transpose_y=ty,
+                        bias=None if bias is None else raw(bias))
+                else:
+                    logits, nc = self.model.forward(
+                        Tensor(ids), caches=caches, prefill_lens=plen,
+                        prefill_chained=chained)
+                    nxt, _ = sample_token(raw(logits)[:1, plen[0] - 1],
+                                          0.0)
             nxt = nxt[0]
             new_pools = {
                 "k": [raw(c.k_pages) for c in nc],
@@ -864,12 +947,31 @@ class ContinuousBatchingEngine:
 
         def verify(state, pools, table, lens, tokens, valid, key):
             caches = self._caches(pools, table, lens)
-            with self._head_ctx(), bind_state(self.model, state), \
-                    no_grad():
-                logits, nc = self.model.verify_step(Tensor(tokens),
-                                                    caches, valid)
-            accept, resid, full, _ = speculative_verify_tokens(
-                raw(logits), tokens[:, 1:], temp, tk, key)
+            with self._head_ctx(), self._fuse_ctx(), \
+                    bind_state(self.model, state), no_grad():
+                hp = self._fused_head()
+                if hp is not None:
+                    # one-program fused verify (r13): the k+1-position
+                    # scoring runs through the fused attention epilogue
+                    # and the accept/resample decisions stream through
+                    # the lm_head per position (nn/decode.py
+                    # fused_verify_tokens) — draft scoring AND
+                    # acceptance in the same fused program, with no
+                    # [B, k+1, vocab] logits tensor on the greedy path
+                    from ..nn.decode import fused_verify_tokens
+                    hidden, nc = self.model.decode_hidden(
+                        Tensor(tokens), caches, prefill_lens=valid,
+                        prefill_chained=True)
+                    w, ty, bias = hp
+                    accept, resid, full, _ = fused_verify_tokens(
+                        raw(hidden), tokens[:, 1:], raw(w), temp, tk,
+                        key, transpose_y=ty,
+                        bias=None if bias is None else raw(bias))
+                else:
+                    logits, nc = self.model.verify_step(Tensor(tokens),
+                                                        caches, valid)
+                    accept, resid, full, _ = speculative_verify_tokens(
+                        raw(logits), tokens[:, 1:], temp, tk, key)
             new_pools = {
                 "k": [raw(c.k_pages) for c in nc],
                 "v": [raw(c.v_pages) for c in nc],
@@ -1299,14 +1401,19 @@ class ContinuousBatchingEngine:
         jit = self._get_prefill(chained)
 
         def run_prefill():
+            from ..dispatch import count_op_calls
             from ..distributed.fault_inject import fault_point
             self._check_pools_live("prefill")
             fault_point("serving.prefill")
-            return jit(self._fresh_state(refresh=True), self._pools,
-                       jnp.asarray(row[None]),
-                       jnp.asarray([cached_len], jnp.int32),
-                       jnp.asarray([len(suffix)], jnp.int32),
-                       jnp.asarray(ids))
+            with count_op_calls() as c:
+                out = jit(self._fresh_state(refresh=True), self._pools,
+                          jnp.asarray(row[None]),
+                          jnp.asarray([cached_len], jnp.int32),
+                          jnp.asarray([len(suffix)], jnp.int32),
+                          jnp.asarray(ids))
+            self._record_programs(
+                "prefill_chained" if chained else "prefill", c.count)
+            return out
 
         t0 = time.monotonic()
         try:
@@ -1420,14 +1527,19 @@ class ContinuousBatchingEngine:
         row = self._table[slot]
 
         def run_chunk():
+            from ..dispatch import count_op_calls
             from ..distributed.fault_inject import fault_point
             self._check_pools_live("prefill")
             fault_point("serving.prefill")
-            return jit(self._fresh_state(refresh=True), self._pools,
-                       jnp.asarray(row[None]),
-                       jnp.asarray([done], jnp.int32),
-                       jnp.asarray([len(suffix)], jnp.int32),
-                       jnp.asarray(ids))
+            with count_op_calls() as c:
+                out = jit(self._fresh_state(refresh=True), self._pools,
+                          jnp.asarray(row[None]),
+                          jnp.asarray([done], jnp.int32),
+                          jnp.asarray([len(suffix)], jnp.int32),
+                          jnp.asarray(ids))
+            self._record_programs(
+                "prefill_chained" if chained else "prefill", c.count)
+            return out
 
         t0 = time.monotonic()
         try:
@@ -1604,13 +1716,17 @@ class ContinuousBatchingEngine:
             key = jax.random.PRNGKey(0)  # unused on the greedy path
 
         def run_verify():
+            from ..dispatch import count_op_calls
             from ..distributed.fault_inject import fault_point
             self._check_pools_live("verify")
             fault_point("serving.verify")
-            return self._verify_jit(
-                self._fresh_state(), self._pools,
-                jnp.asarray(self._table), jnp.asarray(self._lens),
-                jnp.asarray(tokens), jnp.asarray(valid), key)
+            with count_op_calls() as c:
+                out = self._verify_jit(
+                    self._fresh_state(), self._pools,
+                    jnp.asarray(self._table), jnp.asarray(self._lens),
+                    jnp.asarray(tokens), jnp.asarray(valid), key)
+            self._record_programs("verify", c.count)
+            return out
 
         if self._verify_retry is not None:
             accept, resid, full, pools = self._verify_retry.call(
@@ -1715,10 +1831,13 @@ class ContinuousBatchingEngine:
             table = np.where(decoding[:, None], table,
                              self._scratch).astype(np.int32)
             lens = np.where(decoding, lens, 0).astype(np.int32)
-        nxt, pools, lens_new = self._decode_jit(
-            self._fresh_state(), self._pools,
-            jnp.asarray(table), jnp.asarray(lens),
-            jnp.asarray(self._cur))
+        from ..dispatch import count_op_calls
+        with count_op_calls() as c:
+            nxt, pools, lens_new = self._decode_jit(
+                self._fresh_state(), self._pools,
+                jnp.asarray(table), jnp.asarray(lens),
+                jnp.asarray(self._cur))
+        self._record_programs("decode", c.count)
         self._pools = pools
         nxt = np.asarray(nxt)
         # non-decoding slots wrote to the scratch page; keep their host

@@ -14,9 +14,11 @@ parameter annotated with its hybrid-mesh PartitionSpec (dp×mp×pp×sp).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import math
+import threading
 from typing import Any, NamedTuple, Optional
 
 import jax.numpy as jnp
@@ -134,6 +136,39 @@ def gpt_1p3b(**kw):
 def ernie_10b(**kw):
     return GPTConfig(hidden_size=4096, num_layers=48, num_heads=64,
                      max_seq_len=4096, **kw)
+
+
+# -- fused decode hot path (r13) --------------------------------------------
+#
+# Trace-time switch, the same pattern as ops/pallas/paged_attention.py
+# `head_sharding`: while active, the paged decode/verify paths fold
+# their epilogues into fused ops — `paged_attention_fused` (attention +
+# out-projection, one launch) inside GPTAttention, and callers sample
+# through nn/decode.py `fused_sample_token` over `decode_hidden` so the
+# [B, vocab] logits never materialize. THREAD-LOCAL because jit traces
+# run on the calling thread and a fused serving engine may trace
+# concurrently with an unfused one (two server threads). The switch
+# changes the op composition, never the math: greedy outputs stay
+# bit-identical to the unfused trace (pinned in
+# tests/test_fused_decode.py).
+
+_FUSED_DECODE = threading.local()
+
+
+@contextlib.contextmanager
+def fused_decode(enable: bool = True):
+    """Route paged decode/verify traces through the fused kernels for
+    the duration (wrap the jit-traced call, not the runtime one)."""
+    prev = getattr(_FUSED_DECODE, "value", False)
+    _FUSED_DECODE.value = bool(enable)
+    try:
+        yield
+    finally:
+        _FUSED_DECODE.value = prev
+
+
+def fused_decode_active() -> bool:
+    return bool(getattr(_FUSED_DECODE, "value", False))
 
 
 class StaticKVCache(NamedTuple):
@@ -464,7 +499,22 @@ class GPTAttention(Layer):
                     c, kk, vv, valid_len=pl_)),
                 "paged_kv_append", True, (cache, k, v, prefill_len), {})
         new_cache = PagedKVCache(*new_cache)
+        # fused epilogue (r13): under an active fused_decode() trace,
+        # the paged-attention branches fold softmax-normalize +
+        # head-concat + out-projection into ONE op and return the
+        # attention block's output directly — same math, one launch
+        # (the dense fresh-prefill branch keeps its exact pre-r13
+        # program; it is not the decode hot path)
+        fw = (self._fused_epilogue_params() if fused_decode_active()
+              else None)
         if s == 1:
+            if fw is not None:
+                out = F["paged_attention_fused"](
+                    q, new_cache.k_pages, new_cache.v_pages,
+                    new_cache.page_table, new_cache.seq_lens,
+                    fw[0], fw[1], k_scale=new_cache.k_scale,
+                    v_scale=new_cache.v_scale)
+                return out, new_cache
             out = F["paged_attention"](
                 q, new_cache.k_pages, new_cache.v_pages,
                 new_cache.page_table, new_cache.seq_lens,
@@ -474,6 +524,13 @@ class GPTAttention(Layer):
                 q, k, v, is_causal=True, dropout_p=0.0,
                 training=False, use_flash=bool(self.use_flash))
         else:
+            if fw is not None:
+                out = F["paged_attention_fused"](
+                    q, new_cache.k_pages, new_cache.v_pages,
+                    new_cache.page_table, new_cache.seq_lens,
+                    fw[0], fw[1], k_scale=new_cache.k_scale,
+                    v_scale=new_cache.v_scale, q_offsets=old_lens)
+                return out, new_cache
             out = F["paged_attention"](
                 q, new_cache.k_pages, new_cache.v_pages,
                 new_cache.page_table, new_cache.seq_lens,
@@ -482,6 +539,24 @@ class GPTAttention(Layer):
         out = F["reshape"](out, (b, s, self.num_heads * self.head_dim))
         out = self.out_proj(out)
         return out, new_cache
+
+    def _fused_epilogue_params(self):
+        """(weight, bias) of a FUSABLE out-projection, else None: the
+        epilogue folds only a plain fp matmul head ([E, E] weight, the
+        RowParallelLinear layout). A converted projection (e.g.
+        quantization's WeightOnlyInt8Linear, whose weight lives in
+        int8 buffers with an output-scale epilogue of its own) keeps
+        the unfused composition — correctness over fusion."""
+        import jax.numpy as _jnp
+        w = getattr(self.out_proj, "weight", None)
+        if w is None:
+            return None
+        wv = w.value if isinstance(w, Tensor) else w
+        if wv is None or not _jnp.issubdtype(wv.dtype, _jnp.floating):
+            return None
+        if wv.shape[0] != self.num_heads * self.head_dim:
+            return None
+        return w, getattr(self.out_proj, "bias", None)
 
 
 class GPTMLP(Layer):
@@ -663,6 +738,36 @@ class GPTForCausalLM(Layer):
             return self.lm_head(hidden)
         return F["matmul"](hidden, self.gpt.wte.weight, transpose_y=True)
 
+    def head_params(self):
+        """``(weight, transpose_y, bias)`` of the lm_head for the fused
+        streaming sampler (nn/decode.py ``fused_sample_token``), or
+        None when the head is not a plain fp matmul (e.g. an
+        int8-converted lm_head) — callers then fall back to
+        :meth:`logits`. Tied embeddings expose the [V, D] wte weight
+        with ``transpose_y=True``, exactly the :meth:`logits` math."""
+        import jax.numpy as _jnp
+        if self.lm_head is None:
+            return self.gpt.wte.weight, True, None
+        w = getattr(self.lm_head, "weight", None)
+        if w is None:
+            return None
+        wv = w.value if isinstance(w, Tensor) else w
+        if wv is None or not _jnp.issubdtype(wv.dtype, _jnp.floating):
+            return None
+        return w, False, getattr(self.lm_head, "bias", None)
+
+    def decode_hidden(self, input_ids, caches, prefill_lens=None,
+                      prefill_chained=False):
+        """Cached forward returning FINAL HIDDEN STATES instead of
+        logits — the fused decode hot path's model entry: callers
+        sample straight from the hidden row via the streaming lm_head
+        (``fused_sample_token``), so the [B, S, vocab] logits tensor
+        never materializes. Returns ``(hidden [B, S, D],
+        new_caches)``."""
+        return self.gpt(input_ids, None, caches,
+                        prefill_lens=prefill_lens,
+                        prefill_chained=prefill_chained)
+
     def _chunked_lm_loss(self, hidden, labels, chunk):
         """Mean next-token CE without materializing full logits: scan over
         sequence chunks; each chunk's logits+CE run under jax.checkpoint,
@@ -830,28 +935,25 @@ class GPTForCausalLM(Layer):
         if max_new_tokens <= 0:
             return input_ids
         self.eval()
+        # the eager loop samples through the ONE shared sampler
+        # (nn/decode.py sample_token — r13 consolidation: the same
+        # call the jitted scan, the chunked generate and the serving
+        # engine make; previously these four lines lived here inline
+        # with their own key-split order)
+        from ..nn.decode import sample_token
         caches = [None] * self.config.num_layers
         ids = input_ids
         logits, caches = self.forward(ids, caches=caches)
         out_ids = [ids]
         cur = logits[:, -1]
+        key_raw = key.value if isinstance(key, Tensor) else key
+        if temperature != 0.0 and key_raw is None:
+            key_raw = next_key()
         for _ in range(max_new_tokens):
-            if temperature == 0.0:
-                nxt = F["argmax"](cur, axis=-1, keepdim=True)
-            else:
-                scaled = cur / temperature
-                if top_k is not None:
-                    vals, _ = F["topk"](scaled, top_k)
-                    kth = vals[:, -1:]
-                    scaled = F["where"](scaled < kth,
-                                        F["full_like"](scaled, -1e10),
-                                        scaled)
-                k = key if key is not None else next_key()
-                key = jax.random.split(k)[0]
-                raw = jax.random.categorical(
-                    k, scaled.value if isinstance(scaled, Tensor)
-                    else scaled, axis=-1)
-                nxt = Tensor(raw[:, None].astype(jnp.int32))
+            tok, key_raw = sample_token(
+                cur.value if isinstance(cur, Tensor) else cur,
+                float(temperature), top_k, key_raw)
+            nxt = Tensor(tok[:, None].astype(jnp.int32))
             out_ids.append(nxt)
             logits, caches = self.forward(nxt, caches=caches)
             cur = logits[:, -1]
@@ -905,7 +1007,17 @@ class GPTForCausalLM(Layer):
                 pages_per_seq, quantized=(kv_cache == "paged_int8"))
                 for _ in range(nl)]
 
-        def fwd(params, ids, caches):
+        # fused decode hot path (r13): when the lm_head is a plain fp
+        # matmul, every step samples STRAIGHT from the final hidden row
+        # through the streaming lm_head (nn/decode.py
+        # fused_sample_token — greedy tokens bit-identical to
+        # argmax(logits) by the first-index tie rule), and paged traces
+        # additionally fold the attention epilogue (fused_decode()).
+        # A non-fusable head (e.g. int8-converted lm_head) keeps the
+        # exact pre-r13 logits path.
+        use_fused = self.head_params() is not None
+
+        def fwd_tok(params, ids, caches, k):
             # paged prefill chunks (s > 1) pass an explicit full-length
             # prefill_lens: generate() always starts from a FRESH pool,
             # so the chunk-local dense fast path applies (forward()
@@ -917,13 +1029,21 @@ class GPTForCausalLM(Layer):
                                  jnp.int32)
             with bind_state(self, {"params": params, "buffers": {}}), \
                     no_grad():
-                logits, nc = self.forward(Tensor(ids), caches=caches,
-                                          prefill_lens=plens)
-            return raw(logits), [raw_cache(c) for c in nc]
-
-        def sample(last, k):  # last: [B, V]
-            from ..nn.decode import sample_token
-            return sample_token(last, temp, tk, k)
+                if use_fused:
+                    from ..nn.decode import fused_sample_token
+                    hidden, nc = self.decode_hidden(Tensor(ids), caches,
+                                                    prefill_lens=plens)
+                    w, ty, bias = self.head_params()
+                    nxt, k = fused_sample_token(
+                        raw(hidden)[:, -1], raw(w), temp, tk, k,
+                        transpose_y=ty,
+                        bias=None if bias is None else raw(bias))
+                else:
+                    from ..nn.decode import sample_token
+                    logits, nc = self.forward(Tensor(ids), caches=caches,
+                                              prefill_lens=plens)
+                    nxt, k = sample_token(raw(logits)[:, -1], temp, tk, k)
+            return nxt, [raw_cache(c) for c in nc], k
 
         def run(params, ids, k):
             # single-device program: hybrid-mesh activation constraints
@@ -934,15 +1054,16 @@ class GPTForCausalLM(Layer):
             # REPLICATED token output — emitted ids came back exactly
             # mp-times too large while the scan carry stayed correct.
             from ..distributed.mp_layers import no_sharding_constraints
-            with no_sharding_constraints():
+            fuse_attn = (fused_decode() if use_fused and
+                         kv_cache != "static"
+                         else contextlib.nullcontext())
+            with no_sharding_constraints(), fuse_attn:
                 caches = make_caches()
-                logits, caches = fwd(params, ids, caches)  # prefill
-                nxt, k = sample(logits[:, -1], k)
+                nxt, caches, k = fwd_tok(params, ids, caches, k)
 
                 def body(carry, _):
                     cur, cs, kk = carry
-                    lg, cs = fwd(params, cur[:, None], cs)
-                    nxt2, kk = sample(lg[:, -1], kk)
+                    nxt2, cs, kk = fwd_tok(params, cur[:, None], cs, kk)
                     return (nxt2, cs, kk), cur
 
                 (last, _, _), toks = jax.lax.scan(

@@ -27,6 +27,7 @@ from .layer import Layer
 F = dispatch.wrapped_ops
 
 __all__ = ["BeamSearchDecoder", "dynamic_decode", "sample_token",
+           "fused_sample_token", "fused_verify_tokens",
            "speculative_verify_tokens"]
 
 
@@ -57,6 +58,94 @@ def sample_token(last, temperature: float = 0.0, top_k=None, key=None):
     key, sub = jax.random.split(key)
     return jax.random.categorical(sub, scaled, axis=-1).astype(
         jnp.int32), key
+
+
+def _head_logits(hidden, weight, bias, transpose_y: bool):
+    """The unfused lm_head matmul (models/gpt.py ``logits`` semantics:
+    ``hidden @ W.T`` for the tied [V, D] layout, ``hidden @ W`` for the
+    untied [D, V] head) — the fallback the fused sampler delegates to
+    whenever streaming cannot reproduce the exact unfused behavior."""
+    logits = jnp.matmul(hidden, weight.T if transpose_y else weight)
+    if bias is not None:
+        logits = logits + bias
+    return logits
+
+
+def fused_sample_token(hidden, weight, temperature: float = 0.0,
+                       top_k=None, key=None, transpose_y: bool = False,
+                       bias=None, tile: int = 2048):
+    """:func:`sample_token` twin over FINAL HIDDEN STATES + the lm_head
+    weight instead of materialized logits (the r13 fused decode hot
+    path): the jitted whole-generate scan, the continuous-batching
+    engine's fused prefill/decode steps and the fused speculative
+    verify all call THIS function, so their token streams still share
+    ONE sampler while the [B, vocab] logits tensor never reaches HBM
+    on the paths that can stream it.
+
+    ``hidden``: [B, D]; ``weight``/``transpose_y``/``bias``: the head
+    layout (models/gpt.py ``head_params``). Routing:
+
+    - greedy (``temperature == 0``): streaming argmax over vocab tiles
+      (ops/pallas/fused_sample.py) — bit-identical tokens to
+      ``argmax(logits)`` by the first-index tie rule;
+    - ``top_k`` sampling: streaming top-k reservoir, then one
+      categorical over the k candidates (the same top-k distribution;
+      the [B, V] tensor still never materializes);
+    - plain temperature sampling, or an active serving-mesh trace
+      (vocab-sharded weights — GSPMD already keeps per-device logits
+      tiles, and the tile scan would fight the sharding): the exact
+      unfused logits + :func:`sample_token`.
+
+    Returns ``(tokens [B] int32, new_key)`` like ``sample_token``."""
+    import jax
+
+    from ..ops.pallas.fused_sample import fused_sample
+    from ..ops.pallas.paged_attention import get_head_sharding
+
+    if get_head_sharding() is not None:
+        return sample_token(_head_logits(hidden, weight, bias,
+                                         transpose_y),
+                            temperature, top_k, key)
+    if temperature == 0.0:
+        return fused_sample(hidden, weight, bias=bias,
+                            transpose_y=transpose_y, tile=tile), key
+    if top_k is not None:
+        vals, idxs = fused_sample(hidden, weight, bias=bias,
+                                  transpose_y=transpose_y, top_k=top_k,
+                                  tile=tile)
+        key, sub = jax.random.split(key)
+        pick = jax.random.categorical(
+            sub, vals.astype(jnp.float32) / temperature, axis=-1)
+        tok = jnp.take_along_axis(idxs, pick[:, None], axis=1)[:, 0]
+        return tok.astype(jnp.int32), key
+    return sample_token(_head_logits(hidden, weight, bias, transpose_y),
+                        temperature, top_k, key)
+
+
+def fused_verify_tokens(hidden, drafts, weight, temperature: float = 0.0,
+                        top_k=None, key=None, transpose_y: bool = False,
+                        bias=None, tile: int = 2048):
+    """:func:`speculative_verify_tokens` twin over the verify chunk's
+    final hidden states [B, s, D]: on the greedy single-device path the
+    per-position target tokens come from the STREAMING argmax (one
+    fused scoring+acceptance program, no [B, s, V] logits in HBM);
+    temperature/top-k verification needs full per-position
+    distributions (acceptance probabilities + residual resampling), so
+    those — and serving-mesh traces — delegate to the exact unfused
+    logits + ``speculative_verify_tokens``. Same return contract."""
+    from ..ops.pallas.fused_sample import fused_sample
+    from ..ops.pallas.paged_attention import get_head_sharding
+
+    b, s, d = hidden.shape
+    if temperature == 0.0 and get_head_sharding() is None:
+        full = fused_sample(hidden.reshape(b * s, d), weight, bias=bias,
+                            transpose_y=transpose_y, tile=tile)
+        full = full.reshape(b, s).astype(jnp.int32)
+        accept = drafts.astype(jnp.int32) == full[:, :-1]
+        return accept, full[:, :-1], full, key
+    return speculative_verify_tokens(
+        _head_logits(hidden, weight, bias, transpose_y), drafts,
+        temperature, top_k, key)
 
 
 def speculative_verify_tokens(logits, drafts, temperature: float = 0.0,

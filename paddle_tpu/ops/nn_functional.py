@@ -905,6 +905,41 @@ def paged_attention_head_sharded(q, k_pages, v_pages, page_table,
                  scale=scale, q_offsets=q_offsets)
 
 
+def paged_attention_fused(q, k_pages, v_pages, page_table, seq_lens,
+                          w, bias=None, k_scale=None, v_scale=None,
+                          scale=None, q_offsets=None):
+    """Ragged paged attention with the output-projection epilogue
+    fused in (r13 decode hot path): the softmax-normalized per-head
+    context is head-concatenated and pushed through ``w`` ([H*D,
+    E_out], optional ``bias``) inside the SAME kernel/op, returning
+    the attention block's output [B, Sq, E_out] — one launch where the
+    unfused path runs paged_attention + reshape + linear + bias-add.
+    Kernel selection mirrors `paged_attention` (Mosaic fused kernel on
+    TPU under the shape/VMEM gate, dense-gather fused reference
+    elsewhere, head-sharded under an active serving mesh); both are
+    the exact unfused math, so greedy decode stays bit-identical
+    (ops/pallas/paged_attention.py)."""
+    from .pallas.paged_attention import paged_attention_fused as _impl
+    return _impl(q, k_pages, v_pages, page_table, seq_lens, w,
+                 bias=bias, k_scale=k_scale, v_scale=v_scale,
+                 scale=scale, q_offsets=q_offsets)
+
+
+def fused_sample(hidden, weight, bias=None, transpose_y=False,
+                 top_k=None, tile=2048):
+    """Streaming lm_head sampling (r13): tile the logits matmul over
+    the vocab dim and keep a running argmax (``top_k=None`` -> greedy
+    tokens [B] int32, first-index ties exactly like ``argmax``) or a
+    running top-k reservoir (``top_k=k`` -> (values, indices) [B, k]),
+    so the [B, vocab] logits tensor is never materialized in HBM.
+    ``weight``: [V, D] with ``transpose_y=True`` (tied-embedding
+    layout) or [D, V] otherwise (ops/pallas/fused_sample.py — Mosaic
+    streaming kernel on TPU, lax.scan reference elsewhere)."""
+    from .pallas.fused_sample import fused_sample as _impl
+    return _impl(hidden, weight, bias=bias, transpose_y=transpose_y,
+                 top_k=top_k, tile=tile)
+
+
 @functools.lru_cache(maxsize=None)
 def _default_serving_mesh(model_parallel: int):
     """Memoized benchable-default mesh for

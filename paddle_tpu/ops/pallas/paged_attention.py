@@ -72,10 +72,14 @@ def _dequant(x, scale):
 # Pallas kernel (TPU): ragged page walk, double-buffered DMA
 # --------------------------------------------------------------------------
 
-def _decode_kernel(pt_ref, len_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref,
-                   o_ref, k_buf, v_buf, ks_buf, vs_buf, sems, *,
-                   page: int, scale: float, quantized: bool):
-    """One grid step = one sequence: walk its pages, online softmax.
+def _walk_pages(pt_ref, len_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref,
+                k_buf, v_buf, ks_buf, vs_buf, sems, *,
+                page: int, scale: float, quantized: bool):
+    """Shared ragged page walk: one grid step = one sequence; walks its
+    pages with double-buffered DMA and an online softmax, returning the
+    NORMALIZED per-head context [H, D] fp32 (the `_decode_kernel` body,
+    factored out so the fused-epilogue kernel reuses the exact same
+    arithmetic — the bit-identical-per-head property both lean on).
 
     Scratch: ``k_buf``/``v_buf`` [2, page, H, D] double buffers (+int8
     scale buffers [2, page, H] when quantized); ``sems`` [4, 2] DMA
@@ -143,7 +147,50 @@ def _decode_kernel(pt_ref, len_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref,
     _, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, a0))
     # empty sequences (len 0) produce defined zeros, not NaN — the
     # continuous-batching engine parks inactive slots at len 0
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    return acc / jnp.maximum(l, 1e-30)
+
+
+def _decode_kernel(pt_ref, len_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref,
+                   o_ref, k_buf, v_buf, ks_buf, vs_buf, sems, *,
+                   page: int, scale: float, quantized: bool):
+    """Raw per-head context output (the pre-r13 kernel contract)."""
+    ctx = _walk_pages(pt_ref, len_ref, q_ref, kp_ref, vp_ref, ks_ref,
+                      vs_ref, k_buf, v_buf, ks_buf, vs_buf, sems,
+                      page=page, scale=scale, quantized=quantized)
+    o_ref[0] = ctx.astype(o_ref.dtype)
+
+
+def _decode_fused_kernel(pt_ref, len_ref, q_ref, kp_ref, vp_ref, ks_ref,
+                         vs_ref, w_ref, b_ref, o_ref, k_buf, v_buf,
+                         ks_buf, vs_buf, sems, *, page: int, scale: float,
+                         quantized: bool, has_bias: bool):
+    """Fused attention epilogue (r13): the softmax-normalized per-head
+    context never leaves VMEM — it is flattened head-major (the same
+    [H*D] order the model's reshape produces) and pushed straight
+    through the output projection (``w_ref`` [E, E_out] resident in
+    VMEM across the whole grid, ``b_ref`` [1, E_out]), so the kernel
+    emits the attention BLOCK's output row instead of raw per-head
+    context. One launch where the unfused path runs attention + reshape
+    + matmul + bias-add (the Tensix/Neptune epilogue-fusion recipe:
+    fold the chain into the kernel that already holds the data)."""
+    ctx = _walk_pages(pt_ref, len_ref, q_ref, kp_ref, vp_ref, ks_ref,
+                      vs_ref, k_buf, v_buf, ks_buf, vs_buf, sems,
+                      page=page, scale=scale, quantized=quantized)
+    h, d = ctx.shape
+    # mimic the unfused lowering's rounding: the standalone kernel
+    # rounds the context to the output dtype (bf16 in bf16 serving)
+    # BEFORE the model's out-projection matmul, whose MXU dot then
+    # accumulates in f32 — round here the same way so fused-vs-unfused
+    # on-chip divergence is limited to XLA tiling, not operand
+    # precision. (Exact on-chip bit-identity is NOT claimed — see
+    # `paged_attention_fused`; the CPU-lane references are bit-equal.)
+    row = ctx.astype(o_ref.dtype).reshape(1, h * d)
+    out = jax.lax.dot_general(
+        row, w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)  # [1, E_out]
+    if has_bias:
+        out = out + b_ref[...].astype(jnp.float32)
+    o_ref[0] = out[0].astype(o_ref.dtype)
 
 
 def _paged_decode_pallas(q, k_pages, v_pages, page_table, seq_lens,
@@ -192,6 +239,67 @@ def _paged_decode_pallas(q, k_pages, v_pages, page_table, seq_lens,
         if hasattr(pltpu, "CompilerParams") else
         pltpu.TPUCompilerParams(dimension_semantics=("arbitrary",)),
     )(page_table, seq_lens, q, k_pages, v_pages, ks, vs)
+
+
+def _paged_decode_fused_pallas(q, k_pages, v_pages, page_table, seq_lens,
+                               k_scale, v_scale, scale, w, bias):
+    """Fused-epilogue variant of :func:`_paged_decode_pallas`: same
+    grid/scratch layout plus the projection weight as a VMEM-resident
+    block (constant index map — one HBM read for the whole grid) and an
+    output row of E_out lanes per sequence."""
+    b, h, d = q.shape
+    n_pool, page = k_pages.shape[:2]
+    e_out = w.shape[1]
+    quantized = k_scale is not None
+    has_bias = bias is not None
+    dummy = jnp.zeros((1, 1, 1), jnp.float32)
+    ks = k_scale if quantized else dummy
+    vs = v_scale if quantized else dummy
+    sdt = ks.dtype
+    brow = (bias.reshape(1, e_out) if has_bias
+            else jnp.zeros((1, e_out), jnp.float32))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0),
+                         memory_space=pltpu.VMEM),     # q
+            pl.BlockSpec(memory_space=pltpu.ANY),      # k pages (HBM)
+            pl.BlockSpec(memory_space=pltpu.ANY),      # v pages (HBM)
+            pl.BlockSpec(memory_space=pltpu.ANY),      # k scales
+            pl.BlockSpec(memory_space=pltpu.ANY),      # v scales
+            pl.BlockSpec((h * d, e_out), lambda i, *_: (0, 0),
+                         memory_space=pltpu.VMEM),     # o-proj weight
+            pl.BlockSpec((1, e_out), lambda i, *_: (0, 0),
+                         memory_space=pltpu.VMEM),     # o-proj bias
+        ],
+        out_specs=pl.BlockSpec((1, e_out), lambda i, *_: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, page, h, d), k_pages.dtype),
+            pltpu.VMEM((2, page, h, d), v_pages.dtype),
+            pltpu.VMEM((2, page, h), sdt),
+            pltpu.VMEM((2, page, h), sdt),
+            pltpu.SemaphoreType.DMA((4, 2)),
+        ],
+    )
+    kv_bytes = k_pages.dtype.itemsize
+    return pl.pallas_call(
+        functools.partial(_decode_fused_kernel, page=page, scale=scale,
+                          quantized=quantized, has_bias=has_bias),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, e_out), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * int(b) * h * page * d * page_table.shape[1]
+            + 2 * int(b) * h * d * e_out,
+            bytes_accessed=(2 * n_pool * page * h * d * kv_bytes
+                            + h * d * e_out * w.dtype.itemsize),
+            transcendentals=b * h * page * page_table.shape[1]),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+        if hasattr(pltpu, "CompilerParams") else
+        pltpu.TPUCompilerParams(dimension_semantics=("arbitrary",)),
+    )(page_table, seq_lens, q, k_pages, v_pages, ks, vs, w, brow)
 
 
 # --------------------------------------------------------------------------
@@ -419,3 +527,117 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
     return _paged_attention_local(
         q, k_pages, v_pages, page_table, seq_lens, k_scale=k_scale,
         v_scale=v_scale, scale=scale, q_offsets=q_offsets)
+
+
+# --------------------------------------------------------------------------
+# Fused attention epilogue (r13): attention + out-projection, one launch
+# --------------------------------------------------------------------------
+
+# VMEM budget for the resident o-projection weight block: the fused
+# kernel keeps W [E, E_out] live next to the double-buffered page set,
+# so the gate admits only weights that fit comfortably (v4/v5 cores
+# carry 16 MB VMEM; 8 MB leaves the page buffers + q + output headroom).
+_FUSED_W_VMEM_BYTES = 8 * 1024 * 1024
+
+
+def fused_epilogue_supported(q_shape, kp_shape, w_shape,
+                             backend: Optional[str] = None,
+                             w_itemsize: int = 4) -> bool:
+    """Gate for the Mosaic fused-epilogue kernel: everything
+    :func:`paged_attention_supported` requires, plus a lane-tiling
+    projection whose weight block fits the VMEM budget
+    (``w_itemsize``: the weight's storage bytes/element — the kernel
+    keeps W in storage dtype, so a bf16 [2048, 2048] head fits where
+    an fp32 one does not)."""
+    if not paged_attention_supported(q_shape, kp_shape, backend):
+        return False
+    e_in, e_out = w_shape
+    _, _, h, d = q_shape
+    return (e_in == h * d and e_out % 128 == 0 and
+            e_in * e_out * int(w_itemsize) <= _FUSED_W_VMEM_BYTES)
+
+
+def paged_attention_fused_reference(q, k_pages, v_pages, page_table,
+                                    seq_lens, w, bias=None,
+                                    k_scale=None, v_scale=None,
+                                    scale: Optional[float] = None,
+                                    q_offsets=None):
+    """Dense-gather reference for the fused epilogue: EXACTLY the
+    unfused model math — :func:`paged_attention_reference`, the
+    head-concat reshape, ``x @ W`` (ops.nn_functional.linear semantics)
+    and the bias add — composed inside one op, so the fused engine's
+    greedy tokens are bit-identical to the unfused engine on the CPU
+    lane (the jaxpr the trace emits is the same one the unfused layers
+    emit; only the launch/op count differs)."""
+    ctx = paged_attention_reference(
+        q, k_pages, v_pages, page_table, seq_lens, k_scale=k_scale,
+        v_scale=v_scale, scale=scale, q_offsets=q_offsets)
+    b, sq, h, d = ctx.shape
+    out = jnp.matmul(ctx.reshape(b, sq, h * d), w)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def paged_attention_fused(q, k_pages, v_pages, page_table, seq_lens,
+                          w, bias=None, k_scale=None, v_scale=None,
+                          scale: Optional[float] = None, q_offsets=None):
+    """Ragged paged attention with the output-projection epilogue fused
+    in: returns the attention BLOCK's output ``[B, Sq, E_out]`` instead
+    of raw per-head context (``w``: [H*D, E_out] o-projection weight,
+    ``bias``: optional [E_out]).
+
+    Kernel selection mirrors :func:`paged_attention`: under an active
+    :func:`head_sharding` context the attention runs head-sharded and
+    the projection stays in the same traced program (GSPMD partitions
+    the contraction over the head-grouped rows exactly as the unfused
+    RowParallelLinear would — no separate launch, identical math);
+    single-device, the Mosaic fused-epilogue kernel runs where
+    :func:`fused_epilogue_supported` admits, the dense-gather fused
+    reference elsewhere.
+
+    Bit-identity contract: the REFERENCE composes the exact unfused
+    jnp ops, so fused-vs-unfused greedy outputs are bit-equal wherever
+    it runs (the CPU CI lane). The Mosaic kernel mimics the unfused
+    lowering's rounding (context rounded to the output dtype before
+    the epilogue dot, f32 accumulation) but on-chip bit-parity with
+    the separately-launched unfused programs is chip-pending
+    validation — validate with the fused_decode A/B on a chip-attached
+    host before relying on cross-mode determinism there."""
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    scale = float(scale)
+    hs = get_head_sharding()
+    if hs is not None:
+        mesh, axis = hs
+        ctx = paged_attention_head_sharded(
+            q, k_pages, v_pages, page_table, seq_lens, mesh, axis=axis,
+            k_scale=k_scale, v_scale=v_scale, scale=scale,
+            q_offsets=q_offsets)
+        out = jnp.matmul(ctx.reshape(b, sq, h * d), w)
+        if bias is not None:
+            out = out + bias
+        return out
+    if q_offsets is None and fused_epilogue_supported(
+            q.shape, k_pages.shape, w.shape,
+            w_itemsize=w.dtype.itemsize):
+        out = _paged_decode_fused_pallas(
+            q.reshape(b, h, d), k_pages, v_pages,
+            page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+            k_scale, v_scale, scale, w, bias)
+        return out.reshape(b, sq, w.shape[1])
+    # epilogue not in-kernel: compose the STANDARD kernel-selected
+    # attention (_paged_attention_local — the Mosaic page-walk kernel
+    # on TPU where its gate admits, the dense-gather reference on the
+    # CPU lane) with the same epilogue ops, still as one dispatch op.
+    # Falling back to the dense reference here would silently hand the
+    # big-E decode hot path (e.g. a 1.3B head over the VMEM budget)
+    # the worst kernel on exactly the backend the fusion targets.
+    ctx = _paged_attention_local(
+        q, k_pages, v_pages, page_table, seq_lens, k_scale=k_scale,
+        v_scale=v_scale, scale=scale, q_offsets=q_offsets)
+    out = jnp.matmul(ctx.reshape(b, sq, h * d), w)
+    if bias is not None:
+        out = out + bias
+    return out

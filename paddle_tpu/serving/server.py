@@ -846,6 +846,13 @@ class ServingServer:
                 # prefilled slots + queue) and the configured chunk
                 "prefill_debt_tokens": eng.prefill_debt_tokens,
                 "prefill_chunk_tokens": eng.prefill_chunk_tokens,
+                # fused decode hot path (r13): whether the engine
+                # traces fused programs, and the per-program traced-op
+                # launch counts ({"decode": N, ...} — populated as
+                # each program kind first traces)
+                "fused_step": getattr(eng, "fused_step", None),
+                "step_programs": dict(
+                    getattr(eng, "step_programs", {}) or {}),
                 "uptime_s": round(time.monotonic() - self._t0, 3)}
 
     def _gauges(self) -> Dict[str, float]:
@@ -865,6 +872,13 @@ class ServingServer:
              # half-prefilled slots + the queue — the head-of-line
              # pressure a dashboard watches against TPOT
              "prefill_debt_tokens": eng.prefill_debt_tokens}
+        # fused decode (r13): ops traced into the decode-step program
+        # (the launch counter) — exported as serving_step_programs so
+        # the fused launch-count win is visible on a live server; 0
+        # until the decode step first traces
+        sp = getattr(eng, "step_programs", None)
+        if sp is not None:
+            g["step_programs"] = sp.get("decode", 0)
         mi = getattr(eng, "mesh_info", lambda: None)()
         if mi is not None:
             # tensor-parallel serving (r10): mesh layout on the scrape
@@ -986,6 +1000,16 @@ def main(argv=None) -> None:
              "interactive TPOT, larger chunks finish batch prefills "
              "sooner")
     parser.add_argument(
+        "--no-fused-step", action="store_true",
+        help="disable the fused decode hot path (r13: attention + "
+             "out-projection folded into one kernel, sampling streamed "
+             "through the lm_head so [B, vocab] logits never hit HBM). "
+             "The fused path is the default; greedy outputs are "
+             "bit-identical either way on the CPU reference lane "
+             "(on-chip Mosaic-kernel parity is chip-pending "
+             "validation), and this escape hatch restores the "
+             "byte-for-byte pre-r13 programs")
+    parser.add_argument(
         "--speculate", type=int, default=0, metavar="K",
         help="draft K tokens per decode step and verify them in one "
              "forward (0 = off); greedy outputs stay bit-identical")
@@ -1023,6 +1047,10 @@ def main(argv=None) -> None:
         # rides in engine_kwargs, so the resurrection recipe rebuilds
         # a chunked engine too
         engine_kwargs["prefill_chunk_tokens"] = args.prefill_chunk
+    if args.no_fused_step:
+        # rides in engine_kwargs, so a resurrected engine honors the
+        # escape hatch too (fused is the engine default)
+        engine_kwargs["fused_step"] = False
     mesh_desc = "single-device"
     if args.mesh is not None:
         from ..distributed.topology import (make_serving_mesh,

@@ -637,6 +637,12 @@ def main(argv=None) -> None:
              "tokens prefilled per decode step; default: whole-prompt "
              "prefill)")
     parser.add_argument(
+        "--no-fused-step", action="store_true",
+        help="disable the fused decode hot path on every replica "
+             "(threaded to each replica's server as its "
+             "--no-fused-step; fused is the default, greedy outputs "
+             "are bit-identical either way)")
+    parser.add_argument(
         "server_args", nargs="*",
         help="extra args passed to every replica's "
              "`python -m paddle_tpu.serving.server` (e.g. "
@@ -686,6 +692,8 @@ def main(argv=None) -> None:
         server_args += ["--mesh", args.mesh]
     if args.prefill_chunk is not None:
         server_args += ["--prefill-chunk", str(args.prefill_chunk)]
+    if args.no_fused_step:
+        server_args += ["--no-fused-step"]
     sup = Supervisor(model=args.model, replicas=args.replicas,
                      host=args.host, server_args=server_args,
                      probe_interval_s=args.probe_interval_s,

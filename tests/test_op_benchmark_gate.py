@@ -18,19 +18,32 @@ TOOLS = os.path.join(ROOT, "tools")
 sys.path.insert(0, TOOLS)
 
 
-def test_committed_baselines_are_complete():
-    from op_benchmark import default_cases
+def _load_platform(platform):
+    d = os.path.join(TOOLS, "op_baselines", platform)
+    assert os.path.isdir(d), f"missing committed baseline: {d}"
+    cases = {}
+    for fn in os.listdir(d):
+        with open(os.path.join(d, fn)) as f:
+            r = json.loads(f.read().strip())
+        cases[r["case"]] = r
+    return cases
 
-    for platform in ("cpu_smoke", "tpu_v5e"):
-        d = os.path.join(TOOLS, "op_baselines", platform)
-        assert os.path.isdir(d), f"missing committed baseline: {d}"
-        cases = {}
-        for fn in os.listdir(d):
-            with open(os.path.join(d, fn)) as f:
-                r = json.loads(f.read().strip())
-            cases[r["case"]] = r
-        assert set(cases) == set(default_cases()), (
-            platform, sorted(set(default_cases()) - set(cases)))
+
+def test_committed_baselines_are_complete():
+    """cpu_smoke carries default + promoted cases (r13: the promoted
+    tier has REAL cpu baselines, only its chip number is pending);
+    tpu_v5e carries exactly the default set — a promoted case showing
+    up there means it should graduate into default_cases()."""
+    from op_benchmark import default_cases, promoted_cases
+
+    cpu = _load_platform("cpu_smoke")
+    assert set(cpu) == set(default_cases()) | set(promoted_cases()), (
+        sorted((set(default_cases()) | set(promoted_cases()))
+               ^ set(cpu)))
+    tpu = _load_platform("tpu_v5e")
+    assert set(tpu) == set(default_cases()), (
+        sorted(set(default_cases()) ^ set(tpu)))
+    for cases in (cpu, tpu):
         assert all(r["avg_us"] > 0 for r in cases.values())
 
 
@@ -60,6 +73,52 @@ def test_compare_flags_regressions(tmp_path):
          "--develop_logs_dir", str(dev), "--pr_logs_dir", str(pr)],
         capture_output=True)
     assert r.returncode == 8
+
+
+def test_promoted_cases_are_real_ops_and_cpu_gated(tmp_path):
+    """Promoted-tier cases (r13: real committed cpu_smoke baselines,
+    tpu_v5e chip-pending — paged_attention_head_sharded,
+    prefill_chunk_step, and the three fused decode-hot-path shape
+    classes) must be (1) real registered dispatch entries, (2)
+    disjoint from the default and pending tiers, and (3) re-measurable
+    on this host within the catastrophic 4x threshold against their
+    committed cpu_smoke baseline — the same live gate the default
+    cases get."""
+    from check_op_benchmark_result import compare, load_logs_dir
+    from op_benchmark import (default_cases, pending_cases,
+                              promoted_cases)
+
+    import paddle_tpu.dispatch as dispatch
+
+    prom = promoted_cases()
+    assert prom, "drop this test when the promoted tier empties"
+    assert not set(prom) & set(default_cases())
+    assert not set(prom) & set(pending_cases())
+    for name, builder in prom.items():
+        assert getattr(builder, "op_name", name) \
+            in dispatch.wrapped_ops, name
+
+    dev = load_logs_dir(os.path.join(TOOLS, "op_baselines", "cpu_smoke"))
+    dev = {k: v for k, v in dev.items() if k in prom}
+    assert set(dev) == set(prom)
+
+    def measure(out_dir):
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "op_benchmark.py"),
+             "--platform", "cpu", "--ops", ",".join(sorted(prom)),
+             "--repeat", "10", "--output", str(out_dir)],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stderr[-2000:]
+        return load_logs_dir(str(out_dir))
+
+    failures, checked = compare(dev, measure(tmp_path / "pr"),
+                                threshold=4.0)
+    assert checked == len(prom)
+    if failures:  # transient host-load spike: reproduce before failing
+        failures, _ = compare(dev, measure(tmp_path / "pr2"),
+                              threshold=4.0)
+    assert not failures, failures
 
 
 def test_pending_cases_are_tracked_and_cpu_gated(tmp_path):

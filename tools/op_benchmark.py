@@ -54,57 +54,109 @@ def default_cases():
     }
 
 
+def _paged_case():
+    # decode-shaped ragged paged attention: 8 sequences, 16-token
+    # pages, ragged lengths spanning 1..8 pages (the kernel-contract
+    # shape class; on cpu the dense-gather reference runs)
+    n_pages, page, h, d = 65, 16, 8, 64
+    kp = _f32(n_pages, page, h, d)
+    vp = _f32(n_pages, page, h, d)
+    table = np.arange(8 * 8, dtype=np.int32).reshape(8, 8)
+    lens = np.asarray([128, 112, 96, 80, 64, 48, 32, 16], np.int32)
+    return (_f32(8, 1, h, d), kp, vp, table, lens)
+
+
+def _prefill_chunk_case():
+    # one chained prefill chunk (r11 chunked prefill / chained
+    # suffix prefill hot shape): a 64-token chunk appended at
+    # position 128 attends the stored 128-token prefix plus itself
+    # through the q_offsets path — seq_lens is the POST-append
+    # length, q_offsets the chunk's first absolute position. The
+    # r13 fusion landed against this mixed prefill+decode shape class,
+    # not just s=1 decode.
+    n_pages, page, h, d = 65, 16, 8, 64
+    done, chunk = 128, 64
+    kp = _f32(n_pages, page, h, d)
+    vp = _f32(n_pages, page, h, d)
+    table = np.arange(12, dtype=np.int32).reshape(1, 12)
+    lens = np.asarray([done + chunk], np.int32)
+    q_offsets = np.asarray([done], np.int32)
+    # positional tail (k_scale, v_scale, scale) stays None-static
+    return (_f32(1, chunk, h, d), kp, vp, table, lens,
+            None, None, None, q_offsets)
+
+
+_prefill_chunk_case.op_name = "paged_attention"
+
+
 def pending_cases():
     """Ops benchable through this harness whose baseline set is not yet
-    complete on every platform (tools/op_baselines/PENDING.json records
-    which platform is missing and why). Kept OUT of default_cases() so
-    test_op_benchmark_gate's completeness check over the committed
-    baseline dirs stays exact; the gate covers these via the
-    *_pending baseline dirs instead.
+    complete on ANY committed platform dir (tools/op_baselines/
+    PENDING.json records which platform is missing and why). Kept OUT
+    of default_cases() so test_op_benchmark_gate's completeness check
+    over the committed baseline dirs stays exact; the gate covers
+    these via the *_pending baseline dirs instead.
 
     A case whose name is not itself a registered op (a named SHAPE
-    CLASS of one, e.g. prefill_chunk_step) carries the op on its
-    builder's ``op_name`` attribute — bench_op and the gate test
-    resolve through it."""
-    def paged():
-        # decode-shaped ragged paged attention: 8 sequences, 16-token
-        # pages, ragged lengths spanning 1..8 pages (the kernel-contract
-        # shape class; on cpu the dense-gather reference runs)
-        n_pages, page, h, d = 65, 16, 8, 64
-        kp = _f32(n_pages, page, h, d)
-        vp = _f32(n_pages, page, h, d)
-        table = np.arange(8 * 8, dtype=np.int32).reshape(8, 8)
-        lens = np.asarray([128, 112, 96, 80, 64, 48, 32, 16], np.int32)
-        return (_f32(8, 1, h, d), kp, vp, table, lens)
+    CLASS of one) carries the op on its builder's ``op_name``
+    attribute — bench_op and the gate test resolve through it."""
+    return {"paged_attention": _paged_case}
 
-    def prefill_chunk():
-        # one chained prefill chunk (r11 chunked prefill / chained
-        # suffix prefill hot shape): a 64-token chunk appended at
-        # position 128 attends the stored 128-token prefix plus itself
-        # through the q_offsets path — seq_lens is the POST-append
-        # length, q_offsets the chunk's first absolute position. The
-        # r11+ fusion work (ROADMAP item 3) must land against this
-        # mixed prefill+decode shape class, not just s=1 decode.
+
+def promoted_cases():
+    """Cases with a REAL committed cpu_smoke baseline (gated by
+    test_op_benchmark_gate exactly like default_cases' cpu lane) whose
+    tpu_v5e number is still chip-pending — the r13 burn-down of the
+    staged pending tier: `paged_attention_head_sharded` and
+    `prefill_chunk_step` were promoted out of PENDING.json, and the
+    r13 fused decode hot path lands its three shape classes here with
+    baselines from day one.
+
+    Chip-pending paper trail (the PENDING.json role for this tier):
+    each case's tpu_v5e log requires tools/op_benchmark_tpu.sh on a
+    chip-attached host, where the Mosaic kernels run instead of the
+    CPU references these baselines measure; BENCH_STAGED.json
+    conventions.r13_updates records the gap. Once measured on chip,
+    move the case into default_cases() and its log into
+    op_baselines/tpu_v5e/."""
+    def fused_decode_step():
+        # r13 fused decode hot shape: the SAME ragged decode class as
+        # paged_attention with the out-projection epilogue folded in
+        # (one launch for attention + head-concat + o-proj + bias)
+        h, d = 8, 64
+        e = h * d
+        return _paged_case() + (_f32(e, e), _f32(e))
+
+    fused_decode_step.op_name = "paged_attention_fused"
+
+    def fused_verify():
+        # r13 one-program speculative verify shape: a k+1 = 5-position
+        # verify window appended at position 128 scores through the
+        # chained q_offsets path WITH the fused epilogue
         n_pages, page, h, d = 65, 16, 8, 64
-        done, chunk = 128, 64
+        done, s = 128, 5
+        e = h * d
         kp = _f32(n_pages, page, h, d)
         vp = _f32(n_pages, page, h, d)
         table = np.arange(12, dtype=np.int32).reshape(1, 12)
-        lens = np.asarray([done + chunk], np.int32)
+        lens = np.asarray([done + s], np.int32)
         q_offsets = np.asarray([done], np.int32)
-        # positional tail (k_scale, v_scale, scale) stays None-static
-        return (_f32(1, chunk, h, d), kp, vp, table, lens,
-                None, None, None, q_offsets)
+        return (_f32(1, s, h, d), kp, vp, table, lens, _f32(e, e),
+                _f32(e), None, None, None, q_offsets)
 
-    prefill_chunk.op_name = "paged_attention"
+    fused_verify.op_name = "paged_attention_fused"
 
-    # paged twice: the SAME decode shape class dispatched head-sharded
-    # over a serving mesh (min(2, device_count) — the op's benchable
-    # default), so the r10 fusion work (ROADMAP item 3) lands against
-    # a tensor-parallel baseline too, not just the single-device kernel
-    return {"paged_attention": paged,
-            "paged_attention_head_sharded": paged,
-            "prefill_chunk_step": prefill_chunk}
+    def fused_sample():
+        # r13 streaming lm_head sampling: greedy argmax over vocab
+        # tiles of a [4096, 256] vocab-major head — the [B, vocab]
+        # logits tensor never materializes (tile=1024 -> 4 tiles)
+        return (_f32(8, 256), _f32(4096, 256), None, True, None, 1024)
+
+    return {"paged_attention_head_sharded": _paged_case,
+            "prefill_chunk_step": _prefill_chunk_case,
+            "fused_decode_step": fused_decode_step,
+            "fused_verify": fused_verify,
+            "fused_sample": fused_sample}
 
 
 def bench_op(name: str, make_args, repeat: int) -> dict:
@@ -203,8 +255,9 @@ def main() -> int:
     import paddle_tpu  # noqa: F401 - registers ops
 
     cases = default_cases()
-    if args.ops:  # pending cases run only when asked for by name
+    if args.ops:  # pending/promoted cases run only when asked by name
         cases.update(pending_cases())
+        cases.update(promoted_cases())
         wanted = args.ops.split(",")
         missing = [w for w in wanted if w not in cases]
         if missing:
