@@ -1194,6 +1194,130 @@ def bench_serving_prefix(on_tpu: bool) -> Dict:
     return out
 
 
+def bench_prefix_tiers(on_tpu: bool) -> Dict:
+    """Hierarchical prefix cache A/B (r15 tentpole artifact): a
+    RE-VISITED shared-system-prompt stream at cache depth >> the
+    device pool. N distinct system prompts are cycled for several
+    rounds with the pool sized so the chains cannot all stay resident:
+    every revisit finds its prefix EVICTED. With the spill tier OFF
+    the prefix re-prefills from scratch; with it ON the evicted pages
+    restore via one device_put + page-table splice each
+    (serving/prefix_cache.py spill tiers). Reported per mode: TTFT
+    p50/p99, prefill-ms p50, tokens actually prefilled (prompt minus
+    cached/restored — the re-prefill compute the tiers exist to
+    kill), restored pages and restore-ms."""
+    import paddle_tpu as pt
+    from paddle_tpu.inference import create_decode_engine
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import PrefixCache
+
+    if on_tpu:
+        cfg = _decode_1p3b_cfg()
+        slots, page, max_seq = 4, 64, 1024
+        sys_len, tail, new_toks = 512, 16, 16
+        n_prefix, rounds = 8, 3
+        num_pages = 24          # << n_prefix chains of 8 pages
+        spill = 1 << 32
+    else:
+        # a beefed-up tiny config: enough per-token prefill compute
+        # that the A/B measures restore-vs-reprefill, not just CPU
+        # launch overhead (at stock gpt_tiny scale every prefill is
+        # ~one dispatch, so there is nothing for a restore to save)
+        from paddle_tpu.models.gpt import GPTConfig
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                        num_heads=4, max_seq_len=256, dropout=0.0,
+                        attn_dropout=0.0)
+        slots, page, max_seq = 2, 16, 256
+        sys_len, tail, new_toks = 200, 8, 8
+        n_prefix, rounds = 6, 3
+        num_pages = 20          # << 6 chains x 12 full prompt pages
+        spill = 1 << 27
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        _to_bf16_except_norms(model)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompts = [np.concatenate([
+        rng.integers(0, cfg.vocab_size, (sys_len,)).astype(np.int32),
+        rng.integers(0, cfg.vocab_size, (tail,)).astype(np.int32)])
+        for _ in range(n_prefix)]
+
+    def run_mode(spill_on: bool) -> Dict:
+        pc = PrefixCache(page, spill_bytes=spill if spill_on else None)
+        eng = create_decode_engine(
+            model, num_slots=slots, page_size=page,
+            max_seq_len=max_seq, num_pages=num_pages, prefix_cache=pc)
+        finished = []
+        # warm the compiles (fresh + CHAINED prefill, decode, splice)
+        # through the measured engine, then drain — metrics attach
+        # after so compile time never pollutes TTFT. prompts[0] twice:
+        # the second admission hits the cache and compiles the chained
+        # suffix-prefill program both modes use on every revisit.
+        for p in (prompts[0], prompts[1], prompts[0]):
+            eng.submit(p, max_new_tokens=2)
+            eng.run()
+        if spill_on:
+            pc.evict_until(eng.allocator, eng.allocator.num_pages)
+            eng.submit(prompts[0], max_new_tokens=2)
+            eng.run()  # pays the splice-jit bucket compile
+        eng.set_on_complete(lambda req: finished.append(req.stats))
+        t0 = time.perf_counter()
+        # SERIAL revisit stream: one request in flight at a time, so
+        # TTFT is queue-free and measures exactly the prefill-vs-
+        # restore difference the A/B is about
+        for _ in range(rounds):
+            for p in prompts:
+                eng.submit(p, max_new_tokens=new_toks)
+                eng.run()
+        wall = time.perf_counter() - t0
+        ttfts = [(s.ttft_s or 0) * 1e3 for s in finished]
+        prefills = [s.prefill_ms for s in finished]
+
+        def pctl(vals, q):
+            # np.percentile like every other serving bench entry, so
+            # cross-entry TTFT comparisons share one basis
+            return round(float(np.percentile(vals, q)), 3)
+
+        out = {"requests": len(finished),
+               "wall_s": round(wall, 3),
+               "ttft_ms_p50": pctl(ttfts, 50),
+               "ttft_ms_p99": pctl(ttfts, 99),
+               "prefill_ms_p50": pctl(prefills, 50),
+               # the number the tiers exist to shrink: tokens whose
+               # prefill actually ran (cached/restored pages skip it)
+               "prefilled_tokens": int(sum(
+                   s.prompt_len - s.cached_tokens for s in finished)),
+               "cache": {"hit_rate": round(pc.hit_rate() or 0.0, 4),
+                         "spilled_pages": pc.spilled_pages,
+                         "restored_pages": pc.restored_pages,
+                         "tier_stats": pc.tier_stats()}}
+        # measured-only: pc.restored_pages includes warmup restores,
+        # so gate on the per-request stats actually collected
+        rms = [s.restore_ms for s in finished if s.restored_pages]
+        if rms:
+            out["restore_ms_p50"] = pctl(rms, 50)
+        eng.close()
+        return out
+
+    off = run_mode(False)
+    on = run_mode(True)
+    out: Dict = {"metric": "gpt1p3b_prefix_tiers_ab_chip" if on_tpu
+                 else "gpt_tiny_prefix_tiers_ab_cpu_smoke",
+                 "distinct_prefixes": n_prefix, "rounds": rounds,
+                 "system_prompt_len": sys_len, "tail_len": tail,
+                 "num_pages": num_pages, "page_size": page,
+                 "spill_off": off, "spill_on": on}
+    if off["ttft_ms_p50"] and on["ttft_ms_p50"]:
+        out["ttft_p50_speedup"] = round(
+            off["ttft_ms_p50"] / on["ttft_ms_p50"], 3)
+    if off["prefilled_tokens"]:
+        out["reprefill_tokens_saved"] = (off["prefilled_tokens"]
+                                         - on["prefilled_tokens"])
+    return out
+
+
 def bench_speculative_decode(on_tpu: bool) -> Dict:
     """Speculative-decoding A/B (r8 tentpole artifact): the SAME
     request stream through the continuous-batching engine vanilla vs
@@ -1614,6 +1738,7 @@ def run_staged(on_tpu: bool) -> Dict:
                      ("chunked_prefill", bench_chunked_prefill),
                      ("mesh_decode", bench_mesh_decode),
                      ("serving_prefix", bench_serving_prefix),
+                     ("prefix_tiers", bench_prefix_tiers),
                      ("speculative_decode", bench_speculative_decode),
                      ("compile_cache", bench_compile_cache),
                      ("moe_dispatch", bench_moe_dispatch),

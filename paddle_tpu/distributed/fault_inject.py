@@ -41,6 +41,11 @@ net.recv             serving/server.py connection reader and the
                      supervisor's failover-router backend reader —
                      the connection dies like a torn socket; keyed
                      requests are resubmitted to a live replica
+cache.spill          serving/prefix_cache.py spill-tier blob write
+                     (eviction) and read (restore); "torn" corrupts
+                     the written blob so the restore-side crc32 must
+                     catch it — either way the page degrades to a
+                     cache miss and chained prefill recomputes it
 ==================== =================================================
 
 Default-OFF: with no sites armed (the tier-1 default), ``fault_point``
@@ -94,6 +99,11 @@ FAULT_SITES: Dict[str, str] = {
     "engine.step": "decode-engine step (pre-admission, pre-jit)",
     "alloc.page": "page-allocator alloc/reserve (pre-mutation)",
     "net.recv": "connection receive (server + failover router)",
+    "cache.spill": "prefix-cache spill-tier blob write/read "
+                   "(serving/prefix_cache.py; write side implements "
+                   "'torn' — a corrupted blob the restore-side crc32 "
+                   "must catch; either side degrades to a cache miss "
+                   "and the chained-prefill fallback recomputes)",
 }
 
 # Fast-path gate: False whenever no injector exists or no site is armed,

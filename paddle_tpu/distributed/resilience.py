@@ -216,6 +216,11 @@ NO_RETRY_SITES: Dict[str, str] = {
     "net.recv": "connection-level: the failover router resubmits "
                 "keyed requests to a live replica "
                 "(serving/supervisor.py)",
+    "cache.spill": "a failed or corrupt spill blob degrades to a "
+                   "prefix-cache miss and the chained-prefill "
+                   "fallback recomputes the pages "
+                   "(serving/prefix_cache.py); retrying the blob IO "
+                   "in place would buy nothing the fallback doesn't",
 }
 
 _site_policies: Dict[str, RetryPolicy] = {}

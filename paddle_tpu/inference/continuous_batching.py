@@ -231,6 +231,15 @@ class RequestStats:
     prompt_len: int = 0
     cached_pages: int = 0          # prefix-cache pages reused at admit
     cached_tokens: int = 0         # = cached_pages * page_size
+    # hierarchical prefix cache (r15): pages restored from spill tiers
+    # at admission (a subset of cached_pages — restored pages skip
+    # their prefill exactly like device hits, at the cost of one
+    # device_put + page-table splice, whose wall time is restore_ms)
+    restored_pages: int = 0
+    restored_host_pages: int = 0
+    restored_disk_pages: int = 0
+    restore_corrupt: int = 0       # corrupt blobs hit (fell back typed)
+    restore_ms: float = 0.0
     prompt_pages: int = 0          # shareable full pages in the prompt
     cache_enabled: bool = False    # a prefix cache was configured
     prefill_attempts: int = 0      # 1 = first try succeeded
@@ -470,6 +479,17 @@ class ContinuousBatchingEngine:
                 f"prefix_cache.page_size {cache_ps} != engine "
                 f"page_size {self.page_size}")
         self._prefix_cache = prefix_cache
+        # hierarchical prefix cache (r15): a cache carrying spill tiers
+        # needs device IO — how to copy an evicted page's KV to host
+        # (spill) and splice a restored blob into a fresh page. The
+        # splice is one jitted donate-in-place scatter per restore
+        # (models/gpt.py paged_page_splice), compiled once; the spill
+        # read is one jitted stacked gather (same discipline).
+        self._splice_jit = None
+        self._gather_jit = None
+        if getattr(prefix_cache, "tiers", None):
+            prefix_cache.attach_device_io(self._read_page,
+                                          self._splice_page)
         self._prefill_retry = prefill_retry
         self._on_complete = on_complete
         self.max_prefill_attempts = int(max_prefill_attempts)
@@ -796,6 +816,93 @@ class ContinuousBatchingEngine:
 
         return {"k": pin(pools["k"]), "v": pin(pools["v"]),
                 "ks": pin(pools["ks"]), "vs": pin(pools["vs"])}
+
+    # -- spill-tier device IO (r15) -----------------------------------------
+
+    def _read_page(self, page: int) -> List[Tuple]:
+        """Copy one pool page device→host for the prefix cache's spill
+        tier: per layer (k, v, k_scale, v_scale) numpy blocks. Runs at
+        eviction time on the engine thread; indexing the live pools is
+        a read, so the donated buffers are untouched. The per-layer
+        slices are stacked in ONE jitted gather so the spill costs one
+        launch plus one transfer per pool KIND — not 2-4 sequential
+        device round-trips per LAYER (the batched-splice discipline,
+        applied to the read side)."""
+        import jax
+
+        jnp = self._jnp
+        if self._gather_jit is None:
+            def gather(pools, pg):
+                k = jnp.stack([p[pg] for p in pools["k"]])
+                v = jnp.stack([p[pg] for p in pools["v"]])
+                ks = vs = None
+                if self.kv_int8:
+                    ks = jnp.stack([p[pg] for p in pools["ks"]])
+                    vs = jnp.stack([p[pg] for p in pools["vs"]])
+                return k, v, ks, vs
+
+            self._gather_jit = jax.jit(gather)
+        k, v, ks, vs = self._gather_jit(
+            self._pools, jnp.asarray(page, jnp.int32))
+        k, v = np.asarray(k), np.asarray(v)
+        ks = None if ks is None else np.asarray(ks)
+        vs = None if vs is None else np.asarray(vs)
+        return [(k[i], v[i],
+                 None if ks is None else ks[i],
+                 None if vs is None else vs[i])
+                for i in range(self._nl)]
+
+    def _splice_page(self, pages: Sequence[int],
+                     layers_list: Sequence[Sequence[Tuple]]) -> None:
+        """Restore a run of spilled pages in ONE batched device call:
+        stack the per-page/per-layer host blocks and scatter them into
+        every pool through a single jitted donate-in-place program
+        (models/gpt.py paged_page_splice). The page indices are
+        traced, and the batch is padded to a power-of-two bucket
+        targeting the SCRATCH page (whose content is garbage by
+        contract — masked writes land there every step), so the jit
+        compiles once per bucket size, not once per restore shape.
+        This is the whole restore-vs-reprefill trade: one device_put
+        plus one scatter launch against the suffix prefill it
+        replaces."""
+        import jax
+
+        jnp = self._jnp
+        n = len(pages)
+        nb = 1
+        while nb < n:
+            nb *= 2
+        pad = nb - n
+
+        def stack(idx):
+            blocks = [np.stack([layers[i][idx] for layers in
+                                layers_list]) for i in range(self._nl)]
+            out = np.stack(blocks)            # [nl, n, page, ...]
+            if pad:
+                z = np.zeros(out.shape[:1] + (pad,) + out.shape[2:],
+                             out.dtype)
+                out = np.concatenate([out, z], axis=1)
+            return out
+
+        k, v = stack(0), stack(1)
+        ks = vs = None
+        if self.kv_int8:
+            ks, vs = stack(2), stack(3)
+        page_idx = np.asarray(list(pages) + [self._scratch] * pad,
+                              np.int32)
+        if self._splice_jit is None:
+            from ..models.gpt import paged_page_splice
+
+            def splice(pools, pg, kb, vb, ksb, vsb):
+                return self._constrain_pools(
+                    paged_page_splice(pools, pg, kb, vb, ksb, vsb))
+
+            self._splice_jit = jax.jit(splice, donate_argnums=(0,))
+        from ..dispatch import count_op_calls
+        with count_op_calls() as c:
+            self._pools = self._splice_jit(
+                self._pools, jnp.asarray(page_idx), k, v, ks, vs)
+        self._record_programs("restore", c.count)
 
     def mesh_info(self) -> Optional[Dict[str, Any]]:
         """Mesh observability record (server stats / Prometheus):
@@ -1325,10 +1432,37 @@ class ContinuousBatchingEngine:
         shared: List[int] = []
         if cache is not None:
             keys, shared = cache.match(req.prompt, memo=req)
-            # pin the matched chain BEFORE allocating: the eviction
-            # fallback below must never reclaim pages we are about to
-            # point this slot's table row at
+            # a device hit is a device hit; the DISTINCTION from
+            # restored pages matters for the per-tier counters, so
+            # remember where the device chain ended (insert() and the
+            # stats below use it)
+            req._pfx_device_hits = len(keys)
+            # pin the matched chain BEFORE restore/allocation: both
+            # the restore's own eviction pressure and the fallback
+            # below must never reclaim pages we are about to point
+            # this slot's table row at
             cache.acquire(keys)
+            if getattr(cache, "spill_enabled", False):
+                # hierarchical tiers (r15): extend the device match by
+                # restoring spilled blobs into fresh pages (device_put
+                # + page-table splice) — each restored page is one
+                # prefix page this request does NOT re-prefill. A tier
+                # miss mid-chain just stops here; the chained-prefill
+                # suffix path below covers the rest, so outputs are
+                # bit-identical either way.
+                rkeys, rpages, rinfo = cache.restore_from_spill(
+                    req.prompt, keys, self.allocator, memo=req)
+                if rkeys:
+                    cache.acquire(rkeys)
+                    keys = tuple(keys) + rkeys
+                    shared = list(shared) + rpages
+                if rkeys or rinfo.get("corrupt"):
+                    st = req.stats
+                    st.restored_pages += len(rkeys)
+                    st.restored_host_pages += rinfo.get("host", 0)
+                    st.restored_disk_pages += rinfo.get("disk", 0)
+                    st.restore_corrupt += rinfo.get("corrupt", 0)
+                    st.restore_ms += rinfo.get("ms", 0.0)
         cached_len = len(shared) * self.page_size
         capacity = len(req.prompt) + req.max_new_tokens
         need = -(-capacity // self.page_size)
@@ -1466,7 +1600,8 @@ class ContinuousBatchingEngine:
             # this request until it finishes)
             req.cache_keys = cache.insert(
                 req.prompt, row, self.allocator, req.req_id,
-                self.page_size, keys)
+                self.page_size, keys,
+                device_hits=getattr(req, "_pfx_device_hits", None))
         self._slots[slot] = req
         self._emit_token(req, int(nxt))
         self._maybe_finish(slot)
@@ -1598,7 +1733,8 @@ class ContinuousBatchingEngine:
             # from admission are the already-acquired chain head)
             req.cache_keys = cache.insert(
                 req.prompt, row, self.allocator, req.req_id,
-                self.page_size, req.cache_keys)
+                self.page_size, req.cache_keys,
+                device_hits=getattr(req, "_pfx_device_hits", None))
         self._emit_token(req, int(nxt))
         self._maybe_finish(slot)
         return True

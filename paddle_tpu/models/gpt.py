@@ -328,6 +328,36 @@ def paged_kv_append(cache: PagedKVCache, k, v, valid_len=None):
                         cache.page_table, new_lens)
 
 
+def paged_page_splice(pools, page, k_blocks, v_blocks,
+                      ks_blocks=None, vs_blocks=None):
+    """Restore spilled prefix pages into the engine's per-layer pools
+    (r15 hierarchical prefix cache): write layer i's KV blocks
+    (``k_blocks``/``v_blocks`` [nl, n, page, H, D], plus
+    [nl, n, page, H] scales for int8 pools) into every pool at the n
+    page indices ``page`` ([n] int32 — or a scalar with unbatched
+    [nl, page, ...] blocks). ``pools`` is the engine's ``{"k": [...],
+    "v": [...], "ks": [...], "vs": [...]}`` per-layer dict; returns
+    the same structure. jit-friendly with ``page`` traced — one
+    compile per batch bucket serves every restore — and pure, so the
+    engine donates the pools for an in-place scatter exactly like the
+    decode step's appends (inference/continuous_batching.py
+    ``_splice_page``)."""
+    from ..ops.nn_functional import paged_page_splice as _splice_one
+
+    def put(pool_list, blocks):
+        return [_splice_one(pool, blocks[i], page)
+                for i, pool in enumerate(pool_list)]
+
+    return {
+        "k": put(pools["k"], k_blocks),
+        "v": put(pools["v"], v_blocks),
+        "ks": (list(pools["ks"]) if ks_blocks is None
+               else put(pools["ks"], ks_blocks)),
+        "vs": (list(pools["vs"]) if vs_blocks is None
+               else put(pools["vs"], vs_blocks)),
+    }
+
+
 def _remat_block(block, x):
     """Run ``block`` under jax.checkpoint as ONE taped op: the pure kernel
     takes (hidden, *param_values) so the eager tape differentiates through

@@ -44,7 +44,7 @@ _NON_DIFF_OPS = {
     "retinanet_detection_output", "generate_proposal_labels",
     "generate_mask_labels",
     "paged_attention", "paged_attention_head_sharded",
-    "paged_attention_fused", "fused_sample",
+    "paged_attention_fused", "fused_sample", "paged_page_splice",
     "crf_decoding", "gather_tree", "beam_search_decode", "shuffle_batch",
     "digitize", "bitwise_left_shift", "bitwise_right_shift",
     "is_complex", "is_floating_point", "rank",

@@ -940,6 +940,19 @@ def fused_sample(hidden, weight, bias=None, transpose_y=False,
                  top_k=top_k, tile=tile)
 
 
+def paged_page_splice(pool, block, page=0):
+    """Prefix-cache restore splice (r15 hierarchical prefix cache):
+    write one page's restored content ``block`` ([page, H, D] KV
+    block, or [page, H] scale block for int8 pools) into ``pool`` at
+    page index ``page`` ([P+1, page, ...]; the same pool layout
+    `paged_attention` walks). ``page`` may be a traced scalar, so the
+    engine's jitted restore compiles ONCE and splices any page index
+    (inference/continuous_batching.py restores evicted spill-tier
+    blobs through this — a device_put plus this scatter replaces the
+    prefix's whole prefill)."""
+    return pool.at[page].set(jnp.asarray(block).astype(pool.dtype))
+
+
 @functools.lru_cache(maxsize=None)
 def _default_serving_mesh(model_parallel: int):
     """Memoized benchable-default mesh for

@@ -18,7 +18,12 @@ continuous batching, PR r6) into a servable system:
   compute entirely), divergence is handled by writing the suffix into
   fresh private pages (shared pages are never mutated), LRU eviction
   fires only at refcount 0, and greedy outputs stay bit-identical to
-  the uncached path (tests/test_serving.py).
+  the uncached path (tests/test_serving.py). Hierarchical spill tiers
+  (r15): with ``spill_bytes``/``spill_dir`` configured, evicted pages
+  survive as crc32-checked host-RAM/disk blobs and a later hit
+  restores them via one device_put + page-table splice instead of a
+  re-prefill; the failover router steers keyed requests to the
+  replica advertising their prefix (tests/test_prefix_tiers.py).
 - ``metrics``: per-request TTFT / TPOT / queue-delay histograms and
   cache-hit / shed counters in core.monitor's StatRegistry, with a
   Prometheus-style text export — plus speculative-decoding
@@ -49,7 +54,8 @@ management is what makes cross-request prefix sharing possible.
 """
 
 from .metrics import Histogram, ServingMetrics  # noqa: F401
-from .prefix_cache import PrefixCache  # noqa: F401
+from .prefix_cache import (DiskSpillTier, HostSpillTier,  # noqa: F401
+                           PrefixCache, SpillCorrupt)
 from .scheduler import (Priority, ServerOverloaded, SLOConfig,  # noqa: F401
                         SLOScheduler)
 

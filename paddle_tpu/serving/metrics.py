@@ -139,7 +139,15 @@ class ServingMetrics:
                 # _total suffix for counter families, which would
                 # collide with the serving_prefill_chunks HISTOGRAM
                 # family on strict parsers.
-                "prefill_chunk_launches_total")
+                "prefill_chunk_launches_total",
+                # hierarchical prefix cache (r15): per-tier hit split —
+                # cache_hit_pages_total stays TOTAL reuse (device +
+                # restored), these break out the spill-tier share —
+                # plus the typed corrupt-blob fallback count
+                "cache_host_hit_pages_total",
+                "cache_disk_hit_pages_total",
+                "cache_restored_pages_total",
+                "cache_restore_corrupt_total")
 
     def __init__(self, registry: Optional[StatRegistry] = None,
                  prefix: str = "serving"):
@@ -169,6 +177,10 @@ class ServingMetrics:
             f"{prefix}.prefill_chunks", buckets=CHUNK_COUNT_BUCKETS)
         self.prefill_chunk_ms = Histogram(
             f"{prefix}.prefill_chunk_ms")
+        # hierarchical prefix cache (r15): wall time of the spill-tier
+        # restore at admission (device_put + page-table splice) — the
+        # number that must sit well under the prefill it replaces
+        self.restore_ms = Histogram(f"{prefix}.restore_ms")
 
     def counter(self, name: str):
         return self.registry.get(f"{self.prefix}.{name}")
@@ -190,6 +202,7 @@ class ServingMetrics:
             buckets=CHUNK_COUNT_BUCKETS)
         self.prefill_chunk_ms = Histogram(
             f"{self.prefix}.prefill_chunk_ms")
+        self.restore_ms = Histogram(f"{self.prefix}.restore_ms")
 
     # -- ingestion ---------------------------------------------------------
 
@@ -219,6 +232,22 @@ class ServingMetrics:
             # describe complete prefills)
             self.counter("prefill_chunk_launches_total").add(
                 st.prefill_chunks)
+        if st.restored_pages or st.restore_corrupt:
+            # spill-tier restore work happened at admission, so it is
+            # counted for every terminal state too (r15)
+            self.counter("cache_restored_pages_total").add(
+                st.restored_pages)
+            if st.restored_host_pages:
+                self.counter("cache_host_hit_pages_total").add(
+                    st.restored_host_pages)
+            if st.restored_disk_pages:
+                self.counter("cache_disk_hit_pages_total").add(
+                    st.restored_disk_pages)
+            if st.restore_corrupt:
+                self.counter("cache_restore_corrupt_total").add(
+                    st.restore_corrupt)
+            if st.restore_ms:
+                self.restore_ms.observe(st.restore_ms)
         if req.state == "shed":
             self.counter("shed_total").add()
             return
@@ -293,6 +322,7 @@ class ServingMetrics:
                 self.spec_tokens_per_step.snapshot(),
             "prefill_chunks": self.prefill_chunks.snapshot(),
             "prefill_chunk_ms": self.prefill_chunk_ms.snapshot(),
+            "restore_ms": self.restore_ms.snapshot(),
         }
 
     def prometheus_text(self) -> str:
@@ -307,7 +337,7 @@ class ServingMetrics:
         for h in (self.ttft_ms, self.tpot_ms, self.queue_delay_ms,
                   self.prefill_ms, self.e2e_ms, self.spec_accept_rate,
                   self.spec_tokens_per_step, self.prefill_chunks,
-                  self.prefill_chunk_ms):
+                  self.prefill_chunk_ms, self.restore_ms):
             lines.extend(h.prometheus_lines())
         for name, val in sorted(self.gauges().items()):
             gname = f"{self.prefix}_{name}".replace(".", "_")

@@ -30,6 +30,22 @@ Invariants the tests pin (tests/test_serving.py):
   audits every page — `clear()` (engine close) returns everything and
   the allocator must come out whole.
 
+Hierarchical spill tiers (r15): eviction is no longer oblivion. With
+``spill_bytes`` (host RAM) and/or ``spill_dir`` (disk) configured, a
+refcount-0 FULL page that ``_evict_one`` would free is first copied
+device→host as an immutable content blob keyed by the SAME chained
+blake2b block key — the key already proves the content, so a later
+``match()`` that misses device pages can restore the blob into freshly
+allocated pages (one device_put + page-table splice, models/gpt.py
+``paged_page_splice``) instead of re-running the prefix's prefill. A
+tier miss mid-chain just shortens the restored prefix: the remaining
+suffix rides the existing chained-prefill machinery, so restore-hit,
+partial-hit and miss paths all produce bit-identical greedy output.
+Each tier is byte-budgeted LRU; the host tier demotes into the disk
+tier, the last tier drops. Blobs carry a crc32 — a corrupt blob is a
+typed, counted fallback to chained prefill, never wrong tokens
+(``cache.spill`` fault site, distributed/fault_inject.py).
+
 Reference analog: no fluid-era equivalent (the inference engine caches
 whole programs, not KV); this is the serving-layer capability the
 paged pool was built to unlock.
@@ -39,11 +55,18 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-from typing import Dict, Hashable, List, Optional, Sequence, Tuple
+import os
+import struct
+import time
+import zlib
+from collections import OrderedDict
+from typing import (Any, Callable, Dict, Hashable, List, Optional,
+                    Sequence, Tuple)
 
 import numpy as np
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "HostSpillTier", "DiskSpillTier",
+           "SpillCorrupt", "pack_page_blob", "unpack_page_blob"]
 
 
 def _block_hash(parent: Optional[bytes], block: np.ndarray) -> bytes:
@@ -52,6 +75,321 @@ def _block_hash(parent: Optional[bytes], block: np.ndarray) -> bytes:
         h.update(parent)
     h.update(np.ascontiguousarray(block, np.int32).tobytes())
     return h.digest()
+
+
+# -- spill blobs ------------------------------------------------------------
+
+_BLOB_MAGIC = b"PTKV"
+
+
+class SpillCorrupt(RuntimeError):
+    """A spill blob failed its crc32 / structure check. Callers treat
+    the blob as a miss (the chained-prefill fallback recomputes the
+    page) — corrupt KV must never be spliced into the pool."""
+
+
+def pack_page_blob(layers: Sequence[Tuple[np.ndarray, np.ndarray,
+                                          Optional[np.ndarray],
+                                          Optional[np.ndarray]]]
+                   ) -> bytes:
+    """Serialize one evicted page's per-layer (k, v, k_scale, v_scale)
+    blocks into a self-describing blob: magic + layout header + crc32
+    over the payload + raw array bytes. Scales are None for fp pages.
+    The layout header makes restore independent of caller bookkeeping
+    (and lets the audit tests verify byte-equality tier-side)."""
+    first_k = np.ascontiguousarray(layers[0][0])
+    int8 = layers[0][2] is not None
+    head = {
+        "nl": len(layers),
+        "shape": first_k.shape,            # [page, H, D]
+        "dtype": str(first_k.dtype),
+        "scale_dtype": (str(np.ascontiguousarray(layers[0][2]).dtype)
+                        if int8 else ""),
+    }
+    payload = bytearray()
+    for k, v, ks, vs in layers:
+        payload += np.ascontiguousarray(k).tobytes()
+        payload += np.ascontiguousarray(v).tobytes()
+        if int8:
+            payload += np.ascontiguousarray(ks).tobytes()
+            payload += np.ascontiguousarray(vs).tobytes()
+    payload = bytes(payload)
+    meta = (f"{head['nl']};{','.join(map(str, head['shape']))};"
+            f"{head['dtype']};{head['scale_dtype']}").encode("ascii")
+    return (_BLOB_MAGIC + struct.pack("<HI", len(meta), len(payload))
+            + meta + struct.pack("<I", zlib.crc32(payload)) + payload)
+
+
+def unpack_page_blob(blob: bytes
+                     ) -> List[Tuple[np.ndarray, np.ndarray,
+                                     Optional[np.ndarray],
+                                     Optional[np.ndarray]]]:
+    """Inverse of :func:`pack_page_blob`; raises :class:`SpillCorrupt`
+    on any structural or crc32 mismatch (a torn write, a flipped bit,
+    a truncated file — all the same typed fallback)."""
+    try:
+        if blob[:4] != _BLOB_MAGIC:
+            raise SpillCorrupt("bad spill-blob magic")
+        meta_len, payload_len = struct.unpack("<HI", blob[4:10])
+        meta = blob[10:10 + meta_len].decode("ascii")
+        off = 10 + meta_len
+        crc, = struct.unpack("<I", blob[off:off + 4])
+        payload = blob[off + 4:]
+        if len(payload) != payload_len:
+            raise SpillCorrupt("truncated spill blob")
+        if zlib.crc32(payload) != crc:
+            raise SpillCorrupt("spill blob crc32 mismatch")
+        nl_s, shape_s, dtype_s, scale_dtype_s = meta.split(";")
+        nl = int(nl_s)
+        shape = tuple(int(x) for x in shape_s.split(","))
+        dt = np.dtype(dtype_s)
+        int8 = bool(scale_dtype_s)
+        sdt = np.dtype(scale_dtype_s) if int8 else None
+        kv_bytes = int(np.prod(shape)) * dt.itemsize
+        sc_bytes = int(np.prod(shape[:2])) * sdt.itemsize if int8 else 0
+        out = []
+        pos = 0
+        for _ in range(nl):
+            k = np.frombuffer(payload, dt, count=int(np.prod(shape)),
+                              offset=pos).reshape(shape)
+            pos += kv_bytes
+            v = np.frombuffer(payload, dt, count=int(np.prod(shape)),
+                              offset=pos).reshape(shape)
+            pos += kv_bytes
+            ks = vs = None
+            if int8:
+                n_sc = int(np.prod(shape[:2]))
+                ks = np.frombuffer(payload, sdt, count=n_sc,
+                                   offset=pos).reshape(shape[:2])
+                pos += sc_bytes
+                vs = np.frombuffer(payload, sdt, count=n_sc,
+                                   offset=pos).reshape(shape[:2])
+                pos += sc_bytes
+            out.append((k, v, ks, vs))
+        if pos != payload_len:
+            raise SpillCorrupt("spill blob payload size mismatch")
+        return out
+    except SpillCorrupt:
+        raise
+    except Exception as e:  # struct errors, bad meta, short buffers
+        raise SpillCorrupt(f"malformed spill blob: "
+                           f"{type(e).__name__}: {e}")
+
+
+class _SpillTier:
+    """Byte-budgeted LRU blob store (one tier of the hierarchy).
+
+    ``put`` evicts least-recently-used blobs into ``next_tier`` (the
+    demotion chain host→disk) or drops them when this is the last
+    tier; ``get`` refreshes recency. Subclasses supply the storage
+    primitives. Single-threaded like the cache itself (engine-thread
+    only); the occupancy counters are read racily by health probes,
+    which is benign for ints."""
+
+    name = "tier"
+
+    def __init__(self, capacity_bytes: int, next_tier=None):
+        self.capacity_bytes = int(capacity_bytes)
+        self.next_tier = next_tier
+        self._index: "OrderedDict[bytes, int]" = OrderedDict()
+        self.occupancy_bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.stored_blobs = 0       # lifetime puts accepted
+        self.demoted_blobs = 0      # LRU-pushed into next_tier
+        self.dropped_blobs = 0      # LRU/oversize-dropped (no next tier)
+
+    # storage primitives -----------------------------------------------
+    def _store(self, key: bytes, blob: bytes) -> None:
+        raise NotImplementedError
+
+    def _load(self, key: bytes) -> bytes:
+        raise NotImplementedError
+
+    def _delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    # tier interface ---------------------------------------------------
+    def contains(self, key: bytes) -> bool:
+        return key in self._index
+
+    def touch(self, key: bytes) -> None:
+        if key in self._index:
+            self._index.move_to_end(key)
+
+    def _evict_lru(self) -> None:
+        # account BEFORE any IO so a failed load can't corrupt the
+        # occupancy books, and load the blob only when there is a next
+        # tier to demote into — the last tier's budget evictions are
+        # pure drops, not reads
+        key, size = self._index.popitem(last=False)
+        self.occupancy_bytes -= size
+        if self.next_tier is None:
+            self._delete(key)
+            self.dropped_blobs += 1
+            return
+        try:
+            blob = self._load(key)
+        except OSError:
+            # backing file vanished (same degradation get() applies):
+            # the blob is already gone — drop, never raise into the
+            # engine's eviction path
+            self._delete(key)
+            self.dropped_blobs += 1
+            return
+        self._delete(key)
+        self.next_tier.put(key, blob)
+        self.demoted_blobs += 1
+
+    def put(self, key: bytes, blob: bytes) -> bool:
+        """Store (or refresh) ``key``; returns False when the blob
+        cannot fit this tier at all (it is demoted or dropped)."""
+        if len(blob) > self.capacity_bytes:
+            if self.next_tier is not None:
+                self.next_tier.put(key, blob)
+                self.demoted_blobs += 1
+            else:
+                self.dropped_blobs += 1
+            return False
+        if key in self._index:
+            self.remove(key)
+        while self.occupancy_bytes + len(blob) > self.capacity_bytes:
+            self._evict_lru()
+        self._store(key, blob)
+        self._index[key] = len(blob)
+        self.occupancy_bytes += len(blob)
+        self.stored_blobs += 1
+        return True
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        if key not in self._index:
+            self.misses += 1
+            return None
+        try:
+            blob = self._load(key)
+        except OSError:
+            # a vanished/unreadable backing file is a miss, not a
+            # crash: drop the index entry and let the chained-prefill
+            # fallback recompute
+            self.occupancy_bytes -= self._index.pop(key)
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._index.move_to_end(key)
+        return blob
+
+    def remove(self, key: bytes) -> None:
+        size = self._index.pop(key, None)
+        if size is not None:
+            self.occupancy_bytes -= size
+            self._delete(key)
+
+    def clear(self) -> None:
+        for key in list(self._index):
+            self.remove(key)
+
+    @property
+    def blob_count(self) -> int:
+        return len(self._index)
+
+    def check_consistent(self) -> None:
+        """Audit: the occupancy counter matches the index, and every
+        indexed blob is actually loadable (no dangling entries)."""
+        total = sum(self._index.values())
+        if total != self.occupancy_bytes:
+            raise RuntimeError(
+                f"{self.name} tier occupancy {self.occupancy_bytes} != "
+                f"indexed bytes {total}")
+        for key, size in self._index.items():
+            blob = self._load(key)
+            if len(blob) != size:
+                raise RuntimeError(
+                    f"{self.name} tier blob {key.hex()} is {len(blob)}B "
+                    f"but indexed as {size}B")
+
+    def stats(self) -> Dict[str, Any]:
+        return {"blobs": self.blob_count,
+                "occupancy_bytes": self.occupancy_bytes,
+                "capacity_bytes": self.capacity_bytes,
+                "hits": self.hits, "misses": self.misses,
+                "stored_blobs": self.stored_blobs,
+                "demoted_blobs": self.demoted_blobs,
+                "dropped_blobs": self.dropped_blobs}
+
+
+class HostSpillTier(_SpillTier):
+    """Host-RAM blob tier (the first spill stop for evicted pages)."""
+
+    name = "host"
+
+    def __init__(self, capacity_bytes: int, next_tier=None):
+        super().__init__(capacity_bytes, next_tier)
+        self._blobs: Dict[bytes, bytes] = {}
+
+    def _store(self, key, blob):
+        self._blobs[key] = blob
+
+    def _load(self, key):
+        return self._blobs[key]
+
+    def _delete(self, key):
+        self._blobs.pop(key, None)
+
+
+class DiskSpillTier(_SpillTier):
+    """Disk blob tier: one ``<key>.kvblob`` file per blob under
+    ``directory``. Writes are atomic (tmp + rename) so a crash can
+    never leave a half blob behind a valid index entry; construction
+    scrubs stale ``*.kvblob`` files from a previous process — blobs
+    never outlive the cache that wrote them (the zero-dangling-blob
+    audit)."""
+
+    name = "disk"
+
+    def __init__(self, directory: str, capacity_bytes: int,
+                 next_tier=None):
+        super().__init__(capacity_bytes, next_tier)
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        for fn in os.listdir(directory):
+            # .kvblob.tmp too: a crash between the tmp write and the
+            # rename orphans one — restart-looping replicas must not
+            # accumulate them
+            if fn.endswith((".kvblob", ".kvblob.tmp")):
+                try:
+                    os.unlink(os.path.join(directory, fn))
+                except OSError:
+                    pass
+
+    def _path(self, key: bytes) -> str:
+        return os.path.join(self.directory, key.hex() + ".kvblob")
+
+    def _store(self, key, blob):
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._path(key))
+
+    def _load(self, key):
+        with open(self._path(key), "rb") as f:
+            return f.read()
+
+    def _delete(self, key):
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def check_consistent(self) -> None:
+        super().check_consistent()
+        on_disk = {fn for fn in os.listdir(self.directory)
+                   if fn.endswith(".kvblob")}
+        indexed = {k.hex() + ".kvblob" for k in self._index}
+        if on_disk != indexed:
+            raise RuntimeError(
+                f"disk tier diverged from its files: dangling "
+                f"{sorted(on_disk - indexed)[:4]}, missing "
+                f"{sorted(indexed - on_disk)[:4]}")
 
 
 @dataclasses.dataclass
@@ -70,9 +408,19 @@ class PrefixCache:
 
     Single-threaded by design: every method runs on the engine thread
     (the server serializes engine access), matching the allocator's
-    model. ``page_size`` must equal the engine's."""
+    model. ``page_size`` must equal the engine's.
 
-    def __init__(self, page_size: int, max_pages: Optional[int] = None):
+    Spill tiers (r15): ``spill_bytes`` adds a host-RAM tier,
+    ``spill_dir`` a disk tier (of ``disk_bytes``); the host tier
+    demotes into the disk tier. Tiers need device IO — the engine
+    attaches its page reader/splicer via :meth:`attach_device_io` —
+    and stay inert without it (a bare cache behaves exactly as
+    pre-r15)."""
+
+    def __init__(self, page_size: int, max_pages: Optional[int] = None,
+                 spill_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 disk_bytes: Optional[int] = None):
         self.page_size = int(page_size)
         # optional soft cap on cached pages; None = bounded only by
         # pool pressure (evict_until)
@@ -80,11 +428,59 @@ class PrefixCache:
         self._entries: Dict[bytes, _Entry] = {}
         self._tick = 0
         # lifetime counters (serving/metrics.py scrapes these through
-        # the engine's RequestStats; kept here too for direct audits)
+        # the engine's RequestStats; kept here too for direct audits).
+        # hit/miss_pages stay DEVICE-tier figures; tier hits land in
+        # tier_hit_pages and hit_rate() blends all tiers.
         self.hit_pages = 0
         self.miss_pages = 0
         self.inserted_pages = 0
         self.evicted_pages = 0
+        # spill-tier counters (r15)
+        self.tier_hit_pages: Dict[str, int] = {}
+        self.spilled_pages = 0       # blobs written on eviction
+        self.restored_pages = 0      # blobs spliced back on a hit
+        self.restore_corrupt = 0     # crc/structure failures (typed)
+        self.spill_failed = 0        # spill writes lost (fault/io)
+        self.last_restore_ms: Optional[float] = None
+        # spill-tier chain (host -> disk); [] = spill disabled
+        disk = (DiskSpillTier(spill_dir,
+                              int(disk_bytes
+                                  if disk_bytes is not None
+                                  else 1 << 30))
+                if spill_dir else None)
+        host = (HostSpillTier(int(spill_bytes), next_tier=disk)
+                if spill_bytes else None)
+        self.tiers: List[_SpillTier] = [t for t in (host, disk)
+                                        if t is not None]
+        for t in self.tiers:
+            self.tier_hit_pages[t.name] = 0
+        # device IO installed by the engine (attach_device_io):
+        # read_page(page) -> per-layer (k, v, ks, vs) host arrays;
+        # splice_page(page, layers) writes them back into fresh pages
+        self._read_page: Optional[Callable[[int], Any]] = None
+        self._splice_page: Optional[Callable[[int, Any], None]] = None
+        # chain-head keys currently represented in a tier (the router's
+        # affinity advertisement also covers spilled-but-restorable
+        # prefixes); pruned lazily in advertised_keys()
+        self._tier_heads: set = set()
+
+    # -- spill-tier plumbing ------------------------------------------------
+
+    def attach_device_io(self, read_page: Callable[[int], Any],
+                         splice_page: Callable[[int, Any], None]
+                         ) -> None:
+        """Engine hookup: how the cache copies a page device→host at
+        eviction (``read_page(page) -> per-layer blocks``) and splices
+        a run of restored blobs back into fresh pages
+        (``splice_page(pages, layers_list)`` — BATCHED: one device
+        call restores the whole contiguous chain run;
+        inference/continuous_batching.py)."""
+        self._read_page = read_page
+        self._splice_page = splice_page
+
+    @property
+    def spill_enabled(self) -> bool:
+        return bool(self.tiers) and self._read_page is not None
 
     # -- keys --------------------------------------------------------------
 
@@ -107,6 +503,16 @@ class PrefixCache:
 
     # -- lookup / refcounts ------------------------------------------------
 
+    def _memo_chain(self, prompt, memo=None
+                    ) -> List[Tuple[bytes, Optional[bytes], np.ndarray]]:
+        chain = getattr(memo, "_pfx_chain", None) if memo is not None \
+            else None
+        if chain is None:
+            chain = self._chain_keys(prompt)
+            if memo is not None:
+                memo._pfx_chain = chain
+        return chain
+
     def match(self, prompt, memo=None
               ) -> Tuple[Tuple[bytes, ...], List[int]]:
         """Longest cached prefix for ``prompt``: (chain keys, pages).
@@ -115,12 +521,7 @@ class PrefixCache:
         DecodeRequest) caches the chain hashes across calls — the
         prompt is immutable, and per-step admission probes must cost
         dict lookups, not O(prompt) re-hashing."""
-        chain = getattr(memo, "_pfx_chain", None) if memo is not None \
-            else None
-        if chain is None:
-            chain = self._chain_keys(prompt)
-            if memo is not None:
-                memo._pfx_chain = chain
+        chain = self._memo_chain(prompt, memo)
         keys: List[bytes] = []
         pages: List[int] = []
         for key, _parent, block in chain:
@@ -151,11 +552,171 @@ class PrefixCache:
                 raise RuntimeError(
                     f"prefix-cache refcount underflow on {k.hex()}")
 
+    # -- spill / restore (r15) ---------------------------------------------
+
+    def _spill_entry(self, ent: _Entry) -> None:
+        """Copy an about-to-be-evicted entry's page device→host into
+        the first spill tier. Tiers are INCLUSIVE of the device tier:
+        a page restored earlier still has its blob, so re-eviction is
+        an LRU touch, not a second device read. A failed/injected
+        spill write just loses the content (a later match degrades to
+        a miss) — never an error on the eviction path."""
+        if not self.spill_enabled:
+            return
+        for t in self.tiers:
+            if t.contains(ent.key):
+                t.touch(ent.key)
+                return
+        from ..distributed.fault_inject import (InjectedFault,
+                                                fault_point)
+        try:
+            # cache.spill write side: "abort" loses the blob (counted,
+            # degrades to a miss), "torn" stores a corrupted blob the
+            # restore-side crc32 must catch
+            mode = fault_point("cache.spill", modes=("abort", "torn"))
+        except InjectedFault:
+            self.spill_failed += 1
+            return
+        try:
+            blob = pack_page_blob(self._read_page(ent.page))
+        except Exception:
+            self.spill_failed += 1
+            return
+        if mode == "torn":
+            # flip one payload byte; the header/crc stay intact so the
+            # corruption is only detectable by the crc32 check
+            blob = blob[:-1] + bytes([blob[-1] ^ 0xFF])
+        self.tiers[0].put(ent.key, blob)
+        self.spilled_pages += 1
+        if ent.parent is None:
+            self._tier_heads.add(ent.key)
+
+    def restore_from_spill(self, prompt, matched_keys: Sequence[bytes],
+                           allocator, memo=None
+                           ) -> Tuple[Tuple[bytes, ...], List[int],
+                                      Dict[str, Any]]:
+        """Extend a device-tier ``match`` by restoring spilled blobs:
+        walk the prompt's chain past the device hits collecting
+        contiguous tier hits (crc-verified), allocate one fresh page
+        per blob (owner ``("prefix", key)`` — the cache's books,
+        exactly like an inserted page), splice ALL of them back in ONE
+        batched device call (the whole-restore cost is one device_put
+        plus one scatter launch, not one per page), and register the
+        device entries. The walk stops at the first tier miss (the
+        chained-prefill fallback covers the rest), allocation failure,
+        or corrupt blob (typed + counted — never spliced). Returns
+        (restored keys, their pages, info) where info carries per-tier
+        page counts, corrupt count, and the restore wall time in ms.
+        Caller acquires the full chain afterwards, exactly like device
+        hits."""
+        info: Dict[str, Any] = {t.name: 0 for t in self.tiers}
+        info.update(corrupt=0, ms=0.0)
+        if not self.spill_enabled or self._splice_page is None:
+            return (), [], info
+        chain = self._memo_chain(prompt, memo)
+        start = len(matched_keys)
+        if start >= len(chain):
+            return (), [], info
+        from ..distributed.fault_inject import (InjectedFault,
+                                                fault_point)
+        t0 = time.perf_counter()
+        # phase 1: walk the tiers host-side — which contiguous run of
+        # blobs is restorable, and what do they decode to
+        hits: List[Tuple[bytes, Optional[bytes], np.ndarray, str,
+                         Any]] = []
+        for i in range(start, len(chain)):
+            key, parent, block = chain[i]
+            if key in self._entries:
+                break  # collision with different tokens (match missed)
+            blob = None
+            tier = None
+            for t in self.tiers:
+                blob = t.get(key)
+                if blob is not None:
+                    tier = t
+                    break
+            if blob is None:
+                break  # tier miss mid-chain: chained prefill takes over
+            try:
+                # cache.spill read side: an injected read failure is a
+                # typed miss — the fallback prefill recomputes the page
+                fault_point("cache.spill")
+            except InjectedFault:
+                self.spill_failed += 1
+                break
+            try:
+                layers = unpack_page_blob(blob)
+            except SpillCorrupt:
+                tier.remove(key)
+                self.restore_corrupt += 1
+                info["corrupt"] += 1
+                break
+            hits.append((key, parent, block, tier.name, layers))
+        # phase 2: bind pages for the whole run (per-key owners so the
+        # allocator books stay page-exact), splice ONCE, register.
+        # Allocation applies EVICTION PRESSURE: a restore is the cache
+        # choosing to hold the ACTIVE prefix, so cold refcount-0
+        # chains make way (and spill in turn — usually an LRU touch,
+        # their blobs already exist). The caller pinned its
+        # device-matched chain BEFORE restoring, so eviction can never
+        # reclaim pages this admission is about to use.
+        def alloc_one(key):
+            while True:
+                try:
+                    pages = allocator.alloc(("prefix", key), 1)
+                except InjectedFault:
+                    return None  # alloc.page chaos: same as no space
+                if pages is not None:
+                    return pages
+                if not self._evict_one(allocator):
+                    return None
+
+        new_keys: List[bytes] = []
+        new_pages: List[int] = []
+        for key, _parent, _block, _tname, _layers in hits:
+            if self.max_pages is not None and \
+                    self.total_pages() + len(new_keys) >= \
+                    self.max_pages and \
+                    not self._evict_one(allocator):
+                break  # soft cap (same rule as insert())
+            pages = alloc_one(key)
+            if not pages:
+                break
+            new_keys.append(key)
+            new_pages.append(pages[0])
+        hits = hits[:len(new_keys)]
+        if hits:
+            try:
+                self._splice_page(new_pages,
+                                  [h[4] for h in hits])
+            except Exception:
+                # a failed splice must not leak the fresh pages
+                for key in new_keys:
+                    allocator.free(("prefix", key))
+                raise
+            for (key, parent, block, tname, _layers), page in \
+                    zip(hits, new_pages):
+                self._tick += 1
+                self._entries[key] = _Entry(key, parent, page,
+                                            np.array(block, np.int32),
+                                            refcount=0,
+                                            last_used=self._tick)
+                if parent is not None:
+                    self._entries[parent].children += 1
+                self.tier_hit_pages[tname] += 1
+                info[tname] += 1
+        if new_keys or info["corrupt"]:
+            ms = (time.perf_counter() - t0) * 1e3
+            info["ms"] = ms
+            self.last_restore_ms = ms
+            self.restored_pages += len(new_keys)
+        return tuple(new_keys), new_pages, info
+
     # -- insertion ---------------------------------------------------------
 
     def insert(self, prompt, row: np.ndarray, allocator, owner: Hashable,
-               page_size: int, matched_keys: Sequence[bytes]
-               ) -> Tuple[bytes, ...]:
+               page_size: int, matched_keys: Sequence[bytes],
+               device_hits: Optional[int] = None) -> Tuple[bytes, ...]:
         """Adopt the freshly-prefilled full prompt pages of ``row``
         into the cache (ownership transfer ``owner`` → cache) and
         return the request's full chain keys (matched + new), each
@@ -163,14 +724,20 @@ class PrefixCache:
 
         ``row`` is the slot's page-table row: entry i is the physical
         page of token block i, so the new blocks' pages are read
-        straight out of it."""
+        straight out of it.
+
+        ``device_hits``: how many of ``matched_keys`` were DEVICE-tier
+        hits (the rest were restored from spill and already counted
+        per-tier at restore time); None = all of them (the pre-r15
+        single-tier accounting)."""
         if page_size != self.page_size:
             raise ValueError(
                 f"engine page_size {page_size} != cache page_size "
                 f"{self.page_size}")
         chain = self._chain_keys(prompt)
         keys: List[bytes] = list(matched_keys)
-        self.hit_pages += len(matched_keys)
+        self.hit_pages += (len(matched_keys) if device_hits is None
+                           else int(device_hits))
         self.miss_pages += max(0, len(chain) - len(matched_keys))
         for i in range(len(matched_keys), len(chain)):
             key, parent, block = chain[i]
@@ -236,6 +803,9 @@ class PrefixCache:
         if not cands:
             return False
         victim = min(cands, key=lambda e: e.last_used)
+        # r15: eviction spills before it frees — the page's content
+        # survives as a host/disk blob a later match can restore
+        self._spill_entry(victim)
         allocator.free(("prefix", victim.key))
         if victim.parent is not None:
             self._entries[victim.parent].children -= 1
@@ -267,6 +837,11 @@ class PrefixCache:
             allocator.free(("prefix", ent.key))
         self.evicted_pages += len(self._entries)
         self._entries.clear()
+        # spill blobs die with the cache: every exit path must leave
+        # zero dangling tier blobs (disk files included)
+        for t in self.tiers:
+            t.clear()
+        self._tier_heads.clear()
 
     # -- audits ------------------------------------------------------------
 
@@ -274,8 +849,49 @@ class PrefixCache:
         return len(self._entries)
 
     def hit_rate(self) -> Optional[float]:
-        seen = self.hit_pages + self.miss_pages
-        return self.hit_pages / seen if seen else None
+        """Blended hit rate across ALL tiers: device hits plus restored
+        spill hits over everything the cache was asked for. Per-tier
+        figures live in :meth:`tier_stats`."""
+        hits = self.hit_pages + sum(self.tier_hit_pages.values())
+        seen = hits + self.miss_pages
+        return hits / seen if seen else None
+
+    def tier_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tier counters for metrics/stats export: the device tier
+        (resident pages, hit/miss pages) plus each spill tier's
+        occupancy and hit accounting."""
+        out: Dict[str, Dict[str, Any]] = {
+            "device": {"pages": len(self._entries),
+                       "hit_pages": self.hit_pages,
+                       "miss_pages": self.miss_pages}}
+        for t in self.tiers:
+            s = t.stats()
+            s["hit_pages"] = self.tier_hit_pages.get(t.name, 0)
+            out[t.name] = s
+        return out
+
+    def advertised_keys(self, limit: int = 128) -> List[str]:
+        """Chain-HEAD keys (hex) this cache can serve a prefix for —
+        device-resident heads plus heads whose blob still sits in a
+        spill tier. This is the affinity advertisement the server's
+        health reply carries and the failover router steers on
+        (serving/supervisor.py); it is a routing HINT, so staleness is
+        benign and the list is recency-capped."""
+        heads = sorted((e for e in self._entries.values()
+                        if e.parent is None),
+                       key=lambda e: -e.last_used)
+        out = [e.key.hex() for e in heads[:limit]]
+        seen = set(out)
+        for k in list(self._tier_heads):
+            if k in self._entries:
+                continue  # already advertised (or will be) as device
+            if any(t.contains(k) for t in self.tiers):
+                if len(out) < limit and k.hex() not in seen:
+                    out.append(k.hex())
+                    seen.add(k.hex())
+            else:
+                self._tier_heads.discard(k)
+        return out
 
     def check_consistent(self, allocator) -> None:
         """Drained-engine audit: every page the allocator still sees as
@@ -301,3 +917,7 @@ class PrefixCache:
             raise RuntimeError(
                 f"page accounting broken: {allocator.free_count} free + "
                 f"{cache_owned} cached != pool {allocator.num_pages}")
+        # spill tiers: occupancy counters match the stored blobs and
+        # (disk) the files on disk — no dangling blobs
+        for t in self.tiers:
+            t.check_consistent()

@@ -140,7 +140,11 @@ class ServingServer:
                  prefill_retry="site", max_new_tokens_cap: int = 512,
                  poll_interval_s: float = 0.02,
                  max_engine_errors: int = 32,
-                 max_engine_restarts: int = 2, **engine_kwargs):
+                 max_engine_restarts: int = 2,
+                 spill_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 spill_disk_bytes: Optional[int] = None,
+                 **engine_kwargs):
         from ..distributed.resilience import get_retry_policy
 
         self.host = host
@@ -149,6 +153,14 @@ class ServingServer:
             else SLOScheduler()
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self._use_prefix_cache = bool(prefix_cache)
+        # hierarchical prefix cache (r15): spill-tier config is part of
+        # the resurrection recipe — a rebuilt engine gets the same
+        # host-RAM/disk tiers (contents start empty; blobs reference
+        # nothing outside themselves, but the old cache's books died
+        # with the old allocator and clear() scrubbed its blobs)
+        self._spill_bytes = spill_bytes
+        self._spill_dir = spill_dir
+        self._spill_disk_bytes = spill_disk_bytes
         self._page_size = int(engine_kwargs.get("page_size", 64))
         if prefill_retry == "site":
             prefill_retry = get_retry_policy("serving.prefill")
@@ -212,8 +224,12 @@ class ServingServer:
         engine's prefill/decode/verify compiles into cache reads — the
         warm-resurrection lane."""
         from ..inference import create_decode_engine
-        self.prefix_cache = (PrefixCache(self._page_size)
-                             if self._use_prefix_cache else None)
+        self.prefix_cache = (
+            PrefixCache(self._page_size,
+                        spill_bytes=self._spill_bytes,
+                        spill_dir=self._spill_dir,
+                        disk_bytes=self._spill_disk_bytes)
+            if self._use_prefix_cache else None)
         return create_decode_engine(
             self._model, scheduler=self.scheduler,
             prefix_cache=self.prefix_cache,
@@ -822,6 +838,13 @@ class ServingServer:
         return {"status": "draining" if self._draining else "ok",
                 "active": eng.num_active,
                 "queued": eng.num_queued,
+                # cache-affinity routing (r15): the replica's page size
+                # plus the chain-head prefix keys it can serve (device
+                # entries AND spill-tier blobs) — the FailoverRouter
+                # steers keyed requests whose first-block hash matches
+                "page_size": eng.page_size,
+                "prefix_keys": (racy(lambda: pc.advertised_keys(), [])
+                                if pc is not None else []),
                 "free_pages": eng.free_pages,
                 "reserved_pages": racy(
                     lambda: eng.allocator.reserved_total),
@@ -872,6 +895,14 @@ class ServingServer:
              # half-prefilled slots + the queue — the head-of-line
              # pressure a dashboard watches against TPOT
              "prefill_debt_tokens": eng.prefill_debt_tokens}
+        # hierarchical prefix cache (r15): per-tier occupancy so a
+        # dashboard sees how much evicted KV is restorable (bytes and
+        # blob counts per spill tier)
+        if pc is not None and getattr(pc, "tiers", None):
+            for t in pc.tiers:
+                g[f"spill_{t.name}_bytes"] = t.occupancy_bytes
+                g[f"spill_{t.name}_blobs"] = t.blob_count
+                g[f"spill_{t.name}_capacity_bytes"] = t.capacity_bytes
         # fused decode (r13): ops traced into the decode-step program
         # (the launch counter) — exported as serving_step_programs so
         # the fused launch-count win is visible on a live server; 0
@@ -923,7 +954,14 @@ class ServingServer:
                 "miss_pages": pc.miss_pages,
                 "inserted_pages": pc.inserted_pages,
                 "evicted_pages": pc.evicted_pages,
-                "hit_rate": pc.hit_rate()}
+                "hit_rate": pc.hit_rate(),
+                # hierarchical tiers (r15): per-tier hit/occupancy
+                # breakdown plus spill/restore lifetime counters
+                "tiers": pc.tier_stats(),
+                "spilled_pages": pc.spilled_pages,
+                "restored_pages": pc.restored_pages,
+                "restore_corrupt": pc.restore_corrupt,
+                "spill_failed": pc.spill_failed}
 
 
 def _json_stats(stats) -> Dict:
@@ -978,6 +1016,23 @@ def main(argv=None) -> None:
     parser.add_argument("--num-pages", type=int, default=None)
     parser.add_argument("--max-seq-len", type=int, default=None)
     parser.add_argument("--no-prefix-cache", action="store_true")
+    parser.add_argument(
+        "--spill-mb", type=int, default=None, metavar="MB",
+        help="hierarchical prefix cache (r15): add a host-RAM spill "
+             "tier of this many MB — refcount-0 prefix pages evicted "
+             "from the device pool are kept as content-hashed blobs "
+             "and restored on a later hit via one device_put + "
+             "page-table splice instead of a re-prefill (greedy "
+             "outputs stay bit-identical; default: evictions are "
+             "dropped)")
+    parser.add_argument(
+        "--spill-dir", default=None, metavar="DIR",
+        help="add a disk spill tier under DIR behind the host tier "
+             "(host-tier LRU evictions demote here; blobs are "
+             "crc32-checked on restore and scrubbed on shutdown)")
+    parser.add_argument(
+        "--spill-disk-mb", type=int, default=1024, metavar="MB",
+        help="byte budget of the --spill-dir disk tier (default 1024)")
     parser.add_argument(
         "--max-engine-errors", type=int, default=32,
         help="consecutive engine-step failures before the engine is "
@@ -1070,6 +1125,12 @@ def main(argv=None) -> None:
                            max_engine_errors=args.max_engine_errors,
                            max_engine_restarts=args.max_engine_restarts,
                            stall_timeout_s=args.stall_timeout_s,
+                           spill_bytes=(None if args.spill_mb is None
+                                        else args.spill_mb << 20),
+                           spill_dir=args.spill_dir,
+                           spill_disk_bytes=(
+                               None if args.spill_dir is None
+                               else args.spill_disk_mb << 20),
                            speculative=speculative, **engine_kwargs)
     port = server.start()
     print(f"[paddle_tpu.serving] listening on {args.host}:{port} "

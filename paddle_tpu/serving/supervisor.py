@@ -37,6 +37,7 @@ deterministic engine.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import signal
@@ -47,6 +48,8 @@ import tempfile
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
+
+import numpy as np
 
 __all__ = ["Replica", "Supervisor", "FailoverRouter"]
 
@@ -89,6 +92,14 @@ class Replica:
         self.spawn_t: Optional[float] = None       # warmup clock
         self.log_path: Optional[str] = None
         self._log_file = None
+        # cache-affinity advertisement (r15): refreshed from every
+        # healthy probe — the chain-head prefix keys this replica's
+        # cache can serve, its page size (the router needs it to hash
+        # a prompt's first block), and its current load (the
+        # least-loaded fallback's input)
+        self.prefix_keys: frozenset = frozenset()
+        self.page_size: Optional[int] = None
+        self.load: int = 0
 
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
@@ -238,9 +249,14 @@ class Supervisor:
         rep.log_path = os.path.join(self.log_dir,
                                     f"replica{rep.idx}.log")
         rep._log_file = open(rep.log_path, "ab")
+        # "{replica}" in an arg expands to this replica's index — how
+        # per-replica paths (e.g. --spill-dir subdirs) stay disjoint
+        # while every replica shares one server_args list
+        extra = [a.replace("{replica}", str(rep.idx))
+                 if "{replica}" in a else a for a in self.server_args]
         cmd = [sys.executable, "-m", "paddle_tpu.serving.server",
                "--model", self.model, "--host", self.host,
-               "--port", str(rep.port)] + self.server_args
+               "--port", str(rep.port)] + extra
         env = dict(os.environ)
         env.update(self.replica_env)
         rep.proc = subprocess.Popen(cmd, stdout=rep._log_file,
@@ -271,6 +287,19 @@ class Supervisor:
                     rep.ready = True
                     rep.probe_failures = 0
                     rep.consec_deaths = 0
+                    # cache-affinity advertisement (r15): best-effort —
+                    # an old server build without these fields just
+                    # leaves the replica unadvertised (RR/least-loaded
+                    # routing still applies)
+                    try:
+                        rep.prefix_keys = frozenset(
+                            h.get("prefix_keys") or ())
+                        ps = h.get("page_size")
+                        rep.page_size = int(ps) if ps else None
+                        rep.load = (int(h.get("active") or 0)
+                                    + int(h.get("queued") or 0))
+                    except (TypeError, ValueError):
+                        pass
                 else:
                     rep.probe_failures += 1
                     stuck_warmup = (
@@ -318,9 +347,21 @@ class _ClientLost(ConnectionError):
 class FailoverRouter:
     """One client-facing port over N supervised replicas.
 
-    Per-request routing: round-robin over ready replicas. A backend
-    that dies mid-request (connection error, or an armed ``net.recv``
-    schedule) costs an unkeyed request a typed retryable
+    Per-request routing: round-robin over ready replicas — except
+    KEYED requests, which are steered for CACHE AFFINITY (r15): the
+    prompt's first-block prefix key (the same chained blake2b the
+    prefix cache uses) is matched against each replica's advertised
+    cached keys; an advertising holder wins, otherwise a rendezvous
+    hash over the live replicas picks a stable owner so repeated
+    prefixes concentrate on one replica and BUILD affinity, and when
+    no key can be computed (short prompt, no advertisement yet) the
+    least-loaded live replica takes it. Affinity is a ROUTING HINT
+    only: excluded/dead replicas are always filtered first, so it can
+    never block failover — a steered request whose replica dies fails
+    over exactly like any other.
+
+    A backend that dies mid-request (connection error, or an armed
+    ``net.recv`` schedule) costs an unkeyed request a typed retryable
     ``ReplicaFailed``; a KEYED request is resubmitted to another live
     replica, with already-relayed streamed tokens suppressed from the
     resubmission (greedy determinism makes the resubmitted stream a
@@ -331,16 +372,26 @@ class FailoverRouter:
     def __init__(self, supervisor: Supervisor, host: str = "127.0.0.1",
                  port: int = 0, max_failover: int = 3,
                  backend_timeout_s: float = 300.0,
-                 no_replica_wait_s: float = 60.0):
+                 no_replica_wait_s: float = 60.0,
+                 affinity: bool = True):
         self.sup = supervisor
         self.host = host
         self._requested_port = port
         self.max_failover = int(max_failover)
         self.backend_timeout_s = float(backend_timeout_s)
         self.no_replica_wait_s = float(no_replica_wait_s)
+        self.affinity = bool(affinity)
         self.port: Optional[int] = None
         self.failovers_total = 0
         self.replica_failures_total = 0
+        # cache-affinity accounting (r15): per PICK (routing decision),
+        # not per request — a failover retry that re-picks counts
+        # again. routed = picks that had a computable first-block key;
+        # hits = picks steered to a replica ADVERTISING the key (vs
+        # rendezvous-hash placement). Guarded by _lock: picks run on
+        # concurrent connection threads.
+        self.affinity_routed_total = 0
+        self.affinity_hits_total = 0
         # optional routing-event hook: trace({"t": ..., "ev": ...,
         # ...}) — the chaos harness uses it for postmortems
         self.trace = None
@@ -435,9 +486,14 @@ class FailoverRouter:
             send({"status": "ok" if self.sup.live() else "degraded",
                   "live": len(self.sup.live()),
                   "failovers_total": self.failovers_total,
+                  "affinity_routed_total": self.affinity_routed_total,
+                  "affinity_hits_total": self.affinity_hits_total,
                   "replicas": [{"idx": r.idx, "port": r.port,
                                 "ready": r.ready, "alive": r.alive(),
-                                "restarts": r.restarts}
+                                "restarts": r.restarts,
+                                "load": getattr(r, "load", 0),
+                                "advertised_prefixes":
+                                    len(getattr(r, "prefix_keys", ()))}
                                for r in self.sup.replicas]})
             return
         if op != "generate":
@@ -456,16 +512,77 @@ class FailoverRouter:
             return
         self._route_generate(msg, send)
 
-    def _pick(self, exclude: set) -> Optional[Replica]:
+    def _affinity_key(self, msg: Dict) -> Optional[str]:
+        """The prompt's first-block prefix key (hex) — the unit the
+        prefix cache shares by and replicas advertise. None when it
+        cannot be computed: unkeyed request, no live replica has
+        reported its page size yet, or the prompt has no full
+        shareable first block (length <= page_size: the cache never
+        shares a block covering the last prompt token)."""
+        if not self.affinity or msg.get("key") is None:
+            return None
+        prompt = msg.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            return None
+        # getattr: the supervisor is duck-typed (tests front plain
+        # stub replicas) — a replica without advertisement fields
+        # simply never attracts affinity routing
+        ps = next((getattr(r, "page_size", None)
+                   for r in self.sup.live()
+                   if getattr(r, "page_size", None)), None)
+        if not ps or len(prompt) <= ps:
+            return None
+        from .prefix_cache import _block_hash
+        try:
+            return _block_hash(None, np.asarray(prompt[:ps],
+                                                np.int32)).hex()
+        except (TypeError, ValueError, OverflowError):
+            return None  # malformed prompt: backend answers BadRequest
+
+    def _pick(self, exclude: set, affinity_key: Optional[str] = None,
+              keyed: bool = False) -> Optional[Replica]:
+        """Pick a live replica outside ``exclude``. With an
+        ``affinity_key``: an ADVERTISING holder wins (ties:
+        least-loaded), else a rendezvous hash over the live set picks
+        a stable owner so repeated prefixes build cache residency on
+        one replica. A KEYED request whose affinity key could not be
+        computed (short prompt, no advertised page size) falls back to
+        least-loaded (round-robin among load ties); unkeyed requests
+        keep the pre-r15 round-robin. Liveness/exclusion filter FIRST
+        — affinity is a preference among survivors and can never block
+        failover."""
         live = [r for r in self.sup.live() if r.idx not in exclude]
         if not live:
             return None
+        if affinity_key is not None:
+            holders = [r for r in live
+                       if affinity_key in getattr(r, "prefix_keys", ())]
+            with self._lock:
+                self.affinity_routed_total += 1
+                if holders:
+                    self.affinity_hits_total += 1
+            if holders:
+                return min(holders,
+                           key=lambda r: (getattr(r, "load", 0), r.idx))
+            # rendezvous (highest-random-weight) hashing: stable under
+            # replica churn — removing one replica only remaps ITS
+            # keys, so the rest of the fleet's cache residency survives
+            return max(live, key=lambda r: hashlib.blake2b(
+                f"{affinity_key}:{r.idx}".encode(),
+                digest_size=8).digest())
+        if keyed:
+            lo = min(getattr(r, "load", 0) for r in live)
+            live = [r for r in live if getattr(r, "load", 0) == lo]
         with self._lock:
             self._rr += 1
             return live[self._rr % len(live)]
 
     def _route_generate(self, msg: Dict, send) -> None:
         keyed = msg.get("key") is not None
+        # cache-affinity steering (r15): computed ONCE per request and
+        # reused across failover attempts — the tried-set exclusion in
+        # _pick keeps a dead affinity target from ever being retried
+        affinity_key = self._affinity_key(msg)
         # token messages already sent to the client — MUTABLE so a
         # _BackendLost raised mid-stream still preserves the relay
         # progress the next attempt must suppress
@@ -494,7 +611,11 @@ class FailoverRouter:
                     pass
 
         while True:
-            rep = self._pick(tried)
+            # affinity=False restores the pre-r15 keyed routing wholly
+            # (round-robin, no least-loaded filter) — the bisect
+            # escape hatch MIGRATION.md documents
+            rep = self._pick(tried, affinity_key=affinity_key,
+                             keyed=keyed and self.affinity)
             trace("pick", rep=None if rep is None else rep.idx,
                   attempts=attempts)
             if rep is None:
@@ -643,6 +764,22 @@ def main(argv=None) -> None:
              "--no-fused-step; fused is the default, greedy outputs "
              "are bit-identical either way)")
     parser.add_argument(
+        "--spill-mb", type=int, default=None, metavar="MB",
+        help="hierarchical prefix cache per replica (r15): host-RAM "
+             "spill tier of this many MB, threaded to every replica's "
+             "server as its --spill-mb; pairs with the router's "
+             "cache-affinity steering (keyed requests land on the "
+             "replica whose tiers hold their prefix)")
+    parser.add_argument(
+        "--spill-dir", default=None, metavar="DIR",
+        help="disk spill tier per replica: each replica i gets "
+             "DIR/replica<i> as its --spill-dir (per-replica subdirs "
+             "keep blob namespaces disjoint)")
+    parser.add_argument(
+        "--spill-disk-mb", type=int, default=1024, metavar="MB",
+        help="byte budget of each replica's disk tier (with "
+             "--spill-dir; default 1024)")
+    parser.add_argument(
         "server_args", nargs="*",
         help="extra args passed to every replica's "
              "`python -m paddle_tpu.serving.server` (e.g. "
@@ -694,6 +831,12 @@ def main(argv=None) -> None:
         server_args += ["--prefill-chunk", str(args.prefill_chunk)]
     if args.no_fused_step:
         server_args += ["--no-fused-step"]
+    if args.spill_mb is not None:
+        server_args += ["--spill-mb", str(args.spill_mb)]
+    if args.spill_dir is not None:
+        server_args += ["--spill-dir",
+                        os.path.join(args.spill_dir, "replica{replica}"),
+                        "--spill-disk-mb", str(args.spill_disk_mb)]
     sup = Supervisor(model=args.model, replicas=args.replicas,
                      host=args.host, server_args=server_args,
                      probe_interval_s=args.probe_interval_s,

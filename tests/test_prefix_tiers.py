@@ -1,0 +1,807 @@
+"""Hierarchical prefix cache (r15): host-RAM/disk spill tiers under
+the refcounted prefix cache, restore via device_put + page-table
+splice, and cache-affinity routing in the failover router.
+
+The contracts pinned here (ISSUE r15 acceptance):
+
+- greedy outputs are BIT-IDENTICAL with spill tiers on vs off across
+  the restore-hit, partial-chain-hit and miss paths (fp + paged_int8,
+  with chunked prefill and speculative decoding riding along), and
+  restored int8 pages are byte-equal to the evicted blob;
+- every restore-unwind path (deadline expiry, close(), resurrection)
+  releases the restored pages with zero leaks and zero dangling tier
+  blobs after drain;
+- a corrupt blob (seeded ``cache.spill`` "torn" fault) is a typed,
+  counted fallback to chained prefill — never wrong tokens;
+- the router's affinity steering lands keyed requests on the replica
+  advertising their first-block prefix key and NEVER blocks failover.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.monitor import StatRegistry
+from paddle_tpu.distributed import fault_inject as fi
+from paddle_tpu.distributed.resilience import NO_RETRY_SITES
+from paddle_tpu.inference import (PageAllocator, SpeculativeConfig,
+                                  create_decode_engine)
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+from paddle_tpu.serving import (DiskSpillTier, HostSpillTier,
+                                PrefixCache, ServingMetrics,
+                                ServingServer, SpillCorrupt,
+                                client_request)
+from paddle_tpu.serving.prefix_cache import (pack_page_blob,
+                                             unpack_page_blob)
+from paddle_tpu.serving.supervisor import FailoverRouter
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _compile_cache(module_compile_cache):
+    """Engine-heavy file: reuse XLA compiles across tests."""
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+ENGINE_KW = dict(num_slots=2, page_size=8, max_seq_len=96, num_pages=12)
+
+
+def _engine(m, **kw):
+    merged = dict(ENGINE_KW)
+    merged.update(kw)
+    return create_decode_engine(m, **merged)
+
+
+def _prompts(shared_len=19, tails=(3, 5, 7, 9)):
+    rng = np.random.default_rng(7)
+    shared = rng.integers(0, 100, (shared_len,)).astype(np.int32)
+    return [np.concatenate([shared,
+                            rng.integers(0, 100, (t,)).astype(np.int32)])
+            for t in tails]
+
+
+def _baseline(model, prompts, mnt=6, **kw):
+    eng = _engine(model, **kw)
+    out = []
+    for p in prompts:
+        rid = eng.submit(p, max_new_tokens=mnt)
+        out.append(eng.run()[rid])
+    eng.close()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Blob format (no jax)
+# ---------------------------------------------------------------------------
+
+class TestBlobFormat:
+    def _layers(self, int8=False, nl=3, shape=(8, 2, 4)):
+        rng = np.random.default_rng(0)
+        out = []
+        for _ in range(nl):
+            if int8:
+                k = rng.integers(-128, 127, shape).astype(np.int8)
+                v = rng.integers(-128, 127, shape).astype(np.int8)
+                ks = rng.random(shape[:2]).astype(np.float32)
+                vs = rng.random(shape[:2]).astype(np.float32)
+            else:
+                k = rng.random(shape).astype(np.float32)
+                v = rng.random(shape).astype(np.float32)
+                ks = vs = None
+            out.append((k, v, ks, vs))
+        return out
+
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_roundtrip_byte_exact(self, int8):
+        layers = self._layers(int8=int8)
+        back = unpack_page_blob(pack_page_blob(layers))
+        assert len(back) == len(layers)
+        for (a, b) in zip(layers, back):
+            for x, y in zip(a, b):
+                if x is None:
+                    assert y is None
+                    continue
+                assert x.dtype == y.dtype and x.shape == y.shape
+                assert x.tobytes() == y.tobytes()
+
+    def test_corruption_is_typed(self):
+        blob = pack_page_blob(self._layers())
+        flipped = blob[:-1] + bytes([blob[-1] ^ 0xFF])
+        with pytest.raises(SpillCorrupt):
+            unpack_page_blob(flipped)
+        with pytest.raises(SpillCorrupt):
+            unpack_page_blob(blob[: len(blob) // 2])  # truncated
+        with pytest.raises(SpillCorrupt):
+            unpack_page_blob(b"XXXX" + blob[4:])  # bad magic
+        with pytest.raises(SpillCorrupt):
+            unpack_page_blob(b"")
+
+
+# ---------------------------------------------------------------------------
+# Tier semantics (no jax)
+# ---------------------------------------------------------------------------
+
+class TestSpillTiers:
+    def test_host_lru_byte_budget_eviction_order(self):
+        t = HostSpillTier(100)
+        t.put(b"a", b"x" * 40)
+        t.put(b"b", b"y" * 40)
+        assert t.get(b"a") is not None  # refresh a: b becomes LRU
+        t.put(b"c", b"z" * 40)  # over budget -> b (LRU) dropped
+        assert t.contains(b"a") and t.contains(b"c")
+        assert not t.contains(b"b")
+        assert t.dropped_blobs == 1
+        assert t.occupancy_bytes == 80
+        t.check_consistent()
+
+    def test_host_demotes_into_disk(self, tmp_path):
+        disk = DiskSpillTier(str(tmp_path), 1000)
+        host = HostSpillTier(50, next_tier=disk)
+        host.put(b"a", b"x" * 40)
+        host.put(b"b", b"y" * 40)  # a demoted to disk, not dropped
+        assert not host.contains(b"a") and disk.contains(b"a")
+        assert host.demoted_blobs == 1 and host.dropped_blobs == 0
+        assert disk.get(b"a") == b"x" * 40
+        # oversize blob skips the host tier entirely
+        host.put(b"c", b"z" * 80)
+        assert not host.contains(b"c") and disk.contains(b"c")
+        for t in (host, disk):
+            t.check_consistent()
+        disk.clear()
+        assert disk.blob_count == 0
+        assert not any(f.endswith(".kvblob")
+                       for f in os.listdir(str(tmp_path)))
+
+    def test_disk_scrubs_stale_blobs_and_audits_dangling(self, tmp_path):
+        (tmp_path / "deadbeef.kvblob").write_bytes(b"stale")
+        disk = DiskSpillTier(str(tmp_path), 1000)
+        # a previous process's blobs never survive into a new tier
+        assert disk.blob_count == 0
+        assert not (tmp_path / "deadbeef.kvblob").exists()
+        disk.put(b"k", b"blob")
+        disk.check_consistent()
+        (tmp_path / "dangling.kvblob").write_bytes(b"x")
+        with pytest.raises(RuntimeError, match="dangling"):
+            disk.check_consistent()
+
+    def test_disk_vanished_file_degrades_to_miss(self, tmp_path):
+        disk = DiskSpillTier(str(tmp_path), 1000)
+        disk.put(b"k", b"blob")
+        os.unlink(disk._path(b"k"))
+        assert disk.get(b"k") is None  # miss, not a crash
+        assert not disk.contains(b"k")
+
+    def test_last_tier_budget_eviction_survives_vanished_file(
+            self, tmp_path):
+        """A last-tier LRU eviction is a pure drop (no read), and a
+        vanished backing file must not raise into the engine's
+        eviction path or corrupt the occupancy books."""
+        disk = DiskSpillTier(str(tmp_path), 100)
+        disk.put(b"a", b"x" * 60)
+        os.unlink(disk._path(b"a"))
+        disk.put(b"b", b"y" * 60)  # evicts a: file already gone
+        assert disk.contains(b"b") and not disk.contains(b"a")
+        assert disk.occupancy_bytes == 60
+        disk.check_consistent()
+
+
+# ---------------------------------------------------------------------------
+# Cache-level spill/restore semantics (fake device IO, no model)
+# ---------------------------------------------------------------------------
+
+class _FakeIO:
+    """Deterministic per-page fake device content: page p, layer l
+    holds the constant p*10+l — enough to verify which blob lands
+    where without a model."""
+
+    def __init__(self):
+        self.reads = 0
+        self.spliced = {}  # dest page -> source constant
+
+    def read_page(self, page):
+        self.reads += 1
+        return [(np.full((4, 2, 3), page * 10 + l, np.float32),
+                 np.full((4, 2, 3), page * 10 + l, np.float32),
+                 None, None) for l in range(2)]
+
+    def splice_page(self, pages, layers_list):
+        self.calls = getattr(self, "calls", 0) + 1
+        for p, layers in zip(pages, layers_list):
+            self.spliced[p] = float(layers[0][0].flat[0])
+
+
+def _unit_cache(**kw):
+    pc = PrefixCache(4, **kw)
+    io = _FakeIO()
+    pc.attach_device_io(io.read_page, io.splice_page)
+    return pc, io
+
+
+class TestCacheSpillRestore:
+    def _seed(self, pc, alloc, prompt):
+        """Insert prompt's shareable chain, release, evict all (spill).
+        Eviction is leaf-first, so spill order is chain-REVERSED.
+        Returns (chain keys, the original page-table row)."""
+        n = pc._shareable_blocks(prompt)
+        pages = alloc.alloc("req", n + 1)
+        row = np.array(pages, dtype=np.int32)
+        keys = pc.insert(prompt, row, alloc, "req", 4, ())
+        pc.release(keys)
+        alloc.free("req")
+        assert pc.evict_until(alloc, alloc.num_pages)
+        return keys, row
+
+    def test_evict_spills_then_restore_reallocates(self):
+        pc, io = _unit_cache(spill_bytes=1 << 20)
+        alloc = PageAllocator(8)
+        prompt = np.arange(13, dtype=np.int32)  # 3 shareable blocks
+        keys, row = self._seed(pc, alloc, prompt)
+        assert pc.spilled_pages == 3
+        assert pc.tiers[0].blob_count == 3
+        # restore the whole chain into fresh pages
+        mk, mp = pc.match(prompt)
+        assert mk == ()
+        rkeys, rpages, info = pc.restore_from_spill(prompt, mk, alloc)
+        assert rkeys == keys and len(rpages) == 3
+        assert info["host"] == 3 and info["ms"] > 0
+        assert pc.tier_hit_pages["host"] == 3
+        # each restored page got ITS original page's content spliced
+        # in chain order (the fake reads page p as the constant p*10)
+        assert [io.spliced[p] for p in rpages] == \
+            [float(row[i] * 10) for i in range(3)]
+        # the whole 3-page run restored in ONE batched splice call
+        assert io.calls == 1
+        # restored entries are regular device entries: match hits now
+        mk2, mp2 = pc.match(prompt)
+        assert mk2 == keys and mp2 == rpages
+        pc.acquire(rkeys)
+        pc.release(rkeys)
+        pc.clear(alloc)
+        alloc.check_no_leak()
+        assert pc.tiers[0].blob_count == 0  # zero dangling blobs
+
+    def test_mid_chain_tier_miss_stops_restore(self):
+        pc, io = _unit_cache(spill_bytes=1 << 20)
+        alloc = PageAllocator(8)
+        prompt = np.arange(13, dtype=np.int32)
+        keys, _ = self._seed(pc, alloc, prompt)
+        pc.tiers[0].remove(keys[1])  # hole in the middle of the chain
+        rkeys, rpages, _ = pc.restore_from_spill(prompt, (), alloc)
+        assert rkeys == keys[:1]  # contiguous prefix only
+        pc.clear(alloc)
+        alloc.check_no_leak()
+
+    def test_corrupt_blob_is_typed_counted_and_removed(self):
+        pc, io = _unit_cache(spill_bytes=1 << 20)
+        alloc = PageAllocator(8)
+        prompt = np.arange(13, dtype=np.int32)
+        keys, _ = self._seed(pc, alloc, prompt)
+        t = pc.tiers[0]
+        blob = t._load(keys[0])
+        t._blobs[keys[0]] = blob[:-1] + bytes([blob[-1] ^ 0xFF])
+        rkeys, _, info = pc.restore_from_spill(prompt, (), alloc)
+        assert rkeys == ()  # nothing spliced past a corrupt head
+        assert pc.restore_corrupt == 1 and info["corrupt"] == 1
+        assert not t.contains(keys[0])  # poisoned blob dropped
+        assert not io.spliced
+        pc.clear(alloc)
+        alloc.check_no_leak()
+
+    def test_cache_spill_fault_write_and_read_sides(self):
+        # write side: an armed abort loses the blob (counted), the
+        # eviction itself still succeeds
+        pc, io = _unit_cache(spill_bytes=1 << 20)
+        alloc = PageAllocator(8)
+        prompt = np.arange(13, dtype=np.int32)
+        fi.get_injector().arm("cache.spill", at_calls=[1])
+        self._seed(pc, alloc, prompt)
+        assert pc.spill_failed == 1
+        assert pc.tiers[0].blob_count == 2  # calls 2,3 spilled fine
+        fi.reset()
+        # read side: an armed abort on restore degrades to a miss
+        fi.get_injector().arm("cache.spill", probability=1.0)
+        rkeys, _, _ = pc.restore_from_spill(prompt, (), alloc)
+        assert rkeys == () and pc.spill_failed == 2
+        fi.reset()
+        pc.clear(alloc)
+        alloc.check_no_leak()
+
+    def test_torn_spill_write_caught_by_crc_on_restore(self):
+        pc, io = _unit_cache(spill_bytes=1 << 20)
+        alloc = PageAllocator(8)
+        prompt = np.arange(13, dtype=np.int32)
+        fi.get_injector().arm("cache.spill", at_calls=[1], mode="torn")
+        keys, _ = self._seed(pc, alloc, prompt)
+        assert pc.tiers[0].blob_count == 3  # torn blob WAS stored
+        fi.reset()
+        rkeys, _, info = pc.restore_from_spill(prompt, (), alloc)
+        # eviction is leaf-first, so the torn first spill is the chain
+        # TAIL: the head restores fine, crc trips at the tail and the
+        # chained-prefill fallback owns the rest
+        assert rkeys == keys[:2] and info["corrupt"] == 1
+        assert pc.restore_corrupt == 1
+        pc.clear(alloc)
+        alloc.check_no_leak()
+
+    def test_reeviction_of_restored_page_is_a_touch_not_a_reread(self):
+        pc, io = _unit_cache(spill_bytes=1 << 20)
+        alloc = PageAllocator(8)
+        prompt = np.arange(9, dtype=np.int32)  # 2 shareable blocks
+        self._seed(pc, alloc, prompt)
+        reads = io.reads
+        rkeys, _, _ = pc.restore_from_spill(prompt, (), alloc)
+        assert len(rkeys) == 2
+        pc.evict_until(alloc, alloc.num_pages)  # evict the restored
+        # inclusive tiers: the blob is still there, so re-eviction
+        # refreshed LRU without a second device read
+        assert io.reads == reads
+        assert pc.tiers[0].blob_count == 2
+        pc.clear(alloc)
+        alloc.check_no_leak()
+
+    def test_advertised_keys_cover_device_and_tiers(self):
+        pc, io = _unit_cache(spill_bytes=1 << 20)
+        alloc = PageAllocator(8)
+        prompt = np.arange(13, dtype=np.int32)
+        keys, _ = self._seed(pc, alloc, prompt)
+        # everything evicted to the host tier: the head key is still
+        # advertised (restorable == steerable)
+        assert keys[0].hex() in pc.advertised_keys()
+        pc.tiers[0].clear()
+        assert keys[0].hex() not in pc.advertised_keys()  # pruned
+        pc.clear(alloc)
+
+    def test_site_registered_with_disposition(self):
+        assert "cache.spill" in fi.FAULT_SITES
+        assert "cache.spill" in NO_RETRY_SITES
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: bit-identity + byte-equality + leak audits
+# ---------------------------------------------------------------------------
+
+class TestEngineRestore:
+    def _force_spill(self, eng):
+        pc = eng._prefix_cache
+        assert pc.evict_until(eng.allocator, eng.allocator.num_pages)
+        return pc
+
+    def test_restore_partial_and_miss_paths_bit_identical_fp(self, model):
+        prompts = _prompts()
+        base = _baseline(model, prompts)
+        pc = PrefixCache(8, spill_bytes=1 << 20)
+        eng = _engine(model, prefix_cache=pc)
+        try:
+            # MISS path: tiers on, nothing spilled yet
+            for p, b in zip(prompts, base):
+                rid = eng.submit(p, max_new_tokens=6)
+                assert np.array_equal(eng.run()[rid], b)
+            self._force_spill(eng)
+            spilled = pc.spilled_pages
+            assert spilled > 0
+            # RESTORE-HIT path: full chain comes back from the host tier
+            rid = eng.submit(prompts[0], max_new_tokens=6)
+            assert np.array_equal(eng.run()[rid], base[0])
+            assert pc.restored_pages > 0
+            assert pc.tier_hit_pages["host"] > 0
+            # PARTIAL-CHAIN-HIT path: drop the chain's tail blobs so
+            # only a prefix restores; the rest rides chained prefill
+            self._force_spill(eng)
+            chain = pc._chain_keys(prompts[1])
+            for key, _parent, _blk in chain[1:]:
+                pc.tiers[0].remove(key)
+            before = pc.restored_pages
+            rid = eng.submit(prompts[1], max_new_tokens=6)
+            assert np.array_equal(eng.run()[rid], base[1])
+            assert pc.restored_pages == before + 1  # head only
+            pc.check_consistent(eng.allocator)
+        finally:
+            eng.close()
+        assert all(t.blob_count == 0 for t in pc.tiers)
+
+    def test_restored_int8_pages_byte_equal_to_blob(self, model):
+        prompts = _prompts()
+        base = _baseline(model, prompts[:2], kv_int8=True)
+        pc = PrefixCache(8, spill_bytes=1 << 20)
+        eng = _engine(model, prefix_cache=pc, kv_int8=True)
+        try:
+            for p, b in zip(prompts[:2], base):
+                rid = eng.submit(p, max_new_tokens=6)
+                assert np.array_equal(eng.run()[rid], b)
+            self._force_spill(eng)
+            blobs = {k: pc.tiers[0]._load(k)
+                     for k in list(pc.tiers[0]._index)}
+            rid = eng.submit(prompts[0], max_new_tokens=6)
+            assert np.array_equal(eng.run()[rid], base[0])
+            assert pc.restored_pages > 0
+            # byte-equality: every restored page's device content
+            # re-reads EXACTLY as the blob it came from
+            for key, ent in pc._entries.items():
+                if key not in blobs:
+                    continue
+                now = eng._read_page(ent.page)
+                packed = unpack_page_blob(blobs[key])
+                for a, b in zip(now, packed):
+                    for x, y in zip(a, b):
+                        if x is None:
+                            assert y is None
+                            continue
+                        assert x.tobytes() == y.tobytes()
+            pc.check_consistent(eng.allocator)
+        finally:
+            eng.close()
+
+    def test_restore_with_chunked_prefill_bit_identical(self, model):
+        prompts = _prompts()
+        base = _baseline(model, prompts)
+        pc = PrefixCache(8, spill_bytes=1 << 20)
+        eng = _engine(model, prefix_cache=pc, prefill_chunk_tokens=8)
+        try:
+            for p, b in zip(prompts, base):
+                rid = eng.submit(p, max_new_tokens=6)
+                assert np.array_equal(eng.run()[rid], b)
+            self._force_spill(eng)
+            rid = eng.submit(prompts[0], max_new_tokens=6)
+            assert np.array_equal(eng.run()[rid], base[0])
+            assert pc.restored_pages > 0
+            pc.check_consistent(eng.allocator)
+        finally:
+            eng.close()
+
+    def test_restore_with_speculative_bit_identical(self, model):
+        prompts = _prompts()
+        base = _baseline(model, prompts[:2])
+        pc = PrefixCache(8, spill_bytes=1 << 20)
+        eng = _engine(model, prefix_cache=pc,
+                      speculative=SpeculativeConfig(k=2))
+        try:
+            for p, b in zip(prompts[:2], base):
+                rid = eng.submit(p, max_new_tokens=6)
+                assert np.array_equal(eng.run()[rid], b)
+            self._force_spill(eng)
+            rid = eng.submit(prompts[0], max_new_tokens=6)
+            assert np.array_equal(eng.run()[rid], base[0])
+            assert pc.restored_pages > 0
+            pc.check_consistent(eng.allocator)
+        finally:
+            eng.close()
+
+    def test_disk_tier_budget_lru_demotion_end_to_end(self, model,
+                                                      tmp_path):
+        """A host tier too small for the working set demotes LRU blobs
+        to disk; a restore that misses host falls through to disk."""
+        prompts = _prompts()
+        base = _baseline(model, prompts)
+        # one gpt_tiny fp page blob is ~16KiB (4 layers x 2 pools x
+        # 8x4x16 f32); host holds ~2 blobs, disk the overflow
+        pc = PrefixCache(8, spill_bytes=40_000,
+                         spill_dir=str(tmp_path), disk_bytes=1 << 20)
+        eng = _engine(model, prefix_cache=pc)
+        try:
+            for p, b in zip(prompts, base):
+                rid = eng.submit(p, max_new_tokens=6)
+                assert np.array_equal(eng.run()[rid], b)
+            self._force_spill(eng)
+            host, disk = pc.tiers
+            assert host.occupancy_bytes <= host.capacity_bytes
+            assert disk.blob_count > 0, "expected LRU demotion to disk"
+            rid = eng.submit(prompts[0], max_new_tokens=6)
+            assert np.array_equal(eng.run()[rid], base[0])
+            assert (pc.tier_hit_pages["host"]
+                    + pc.tier_hit_pages["disk"]) > 0
+            pc.check_consistent(eng.allocator)
+        finally:
+            eng.close()
+        assert not any(f.endswith(".kvblob")
+                       for f in os.listdir(str(tmp_path)))
+
+    def test_torn_spill_falls_back_to_prefill_same_tokens(self, model):
+        prompts = _prompts()
+        base = _baseline(model, prompts[:2])
+        pc = PrefixCache(8, spill_bytes=1 << 20)
+        eng = _engine(model, prefix_cache=pc)
+        try:
+            for p, b in zip(prompts[:2], base):
+                rid = eng.submit(p, max_new_tokens=6)
+                assert np.array_equal(eng.run()[rid], b)
+            fi.get_injector().arm("cache.spill", probability=1.0,
+                                  mode="torn", seed=3)
+            self._force_spill(eng)
+            fi.reset()
+            rid = eng.submit(prompts[0], max_new_tokens=6)
+            # every blob is corrupt: crc trips, chained prefill
+            # recomputes — tokens STILL bit-identical, failure typed
+            assert np.array_equal(eng.run()[rid], base[0])
+            assert pc.restore_corrupt > 0
+            assert pc.restored_pages == 0
+            pc.check_consistent(eng.allocator)
+        finally:
+            eng.close()
+
+    def test_restore_unwind_deadline_and_close_zero_leak(self, model):
+        prompts = _prompts()
+        pc = PrefixCache(8, spill_bytes=1 << 20)
+        eng = _engine(model, prefix_cache=pc)
+        try:
+            rid = eng.submit(prompts[0], max_new_tokens=6)
+            eng.run()
+            self._force_spill(eng)
+            # deadline already expired at admission: the engine sheds
+            # it typed before any restore work is spent
+            eng.submit(prompts[0], max_new_tokens=6,
+                       deadline_t=time.monotonic() - 1.0)
+            eng.step()
+            # restore-hit request evicted mid-flight by a deadline:
+            # admit with a generous budget (so the deadline-hopeless
+            # gate can't shed it before the restore — host-load
+            # dependent), then expire it DETERMINISTICALLY via the
+            # sweep's now= knob: the restored pages are cache-owned
+            # and survive, the request's pins release, books balance
+            rid = eng.submit(prompts[0], max_new_tokens=50,
+                             deadline_t=time.monotonic() + 60.0)
+            eng.step()  # admission restores + first token
+            assert pc.restored_pages > 0
+            expired = eng.expire_deadlines(now=time.monotonic() + 61.0)
+            assert [r.req_id for r in expired] == [rid]
+            assert expired[0].state == "deadline"
+            pc.check_consistent(eng.allocator)
+            # close() mid-flight with a restored chain pinned
+            rid = eng.submit(prompts[0], max_new_tokens=6)
+            eng.step()
+        finally:
+            eng.close()  # asserts check_no_leak internally
+        assert all(t.blob_count == 0 for t in pc.tiers)
+
+    def test_resurrection_with_spill_tiers_zero_leak(self, model):
+        """Engine death mid-decode with spill tiers configured: the
+        rebuilt engine carries the SAME tier config (fresh, empty
+        tiers — the old cache's blobs are scrubbed by close()), the
+        replay is bit-identical, and the books balance after drain."""
+        prompts = [list(range(1, 7)), list(range(3, 12))]
+        exp = _baseline(model, [np.asarray(p, np.int32)
+                                for p in prompts], mnt=8)
+        fi.get_injector().arm("engine.step", at_calls=[3, 4])
+        srv = ServingServer(model, spill_bytes=1 << 20,
+                            max_engine_errors=2,
+                            metrics=ServingMetrics(
+                                registry=StatRegistry()),
+                            **ENGINE_KW)
+        port = srv.start()
+        results = [None, None]
+
+        def client(i):
+            results[i] = client_request(
+                "127.0.0.1", port,
+                {"op": "generate", "prompt": prompts[i],
+                 "max_new_tokens": 8}, timeout_s=180)
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+        for i in range(2):
+            assert results[i] is not None and \
+                "error" not in results[i], results[i]
+            assert results[i]["tokens"] == [int(t) for t in exp[i]]
+        assert srv._restarts == 1
+        # the resurrected engine's cache still carries spill tiers
+        # (the recipe preserved the config)
+        assert srv.prefix_cache.tiers and \
+            srv.prefix_cache.tiers[0].name == "host"
+        chk = client_request("127.0.0.1", port, {"op": "leak_check"})
+        assert chk["ok"], chk
+        srv.stop()
+        srv.prefix_cache.check_consistent(srv.engine.allocator)
+        assert all(t.blob_count == 0
+                   for t in srv.prefix_cache.tiers)
+
+    def test_stats_and_metrics_surfaces(self, model):
+        prompts = _prompts()
+        met = ServingMetrics(registry=StatRegistry())
+        pc = PrefixCache(8, spill_bytes=1 << 20)
+        eng = _engine(model, prefix_cache=pc,
+                      on_complete=met.observe_request)
+        try:
+            for p in prompts:
+                eng.submit(p, max_new_tokens=4)
+            eng.run()
+            self._force_spill(eng)
+            eng.submit(prompts[0], max_new_tokens=4)
+            eng.run()
+            counters = met.snapshot()["counters"]
+            assert counters["cache_restored_pages_total"] > 0
+            assert counters["cache_host_hit_pages_total"] > 0
+            text = met.prometheus_text()
+            assert "serving_restore_ms_bucket" in text
+            assert "serving_cache_restored_pages_total" in text
+            # per-tier stats surface
+            ts = pc.tier_stats()
+            assert set(ts) == {"device", "host"}
+            assert ts["host"]["hit_pages"] > 0
+            assert pc.hit_rate() > 0
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Router cache-affinity steering
+# ---------------------------------------------------------------------------
+
+class _StubReplica:
+    def __init__(self, idx, port=0, page_size=8, load=0, keys=()):
+        self.idx = idx
+        self.port = port
+        self.ready = True
+        self.restarts = 0
+        self.page_size = page_size
+        self.load = load
+        self.prefix_keys = frozenset(keys)
+
+    def alive(self):
+        return True
+
+
+class _StubSup:
+    def __init__(self, reps, host="127.0.0.1"):
+        self.replicas = reps
+        self.host = host
+
+    def live(self):
+        return [r for r in self.replicas if r.ready]
+
+
+def _first_block_key(prompt, page_size=8):
+    from paddle_tpu.serving.prefix_cache import _block_hash
+    return _block_hash(None, np.asarray(prompt[:page_size],
+                                        np.int32)).hex()
+
+
+class TestRouterAffinity:
+    def _router(self, reps):
+        return FailoverRouter(_StubSup(reps))
+
+    def test_advertising_holder_wins(self):
+        prompt = list(range(20))
+        key = _first_block_key(prompt)
+        reps = [_StubReplica(0), _StubReplica(1, keys=[key]),
+                _StubReplica(2)]
+        router = self._router(reps)
+        msg = {"prompt": prompt, "key": "k"}
+        ak = router._affinity_key(msg)
+        assert ak == key
+        for _ in range(4):  # deterministic, not round-robin
+            assert router._pick(set(), affinity_key=ak).idx == 1
+        assert router.affinity_hits_total == 4
+
+    def test_holder_ties_break_least_loaded(self):
+        prompt = list(range(20))
+        key = _first_block_key(prompt)
+        reps = [_StubReplica(0, load=5, keys=[key]),
+                _StubReplica(1, load=1, keys=[key])]
+        router = self._router(reps)
+        assert router._pick(set(), affinity_key=key).idx == 1
+
+    def test_rendezvous_is_stable_and_spreads(self):
+        reps = [_StubReplica(i) for i in range(4)]
+        router = self._router(reps)
+        picks = {}
+        for i in range(32):
+            ak = _first_block_key(list(range(i, i + 20)))
+            p1 = router._pick(set(), affinity_key=ak).idx
+            p2 = router._pick(set(), affinity_key=ak).idx
+            assert p1 == p2  # stable per key
+            picks.setdefault(p1, 0)
+            picks[p1] += 1
+        assert len(picks) >= 2  # different keys spread across replicas
+
+    def test_affinity_never_blocks_failover(self):
+        prompt = list(range(20))
+        key = _first_block_key(prompt)
+        reps = [_StubReplica(0, keys=[key]), _StubReplica(1)]
+        router = self._router(reps)
+        # the advertising holder has been tried and died: excluded —
+        # the pick MUST fall through to another live replica
+        assert router._pick({0}, affinity_key=key).idx == 1
+        # holder not ready (mid-respawn): same
+        reps[0].ready = False
+        assert router._pick(set(), affinity_key=key).idx == 1
+        reps[1].ready = False
+        assert router._pick(set(), affinity_key=key) is None
+
+    def test_keyed_without_affinity_key_goes_least_loaded(self):
+        reps = [_StubReplica(0, load=4), _StubReplica(1, load=1),
+                _StubReplica(2, load=4)]
+        router = self._router(reps)
+        # keyed but no computable key: least-loaded, not round-robin
+        for _ in range(3):
+            assert router._pick(set(), keyed=True).idx == 1
+        # load ties round-robin instead of pinning the lowest idx
+        reps[0].load = reps[2].load = 1
+        picked = {router._pick(set(), keyed=True).idx
+                  for _ in range(6)}
+        assert len(picked) == 3
+
+    def test_unkeyed_and_short_prompts_skip_affinity(self):
+        reps = [_StubReplica(0), _StubReplica(1)]
+        router = self._router(reps)
+        assert router._affinity_key({"prompt": list(range(20))}) is None
+        # prompt shorter than one full shareable block
+        assert router._affinity_key(
+            {"prompt": [1, 2, 3], "key": "k"}) is None
+        # no replica has advertised a page size yet
+        for r in reps:
+            r.page_size = None
+        assert router._affinity_key(
+            {"prompt": list(range(20)), "key": "k"}) is None
+        assert router.affinity_routed_total == 0
+
+    def test_end_to_end_steering_over_live_servers(self, model):
+        """Two in-process servers behind a real router socket: the
+        first keyed request lands somewhere and populates that
+        replica's cache; once the advertisement is refreshed, later
+        keyed requests with the same prefix steer to it."""
+        prompts = _prompts(tails=(3, 5))
+        srvs = [ServingServer(model, spill_bytes=1 << 20, **ENGINE_KW)
+                for _ in range(2)]
+        reps = []
+        try:
+            for i, s in enumerate(srvs):
+                s.start()
+                reps.append(_StubReplica(i, port=s.port))
+            sup = _StubSup(reps)
+            router = FailoverRouter(sup)
+            port = router.start()
+            try:
+                p0 = [int(t) for t in prompts[0]]
+                rep1 = client_request(
+                    "127.0.0.1", port,
+                    {"op": "generate", "prompt": p0,
+                     "max_new_tokens": 4, "key": "a"}, timeout_s=120)
+                assert "error" not in rep1, rep1
+                # refresh advertisements the way the supervisor's
+                # monitor does (stub sup has no monitor thread)
+                for r, s in zip(reps, srvs):
+                    h = client_request("127.0.0.1", s.port,
+                                       {"op": "health"})
+                    r.prefix_keys = frozenset(h["prefix_keys"])
+                    r.page_size = h["page_size"]
+                holder = [i for i, r in enumerate(reps)
+                          if _first_block_key(p0) in r.prefix_keys]
+                assert len(holder) == 1
+                before = router.affinity_hits_total
+                p1 = [int(t) for t in prompts[1]]  # same shared prefix
+                rep2 = client_request(
+                    "127.0.0.1", port,
+                    {"op": "generate", "prompt": p1,
+                     "max_new_tokens": 4, "key": "b"}, timeout_s=120)
+                assert "error" not in rep2, rep2
+                assert router.affinity_hits_total == before + 1
+                # the steered replica actually reused the prefix
+                st = client_request("127.0.0.1", srvs[holder[0]].port,
+                                    {"op": "stats"})
+                assert st["prefix_cache"]["hit_pages"] > 0
+            finally:
+                router.stop()
+        finally:
+            for s in srvs:
+                s.stop()

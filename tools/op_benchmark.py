@@ -152,11 +152,24 @@ def promoted_cases():
         # logits tensor never materializes (tile=1024 -> 4 tiles)
         return (_f32(8, 256), _f32(4096, 256), None, True, None, 1024)
 
+    def prefix_restore():
+        # r15 hierarchical prefix cache restore shape: splice one
+        # spilled 16-token page's KV block back into the standard
+        # decode pool (device_put + .at[page].set scatter — the
+        # engine's per-pool primitive; the whole-restore path runs one
+        # such splice per layer pool per restored page). This is the
+        # op whose latency must sit well under the chained prefill a
+        # restore replaces.
+        return (_f32(65, 16, 8, 64), _f32(16, 8, 64), 5)
+
+    prefix_restore.op_name = "paged_page_splice"
+
     return {"paged_attention_head_sharded": _paged_case,
             "prefill_chunk_step": _prefill_chunk_case,
             "fused_decode_step": fused_decode_step,
             "fused_verify": fused_verify,
-            "fused_sample": fused_sample}
+            "fused_sample": fused_sample,
+            "prefix_restore": prefix_restore}
 
 
 def bench_op(name: str, make_args, repeat: int) -> dict:
