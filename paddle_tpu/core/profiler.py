@@ -88,6 +88,26 @@ def profiler_events() -> List[_Event]:
         return list(_STATE.events)
 
 
+def profiler_active() -> bool:
+    """Cheap enabled-check for external event sources (the serving
+    span tracer bridges through this before paying any work)."""
+    return _STATE.enabled or bool(get_flag("profiler_enabled"))
+
+
+def external_event(name: str, start_us: float, end_us: float,
+                   annotation: Optional[str] = None) -> None:
+    """Inject an externally-timed host event (perf_counter/monotonic
+    microseconds — the same clock domain on Linux). The serving span
+    tracer (serving/tracing.py) uses this so request spans land in the
+    same ``export_chrome_trace`` as RecordEvent markers."""
+    if not profiler_active():
+        return
+    evt = _Event(name, float(start_us), float(end_us),
+                 threading.get_ident(), annotation)
+    with _STATE.lock:
+        _STATE.events.append(evt)
+
+
 def export_chrome_trace(path: str) -> None:
     """Write collected host events as a chrome://tracing JSON file."""
     with _STATE.lock:

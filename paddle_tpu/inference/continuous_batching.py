@@ -66,6 +66,7 @@ rebuilt as a scheduler over one jitted step instead of a stream pool.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import time
@@ -329,6 +330,12 @@ class DecodeRequest:
     deadline_t: Optional[float] = None
     # last time a token was delivered (stall watchdog input)
     last_emit_t: float = 0.0
+    # end-to-end tracing (r16): the request's span tree
+    # (serving/tracing.py RequestTrace; None = unsampled — the hot
+    # path's only cost is this attribute check) and the currently open
+    # lifecycle-stage span (queue -> prefill -> decode)
+    trace: Any = None
+    span: Any = None
 
     @property
     def tokens(self) -> np.ndarray:
@@ -359,7 +366,9 @@ class ContinuousBatchingEngine:
                  stall_timeout_s: Optional[float] = None,
                  mesh=None,
                  prefill_chunk_tokens: Optional[int] = None,
-                 fused_step: bool = True):
+                 fused_step: bool = True,
+                 tracer=None, timeline_steps: int = 256,
+                 capture_costs: bool = False):
         import jax.numpy as jnp
 
         from ..core.compile_cache import enable_compile_cache
@@ -558,6 +567,33 @@ class ContinuousBatchingEngine:
         # compiled fast path) — the fused_decode A/B's currency and
         # the serving_step_programs gauge's source
         self.step_programs: Dict[str, int] = {}
+        # end-to-end tracing (r16): a serving/tracing.py SpanTracer
+        # (None = off, the default — every hook degrades to one
+        # attribute check; sampling happens once per request at
+        # submit, so there is NO per-token cost for unsampled work)
+        self._tracer = tracer
+        # step timeline (r16): a fixed-size ring of per-step records —
+        # programs launched by kind, decode/verify/chunk/splice wall
+        # ms, slot occupancy and page pressure. Always on: one small
+        # dict per ENGINE STEP (never per token) next to a jit launch.
+        self.timeline: "collections.deque" = collections.deque(
+            maxlen=max(1, int(timeline_steps)))
+        # cumulative program launches by kind (every jit call — 1 per
+        # launch, unlike step_programs which records traced-op counts)
+        self.programs_launched: Dict[str, int] = {}
+        self._tl_programs: Dict[str, int] = {}
+        self._tl_ms: Dict[str, float] = {}
+        # program cost capture (r16 satellite): at each program kind's
+        # first (re)trace, run jit.lower(...).cost_analysis() on stub
+        # avals — flops / bytes-accessed estimates for the
+        # serving_program_* gauges (replacing the r10 collective-bytes
+        # stub). Engine-thread only (bind_state tracing is not
+        # thread-safe) and OFF by default: the extra abstract trace
+        # per kind (~decode-trace cost) is only worth paying where the
+        # numbers are scraped — the server enables it.
+        self._capture_costs = bool(capture_costs)
+        self._program_costs: Dict[str, Dict] = {}
+        self._kv_dtype = dt
         # speculative decoding (inference/speculative.py): draft k
         # tokens per step, verify all k+1 in ONE forward, emit the
         # longest accepted prefix + 1. Greedy stays bit-identical to
@@ -582,7 +618,14 @@ class ContinuousBatchingEngine:
     def submit(self, prompt, max_new_tokens: int,
                eos_token: Optional[int] = None, priority: int = 1,
                on_token: Optional[Callable[[int, int, bool], None]] = None,
-               deadline_t: Optional[float] = None) -> int:
+               deadline_t: Optional[float] = None,
+               trace=None, trace_ctx: Optional[Dict] = None) -> int:
+        """``trace``: an existing RequestTrace to CONTINUE (resurrection
+        replay resubmits the in-flight request onto the same tree);
+        ``trace_ctx``: a wire context from an upstream hop (the
+        failover router) that forces sampling and links this request's
+        root under the upstream span. With neither, the engine's own
+        tracer (if any) makes the sampling decision."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) + max_new_tokens > self.max_seq_len:
             raise ValueError(
@@ -611,6 +654,24 @@ class ContinuousBatchingEngine:
         req.stats.submit_t = time.monotonic()
         req.stats.prompt_len = len(prompt)
         self._next_id += 1
+        tr = trace
+        if tr is None and self._tracer is not None:
+            if trace_ctx is not None:
+                tr = self._tracer.start(
+                    "request", ctx=trace_ctx, req_id=req.req_id,
+                    prompt_len=len(prompt),
+                    max_new=int(max_new_tokens))
+            elif self._tracer.sample():
+                tr = self._tracer.start(
+                    "request", sampled=True, req_id=req.req_id,
+                    prompt_len=len(prompt),
+                    max_new=int(max_new_tokens))
+        if tr is not None:
+            req.trace = tr
+            req.span = tr.begin("queue", parent=tr.anchor,
+                                req_id=req.req_id,
+                                priority=int(priority),
+                                prompt_len=len(prompt))
         self._queue.append(req)
         return req.req_id
 
@@ -790,9 +851,137 @@ class ContinuousBatchingEngine:
 
     def _record_programs(self, kind: str, count: int) -> None:
         """Record a (re)trace's program op count; the compiled fast
-        path counts zero and keeps the last traced figure."""
+        path counts zero and keeps the last traced figure. Every call
+        is also one program LAUNCH of ``kind`` — the step timeline's
+        per-kind launch currency (r16)."""
         if count:
             self.step_programs[kind] = count
+        self.programs_launched[kind] = \
+            self.programs_launched.get(kind, 0) + 1
+        self._tl_programs[kind] = self._tl_programs.get(kind, 0) + 1
+
+    # -- end-to-end tracing hooks (r16) -------------------------------------
+    #
+    # Every hook is a `req.trace is None` check when tracing is off —
+    # the ~zero-cost contract. Stage spans (queue -> prefill -> decode)
+    # live on req.span; per-step work is appended as pre-timed closed
+    # spans (RequestTrace.add), so the per-slot cost of a traced step
+    # is one list append, with no extra clock reads per slot.
+
+    def _tr_end(self, req: DecodeRequest, **args) -> None:
+        """Close the request's current lifecycle-stage span (no-op for
+        unsampled requests); stage OPENS stay at their sites, where
+        the stage-specific args live."""
+        tr = req.trace
+        if tr is not None and req.span is not None:
+            tr.end(req.span, **args)
+            req.span = None
+
+    # -- step timeline + program cost capture (r16) -------------------------
+
+    def _tl_commit(self, t_step: float) -> None:
+        """Append one fixed-size step-timeline record (bounded ring)."""
+        entry: Dict[str, Any] = {
+            "step": self.steps,
+            "t_us": t_step * 1e6,
+            "ms": round((time.monotonic() - t_step) * 1e3, 4),
+            "programs": self._tl_programs,
+            "slots_active": self.num_active,
+            "slots_decoding": sum(
+                1 for r in self._slots
+                if r is not None and r.state == "decoding"),
+            "queued": len(self._queue),
+            "free_pages": self.allocator.free_count,
+            "reserved_pages": self.allocator.reserved_total,
+        }
+        for k, v in self._tl_ms.items():
+            entry[k] = round(v, 4)
+        self.timeline.append(entry)
+
+    def step_timeline(self) -> List[Dict[str, Any]]:
+        """Snapshot of the per-step ring (oldest first) — the server's
+        ``trace``/``stats`` ops and the goodput bench read this."""
+        return list(self.timeline)
+
+    def _tl_add_ms(self, key: str, seconds: float) -> None:
+        self._tl_ms[key] = self._tl_ms.get(key, 0.0) + seconds * 1e3
+
+    def _capture_cost(self, kind: str, jitfn, args: Tuple) -> None:
+        """Capture flops / bytes-accessed estimates for ``kind`` from
+        ``jit.lower(...).cost_analysis()`` on stub avals (no compile,
+        no execution) — once per program kind, at (re)trace time, on
+        the ENGINE thread (bind_state substitution is process-global,
+        so a scrape thread must never trace the model concurrently).
+        These feed the serving_program_* gauges that replace the r10
+        ``serving_mesh_collective_bytes`` 0-stub; the chip-MEASURED
+        collective traffic still needs an on-chip profiler session
+        (chip-pending, as before)."""
+        if not self._capture_costs or kind in self._program_costs:
+            return
+        import jax
+
+        def stub(x):
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                sh = getattr(x, "sharding", None)
+                if sh is not None and self.mesh is not None:
+                    # host-side args (page table, lens, tokens) land
+                    # on ONE device in the live call and jax replicates
+                    # them; an abstract lower() has no auto-placement,
+                    # so stub them replicated over the mesh or the
+                    # mixed device sets fail the lowering
+                    try:
+                        if len(sh.device_set) == 1:
+                            from jax.sharding import (NamedSharding,
+                                                      PartitionSpec)
+                            sh = NamedSharding(self.mesh,
+                                               PartitionSpec())
+                    except Exception:
+                        sh = None
+                try:
+                    return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                sharding=sh)
+                except TypeError:
+                    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+            return x
+
+        try:
+            stubs = jax.tree_util.tree_map(stub, args)
+            ca = jitfn.lower(*stubs).cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            self._program_costs[kind] = {
+                "flops": float(ca.get("flops") or 0.0),
+                "bytes_accessed": float(ca.get("bytes accessed")
+                                        or 0.0),
+            }
+        except Exception as e:  # cost capture must never break a step
+            self._program_costs[kind] = {
+                "error": f"{type(e).__name__}: {e}"}
+
+    def program_costs(self) -> Dict[str, Dict]:
+        """Per-program-kind cost estimates captured so far (empty
+        until the first traced launch, or with capture off)."""
+        return dict(self._program_costs)
+
+    def mesh_collective_bytes_estimate(self) -> Optional[float]:
+        """Estimated per-decode-step collective traffic under the
+        serving mesh (None = single-device): the mp-partitioned
+        contractions all-reduce their partial sums — 2 row-parallel
+        reductions per layer (attention out-projection + MLP
+        down-projection) plus the sampled-head reduction — and a ring
+        all-reduce moves ``2 * (mp-1)/mp`` of the tensor bytes per
+        device. The per-program flops/bytes figures come from
+        ``program_costs`` (cost_analysis); the chip-MEASURED value
+        remains chip-pending (xprof collective stats)."""
+        if self.mesh is None:
+            return None
+        mp = int(self.mesh.shape[self._mesh_axis])
+        if mp <= 1:
+            return 0.0
+        import numpy as _np
+        itemsize = _np.dtype(self._kv_dtype).itemsize
+        act = self.num_slots * int(self.cfg.hidden_size) * itemsize
+        return float((2 * self._nl + 1) * act * 2 * (mp - 1) / mp)
 
     def _constrain_pools(self, pools):
         """Pin the returned pools to the engine's KV sharding (heads
@@ -894,15 +1083,20 @@ class ContinuousBatchingEngine:
             from ..models.gpt import paged_page_splice
 
             def splice(pools, pg, kb, vb, ksb, vsb):
-                return self._constrain_pools(
-                    paged_page_splice(pools, pg, kb, vb, ksb, vsb))
+                with jax.named_scope("pt.page_splice"):
+                    return self._constrain_pools(
+                        paged_page_splice(pools, pg, kb, vb, ksb, vsb))
 
             self._splice_jit = jax.jit(splice, donate_argnums=(0,))
         from ..dispatch import count_op_calls
+        args = (self._pools, jnp.asarray(page_idx), k, v, ks, vs)
+        t0 = time.monotonic()
         with count_op_calls() as c:
-            self._pools = self._splice_jit(
-                self._pools, jnp.asarray(page_idx), k, v, ks, vs)
+            self._pools = self._splice_jit(*args)
+        self._tl_add_ms("splice_ms", time.monotonic() - t0)
         self._record_programs("restore", c.count)
+        if c.count:
+            self._capture_cost("restore", self._splice_jit, args)
 
     def mesh_info(self) -> Optional[Dict[str, Any]]:
         """Mesh observability record (server stats / Prometheus):
@@ -928,7 +1122,11 @@ class ContinuousBatchingEngine:
 
         def step(state, pools, table, lens, tokens):
             caches = self._caches(pools, table, lens)
-            with self._head_ctx(), self._fuse_ctx(), \
+            # named_scope: metadata-only, UNCONDITIONAL (never keyed on
+            # tracing state, so programs are identical tracing on/off)
+            # — serving steps show up inside jax.profiler device traces
+            with jax.named_scope("pt.decode_step"), self._head_ctx(), \
+                    self._fuse_ctx(), \
                     bind_state(self.model, state), no_grad():
                 hp = self._fused_head()
                 if hp is not None:
@@ -988,7 +1186,9 @@ class ContinuousBatchingEngine:
 
         def prefill(state, pools, trow, slens, plen, ids):
             caches = self._caches(pools, trow, slens)
-            with self._head_ctx(), self._fuse_ctx(), \
+            with jax.named_scope(
+                    "pt.prefill_chained" if chained else "pt.prefill"), \
+                    self._head_ctx(), self._fuse_ctx(), \
                     bind_state(self.model, state), no_grad():
                 hp = self._fused_head()
                 if hp is not None:
@@ -1054,7 +1254,8 @@ class ContinuousBatchingEngine:
 
         def verify(state, pools, table, lens, tokens, valid, key):
             caches = self._caches(pools, table, lens)
-            with self._head_ctx(), self._fuse_ctx(), \
+            with jax.named_scope("pt.verify_step"), self._head_ctx(), \
+                    self._fuse_ctx(), \
                     bind_state(self.model, state), no_grad():
                 hp = self._fused_head()
                 if hp is not None:
@@ -1121,6 +1322,14 @@ class ContinuousBatchingEngine:
             self._notify_complete(req)
         else:
             req.state = "queued"
+            # a requeued request is queued again: close any stage span
+            # (the chunked-mode "prefill") and reopen "queue" so the
+            # tree mirrors the real lifecycle
+            self._tr_end(req, state="prefill_failed")
+            if req.trace is not None:
+                req.span = req.trace.begin(
+                    "queue", parent=req.trace.anchor,
+                    retry=req.stats.prefill_attempts)
             self._queue.insert(0, req)
 
     def _check_pools_live(self, what: str) -> None:
@@ -1236,6 +1445,18 @@ class ContinuousBatchingEngine:
         self._on_complete = fn
 
     def _notify_complete(self, req: DecodeRequest) -> None:
+        tr = req.trace
+        if tr is not None:
+            # EVERY terminal path funnels through here, so this is the
+            # one place open stage spans close and the tree finishes —
+            # the zero-leaked-open-spans contract. Resurrection
+            # detaches req.trace BEFORE teardown, so a replayed
+            # request's tree survives to be continued, not finished.
+            self._tr_end(req, state=req.state)
+            tr.event("complete", parent=tr.anchor, state=req.state,
+                     tokens_out=len(req.generated),
+                     req_id=req.req_id)
+            tr._tracer.finish(tr, state=req.state)
         if self._on_complete is not None:
             self._on_complete(req)
 
@@ -1428,6 +1649,9 @@ class ContinuousBatchingEngine:
         not charge fairness accounting)."""
         jnp = self._jnp
         cache = self._prefix_cache
+        tr = req.trace
+        sp_admit = (tr.begin("admit", parent=tr.anchor, slot=slot)
+                    if tr is not None else None)
         keys: Tuple[Hashable, ...] = ()
         shared: List[int] = []
         if cache is not None:
@@ -1450,8 +1674,13 @@ class ContinuousBatchingEngine:
                 # miss mid-chain just stops here; the chained-prefill
                 # suffix path below covers the rest, so outputs are
                 # bit-identical either way.
+                rsp = (tr.begin("restore", parent=sp_admit)
+                       if tr is not None else None)
                 rkeys, rpages, rinfo = cache.restore_from_spill(
                     req.prompt, keys, self.allocator, memo=req)
+                if tr is not None:
+                    tr.end(rsp, pages=len(rkeys),
+                           corrupt=rinfo.get("corrupt", 0))
                 if rkeys:
                     cache.acquire(rkeys)
                     keys = tuple(keys) + rkeys
@@ -1497,11 +1726,25 @@ class ContinuousBatchingEngine:
             # roll back)
             pages = None
         if pages is None:
+            if tr is not None:
+                tr.end(sp_admit, admitted=False, reason="no_fit")
             if cache is not None:
                 cache.release(keys)
             self._queue.insert(0, req)
             return False
         req.stats.admit_t = time.monotonic()
+        if tr is not None:
+            # the queue stage ends at the committed admission; the
+            # scheduler's explain() (duck-typed) attributes WHY the
+            # request waited (class, promotion, bypasses)
+            exp = {}
+            explain = getattr(self._scheduler, "explain", None)
+            if explain is not None:
+                try:
+                    exp = dict(explain(req, req.stats.admit_t))
+                except Exception:
+                    exp = {}
+            self._tr_end(req, bypass_count=req.bypass_count, **exp)
         req.stats.cached_pages = len(shared)
         req.stats.cached_tokens = cached_len
         req.stats.prompt_pages = (len(req.prompt) - 1) // self.page_size
@@ -1526,6 +1769,14 @@ class ContinuousBatchingEngine:
             self._lens[slot] = cached_len
             self._cur[slot] = 0
             self._slots[slot] = req
+            if tr is not None:
+                tr.end(sp_admit, cached_pages=len(shared),
+                       restored_pages=req.stats.restored_pages)
+                # chunked mode: the prefill STAGE stays open across
+                # chunks; each chunk appends a child span
+                req.span = tr.begin(
+                    "prefill", parent=tr.anchor, chunked=True,
+                    remaining=len(req.prompt) - cached_len)
             return True
         suffix = req.prompt[cached_len:]
         bucket = self._bucket(len(suffix))
@@ -1533,20 +1784,29 @@ class ContinuousBatchingEngine:
         ids[0, :len(suffix)] = suffix
         chained = cached_len > 0
         jit = self._get_prefill(chained)
+        if tr is not None:
+            tr.end(sp_admit, cached_pages=len(shared),
+                   restored_pages=req.stats.restored_pages)
+        sp_pref = (tr.begin("prefill", parent=tr.anchor, bucket=bucket,
+                            chained=chained)
+                   if tr is not None else None)
 
         def run_prefill():
             from ..dispatch import count_op_calls
             from ..distributed.fault_inject import fault_point
             self._check_pools_live("prefill")
             fault_point("serving.prefill")
+            kind = "prefill_chained" if chained else "prefill"
+            args = (self._fresh_state(refresh=True), self._pools,
+                    jnp.asarray(row[None]),
+                    jnp.asarray([cached_len], jnp.int32),
+                    jnp.asarray([len(suffix)], jnp.int32),
+                    jnp.asarray(ids))
             with count_op_calls() as c:
-                out = jit(self._fresh_state(refresh=True), self._pools,
-                          jnp.asarray(row[None]),
-                          jnp.asarray([cached_len], jnp.int32),
-                          jnp.asarray([len(suffix)], jnp.int32),
-                          jnp.asarray(ids))
-            self._record_programs(
-                "prefill_chained" if chained else "prefill", c.count)
+                out = jit(*args)
+            self._record_programs(kind, c.count)
+            if c.count:
+                self._capture_cost(kind, jit, args)
             return out
 
         t0 = time.monotonic()
@@ -1565,11 +1825,16 @@ class ContinuousBatchingEngine:
             # execution began, the donated pool buffers may be gone
             # with it — compile-time failures, the documented class,
             # leave them untouched.)
+            if tr is not None:
+                tr.end(sp_pref, error=True)
             self._unwind_prefill_failure(slot, req)
             raise
         self._pools = pools
         now = time.monotonic()
         req.stats.prefill_ms = (now - t0) * 1e3
+        self._tl_add_ms("prefill_ms", now - t0)
+        if tr is not None:
+            tr.end(sp_pref, ms=round(req.stats.prefill_ms, 3))
         req.stats.prefill_attempts += 1
         req.stats.prefill_chunks = 1  # whole prefill = one launch
         if req.deadline_t is not None and now >= req.deadline_t:
@@ -1603,6 +1868,9 @@ class ContinuousBatchingEngine:
                 self.page_size, keys,
                 device_hits=getattr(req, "_pfx_device_hits", None))
         self._slots[slot] = req
+        if tr is not None:
+            tr.event("first_token", parent=tr.anchor, token=int(nxt))
+            req.span = tr.begin("decode", parent=tr.anchor)
         self._emit_token(req, int(nxt))
         self._maybe_finish(slot)
         return True
@@ -1666,16 +1934,24 @@ class ContinuousBatchingEngine:
             from ..distributed.fault_inject import fault_point
             self._check_pools_live("prefill")
             fault_point("serving.prefill")
+            kind = "prefill_chained" if chained else "prefill"
+            args = (self._fresh_state(refresh=True), self._pools,
+                    jnp.asarray(row[None]),
+                    jnp.asarray([done], jnp.int32),
+                    jnp.asarray([len(suffix)], jnp.int32),
+                    jnp.asarray(ids))
             with count_op_calls() as c:
-                out = jit(self._fresh_state(refresh=True), self._pools,
-                          jnp.asarray(row[None]),
-                          jnp.asarray([done], jnp.int32),
-                          jnp.asarray([len(suffix)], jnp.int32),
-                          jnp.asarray(ids))
-            self._record_programs(
-                "prefill_chained" if chained else "prefill", c.count)
+                out = jit(*args)
+            self._record_programs(kind, c.count)
+            if c.count:
+                self._capture_cost(kind, jit, args)
             return out
 
+        tr = req.trace
+        sp_chunk = (tr.begin("prefill_chunk", parent=req.span,
+                             idx=req.stats.prefill_chunks,
+                             done_tokens=done)
+                    if tr is not None else None)
         t0 = time.monotonic()
         try:
             if self._prefill_retry is not None:
@@ -1686,10 +1962,15 @@ class ContinuousBatchingEngine:
         except Exception:
             # unwind the WHOLE half-prefilled admission (not just this
             # chunk) — shared with the whole-prefill failure path
+            if tr is not None:
+                tr.end(sp_chunk, error=True)
             self._unwind_prefill_failure(slot, req)
             raise
         self._pools = pools
         now = time.monotonic()
+        self._tl_add_ms("chunk_ms", now - t0)
+        if tr is not None:
+            tr.end(sp_chunk, tokens=len(suffix))
         req.stats.prefill_ms += (now - t0) * 1e3
         req.stats.prefill_chunks += 1
         if self._chunk_warm[chained]:
@@ -1727,6 +2008,12 @@ class ContinuousBatchingEngine:
         req.state = "decoding"
         req.generated.append(int(nxt))
         req.stats.tokens_out = 1
+        if tr is not None:
+            # close the chunked "prefill" stage, mark the first token,
+            # and open the decode stage — same shape as whole prefill
+            self._tr_end(req, chunks=req.stats.prefill_chunks)
+            tr.event("first_token", parent=tr.anchor, token=int(nxt))
+            req.span = tr.begin("decode", parent=tr.anchor)
         if cache is not None:
             # the slot's full prompt pages now hold valid KV — hand
             # them to the cache (ownership transfer; the matched keys
@@ -1856,19 +2143,24 @@ class ContinuousBatchingEngine:
             from ..distributed.fault_inject import fault_point
             self._check_pools_live("verify")
             fault_point("serving.verify")
-            with count_op_calls() as c:
-                out = self._verify_jit(
-                    self._fresh_state(), self._pools,
+            args = (self._fresh_state(), self._pools,
                     jnp.asarray(self._table), jnp.asarray(self._lens),
                     jnp.asarray(tokens), jnp.asarray(valid), key)
+            with count_op_calls() as c:
+                out = self._verify_jit(*args)
             self._record_programs("verify", c.count)
+            if c.count:
+                self._capture_cost("verify", self._verify_jit, args)
             return out
 
+        t0v = time.monotonic()
         if self._verify_retry is not None:
             accept, resid, full, pools = self._verify_retry.call(
                 run_verify, site="serving.verify")
         else:
             accept, resid, full, pools = run_verify()
+        t1v = time.monotonic()
+        self._tl_add_ms("verify_ms", t1v - t0v)
         self._pools = pools
         accept = np.asarray(accept)
         resid = np.asarray(resid)
@@ -1883,6 +2175,10 @@ class ContinuousBatchingEngine:
             req.stats.spec_steps += 1
             req.stats.spec_drafted += k_eff
             req.stats.spec_accepted += n
+            if req.trace is not None:
+                req.trace.add("verify_step", t0v * 1e6, t1v * 1e6,
+                              parent=req.span, step=self.steps,
+                              drafted=k_eff, accepted=n)
             nxt = int(resid[i, n]) if n < k_eff else int(full[i, k_eff])
             emitted = [int(t) for t in tokens[i, 1:1 + n]] + [nxt]
             finished = False
@@ -1918,6 +2214,18 @@ class ContinuousBatchingEngine:
         replay)."""
         from ..distributed.fault_inject import fault_point
         fault_point("engine.step")
+        # r16 step timeline: reset per-step accumulators, commit one
+        # ring entry per step attempt (a dict per STEP — never per
+        # token — next to at least one jit launch)
+        self._tl_programs = {}
+        self._tl_ms = {}
+        t_step = time.monotonic()
+        try:
+            return self._step_inner()
+        finally:
+            self._tl_commit(t_step)
+
+    def _step_inner(self) -> int:
         self.expire_deadlines()
         self.evict_stalled()
         self._admit()
@@ -1968,12 +2276,17 @@ class ContinuousBatchingEngine:
                              self._scratch).astype(np.int32)
             lens = np.where(decoding, lens, 0).astype(np.int32)
         from ..dispatch import count_op_calls
-        with count_op_calls() as c:
-            nxt, pools, lens_new = self._decode_jit(
-                self._fresh_state(), self._pools,
+        args = (self._fresh_state(), self._pools,
                 jnp.asarray(table), jnp.asarray(lens),
                 jnp.asarray(self._cur))
+        t0d = time.monotonic()
+        with count_op_calls() as c:
+            nxt, pools, lens_new = self._decode_jit(*args)
+        t1d = time.monotonic()
+        self._tl_add_ms("decode_ms", t1d - t0d)
         self._record_programs("decode", c.count)
+        if c.count:
+            self._capture_cost("decode", self._decode_jit, args)
         self._pools = pools
         nxt = np.asarray(nxt)
         # non-decoding slots wrote to the scratch page; keep their host
@@ -1989,6 +2302,12 @@ class ContinuousBatchingEngine:
             req.generated.append(tok)
             req.stats.tokens_out = len(req.generated)
             self._cur[slot] = tok
+            if req.trace is not None:
+                # pre-timed closed span: one list append per traced
+                # in-flight request, no extra clock reads per slot
+                req.trace.add("decode_step", t0d * 1e6, t1d * 1e6,
+                              parent=req.span, step=self.steps,
+                              token=tok)
             self._emit_token(req, tok)
             self._maybe_finish(slot)
         return self.num_active

@@ -29,6 +29,15 @@ continuous batching, PR r6) into a servable system:
   Prometheus-style text export — plus speculative-decoding
   acceptance-rate and tokens-per-step histograms (r8), engine
   occupancy gauges and resurrection/replay counters (r9).
+- ``tracing``: end-to-end request tracing (r16) — a sampling,
+  bounded-memory span tracer threading ONE trace id from the failover
+  router through replica, scheduler queue, admission, every prefill
+  chunk, decode/verify step, spill-tier restore, resurrection replay
+  and failover hop; per-request span trees export as JSON (validated
+  by tools/trace_lint.py) or Chrome trace events mergeable with
+  ``jax.profiler`` device traces (tools/merge_traces.py). Off by
+  default at ~zero hot-path cost; PT_SERVING_DEBUG=1 is this tracer
+  at sample 1.0 with a stderr sink.
 - ``supervisor``: crash-safe serving above the process boundary (r9)
   — N supervised replica processes with health-probed backoff
   restarts, fronted by a failover router that resubmits idempotent
@@ -58,6 +67,8 @@ from .prefix_cache import (DiskSpillTier, HostSpillTier,  # noqa: F401
                            PrefixCache, SpillCorrupt)
 from .scheduler import (Priority, ServerOverloaded, SLOConfig,  # noqa: F401
                         SLOScheduler)
+from .tracing import (RequestTrace, SpanTracer,  # noqa: F401
+                      request_latencies, stderr_span_sink)
 
 
 def __getattr__(name):

@@ -147,7 +147,13 @@ class ServingMetrics:
                 "cache_host_hit_pages_total",
                 "cache_disk_hit_pages_total",
                 "cache_restored_pages_total",
-                "cache_restore_corrupt_total")
+                "cache_restore_corrupt_total",
+                # end-to-end tracing (r16): sampling/ring accounting —
+                # synced from the SpanTracer's lifetime counters at
+                # scrape time (tracer counts are monotonic, so the
+                # counter contract holds)
+                "traces_sampled_total", "traces_finished_total",
+                "trace_spans_dropped_total")
 
     def __init__(self, registry: Optional[StatRegistry] = None,
                  prefix: str = "serving"):
@@ -181,6 +187,10 @@ class ServingMetrics:
         # restore at admission (device_put + page-table splice) — the
         # number that must sit well under the prefill it replaces
         self.restore_ms = Histogram(f"{prefix}.restore_ms")
+        # step timeline (r16): whole-engine-step wall time, fed from
+        # the engine's ring-buffer deltas at scrape time (the server
+        # tracks which steps it has already observed)
+        self.step_ms = Histogram(f"{prefix}.step_ms")
 
     def counter(self, name: str):
         return self.registry.get(f"{self.prefix}.{name}")
@@ -203,6 +213,7 @@ class ServingMetrics:
         self.prefill_chunk_ms = Histogram(
             f"{self.prefix}.prefill_chunk_ms")
         self.restore_ms = Histogram(f"{self.prefix}.restore_ms")
+        self.step_ms = Histogram(f"{self.prefix}.step_ms")
 
     # -- ingestion ---------------------------------------------------------
 
@@ -323,6 +334,7 @@ class ServingMetrics:
             "prefill_chunks": self.prefill_chunks.snapshot(),
             "prefill_chunk_ms": self.prefill_chunk_ms.snapshot(),
             "restore_ms": self.restore_ms.snapshot(),
+            "step_ms": self.step_ms.snapshot(),
         }
 
     def prometheus_text(self) -> str:
@@ -337,7 +349,8 @@ class ServingMetrics:
         for h in (self.ttft_ms, self.tpot_ms, self.queue_delay_ms,
                   self.prefill_ms, self.e2e_ms, self.spec_accept_rate,
                   self.spec_tokens_per_step, self.prefill_chunks,
-                  self.prefill_chunk_ms, self.restore_ms):
+                  self.prefill_chunk_ms, self.restore_ms,
+                  self.step_ms):
             lines.extend(h.prometheus_lines())
         for name, val in sorted(self.gauges().items()):
             gname = f"{self.prefix}_{name}".replace(".", "_")

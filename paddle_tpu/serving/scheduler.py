@@ -142,6 +142,18 @@ class SLOScheduler:
                 return queue.index(cand)
         return None
 
+    def explain(self, req, now: float) -> dict:
+        """Queue-delay attribution for the tracer (r16): WHY this
+        request waited — its class, any promotion it earned, and how
+        often it was bypassed. Duck-typed: the engine attaches this to
+        the queue span's close when the scheduler provides it."""
+        eff = self.effective_priority(req, now)
+        return {"priority": int(req.priority),
+                "effective_priority": int(eff),
+                "promoted": bool(eff > req.priority),
+                "waited_ms": round(
+                    max(0.0, now - req.stats.submit_t) * 1e3, 3)}
+
     def note_admitted(self, req, queue: List, now: float) -> None:
         """Called by the engine AFTER an admission COMMITS: charge one
         bypass to every earlier-arrived request still queued. Charging

@@ -11,8 +11,20 @@ Newline-JSON protocol (one JSON object per line, both directions):
     <- {"status": "ok", "active": 1, "queued": 0, "free_pages": 9, ...}
     -> {"op": "stats"}     # metrics snapshot (JSON)
     -> {"op": "metrics"}   # Prometheus text page (in "text")
+    -> {"op": "trace"}     # finished span trees + engine step
+                           # timeline (r16); {"format": "chrome"}
+                           # returns chrome://tracing JSON mergeable
+                           # with jax.profiler via tools/merge_traces
     -> {"op": "drain"}     # stop admitting, finish in-flight, close
     -> {"op": "leak_check"}  # engine-thread page-accounting audit
+
+End-to-end tracing (r16): ``--trace-sample R`` samples a fraction R of
+requests into per-request span trees (serving/tracing.py) covering
+queue → admit → prefill chunks → decode/verify steps → complete,
+stitched across engine resurrection and router failover; an incoming
+``"trace": {"id": ..., "parent": ...}`` context (set by the failover
+router) forces sampling so one trace id spans router and replica.
+Dump via the ``trace`` op; validate with tools/trace_lint.py.
 
 ``deadline_ms`` is a completion budget measured from arrival: a
 request that cannot finish in time is never admitted (shed from the
@@ -91,23 +103,18 @@ import numpy as np
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .scheduler import Priority, ServerOverloaded, SLOScheduler
+from .tracing import SpanTracer, stderr_span_sink
 
 __all__ = ["ServingServer", "client_request"]
 
 import os as _os
-import sys as _sys
 
-# PT_SERVING_DEBUG=1: engine-thread request-lifecycle tracing on
-# stderr (submits, completions, resurrection snapshots/replays). The
-# chaos harness's postmortems lean on this — it is how a request that
-# vanishes between layers is localized.
-_DEBUG = bool(_os.environ.get("PT_SERVING_DEBUG"))
-
-
-def _dbg(msg: str) -> None:
-    if _DEBUG:
-        print(f"[pt-serving-dbg {time.monotonic():.3f}] {msg}",
-              file=_sys.stderr, flush=True)
+# PT_SERVING_DEBUG=1: request-lifecycle tracing on stderr. Since r16
+# this IS the span tracer at sample_rate=1.0 with the stderr span sink
+# (serving/tracing.py) — one event vocabulary for live debugging, the
+# ``trace`` op, and chrome-trace export, replacing the old ad-hoc
+# print sites. The chaos harness's postmortems lean on it: it is how
+# a request that vanishes between layers is localized.
 
 _PRIORITIES = {"batch": Priority.BATCH, "normal": Priority.NORMAL,
                "interactive": Priority.INTERACTIVE}
@@ -144,9 +151,25 @@ class ServingServer:
                  spill_bytes: Optional[int] = None,
                  spill_dir: Optional[str] = None,
                  spill_disk_bytes: Optional[int] = None,
+                 trace_sample: float = 0.0,
+                 trace_max: int = 64,
+                 tracer: Optional[SpanTracer] = None,
                  **engine_kwargs):
         from ..distributed.resilience import get_retry_policy
 
+        # end-to-end tracing (r16): one tracer shared by the server
+        # and its (resurrected) engines so a request's span tree spans
+        # the whole stack. PT_SERVING_DEBUG=1 forces sample_rate=1.0
+        # with the stderr span sink — the unified debug mode.
+        if tracer is not None:
+            self.tracer = tracer
+        else:
+            rate, sink = float(trace_sample), None
+            if _os.environ.get("PT_SERVING_DEBUG"):
+                rate, sink = 1.0, stderr_span_sink
+            self.tracer = SpanTracer(sample_rate=rate,
+                                     max_traces=int(trace_max),
+                                     on_span=sink)
         self.host = host
         self._requested_port = port
         self.scheduler = scheduler if scheduler is not None \
@@ -213,6 +236,9 @@ class ServingServer:
         self._conns = []
         self._conns_lock = threading.Lock()
         self._t0 = time.monotonic()
+        # step-histogram scrape marker: (engine identity, last step
+        # observed) — resurrection swaps the engine and resets it
+        self._tl_seen: tuple = (None, -1)
         self.port: Optional[int] = None
 
     def _build_engine(self):
@@ -234,7 +260,13 @@ class ServingServer:
             self._model, scheduler=self.scheduler,
             prefix_cache=self.prefix_cache,
             prefill_retry=self._prefill_retry,
-            on_complete=self._on_complete, **self._engine_kwargs)
+            on_complete=self._on_complete,
+            # the SAME tracer across resurrections: a replayed
+            # request's spans land on its original tree. Program-cost
+            # capture is on for served engines — the scrape gauges
+            # (serving_program_*) are this server's to export.
+            tracer=self.tracer, capture_costs=True,
+            **self._engine_kwargs)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -423,10 +455,30 @@ class ServingServer:
         self.metrics.counter("engine_restarts_total").add()
         old = self.engine
         snapshot = old.dump_inflight()
-        _dbg(f"resurrect: snapshot rids="
-             f"{[(r.req_id, len(r.prompt), len(r.generated), r.state) for r in snapshot]} "
-             f"pending={sorted(self._pending)} "
-             f"inbox={self._inbox.qsize()}")
+        self.tracer.annotate(
+            "resurrect",
+            rids=[(r.req_id, len(r.prompt), len(r.generated), r.state)
+                  for r in snapshot],
+            pending=sorted(self._pending), inbox=self._inbox.qsize(),
+            restarts=self._restarts)
+        # detach each in-flight request's TRACE before teardown: the
+        # close() below evicts every slot, and the engine's terminal
+        # path would otherwise FINISH the tree — the replayed request
+        # must keep appending to it (one tree across the stitch, the
+        # r16 contract). The open stage span is closed typed here.
+        saved_traces: Dict[int, Any] = {}
+        for req in snapshot:
+            tr = req.trace
+            if tr is None:
+                continue
+            if req.span is not None:
+                tr.end(req.span, state="resurrect")
+                req.span = None
+            tr.event("resurrect_replay", parent=tr.anchor,
+                     restarts=self._restarts,
+                     pre_tokens=len(req.generated))
+            saved_traces[req.req_id] = tr
+            req.trace = None
         # detach the completion hook BEFORE close(): teardown evictions
         # are an implementation detail of the restart, not terminal
         # replies the clients should see
@@ -463,10 +515,20 @@ class ServingServer:
                 new_rid = self.engine.submit(
                     replay_prompt, max_new_tokens=remaining,
                     eos_token=req.eos_token, priority=req.priority,
-                    deadline_t=req.deadline_t, on_token=on_token)
+                    deadline_t=req.deadline_t, on_token=on_token,
+                    # continue the original span tree on the rebuilt
+                    # engine — queue/admit/prefill/decode spans of the
+                    # replay append after the resurrect_replay marker
+                    trace=saved_traces.get(req.req_id))
             except Exception as e:
-                _dbg(f"replay FAILED old_rid={req.req_id}: "
-                     f"{type(e).__name__}: {e}")
+                self.tracer.annotate(
+                    "replay_failed", old_rid=req.req_id,
+                    error=f"{type(e).__name__}: {e}")
+                tr = saved_traces.get(req.req_id)
+                if tr is not None:
+                    tr.event("complete", parent=tr.anchor,
+                             state="replay_failed")
+                    self.tracer.finish(tr, state="replay_failed")
                 if pending is not None:
                     pending.outbox.put(
                         {"error": "ReplayFailed",
@@ -474,8 +536,9 @@ class ServingServer:
                     pending.outbox.put(None)
                 continue
             self.metrics.counter("replayed_requests_total").add()
-            _dbg(f"replay old_rid={req.req_id} -> new_rid={new_rid} "
-                 f"pending={'yes' if pending is not None else 'NO'}")
+            self.tracer.annotate(
+                "replay", old_rid=req.req_id, new_rid=new_rid,
+                pending=pending is not None)
             self._replay[new_rid] = (orig_prompt, pre, orig_stats)
             if pending is not None:
                 self._pending[new_rid] = pending
@@ -534,7 +597,12 @@ class ServingServer:
                     eos_token=payload.get("eos"),
                     priority=payload.get("priority", Priority.NORMAL),
                     deadline_t=payload.get("deadline_t"),
-                    on_token=on_token)
+                    on_token=on_token,
+                    # upstream trace context (the failover router's
+                    # forward span) forces sampling and links this
+                    # replica's tree under the router's; without it
+                    # the engine's own sampler decides
+                    trace_ctx=payload.get("trace_ctx"))
             except Exception as e:
                 # broad on purpose: this runs on the ENGINE thread, and
                 # one malformed payload (e.g. prompt [null] -> numpy
@@ -544,7 +612,6 @@ class ServingServer:
                                     "reason": f"{type(e).__name__}: {e}"})
                 pending.outbox.put(None)
                 continue
-            _dbg(f"inbox submit rid={rid} plen={len(payload['prompt'])}")
             self._pending[rid] = pending
 
     def _on_complete(self, req) -> None:
@@ -574,9 +641,6 @@ class ServingServer:
         # every DecodeRequest (and its outbox closure) ever finished
         self.engine.result(req.req_id, pop=True)
         pending = self._pending.pop(req.req_id, None)
-        _dbg(f"on_complete rid={req.req_id} state={req.state} "
-             f"plen={len(req.prompt)} gen={len(req.generated)} "
-             f"pending={'yes' if pending is not None else 'LOST'}")
         if pending is None:
             return  # engine used without the server front-end
         if req.state == "done":
@@ -713,11 +777,38 @@ class ServingServer:
             send(self._health())
             return
         if op == "stats":
+            eng = self.engine
             send({"stats": self.metrics.snapshot(),
-                  "prefix_cache": self._cache_stats()})
+                  "prefix_cache": self._cache_stats(),
+                  # step timeline tail (r16) — the full ring rides the
+                  # "trace" op; program launch totals by kind
+                  "step_timeline": getattr(
+                      eng, "step_timeline", lambda: [])()[-16:],
+                  "programs_launched": dict(
+                      getattr(eng, "programs_launched", {}) or {})})
             return
         if op == "metrics":
             send({"text": self.metrics.prometheus_text()})
+            return
+        if op == "trace":
+            # r16: finished span trees + tracer annotations + the
+            # engine step-timeline ring. format=chrome returns a
+            # chrome://tracing JSON mergeable with jax.profiler output
+            # via tools/merge_traces.py.
+            eng = self.engine
+            if msg.get("format") == "chrome":
+                send({"chrome": self.tracer.to_chrome()})
+                return
+            n = msg.get("n")
+            send({"traces": self.tracer.finished(
+                      n if isinstance(n, int) and not isinstance(
+                          n, bool) else None),
+                  "events": self.tracer.events(),
+                  "step_timeline": getattr(
+                      eng, "step_timeline", lambda: [])(),
+                  "program_costs": getattr(
+                      eng, "program_costs", lambda: {})(),
+                  "sample_rate": self.tracer.sample_rate})
             return
         if op == "drain":
             self.drain()
@@ -785,10 +876,15 @@ class ServingServer:
             check = getattr(self.scheduler, "check_admission", None)
             if check is not None:
                 check(self.engine.num_queued + self._inbox.qsize())
+            tctx = msg.get("trace")
+            if not (isinstance(tctx, dict) and
+                    isinstance(tctx.get("id"), str)):
+                tctx = None  # malformed/absent: engine sampler decides
             self._inbox.put(({"prompt": prompt, "max_new_tokens": mnt,
                               "eos": msg.get("eos"),
                               "priority": int(_PRIORITIES[prio]),
-                              "deadline_t": deadline_t},
+                              "deadline_t": deadline_t,
+                              "trace_ctx": tctx},
                              pending))
         self._wake.set()
         self._await_outbox(pending, send)
@@ -876,6 +972,10 @@ class ServingServer:
                 "fused_step": getattr(eng, "fused_step", None),
                 "step_programs": dict(
                     getattr(eng, "step_programs", {}) or {}),
+                # end-to-end tracing (r16): the sampling rate and how
+                # many span trees the finished ring holds
+                "trace_sample": self.tracer.sample_rate,
+                "traces_finished": self.tracer.finished_total,
                 "uptime_s": round(time.monotonic() - self._t0, 3)}
 
     def _gauges(self) -> Dict[str, float]:
@@ -910,18 +1010,69 @@ class ServingServer:
         sp = getattr(eng, "step_programs", None)
         if sp is not None:
             g["step_programs"] = sp.get("decode", 0)
+        # step timeline (r16): per-kind program LAUNCH totals, the
+        # engine step count, and the latest step's decode wall ms;
+        # new entries since the last scrape feed the serving_step_ms
+        # histogram (ServingMetrics.step_ms)
+        for kind, n in dict(getattr(eng, "programs_launched", {})
+                            or {}).items():
+            g[f"programs_launched_{kind}"] = n
+        g["engine_steps"] = getattr(eng, "steps", 0)
+        tl = getattr(eng, "step_timeline", lambda: [])()
+        if tl:
+            g["step_last_ms"] = tl[-1].get("ms", 0.0)
+            g["step_last_decode_ms"] = tl[-1].get("decode_ms", 0.0)
+            self._feed_step_histogram(eng, tl)
+        # program-cost gauges (r16 satellite): flops / bytes-accessed
+        # per program kind from jit cost_analysis at build time
+        for kind, cost in getattr(eng, "program_costs",
+                                  lambda: {})().items():
+            if "flops" in cost:
+                g[f"program_{kind}_flops"] = cost["flops"]
+                g[f"program_{kind}_bytes_accessed"] = \
+                    cost["bytes_accessed"]
+        # tracing counters (r16): tracer lifetime totals synced into
+        # the registry at scrape (monotonic, so the counter contract
+        # holds)
+        for cname, val in (
+                ("traces_sampled_total", self.tracer.sampled_total),
+                ("traces_finished_total", self.tracer.finished_total),
+                ("trace_spans_dropped_total",
+                 self.tracer.spans_dropped_total)):
+            self.metrics.counter(cname).set(val)
         mi = getattr(eng, "mesh_info", lambda: None)()
         if mi is not None:
-            # tensor-parallel serving (r10): mesh layout on the scrape
-            # page. mesh_collective_bytes is a STUB pinned at 0 —
-            # per-step collective traffic needs the on-chip profiler
-            # (xprof collective stats); CPU host-platform meshes have
-            # no transport counters. Chip-pending, same convention as
-            # the BENCH_STAGED cpu_smoke markers.
+            # tensor-parallel serving (r10/r16): mesh layout on the
+            # scrape page. mesh_collective_bytes was a STUB pinned 0
+            # through r15; it now carries the engine's per-decode-step
+            # ESTIMATE (ring-allreduce traffic of the row-parallel
+            # reductions — see mesh_collective_bytes_estimate, with
+            # the per-program flops/bytes from cost_analysis exported
+            # above). The chip-MEASURED value still needs an on-chip
+            # profiler session (xprof collective stats) — chip-pending,
+            # same convention as the BENCH_STAGED cpu_smoke markers.
             g["mesh_model_parallel"] = mi["model_parallel"]
             g["mesh_devices"] = mi["devices"]
-            g["mesh_collective_bytes"] = 0.0
+            est = getattr(eng, "mesh_collective_bytes_estimate",
+                          lambda: None)()
+            g["mesh_collective_bytes"] = est if est is not None else 0.0
         return g
+
+    def _feed_step_histogram(self, eng, tl) -> None:
+        """Observe ring entries newer than the last scrape into the
+        serving_step_ms histogram. The marker keys on the RESTART
+        COUNT (monotonic) — id(eng) could be reused by a later engine
+        allocated at a freed one's address, silently inheriting a
+        stale high-water step."""
+        key, seen = self._tl_seen
+        if key != self._restarts:
+            key, seen = self._restarts, -1
+        for entry in tl:
+            s = entry.get("step", 0)
+            if s > seen:
+                self.metrics.step_ms.observe(entry.get("ms", 0.0))
+                seen = s
+        self._tl_seen = (key, seen)
 
     def _leak_check(self) -> Dict:
         """Engine-thread page audit: with no in-flight work, the
@@ -1082,6 +1233,15 @@ def main(argv=None) -> None:
              "(distributed/topology.py make_serving_mesh). Greedy "
              "outputs stay bit-identical to the single-device engine; "
              "omit for the single-device default")
+    parser.add_argument(
+        "--trace-sample", type=float, default=0.0, metavar="R",
+        help="end-to-end request tracing (r16): sample this fraction "
+             "of requests into span trees (queue -> admit -> prefill "
+             "chunks -> decode steps -> complete, stitched across "
+             "resurrection/failover). 0 = off (the default; tracing "
+             "off costs ~zero on the hot path), 1.0 = every request. "
+             "Dump via the 'trace' op; greedy outputs are "
+             "bit-identical tracing on/off")
     args = parser.parse_args(argv)
 
     model = _build_model(args.model)
@@ -1131,6 +1291,7 @@ def main(argv=None) -> None:
                            spill_disk_bytes=(
                                None if args.spill_dir is None
                                else args.spill_disk_mb << 20),
+                           trace_sample=args.trace_sample,
                            speculative=speculative, **engine_kwargs)
     port = server.start()
     print(f"[paddle_tpu.serving] listening on {args.host}:{port} "

@@ -373,7 +373,8 @@ class FailoverRouter:
                  port: int = 0, max_failover: int = 3,
                  backend_timeout_s: float = 300.0,
                  no_replica_wait_s: float = 60.0,
-                 affinity: bool = True):
+                 affinity: bool = True,
+                 trace_sample: float = 0.0, tracer=None):
         self.sup = supervisor
         self.host = host
         self._requested_port = port
@@ -381,6 +382,20 @@ class FailoverRouter:
         self.backend_timeout_s = float(backend_timeout_s)
         self.no_replica_wait_s = float(no_replica_wait_s)
         self.affinity = bool(affinity)
+        # end-to-end tracing (r16): the router is the FIRST hop, so
+        # its sampler decides for the whole request — a sampled
+        # request's forward carries a trace context that forces the
+        # replica to trace under the router's forward span (one trace
+        # id, one merged tree; keyed failover appends failover spans
+        # to the same tree)
+        if tracer is not None:
+            self.tracer = tracer
+        else:
+            from .tracing import SpanTracer, stderr_span_sink
+            rate, sink = float(trace_sample), None
+            if os.environ.get("PT_SERVING_DEBUG"):
+                rate, sink = 1.0, stderr_span_sink
+            self.tracer = SpanTracer(sample_rate=rate, on_span=sink)
         self.port: Optional[int] = None
         self.failovers_total = 0
         self.replica_failures_total = 0
@@ -496,6 +511,16 @@ class FailoverRouter:
                                     len(getattr(r, "prefix_keys", ()))}
                                for r in self.sup.replicas]})
             return
+        if op == "trace":
+            # the ROUTER's share of the span trees (pick/forward/
+            # failover spans); replica shares come from each replica's
+            # own trace op and merge by trace id — router spans carry
+            # the forward span ids the replica roots reference as
+            # remote_parent
+            send({"traces": self.tracer.finished(),
+                  "events": self.tracer.events(),
+                  "sample_rate": self.tracer.sample_rate})
+            return
         if op != "generate":
             # admin op: first live replica answers (replica-targeted
             # audits talk to replica ports directly)
@@ -601,6 +626,16 @@ class FailoverRouter:
         if isinstance(budget_ms, bool) or \
                 not isinstance(budget_ms, (int, float)):
             budget_ms = None  # malformed: backend answers BadRequest
+        # end-to-end tracing (r16): the router's span tree for this
+        # request — pick/forward/failover. A client-supplied trace
+        # context is adopted; otherwise the router's sampler decides.
+        prompt = msg.get("prompt")
+        rtr = self.tracer.start(
+            "route", ctx=msg.get("trace") if isinstance(
+                msg.get("trace"), dict) else None,
+            key=msg.get("key"),
+            prompt_len=len(prompt) if isinstance(prompt, list) else 0)
+
         def trace(ev: str, **kw) -> None:
             if self.trace is not None:
                 kw.update(ev=ev, key=msg.get("key"),
@@ -623,6 +658,8 @@ class FailoverRouter:
                 # resurrect one (fresh respawns are fair game again)
                 if time.monotonic() >= wait_deadline:
                     self.replica_failures_total += 1
+                    if rtr is not None:
+                        self.tracer.finish(rtr, state="no_replica")
                     send({"error": "NoReplicaAvailable",
                           "retryable": True,
                           "reason": "no live replica within "
@@ -636,6 +673,8 @@ class FailoverRouter:
                 remaining = budget_ms \
                     - (time.monotonic() - arrival) * 1e3
                 if remaining <= 0:
+                    if rtr is not None:
+                        self.tracer.finish(rtr, state="deadline")
                     send({"error": "DeadlineExceeded",
                           "reason": "deadline_ms elapsed before "
                                     "completion",
@@ -643,23 +682,44 @@ class FailoverRouter:
                     return
                 fwd = dict(msg)
                 fwd["deadline_ms"] = remaining
+            fs = None
+            if rtr is not None:
+                # each forward attempt is one span; the replica roots
+                # its share of the tree under this span via the wire
+                # context (engine submit trace_ctx -> remote_parent)
+                fs = rtr.begin("forward", parent=rtr.anchor,
+                               replica=rep.idx, attempt=attempts)
+                if fwd is msg:
+                    fwd = dict(msg)
+                fwd["trace"] = rtr.ctx(parent=fs)
             try:
                 self._forward(rep, fwd, send, progress)
                 trace("done", rep=rep.idx,
                       relayed=progress["relayed"])
+                if rtr is not None:
+                    rtr.end(fs, relayed=progress["relayed"])
+                    self.tracer.finish(rtr, state="done")
                 return
             except _ClientLost as e:
                 # OUR client hung up mid-relay; the replica is fine.
                 # Abort quietly — no failover, no replica-failure
                 # metrics, nothing left to deliver the reply to.
                 trace("client_lost", rep=rep.idx, err=str(e))
+                if rtr is not None:
+                    rtr.end(fs, error="client_lost")
+                    self.tracer.finish(rtr, state="client_lost")
                 return
             except _BackendLost as e:
                 trace("backend_lost", rep=rep.idx, err=str(e))
+                if rtr is not None:
+                    rtr.end(fs, error=str(e),
+                            relayed=progress["relayed"])
                 attempts += 1
                 tried.add(rep.idx)
                 if not keyed:
                     self.replica_failures_total += 1
+                    if rtr is not None:
+                        self.tracer.finish(rtr, state="replica_failed")
                     send({"error": "ReplicaFailed", "retryable": True,
                           "reason": f"replica {rep.idx} lost "
                                     f"mid-request ({e}); resubmit "
@@ -668,11 +728,18 @@ class FailoverRouter:
                     return
                 if attempts > self.max_failover:
                     self.replica_failures_total += 1
+                    if rtr is not None:
+                        self.tracer.finish(rtr, state="replica_failed")
                     send({"error": "ReplicaFailed", "retryable": True,
                           "reason": f"{attempts} replicas lost "
                                     f"mid-request"})
                     return
                 self.failovers_total += 1
+                if rtr is not None:
+                    # the stitch marker: the same tree continues on
+                    # the next replica
+                    rtr.event("failover", parent=rtr.anchor,
+                              from_replica=rep.idx, attempt=attempts)
 
     def _forward(self, rep: Replica, msg: Dict, send,
                  progress: Dict[str, int]) -> None:
@@ -780,6 +847,16 @@ def main(argv=None) -> None:
         help="byte budget of each replica's disk tier (with "
              "--spill-dir; default 1024)")
     parser.add_argument(
+        "--trace-sample", type=float, default=0.0, metavar="R",
+        help="end-to-end request tracing (r16): the ROUTER samples "
+             "this fraction of requests; a sampled request's forward "
+             "carries a trace context so the replica traces it too — "
+             "one trace id from router pick/forward/failover spans "
+             "down to the engine's decode steps. Also threaded to "
+             "every replica's server as its --trace-sample so "
+             "replica-local sampling works when the router doesn't "
+             "sample")
+    parser.add_argument(
         "server_args", nargs="*",
         help="extra args passed to every replica's "
              "`python -m paddle_tpu.serving.server` (e.g. "
@@ -837,6 +914,8 @@ def main(argv=None) -> None:
         server_args += ["--spill-dir",
                         os.path.join(args.spill_dir, "replica{replica}"),
                         "--spill-disk-mb", str(args.spill_disk_mb)]
+    if args.trace_sample:
+        server_args += ["--trace-sample", str(args.trace_sample)]
     sup = Supervisor(model=args.model, replicas=args.replicas,
                      host=args.host, server_args=server_args,
                      probe_interval_s=args.probe_interval_s,
@@ -847,7 +926,8 @@ def main(argv=None) -> None:
     router = None
     try:
         sup.start(wait_ready=True)
-        router = FailoverRouter(sup, host=args.host, port=args.port)
+        router = FailoverRouter(sup, host=args.host, port=args.port,
+                                trace_sample=args.trace_sample)
         port = router.start()
         print(f"[paddle_tpu.supervisor] router on {args.host}:{port}; "
               f"replicas "

@@ -306,8 +306,14 @@ class TestMeshServer:
             m = client_request("127.0.0.1", port, {"op": "metrics"})
             assert "serving_mesh_model_parallel 2" in m["text"]
             assert "serving_mesh_devices 2" in m["text"]
-            # chip-pending stub: present and pinned at 0 on CPU meshes
-            assert "serving_mesh_collective_bytes 0" in m["text"]
+            # r16: the r10 0-stub is replaced by a per-step estimate
+            # (ring-allreduce traffic of the row-parallel reductions);
+            # chip-MEASURED collective bytes remain chip-pending
+            line = next(l for l in m["text"].splitlines()
+                        if l.startswith("serving_mesh_collective_bytes "))
+            assert float(line.split()[-1]) > 0
+            # per-program cost gauges from jit cost_analysis ride too
+            assert "serving_program_decode_flops" in m["text"]
             chk = client_request("127.0.0.1", port, {"op": "leak_check"})
             assert chk["ok"], chk
         finally:

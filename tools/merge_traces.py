@@ -18,10 +18,37 @@ import os
 import sys
 
 
+def _spans_to_events(trace):
+    """Serving span-tree trace (paddle_tpu/serving/tracing.py) ->
+    chrome 'X' events. Local duplicate of tracing.chrome_events so
+    this tool keeps working without importing the framework (and its
+    jax dependency)."""
+    tid = abs(hash(trace.get("trace_id", ""))) % 1_000_000
+    out = []
+    for s in trace.get("spans", ()):
+        t0 = s.get("t0_us", 0.0)
+        t1 = s.get("t1_us")
+        args = dict(s.get("args") or {})
+        args["trace_id"] = trace.get("trace_id")
+        out.append({"name": s.get("name", "?"), "ph": "X", "ts": t0,
+                    "dur": max((t1 if t1 is not None else t0) - t0,
+                               0.01),
+                    "pid": trace.get("pid", 0), "tid": tid,
+                    "args": args})
+    return out
+
+
 def load_trace(path: str):
     with open(path) as f:
         data = json.load(f)
     if isinstance(data, dict):
+        if "traces" in data:  # serving span-tree dump (r16 trace op)
+            events = []
+            for t in data["traces"]:
+                events.extend(_spans_to_events(t))
+            return events
+        if "spans" in data:  # a single span-tree trace
+            return _spans_to_events(data)
         return data.get("traceEvents", [])
     return data
 

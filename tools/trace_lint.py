@@ -1,0 +1,174 @@
+"""Validate a dumped serving trace file (r16 tracing tentpole).
+
+Accepted inputs (auto-detected):
+
+- a span-tree dump: ``{"traces": [...]}``, a single trace dict
+  (``{"trace_id": ..., "spans": [...]}``), or a bare list of trace
+  dicts — the ``trace`` server op / ``SpanTracer.finished()`` format;
+- a Chrome trace-event file (``{"traceEvents": [...]}``) — e.g. the
+  output of ``SpanTracer.to_chrome`` or tools/merge_traces.py.
+
+Checks (per trace):
+
+- every span is CLOSED (``t1_us`` set) and ``t1_us >= t0_us >= 0``
+  (monotonic timestamps);
+- span ids unique; every non-null ``parent`` refers to a span in the
+  same trace (no orphan parents) and is acyclic;
+- SAME-PROCESS children nest inside their parent's interval (small
+  epsilon for clock granularity). Spans from different participants
+  (router vs replica — distinguished by per-span/trace ``pid``) share
+  no clock; a ctx-adopted root carries its upstream span id as a
+  ``remote_parent`` ARG (not a parent link), so each participant's
+  dump stays orphan-free on its own — a merger that rewires
+  ``remote_parent`` into real parent links gets the full checks;
+- ``leaked_open == 0``: no terminal path left a span open.
+
+Importable (``lint_trace_obj`` — the tracing tests call it directly)
+and a CLI::
+
+    python tools/trace_lint.py dump.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+# clock-granularity slack for containment checks, in microseconds
+EPS_US = 2.0
+
+
+def _lint_chrome(events: List[Dict]) -> List[str]:
+    errors = []
+    for i, e in enumerate(events):
+        if e.get("ph") == "M":
+            continue  # metadata record
+        if "name" not in e:
+            errors.append(f"event {i}: missing name")
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f"event {i} ({e.get('name')}): bad ts {ts!r}")
+        if e.get("ph") == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(
+                    f"event {i} ({e.get('name')}): bad dur {dur!r}")
+    return errors
+
+
+def _lint_spans(trace: Dict) -> List[str]:
+    tid = trace.get("trace_id", "?")
+    errors: List[str] = []
+    spans = trace.get("spans")
+    if not isinstance(spans, list):
+        return [f"trace {tid}: no spans list"]
+    if trace.get("leaked_open"):
+        errors.append(f"trace {tid}: {trace['leaked_open']} span(s) "
+                      f"were force-closed at finish (leaked open)")
+    by_id: Dict[str, Dict] = {}
+    trace_pid = trace.get("pid")
+    for s in spans:
+        sid = s.get("sid")
+        if not sid:
+            errors.append(f"trace {tid}: span without sid "
+                          f"({s.get('name')})")
+            continue
+        if sid in by_id:
+            errors.append(f"trace {tid}: duplicate span id {sid}")
+        by_id[sid] = s
+    for s in spans:
+        name, sid = s.get("name", "?"), s.get("sid")
+        t0, t1 = s.get("t0_us"), s.get("t1_us")
+        if t1 is None:
+            errors.append(f"trace {tid}: span {name} ({sid}) is OPEN")
+            continue
+        if not isinstance(t0, (int, float)) or t0 < 0:
+            errors.append(f"trace {tid}: span {name} bad t0 {t0!r}")
+            continue
+        if t1 + EPS_US < t0:
+            errors.append(f"trace {tid}: span {name} ends before it "
+                          f"starts ({t0} -> {t1})")
+        parent = s.get("parent")
+        if parent is not None:
+            p = by_id.get(parent)
+            if p is None:
+                errors.append(f"trace {tid}: span {name} ({sid}) has "
+                              f"ORPHAN parent {parent}")
+            else:
+                # same-participant containment (shared clock only)
+                s_pid = (s.get("args") or {}).get("pid", trace_pid)
+                p_pid = (p.get("args") or {}).get("pid", trace_pid)
+                if s_pid == p_pid and p.get("t1_us") is not None:
+                    if t0 + EPS_US < p["t0_us"] or \
+                            t1 - EPS_US > p["t1_us"]:
+                        errors.append(
+                            f"trace {tid}: span {name} ({sid}) "
+                            f"[{t0}, {t1}] escapes parent "
+                            f"{p.get('name')} [{p['t0_us']}, "
+                            f"{p['t1_us']}]")
+    # cycle check (parent chains must terminate)
+    for s in spans:
+        seen, cur = set(), s.get("sid")
+        while cur is not None:
+            if cur in seen:
+                errors.append(f"trace {tid}: parent cycle at {cur}")
+                break
+            seen.add(cur)
+            nxt = by_id.get(cur)
+            cur = nxt.get("parent") if nxt else None
+    return errors
+
+
+def lint_trace_obj(obj: Any) -> List[str]:
+    """Lint a parsed trace object; returns a list of error strings
+    (empty = valid)."""
+    if isinstance(obj, dict) and "traceEvents" in obj:
+        return _lint_chrome(obj["traceEvents"])
+    if isinstance(obj, dict) and "traces" in obj:
+        traces = obj["traces"]
+    elif isinstance(obj, dict) and "spans" in obj:
+        traces = [obj]
+    elif isinstance(obj, list):
+        traces = obj
+    else:
+        return ["unrecognized trace format (expected {'traces': [...]},"
+                " a trace dict with 'spans', or {'traceEvents': [...]})"]
+    errors: List[str] = []
+    if not traces:
+        errors.append("no traces in dump")
+    for t in traces:
+        if not isinstance(t, dict):
+            errors.append(f"non-dict trace entry: {type(t).__name__}")
+            continue
+        errors.extend(_lint_spans(t))
+    return errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate a dumped serving trace (span nesting, "
+                    "monotonic timestamps, no orphan parents, no "
+                    "leaked open spans)")
+    ap.add_argument("path", help="trace dump (span-tree or chrome JSON)")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    with open(args.path) as f:
+        obj = json.load(f)
+    errors = lint_trace_obj(obj)
+    if errors:
+        for e in errors:
+            print(f"trace_lint: {e}", file=sys.stderr)
+        print(f"trace_lint: FAIL ({len(errors)} error(s)) {args.path}",
+              file=sys.stderr)
+        return 1
+    if not args.quiet:
+        n = (len(obj.get("traces", obj.get("traceEvents", [])))
+             if isinstance(obj, dict) else len(obj))
+        print(f"trace_lint: OK ({n} trace(s)/event(s)) {args.path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
