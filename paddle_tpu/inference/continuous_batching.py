@@ -903,6 +903,36 @@ class ContinuousBatchingEngine:
         ``trace``/``stats`` ops and the goodput bench read this."""
         return list(self.timeline)
 
+    def flight_summary(self) -> Dict[str, Any]:
+        """JSON-safe engine state card for the crash flight recorder
+        (r17): the numbers a postmortem wants next to the timeline
+        ring — occupancy, page pressure, EMAs, launch totals, and the
+        feature flags that shaped the traced programs. Host-side ints
+        and floats only; safe to call from a dying engine."""
+        return {
+            "steps": int(self.steps),
+            "num_slots": int(self.num_slots),
+            "num_active": int(self.num_active),
+            "num_queued": int(self.num_queued),
+            "num_pages": int(self.num_pages),
+            "free_pages": int(self.free_pages),
+            "reserved_pages": int(self.allocator.reserved_total),
+            "page_size": int(self.page_size),
+            "max_seq_len": int(self.max_seq_len),
+            "decode_ema_ms": (None if self.decode_ema_s is None
+                              else round(self.decode_ema_s * 1e3, 3)),
+            "prefill_chunk_ema_ms": (
+                None if self.prefill_chunk_ema_s is None
+                else round(self.prefill_chunk_ema_s * 1e3, 3)),
+            "prefill_debt_tokens": int(self.prefill_debt_tokens),
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
+            "fused_step": bool(self.fused_step),
+            "speculative": self._spec_cfg is not None,
+            "mesh": self.mesh_info(),
+            "programs_launched": dict(self.programs_launched),
+            "step_programs": dict(self.step_programs),
+        }
+
     def _tl_add_ms(self, key: str, seconds: float) -> None:
         self._tl_ms[key] = self._tl_ms.get(key, 0.0) + seconds * 1e3
 

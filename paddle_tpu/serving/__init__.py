@@ -38,6 +38,20 @@ continuous batching, PR r6) into a servable system:
   ``jax.profiler`` device traces (tools/merge_traces.py). Off by
   default at ~zero hot-path cost; PT_SERVING_DEBUG=1 is this tracer
   at sample 1.0 with a stderr sink.
+- ``fleet_metrics``: the fleet telemetry plane (r17) — the
+  supervisor's probe cycle scrapes each replica's STRUCTURED metrics
+  export (``ServingMetrics.export()``: exact counters, bucket-exact
+  histogram counts, SLO window counts) and merges them bucket-exactly
+  into fleet rollups with interpolated fleet quantiles; a live
+  per-class SLO-attainment monitor (``--slo-ttft-ms``/
+  ``--slo-tpot-ms``) with queue/debt pressure signals and a
+  hysteretic ``scale_up``/``steady``/``scale_down`` verdict (the
+  ROADMAP 3(a) autoscaler input, telemetry-only); MAD-based
+  per-replica outlier detection; and a crash flight recorder
+  (``--flight-dir``) writing atomic, byte-budget-ringed black-box
+  bundles on resurrection/EngineFailed/stall
+  (tools/flight_inspect.py lints them). Router ops ``fleet_stats`` /
+  ``fleet_metrics`` expose it all on one port.
 - ``supervisor``: crash-safe serving above the process boundary (r9)
   — N supervised replica processes with health-probed backoff
   restarts, fronted by a failover router that resubmits idempotent
@@ -62,7 +76,11 @@ Paper basis: *Ragged Paged Attention* (PAPERS.md) — page-granular KV
 management is what makes cross-request prefix sharing possible.
 """
 
-from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .fleet_metrics import (FleetMetrics, FlightRecorder,  # noqa: F401
+                            PressureMonitor)
+from .metrics import (Histogram, ServingMetrics,  # noqa: F401
+                      SLOAttainment, merge_exports,
+                      quantile_from_buckets)
 from .prefix_cache import (DiskSpillTier, HostSpillTier,  # noqa: F401
                            PrefixCache, SpillCorrupt)
 from .scheduler import (Priority, ServerOverloaded, SLOConfig,  # noqa: F401

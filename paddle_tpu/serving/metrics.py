@@ -18,12 +18,16 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from bisect import bisect_left
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 from ..core.monitor import GLOBAL_STATS, StatRegistry
 
-__all__ = ["Histogram", "ServingMetrics"]
+__all__ = ["Histogram", "ServingMetrics", "SLOAttainment",
+           "merge_exports", "quantile_from_buckets", "export_snapshot",
+           "attainment_from_export"]
 
 # log-ish spaced latency buckets (ms): sub-ms CPU-smoke prefills up to
 # multi-second chip TTFTs land in distinct buckets
@@ -110,6 +114,209 @@ class Histogram:
             out.append(f"{name}_count {self.total}")
         return out
 
+    # -- fleet telemetry (r17) ---------------------------------------------
+
+    def export(self) -> Dict:
+        """Wire-friendly exact state: per-bucket (NON-cumulative)
+        counts with the last slot the +Inf overflow, plus sum/total.
+        The fixed ladder makes replica exports MERGEABLE bucket-exactly
+        (``merge_exports``); the reservoir is deliberately excluded —
+        samples don't merge, fleet quantiles come from the buckets."""
+        with self._lock:
+            return {"name": self.name.replace(".", "_"),
+                    "buckets": list(self.buckets),
+                    "counts": list(self.counts),
+                    "sum": self.sum, "total": self.total}
+
+
+def merge_exports(exports: Sequence[Dict]) -> Dict:
+    """Fold N ``Histogram.export()`` dicts (same bucket ladder) into
+    one bucket-exact fleet export: counts sum element-wise, sum/total
+    add. The merged ``_count``/``_sum``/``_bucket`` therefore equal
+    the sums of the replica exports exactly — the fleet-rollup
+    invariant the tests pin. Raises ValueError on a ladder mismatch
+    (merging histograms measured in different buckets would silently
+    misattribute mass)."""
+    exports = [e for e in exports if e]
+    if not exports:
+        return {"name": "empty", "buckets": [], "counts": [0],
+                "sum": 0.0, "total": 0}
+    base = exports[0]
+    buckets = list(base["buckets"])
+    counts = [0] * (len(buckets) + 1)
+    total, total_sum = 0, 0.0
+    name = base.get("name", "merged")
+    for e in exports:
+        if list(e["buckets"]) != buckets:
+            raise ValueError(
+                f"bucket ladder mismatch merging {e.get('name')!r}: "
+                f"{e['buckets']} != {buckets}")
+        if len(e["counts"]) != len(counts):
+            raise ValueError(
+                f"count vector length {len(e['counts'])} != "
+                f"{len(counts)} for {e.get('name')!r}")
+        for i, c in enumerate(e["counts"]):
+            counts[i] += int(c)
+        total += int(e["total"])
+        total_sum += float(e["sum"])
+    return {"name": name, "buckets": buckets, "counts": counts,
+            "sum": total_sum, "total": total}
+
+
+def quantile_from_buckets(export: Dict, p: float) -> Optional[float]:
+    """Interpolated quantile from an export's bucket counts (the
+    prometheus ``histogram_quantile`` estimator): walk the cumulative
+    counts to the target rank and interpolate linearly inside the
+    containing bucket. The +Inf bucket clamps to the highest finite
+    edge (there is no upper bound to interpolate toward). This is the
+    FLEET quantile path — replica reservoirs don't merge, fixed
+    buckets do — so it trades exactness for mergeability; on one
+    replica it must land within a bucket width of the reservoir
+    quantile (pinned by tests)."""
+    total = int(export.get("total", 0))
+    if total <= 0:
+        return None
+    buckets = export["buckets"]
+    counts = export["counts"]
+    target = (p / 100.0) * total
+    acc = 0.0
+    for i, c in enumerate(counts[:-1]):
+        prev_acc = acc
+        acc += c
+        if acc >= target and c > 0:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i]
+            return lo + (hi - lo) * (target - prev_acc) / c
+    # rank lands in the +Inf overflow bucket: no finite upper bound
+    return float(buckets[-1]) if buckets else None
+
+
+def export_snapshot(export: Dict) -> Dict[str, Optional[float]]:
+    """Bucket-derived snapshot of an export (fleet rollups: same shape
+    as ``Histogram.snapshot`` but quantiles interpolated, not
+    reservoir-exact)."""
+    n = int(export.get("total", 0))
+    return {"count": n,
+            "mean": (export["sum"] / n) if n else None,
+            "p50": quantile_from_buckets(export, 50),
+            "p90": quantile_from_buckets(export, 90),
+            "p99": quantile_from_buckets(export, 99)}
+
+
+# priority-int -> class-name mapping (serving/scheduler.py Priority);
+# kept here as plain ints so metrics never imports the scheduler
+_CLASS_NAMES = {0: "batch", 1: "normal", 2: "interactive"}
+
+
+class SLOAttainment:
+    """Live SLO-attainment tracker (r17 fleet telemetry): the rolling-
+    window fraction of finished requests whose TTFT/TPOT met the
+    configured targets, per priority class — computed ONLINE from the
+    same lifecycle markers (submit/first-token/finish) the goodput
+    bench reads from traces, so the live gauge and the trace-computed
+    attainment must agree (the fleet_goodput bench pins ±0.05).
+
+    Targets are optional (``None`` = that dimension always counts as
+    met); ``window_s`` bounds memory AND recency — an autoscaler wants
+    the last minute, not the process lifetime. ``observe`` runs on the
+    engine thread inside ``observe_request``; export/attainment can run
+    on scrape threads, hence the lock. Window entries are per finished
+    request (one small tuple), pruned lazily at observe/read time.
+
+    Merging: ``export()`` carries per-class (total, ttft_met,
+    tpot_met, met) COUNTS over the window — counts sum across
+    replicas, so the fleet attainment is exact over the union window
+    (fleet_metrics.merge_slo_exports)."""
+
+    def __init__(self, ttft_ms: Optional[float] = None,
+                 tpot_ms: Optional[float] = None,
+                 window_s: float = 120.0,
+                 max_events: int = 65536):
+        self.ttft_ms = None if ttft_ms is None else float(ttft_ms)
+        self.tpot_ms = None if tpot_ms is None else float(tpot_ms)
+        self.window_s = float(window_s)
+        # (t, class_name, ttft_met, tpot_met) per finished request.
+        # maxlen caps memory AND the export()-walk cost at sustained
+        # high request rates (oldest events drop first — attainment
+        # then covers the most recent max_events inside the window,
+        # which is the recency an autoscaler wants anyway)
+        self._events: "deque" = deque(maxlen=max(1, int(max_events)))
+        self._lock = threading.Lock()
+
+    @property
+    def configured(self) -> bool:
+        return self.ttft_ms is not None or self.tpot_ms is not None
+
+    def set_targets(self, ttft_ms: Optional[float],
+                    tpot_ms: Optional[float]) -> None:
+        """Retarget at runtime (the server's ``slo`` op — calibration
+        without a replica restart). Resets the window: attainment
+        against old targets is not attainment against new ones."""
+        with self._lock:
+            self.ttft_ms = None if ttft_ms is None else float(ttft_ms)
+            self.tpot_ms = None if tpot_ms is None else float(tpot_ms)
+            self._events.clear()
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
+
+    def observe(self, priority: int, ttft_s: Optional[float],
+                tpot_s: Optional[float],
+                now: Optional[float] = None) -> None:
+        """One finished request's markers. A missing marker counts as
+        MET for its dimension (a 1-token request has no TPOT; a
+        request that produced no token never reaches here — terminal
+        non-done states are not attainment inputs, matching the trace
+        path which skips traces without lifecycle markers)."""
+        now = time.monotonic() if now is None else now
+        ttft_met = (self.ttft_ms is None or ttft_s is None
+                    or ttft_s * 1e3 <= self.ttft_ms)
+        tpot_met = (self.tpot_ms is None or tpot_s is None
+                    or tpot_s * 1e3 <= self.tpot_ms)
+        cls = _CLASS_NAMES.get(int(priority), "normal")
+        with self._lock:
+            self._events.append((now, cls, ttft_met, tpot_met))
+            self._prune(now)
+
+    def export(self, now: Optional[float] = None) -> Dict:
+        """Wire form: per-class met/total counts over the window plus
+        the targets (the fleet collector checks replicas agree)."""
+        now = time.monotonic() if now is None else now
+        classes: Dict[str, Dict[str, int]] = {}
+        with self._lock:
+            self._prune(now)
+            for _t, cls, ttft_met, tpot_met in self._events:
+                c = classes.setdefault(
+                    cls, {"total": 0, "ttft_met": 0, "tpot_met": 0,
+                          "met": 0})
+                c["total"] += 1
+                c["ttft_met"] += ttft_met
+                c["tpot_met"] += tpot_met
+                c["met"] += ttft_met and tpot_met
+        return {"ttft_ms": self.ttft_ms, "tpot_ms": self.tpot_ms,
+                "window_s": self.window_s, "classes": classes}
+
+    def attainment(self) -> Dict[str, Optional[float]]:
+        """Per-class attained fraction over the window (None = no
+        finished requests in the window), plus an "all" rollup."""
+        return attainment_from_export(self.export())
+
+
+def attainment_from_export(slo_export: Dict
+                           ) -> Dict[str, Optional[float]]:
+    """Per-class + "all" attainment fractions from an ``SLOAttainment``
+    export (replica-local or fleet-merged — counts are counts)."""
+    out: Dict[str, Optional[float]] = {}
+    tot = met = 0
+    for cls, c in (slo_export.get("classes") or {}).items():
+        out[cls] = (c["met"] / c["total"]) if c["total"] else None
+        tot += c["total"]
+        met += c["met"]
+    out["all"] = (met / tot) if tot else None
+    return out
+
 
 class ServingMetrics:
     """The serving layer's stat surface.
@@ -156,9 +363,14 @@ class ServingMetrics:
                 "trace_spans_dropped_total")
 
     def __init__(self, registry: Optional[StatRegistry] = None,
-                 prefix: str = "serving"):
+                 prefix: str = "serving",
+                 slo: Optional[SLOAttainment] = None):
         self.registry = registry if registry is not None else GLOBAL_STATS
         self.prefix = prefix
+        # live SLO monitor (r17): always present so export()/the slo
+        # op have a stable surface; without targets it tracks nothing
+        # binding (every request counts as met) and exports no gauges
+        self.slo = slo if slo is not None else SLOAttainment()
         # live gauge source (engine occupancy): a callable returning
         # {name: value}, sampled at scrape time — the server wires
         # in-flight slots / free vs reserved pages / prefix-cache
@@ -199,6 +411,7 @@ class ServingMetrics:
         """Zero the serving counters (tests); histograms are rebuilt."""
         for c in self.COUNTERS:
             self.counter(c).reset()
+        self.slo.set_targets(self.slo.ttft_ms, self.slo.tpot_ms)
         for h in ("ttft_ms", "tpot_ms", "queue_delay_ms", "prefill_ms",
                   "e2e_ms"):
             setattr(self, h, Histogram(f"{self.prefix}.{h}"))
@@ -293,6 +506,13 @@ class ServingMetrics:
         if st.prefill_attempts > 1:
             self.counter("prefill_retries_total").add(
                 st.prefill_attempts - 1)
+        if st.first_token_t:
+            # live SLO monitor (r17): a DONE request that produced a
+            # first token is an attainment input — the same lifecycle
+            # markers the goodput bench reads from traces, evaluated
+            # online against the configured targets
+            self.slo.observe(getattr(req, "priority", 1),
+                             st.ttft_s, st.tpot_s)
         if st.ttft_s is not None:
             self.ttft_ms.observe(st.ttft_s * 1e3)
         if st.tpot_s is not None:
@@ -335,7 +555,63 @@ class ServingMetrics:
             "prefill_chunk_ms": self.prefill_chunk_ms.snapshot(),
             "restore_ms": self.restore_ms.snapshot(),
             "step_ms": self.step_ms.snapshot(),
+            # live SLO monitor (r17): targets + rolling attainment
+            "slo": {"ttft_ms": self.slo.ttft_ms,
+                    "tpot_ms": self.slo.tpot_ms,
+                    "attainment": self.slo.attainment()},
         }
+
+    def _histograms(self) -> Dict[str, Histogram]:
+        """Every histogram this surface owns, by attribute name — the
+        one list export()/prometheus_text iterate so a histogram added
+        later can't silently miss either surface."""
+        return {"ttft_ms": self.ttft_ms, "tpot_ms": self.tpot_ms,
+                "queue_delay_ms": self.queue_delay_ms,
+                "prefill_ms": self.prefill_ms, "e2e_ms": self.e2e_ms,
+                "spec_accept_rate": self.spec_accept_rate,
+                "spec_tokens_per_step": self.spec_tokens_per_step,
+                "prefill_chunks": self.prefill_chunks,
+                "prefill_chunk_ms": self.prefill_chunk_ms,
+                "restore_ms": self.restore_ms,
+                "step_ms": self.step_ms}
+
+    def export(self) -> Dict:
+        """Fleet-telemetry wire form (r17): exact counters, sampled
+        gauges, every histogram's bucket-exact ``export()``, and the
+        SLO monitor's window counts — everything the supervisor-side
+        collector needs, structured, so the fleet plane never parses
+        exposition text. Deliberately excludes reservoirs (don't
+        merge) and traces (their own op)."""
+        return {"v": 1, "t": time.time(),
+                "prefix": self.prefix,
+                "counters": {c: self.counter(c).get()
+                             for c in self.COUNTERS},
+                "gauges": self.gauges(),
+                "histograms": {k: h.export()
+                               for k, h in self._histograms().items()},
+                "slo": self.slo.export()}
+
+    def _slo_lines(self) -> List[str]:
+        """``serving_slo_attainment{class=...}`` gauges (plus the
+        targets) — only once targets are configured, so a deployment
+        without SLOs doesn't export a meaningless 1.0."""
+        if not self.slo.configured:
+            return []
+        lines = [f"# TYPE {self.prefix}_slo_attainment gauge"]
+        att = self.slo.attainment()
+        for cls in sorted(att):
+            v = att[cls]
+            if v is not None:
+                lines.append(
+                    f'{self.prefix}_slo_attainment{{class="{cls}"}} '
+                    f"{v:g}")
+        for dim, target in (("ttft", self.slo.ttft_ms),
+                            ("tpot", self.slo.tpot_ms)):
+            if target is not None:
+                gname = f"{self.prefix}_slo_{dim}_target_ms"
+                lines.append(f"# TYPE {gname} gauge")
+                lines.append(f"{gname} {target:g}")
+        return lines
 
     def prometheus_text(self) -> str:
         """Prometheus text exposition: serving histograms + every
@@ -346,12 +622,9 @@ class ServingMetrics:
         for c in self.COUNTERS:
             self.counter(c)
         lines: List[str] = []
-        for h in (self.ttft_ms, self.tpot_ms, self.queue_delay_ms,
-                  self.prefill_ms, self.e2e_ms, self.spec_accept_rate,
-                  self.spec_tokens_per_step, self.prefill_chunks,
-                  self.prefill_chunk_ms, self.restore_ms,
-                  self.step_ms):
+        for h in self._histograms().values():
             lines.extend(h.prometheus_lines())
+        lines.extend(self._slo_lines())
         for name, val in sorted(self.gauges().items()):
             gname = f"{self.prefix}_{name}".replace(".", "_")
             lines.append(f"# TYPE {gname} gauge")

@@ -11,6 +11,13 @@ Newline-JSON protocol (one JSON object per line, both directions):
     <- {"status": "ok", "active": 1, "queued": 0, "free_pages": 9, ...}
     -> {"op": "stats"}     # metrics snapshot (JSON)
     -> {"op": "metrics"}   # Prometheus text page (in "text")
+    -> {"op": "export"}    # structured metrics export (r17): exact
+                           # counters + bucket-exact histogram counts
+                           # + SLO window counts — what the
+                           # supervisor's fleet collector scrapes
+    -> {"op": "slo"}       # read / retarget the live SLO monitor
+                           # ({"ttft_ms": 50, "tpot_ms": 10} sets and
+                           # resets the rolling window)
     -> {"op": "trace"}     # finished span trees + engine step
                            # timeline (r16); {"format": "chrome"}
                            # returns chrome://tracing JSON mergeable
@@ -100,7 +107,8 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from .metrics import ServingMetrics
+from .fleet_metrics import FlightRecorder
+from .metrics import ServingMetrics, SLOAttainment
 from .prefix_cache import PrefixCache
 from .scheduler import Priority, ServerOverloaded, SLOScheduler
 from .tracing import SpanTracer, stderr_span_sink
@@ -154,6 +162,11 @@ class ServingServer:
                  trace_sample: float = 0.0,
                  trace_max: int = 64,
                  tracer: Optional[SpanTracer] = None,
+                 slo_ttft_ms: Optional[float] = None,
+                 slo_tpot_ms: Optional[float] = None,
+                 slo_window_s: float = 120.0,
+                 flight_dir: Optional[str] = None,
+                 flight_budget_bytes: int = 64 << 20,
                  **engine_kwargs):
         from ..distributed.resilience import get_retry_policy
 
@@ -174,7 +187,33 @@ class ServingServer:
         self._requested_port = port
         self.scheduler = scheduler if scheduler is not None \
             else SLOScheduler()
-        self.metrics = metrics if metrics is not None else ServingMetrics()
+        if metrics is not None:
+            # the caller owns the SLOAttainment (window size included);
+            # constructor kwargs overlay per-target, preserving any
+            # target already configured there — the same partial-
+            # retarget rule as the runtime "slo" op
+            self.metrics = metrics
+            if slo_ttft_ms is not None or slo_tpot_ms is not None:
+                slo = self.metrics.slo
+                slo.set_targets(
+                    slo_ttft_ms if slo_ttft_ms is not None
+                    else slo.ttft_ms,
+                    slo_tpot_ms if slo_tpot_ms is not None
+                    else slo.tpot_ms)
+        else:
+            # live SLO monitor (r17): targets from the CLI (or the
+            # runtime "slo" op); without targets the tracker is inert
+            # and exports no attainment gauges
+            self.metrics = ServingMetrics(
+                slo=SLOAttainment(ttft_ms=slo_ttft_ms,
+                                  tpot_ms=slo_tpot_ms,
+                                  window_s=slo_window_s))
+        # crash flight recorder (r17): black-box bundles on engine
+        # resurrection / terminal failure / stall — postmortems stop
+        # depending on having had stderr attached
+        self.flight = (FlightRecorder(flight_dir,
+                                      budget_bytes=flight_budget_bytes)
+                       if flight_dir else None)
         self._use_prefix_cache = bool(prefix_cache)
         # hierarchical prefix cache (r15): spill-tier config is part of
         # the resurrection recipe — a rebuilt engine gets the same
@@ -440,6 +479,43 @@ class ServingServer:
             self._wake.wait(timeout=self.poll_interval_s)
             self._wake.clear()
 
+    def _flight_record(self, reason: str, inflight=None,
+                       **extra) -> None:
+        """Crash flight recorder (r17): assemble + atomically write
+        one black-box bundle (engine thread; bounded structures only —
+        timeline ring, finished-trace ring, slot-count inflight dump).
+        Never raises: a postmortem artifact must not create the next
+        incident."""
+        if self.flight is None:
+            return
+        eng = self.engine
+
+        def collect() -> Dict:
+            reqs = (inflight if inflight is not None
+                    else eng.dump_inflight())
+            return {
+                "model": type(self._model).__name__,
+                "engine": getattr(eng, "flight_summary",
+                                  lambda: {})(),
+                "recipe": dict(self._engine_kwargs),
+                "restarts": self._restarts,
+                "consec_errors": self._consec_errors,
+                "step_timeline": getattr(eng, "step_timeline",
+                                         lambda: [])(),
+                "traces": self.tracer.finished(),
+                "events": self.tracer.events(),
+                "metrics": self.metrics.export(),
+                "inflight": [{"req_id": int(r.req_id),
+                              "state": r.state,
+                              "prompt_len": int(len(r.prompt)),
+                              "generated": int(len(r.generated)),
+                              "priority": int(r.priority)}
+                             for r in reqs],
+                **extra,
+            }
+
+        self.flight.record(reason, collect)
+
     def _resurrect_engine(self) -> None:
         """Terminal engine-step failure, recoverable edition (engine
         thread): snapshot every request the dead engine still owes an
@@ -455,6 +531,9 @@ class ServingServer:
         self.metrics.counter("engine_restarts_total").add()
         old = self.engine
         snapshot = old.dump_inflight()
+        # flight bundle BEFORE teardown: the dying engine's timeline
+        # ring and in-flight set are exactly what the postmortem needs
+        self._flight_record("resurrect", inflight=snapshot)
         self.tracer.annotate(
             "resurrect",
             rids=[(r.req_id, len(r.prompt), len(r.generated), r.state)
@@ -551,6 +630,7 @@ class ServingServer:
         pages are torn down best-effort, and the server stops admitting
         (health keeps answering with status "draining")."""
         self._draining = True
+        self._flight_record("engine_failed")
         err = {"error": "EngineFailed",
                "reason": f"decode engine failed "
                          f"{self._consec_errors} consecutive steps; "
@@ -666,6 +746,11 @@ class ServingServer:
                    "reason": "deadline_ms elapsed before completion",
                    "tokens_out": int(req.stats.tokens_out)}
         elif req.state == "stalled":
+            # a stall is the third black-box trigger: something below
+            # the engine stopped making progress without erroring —
+            # the rate-limited bundle captures the step timeline that
+            # explains the silence (r17)
+            self._flight_record("stall", stalled_rid=int(req.req_id))
             msg = {"rid": req.req_id, "error": "RequestStalled",
                    "reason": f"no token for "
                              f"{self.engine.stall_timeout_s}s; evicted",
@@ -790,6 +875,47 @@ class ServingServer:
         if op == "metrics":
             send({"text": self.metrics.prometheus_text()})
             return
+        if op == "export":
+            # fleet telemetry (r17): the STRUCTURED metrics export the
+            # supervisor's collector scrapes — exact counters,
+            # bucket-exact histogram counts, SLO window counts. The
+            # fleet plane merges these; it never parses exposition
+            # text.
+            send({"export": self.metrics.export()})
+            return
+        if op == "slo":
+            # runtime SLO retargeting: {"op": "slo", "ttft_ms": 50,
+            # "tpot_ms": 10} sets (resetting the window — attainment
+            # against old targets is not attainment against new);
+            # omitting both fields just reads the current state. The
+            # fleet_goodput bench calibrates targets this way without
+            # a replica restart.
+            if "ttft_ms" in msg or "tpot_ms" in msg:
+                for k in ("ttft_ms", "tpot_ms"):
+                    v = msg.get(k)
+                    if v is not None and (isinstance(v, bool)
+                                          or not isinstance(
+                                              v, (int, float))
+                                          or v <= 0):
+                        send({"error": "BadRequest",
+                              "reason": f"{k} must be a positive "
+                                        f"number of ms or null"})
+                        return
+                # an ABSENT key preserves the current target (partial
+                # retarget must not silently drop the other SLO); an
+                # explicit null clears it
+                slo = self.metrics.slo
+                slo.set_targets(
+                    msg["ttft_ms"] if "ttft_ms" in msg
+                    else slo.ttft_ms,
+                    msg["tpot_ms"] if "tpot_ms" in msg
+                    else slo.tpot_ms)
+            send({"slo": {"ttft_ms": self.metrics.slo.ttft_ms,
+                          "tpot_ms": self.metrics.slo.tpot_ms,
+                          "window_s": self.metrics.slo.window_s,
+                          "attainment":
+                              self.metrics.slo.attainment()}})
+            return
         if op == "trace":
             # r16: finished span trees + tracer annotations + the
             # engine step-timeline ring. format=chrome returns a
@@ -800,9 +926,17 @@ class ServingServer:
                 send({"chrome": self.tracer.to_chrome()})
                 return
             n = msg.get("n")
-            send({"traces": self.tracer.finished(
-                      n if isinstance(n, int) and not isinstance(
-                          n, bool) else None),
+            if msg.get("drain") is True:
+                # consume the finished ring (r17): phase-scoped trace
+                # collection — the fleet_goodput bench reads each
+                # swept rate's traces without earlier phases bleeding
+                # into its attainment computation
+                traces = self.tracer.drain()
+            else:
+                traces = self.tracer.finished(
+                    n if isinstance(n, int) and not isinstance(
+                        n, bool) else None)
+            send({"traces": traces,
                   "events": self.tracer.events(),
                   "step_timeline": getattr(
                       eng, "step_timeline", lambda: [])(),
@@ -985,6 +1119,9 @@ class ServingServer:
         eng = self.engine
         pc = self.prefix_cache
         g = {"inflight_slots": eng.num_active,
+             # num_slots rides along so the fleet plane can compute
+             # occupancy (inflight/slots) for the pressure verdict
+             "num_slots": eng.num_slots,
              "queued_requests": eng.num_queued,
              "free_pages": eng.free_pages,
              "reserved_pages": eng.allocator.reserved_total,
@@ -1242,6 +1379,31 @@ def main(argv=None) -> None:
              "off costs ~zero on the hot path), 1.0 = every request. "
              "Dump via the 'trace' op; greedy outputs are "
              "bit-identical tracing on/off")
+    parser.add_argument(
+        "--slo-ttft-ms", type=float, default=None, metavar="MS",
+        help="fleet telemetry (r17): TTFT target for the live "
+             "SLO-attainment monitor — the rolling-window fraction of "
+             "finished requests meeting it surfaces per class as "
+             "serving_slo_attainment gauges and in the supervisor's "
+             "fleet_stats (retargetable at runtime via the 'slo' op)")
+    parser.add_argument(
+        "--slo-tpot-ms", type=float, default=None, metavar="MS",
+        help="TPOT target for the live SLO monitor (see --slo-ttft-ms)")
+    parser.add_argument(
+        "--slo-window-s", type=float, default=120.0, metavar="S",
+        help="rolling window of the live SLO monitor (default 120)")
+    parser.add_argument(
+        "--flight-dir", default=None, metavar="DIR",
+        help="crash flight recorder (r17): write black-box bundles "
+             "(step-timeline ring, sampled traces, metrics export, "
+             "inflight dump, engine recipe) to DIR on engine "
+             "resurrection, terminal EngineFailed, or a stalled "
+             "request — atomic tmp+rename writes, byte-budgeted "
+             "retention; inspect with tools/flight_inspect.py")
+    parser.add_argument(
+        "--flight-budget-mb", type=int, default=64, metavar="MB",
+        help="retention byte budget of --flight-dir (oldest bundles "
+             "pruned first, the newest always kept; default 64)")
     args = parser.parse_args(argv)
 
     model = _build_model(args.model)
@@ -1292,6 +1454,12 @@ def main(argv=None) -> None:
                                None if args.spill_dir is None
                                else args.spill_disk_mb << 20),
                            trace_sample=args.trace_sample,
+                           slo_ttft_ms=args.slo_ttft_ms,
+                           slo_tpot_ms=args.slo_tpot_ms,
+                           slo_window_s=args.slo_window_s,
+                           flight_dir=args.flight_dir,
+                           flight_budget_bytes=(
+                               args.flight_budget_mb << 20),
                            speculative=speculative, **engine_kwargs)
     port = server.start()
     print(f"[paddle_tpu.serving] listening on {args.host}:{port} "

@@ -19,6 +19,15 @@ from the resubmitted stream — the client's stream continues seamlessly.
 Unkeyed requests get a typed retryable ``ReplicaFailed`` instead (the
 router must not guess at idempotency).
 
+Fleet telemetry plane (r17, serving/fleet_metrics.py): each healthy
+probe cycle also scrapes the replica's STRUCTURED metrics export
+(``{"op": "export"}``) into a supervisor-side collector that merges
+histograms bucket-exactly, tracks fleet SLO attainment, classifies
+probe failures (timeout/refused/malformed/...), flags outlier
+replicas against the fleet median, and publishes it all through the
+router's ``fleet_stats`` (JSON) and ``fleet_metrics`` (Prometheus,
+``replica``-labeled series + ``fleet_*`` rollups) ops.
+
 Fault sites (distributed/fault_inject.py): ``net.recv`` fires in the
 router's backend reader — an armed schedule makes the router treat the
 backend as dead and exercise the failover path; the same site inside a
@@ -51,7 +60,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["Replica", "Supervisor", "FailoverRouter"]
+__all__ = ["Replica", "Supervisor", "FailoverRouter",
+           "classify_probe_failure"]
 
 
 def _free_port(host: str = "127.0.0.1") -> int:
@@ -76,6 +86,29 @@ def _rpc(host: str, port: int, payload: Dict, timeout_s: float) -> Dict:
         return json.loads(line)
 
 
+def classify_probe_failure(exc: Optional[BaseException]) -> str:
+    """Probe-failure taxonomy (r17): map a probe exception (None = the
+    reply arrived but was malformed) onto a stable kind. The monitor
+    loop keeps per-replica counts per kind — a replica that TIMES OUT
+    (wedged/overloaded) and one REFUSING connections (dead port) and
+    one answering GARBAGE (torn/buggy) are different incidents."""
+    if exc is None:
+        return "malformed"
+    if isinstance(exc, socket.timeout):
+        return "timeout"
+    if isinstance(exc, ConnectionRefusedError):
+        return "refused"
+    if isinstance(exc, ConnectionResetError):
+        return "reset"
+    if isinstance(exc, json.JSONDecodeError):
+        return "torn_json"
+    if isinstance(exc, ConnectionError):
+        return "closed"
+    if isinstance(exc, OSError):
+        return "os_error"
+    return "error"
+
+
 class Replica:
     """One supervised server process."""
 
@@ -88,6 +121,13 @@ class Replica:
         self.restarts = 0           # respawns after a death
         self.consec_deaths = 0      # resets on a healthy probe
         self.probe_failures = 0
+        # probe-failure taxonomy (r17): a bare "ok = False" collapsed
+        # timeout/refused/malformed into one signal — these keep the
+        # per-kind lifetime counts + the most recent classified error,
+        # exported through fleet_stats (a replica that times out under
+        # load and one that answers garbage need different operators)
+        self.probe_failures_by_kind: Dict[str, int] = {}
+        self.last_probe_error: Optional[str] = None
         self.next_spawn_t: Optional[float] = None  # backoff gate
         self.spawn_t: Optional[float] = None       # warmup clock
         self.log_path: Optional[str] = None
@@ -135,7 +175,9 @@ class Supervisor:
                  backoff_base_s: float = 0.5,
                  backoff_max_s: float = 10.0,
                  ready_timeout_s: float = 300.0,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 collect_metrics: bool = True,
+                 fleet=None):
         self.model = model
         self.host = host
         self.server_args = list(server_args)
@@ -146,6 +188,20 @@ class Supervisor:
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
         self.ready_timeout_s = float(ready_timeout_s)
+        # fleet telemetry plane (r17): a healthy probe cycle also
+        # scrapes the replica's STRUCTURED metrics export into the
+        # collector (ServingMetrics.export() over the wire — never
+        # parsed exposition text); collect_metrics=False is the
+        # scrape-overhead escape hatch the fleet_goodput bench A/Bs
+        self.collect_metrics = bool(collect_metrics)
+        if fleet is not None:
+            self.fleet = fleet
+        elif collect_metrics:
+            from .fleet_metrics import FleetMetrics
+            self.fleet = FleetMetrics(
+                stale_after_s=max(10.0, 4 * float(probe_interval_s)))
+        else:
+            self.fleet = None
         if log_dir is None:
             self.log_dir = tempfile.mkdtemp(
                 prefix="pt-serving-supervisor-")
@@ -277,16 +333,19 @@ class Supervisor:
                 if not rep.alive():
                     self._mark_dead(rep)
                     continue
+                probe_exc: Optional[BaseException] = None
                 try:
                     h = _rpc(self.host, rep.port, {"op": "health"},
                              timeout_s=self.probe_timeout_s)
                     ok = "status" in h
-                except Exception:
+                except Exception as e:
                     ok = False
+                    probe_exc = e
                 if ok:
                     rep.ready = True
                     rep.probe_failures = 0
                     rep.consec_deaths = 0
+                    self._scrape_metrics(rep)
                     # cache-affinity advertisement (r15): best-effort —
                     # an old server build without these fields just
                     # leaves the replica unadvertised (RR/least-loaded
@@ -302,6 +361,15 @@ class Supervisor:
                         pass
                 else:
                     rep.probe_failures += 1
+                    # taxonomy (r17): timeout / refused / malformed /
+                    # torn are different incidents; count them apart
+                    kind = classify_probe_failure(probe_exc)
+                    rep.probe_failures_by_kind[kind] = \
+                        rep.probe_failures_by_kind.get(kind, 0) + 1
+                    rep.last_probe_error = (
+                        kind if probe_exc is None else
+                        f"{kind}: {type(probe_exc).__name__}: "
+                        f"{probe_exc}")
                     stuck_warmup = (
                         not rep.ready and rep.spawn_t is not None
                         and time.monotonic() - rep.spawn_t
@@ -323,6 +391,57 @@ class Supervisor:
                         self._mark_dead(rep)
             self._stop.wait(timeout=self.probe_interval_s)
 
+    def _scrape_metrics(self, rep: Replica) -> None:
+        """Collector half of the probe cycle (r17): pull the replica's
+        structured metrics export into the fleet plane. A scrape that
+        fails mid-cycle (replica died between probe and scrape, torn
+        reply) marks the replica STALE — its last export is kept for
+        postmortems but dropped from fleet rollups, so a dying replica
+        can never poison fleet totals."""
+        if self.fleet is None or not self.collect_metrics:
+            return
+        try:
+            reply = _rpc(self.host, rep.port, {"op": "export"},
+                         timeout_s=self.probe_timeout_s)
+            export = reply.get("export")
+            if not isinstance(export, dict):
+                raise ValueError("export op returned no export dict")
+            self.fleet.ingest(rep.idx, export)
+        except Exception:
+            self.fleet.mark_stale(rep.idx)
+
+    def fleet_stats(self) -> Dict:
+        """The ``fleet_stats`` payload (r17): the collector's merged
+        telemetry (bucket-exact fleet histograms, merged SLO window,
+        pressure verdict, outlier flags) JOINED with the supervision
+        state only this process knows — per-replica probe-failure
+        taxonomy, restart counts, and live backoff gates (previously
+        computed and exported nowhere)."""
+        now = time.monotonic()
+        supervision = {}
+        for r in self.replicas:
+            supervision[str(r.idx)] = {
+                "port": r.port, "ready": r.ready, "alive": r.alive(),
+                "load": r.load,
+                "restarts": r.restarts,
+                "consec_deaths": r.consec_deaths,
+                "probe_failures": r.probe_failures,
+                "probe_failures_by_kind":
+                    dict(r.probe_failures_by_kind),
+                "last_probe_error": r.last_probe_error,
+                "backoff_remaining_s": (
+                    None if r.next_spawn_t is None
+                    else round(max(0.0, r.next_spawn_t - now), 3)),
+            }
+        out = (self.fleet.fleet_snapshot()
+               if self.fleet is not None else
+               {"replicas_fresh": 0, "replicas_known": 0,
+                "collector": None})
+        out["supervision"] = supervision
+        out["restarts_total"] = self.restarts_total
+        out["collect_metrics"] = self.collect_metrics
+        return out
+
     def _mark_dead(self, rep: Replica) -> None:
         rep.ready = False
         rep.consec_deaths += 1
@@ -331,6 +450,10 @@ class Supervisor:
                       * 2 ** (rep.consec_deaths - 1))
         rep.next_spawn_t = time.monotonic() + backoff
         rep.close_log()
+        if self.fleet is not None:
+            # drop the dead replica from fleet rollups immediately —
+            # not after stale_after_s ages it out
+            self.fleet.mark_stale(rep.idx)
 
 
 class _BackendLost(ConnectionError):
@@ -374,7 +497,8 @@ class FailoverRouter:
                  backend_timeout_s: float = 300.0,
                  no_replica_wait_s: float = 60.0,
                  affinity: bool = True,
-                 trace_sample: float = 0.0, tracer=None):
+                 trace_sample: float = 0.0, tracer=None,
+                 deprioritize_outliers: bool = False):
         self.sup = supervisor
         self.host = host
         self._requested_port = port
@@ -382,6 +506,13 @@ class FailoverRouter:
         self.backend_timeout_s = float(backend_timeout_s)
         self.no_replica_wait_s = float(no_replica_wait_s)
         self.affinity = bool(affinity)
+        # fleet telemetry (r17), default OFF: steer UNKEYED traffic
+        # away from replicas the outlier detector currently flags
+        # (slow step-ms/TPOT or erroring vs the fleet median). A
+        # routing PREFERENCE only — flagged replicas still serve when
+        # they are all that's live, keyed/affinity routing is
+        # untouched, and failover exclusion always filters first.
+        self.deprioritize_outliers = bool(deprioritize_outliers)
         # end-to-end tracing (r16): the router is the FIRST hop, so
         # its sampler decides for the whole request — a sampled
         # request's forward carries a trace context that forces the
@@ -521,6 +652,38 @@ class FailoverRouter:
                   "events": self.tracer.events(),
                   "sample_rate": self.tracer.sample_rate})
             return
+        if op == "fleet_stats":
+            # fleet telemetry plane (r17): the collector's merged view
+            # + supervision taxonomy, answered BY THE ROUTER (the one
+            # port an operator watches). Duck-typed: a stub supervisor
+            # without the plane gets a typed reply, not a crash.
+            fs = getattr(self.sup, "fleet_stats", None)
+            if fs is None:
+                send({"error": "FleetMetricsUnavailable",
+                      "reason": "supervisor has no fleet telemetry "
+                                "plane"})
+                return
+            stats = fs()
+            stats["router"] = {
+                "failovers_total": self.failovers_total,
+                "replica_failures_total": self.replica_failures_total,
+                "affinity_routed_total": self.affinity_routed_total,
+                "affinity_hits_total": self.affinity_hits_total,
+                "deprioritize_outliers": self.deprioritize_outliers,
+            }
+            send({"fleet": stats})
+            return
+        if op == "fleet_metrics":
+            # fleet Prometheus exposition: per-replica series carry a
+            # replica label, fleet rollups live in fleet_* families
+            fm = getattr(self.sup, "fleet", None)
+            if fm is None:
+                send({"error": "FleetMetricsUnavailable",
+                      "reason": "supervisor has no fleet telemetry "
+                                "plane"})
+                return
+            send({"text": fm.prometheus_text()})
+            return
         if op != "generate":
             # admin op: first live replica answers (replica-targeted
             # audits talk to replica ports directly)
@@ -598,6 +761,21 @@ class FailoverRouter:
         if keyed:
             lo = min(getattr(r, "load", 0) for r in live)
             live = [r for r in live if getattr(r, "load", 0) == lo]
+        elif self.deprioritize_outliers:
+            # r17 (default off): unkeyed traffic prefers replicas the
+            # fleet outlier detector hasn't flagged — a preference,
+            # never a filter-to-empty (a fully-flagged fleet still
+            # serves), applied AFTER liveness/exclusion so it cannot
+            # block failover
+            fm = getattr(self.sup, "fleet", None)
+            if fm is not None:
+                try:
+                    flagged = set(fm.outliers())
+                except Exception:
+                    flagged = set()
+                healthy = [r for r in live if r.idx not in flagged]
+                if healthy:
+                    live = healthy
         with self._lock:
             self._rr += 1
             return live[self._rr % len(live)]
@@ -857,6 +1035,41 @@ def main(argv=None) -> None:
              "replica-local sampling works when the router doesn't "
              "sample")
     parser.add_argument(
+        "--slo-ttft-ms", type=float, default=None, metavar="MS",
+        help="fleet telemetry (r17): TTFT target for the live "
+             "SLO-attainment monitor, threaded to every replica's "
+             "server; per-class rolling-window attainment surfaces as "
+             "serving_slo_attainment gauges and merges into the "
+             "router's fleet_stats op (the 3(a) autoscaler signal)")
+    parser.add_argument(
+        "--slo-tpot-ms", type=float, default=None, metavar="MS",
+        help="TPOT target for the live SLO monitor (see --slo-ttft-ms)")
+    parser.add_argument(
+        "--flight-dir", default=None, metavar="DIR",
+        help="crash flight recorder (r17): each replica i writes "
+             "black-box bundles (step timeline, sampled traces, "
+             "metrics export, inflight dump, engine recipe) to "
+             "DIR/replica<i> on engine resurrection / terminal "
+             "EngineFailed / stalled-request eviction; inspect with "
+             "tools/flight_inspect.py")
+    parser.add_argument(
+        "--flight-budget-mb", type=int, default=64, metavar="MB",
+        help="byte budget of each replica's flight-bundle retention "
+             "ring (oldest bundles pruned; default 64)")
+    parser.add_argument(
+        "--no-collect-metrics", action="store_true",
+        help="disable the fleet metrics collector (the probe cycle's "
+             "per-replica export scrape); fleet_stats then reports "
+             "supervision state only (no merged counters/SLO/"
+             "pressure) and fleet_metrics answers typed "
+             "FleetMetricsUnavailable")
+    parser.add_argument(
+        "--deprioritize-outliers", action="store_true",
+        help="steer unkeyed traffic away from replicas the fleet "
+             "outlier detector flags (slow step-ms/TPOT or erroring "
+             "vs the fleet median); default off — detection always "
+             "runs, only the routing preference is gated")
+    parser.add_argument(
         "server_args", nargs="*",
         help="extra args passed to every replica's "
              "`python -m paddle_tpu.serving.server` (e.g. "
@@ -916,18 +1129,31 @@ def main(argv=None) -> None:
                         "--spill-disk-mb", str(args.spill_disk_mb)]
     if args.trace_sample:
         server_args += ["--trace-sample", str(args.trace_sample)]
+    if args.slo_ttft_ms is not None:
+        server_args += ["--slo-ttft-ms", str(args.slo_ttft_ms)]
+    if args.slo_tpot_ms is not None:
+        server_args += ["--slo-tpot-ms", str(args.slo_tpot_ms)]
+    if args.flight_dir is not None:
+        server_args += ["--flight-dir",
+                        os.path.join(args.flight_dir,
+                                     "replica{replica}"),
+                        "--flight-budget-mb",
+                        str(args.flight_budget_mb)]
     sup = Supervisor(model=args.model, replicas=args.replicas,
                      host=args.host, server_args=server_args,
                      probe_interval_s=args.probe_interval_s,
                      backoff_base_s=args.backoff_base_s,
-                     log_dir=args.log_dir)
+                     log_dir=args.log_dir,
+                     collect_metrics=not args.no_collect_metrics)
     print(f"[paddle_tpu.supervisor] spawning {args.replicas} replicas "
           f"of {args.model} (logs: {sup.log_dir}) ...", flush=True)
     router = None
     try:
         sup.start(wait_ready=True)
-        router = FailoverRouter(sup, host=args.host, port=args.port,
-                                trace_sample=args.trace_sample)
+        router = FailoverRouter(
+            sup, host=args.host, port=args.port,
+            trace_sample=args.trace_sample,
+            deprioritize_outliers=args.deprioritize_outliers)
         port = router.start()
         print(f"[paddle_tpu.supervisor] router on {args.host}:{port}; "
               f"replicas "

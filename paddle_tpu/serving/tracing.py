@@ -375,10 +375,14 @@ def request_latencies(trace: Dict) -> Optional[Dict[str, float]]:
     request that never produced a token)."""
     submit = first = complete = None
     tokens_out = pre_tokens = 0
+    priority = None
     for s in trace.get("spans", ()):
         name = s.get("name")
         if name == "queue" and submit is None:
             submit = s.get("t0_us")
+            p = (s.get("args") or {}).get("priority")
+            if isinstance(p, int) and not isinstance(p, bool):
+                priority = p
         elif name == "first_token" and first is None:
             first = s.get("t0_us")
         elif name == "complete":
@@ -396,6 +400,10 @@ def request_latencies(trace: Dict) -> Optional[Dict[str, float]]:
     tokens_out += pre_tokens
     out = {"submit_us": submit, "complete_us": complete,
            "tokens_out": tokens_out,
+           # the queue span's priority arg (None when untraced) — the
+           # fleet SLO monitor tracks attainment per class, and the
+           # trace-computed attainment must split the same way (r17)
+           "priority": priority,
            "e2e_s": (complete - submit) / 1e6,
            "ttft_s": None, "tpot_s": None}
     if first is not None:

@@ -729,3 +729,41 @@ class TestMetricsRegistryAudit:
         for name in ("traces_sampled_total", "traces_finished_total",
                      "trace_spans_dropped_total"):
             assert f"serving_{name} 0" in text
+
+    def test_fleet_exposition_obeys_the_same_rules(self):
+        """r17 extension: the FLEET exposition (per-replica series
+        with a replica label + fleet_* rollup families) must obey the
+        exact audit this class pins for one replica — counter
+        families end _total, no histogram/counter family collisions
+        (rollups live in distinct fleet_* families, so an unlabeled
+        rollup can never collide with a labeled series), every line
+        parses."""
+        from paddle_tpu.serving.fleet_metrics import FleetMetrics
+        fm = FleetMetrics()
+        for i in range(2):
+            met = ServingMetrics(registry=StatRegistry())
+            met.ttft_ms.observe(2.0 + i)
+            met.counter("requests_total").add()
+            fm.ingest(i, met.export())
+        text = fm.prometheus_text()
+        assert text.endswith("\n")
+        fams = self._families(text)
+        assert fams, "no TYPE lines in fleet exposition"
+        hist = {n for n, t in fams.items() if t == "histogram"}
+        counters = {n for n, t in fams.items() if t == "counter"}
+        gauges = {n for n, t in fams.items() if t == "gauge"}
+        for c in counters:
+            assert c.endswith("_total"), c
+            assert c[:-len("_total")] not in hist, c
+        for h in hist:
+            assert not h.endswith("_total"), h
+            for suffix in ("_bucket", "_sum", "_count"):
+                assert h + suffix not in counters | gauges | hist
+        # replica-labeled series and fleet rollups never share a family
+        assert not {f for f in fams if f.startswith("serving_")} & \
+            {f for f in fams if f.startswith("fleet_")}
+        for line in text.splitlines():
+            if not line:
+                continue
+            assert _PROM_TYPE.match(line) or _PROM_SAMPLE.match(line), (
+                f"fleet exposition line does not parse: {line!r}")

@@ -47,9 +47,11 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _REPO not in sys.path:
-    sys.path.insert(0, _REPO)
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+for _p in (_REPO, _TOOLS):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
 
 # default replica fault schedule: an engine.step burst long enough to
 # breach --max-engine-errors 3 (forcing one resurrection per replica
@@ -70,6 +72,11 @@ class ChaosReport:
     hangs: int = 0                # no reply within timeout (INVARIANT 1)
     mismatches: int = 0           # greedy output != reference (INV. 3)
     leak_failures: int = 0        # replica leak_check not ok (INV. 2)
+    # crash flight recorder (r17, INVARIANT 4): every survivor bundle
+    # lints clean and each replica's retention ring held its budget
+    flight_bundles: int = 0
+    flight_lint_failures: int = 0
+    flight_errors: List[str] = dataclasses.field(default_factory=list)
     error_kinds: Dict[str, int] = dataclasses.field(default_factory=dict)
     details: List[Dict] = dataclasses.field(default_factory=list)
     engine_restarts: int = 0      # scraped from surviving replicas
@@ -83,6 +90,7 @@ class ChaosReport:
     def ok(self) -> bool:
         return (self.hangs == 0 and self.mismatches == 0
                 and self.leak_failures == 0
+                and self.flight_lint_failures == 0
                 and self.completed + self.typed_errors == self.requests)
 
     def to_dict(self) -> Dict:
@@ -132,7 +140,8 @@ def run_chaos(replicas: int = 2, requests: int = 12, seed: int = 0,
               request_timeout_s: float = 300.0,
               drain_timeout_s: float = 120.0,
               platform: str = "cpu",
-              log_dir: Optional[str] = None) -> ChaosReport:
+              log_dir: Optional[str] = None,
+              flight_budget_mb: int = 4) -> ChaosReport:
     """One seeded chaos run; see module docstring for the invariants.
 
     ``deadline_doomed`` requests carry a 1 ms deadline (guaranteed
@@ -174,11 +183,21 @@ def run_chaos(replicas: int = 2, requests: int = 12, seed: int = 0,
     if replica_faults:
         replica_env["PT_FAULT_INJECT"] = replica_faults
 
+    # crash flight recorder (r17): every replica writes black-box
+    # bundles on resurrection/EngineFailed/stall. The engine.step
+    # fault burst forces a resurrection in each replica process, so a
+    # successful run leaves lint-clean bundles behind — the SIGKILLed
+    # replica's SURVIVORS (and its own respawn) are exactly the
+    # postmortem artifacts a real incident would need.
+    flight_root = os.path.join(log_dir, "flight")
     server_args = ["--page-size", str(page_size),
                    "--max-seq-len", str(max_seq_len),
                    "--num-slots", str(num_slots),
                    "--max-engine-errors", "3",
-                   "--stall-timeout-s", "120"]
+                   "--stall-timeout-s", "120",
+                   "--flight-dir",
+                   os.path.join(flight_root, "replica{replica}"),
+                   "--flight-budget-mb", str(flight_budget_mb)]
     sup = Supervisor(model=model, replicas=replicas,
                      server_args=server_args, replica_env=replica_env,
                      probe_interval_s=0.3, backoff_base_s=0.5,
@@ -321,6 +340,30 @@ def run_chaos(replicas: int = 2, requests: int = 12, seed: int = 0,
                 int(counters.get("engine_restarts_total", 0))
             report.replayed_requests += \
                 int(counters.get("replayed_requests_total", 0))
+        # -- invariant 4: lint-clean flight bundles under budget -----------
+        # (r17) the engine.step bursts forced resurrections, so each
+        # replica process left black-box bundles; every one must lint
+        # clean (closed spans, monotonic timeline, consistent metrics
+        # export) and each retention ring must hold its byte budget.
+        import flight_inspect
+        budget = flight_budget_mb << 20
+        for rep in sup.replicas:
+            rep_dir = os.path.join(flight_root, f"replica{rep.idx}")
+            if not os.path.isdir(rep_dir):
+                continue
+            bundles, errors = flight_inspect.lint_dir(
+                rep_dir, budget_bytes=budget)
+            report.flight_bundles += len(bundles)
+            if errors:
+                report.flight_lint_failures += 1
+                report.flight_errors.extend(errors[:8])
+        if report.flight_bundles == 0 and replica_faults:
+            # the fault schedule guarantees resurrections; zero
+            # bundles means the recorder silently failed
+            report.flight_lint_failures += 1
+            report.flight_errors.append(
+                f"no flight bundles under {flight_root} despite the "
+                f"engine.step fault schedule")
         report.supervisor_restarts = sup.restarts_total
         report.router_failovers = router.failovers_total
         router.stop()

@@ -1,0 +1,253 @@
+"""Lint + pretty-print crash flight-recorder bundles (r17).
+
+A flight bundle is the black box a serving replica writes on engine
+resurrection, terminal EngineFailed, or a stalled-request eviction
+(serving/fleet_metrics.py FlightRecorder, armed via the server's
+``--flight-dir``): step-timeline ring, finished sampled traces,
+metrics export, in-flight dump, and the engine construction recipe —
+written atomically (tmp+rename), retained under a byte budget.
+
+``lint_bundle`` validates one parsed bundle:
+
+- required keys present and sanely typed (``v``, ``reason``,
+  ``t_unix``, ``pid``, ``engine``, ``metrics``, ``step_timeline``,
+  ``traces``, ``inflight``);
+- the embedded traces lint clean via tools/trace_lint.py (spans
+  closed, ids unique, no orphan parents, nesting containment) — the
+  bundle only carries FINISHED trees, so the full checks apply;
+- the step timeline is a list of per-step dicts with monotonically
+  non-decreasing step numbers;
+- every inflight entry carries req_id/state/prompt_len/generated;
+- the metrics export's histograms are internally consistent
+  (sum(counts) == total).
+
+CLI::
+
+    python tools/flight_inspect.py DIR_OR_BUNDLE [--lint-only]
+    python tools/flight_inspect.py DIR --budget-bytes N   # ring audit
+
+Given a directory, every ``flight-*.json`` in it is linted (and with
+``--budget-bytes`` the retention-ring invariant — total committed
+bytes <= budget — is checked too: the chaos harness runs exactly
+this). Importable: the chaos harness and tests call ``lint_bundle`` /
+``lint_dir`` directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+for p in (_REPO, _TOOLS):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+from trace_lint import lint_trace_obj  # noqa: E402
+
+REQUIRED_KEYS = ("v", "reason", "t_unix", "pid", "engine", "metrics",
+                 "step_timeline", "traces", "inflight")
+KNOWN_REASONS = ("resurrect", "engine_failed", "stall")
+
+
+def lint_bundle(bundle: Any, name: str = "bundle") -> List[str]:
+    """Validate one parsed flight bundle; returns error strings
+    (empty = clean)."""
+    errors: List[str] = []
+    if not isinstance(bundle, dict):
+        return [f"{name}: not a JSON object"]
+    for k in REQUIRED_KEYS:
+        if k not in bundle:
+            errors.append(f"{name}: missing key {k!r}")
+    if errors:
+        return errors
+    if bundle.get("reason") not in KNOWN_REASONS:
+        errors.append(f"{name}: unknown reason "
+                      f"{bundle.get('reason')!r}")
+    if not isinstance(bundle.get("t_unix"), (int, float)) \
+            or bundle["t_unix"] <= 0:
+        errors.append(f"{name}: bad t_unix {bundle.get('t_unix')!r}")
+    if not isinstance(bundle.get("pid"), int):
+        errors.append(f"{name}: bad pid {bundle.get('pid')!r}")
+
+    # embedded traces: only FINISHED trees travel, so the full
+    # trace_lint contract applies (an empty list is fine — tracing
+    # may be unsampled/off; the flight recorder still has the ring)
+    traces = bundle.get("traces")
+    if not isinstance(traces, list):
+        errors.append(f"{name}: traces must be a list")
+    elif traces:
+        errors.extend(f"{name}: {e}"
+                      for e in lint_trace_obj({"traces": traces}))
+
+    tl = bundle.get("step_timeline")
+    if not isinstance(tl, list):
+        errors.append(f"{name}: step_timeline must be a list")
+    else:
+        last = -1
+        for i, entry in enumerate(tl):
+            if not isinstance(entry, dict) or "step" not in entry:
+                errors.append(f"{name}: timeline[{i}] not a per-step "
+                              f"dict")
+                continue
+            s = entry["step"]
+            if not isinstance(s, int) or s < last:
+                errors.append(f"{name}: timeline step numbers not "
+                              f"monotonic at [{i}] ({last} -> {s!r})")
+                break
+            last = s
+
+    infl = bundle.get("inflight")
+    if not isinstance(infl, list):
+        errors.append(f"{name}: inflight must be a list")
+    else:
+        for i, r in enumerate(infl):
+            if not isinstance(r, dict) or not all(
+                    k in r for k in ("req_id", "state", "prompt_len",
+                                     "generated")):
+                errors.append(f"{name}: inflight[{i}] missing "
+                              f"req_id/state/prompt_len/generated")
+
+    met = bundle.get("metrics")
+    if not isinstance(met, dict):
+        errors.append(f"{name}: metrics must be an export dict")
+    else:
+        for hname, h in (met.get("histograms") or {}).items():
+            if not isinstance(h, dict) or "counts" not in h:
+                errors.append(f"{name}: histogram {hname} malformed")
+                continue
+            if sum(h["counts"]) != h.get("total"):
+                errors.append(
+                    f"{name}: histogram {hname} counts sum "
+                    f"{sum(h['counts'])} != total {h.get('total')}")
+    return errors
+
+
+def lint_dir(path: str, budget_bytes: Optional[int] = None
+             ) -> Tuple[List[str], List[str]]:
+    """Lint every committed bundle under ``path``; returns (bundle
+    paths, errors). With ``budget_bytes``, also checks the retention
+    ring held its byte budget (the chaos-harness invariant). Only
+    COMMITTED bundles (``flight-*.json``) are considered: a leftover
+    ``*.tmp`` is legitimate crash debris under the atomic-rename
+    contract (a SIGKILL mid-write abandons the tmp; the rename is
+    what commits), so tmp files are ignored, never linted, and never
+    counted against the budget."""
+    errors: List[str] = []
+    try:
+        names = sorted(os.listdir(path))
+    except OSError as e:
+        return [], [f"{path}: {e}"]
+    bundles = [os.path.join(path, n) for n in names
+               if n.startswith("flight-") and n.endswith(".json")]
+    total = 0
+    for p in bundles:
+        try:
+            total += os.path.getsize(p)
+            with open(p, encoding="utf-8") as f:
+                obj = json.load(f)
+        except Exception as e:
+            errors.append(f"{p}: unreadable ({type(e).__name__}: {e})")
+            continue
+        errors.extend(lint_bundle(obj, name=os.path.basename(p)))
+    if budget_bytes is not None and len(bundles) > 1 \
+            and total > budget_bytes:
+        # a single oversized newest bundle is allowed (the most
+        # recent crash always survives); more than one while over
+        # budget means pruning failed
+        errors.append(f"{path}: retention ring over budget "
+                      f"({total} > {budget_bytes} bytes across "
+                      f"{len(bundles)} bundles)")
+    return bundles, errors
+
+
+def summarize(bundle: Dict) -> str:
+    """Human-readable card for one bundle."""
+    eng = bundle.get("engine") or {}
+    met = (bundle.get("metrics") or {}).get("counters") or {}
+    tl = bundle.get("step_timeline") or []
+    lines = [
+        f"reason      : {bundle.get('reason')}  "
+        f"(pid {bundle.get('pid')}, restarts "
+        f"{bundle.get('restarts')}, consec_errors "
+        f"{bundle.get('consec_errors')})",
+        f"engine      : step {eng.get('steps')}  "
+        f"slots {eng.get('num_active')}/{eng.get('num_slots')}  "
+        f"queued {eng.get('num_queued')}  free_pages "
+        f"{eng.get('free_pages')}/{eng.get('num_pages')}",
+        f"features    : fused={eng.get('fused_step')} "
+        f"spec={eng.get('speculative')} "
+        f"chunk={eng.get('prefill_chunk_tokens')} "
+        f"mesh={'yes' if eng.get('mesh') else 'no'}",
+        f"counters    : requests={met.get('requests_total')} "
+        f"tokens={met.get('tokens_generated_total')} "
+        f"engine_errors={met.get('engine_errors_total')} "
+        f"restarts={met.get('engine_restarts_total')} "
+        f"stalled={met.get('stalled_total')}",
+        f"timeline    : {len(tl)} step entries"
+        + (f", last step {tl[-1].get('step')} "
+           f"({tl[-1].get('ms')} ms)" if tl else ""),
+        f"traces      : {len(bundle.get('traces') or [])} finished "
+        f"tree(s), {len(bundle.get('events') or [])} annotation(s)",
+    ]
+    infl = bundle.get("inflight") or []
+    lines.append(f"inflight    : {len(infl)} request(s)")
+    for r in infl[:8]:
+        lines.append(f"  - rid {r.get('req_id')} [{r.get('state')}] "
+                     f"prompt {r.get('prompt_len')} tok, "
+                     f"{r.get('generated')} generated")
+    if len(infl) > 8:
+        lines.append(f"  ... and {len(infl) - 8} more")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="lint + pretty-print crash flight-recorder "
+                    "bundles (serving --flight-dir)")
+    ap.add_argument("path", help="a flight-*.json bundle or a "
+                                 "--flight-dir directory")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="suppress the summary; exit code only")
+    ap.add_argument("--budget-bytes", type=int, default=None,
+                    help="also assert the directory's retention ring "
+                         "held this byte budget")
+    args = ap.parse_args(argv)
+
+    if os.path.isdir(args.path):
+        bundles, errors = lint_dir(args.path,
+                                   budget_bytes=args.budget_bytes)
+        if not args.lint_only:
+            for p in bundles:
+                try:
+                    with open(p, encoding="utf-8") as f:
+                        obj = json.load(f)
+                except Exception:
+                    continue
+                print(f"== {os.path.basename(p)}")
+                print(summarize(obj))
+                print()
+    else:
+        with open(args.path, encoding="utf-8") as f:
+            obj = json.load(f)
+        errors = lint_bundle(obj, name=os.path.basename(args.path))
+        bundles = [args.path]
+        if not args.lint_only:
+            print(summarize(obj))
+    if errors:
+        for e in errors:
+            print(f"flight_inspect: {e}", file=sys.stderr)
+        print(f"flight_inspect: FAIL ({len(errors)} error(s))",
+              file=sys.stderr)
+        return 1
+    if not args.lint_only:
+        print(f"flight_inspect: OK ({len(bundles)} bundle(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
