@@ -1318,6 +1318,123 @@ def bench_prefix_tiers(on_tpu: bool) -> Dict:
     return out
 
 
+def bench_memory_observatory(on_tpu: bool) -> Dict:
+    """memory_observatory (r18): ledger-overhead A/B on a page-CHURN
+    stream — a revisited shared-prefix workload over a pool smaller
+    than the working set, so every round drives admit / evict / spill
+    / restore traffic (the event mix the ledger records). Reported:
+    ms/step with the page ledger on vs off (the behavior-neutrality
+    claim: ~1.0x), ledger event totals by kind, the occupancy
+    timeline's tail (owner-class breakdown per step) and the EWMA
+    exhaustion forecast over it. Outputs are asserted BIT-IDENTICAL
+    ledger on/off. On CPU this measures the host-side dict-append
+    cost next to real jit launches; HBM gauges (the profile op's
+    device.memory_stats) need a real device — chip pending."""
+    import paddle_tpu as pt
+    from paddle_tpu.inference import create_decode_engine
+    from paddle_tpu.inference.page_ledger import forecast_exhaustion
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import PrefixCache
+
+    if on_tpu:
+        cfg = _decode_1p3b_cfg()
+        slots, page, max_seq = 4, 64, 1024
+        sys_len, tail, new_toks = 256, 16, 8
+        n_prefix, rounds, num_pages = 8, 3, 24
+    else:
+        cfg = gpt_tiny()
+        slots, page, max_seq = 2, 8, 128
+        sys_len, tail, new_toks = 48, 8, 6
+        n_prefix, rounds, num_pages = 6, 4, 16
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        _to_bf16_except_norms(model)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompts = [np.concatenate([
+        rng.integers(0, cfg.vocab_size, (sys_len,)).astype(np.int32),
+        rng.integers(0, cfg.vocab_size, (tail,)).astype(np.int32)])
+        for _ in range(n_prefix)]
+
+    def prepare(ledger: bool):
+        pc = PrefixCache(page, spill_bytes=1 << 26)
+        eng = create_decode_engine(
+            model, num_slots=slots, page_size=page,
+            max_seq_len=max_seq, num_pages=num_pages,
+            prefix_cache=pc, page_ledger=ledger)
+        # warm every compile (fresh + chained prefill, decode, splice)
+        # through the measured engine before timing
+        for p in (prompts[0], prompts[1], prompts[0]):
+            eng.submit(p, max_new_tokens=2)
+            eng.run()
+        pc.evict_until(eng.allocator, eng.allocator.num_pages)
+        eng.submit(prompts[0], max_new_tokens=2)
+        eng.run()
+        return eng
+
+    def one_pass(eng, outputs=None):
+        steps0 = eng.steps
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            for p in prompts:
+                eng.submit(p, max_new_tokens=new_toks)
+                res = [int(t) for t in list(eng.run().values())[0]]
+                if outputs is not None:
+                    outputs.append(res)
+        return time.perf_counter() - t0, eng.steps - steps0
+
+    # both engines built and warmed BEFORE any timing, passes
+    # INTERLEAVED on/off/on/... with min-of-passes per mode — at
+    # ~1.5 ms/step on a shared CPU host the A/B would otherwise
+    # measure process warmup drift, not the ledger (the cache is
+    # inclusive, so every pass sees the same hit/spill/restore mix)
+    eng_on, eng_off = prepare(True), prepare(False)
+    out_on: list = []
+    out_off: list = []
+    walls = {True: [], False: []}
+    steps = 0
+    for p_idx in range(4):
+        for led, eng, sink in ((True, eng_on, out_on),
+                               (False, eng_off, out_off)):
+            w, steps = one_pass(
+                eng, sink if p_idx == 0 else None)
+            walls[led].append(w)
+
+    def mode_out(eng, wall_list) -> Dict:
+        wall = min(wall_list)
+        tl = eng.step_timeline()
+        out = {"wall_s": round(wall, 3), "steps": steps,
+               "ms_per_step": round(wall * 1e3 / max(1, steps), 4),
+               "occupancy_tail": [e.get("occupancy") for e in tl[-8:]],
+               "forecast": forecast_exhaustion(tl)}
+        if eng.ledger is not None:
+            st = eng.ledger.stats()
+            out["ledger_events_total"] = st["events_total"]
+            out["ledger_events_by_kind"] = st["by_kind"]
+            out["ledger_dropped"] = st["dropped_total"]
+            out["ledger_reconcile_ok"] = \
+                eng.ledger.reconcile(eng.allocator)["ok"]
+        eng.close()
+        return out
+
+    on = mode_out(eng_on, walls[True])
+    off = mode_out(eng_off, walls[False])
+    bit_identical = out_on == out_off
+    out: Dict = {"metric": "gpt1p3b_memory_observatory_ab_chip"
+                 if on_tpu else
+                 "gpt_tiny_memory_observatory_ab_cpu_smoke",
+                 "distinct_prefixes": n_prefix, "rounds": rounds,
+                 "num_pages": num_pages, "page_size": page,
+                 "bit_identical": bit_identical,
+                 "ledger_on": on, "ledger_off": off}
+    if off["ms_per_step"]:
+        out["ms_per_step_ratio"] = round(
+            on["ms_per_step"] / off["ms_per_step"], 4)
+    return out
+
+
 def bench_serving_goodput(on_tpu: bool) -> Dict:
     """serving_goodput (r16, ROADMAP item 3c): open-loop Poisson
     arrivals swept over request rates, reporting SLO-ATTAINMENT curves
@@ -2171,6 +2288,7 @@ def run_staged(on_tpu: bool) -> Dict:
                      ("prefix_tiers", bench_prefix_tiers),
                      ("serving_goodput", bench_serving_goodput),
                      ("fleet_goodput", bench_fleet_goodput),
+                     ("memory_observatory", bench_memory_observatory),
                      ("speculative_decode", bench_speculative_decode),
                      ("compile_cache", bench_compile_cache),
                      ("moe_dispatch", bench_moe_dispatch),

@@ -12,6 +12,8 @@ at load; zero-copy handles are device arrays.
 from .continuous_batching import (ContinuousBatchingEngine,  # noqa: F401
                                   DecodeRequest, PageAllocator,
                                   create_decode_engine)
+from .page_ledger import (PageLedger,  # noqa: F401 (r18 observatory)
+                          forecast_exhaustion)
 from .speculative import (CallableDraft, ModelDraft,  # noqa: F401
                           NGramDraft, SpeculativeConfig)
 from .fusion import fuse_conv_bn  # noqa: F401 (conv_bn_fuse_pass analog)

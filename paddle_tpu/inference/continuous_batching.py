@@ -98,13 +98,21 @@ class PageAllocator:
     only genuinely available pages. This is what lets a speculative
     slot grow its page set token-by-token and RETURN wholly-unused
     pages on rejection rollback while its future growth stays
-    deadlock-free (capacity was committed at admission)."""
+    deadlock-free (capacity was committed at admission).
 
-    def __init__(self, num_pages: int):
+    ``ledger`` (r18, inference/page_ledger.py): an optional PageLedger
+    that every successful mutation appends to — the memory-forensics
+    plane. With a ledger attached, ``check_no_leak`` failures dump the
+    dangling pages' ownership history instead of bare counts. None
+    (the default for direct construction) is byte-for-byte the
+    pre-r18 allocator."""
+
+    def __init__(self, num_pages: int, ledger=None):
         self.num_pages = num_pages
         self._free: List[int] = list(range(num_pages))
         self._owned: Dict[Hashable, List[int]] = {}
         self._reserved: Dict[Hashable, int] = {}
+        self.ledger = ledger
 
     @property
     def free_count(self) -> int:
@@ -124,6 +132,8 @@ class PageAllocator:
             return None
         pages = [self._free.pop() for _ in range(n)]
         self._owned.setdefault(owner, []).extend(pages)
+        if self.ledger is not None:
+            self.ledger.record("alloc", owner, pages)
         return pages
 
     def reserve(self, owner: Hashable, n: int) -> bool:
@@ -134,6 +144,8 @@ class PageAllocator:
             return False
         if n:
             self._reserved[owner] = self._reserved.get(owner, 0) + n
+            if self.ledger is not None:
+                self.ledger.record("reserve", owner, n=n)
         return True
 
     def reserved(self, owner: Hashable) -> int:
@@ -154,6 +166,8 @@ class PageAllocator:
             self._reserved.pop(owner, None)
         else:
             self._reserved[owner] = held - n
+        if self.ledger is not None and pages:
+            self.ledger.record("alloc_reserved", owner, pages)
         return pages
 
     def release_pages(self, owner: Hashable, pages: Sequence[int],
@@ -173,6 +187,9 @@ class PageAllocator:
         if rereserve and pages:
             self._reserved[owner] = (self._reserved.get(owner, 0) +
                                      len(pages))
+        if self.ledger is not None and pages:
+            self.ledger.record("release", owner, pages,
+                               rereserve=rereserve)
 
     def free(self, owner: Hashable) -> int:
         pages = self._owned.pop(owner, [])
@@ -180,7 +197,10 @@ class PageAllocator:
             if p in self._free:  # double free = scheduler bug
                 raise RuntimeError(f"page {p} double-freed")
         self._free.extend(pages)
-        self._reserved.pop(owner, None)
+        res_held = self._reserved.pop(owner, None) or 0
+        if self.ledger is not None and (pages or res_held):
+            self.ledger.record("free", owner, pages,
+                               reserved_freed=res_held)
         return len(pages)
 
     def transfer(self, owner: Hashable, new_owner: Hashable,
@@ -198,20 +218,64 @@ class PageAllocator:
         if not held:
             self._owned.pop(owner, None)
         self._owned.setdefault(new_owner, []).extend(pages)
+        if self.ledger is not None and pages:
+            self.ledger.record("transfer", owner, pages,
+                               new_owner=new_owner)
 
     def owners(self) -> Dict[Hashable, Tuple[int, ...]]:
         """Snapshot of live ownership (diagnostics / cache audits)."""
         return {k: tuple(v) for k, v in self._owned.items()}
 
+    def occupancy(self) -> Dict[str, int]:
+        """Pool breakdown by owner class (r18 capacity timeline):
+        ``inflight`` (request-owned) / ``prefix_device`` (prefix-cache
+        chains) / ``reserved`` (speculative capacity) / ``free``.
+        Sums to ``num_pages`` by construction — the invariant
+        tools/flight_inspect.py lints. Scrape/conn threads read this
+        while the engine thread mutates; retry the benign
+        dict-iteration race (the health-op discipline) — a class
+        count pinned between retries stays self-consistent because it
+        is recomputed whole."""
+        infl = pfx = reserved = 0
+        for attempt in range(3):
+            infl = pfx = reserved = 0
+            try:
+                for owner, pages in list(self._owned.items()):
+                    if isinstance(owner, tuple) and owner \
+                            and owner[0] == "prefix":
+                        pfx += len(pages)
+                    else:
+                        infl += len(pages)
+                # inside the retry: summing _reserved.values() races
+                # the same engine-thread mutations the _owned walk does
+                reserved = self.reserved_total
+                break
+            except RuntimeError:
+                continue
+        # free NORMALIZED from the other classes (not read separately):
+        # engine-thread reads are exact either way, and a scrape-side
+        # racy read then still satisfies sum-to-pool instead of
+        # presenting classes torn across two snapshots
+        free = max(0, self.num_pages - infl - pfx - reserved)
+        return {"inflight": infl, "prefix_device": pfx,
+                "reserved": reserved, "free": free}
+
     def check_no_leak(self) -> None:
         if self._owned or self._reserved or \
                 len(self._free) != self.num_pages:
-            raise RuntimeError(
+            msg = (
                 f"page leak: {sum(map(len, self._owned.values()))} owned "
                 f"by {sorted(self._owned, key=str)}, "
                 f"{self.reserved_total} reserved by "
                 f"{sorted(self._reserved, key=str)} with "
                 f"{len(self._free)}/{self.num_pages} free")
+            if self.ledger is not None:
+                # forensics, not counts (r18): each dangling page's
+                # retained ownership history — who alloc'd it, on
+                # which step, why, and every transfer since
+                msg += "\nledger forensics:\n" + self.ledger.forensics(
+                    self._owned, self._reserved)
+            raise RuntimeError(msg)
 
 
 @dataclasses.dataclass
@@ -248,6 +312,14 @@ class RequestStats:
     spec_steps: int = 0            # verify steps this request rode
     spec_drafted: int = 0          # draft tokens offered to verify
     spec_accepted: int = 0         # draft tokens accepted
+    # memory observatory (r18): per-request page attribution — the
+    # high-water mark of privately-owned pages (shared prefix pages
+    # are the cache's) and the time integral of pages held (page *
+    # seconds), maintained by the engine at admission, each step, and
+    # final free. The serving_request_peak_pages histogram aggregates
+    # the former.
+    peak_pages: int = 0
+    page_seconds: float = 0.0
 
     @property
     def acceptance_rate(self) -> Optional[float]:
@@ -368,7 +440,9 @@ class ContinuousBatchingEngine:
                  prefill_chunk_tokens: Optional[int] = None,
                  fused_step: bool = True,
                  tracer=None, timeline_steps: int = 256,
-                 capture_costs: bool = False):
+                 capture_costs: bool = False,
+                 page_ledger: bool = True,
+                 ledger_events: int = 1024):
         import jax.numpy as jnp
 
         from ..core.compile_cache import enable_compile_cache
@@ -445,7 +519,20 @@ class ContinuousBatchingEngine:
             prompt_buckets.append(self.max_seq_len)
         self.prompt_buckets = sorted(set(int(x) for x in prompt_buckets))
 
-        self.allocator = PageAllocator(self.num_pages)
+        # page ledger (r18, inference/page_ledger.py): every allocator
+        # mutation appended to a bounded ring with owner/step/reason —
+        # the memory-forensics plane. Default ON (host-side dict
+        # appends next to jit launches; the memory_observatory bench
+        # A/Bs it at ~1.0x ms/step); page_ledger=False is the
+        # byte-for-byte pre-r18 allocator.
+        if page_ledger:
+            from .page_ledger import PageLedger
+            self.ledger: Optional["PageLedger"] = PageLedger(
+                capacity=int(ledger_events))
+        else:
+            self.ledger = None
+        self.allocator = PageAllocator(self.num_pages,
+                                       ledger=self.ledger)
         self._scratch = self.num_pages  # reserved page index
         dt = functional_state(model)["params"]["gpt.wte.weight"].dtype
         nh, hd, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
@@ -877,14 +964,93 @@ class ContinuousBatchingEngine:
             tr.end(req.span, **args)
             req.span = None
 
+    # -- page ledger + per-request page attribution (r18) -------------------
+
+    def _led(self, reason: str, req_id: Optional[int] = None):
+        """Ledger reason context for a page-moving code path (no-op
+        null context with the ledger off)."""
+        if self.ledger is None:
+            return contextlib.nullcontext()
+        return self.ledger.why(reason, req_id)
+
+    def ledger_tail(self, n: int = 256) -> List[Dict[str, Any]]:
+        """The ledger ring's most recent events (flight bundles and
+        the server's ``capacity`` op); [] with the ledger off."""
+        return [] if self.ledger is None else self.ledger.tail(n)
+
+    def _account_req_pages(self, req: DecodeRequest,
+                           now: Optional[float] = None) -> None:
+        """Fold the request's CURRENT private page holding into its
+        peak-pages / page-seconds attribution. Called at admission,
+        once per engine step (_tl_commit), and right before the final
+        free, so one-step requests still record their peak."""
+        owned = len(self.allocator._owned.get(req.req_id, ()))
+        st = req.stats
+        if owned > st.peak_pages:
+            st.peak_pages = owned
+        now = time.monotonic() if now is None else now
+        last = getattr(req, "_pages_t", None)
+        if last is not None and owned:
+            st.page_seconds += owned * max(0.0, now - last)
+        req._pages_t = now
+
+    def capacity_snapshot(self) -> Dict[str, Any]:
+        """Point-in-time capacity card (the server's ``capacity`` op
+        and flight bundles): pool occupancy by owner class (sums to
+        num_pages), spill-tier residency, and ledger stats. Host-side
+        ints only — safe from any thread, like the health gauges."""
+        occ = self.allocator.occupancy()
+        out: Dict[str, Any] = {
+            "num_pages": int(self.num_pages),
+            "page_size": int(self.page_size),
+            "occupancy": occ,
+            "used_fraction": round(
+                1.0 - occ["free"] / self.num_pages, 4)
+            if self.num_pages else 0.0,
+            "steps": int(self.steps),
+        }
+        pc = self._prefix_cache
+        evictable = 0
+        if pc is not None:
+            # refcount-0 cache pages are reclaimed on demand at every
+            # admission (evict_until) — a warm inclusive cache
+            # legitimately fills the pool, so the PRESSURE-relevant
+            # figure is the unreclaimable remainder, not raw used
+            for _ in range(3):  # conn-thread read vs engine mutation
+                try:
+                    evictable = int(pc.evictable_pages())
+                    break
+                except RuntimeError:
+                    continue
+        out["evictable_pages"] = evictable
+        out["unreclaimable_pages"] = max(
+            0, self.num_pages - occ["free"] - evictable)
+        out["unreclaimable_fraction"] = round(
+            out["unreclaimable_pages"] / self.num_pages, 4) \
+            if self.num_pages else 0.0
+        if pc is not None and getattr(pc, "tiers", None):
+            for t in pc.tiers:
+                out[f"{t.name}_tier_pages"] = int(t.blob_count)
+                out[f"{t.name}_tier_bytes"] = int(t.occupancy_bytes)
+        if self.ledger is not None:
+            out["ledger"] = self.ledger.stats()
+        return out
+
     # -- step timeline + program cost capture (r16) -------------------------
 
     def _tl_commit(self, t_step: float) -> None:
         """Append one fixed-size step-timeline record (bounded ring)."""
+        now = time.monotonic()
+        # per-request page attribution (r18): one pass over the slots
+        # per STEP (never per token) keeps peak-pages/page-seconds
+        # current for long-running requests
+        for r in self._slots:
+            if r is not None:
+                self._account_req_pages(r, now)
         entry: Dict[str, Any] = {
             "step": self.steps,
             "t_us": t_step * 1e6,
-            "ms": round((time.monotonic() - t_step) * 1e3, 4),
+            "ms": round((now - t_step) * 1e3, 4),
             "programs": self._tl_programs,
             "slots_active": self.num_active,
             "slots_decoding": sum(
@@ -893,7 +1059,15 @@ class ContinuousBatchingEngine:
             "queued": len(self._queue),
             "free_pages": self.allocator.free_count,
             "reserved_pages": self.allocator.reserved_total,
+            # capacity timeline (r18): pool breakdown by owner class
+            # (inflight/prefix_device/reserved/free — sums to the pool
+            # size); the capacity op's forecast reads the free deltas
+            "occupancy": self.allocator.occupancy(),
         }
+        pc = self._prefix_cache
+        if pc is not None and getattr(pc, "tiers", None):
+            for t in pc.tiers:
+                entry[f"{t.name}_tier_pages"] = int(t.blob_count)
         for k, v in self._tl_ms.items():
             entry[k] = round(v, 4)
         self.timeline.append(entry)
@@ -931,6 +1105,8 @@ class ContinuousBatchingEngine:
             "mesh": self.mesh_info(),
             "programs_launched": dict(self.programs_launched),
             "step_programs": dict(self.step_programs),
+            "ledger_events": (None if self.ledger is None
+                              else int(self.ledger.seq)),
         }
 
     def _tl_add_ms(self, key: str, seconds: float) -> None:
@@ -1061,6 +1237,10 @@ class ContinuousBatchingEngine:
                 return k, v, ks, vs
 
             self._gather_jit = jax.jit(gather)
+        if self.ledger is not None:
+            # spill-side device IO: the page's KV is leaving the
+            # device for a spill tier (the cache decides which)
+            self.ledger.record("spill", None, pages=[int(page)])
         k, v, ks, vs = self._gather_jit(
             self._pools, jnp.asarray(page, jnp.int32))
         k, v = np.asarray(k), np.asarray(v)
@@ -1119,6 +1299,11 @@ class ContinuousBatchingEngine:
 
             self._splice_jit = jax.jit(splice, donate_argnums=(0,))
         from ..dispatch import count_op_calls
+        if self.ledger is not None:
+            # restore-side device IO: one batched splice writes the
+            # whole contiguous run (padding targets scratch, excluded)
+            self.ledger.record("splice", None,
+                               pages=[int(p) for p in pages])
         args = (self._pools, jnp.asarray(page_idx), k, v, ks, vs)
         t0 = time.monotonic()
         with count_op_calls() as c:
@@ -1334,9 +1519,10 @@ class ContinuousBatchingEngine:
         needs (its slot was never committed: lens/cur are still 0 and
         the _slots entry still None — re-clearing them is a no-op), so
         both leak-critical paths stay in sync by construction."""
-        self.allocator.free(req.req_id)
-        if self._prefix_cache is not None and req.cache_keys:
-            self._prefix_cache.release(req.cache_keys)
+        with self._led("prefill_unwind", req.req_id):
+            self.allocator.free(req.req_id)
+            if self._prefix_cache is not None and req.cache_keys:
+                self._prefix_cache.release(req.cache_keys)
         req.cache_keys = ()
         req.prefill_done_len = 0
         self._table[slot] = self._scratch
@@ -1508,14 +1694,22 @@ class ContinuousBatchingEngine:
         rejection-rollback machinery relies on), drop the prefix-cache
         pins, park the slot on the scratch page, and notify."""
         req = self._slots[slot]
-        self.allocator.free(req.req_id)
-        if self._prefix_cache is not None and req.cache_keys:
-            # for a half-prefilled slot these are the matched chain
-            # pins acquired at admission (insert() never ran); for a
-            # decoding slot, the full inserted chain — release() is
-            # the right unwind for both
-            self._prefix_cache.release(req.cache_keys)
-            req.cache_keys = ()
+        self._account_req_pages(req)
+        if self.ledger is not None and state in ("stalled", "deadline"):
+            # the stall/deadline unwind forensics (r18): snapshot the
+            # pages' event history BEFORE the free below rewrites it —
+            # the server's stall flight bundle and typed reply carry it
+            req.page_forensics = self.ledger.history_for_owner(
+                req.req_id)
+        with self._led(state, req.req_id):
+            self.allocator.free(req.req_id)
+            if self._prefix_cache is not None and req.cache_keys:
+                # for a half-prefilled slot these are the matched chain
+                # pins acquired at admission (insert() never ran); for a
+                # decoding slot, the full inserted chain — release() is
+                # the right unwind for both
+                self._prefix_cache.release(req.cache_keys)
+                req.cache_keys = ()
         req.prefill_done_len = 0
         req.state = state
         req.done = True
@@ -1706,8 +1900,12 @@ class ContinuousBatchingEngine:
                 # bit-identical either way.
                 rsp = (tr.begin("restore", parent=sp_admit)
                        if tr is not None else None)
-                rkeys, rpages, rinfo = cache.restore_from_spill(
-                    req.prompt, keys, self.allocator, memo=req)
+                with self._led("restore", req.req_id):
+                    rkeys, rpages, rinfo = cache.restore_from_spill(
+                        req.prompt, keys, self.allocator, memo=req)
+                if rkeys and self.ledger is not None:
+                    self.ledger.record("restore", req.req_id,
+                                       pages=rpages)
                 if tr is not None:
                     tr.end(rsp, pages=len(rkeys),
                            corrupt=rinfo.get("corrupt", 0))
@@ -1744,10 +1942,11 @@ class ContinuousBatchingEngine:
 
         from ..distributed.fault_inject import InjectedFault
         try:
-            pages = grab()
-            if pages is None and cache is not None:
-                if cache.evict_until(self.allocator, private_need):
-                    pages = grab()
+            with self._led("admit", req.req_id):
+                pages = grab()
+                if pages is None and cache is not None:
+                    if cache.evict_until(self.allocator, private_need):
+                        pages = grab()
         except InjectedFault:
             # armed alloc.page site: a transient allocation failure is
             # the same outcome as not fitting — unwind and requeue;
@@ -1763,6 +1962,9 @@ class ContinuousBatchingEngine:
             self._queue.insert(0, req)
             return False
         req.stats.admit_t = time.monotonic()
+        # page-attribution baseline (r18): peak starts at the admitted
+        # holding, page-seconds integrate from here
+        self._account_req_pages(req, req.stats.admit_t)
         if tr is not None:
             # the queue stage ends at the committed admission; the
             # scheduler's explain() (duck-typed) attributes WHY the
@@ -1872,10 +2074,18 @@ class ContinuousBatchingEngine:
             # for, but delivering a token past the deadline breaks the
             # contract — unwind the admission typed instead (pools were
             # adopted above, so device state stays coherent)
-            self.allocator.free(req.req_id)
-            if cache is not None:
-                cache.release(keys)
-                req.cache_keys = ()
+            self._account_req_pages(req, now)
+            if self.ledger is not None:
+                # same forensics contract as _evict_slot's deadline
+                # path: snapshot the page history BEFORE free rewrites
+                # it so the typed reply can carry it
+                req.page_forensics = self.ledger.history_for_owner(
+                    req.req_id)
+            with self._led("deadline", req.req_id):
+                self.allocator.free(req.req_id)
+                if cache is not None:
+                    cache.release(keys)
+                    req.cache_keys = ()
             self._table[slot] = self._scratch
             req.state = "deadline"
             req.done = True
@@ -2071,10 +2281,12 @@ class ContinuousBatchingEngine:
             req.stats.finish_t = time.monotonic()
             req.stats.tokens_out = len(req.generated)
             self._finished[req.req_id] = req
-            self.allocator.free(req.req_id)
-            if self._prefix_cache is not None and req.cache_keys:
-                self._prefix_cache.release(req.cache_keys)
-                req.cache_keys = ()
+            self._account_req_pages(req)
+            with self._led("done", req.req_id):
+                self.allocator.free(req.req_id)
+                if self._prefix_cache is not None and req.cache_keys:
+                    self._prefix_cache.release(req.cache_keys)
+                    req.cache_keys = ()
             self._table[slot] = self._scratch  # park on scratch page
             self._lens[slot] = 0
             self._cur[slot] = 0
@@ -2094,7 +2306,9 @@ class ContinuousBatchingEngine:
         missing = [j for j in range(want) if row[j] == self._scratch]
         if not missing:
             return
-        pages = self.allocator.alloc_reserved(req.req_id, len(missing))
+        with self._led("spec_grow", req.req_id):
+            pages = self.allocator.alloc_reserved(req.req_id,
+                                                  len(missing))
         for j, p in zip(missing, pages):
             row[j] = p
 
@@ -2114,8 +2328,9 @@ class ContinuousBatchingEngine:
         victims = [int(row[j]) for j in range(keep, self.max_pages)
                    if row[j] != self._scratch]
         if victims:
-            self.allocator.release_pages(req.req_id, victims,
-                                         rereserve=True)
+            with self._led("spec_rollback", req.req_id):
+                self.allocator.release_pages(req.req_id, victims,
+                                             rereserve=True)
             row[keep:] = self._scratch
         return len(victims)
 
@@ -2249,6 +2464,8 @@ class ContinuousBatchingEngine:
         # token — next to at least one jit launch)
         self._tl_programs = {}
         self._tl_ms = {}
+        if self.ledger is not None:
+            self.ledger.step = self.steps
         t_step = time.monotonic()
         try:
             return self._step_inner()
@@ -2378,7 +2595,8 @@ class ContinuousBatchingEngine:
         for req in list(self._queue):
             self._terminate_queued(req, "evicted")
         if self._prefix_cache is not None:
-            self._prefix_cache.clear(self.allocator)
+            with self._led("close"):
+                self._prefix_cache.clear(self.allocator)
         self.allocator.check_no_leak()
 
 

@@ -168,10 +168,14 @@ class PressureMonitor:
     - ``scale_up``   — SLO attainment (when targets are configured)
       below ``attain_low``, OR mean queued requests per live replica
       above ``queue_high``, OR prefill debt per replica above
-      ``debt_high`` tokens;
+      ``debt_high`` tokens, OR fleet page-pool utilization above
+      ``mem_high`` (the r18 memory input: a fleet meeting every
+      latency SLO still needs replicas BEFORE its KV pool exhausts —
+      the missing half of the 3(a) actuator contract);
     - ``scale_down`` — attainment at/above ``attain_high`` (or no
-      targets), near-empty queues (< ``queue_low``) AND slot
-      occupancy below ``occupancy_low``;
+      targets), near-empty queues (< ``queue_low``), slot occupancy
+      below ``occupancy_low``, AND memory comfortably below
+      ``mem_high``;
     - ``steady``     — anything else.
 
     The PUBLISHED verdict only flips after ``hysteresis`` consecutive
@@ -184,7 +188,8 @@ class PressureMonitor:
                  attain_high: float = 0.98,
                  queue_high: float = 4.0, queue_low: float = 0.5,
                  debt_high: float = 4096.0,
-                 occupancy_low: float = 0.25, hysteresis: int = 3):
+                 occupancy_low: float = 0.25, hysteresis: int = 3,
+                 mem_high: float = 0.92):
         self.attain_low = float(attain_low)
         self.attain_high = float(attain_high)
         self.queue_high = float(queue_high)
@@ -192,6 +197,7 @@ class PressureMonitor:
         self.debt_high = float(debt_high)
         self.occupancy_low = float(occupancy_low)
         self.hysteresis = max(1, int(hysteresis))
+        self.mem_high = float(mem_high)
         self.verdict = "steady"
         self._raw = "steady"
         self._streak = 0
@@ -199,15 +205,21 @@ class PressureMonitor:
     def _raw_verdict(self, attainment: Optional[float],
                      queued_per_replica: float,
                      debt_per_replica: float,
-                     occupancy: Optional[float]) -> str:
+                     occupancy: Optional[float],
+                     mem_utilization: Optional[float] = None) -> str:
         missed = attainment is not None and attainment < self.attain_low
-        if (missed or queued_per_replica > self.queue_high
+        mem_pressed = (mem_utilization is not None
+                       and mem_utilization > self.mem_high)
+        if (missed or mem_pressed
+                or queued_per_replica > self.queue_high
                 or debt_per_replica > self.debt_high):
             return "scale_up"
         attained = attainment is None or attainment >= self.attain_high
         idle = (queued_per_replica < self.queue_low
                 and (occupancy is None
-                     or occupancy < self.occupancy_low))
+                     or occupancy < self.occupancy_low)
+                and (mem_utilization is None
+                     or mem_utilization <= self.mem_high))
         if attained and idle:
             return "scale_down"
         return "steady"
@@ -215,9 +227,12 @@ class PressureMonitor:
     def evaluate(self, attainment: Optional[float],
                  queued_per_replica: float,
                  debt_per_replica: float,
-                 occupancy: Optional[float]) -> Dict[str, Any]:
+                 occupancy: Optional[float],
+                 mem_utilization: Optional[float] = None
+                 ) -> Dict[str, Any]:
         raw = self._raw_verdict(attainment, queued_per_replica,
-                                debt_per_replica, occupancy)
+                                debt_per_replica, occupancy,
+                                mem_utilization)
         if raw == self._raw:
             self._streak += 1
         else:
@@ -236,7 +251,10 @@ class PressureMonitor:
                            "debt_per_replica":
                                round(debt_per_replica, 1),
                            "occupancy": (None if occupancy is None
-                                         else round(occupancy, 3))}}
+                                         else round(occupancy, 3)),
+                           "mem_utilization": (
+                               None if mem_utilization is None
+                               else round(mem_utilization, 4))}}
 
 
 # ---------------------------------------------------------------------------
@@ -435,6 +453,19 @@ class FleetMetrics:
             n_fresh = len(fresh)
             slots = gauges.get("num_slots", 0.0)
             inflight = gauges.get("inflight_slots", 0.0)
+            # memory input (r18): fleet page-pool utilization from the
+            # scraped occupancy gauges (a ratio of sums across fresh
+            # replicas; per-replica detail lives in fleet_capacity).
+            # UNRECLAIMABLE pages when the replica exports them (raw
+            # used minus refcount-0 cache pages — a warm inclusive
+            # cache fills the pool by design and must not read as
+            # exhaustion); pages_used is the pre-refinement fallback.
+            pool = gauges.get("num_pages", 0.0)
+            used = gauges.get("pages_unreclaimable")
+            if used is None:
+                used = gauges.get("pages_used")
+            mem_util = ((used / pool)
+                        if pool and used is not None else None)
             slo = merged["slo"]
             pressure = self.pressure.evaluate(
                 att.get("all")
@@ -442,7 +473,8 @@ class FleetMetrics:
                     or slo.get("tpot_ms") is not None) else None,
                 gauges.get("queued_requests", 0.0) / n_fresh,
                 gauges.get("prefill_debt_tokens", 0.0) / n_fresh,
-                (inflight / slots) if slots else None)
+                (inflight / slots) if slots else None,
+                mem_utilization=mem_util)
             self._pressure_t = now
         elif self._last_eval is not None:
             pressure = self._last_eval["pressure"]

@@ -48,6 +48,11 @@ TOKENS_PER_STEP_BUCKETS = (1.0, 1.25, 1.5, 2.0, 2.5, 3.0, 4.0, 5.0,
 CHUNK_COUNT_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
                        24.0, 32.0, 48.0, 64.0)
 
+# memory observatory (r18): per-request peak private page holdings —
+# page-count scale (a 64-page request at page 64 is a 4k-token context)
+PAGE_COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                      256.0, 512.0)
+
 
 class Histogram:
     """Fixed-bucket latency histogram with quantiles over a bounded
@@ -403,6 +408,12 @@ class ServingMetrics:
         # the engine's ring-buffer deltas at scrape time (the server
         # tracks which steps it has already observed)
         self.step_ms = Histogram(f"{prefix}.step_ms")
+        # memory observatory (r18): per-request peak page attribution
+        # from the engine's ledger-era RequestStats (every terminal
+        # state that held pages contributes — an evicted request's
+        # footprint was still capacity spent)
+        self.request_peak_pages = Histogram(
+            f"{prefix}.request_peak_pages", buckets=PAGE_COUNT_BUCKETS)
 
     def counter(self, name: str):
         return self.registry.get(f"{self.prefix}.{name}")
@@ -427,6 +438,9 @@ class ServingMetrics:
             f"{self.prefix}.prefill_chunk_ms")
         self.restore_ms = Histogram(f"{self.prefix}.restore_ms")
         self.step_ms = Histogram(f"{self.prefix}.step_ms")
+        self.request_peak_pages = Histogram(
+            f"{self.prefix}.request_peak_pages",
+            buckets=PAGE_COUNT_BUCKETS)
 
     # -- ingestion ---------------------------------------------------------
 
@@ -472,6 +486,10 @@ class ServingMetrics:
                     st.restore_corrupt)
             if st.restore_ms:
                 self.restore_ms.observe(st.restore_ms)
+        if getattr(st, "peak_pages", 0):
+            # any terminal state: pages held by a later-evicted
+            # request were still pool capacity spent (r18)
+            self.request_peak_pages.observe(st.peak_pages)
         if req.state == "shed":
             self.counter("shed_total").add()
             return
@@ -555,6 +573,7 @@ class ServingMetrics:
             "prefill_chunk_ms": self.prefill_chunk_ms.snapshot(),
             "restore_ms": self.restore_ms.snapshot(),
             "step_ms": self.step_ms.snapshot(),
+            "request_peak_pages": self.request_peak_pages.snapshot(),
             # live SLO monitor (r17): targets + rolling attainment
             "slo": {"ttft_ms": self.slo.ttft_ms,
                     "tpot_ms": self.slo.tpot_ms,
@@ -573,7 +592,8 @@ class ServingMetrics:
                 "prefill_chunks": self.prefill_chunks,
                 "prefill_chunk_ms": self.prefill_chunk_ms,
                 "restore_ms": self.restore_ms,
-                "step_ms": self.step_ms}
+                "step_ms": self.step_ms,
+                "request_peak_pages": self.request_peak_pages}
 
     def export(self) -> Dict:
         """Fleet-telemetry wire form (r17): exact counters, sampled

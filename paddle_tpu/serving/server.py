@@ -22,8 +22,23 @@ Newline-JSON protocol (one JSON object per line, both directions):
                            # timeline (r16); {"format": "chrome"}
                            # returns chrome://tracing JSON mergeable
                            # with jax.profiler via tools/merge_traces
+    -> {"op": "capacity"}  # memory observatory (r18): pool occupancy
+                           # by owner class (inflight/prefix-device/
+                           # reserved/free, summing to the pool), spill-
+                           # tier residency, the page-ledger tail, and
+                           # an EWMA time-to-exhaustion forecast over
+                           # step-timeline ring deltas
+    -> {"op": "profile"}   # on-demand device profiling: live per-
+                           # device HBM accounting (device.memory_stats
+                           # where the backend provides it; chip-pending
+                           # gauges on CPU) and, with {"ms": N}, a
+                           # jax.profiler capture window server-side —
+                           # the engine keeps stepping, so the dump
+                           # holds real serving steps (merge with span
+                           # dumps via tools/merge_traces.py)
     -> {"op": "drain"}     # stop admitting, finish in-flight, close
     -> {"op": "leak_check"}  # engine-thread page-accounting audit
+                             # (+ page-ledger reconciliation, r18)
 
 End-to-end tracing (r16): ``--trace-sample R`` samples a fraction R of
 requests into per-request span trees (serving/tracing.py) covering
@@ -278,6 +293,8 @@ class ServingServer:
         # step-histogram scrape marker: (engine identity, last step
         # observed) — resurrection swaps the engine and resets it
         self._tl_seen: tuple = (None, -1)
+        # one jax.profiler capture at a time (r18 profile op)
+        self._profile_lock = threading.Lock()
         self.port: Optional[int] = None
 
     def _build_engine(self):
@@ -494,6 +511,13 @@ class ServingServer:
             reqs = (inflight if inflight is not None
                     else eng.dump_inflight())
             return {
+                # v2 bundles (r18) carry the page-ledger tail and a
+                # capacity snapshot; tools/flight_inspect.py requires
+                # and lints both at this version
+                "v": 2,
+                "page_ledger": getattr(eng, "ledger_tail",
+                                       lambda n: [])(256),
+                "capacity": self._capacity(),
                 "model": type(self._model).__name__,
                 "engine": getattr(eng, "flight_summary",
                                   lambda: {})(),
@@ -745,16 +769,25 @@ class ServingServer:
             msg = {"rid": req.req_id, "error": "DeadlineExceeded",
                    "reason": "deadline_ms elapsed before completion",
                    "tokens_out": int(req.stats.tokens_out)}
+            fors = getattr(req, "page_forensics", None)
+            if fors:
+                # memory observatory (r18): the unwound request's page
+                # ownership history rides the typed reply (bounded)
+                msg["page_forensics"] = fors[-8:]
         elif req.state == "stalled":
             # a stall is the third black-box trigger: something below
             # the engine stopped making progress without erroring —
             # the rate-limited bundle captures the step timeline that
             # explains the silence (r17)
-            self._flight_record("stall", stalled_rid=int(req.req_id))
+            fors = getattr(req, "page_forensics", None)
+            self._flight_record("stall", stalled_rid=int(req.req_id),
+                                page_forensics=fors or [])
             msg = {"rid": req.req_id, "error": "RequestStalled",
                    "reason": f"no token for "
                              f"{self.engine.stall_timeout_s}s; evicted",
                    "tokens_out": int(req.stats.tokens_out)}
+            if fors:
+                msg["page_forensics"] = fors[-8:]
         elif req.state == "shed":
             cfg = getattr(self.scheduler, "cfg", None)
             msg = {"rid": req.req_id, "error": "ServerOverloaded",
@@ -944,6 +977,17 @@ class ServingServer:
                       eng, "program_costs", lambda: {})(),
                   "sample_rate": self.tracer.sample_rate})
             return
+        if op == "capacity":
+            # memory observatory (r18): occupancy + forecast + ledger
+            # tail — the capacity/headroom signal the supervisor
+            # scrapes per probe cycle and the autoscaler actuator
+            # consumes (ROADMAP 3a, memory half)
+            send(self._capacity(
+                ledger_tail=msg.get("ledger_tail")))
+            return
+        if op == "profile":
+            send(self._profile(msg))
+            return
         if op == "drain":
             self.drain()
             send({"ok": True, "status": "draining"})
@@ -1132,6 +1176,30 @@ class ServingServer:
              # half-prefilled slots + the queue — the head-of-line
              # pressure a dashboard watches against TPOT
              "prefill_debt_tokens": eng.prefill_debt_tokens}
+        # memory observatory (r18): pool occupancy by owner class —
+        # the same breakdown the capacity op and the step-timeline
+        # ring carry, scraped into the fleet plane where the pressure
+        # verdict's memory input reads it (pages_used/num_pages)
+        occ = getattr(eng.allocator, "occupancy", lambda: None)()
+        if occ:
+            g["pages_inflight"] = occ["inflight"]
+            g["pages_prefix_device"] = occ["prefix_device"]
+            g["pages_used"] = eng.num_pages - occ["free"]
+            # the PRESSURE input: used minus reclaimable-on-demand
+            # (refcount-0 cache pages) — a warm inclusive cache fills
+            # the pool by design and must not read as exhaustion
+            evictable = 0
+            if pc is not None:
+                try:
+                    evictable = int(pc.evictable_pages())
+                except RuntimeError:
+                    pass  # racy read: skip this scrape's refinement
+            g["pages_unreclaimable"] = max(
+                0, eng.num_pages - occ["free"] - evictable)
+        led = getattr(eng, "ledger", None)
+        if led is not None:
+            g["ledger_events"] = led.seq
+            g["ledger_dropped"] = led.dropped_total
         # hierarchical prefix cache (r15): per-tier occupancy so a
         # dashboard sees how much evicted KV is restorable (bytes and
         # blob counts per spill tier)
@@ -1214,8 +1282,14 @@ class ServingServer:
     def _leak_check(self) -> Dict:
         """Engine-thread page audit: with no in-flight work, the
         allocator must balance (cache-less: everything free; cached:
-        free + cache-owned == pool, no other owners)."""
+        free + cache-owned == pool, no other owners). The reply also
+        carries the page-ledger RECONCILIATION (r18): the event-derived
+        ownership shadow must match the allocator's books exactly —
+        the chaos harness's invariant 5."""
         eng = self.engine
+        led = getattr(eng, "ledger", None)
+        ledger_info = ({"ok": True, "enabled": False} if led is None
+                       else led.reconcile(eng.allocator))
         if eng.num_active or eng.num_queued:
             return {"ok": False, "busy": True,
                     "active": eng.num_active, "queued": eng.num_queued}
@@ -1226,13 +1300,92 @@ class ServingServer:
                 eng.allocator.check_no_leak()
         except Exception as e:
             return {"ok": False, "busy": False,
-                    "error": type(e).__name__, "reason": str(e)}
+                    "error": type(e).__name__, "reason": str(e),
+                    "ledger": ledger_info}
         return {"ok": True, "busy": False,
                 "free_pages": eng.free_pages,
                 "reserved_pages": eng.allocator.reserved_total,
                 "cached_pages": (self.prefix_cache.total_pages()
                                  if self.prefix_cache is not None else 0),
-                "num_pages": eng.num_pages}
+                "num_pages": eng.num_pages,
+                "ledger": ledger_info}
+
+    def _capacity(self, ledger_tail=None) -> Dict:
+        """The ``capacity`` op payload: the engine's occupancy card,
+        an EWMA exhaustion forecast over step-timeline ring deltas,
+        and (on request) the ledger ring tail. Conn-thread reads of
+        host ints/dicts — the same benign-race contract as health."""
+        from ..inference.page_ledger import forecast_exhaustion
+        eng = self.engine
+        snap = getattr(eng, "capacity_snapshot", lambda: {})()
+        snap["forecast"] = forecast_exhaustion(
+            getattr(eng, "step_timeline", lambda: [])())
+        n = ledger_tail
+        if isinstance(n, int) and not isinstance(n, bool) and n > 0:
+            snap["ledger_tail"] = getattr(
+                eng, "ledger_tail", lambda _n: [])(n)
+        snap["engine_restarts"] = self._restarts
+        return snap
+
+    def _profile(self, msg: Dict) -> Dict:
+        """The ``profile`` op (r18): live per-device HBM accounting
+        plus an optional ``jax.profiler`` device capture window. The
+        capture runs on THIS connection thread while the engine thread
+        keeps stepping, so the dump holds real serving programs (the
+        jit bodies' pt.* named_scopes); ``{"ms": N, "dir": PATH}``
+        captures N ms into PATH (tensorboard layout — the
+        *.trace.json.gz inside merges with span dumps via
+        tools/merge_traces.py). One capture at a time: a concurrent
+        request gets a typed ProfileBusy, never a corrupted trace."""
+        import jax
+        out: Dict[str, Any] = {"devices": [], "chip_pending": True}
+        for d in jax.devices():
+            stats = None
+            fn = getattr(d, "memory_stats", None)
+            if callable(fn):
+                try:
+                    raw = fn()
+                    if raw:
+                        stats = {str(k): int(v)
+                                 for k, v in raw.items()
+                                 if isinstance(v, (int, float))}
+                except Exception:
+                    stats = None
+            if stats:
+                # a backend that accounts HBM makes the gauges real;
+                # CPU reports none — the numbers stay chip-pending
+                out["chip_pending"] = False
+            out["devices"].append({"id": int(d.id),
+                                   "platform": str(d.platform),
+                                   "memory_stats": stats})
+        ms = msg.get("ms")
+        if ms is not None:
+            if isinstance(ms, bool) or not isinstance(ms, (int, float)) \
+                    or ms <= 0 or ms > 30_000:
+                return {"error": "BadRequest",
+                        "reason": "ms must be a capture window in "
+                                  "(0, 30000] milliseconds"}
+            if not self._profile_lock.acquire(blocking=False):
+                return {"error": "ProfileBusy",
+                        "reason": "a profiler capture is already "
+                                  "running; retry after it finishes"}
+            try:
+                import tempfile
+                trace_dir = msg.get("dir") or tempfile.mkdtemp(
+                    prefix="pt-profile-")
+                jax.profiler.start_trace(trace_dir)
+                try:
+                    time.sleep(float(ms) / 1e3)
+                finally:
+                    jax.profiler.stop_trace()
+                out["trace_dir"] = trace_dir
+                out["ms"] = float(ms)
+            except Exception as e:
+                return {"error": "ProfileFailed",
+                        "reason": f"{type(e).__name__}: {e}"}
+            finally:
+                self._profile_lock.release()
+        return out
 
     def _cache_stats(self) -> Optional[Dict]:
         pc = self.prefix_cache
@@ -1404,6 +1557,13 @@ def main(argv=None) -> None:
         "--flight-budget-mb", type=int, default=64, metavar="MB",
         help="retention byte budget of --flight-dir (oldest bundles "
              "pruned first, the newest always kept; default 64)")
+    parser.add_argument(
+        "--no-page-ledger", action="store_true",
+        help="disable the page ledger (r18: every page event appended "
+             "to a bounded ring with owner/step/reason — leak "
+             "forensics, ledger reconciliation, capacity-op event "
+             "tail). On by default at ~1.0x ms/step; greedy outputs "
+             "are bit-identical either way")
     args = parser.parse_args(argv)
 
     model = _build_model(args.model)
@@ -1428,6 +1588,8 @@ def main(argv=None) -> None:
         # rides in engine_kwargs, so a resurrected engine honors the
         # escape hatch too (fused is the engine default)
         engine_kwargs["fused_step"] = False
+    if args.no_page_ledger:
+        engine_kwargs["page_ledger"] = False
     mesh_desc = "single-device"
     if args.mesh is not None:
         from ..distributed.topology import (make_serving_mesh,

@@ -140,6 +140,12 @@ class Replica:
         self.prefix_keys: frozenset = frozenset()
         self.page_size: Optional[int] = None
         self.load: int = 0
+        # memory observatory (r18): the replica's latest capacity-op
+        # reply (occupancy by owner class + exhaustion forecast),
+        # refreshed each healthy probe cycle — fleet_capacity merges
+        # the fresh ones
+        self.capacity: Optional[Dict] = None
+        self.capacity_t: float = 0.0
 
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
@@ -346,6 +352,7 @@ class Supervisor:
                     rep.probe_failures = 0
                     rep.consec_deaths = 0
                     self._scrape_metrics(rep)
+                    self._scrape_capacity(rep)
                     # cache-affinity advertisement (r15): best-effort —
                     # an old server build without these fields just
                     # leaves the replica unadvertised (RR/least-loaded
@@ -409,6 +416,67 @@ class Supervisor:
             self.fleet.ingest(rep.idx, export)
         except Exception:
             self.fleet.mark_stale(rep.idx)
+
+    def _scrape_capacity(self, rep: Replica) -> None:
+        """Memory observatory (r18): pull the replica's ``capacity``
+        op (occupancy by owner class + exhaustion forecast) each
+        healthy probe cycle. Advisory — a failed scrape just leaves
+        the last snapshot to age out of ``fleet_capacity`` rollups."""
+        if not self.collect_metrics:
+            return
+        try:
+            reply = _rpc(self.host, rep.port, {"op": "capacity"},
+                         timeout_s=self.probe_timeout_s)
+            if not isinstance(reply.get("num_pages"), int):
+                raise ValueError("capacity op returned no pool size")
+            rep.capacity = reply
+            rep.capacity_t = time.monotonic()
+        except Exception:
+            pass
+
+    def fleet_capacity(self) -> Dict:
+        """The ``fleet_capacity`` payload (r18): per-replica occupancy
+        merged into one fleet view — summed owner-class page counts,
+        the fleet used-fraction, and the most urgent (minimum)
+        time-to-exhaustion forecast across replicas. Stale snapshots
+        (older than 4 probe intervals, min 10 s — the collector's
+        freshness rule) are reported but excluded from the rollup."""
+        now = time.monotonic()
+        stale_after = max(10.0, 4 * self.probe_interval_s)
+        totals: Dict[str, int] = {}
+        num_pages = 0
+        fresh = 0
+        ttes: List[float] = []
+        per: Dict[str, Dict] = {}
+        for r in self.replicas:
+            cap = r.capacity
+            is_fresh = (cap is not None and r.ready
+                        and now - r.capacity_t <= stale_after)
+            per[str(r.idx)] = {
+                "fresh": is_fresh,
+                "age_s": (round(now - r.capacity_t, 3)
+                          if cap is not None else None),
+                "capacity": cap}
+            if not is_fresh:
+                continue
+            fresh += 1
+            num_pages += int(cap.get("num_pages") or 0)
+            for k, v in (cap.get("occupancy") or {}).items():
+                totals[k] = totals.get(k, 0) + int(v)
+            tte = (cap.get("forecast") or {}).get("tte_s")
+            if isinstance(tte, (int, float)):
+                ttes.append(float(tte))
+        return {"replicas_fresh": fresh,
+                "replicas_known": len(self.replicas),
+                "num_pages": num_pages,
+                "occupancy": totals,
+                "used_fraction": (
+                    round(1.0 - totals.get("free", 0) / num_pages, 4)
+                    if num_pages else None),
+                # the fleet exhausts when its FIRST replica does: a
+                # router can't split one request across pools
+                "tte_s": (round(min(ttes), 3) if ttes else None),
+                "per_replica": per}
 
     def fleet_stats(self) -> Dict:
         """The ``fleet_stats`` payload (r17): the collector's merged
@@ -672,6 +740,19 @@ class FailoverRouter:
                 "deprioritize_outliers": self.deprioritize_outliers,
             }
             send({"fleet": stats})
+            return
+        if op == "fleet_capacity":
+            # memory observatory (r18): merged per-replica occupancy +
+            # the fleet's nearest time-to-exhaustion — the capacity
+            # half of the autoscaler input contract (3a). Duck-typed
+            # like fleet_stats.
+            fc = getattr(self.sup, "fleet_capacity", None)
+            if fc is None:
+                send({"error": "FleetCapacityUnavailable",
+                      "reason": "supervisor has no capacity "
+                                "collector"})
+                return
+            send({"capacity": fc()})
             return
         if op == "fleet_metrics":
             # fleet Prometheus exposition: per-replica series carry a

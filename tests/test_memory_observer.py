@@ -1,0 +1,676 @@
+"""Memory observatory (r18): page-ledger forensics, the capacity
+timeline + exhaustion forecast, on-demand profiling, and the fleet
+capacity surface.
+
+Contracts pinned (ISSUE r18 acceptance):
+
+- greedy outputs are BIT-IDENTICAL page ledger on/off;
+- a forced dangling page makes ``check_no_leak`` dump a forensic
+  history naming the owner chain and last event (not just a count);
+- the ledger ring is bounded and the exhaustion-forecast math is unit
+  tested against synthetic timelines;
+- the step timeline's occupancy classes sum to the pool size;
+- ``fleet_capacity`` merges per-replica occupancy, and the
+  ``PressureMonitor`` flips on memory pressure ALONE (SLO attainment
+  healthy);
+- flight bundles (v2) carry the ledger tail + a capacity snapshot and
+  lint clean through tools/flight_inspect.py.
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.monitor import StatRegistry
+from paddle_tpu.distributed import fault_inject as fi
+from paddle_tpu.inference import create_decode_engine
+from paddle_tpu.inference.continuous_batching import PageAllocator
+from paddle_tpu.inference.page_ledger import (PageLedger,
+                                              forecast_exhaustion)
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+from paddle_tpu.serving import PrefixCache, ServingMetrics
+from paddle_tpu.serving.fleet_metrics import (FleetMetrics,
+                                              PressureMonitor)
+from paddle_tpu.serving.server import ServingServer, client_request
+from paddle_tpu.serving.supervisor import FailoverRouter, Supervisor
+
+_FI_PATH = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "flight_inspect.py")
+_spec = importlib.util.spec_from_file_location("flight_inspect",
+                                               _FI_PATH)
+flight_inspect = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(flight_inspect)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _compile_cache(module_compile_cache):
+    """Engine-heavy file: reuse XLA compiles across tests."""
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+ENGINE_KW = dict(num_slots=2, page_size=8, max_seq_len=96, num_pages=24)
+
+
+def _engine(m, **kw):
+    merged = dict(ENGINE_KW)
+    merged.update(kw)
+    return create_decode_engine(m, **merged)
+
+
+def _server(m, **kw):
+    merged = dict(ENGINE_KW)
+    merged.update(kw)
+    merged.setdefault("metrics",
+                      ServingMetrics(registry=StatRegistry()))
+    return ServingServer(m, **merged)
+
+
+# ---------------------------------------------------------------------------
+# PageLedger unit semantics (no model)
+# ---------------------------------------------------------------------------
+
+class TestPageLedgerUnit:
+    def test_ring_is_bounded_and_drops_counted(self):
+        led = PageLedger(capacity=4)
+        for i in range(10):
+            led.record("alloc", i, pages=[i])
+        assert len(led.ring) == 4
+        assert led.seq == 10
+        assert led.dropped_total == 6
+        assert [r["owner"] for r in led.tail(2)] == [8, 9]
+
+    def test_page_history_is_bounded(self):
+        led = PageLedger(capacity=64, page_history=3)
+        for i in range(6):
+            led.record("alloc", i, pages=[7])
+        hist = led.history(7)
+        assert len(hist) == 3
+        assert hist[-1]["owner"] == 5
+
+    def test_why_threads_reason_and_request(self):
+        led = PageLedger()
+        with led.why("admit", req_id=12):
+            led.record("alloc", 12, pages=[0])
+        led.record("alloc", 13, pages=[1])
+        a, b = led.tail(2)
+        assert a["reason"] == "admit" and a["req"] == 12
+        assert "reason" not in b
+
+    def test_live_shadow_tracks_full_allocator_lifecycle(self):
+        led = PageLedger()
+        alloc = PageAllocator(8, ledger=led)
+        pages = alloc.alloc("r1", 2)
+        assert alloc.reserve("r1", 3)
+        got = alloc.alloc_reserved("r1", 2)
+        alloc.release_pages("r1", got[:1], rereserve=True)
+        alloc.transfer("r1", ("prefix", b"k"), pages[:1])
+        rec = led.reconcile(alloc)
+        assert rec["ok"], rec
+        alloc.free("r1")
+        alloc.free(("prefix", b"k"))
+        rec = led.reconcile(alloc)
+        assert rec["ok"] and rec["live_owners"] == 0
+        alloc.check_no_leak()
+
+    def test_reconcile_catches_out_of_band_moves(self):
+        led = PageLedger()
+        alloc = PageAllocator(4, ledger=led)
+        alloc.alloc("r1", 2)
+        # a page moved BEHIND the ledger's back (the bug class
+        # reconciliation exists for)
+        alloc._owned["r1"].pop()
+        rec = led.reconcile(alloc)
+        assert not rec["ok"]
+        assert any("r1" in m for m in rec["mismatches"])
+
+    def test_events_are_json_safe(self):
+        led = PageLedger()
+        alloc = PageAllocator(4, ledger=led)
+        alloc.alloc(("prefix", b"\x01\x02"), 1)
+        json.dumps(led.tail(8))  # must not raise
+
+    def test_stats_shape(self):
+        led = PageLedger(capacity=16)
+        led.record("alloc", 1, pages=[0])
+        st = led.stats()
+        assert st["events_total"] == 1
+        assert st["by_kind"] == {"alloc": 1}
+        assert st["capacity"] == 16
+
+
+# ---------------------------------------------------------------------------
+# Exhaustion-forecast math (synthetic timelines)
+# ---------------------------------------------------------------------------
+
+class TestForecastMath:
+    @staticmethod
+    def _entries(frees, dt_s=1.0):
+        return [{"t_us": i * dt_s * 1e6, "free_pages": f}
+                for i, f in enumerate(frees)]
+
+    def test_steady_consumption_projects_tte(self):
+        # 2 pages consumed per second, 10 left -> ~5 s to exhaustion
+        fc = forecast_exhaustion(self._entries([20, 18, 16, 14, 12, 10]))
+        assert fc["samples"] == 5
+        assert fc["rate_pages_per_s"] == pytest.approx(2.0)
+        assert fc["tte_s"] == pytest.approx(5.0)
+
+    def test_freeing_or_steady_never_exhausts(self):
+        assert forecast_exhaustion(
+            self._entries([4, 8, 12]))["tte_s"] is None
+        assert forecast_exhaustion(
+            self._entries([8, 8, 8]))["tte_s"] is None
+
+    def test_too_few_entries(self):
+        assert forecast_exhaustion([])["samples"] == 0
+        assert forecast_exhaustion(
+            self._entries([5]))["samples"] == 0
+        assert forecast_exhaustion([])["tte_s"] is None
+
+    def test_ewma_weights_recent_rate(self):
+        # an old burn rate followed by a calm tail: the EWMA must sit
+        # closer to the recent (zero) rate than the historic one
+        fc = forecast_exhaustion(
+            self._entries([40, 30, 20, 20, 20, 20, 20, 20]))
+        assert fc["rate_pages_per_s"] < 5.0
+
+    def test_malformed_entries_skipped(self):
+        fc = forecast_exhaustion([{"free_pages": 4},
+                                  {"t_us": 1.0},
+                                  {"t_us": 0.0, "free_pages": 8},
+                                  {"t_us": 1e6, "free_pages": 6}])
+        assert fc["samples"] == 1
+        assert fc["rate_pages_per_s"] == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# Forced-leak forensics
+# ---------------------------------------------------------------------------
+
+class TestForcedLeakForensics:
+    def test_dangling_page_dump_names_owner_and_history(self):
+        led = PageLedger()
+        alloc = PageAllocator(4, ledger=led)
+        with led.why("admit", req_id=7):
+            pages = alloc.alloc(7, 1)
+        alloc.transfer(7, ("prefix", b"k"), pages)
+        with pytest.raises(RuntimeError) as ei:
+            alloc.check_no_leak()
+        msg = str(ei.value)
+        assert "ledger forensics" in msg
+        # the owner CHAIN: alloc'd by request 7 during admit, then
+        # transferred to the prefix cache — both named
+        assert "alloc owner=7 (admit)" in msg
+        assert "transfer owner=7" in msg and "prefix" in msg
+
+    def test_engine_close_dumps_strand_forensics(self, model):
+        eng = _engine(model)
+        eng.submit(np.arange(1, 7, dtype=np.int32), 3)
+        eng.run()
+        # strand one page behind the engine's back (a simulated buggy
+        # owner) — close() must FAIL with the forensic dump
+        eng.allocator.alloc("bug-owner", 1)
+        with pytest.raises(RuntimeError) as ei:
+            eng.close()
+        msg = str(ei.value)
+        assert "bug-owner" in msg and "ledger forensics" in msg
+        assert "alloc" in msg
+
+    def test_fault_driven_unwind_is_ledgered_and_leak_free(self, model):
+        """The existing serving.prefill fault site: a persistent fault
+        FAILs the request typed — and every page event of the unwind
+        lands in the ledger with the prefill_unwind reason, reconciling
+        clean (faults never strand pages; the ledger proves it)."""
+        eng = _engine(model, max_prefill_attempts=2, prefill_retry=None)
+        fi.get_injector().arm("serving.prefill", probability=1.0,
+                              max_faults=100, seed=0)
+        eng.submit(np.arange(1, 9, dtype=np.int32), 3)
+        for _ in range(4):
+            try:
+                eng.step()
+            except fi.InjectedFault:
+                continue
+        fi.reset()
+        reasons = {r.get("reason") for r in eng.ledger.tail(64)}
+        assert "prefill_unwind" in reasons
+        assert eng.ledger.reconcile(eng.allocator)["ok"]
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: bit-identity, occupancy timeline, attribution
+# ---------------------------------------------------------------------------
+
+class TestEngineLedger:
+    def test_bit_identical_ledger_on_off(self, model):
+        prompts = [np.arange(1, 7, dtype=np.int32),
+                   np.arange(3, 18, dtype=np.int32),
+                   np.arange(2, 11, dtype=np.int32)]
+
+        def run(ledger):
+            eng = _engine(model, prefix_cache=PrefixCache(8),
+                          page_ledger=ledger)
+            for p in prompts:
+                eng.submit(p, 6)
+            out = eng.run()
+            eng.close()
+            return {k: [int(t) for t in v] for k, v in out.items()}
+
+        assert run(True) == run(False)
+
+    def test_timeline_occupancy_sums_to_pool(self, model):
+        eng = _engine(model, prefix_cache=PrefixCache(8))
+        for _ in range(2):
+            eng.submit(np.arange(1, 10, dtype=np.int32), 5)
+        eng.run()
+        tl = eng.step_timeline()
+        assert tl
+        for e in tl:
+            occ = e["occupancy"]
+            assert sum(occ[c] for c in ("inflight", "prefix_device",
+                                        "reserved", "free")) == \
+                eng.num_pages, e
+        # mid-run entries must actually attribute pages to classes
+        assert any(e["occupancy"]["inflight"] > 0 for e in tl)
+        assert any(e["occupancy"]["prefix_device"] > 0 for e in tl)
+        eng.close()
+
+    def test_capacity_snapshot_shape(self, model):
+        eng = _engine(model, prefix_cache=PrefixCache(
+            8, spill_bytes=1 << 20))
+        eng.submit(np.arange(1, 10, dtype=np.int32), 3)
+        eng.run()
+        snap = eng.capacity_snapshot()
+        assert snap["num_pages"] == eng.num_pages
+        occ = snap["occupancy"]
+        assert sum(occ[c] for c in ("inflight", "prefix_device",
+                                    "reserved", "free")) == \
+            eng.num_pages
+        assert "host_tier_pages" in snap
+        assert snap["ledger"]["events_total"] > 0
+        eng.close()
+
+    def test_request_peak_pages_and_page_seconds(self, model):
+        eng = _engine(model)
+        done = []
+        eng.set_on_complete(lambda r: done.append(r))
+        eng.submit(np.arange(1, 12, dtype=np.int32), 6)
+        eng.run()
+        st = done[0].stats
+        # 11 prompt + 6 new tokens over 8-token pages -> 3 pages bound
+        assert st.peak_pages == 3
+        assert st.page_seconds > 0.0
+        d = st.to_dict()
+        assert d["peak_pages"] == 3 and d["page_seconds"] > 0.0
+        eng.close()
+
+    def test_spec_reservation_events_reconcile(self, model):
+        from paddle_tpu.inference import SpeculativeConfig
+        eng = _engine(model,
+                      speculative=SpeculativeConfig(k=2, draft="ngram"))
+        eng.submit(np.asarray([1, 2, 3, 1, 2, 3, 1], np.int32), 6)
+        eng.run()
+        kinds = eng.ledger.stats()["by_kind"]
+        assert kinds.get("reserve", 0) > 0
+        assert kinds.get("alloc_reserved", 0) > 0
+        assert eng.ledger.reconcile(eng.allocator)["ok"]
+        eng.close()
+
+    def test_deadline_unwind_attaches_page_forensics(self, model):
+        eng = _engine(model, page_ledger=True)
+        done = []
+        eng.set_on_complete(lambda r: done.append(r))
+        eng.submit(np.arange(1, 12, dtype=np.int32), 64)
+        eng.step()  # admit + prefill + first decode (pages held)
+        req = next(r for r in eng._slots if r is not None)
+        # expire the LIVE slot deterministically (a wall-clock budget
+        # races the first compile: queued expiry takes the
+        # no-forensics path by design)
+        req.deadline_t = time.monotonic() - 1.0
+        eng.step()
+        assert done and done[0].state == "deadline"
+        fors = getattr(done[0], "page_forensics", None)
+        assert fors, "deadline eviction must attach page forensics"
+        assert any(ev["ev"] == "alloc" for ev in fors)
+        assert eng.ledger.reconcile(eng.allocator)["ok"]
+        eng.close()
+
+    def test_ledger_off_engine_has_no_ledger(self, model):
+        eng = _engine(model, page_ledger=False)
+        assert eng.ledger is None
+        assert eng.ledger_tail(8) == []
+        eng.submit(np.arange(1, 5, dtype=np.int32), 2)
+        eng.run()
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Server surface: capacity / profile ops, leak_check reconciliation
+# ---------------------------------------------------------------------------
+
+class TestServerSurface:
+    def test_capacity_op_occupancy_forecast_and_tail(self, model):
+        srv = _server(model)
+        port = srv.start()
+        client_request("127.0.0.1", port,
+                       {"op": "generate", "prompt": [1, 2, 3, 4],
+                        "max_new_tokens": 3})
+        cap = client_request("127.0.0.1", port,
+                             {"op": "capacity", "ledger_tail": 8})
+        srv.stop()
+        occ = cap["occupancy"]
+        assert sum(occ[c] for c in ("inflight", "prefix_device",
+                                    "reserved", "free")) == \
+            cap["num_pages"]
+        assert "forecast" in cap and "tte_s" in cap["forecast"]
+        assert cap["ledger_tail"], "requested tail must be present"
+        assert all("seq" in e and "ev" in e
+                   for e in cap["ledger_tail"])
+
+    def test_leak_check_carries_ledger_reconcile(self, model):
+        srv = _server(model)
+        port = srv.start()
+        client_request("127.0.0.1", port,
+                       {"op": "generate", "prompt": [1, 2, 3],
+                        "max_new_tokens": 2})
+        lc = client_request("127.0.0.1", port, {"op": "leak_check"})
+        srv.stop()
+        assert lc["ok"]
+        assert lc["ledger"]["enabled"] and lc["ledger"]["ok"]
+
+    def test_profile_op_memory_stats_cpu_chip_pending(self, model):
+        srv = _server(model)
+        port = srv.start()
+        prof = client_request("127.0.0.1", port, {"op": "profile"})
+        srv.stop()
+        assert prof["devices"], "must report every jax device"
+        for d in prof["devices"]:
+            assert {"id", "platform", "memory_stats"} <= set(d)
+        # the CPU lane has no HBM accounting: gauges stay chip-pending
+        if all(d["platform"] == "cpu" for d in prof["devices"]):
+            assert prof["chip_pending"] is True
+
+    def test_profile_op_capture_window_merges(self, model, tmp_path):
+        srv = _server(model)
+        port = srv.start()
+        prof = client_request(
+            "127.0.0.1", port,
+            {"op": "profile", "ms": 40, "dir": str(tmp_path)},
+            timeout_s=120)
+        bad = client_request("127.0.0.1", port,
+                             {"op": "profile", "ms": -1})
+        srv.stop()
+        if prof.get("error") == "ProfileFailed":
+            pytest.skip(f"jax.profiler unavailable: {prof['reason']}")
+        assert prof["trace_dir"] == str(tmp_path)
+        # the capture is mergeable with span dumps: merge_traces loads
+        # the dir (tensorboard layout, *.trace.json.gz) directly
+        import importlib.util as _ilu
+        mt_path = os.path.join(os.path.dirname(__file__), "..",
+                               "tools", "merge_traces.py")
+        spec = _ilu.spec_from_file_location("merge_traces", mt_path)
+        merge_traces = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(merge_traces)
+        events = merge_traces.load_trace(str(tmp_path))
+        assert isinstance(events, list) and events
+        assert bad["error"] == "BadRequest"
+
+    def test_gauges_carry_occupancy_and_ledger(self, model):
+        srv = _server(model)
+        port = srv.start()
+        client_request("127.0.0.1", port,
+                       {"op": "generate", "prompt": [1, 2, 3],
+                        "max_new_tokens": 2})
+        text = client_request("127.0.0.1", port,
+                              {"op": "metrics"})["text"]
+        srv.stop()
+        for fam in ("serving_pages_inflight", "serving_pages_used",
+                    "serving_pages_prefix_device",
+                    "serving_ledger_events"):
+            assert fam in text, fam
+        assert "serving_request_peak_pages_bucket" in text
+
+
+# ---------------------------------------------------------------------------
+# Fleet capacity + pressure memory input
+# ---------------------------------------------------------------------------
+
+def _cap(num_pages=24, free=4, inflight=16, pfx=4, tte=12.0):
+    return {"num_pages": num_pages,
+            "occupancy": {"inflight": inflight, "prefix_device": pfx,
+                          "reserved": 0, "free": free},
+            "used_fraction": 1.0 - free / num_pages,
+            "forecast": {"tte_s": tte, "rate_pages_per_s": 1.0,
+                         "samples": 4}}
+
+
+class TestFleetCapacity:
+    def _sup(self):
+        sup = Supervisor(model="gpt_tiny", replicas=2)
+        now = time.monotonic()
+        for i, rep in enumerate(sup.replicas):
+            rep.ready = True
+            rep.capacity = _cap(free=4 - 2 * i, inflight=16 + 2 * i,
+                                tte=12.0 + 5 * i)
+            rep.capacity_t = now
+        return sup
+
+    def test_fleet_capacity_merges_occupancy(self):
+        fc = self._sup().fleet_capacity()
+        assert fc["replicas_fresh"] == 2
+        assert fc["num_pages"] == 48
+        occ = fc["occupancy"]
+        assert occ["inflight"] == 34 and occ["free"] == 6
+        assert sum(occ[c] for c in ("inflight", "prefix_device",
+                                    "reserved", "free")) == 48
+        # the fleet exhausts when its FIRST replica does
+        assert fc["tte_s"] == pytest.approx(12.0)
+        assert fc["used_fraction"] == pytest.approx(1 - 6 / 48)
+
+    def test_stale_capacity_excluded_from_rollup(self):
+        sup = self._sup()
+        sup.replicas[1].capacity_t -= 1e6
+        fc = sup.fleet_capacity()
+        assert fc["replicas_fresh"] == 1
+        assert fc["num_pages"] == 24
+        assert fc["per_replica"]["1"]["fresh"] is False
+
+    def test_router_fleet_capacity_op(self):
+        sup = self._sup()
+        router = FailoverRouter(sup)
+        port = router.start()
+        fc = client_request("127.0.0.1", port,
+                            {"op": "fleet_capacity"})["capacity"]
+        router.stop()
+        assert fc["replicas_fresh"] == 2 and fc["num_pages"] == 48
+
+    def test_stub_supervisor_gets_typed_unavailable(self):
+        class _Stub:
+            host = "127.0.0.1"
+            replicas = []
+
+            def live(self):
+                return []
+
+        router = FailoverRouter(_Stub())
+        port = router.start()
+        r = client_request("127.0.0.1", port, {"op": "fleet_capacity"})
+        router.stop()
+        assert r["error"] == "FleetCapacityUnavailable"
+
+
+class TestPressureMemoryInput:
+    def test_flips_on_memory_alone_with_healthy_slo(self):
+        """The acceptance pin: SLO attainment perfect, queues empty —
+        a nearly-exhausted page pool must still drive scale_up."""
+        pm = PressureMonitor(hysteresis=2, mem_high=0.9)
+        for _ in range(2):
+            out = pm.evaluate(1.0, 0.0, 0.0, 0.5,
+                              mem_utilization=0.97)
+        assert out["verdict"] == "scale_up"
+        assert out["inputs"]["mem_utilization"] == 0.97
+
+    def test_memory_headroom_keeps_prior_behavior(self):
+        pm = PressureMonitor(hysteresis=1)
+        out = pm.evaluate(1.0, 0.0, 0.0, 0.1, mem_utilization=0.2)
+        assert out["verdict"] == "scale_down"
+        # mem omitted entirely (pre-r18 caller): behavior unchanged
+        pm2 = PressureMonitor(hysteresis=1)
+        assert pm2.evaluate(1.0, 0.0, 0.0, 0.1)["verdict"] == \
+            "scale_down"
+
+    def test_memory_pressure_blocks_scale_down(self):
+        pm = PressureMonitor(hysteresis=1, mem_high=0.9)
+        out = pm.evaluate(1.0, 0.0, 0.0, 0.1, mem_utilization=0.95)
+        assert out["verdict"] != "scale_down"
+
+    def test_fleet_metrics_threads_mem_utilization(self):
+        fm = FleetMetrics(
+            pressure=PressureMonitor(hysteresis=1, mem_high=0.9),
+            pressure_interval_s=0.0)
+        met = ServingMetrics(registry=StatRegistry())
+        export = met.export()
+        export["gauges"] = {"num_pages": 24.0, "pages_used": 23.0,
+                            "pages_unreclaimable": 23.0,
+                            "num_slots": 2.0, "inflight_slots": 1.0,
+                            "queued_requests": 0.0,
+                            "prefill_debt_tokens": 0.0}
+        fm.ingest(0, export)
+        snap = fm.fleet_snapshot()
+        inputs = snap["pressure"]["inputs"]
+        assert inputs["mem_utilization"] == pytest.approx(23 / 24,
+                                                          abs=1e-3)
+        assert snap["pressure"]["raw"] == "scale_up"
+
+    def test_warm_cache_is_not_memory_pressure(self):
+        """A pool FULL of refcount-0 prefix-cache pages is reclaimable
+        on demand — the pressure input must read the UNRECLAIMABLE
+        figure, not raw used, or every warm inclusive cache would
+        permanently demand scale_up and block scale_down."""
+        fm = FleetMetrics(
+            pressure=PressureMonitor(hysteresis=1, mem_high=0.9),
+            pressure_interval_s=0.0)
+        met = ServingMetrics(registry=StatRegistry())
+        export = met.export()
+        export["gauges"] = {"num_pages": 24.0, "pages_used": 24.0,
+                            "pages_unreclaimable": 2.0,
+                            "num_slots": 2.0, "inflight_slots": 1.0,
+                            "queued_requests": 0.0,
+                            "prefill_debt_tokens": 0.0}
+        fm.ingest(0, export)
+        snap = fm.fleet_snapshot()
+        inputs = snap["pressure"]["inputs"]
+        assert inputs["mem_utilization"] == pytest.approx(2 / 24,
+                                                          abs=1e-3)
+        assert snap["pressure"]["raw"] != "scale_up"
+
+    def test_server_exports_unreclaimable_below_used_with_warm_cache(
+            self, model):
+        """Live engine: after a cached request finishes, its prompt
+        pages sit refcount-0 in the cache — pages_used counts them,
+        pages_unreclaimable does not."""
+        srv = _server(model)
+        port = srv.start()
+        client_request("127.0.0.1", port,
+                       {"op": "generate",
+                        "prompt": list(range(1, 20)),
+                        "max_new_tokens": 2})
+        g = srv.metrics.gauges()
+        cap = srv._capacity()
+        srv.stop()
+        assert g["pages_used"] > 0
+        assert g["pages_unreclaimable"] < g["pages_used"]
+        assert cap["evictable_pages"] > 0
+        assert cap["unreclaimable_pages"] == g["pages_unreclaimable"]
+
+
+# ---------------------------------------------------------------------------
+# Flight bundles v2 + inspector lint
+# ---------------------------------------------------------------------------
+
+class TestFlightBundlesV2:
+    def test_server_bundle_is_v2_and_lints(self, model, tmp_path):
+        srv = _server(model, flight_dir=str(tmp_path))
+        port = srv.start()
+        client_request("127.0.0.1", port,
+                       {"op": "generate", "prompt": [1, 2, 3],
+                        "max_new_tokens": 2})
+        srv._flight_record("stall")
+        srv.stop()
+        bundles, errors = flight_inspect.lint_dir(str(tmp_path))
+        assert bundles and errors == [], errors
+        obj = json.load(open(bundles[0]))
+        assert obj["v"] == 2
+        assert obj["page_ledger"], "v2 bundle carries the ledger tail"
+        occ = obj["capacity"]["occupancy"]
+        assert sum(occ[c] for c in ("inflight", "prefix_device",
+                                    "reserved", "free")) == \
+            obj["capacity"]["num_pages"]
+
+    @staticmethod
+    def _v2_bundle():
+        return {"v": 2, "reason": "stall", "t_unix": time.time(),
+                "pid": os.getpid(), "engine": {"steps": 1},
+                "metrics": ServingMetrics(
+                    registry=StatRegistry()).export(),
+                "step_timeline": [{"step": 0, "ms": 1.0}],
+                "traces": [], "inflight": [],
+                "page_ledger": [
+                    {"seq": 1, "ev": "alloc", "owner": 0,
+                     "pages": [0], "step": 0},
+                    {"seq": 2, "ev": "free", "owner": 0,
+                     "pages": [0], "step": 1}],
+                "capacity": {"num_pages": 8,
+                             "occupancy": {"inflight": 1,
+                                           "prefix_device": 2,
+                                           "reserved": 1, "free": 4}}}
+
+    def test_lint_requires_v2_keys(self):
+        b = self._v2_bundle()
+        del b["page_ledger"]
+        assert any("page_ledger" in e
+                   for e in flight_inspect.lint_bundle(b))
+        b = self._v2_bundle()
+        del b["capacity"]
+        assert any("capacity" in e
+                   for e in flight_inspect.lint_bundle(b))
+        # v1 bundles predate both keys and still lint clean
+        b = self._v2_bundle()
+        b["v"] = 1
+        del b["page_ledger"], b["capacity"]
+        assert flight_inspect.lint_bundle(b) == []
+
+    def test_lint_catches_nonmonotonic_ledger_seq(self):
+        b = self._v2_bundle()
+        b["page_ledger"][1]["seq"] = 1
+        assert any("seq not" in e and "monotonic" in e
+                   for e in flight_inspect.lint_bundle(b))
+
+    def test_lint_catches_occupancy_sum_mismatch(self):
+        b = self._v2_bundle()
+        b["capacity"]["occupancy"]["free"] = 99
+        assert any("occupancy classes sum" in e
+                   for e in flight_inspect.lint_bundle(b))
+
+    def test_clean_v2_bundle_lints(self):
+        assert flight_inspect.lint_bundle(self._v2_bundle()) == []

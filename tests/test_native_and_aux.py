@@ -218,6 +218,12 @@ def test_elastic_membership():
             return cond()
 
         assert wait_for(m0.healthy)
+        # the watch thread must have taken its FIRST observation (the
+        # change-detection baseline) before the scale-down happens: on
+        # a 1-cpu host the main thread otherwise reaches stop() before
+        # the watch loop ever runs, the baseline is post-scale-down,
+        # and on_change can never fire (observed deterministic there)
+        assert wait_for(lambda: m0._last_members is not None)
         m1.stop()  # scale-down event
         assert wait_for(lambda: not m0.healthy())
         # the watch-loop callback runs on its own cadence — poll it too

@@ -730,6 +730,27 @@ class TestMetricsRegistryAudit:
                      "trace_spans_dropped_total"):
             assert f"serving_{name} 0" in text
 
+    def test_r18_memory_families_ride_the_audit(self, model):
+        """r18 extension: the memory observatory's new families — the
+        serving_request_peak_pages histogram and the occupancy/ledger
+        gauges — appear on the exposition page with the right types
+        (the generic collision/parse audits above already cover them
+        by running over the same page)."""
+        srv = _server(model)
+        port = srv.start()
+        client_request("127.0.0.1", port,
+                       {"op": "generate", "prompt": [1, 2, 3],
+                        "max_new_tokens": 2})
+        text = client_request("127.0.0.1", port,
+                              {"op": "metrics"})["text"]
+        srv.stop()
+        fams = self._families(text)
+        assert fams.get("serving_request_peak_pages") == "histogram"
+        for g in ("serving_pages_inflight",
+                  "serving_pages_prefix_device", "serving_pages_used",
+                  "serving_ledger_events"):
+            assert fams.get(g) == "gauge", (g, fams.get(g))
+
     def test_fleet_exposition_obeys_the_same_rules(self):
         """r17 extension: the FLEET exposition (per-replica series
         with a replica label + fleet_* rollup families) must obey the

@@ -77,6 +77,12 @@ class ChaosReport:
     flight_bundles: int = 0
     flight_lint_failures: int = 0
     flight_errors: List[str] = dataclasses.field(default_factory=list)
+    # page ledger (r18, INVARIANT 5): after drain every replica's
+    # ledger RECONCILES — the event-derived ownership shadow matches
+    # the allocator's books exactly (each alloc/reserve had its
+    # matching release/free), alongside the existing leak_check
+    ledger_failures: int = 0
+    ledger_errors: List[str] = dataclasses.field(default_factory=list)
     error_kinds: Dict[str, int] = dataclasses.field(default_factory=dict)
     details: List[Dict] = dataclasses.field(default_factory=list)
     engine_restarts: int = 0      # scraped from surviving replicas
@@ -91,6 +97,7 @@ class ChaosReport:
         return (self.hangs == 0 and self.mismatches == 0
                 and self.leak_failures == 0
                 and self.flight_lint_failures == 0
+                and self.ledger_failures == 0
                 and self.completed + self.typed_errors == self.requests)
 
     def to_dict(self) -> Dict:
@@ -318,6 +325,7 @@ def run_chaos(replicas: int = 2, requests: int = 12, seed: int = 0,
                 report.leak_failures += 1
                 continue
             ok = False
+            chk: Dict = {}
             while time.monotonic() < deadline:
                 try:
                     chk = _rpc(sup.host, rep.port, {"op": "leak_check"},
@@ -335,6 +343,19 @@ def run_chaos(replicas: int = 2, requests: int = 12, seed: int = 0,
                 report.replicas_checked += 1
             else:
                 report.leak_failures += 1
+            # -- invariant 5: ledger reconciliation (r18) ---------------
+            # the leak_check reply carries the page-ledger reconcile:
+            # the event-derived ownership shadow must match the
+            # allocator's books (every alloc/reserve matched by a
+            # release/free). A replica without a ledger reports
+            # enabled=False and passes vacuously.
+            led = chk.get("ledger")
+            if isinstance(led, dict) and not led.get("ok", True):
+                report.ledger_failures += 1
+                report.ledger_errors.extend(
+                    f"replica {rep.idx}: {m}"
+                    for m in (led.get("mismatches") or
+                              ["reconcile failed"])[:4])
             counters = _scrape_counters(sup.host, rep.port)
             report.engine_restarts += \
                 int(counters.get("engine_restarts_total", 0))

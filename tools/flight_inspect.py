@@ -51,7 +51,13 @@ from trace_lint import lint_trace_obj  # noqa: E402
 
 REQUIRED_KEYS = ("v", "reason", "t_unix", "pid", "engine", "metrics",
                  "step_timeline", "traces", "inflight")
+# v2 bundles (r18 memory observatory) additionally carry the
+# page-ledger ring tail and a capacity snapshot; both are REQUIRED at
+# that version and linted below (v1 bundles predate them)
+REQUIRED_KEYS_V2 = ("page_ledger", "capacity")
 KNOWN_REASONS = ("resurrect", "engine_failed", "stall")
+# the device-pool owner classes that must sum to the pool size
+OCCUPANCY_CLASSES = ("inflight", "prefix_device", "reserved", "free")
 
 
 def lint_bundle(bundle: Any, name: str = "bundle") -> List[str]:
@@ -60,7 +66,10 @@ def lint_bundle(bundle: Any, name: str = "bundle") -> List[str]:
     errors: List[str] = []
     if not isinstance(bundle, dict):
         return [f"{name}: not a JSON object"]
-    for k in REQUIRED_KEYS:
+    required = REQUIRED_KEYS
+    if isinstance(bundle.get("v"), int) and bundle["v"] >= 2:
+        required = REQUIRED_KEYS + REQUIRED_KEYS_V2
+    for k in required:
         if k not in bundle:
             errors.append(f"{name}: missing key {k!r}")
     if errors:
@@ -111,6 +120,47 @@ def lint_bundle(bundle: Any, name: str = "bundle") -> List[str]:
                                      "generated")):
                 errors.append(f"{name}: inflight[{i}] missing "
                               f"req_id/state/prompt_len/generated")
+
+    # r18: page-ledger tail (event seq strictly increasing) and the
+    # capacity snapshot (occupancy owner classes sum to the pool size)
+    led = bundle.get("page_ledger")
+    if led is not None:
+        if not isinstance(led, list):
+            errors.append(f"{name}: page_ledger must be a list")
+        else:
+            last_seq = 0
+            for i, ev in enumerate(led):
+                if not isinstance(ev, dict) or "seq" not in ev \
+                        or "ev" not in ev:
+                    errors.append(f"{name}: page_ledger[{i}] not an "
+                                  f"event dict")
+                    break
+                s = ev["seq"]
+                if not isinstance(s, int) or s <= last_seq:
+                    errors.append(f"{name}: page_ledger seq not "
+                                  f"monotonic at [{i}] "
+                                  f"({last_seq} -> {s!r})")
+                    break
+                last_seq = s
+    cap = bundle.get("capacity")
+    if cap is not None:
+        if not isinstance(cap, dict) \
+                or not isinstance(cap.get("num_pages"), int) \
+                or not isinstance(cap.get("occupancy"), dict):
+            errors.append(f"{name}: capacity must carry num_pages + "
+                          f"occupancy")
+        else:
+            occ = cap["occupancy"]
+            missing = [c for c in OCCUPANCY_CLASSES if c not in occ]
+            if missing:
+                errors.append(f"{name}: capacity occupancy missing "
+                              f"classes {missing}")
+            else:
+                total = sum(int(occ[c]) for c in OCCUPANCY_CLASSES)
+                if total != cap["num_pages"]:
+                    errors.append(
+                        f"{name}: occupancy classes sum {total} != "
+                        f"pool size {cap['num_pages']}")
 
     met = bundle.get("metrics")
     if not isinstance(met, dict):
@@ -194,6 +244,22 @@ def summarize(bundle: Dict) -> str:
         f"traces      : {len(bundle.get('traces') or [])} finished "
         f"tree(s), {len(bundle.get('events') or [])} annotation(s)",
     ]
+    cap = bundle.get("capacity")
+    if isinstance(cap, dict) and isinstance(cap.get("occupancy"), dict):
+        occ = cap["occupancy"]
+        fc = cap.get("forecast") or {}
+        lines.append(
+            f"capacity    : "
+            + " ".join(f"{k}={occ.get(k)}" for k in OCCUPANCY_CLASSES)
+            + f" / {cap.get('num_pages')} pages"
+            + (f", tte {fc.get('tte_s')}s"
+               if fc.get("tte_s") is not None else ""))
+    led = bundle.get("page_ledger")
+    if isinstance(led, list):
+        lines.append(f"page ledger : {len(led)} event(s) in tail"
+                     + (f", last: {led[-1].get('ev')} "
+                        f"owner={led[-1].get('owner')!r} "
+                        f"step {led[-1].get('step')}" if led else ""))
     infl = bundle.get("inflight") or []
     lines.append(f"inflight    : {len(infl)} request(s)")
     for r in infl[:8]:

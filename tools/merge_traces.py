@@ -39,8 +39,24 @@ def _spans_to_events(trace):
 
 
 def load_trace(path: str):
-    with open(path) as f:
-        data = json.load(f)
+    if os.path.isdir(path):
+        # a jax.profiler capture dir (the serving `profile` op, r18):
+        # the chrome trace lives at plugins/profile/<run>/*.trace.json.gz
+        # — merge every run found under the dir
+        events = []
+        for root, _dirs, files in os.walk(path):
+            for fn in sorted(files):
+                if fn.endswith(".trace.json.gz") \
+                        or fn.endswith(".trace.json"):
+                    events.extend(load_trace(os.path.join(root, fn)))
+        return events
+    if path.endswith(".gz"):
+        import gzip
+        with gzip.open(path, "rt", encoding="utf-8") as f:
+            data = json.load(f)
+    else:
+        with open(path) as f:
+            data = json.load(f)
     if isinstance(data, dict):
         if "traces" in data:  # serving span-tree dump (r16 trace op)
             events = []
@@ -99,7 +115,10 @@ def merge(paths, align_start: bool = True):
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("traces", nargs="+", help="chrome trace json files")
+    ap.add_argument("traces", nargs="+",
+                    help="chrome trace json files, span-tree dumps, "
+                         "*.trace.json.gz, or jax.profiler capture "
+                         "dirs (the serving profile op's trace_dir)")
     ap.add_argument("--out", required=True)
     ap.add_argument("--no-align", action="store_true",
                     help="keep absolute timestamps (clock-synced hosts)")
