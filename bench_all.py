@@ -766,6 +766,107 @@ def bench_fused_decode(on_tpu: bool) -> Dict:
                     "tokens/s measures host overhead, not the fusion"}
 
 
+def bench_multi_step_decode(on_tpu: bool) -> Dict:
+    """Device-resident multi-step decode A/B (r19, ROADMAP item 2):
+    the ragged_serving request stream through the SAME engine at
+    ``multi_step`` N ∈ {1, 4, 8, 16} — N fused decode steps per
+    on-device program launch (one early-exit while_loop + a [B, N]
+    token ring read back once per launch) vs the per-token engine.
+    Reports tokens/s, host program launches per emitted token (the
+    number the macro launch exists to shrink), steps-per-launch, the
+    host-overlap idle fraction, and the bit_identical flag over the
+    full greedy token streams."""
+    import paddle_tpu as pt
+    from paddle_tpu.inference import create_decode_engine
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+    if on_tpu:
+        cfg = _decode_1p3b_cfg()
+        slots, page, max_seq = 32, 64, 1024
+        lens = [64, 96, 128, 192, 256, 384, 512, 640]
+        n_req, new_toks = 64, 64
+    else:
+        cfg = gpt_tiny()
+        slots, page, max_seq = 2, 8, 64
+        lens = [5, 9, 13]
+        n_req, new_toks = 4, 16
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        _to_bf16_except_norms(model)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            (lens[i % len(lens)],)).astype(np.int32)
+               for i in range(n_req)]
+
+    def run_mode(n: int) -> Dict:
+        eng = create_decode_engine(model, num_slots=slots,
+                                   page_size=page, max_seq_len=max_seq,
+                                   multi_step=n)
+        # warm THE MEASURED ENGINE's compiles (per-instance closures;
+        # see bench_ragged_serving) — one request per distinct bucket
+        for p in prompts[:len(lens)]:
+            eng.submit(p, max_new_tokens=2)
+        eng.run()
+        launches0 = dict(eng.programs_launched)
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, max_new_tokens=new_toks) for p in prompts]
+        try:
+            results = eng.run()
+        finally:
+            tl = eng.step_timeline()
+            eng.close()
+        wall = time.perf_counter() - t0
+        gen = sum(len(results[rid]) - len(p)
+                  for rid, p in zip(rids, prompts))
+        launches = sum(v - launches0.get(k, 0)
+                       for k, v in eng.programs_launched.items())
+        macro = [e["macro"] for e in tl if "macro" in e]
+        idle = [m["overlap_idle_ms"] for m in macro]
+        ms = [m["ms"] for m in macro]
+        return {"tokens_per_s": round(gen / max(1e-9, wall), 1),
+                "launches": launches,
+                "launches_per_token": round(launches / max(1, gen), 4),
+                "steps_per_launch": (round(sum(m["steps"]
+                                               for m in macro)
+                                           / len(macro), 2)
+                                     if macro else 1.0),
+                "host_overlap_idle_frac": (
+                    round(sum(idle) / max(1e-9, sum(ms)), 3)
+                    if macro else None),
+                "tokens": {rid: results[rid].tolist() for rid in rids}}
+
+    by_n = {str(n): run_mode(n) for n in (1, 4, 8, 16)}
+    base = by_n["1"].pop("tokens")
+    bit_identical = all(v.pop("tokens") == base
+                        for k, v in by_n.items() if k != "1")
+    l1 = by_n["1"]["launches_per_token"]
+    l16 = by_n["16"]["launches_per_token"]
+    return {"metric": "gpt1p3b_multi_step_decode_ab_chip" if on_tpu
+            else "gpt_tiny_multi_step_decode_ab_cpu_smoke",
+            "unit": "tokens/s + launches/token (A/B over N)",
+            "by_multi_step": by_n,
+            "bit_identical": bool(bit_identical),
+            "launches_per_token_1": l1,
+            "launches_per_token_16": l16,
+            "launch_reduction": round(1.0 - l16 / l1, 3) if l1 else None,
+            "requests": n_req, "prompt_lens": lens,
+            "new_tokens_per_req": new_toks, "num_slots": slots,
+            "page_size": page,
+            "note": "launches counts every jitted program call "
+                    "(prefill + decode/decode_multi) over the timed "
+                    "stream. Even the cpu lane speeds up (per-launch "
+                    "python dispatch + readback is real overhead at "
+                    "tiny scale); the MAGNITUDE claim needs real "
+                    "chips, where the ~ms tunneled host launch/sync "
+                    "round trip — not FLOPs — sets the streaming "
+                    "floor. host_overlap_idle_frac ~0 = the host "
+                    "never blocked at a drain (the dispatch-then-"
+                    "drain overlap fully hid device time)"}
+
+
 # ONE set of workload constants, interpolated into both the subprocess
 # payload and the result-dict metadata below — the BENCH_STAGED entry
 # must describe the workload that was actually measured
@@ -2282,6 +2383,7 @@ def run_staged(on_tpu: bool) -> Dict:
                      ("paged_decode", bench_paged_decode),
                      ("ragged_serving", bench_ragged_serving),
                      ("fused_decode", bench_fused_decode),
+                     ("multi_step_decode", bench_multi_step_decode),
                      ("chunked_prefill", bench_chunked_prefill),
                      ("mesh_decode", bench_mesh_decode),
                      ("serving_prefix", bench_serving_prefix),

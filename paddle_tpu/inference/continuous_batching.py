@@ -59,6 +59,17 @@ in-flight decode streams keep ticking while a long prompt trickles in
 (the TTFT-vs-TPOT head-of-line fix; greedy outputs stay bit-identical
 to whole prefill).
 
+Multi-step engines (``multi_step=N``, r19) replace the per-token
+launch/readback cadence with one on-device N-step program per
+boundary (models/gpt.py ``multi_step_decode``): admission and chunked
+prefill run AT the boundary (they mutate the launch's inputs and
+donate the pools, so they cannot run under an in-flight launch),
+while token delivery/tracing/metrics and the serving loop's inbox
+work OVERLAP the launch (dispatch-then-drain: ring K−1 streams after
+launch K is dispatched). Greedy outputs stay bit-identical to
+``multi_step=1`` (the default, which is byte-for-byte the per-token
+engine).
+
 Reference analog: the inference engine's multi-stream serving loop
 (`inference/api/analysis_predictor.cc` + TensorRT's enqueue batching),
 rebuilt as a scheduler over one jitted step instead of a stream pool.
@@ -439,6 +450,7 @@ class ContinuousBatchingEngine:
                  mesh=None,
                  prefill_chunk_tokens: Optional[int] = None,
                  fused_step: bool = True,
+                 multi_step: int = 1,
                  tracer=None, timeline_steps: int = 256,
                  capture_costs: bool = False,
                  page_ledger: bool = True,
@@ -648,6 +660,58 @@ class ContinuousBatchingEngine:
         # False is byte-for-byte the pre-r13 trace — the same
         # escape-hatch pattern as mesh=None / prefill_chunk_tokens=None.
         self.fused_step = bool(fused_step)
+        # device-resident multi-step decode (r19, ROADMAP item 2):
+        # multi_step=N wraps N fused decode steps in ONE on-device
+        # lax.while_loop program (models/gpt.py multi_step_decode) —
+        # early exit on EOS via masked carry, KV appends against
+        # PRE-BOUND page budgets (admission reserves the growth pages;
+        # _dispatch_macro converts reservation -> physical pages before
+        # every launch, which cannot fail by the PR 4 contract), and a
+        # device-side token ring [B, N] read back once per launch.
+        # Launches are dispatch-then-drain: step K's results are
+        # drained at boundary K+1, so token delivery/tracing/metrics
+        # and the serving loop's inbox work overlap the device compute
+        # (JAX async dispatch; no new threads). Admission and chunked
+        # prefill run at the boundary, in the drain->dispatch gap —
+        # they rewrite the launch's table/lens/cur inputs and donate
+        # the pools, so they cannot run under an in-flight launch;
+        # that gap is the N-vs-TTFT trade. multi_step=1 (the default)
+        # is byte-for-byte the per-token engine. Speculative engines
+        # compose AT the boundary: their verify step already amortizes
+        # k+1 tokens per launch, so the macro wrap applies only to the
+        # vanilla decode path.
+        self.multi_step = int(multi_step)
+        if self.multi_step < 1:
+            raise ValueError(
+                f"multi_step must be >= 1 (1 = per-token decode); got "
+                f"{multi_step}")
+        self._multi_jit = None
+        # in-flight macro launch: device handles + the slot->request
+        # snapshot the drain folds back (None = nothing dispatched)
+        self._pending_macro: Optional[Dict[str, Any]] = None
+        # drained-but-undelivered (req, token, done) emissions, in the
+        # exact (in-macro step, slot) order the per-token engine would
+        # have streamed them; delivered AFTER the next launch is
+        # dispatched (host/device overlap), and flushed per-request by
+        # _notify_complete so streamed tokens always precede the
+        # completion notification on every terminal path
+        self._pending_emit: List[Tuple] = []
+        self.macro_launches = 0
+        # macro-EMA warmup: the first launch is compile-dominated
+        # (the skip-first-step rule, applied per program kind)
+        self._macro_warm = False
+        # engine-wide last-macro-drain timestamp: the stall watchdog's
+        # liveness signal for decoding slots between boundaries (a
+        # healthy macro delivers every decoding slot's tokens at each
+        # drain; a broken one lets this go stale and the stall fires)
+        self._last_macro_t = 0.0
+        # page-growth discipline: multi-step shares the speculative
+        # reserve-then-grow contract — admission binds only the
+        # prefill-covering pages and RESERVES the rest, macro dispatch
+        # grows each slot's page set to cover its next min(N, rem)
+        # positions out of that reservation (guaranteed to succeed)
+        self._reserve_growth = (speculative is not None or
+                                self.multi_step > 1)
         # traced-program op counts per jitted step kind (the launch
         # counter: dispatch.count_op_calls around each jit call counts
         # the ops traced into the program on a (re)trace, zero on the
@@ -665,6 +729,8 @@ class ContinuousBatchingEngine:
         # dict per ENGINE STEP (never per token) next to a jit launch.
         self.timeline: "collections.deque" = collections.deque(
             maxlen=max(1, int(timeline_steps)))
+        # drained-macro attribution for the NEXT _tl_commit (r19)
+        self._tl_macro: Optional[Dict[str, Any]] = None
         # cumulative program launches by kind (every jit call — 1 per
         # launch, unlike step_programs which records traced-op counts)
         self.programs_launched: Dict[str, int] = {}
@@ -1070,6 +1136,12 @@ class ContinuousBatchingEngine:
                 entry[f"{t.name}_tier_pages"] = int(t.blob_count)
         for k, v in self._tl_ms.items():
             entry[k] = round(v, 4)
+        # multi-step decode (r19): the boundary that drained a macro
+        # launch marks its entry with the launch's attribution
+        # (per_token_timeline() reconstructs per-step rows from it)
+        if self._tl_macro is not None:
+            entry["macro"] = self._tl_macro
+            self._tl_macro = None
         self.timeline.append(entry)
 
     def step_timeline(self) -> List[Dict[str, Any]]:
@@ -1101,6 +1173,8 @@ class ContinuousBatchingEngine:
             "prefill_debt_tokens": int(self.prefill_debt_tokens),
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "fused_step": bool(self.fused_step),
+            "multi_step": int(self.multi_step),
+            "macro_launches": int(self.macro_launches),
             "speculative": self._spec_cfg is not None,
             "mesh": self.mesh_info(),
             "programs_launched": dict(self.programs_launched),
@@ -1324,7 +1398,13 @@ class ContinuousBatchingEngine:
                 "devices": int(self.mesh.size),
                 "model_axis": self._mesh_axis}
 
-    def _build_decode(self):
+    def _decode_body_fn(self):
+        """The ONE single-token decode step body: shared verbatim by
+        the per-token decode jit (``multi_step=1`` — byte-for-byte the
+        pre-r19 trace) and by every iteration of the r19 multi-step
+        macro program (models/gpt.py ``multi_step_decode``), so the
+        two modes' per-step math is identical by construction — the
+        bit-identity contract tests/test_multi_step_decode.py pins."""
         import jax
 
         from ..autograd.engine import no_grad
@@ -1373,13 +1453,44 @@ class ContinuousBatchingEngine:
             return nxt, self._constrain_pools(new_pools), \
                 raw(nc[0].seq_lens)
 
+        return step
+
+    def _build_decode(self):
+        import jax
+
         # donate the pools: the append scatters then update the pool
         # buffers IN PLACE instead of materializing a fresh copy of
         # every per-layer pool each token (~GBs/step at serving scale,
         # plus 2x peak KV memory); the engine always adopts the
         # returned pools, so the donated buffers are never reused.
         # (On CPU donation is ignored with a warning — harmless.)
-        return jax.jit(step, donate_argnums=(1,))
+        return jax.jit(self._decode_body_fn(), donate_argnums=(1,))
+
+    def _build_multi_decode(self):
+        """The r19 macro program: up to ``multi_step`` iterations of
+        the EXACT single-token decode body wrapped in one on-device
+        early-exit loop (models/gpt.py ``multi_step_decode``), with
+        the per-slot stop/mask bookkeeping the host used to run
+        between launches carried in-program. ONE compile serves the
+        engine lifetime (N is static; rem/eos/active are data)."""
+        import jax
+
+        from ..models.gpt import multi_step_decode
+
+        body = self._decode_body_fn()
+        n = self.multi_step
+        scratch = self._scratch
+
+        def macro(state, pools, table, lens, tokens, active, rem, eos):
+            def step_fn(pl, tbl, ln, cur):
+                return body(state, pl, tbl, ln, cur)
+
+            with jax.named_scope("pt.multi_step"):
+                return multi_step_decode(step_fn, pools, table, lens,
+                                         tokens, active, rem, eos,
+                                         n, scratch)
+
+        return jax.jit(macro, donate_argnums=(1,))
 
     def _build_prefill(self, chained: bool):
         """One jitted prefill; jax.jit's shape-keyed cache compiles it
@@ -1661,6 +1772,11 @@ class ContinuousBatchingEngine:
         self._on_complete = fn
 
     def _notify_complete(self, req: DecodeRequest) -> None:
+        # multi-step decode (r19): a request terminating at a macro
+        # boundary may still hold undelivered ring tokens — stream
+        # them FIRST so tokens always precede the completion, on
+        # every terminal path (no-op outside multi-step mode)
+        self._flush_req_emissions(req)
         tr = req.trace
         if tr is not None:
             # EVERY terminal path funnels through here, so this is the
@@ -1681,6 +1797,20 @@ class ContinuousBatchingEngine:
         # the completion notification; callbacks run on the engine
         # thread and must not raise — the server's callback catches
         # its own socket errors
+        if self.multi_step > 1 and self._spec_cfg is None:
+            # multi-step mode (r19): EVERY emission rides the pending
+            # queue — boundary-time prefill first-tokens included —
+            # so the stream keeps (step, slot) order: the drained
+            # ring's tokens (earlier steps) always precede this
+            # boundary's admissions, and per-request streams match
+            # multi_step=1 exactly (cross-request interleave matches
+            # too whenever admission lands at the same points; the
+            # boundary-coarsened admission CADENCE is the one thing N
+            # changes). _deliver_pending streams the queue after the
+            # next launch is dispatched; terminal paths flush a
+            # request's share first (_notify_complete).
+            self._pending_emit.append((req, tok, self._finish_due(req)))
+            return
         req.last_emit_t = time.monotonic()
         if req.on_token is not None:
             req.on_token(req.req_id, tok, self._finish_due(req))
@@ -1753,7 +1883,15 @@ class ContinuousBatchingEngine:
             return True
         if self.decode_ema_s is not None:
             need = 1 if req.eos_token is not None else req.max_new_tokens
-            per_step = 1 if self._spec_cfg is None else self._spec_cfg.k + 1
+            # decode_ema_s is per LAUNCH: one token for the per-token
+            # engine, up to k+1 for a speculative verify, up to
+            # multi_step for a macro launch (r19 — the EMA is tracked
+            # per macro at drain, so the per-token estimate is ema/N
+            # and charging ema per token would shed feasible work)
+            if self._spec_cfg is not None:
+                per_step = self._spec_cfg.k + 1
+            else:
+                per_step = self.multi_step
             steps = -(-need // per_step)
             est = steps * self.decode_ema_s
             if self.prefill_chunk_tokens is not None and \
@@ -1776,7 +1914,15 @@ class ContinuousBatchingEngine:
         active slots are evicted mid-flight with their pages (and any
         speculative reservation) returned. Runs at the top of every
         step and is safe to call from the serving loop even when the
-        step itself is failing (host state only)."""
+        step itself is failing (host state only). Multi-step engines
+        flush the in-flight launch first — never sweep stale slot
+        state, and deliver its tokens/completions so a failing step
+        loop can't strand answered work (r19)."""
+        self._flush_macro()
+        return self._expire_deadlines_inner(now)
+
+    def _expire_deadlines_inner(self, now: Optional[float] = None
+                                ) -> List[DecodeRequest]:
         now = time.monotonic() if now is None else now
         expired: List[DecodeRequest] = []
         for req in [r for r in self._queue
@@ -1798,12 +1944,28 @@ class ContinuousBatchingEngine:
         the serving loop calls it even mid engine failure."""
         if self.stall_timeout_s is None:
             return []
+        self._flush_macro()
+        return self._evict_stalled_inner(now)
+
+    def _evict_stalled_inner(self, now: Optional[float] = None
+                             ) -> List[DecodeRequest]:
+        if self.stall_timeout_s is None:
+            return []
         now = time.monotonic() if now is None else now
         out: List[DecodeRequest] = []
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue
             last = max(req.last_emit_t, req.stats.admit_t)
+            if self.multi_step > 1 and req.state == "decoding":
+                # multi-step mode delivers tokens once per macro
+                # boundary, not per step — engine-wide drain progress
+                # is the liveness signal (every decoding slot gets
+                # tokens each healthy launch; a broken engine stops
+                # draining anywhere and the timestamp goes stale, so
+                # a genuine stall still fires typed). Same shape as
+                # the chunked-prefill _last_chunk_t rule below.
+                last = max(last, self._last_macro_t)
             if req.state == "prefill_partial":
                 # a half-prefilled slot may be healthily WAITING its
                 # turn for the single per-step chunk budget while
@@ -1824,6 +1986,18 @@ class ContinuousBatchingEngine:
         engine via a chained greedy prefill (bit-identical continuation
         is the paged design's recovery dividend). Does NOT release
         anything; callers tear down via close()."""
+        # multi-step (r19): fold any in-flight launch's tokens into
+        # the snapshot first — those tokens were NEVER delivered (the
+        # ring streams at the NEXT boundary), so on a failed drain
+        # the pre-launch state is equally gapless to replay from
+        try:
+            self._flush_macro()
+        except Exception:
+            # the in-flight computation died with the engine; its
+            # tokens were never generated as far as any client knows
+            # (earlier drains' emissions still deliver)
+            self._pending_macro = None
+            self._deliver_pending()
         live = [r for r in self._slots if r is not None]
         return sorted(live + list(self._queue), key=lambda r: r.req_id)
 
@@ -1926,13 +2100,15 @@ class ContinuousBatchingEngine:
         private_need = need - len(shared)
 
         def grab():
-            if self._spec_cfg is None:
+            if not self._reserve_growth:
                 return self.allocator.alloc(req.req_id, private_need)
-            # speculative mode binds only the prefill-covering pages
-            # and RESERVES the rest of the capacity: decode grows the
-            # page set on demand (_ensure_pages) and rollback returns
-            # wholly-unused pages (_rollback_pages) without ever
-            # risking a mid-decode allocation failure
+            # speculative AND multi-step modes bind only the
+            # prefill-covering pages and RESERVE the rest of the
+            # capacity: decode grows the page set on demand
+            # (_ensure_pages — per spec step, or per macro launch to
+            # cover the next min(N, rem) positions) and speculative
+            # rollback returns wholly-unused pages (_rollback_pages)
+            # without ever risking a mid-decode allocation failure
             prefill_need = (-(-len(req.prompt) // self.page_size)
                             - len(shared))
             if not self.allocator.reserve(req.req_id, private_need):
@@ -2271,27 +2447,291 @@ class ContinuousBatchingEngine:
                    req.generated[-1] == req.eos_token)
         return len(req.generated) >= req.max_new_tokens or hit_eos
 
-    def _maybe_finish(self, slot: int) -> None:
+    def _maybe_finish(self, slot: int, notify: bool = True) -> None:
         req = self._slots[slot]
         if req is None:
             return
         if self._finish_due(req):
-            req.done = True
-            req.state = "done"
-            req.stats.finish_t = time.monotonic()
-            req.stats.tokens_out = len(req.generated)
-            self._finished[req.req_id] = req
-            self._account_req_pages(req)
-            with self._led("done", req.req_id):
-                self.allocator.free(req.req_id)
-                if self._prefix_cache is not None and req.cache_keys:
-                    self._prefix_cache.release(req.cache_keys)
-                    req.cache_keys = ()
-            self._table[slot] = self._scratch  # park on scratch page
-            self._lens[slot] = 0
-            self._cur[slot] = 0
-            self._slots[slot] = None
+            self._finish_slot(slot, notify=notify)
+
+    def _finish_slot(self, slot: int, notify: bool = True) -> None:
+        """Terminal "done" teardown for one slot: free pages, release
+        cache pins, park on scratch. ``notify=False`` (the macro-drain
+        path, r19) defers _notify_complete to the delivery phase so
+        the request's ring tokens stream before its completion —
+        delivery calls _notify_complete after the last token."""
+        req = self._slots[slot]
+        req.done = True
+        req.state = "done"
+        req.stats.finish_t = time.monotonic()
+        req.stats.tokens_out = len(req.generated)
+        self._finished[req.req_id] = req
+        self._account_req_pages(req)
+        with self._led("done", req.req_id):
+            self.allocator.free(req.req_id)
+            if self._prefix_cache is not None and req.cache_keys:
+                self._prefix_cache.release(req.cache_keys)
+                req.cache_keys = ()
+        self._table[slot] = self._scratch  # park on scratch page
+        self._lens[slot] = 0
+        self._cur[slot] = 0
+        self._slots[slot] = None
+        if notify:
             self._notify_complete(req)
+
+    # -- device-resident multi-step decode (r19) ----------------------------
+    #
+    # multi_step=N turns the per-token launch cadence into one macro
+    # launch per N tokens: _dispatch_macro pre-binds each decoding
+    # slot's growth pages out of its admission reservation and fires
+    # the on-device while_loop program (models/gpt.py
+    # multi_step_decode); JAX async dispatch returns immediately, so
+    # the boundary that DRAINS launch K runs at the top of step K+1 —
+    # the host spends launch K's device time delivering ring K−1's
+    # tokens (on_token/tracing/metrics) and on the serving loop's
+    # inbox/socket work. Admission and chunked prefill run at the
+    # boundary itself, in the drain->dispatch gap: they rewrite the
+    # launch's table/lens/cur inputs and donate the pools, so they
+    # cannot run under an in-flight launch (the device idles for that
+    # window — the N-vs-TTFT trade the README tuning rule names). Every
+    # external entry point that reads or mutates slot state
+    # (expire_deadlines, evict_stalled, dump_inflight, close) flushes
+    # the in-flight launch first, so host state is never stale where
+    # it matters, and _notify_complete streams a request's undelivered
+    # ring tokens before its completion on every terminal path.
+
+    def _dispatch_macro(self) -> bool:
+        """Launch ONE macro program covering up to ``multi_step``
+        decode steps for every decoding slot. Returns True when a
+        launch happened (False: nothing is decoding). Does NOT block:
+        the device handles land in ``_pending_macro`` for the next
+        boundary's drain."""
+        jnp = self._jnp
+        n = self.multi_step
+        reqs: Dict[int, DecodeRequest] = {}
+        active = np.zeros((self.num_slots,), bool)
+        rem = np.zeros((self.num_slots,), np.int32)
+        eos = np.full((self.num_slots,), -1, np.int32)
+        for i, r in enumerate(self._slots):
+            if r is None or r.state != "decoding":
+                continue
+            r_rem = r.max_new_tokens - len(r.generated)
+            active[i] = True
+            rem[i] = r_rem
+            if r.eos_token is not None:
+                eos[i] = int(r.eos_token)
+            # pre-bind the launch's growth pages out of the admission
+            # reservation (PR 4 contract: cannot fail) — the page
+            # table is then a CONSTANT of the program and in-program
+            # appends are pure index writes through it
+            self._ensure_pages(i, r, int(self._lens[i]) + min(n, r_rem))
+            reqs[i] = r
+        if not reqs:
+            return False
+        if self._multi_jit is None:
+            self._multi_jit = self._build_multi_decode()
+        from ..dispatch import count_op_calls
+        args = (self._fresh_state(), self._pools,
+                jnp.asarray(self._table), jnp.asarray(self._lens),
+                jnp.asarray(self._cur), jnp.asarray(active),
+                jnp.asarray(rem), jnp.asarray(eos))
+        t0 = time.monotonic()
+        with count_op_calls() as c:
+            ring, nsteps, cur, lens, act, pools = self._multi_jit(*args)
+        self._record_programs("decode_multi", c.count)
+        if c.count:
+            self._capture_cost("decode_multi", self._multi_jit, args)
+        self._pools = pools
+        self.macro_launches += 1
+        self._pending_macro = {
+            "ring": ring, "nsteps": nsteps, "cur": cur, "lens": lens,
+            "reqs": reqs, "t_dispatch": t0,
+            "launch": self.macro_launches,
+            "dispatch_ms": (time.monotonic() - t0) * 1e3,
+        }
+        return True
+
+    def _drain_macro(self) -> List[Tuple]:
+        """Block on the in-flight macro launch (if any) and fold its
+        ring into host state: generated token lists, per-slot
+        lens/cur, finished-slot teardown (pages freed, reservations
+        returned — notify deferred), the per-launch decode EMA and
+        the step-timeline macro record. Returns the emission schedule
+        ``[(req, token, done)]`` in exact (in-macro step, slot) order
+        — the same order ``multi_step=1`` streams — WITHOUT delivering
+        it: the boundary delivers after the next launch is dispatched
+        (host/device overlap), and _notify_complete flushes a
+        terminating request's share first."""
+        pend = self._pending_macro
+        if pend is None:
+            return []
+        # cleared BEFORE the blocking read: a failed async computation
+        # raises here, and retrying dead handles would only re-raise
+        self._pending_macro = None
+        t_wait = time.monotonic()
+        ring = np.asarray(pend["ring"])  # blocks until the launch ends
+        idle_s = time.monotonic() - t_wait
+        nsteps = int(pend["nsteps"])
+        lens_f = np.asarray(pend["lens"])
+        cur_f = np.asarray(pend["cur"])
+        now = time.monotonic()
+        self._last_macro_t = now
+        dt = now - pend["t_dispatch"]
+        # per-MACRO-LAUNCH decode EMA (the r19 satellite):
+        # decode_ema_s now tracks one dispatch->drain launch window;
+        # per-token estimates derive as ema/multi_step and the
+        # deadline gate charges ceil(need/multi_step) launches
+        # (_deadline_hopeless). First launch is compile-dominated —
+        # skip it, the same warmup rule as the per-token EMA.
+        if self._macro_warm:
+            self.decode_ema_s = dt if self.decode_ema_s is None \
+                else 0.8 * self.decode_ema_s + 0.2 * dt
+        else:
+            self._macro_warm = True
+        reqs = pend["reqs"]
+        emissions: List[Tuple] = []
+        per_step_tokens: List[int] = []
+        for j in range(nsteps):
+            count = 0
+            for i in sorted(reqs):
+                tok = int(ring[i, j])
+                if tok < 0:
+                    continue
+                req = reqs[i]
+                req.generated.append(tok)
+                req.stats.tokens_out = len(req.generated)
+                emissions.append((req, tok, self._finish_due(req)))
+                count += 1
+            per_step_tokens.append(count)
+        for i in sorted(reqs):
+            req = reqs[i]
+            if self._slots[i] is not req:
+                continue  # defensive: slot reassigned (cannot happen
+                # under the flush discipline, but never corrupt it)
+            self._lens[i] = int(lens_f[i])
+            self._cur[i] = int(cur_f[i])
+            if self._finish_due(req):
+                # teardown now (pages/reservations back before the
+                # boundary's admission), notify at delivery — after
+                # the request's ring tokens have streamed
+                self._finish_slot(i, notify=False)
+            if req.trace is not None:
+                req.trace.add("macro_step", pend["t_dispatch"] * 1e6,
+                              now * 1e6, parent=req.span,
+                              step=self.steps + nsteps,
+                              launch=pend["launch"],
+                              steps_run=nsteps,
+                              tokens=int((ring[i, :nsteps] >= 0).sum()))
+        self.steps += nsteps
+        # step-timeline macro record (r16 ring, r19 fields): the entry
+        # committed for THIS boundary carries the drained launch's
+        # attribution; per_token_timeline() reconstructs per-step rows
+        self._tl_add_ms("decode_ms", dt)
+        self._tl_add_ms("overlap_idle_ms", idle_s)
+        self._tl_macro = {
+            "launch": pend["launch"], "steps": nsteps,
+            "tokens": int(sum(per_step_tokens)),
+            "per_step_tokens": per_step_tokens,
+            "ms": round(dt * 1e3, 4),
+            "overlap_idle_ms": round(idle_s * 1e3, 4),
+            "dispatch_ms": round(pend["dispatch_ms"], 4),
+        }
+        return emissions
+
+    def _flush_macro(self) -> None:
+        """EXTERNAL-entry drain: block on any in-flight macro launch
+        AND deliver everything pending immediately — callbacks,
+        completion notifications included. Called by every public
+        entry point that reads or mutates slot state
+        (expire_deadlines, evict_stalled, dump_inflight, close), so
+        outside a boundary there is never a request whose tokens were
+        folded but whose completion is still owed (the resurrection
+        path depends on this: a request finishing inside a flushed
+        launch must answer its client BEFORE the completion hook is
+        detached, or the client hangs). The boundary itself
+        (_macro_multi_step) drains WITHOUT this helper and defers
+        delivery past the next dispatch — that is the overlap."""
+        if self._pending_macro is not None:
+            self._pending_emit.extend(self._drain_macro())
+        self._deliver_pending()
+
+    def _flush_req_emissions(self, req: DecodeRequest) -> None:
+        """Stream ONE request's undelivered ring tokens (terminal-path
+        ordering: tokens before completion). No-op for requests with
+        nothing pending."""
+        if not self._pending_emit:
+            return
+        mine = [e for e in self._pending_emit if e[0] is req]
+        if not mine:
+            return
+        self._pending_emit = [e for e in self._pending_emit
+                              if e[0] is not req]
+        for _req, tok, done in mine:
+            req.last_emit_t = time.monotonic()
+            if req.on_token is not None:
+                req.on_token(req.req_id, tok, done)
+
+    def _deliver_pending(self) -> None:
+        """Deliver the drained emission schedule in order — on_token
+        callbacks, stall-watchdog liveness, completion notifications
+        for requests that finished inside the launch. Runs AFTER the
+        next launch is dispatched, so callback/tracing/metrics work
+        overlaps device compute."""
+        while self._pending_emit:
+            req, tok, done = self._pending_emit.pop(0)
+            req.last_emit_t = time.monotonic()
+            if req.on_token is not None:
+                req.on_token(req.req_id, tok, done)
+            if done and req.done:
+                # the request's terminal bookkeeping ran at drain
+                # (notify deferred to exactly here, after its tokens)
+                self._notify_complete(req)
+
+    def _macro_multi_step(self) -> int:
+        """One multi-step boundary: drain launch K−1, run the host
+        boundary work (deadline/stall sweeps, admission, one chunked-
+        prefill advance), dispatch launch K, then deliver ring K−1's
+        tokens while the device runs K."""
+        emissions = self._drain_macro()
+        if emissions:
+            self._pending_emit.extend(emissions)
+        # the INNER sweeps: the public wrappers would flush-and-
+        # deliver the emissions just drained, forfeiting the overlap
+        self._expire_deadlines_inner()
+        self._evict_stalled_inner()
+        self._admit()
+        if self.num_active == 0:
+            self._deliver_pending()
+            return 0
+        if self.prefill_chunk_tokens is not None:
+            self._advance_prefill_chunk()
+        self._dispatch_macro()
+        self._deliver_pending()
+        return self.num_active
+
+    def per_token_timeline(self) -> List[Dict[str, Any]]:
+        """Step-timeline view with macro-launch entries expanded back
+        into per-token-step rows (the r19 observability contract: the
+        ring marks macro launches; this reconstructs the per-step
+        attribution a per-token engine's ring would have carried).
+        Non-macro entries pass through unchanged."""
+        out: List[Dict[str, Any]] = []
+        for entry in self.timeline:
+            macro = entry.get("macro")
+            if not macro or not macro.get("steps"):
+                out.append(dict(entry))
+                continue
+            nsteps = macro["steps"]
+            base = entry["step"] - nsteps
+            for j, toks in enumerate(macro["per_step_tokens"]):
+                out.append({
+                    "step": base + j + 1,
+                    "ms": round(macro["ms"] / nsteps, 4),
+                    "tokens": toks,
+                    "macro_launch": macro["launch"],
+                    "macro_offset": j,
+                })
+        return out
 
     # -- speculative decoding ----------------------------------------------
 
@@ -2299,14 +2739,17 @@ class ContinuousBatchingEngine:
                       need_len: int) -> None:
         """Grow the slot's page set to cover positions [0, need_len)
         out of the request's reservation (guaranteed: capacity was
-        committed at admission). Speculative mode only — vanilla
-        admission binds every page up front."""
+        committed at admission). Reserve-growth modes only
+        (speculative, and multi-step macro dispatch) — vanilla
+        per-token admission binds every page up front."""
         row = self._table[slot]
         want = -(-need_len // self.page_size)
         missing = [j for j in range(want) if row[j] == self._scratch]
         if not missing:
             return
-        with self._led("spec_grow", req.req_id):
+        reason = ("spec_grow" if self._spec_cfg is not None
+                  else "macro_grow")
+        with self._led(reason, req.req_id):
             pages = self.allocator.alloc_reserved(req.req_id,
                                                   len(missing))
         for j, p in zip(missing, pages):
@@ -2473,6 +2916,13 @@ class ContinuousBatchingEngine:
             self._tl_commit(t_step)
 
     def _step_inner(self) -> int:
+        if self.multi_step > 1 and self._spec_cfg is None:
+            # device-resident multi-step decode (r19): one boundary =
+            # drain launch K−1, boundary scheduling, dispatch launch
+            # K, deliver K−1's ring. Speculative engines keep their
+            # per-step verify cadence (it already amortizes k+1
+            # tokens per launch — spec composes AT the boundary).
+            return self._macro_multi_step()
         self.expire_deadlines()
         self.evict_stalled()
         self._admit()
@@ -2589,6 +3039,16 @@ class ContinuousBatchingEngine:
         — the graceful-drain endpoint bench/tests call on every exit
         path (a drained `run()` followed by close() is the clean
         shutdown; close() mid-flight is the hard stop)."""
+        # multi-step (r19): drain + deliver any in-flight launch so
+        # teardown evictions see current state and streamed tokens
+        # precede every eviction notification. A failed drain means
+        # the launch's tokens never existed for any client — drop it
+        # (anything drained EARLIER still delivers).
+        try:
+            self._flush_macro()
+        except Exception:
+            self._pending_macro = None
+            self._deliver_pending()
         for slot, req in enumerate(self._slots):
             if req is not None:
                 self._evict_slot(slot, "evicted")

@@ -6,7 +6,8 @@ Ragged Paged Attention layout the allocator books. Every page event
 the `PageAllocator` (and the engine's spill/restore device IO) performs
 is appended to a BOUNDED ring with its owner, the engine step it
 happened on, and the reason the engine was touching pages at the time
-(admit / done / deadline / stalled / spec_rollback / close / ...).
+(admit / done / deadline / stalled / spec_rollback / macro_grow —
+the r19 multi-step launch's reservation→page growth — / close / ...).
 
 What this buys:
 

@@ -358,6 +358,86 @@ def paged_page_splice(pools, page, k_blocks, v_blocks,
     }
 
 
+def multi_step_decode(step_fn, pools, table, lens, tokens, active,
+                      rem, eos, num_steps: int, scratch: int):
+    """Device-resident multi-step decode (r19, ROADMAP item 2): run up
+    to ``num_steps`` fused decode steps in ONE on-device
+    ``lax.while_loop`` program, so the host pays one launch + one
+    readback per N tokens instead of per token — the launch/sync
+    boundary was the remaining overhead after PR 8 fused the step to
+    ~one program (the Neptune / FusionStitching locality argument one
+    level up).
+
+    ``step_fn(pools, table, lens, cur) -> (nxt, new_pools,
+    new_lens)`` is the engine's SINGLE-TOKEN decode body — exactly the
+    trace a ``multi_step=1`` launch runs — so every in-program
+    iteration is bit-identical to one host-driven step by
+    construction. The loop only adds the host bookkeeping the engine
+    used to do between launches, in carry form:
+
+    - masking: iteration inputs are re-derived per step — an inactive
+      slot (finished mid-launch, half-prefilled, or empty) sees the
+      scratch-page table at length 0, exactly how ``_decode_step``
+      masks non-decoding slots, so its KV writes land on scratch and
+      its pages are never touched;
+    - early exit: the while_loop stops as soon as EVERY slot has
+      stopped (EOS or budget — nn/decode.py ``masked_carry_advance``,
+      the carry-form twin of the host's ``_finish_due``), so a batch
+      that finishes at iteration j pays j steps, not N;
+    - the token ring: each iteration writes its sampled tokens into a
+      ``[B, num_steps]`` ring (−1 for masked slots), read back ONCE
+      per launch — the host drains it through on_token/tracing at the
+      next boundary while the device runs the following launch.
+
+    Page growth stays host-owned and PRE-BOUND: the engine converts
+    each slot's admission reservation into physical pages covering
+    ``lens + min(num_steps, rem)`` positions BEFORE the launch (the
+    PR 4 reservation machinery guarantees this cannot fail), so the
+    page table is a constant of the program and in-program appends
+    are pure index writes through it.
+
+    Returns ``(ring [B, num_steps] int32, steps_done, cur, lens,
+    active, pools)`` — final carry values the host folds back into
+    its slot state at drain."""
+    import jax
+
+    from ..nn.decode import masked_carry_advance
+
+    b = tokens.shape[0]
+    ring0 = jnp.full((b, num_steps), -1, jnp.int32)
+    emitted0 = jnp.zeros((b,), jnp.int32)
+    rem = rem.astype(jnp.int32)
+    eos = eos.astype(jnp.int32)
+
+    def cond(carry):
+        j, _cur, _lens, act, _emitted, _ring, _pl = carry
+        return jnp.logical_and(j < num_steps, jnp.any(act))
+
+    def body(carry):
+        j, cur, lens_c, act, emitted, ring, pl = carry
+        # per-iteration masking (the _decode_step contract): inactive
+        # slots ride the fixed-shape step parked on the scratch page
+        # at length 0 — defined zeros out, writes land on scratch
+        table_eff = jnp.where(act[:, None], table,
+                              scratch).astype(jnp.int32)
+        lens_eff = jnp.where(act, lens_c, 0).astype(jnp.int32)
+        nxt, pl, _ = step_fn(pl, table_eff, lens_eff, cur)
+        col = jnp.where(act, nxt, -1).astype(jnp.int32)
+        ring = jax.lax.dynamic_update_slice(ring, col[:, None], (0, j))
+        # this iteration appended cur's KV for every active slot —
+        # advance their lengths with the PRE-update mask
+        lens_c = jnp.where(act, lens_c + 1, lens_c)
+        cur, act, emitted = masked_carry_advance(nxt, cur, act,
+                                                 emitted, rem, eos)
+        return (j + 1, cur, lens_c, act, emitted, ring, pl)
+
+    j, cur, lens_c, act, _emitted, ring, pl = jax.lax.while_loop(
+        cond, body,
+        (jnp.asarray(0, jnp.int32), tokens.astype(jnp.int32),
+         lens.astype(jnp.int32), active, emitted0, ring0, pools))
+    return ring, j, cur, lens_c, act, pl
+
+
 def _remat_block(block, x):
     """Run ``block`` under jax.checkpoint as ONE taped op: the pure kernel
     takes (hidden, *param_values) so the eager tape differentiates through

@@ -28,7 +28,7 @@ F = dispatch.wrapped_ops
 
 __all__ = ["BeamSearchDecoder", "dynamic_decode", "sample_token",
            "fused_sample_token", "fused_verify_tokens",
-           "speculative_verify_tokens"]
+           "speculative_verify_tokens", "masked_carry_advance"]
 
 
 # ---------------------------------------------------------------------------
@@ -58,6 +58,35 @@ def sample_token(last, temperature: float = 0.0, top_k=None, key=None):
     key, sub = jax.random.split(key)
     return jax.random.categorical(sub, scaled, axis=-1).astype(
         jnp.int32), key
+
+
+def masked_carry_advance(nxt, cur, active, emitted, rem, eos):
+    """Carry-form sampler update for the device-resident multi-step
+    decode loop (r19, models/gpt.py ``multi_step_decode``): fold one
+    freshly sampled token batch into the ``(cur, active, emitted)``
+    loop carry under the per-slot active mask.
+
+    ``nxt``: [B] int32 tokens this iteration's :func:`sample_token` /
+    :func:`fused_sample_token` produced; ``cur``: [B] the previous
+    carry tokens; ``active``: [B] bool, which slots are still
+    generating; ``emitted``: [B] int32, tokens emitted so far THIS
+    macro launch; ``rem``: [B] int32, each slot's remaining emission
+    budget (``max_new_tokens - len(generated)`` at the boundary);
+    ``eos``: [B] int32 EOS ids (−1 = none — token ids are >= 0, so −1
+    never matches).
+
+    Returns ``(cur', active', emitted')``. The stop rule mirrors the
+    host engine's ``_finish_due`` exactly — a slot stops after
+    emitting EOS or its budget's last token — so an N-step launch's
+    per-slot token streams are bit-identical to N host-driven steps.
+    A stopped slot keeps its last token in ``cur`` and rides the rest
+    of the launch masked (the harness redirects its KV writes to the
+    scratch page), exactly like a parked slot in the per-token
+    engine."""
+    emitted = emitted + active.astype(jnp.int32)
+    stop = (nxt == eos) | (emitted >= rem)
+    new_active = jnp.logical_and(active, jnp.logical_not(stop))
+    return jnp.where(active, nxt, cur), new_active, emitted
 
 
 def _head_logits(hidden, weight, bias, transpose_y: bool):

@@ -53,6 +53,11 @@ CHUNK_COUNT_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
 PAGE_COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                       256.0, 512.0)
 
+# multi-step decode (r19): decode steps executed per macro launch —
+# lives in [1, multi_step]; below-N buckets show early EOS exits
+STEPS_PER_LAUNCH_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
+                            24.0, 32.0, 48.0, 64.0)
+
 
 class Histogram:
     """Fixed-bucket latency histogram with quantiles over a bounded
@@ -365,7 +370,12 @@ class ServingMetrics:
                 # scrape time (tracer counts are monotonic, so the
                 # counter contract holds)
                 "traces_sampled_total", "traces_finished_total",
-                "trace_spans_dropped_total")
+                "trace_spans_dropped_total",
+                # multi-step decode (r19): macro launches — synced
+                # from the engine's lifetime macro_launches counter at
+                # scrape time (monotonic across resurrections is NOT
+                # guaranteed engine-side, so the server accumulates)
+                "macro_steps_total")
 
     def __init__(self, registry: Optional[StatRegistry] = None,
                  prefix: str = "serving",
@@ -414,6 +424,17 @@ class ServingMetrics:
         # footprint was still capacity spent)
         self.request_peak_pages = Histogram(
             f"{prefix}.request_peak_pages", buckets=PAGE_COUNT_BUCKETS)
+        # multi-step decode (r19): decode steps per macro launch
+        # (early-EOS exits land under N) and host time spent BLOCKED
+        # on a macro drain (0-ish = the overlap worked: the device
+        # finished while the host ran the serving loop) — both fed
+        # from step-timeline macro records at scrape time, like
+        # step_ms
+        self.steps_per_launch = Histogram(
+            f"{prefix}.steps_per_launch",
+            buckets=STEPS_PER_LAUNCH_BUCKETS)
+        self.host_overlap_idle_ms = Histogram(
+            f"{prefix}.host_overlap_idle_ms")
 
     def counter(self, name: str):
         return self.registry.get(f"{self.prefix}.{name}")
@@ -441,6 +462,11 @@ class ServingMetrics:
         self.request_peak_pages = Histogram(
             f"{self.prefix}.request_peak_pages",
             buckets=PAGE_COUNT_BUCKETS)
+        self.steps_per_launch = Histogram(
+            f"{self.prefix}.steps_per_launch",
+            buckets=STEPS_PER_LAUNCH_BUCKETS)
+        self.host_overlap_idle_ms = Histogram(
+            f"{self.prefix}.host_overlap_idle_ms")
 
     # -- ingestion ---------------------------------------------------------
 
@@ -593,7 +619,9 @@ class ServingMetrics:
                 "prefill_chunk_ms": self.prefill_chunk_ms,
                 "restore_ms": self.restore_ms,
                 "step_ms": self.step_ms,
-                "request_peak_pages": self.request_peak_pages}
+                "request_peak_pages": self.request_peak_pages,
+                "steps_per_launch": self.steps_per_launch,
+                "host_overlap_idle_ms": self.host_overlap_idle_ms}
 
     def export(self) -> Dict:
         """Fleet-telemetry wire form (r17): exact counters, sampled

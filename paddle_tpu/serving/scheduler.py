@@ -77,9 +77,14 @@ class SLOConfig:
     # the mandatory next admission
     max_bypass: int = 4
     retry_after_ms: int = 1000
-    # chunked prefill (r11): consecutive engine steps a lower-class
-    # prefill chunk may be deferred by higher-class decode before it
-    # runs anyway (the starvation bound of decode-preempts-prefill)
+    # chunked prefill (r11): consecutive ENGINE BOUNDARIES a
+    # lower-class prefill chunk may be deferred by higher-class decode
+    # before it runs anyway (the starvation bound of
+    # decode-preempts-prefill). Units are engine step() calls — with
+    # multi-step decode (r19, multi_step=N) each boundary covers up
+    # to N generated tokens, so a deferral budget of 4 means up to
+    # 4*N decode tokens of delay, not 4; TTFT-sensitive deployments
+    # running large N should shrink this accordingly.
     max_chunk_deferrals: int = 4
     # per-class cap on in-flight half-prefilled debt (tokens) at
     # admission; None = unbounded. A class with zero in-flight debt is
@@ -179,7 +184,15 @@ class SLOScheduler:
         prompt still finishes (the bypass-bound idea applied to the
         prefill budget). With nothing decoding there is nothing to
         protect: the top-ranked chunk always runs (the engine relies
-        on this for drain progress)."""
+        on this for drain progress).
+
+        Multi-step decode (r19): this hook runs once per BOUNDARY, so
+        under ``multi_step=N`` each deferral costs up to N decode
+        tokens of prefill delay and each granted chunk displaces
+        nothing (the chunk runs at the boundary, outside the macro
+        launch) — the deferral bound is a boundary count, exactly as
+        the deadline gate's estimates are per-launch
+        (``decode_ema_s`` tracks one macro launch there)."""
         if not partial:
             return None
         ranked = sorted(partial, key=lambda sr: (

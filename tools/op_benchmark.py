@@ -164,12 +164,39 @@ def promoted_cases():
 
     prefix_restore.op_name = "paged_page_splice"
 
+    def multi_step_decode():
+        # r19 device-resident multi-step decode: the macro loop's
+        # per-iteration hot op — fused decode attention at MID-MACRO
+        # lengths. In-program steps decode at seq_lens that are not
+        # page-aligned (lens grow by one inside the launch between
+        # page boundaries), so this shape class pins the page-walk +
+        # epilogue at the offsets the while_loop body actually runs,
+        # where the fused_decode_step case above pins the boundary-
+        # aligned shape. The whole-loop program is model-shaped (it
+        # contains the transformer), so the op-level case benches its
+        # dominant inner op; bench_all multi_step_decode carries the
+        # end-to-end launches/token A/B.
+        h, d = 8, 64
+        e = h * d
+        n_pages, page = 65, 16
+        kp = _f32(n_pages, page, h, d)
+        vp = _f32(n_pages, page, h, d)
+        table = np.arange(8 * 8, dtype=np.int32).reshape(8, 8)
+        # the _paged_case lens shifted +3 into their pages: iteration
+        # j=3 of a macro launch that started page-aligned
+        lens = np.asarray([128, 115, 99, 83, 67, 51, 35, 19], np.int32)
+        return (_f32(8, 1, h, d), kp, vp, table, lens,
+                _f32(e, e), _f32(e))
+
+    multi_step_decode.op_name = "paged_attention_fused"
+
     return {"paged_attention_head_sharded": _paged_case,
             "prefill_chunk_step": _prefill_chunk_case,
             "fused_decode_step": fused_decode_step,
             "fused_verify": fused_verify,
             "fused_sample": fused_sample,
-            "prefix_restore": prefix_restore}
+            "prefix_restore": prefix_restore,
+            "multi_step_decode": multi_step_decode}
 
 
 def bench_op(name: str, make_args, repeat: int) -> dict:
