@@ -316,6 +316,13 @@ class RequestStats:
     restored_disk_pages: int = 0
     restore_corrupt: int = 0       # corrupt blobs hit (fell back typed)
     restore_ms: float = 0.0
+    # disaggregated serving (r20): pages spliced in whose blobs were
+    # FETCHED from a peer replica over the wire (a subset of
+    # restored_pages — the fetched-vs-restored split), and the wall
+    # time the server's connection thread spent on the fetch RPC
+    # (off the engine thread; decode never waits on the wire)
+    handoff_pages: int = 0
+    handoff_ms: float = 0.0
     prompt_pages: int = 0          # shareable full pages in the prompt
     cache_enabled: bool = False    # a prefix cache was configured
     prefill_attempts: int = 0      # 1 = first try succeeded
@@ -419,6 +426,11 @@ class DecodeRequest:
     # lifecycle-stage span (queue -> prefill -> decode)
     trace: Any = None
     span: Any = None
+    # disaggregated serving (r20): True marks a handoff-blocking
+    # prefill job (a prefill-class replica's prefill_only request —
+    # a decode replica is waiting on its chain), which the SLO
+    # scheduler boosts by cfg.handoff_boost priority levels
+    handoff: bool = False
 
     @property
     def tokens(self) -> np.ndarray:
@@ -519,6 +531,18 @@ class ContinuousBatchingEngine:
         self.page_size = int(page_size)
         self.num_slots = int(num_slots)
         self.max_seq_len = int(max_seq_len or cfg.max_seq_len)
+        if self.max_seq_len > int(cfg.max_seq_len):
+            # the GPT position table (wpe) has exactly cfg.max_seq_len
+            # rows: positions past it are an out-of-bounds gather whose
+            # jnp fill-mode NaNs poison the shared scratch page and,
+            # through the attention row max, every co-resident slot's
+            # stream — fail typed at construction instead
+            raise ValueError(
+                f"max_seq_len={self.max_seq_len} exceeds the model's "
+                f"position-embedding capacity "
+                f"(cfg.max_seq_len={cfg.max_seq_len}); positions past "
+                f"it would read garbage embeddings. Use a config with "
+                f"a larger max_seq_len")
         self.max_pages = -(-self.max_seq_len // self.page_size)
         self.num_pages = int(num_pages if num_pages is not None
                              else num_slots * self.max_pages)
@@ -772,13 +796,22 @@ class ContinuousBatchingEngine:
                eos_token: Optional[int] = None, priority: int = 1,
                on_token: Optional[Callable[[int, int, bool], None]] = None,
                deadline_t: Optional[float] = None,
-               trace=None, trace_ctx: Optional[Dict] = None) -> int:
+               trace=None, trace_ctx: Optional[Dict] = None,
+               handoff: bool = False,
+               handoff_info: Optional[Dict] = None) -> int:
         """``trace``: an existing RequestTrace to CONTINUE (resurrection
         replay resubmits the in-flight request onto the same tree);
         ``trace_ctx``: a wire context from an upstream hop (the
         failover router) that forces sampling and links this request's
         root under the upstream span. With neither, the engine's own
-        tracer (if any) makes the sampling decision."""
+        tracer (if any) makes the sampling decision.
+
+        Disaggregated serving (r20): ``handoff=True`` marks a
+        handoff-blocking prefill job (scheduler boost);
+        ``handoff_info={"ms": ..., "bytes": ...}`` records the wire
+        fetch the server's connection thread already performed for
+        this request (the fetched blobs sit in the prefix cache's
+        tiers; admission splices them via restore_from_spill)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) + max_new_tokens > self.max_seq_len:
             raise ValueError(
@@ -803,9 +836,12 @@ class ContinuousBatchingEngine:
                             eos_token, priority=int(priority),
                             on_token=on_token,
                             deadline_t=(None if deadline_t is None
-                                        else float(deadline_t)))
+                                        else float(deadline_t)),
+                            handoff=bool(handoff))
         req.stats.submit_t = time.monotonic()
         req.stats.prompt_len = len(prompt)
+        if handoff_info:
+            req.stats.handoff_ms = float(handoff_info.get("ms", 0.0))
         self._next_id += 1
         tr = trace
         if tr is None and self._tracer is not None:
@@ -2081,7 +2117,11 @@ class ContinuousBatchingEngine:
                     self.ledger.record("restore", req.req_id,
                                        pages=rpages)
                 if tr is not None:
+                    # fetched-vs-restored split (r20): how many of the
+                    # restored pages arrived over the wire vs from a
+                    # local eviction's blob
                     tr.end(rsp, pages=len(rkeys),
+                           fetched=rinfo.get("fetched", 0),
                            corrupt=rinfo.get("corrupt", 0))
                 if rkeys:
                     cache.acquire(rkeys)
@@ -2094,6 +2134,7 @@ class ContinuousBatchingEngine:
                     st.restored_disk_pages += rinfo.get("disk", 0)
                     st.restore_corrupt += rinfo.get("corrupt", 0)
                     st.restore_ms += rinfo.get("ms", 0.0)
+                    st.handoff_pages += rinfo.get("fetched", 0)
         cached_len = len(shared) * self.page_size
         capacity = len(req.prompt) + req.max_new_tokens
         need = -(-capacity // self.page_size)

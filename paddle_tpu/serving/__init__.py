@@ -93,7 +93,8 @@ def __getattr__(name):
     # server.py / supervisor.py are lazy so `python -m
     # paddle_tpu.serving.<mod>` does not execute the module twice
     # (runpy re-runs what the package __init__ already imported)
-    if name in ("ServingServer", "client_request"):
+    if name in ("ServingServer", "client_request", "PageFetchFailed",
+                "fetch_page_blobs"):
         from . import server
         return getattr(server, name)
     if name in ("Supervisor", "FailoverRouter", "Replica"):

@@ -375,7 +375,14 @@ class ServingMetrics:
                 # from the engine's lifetime macro_launches counter at
                 # scrape time (monotonic across resurrections is NOT
                 # guaranteed engine-side, so the server accumulates)
-                "macro_steps_total")
+                "macro_steps_total",
+                # disaggregated serving (r20): cross-replica KV
+                # handoff accounting — pages spliced from wire-fetched
+                # blobs, bytes pulled over fetch_pages, and fetch
+                # failures (each one a counted fall-back to local
+                # prefill, never a hang)
+                "handoff_pages_total", "handoff_bytes_total",
+                "handoff_failures_total")
 
     def __init__(self, registry: Optional[StatRegistry] = None,
                  prefix: str = "serving",
@@ -435,6 +442,12 @@ class ServingMetrics:
             buckets=STEPS_PER_LAUNCH_BUCKETS)
         self.host_overlap_idle_ms = Histogram(
             f"{prefix}.host_overlap_idle_ms")
+        # disaggregated serving (r20): wall time of the fetch_pages
+        # RPC a decode replica's connection thread spent pulling a
+        # request's chain from a peer (the number that must sit well
+        # under the prefill it replaces, like restore_ms one wire hop
+        # out)
+        self.handoff_ms = Histogram(f"{prefix}.handoff_ms")
 
     def counter(self, name: str):
         return self.registry.get(f"{self.prefix}.{name}")
@@ -467,6 +480,7 @@ class ServingMetrics:
             buckets=STEPS_PER_LAUNCH_BUCKETS)
         self.host_overlap_idle_ms = Histogram(
             f"{self.prefix}.host_overlap_idle_ms")
+        self.handoff_ms = Histogram(f"{self.prefix}.handoff_ms")
 
     # -- ingestion ---------------------------------------------------------
 
@@ -516,6 +530,15 @@ class ServingMetrics:
             # any terminal state: pages held by a later-evicted
             # request were still pool capacity spent (r18)
             self.request_peak_pages.observe(st.peak_pages)
+        if getattr(st, "handoff_pages", 0) or \
+                getattr(st, "handoff_ms", 0.0):
+            # disaggregated handoff (r20): counted for every terminal
+            # state — the wire fetch and splice happened at admission,
+            # like restore accounting (bytes/failures are counted by
+            # the server at fetch time on the connection thread)
+            self.counter("handoff_pages_total").add(st.handoff_pages)
+            if st.handoff_ms:
+                self.handoff_ms.observe(st.handoff_ms)
         if req.state == "shed":
             self.counter("shed_total").add()
             return
@@ -600,6 +623,7 @@ class ServingMetrics:
             "restore_ms": self.restore_ms.snapshot(),
             "step_ms": self.step_ms.snapshot(),
             "request_peak_pages": self.request_peak_pages.snapshot(),
+            "handoff_ms": self.handoff_ms.snapshot(),
             # live SLO monitor (r17): targets + rolling attainment
             "slo": {"ttft_ms": self.slo.ttft_ms,
                     "tpot_ms": self.slo.tpot_ms,
@@ -621,7 +645,8 @@ class ServingMetrics:
                 "step_ms": self.step_ms,
                 "request_peak_pages": self.request_peak_pages,
                 "steps_per_launch": self.steps_per_launch,
-                "host_overlap_idle_ms": self.host_overlap_idle_ms}
+                "host_overlap_idle_ms": self.host_overlap_idle_ms,
+                "handoff_ms": self.handoff_ms}
 
     def export(self) -> Dict:
         """Fleet-telemetry wire form (r17): exact counters, sampled

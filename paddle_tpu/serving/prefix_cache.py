@@ -398,9 +398,13 @@ class _Entry:
     parent: Optional[bytes]
     page: int
     tokens: np.ndarray            # the block's tokens (collision guard)
-    refcount: int = 0             # active requests holding this entry
+    refcount: int = 0             # active requests holding this one
     children: int = 0             # child entries chaining off this one
     last_used: int = 0            # LRU tick
+    head: Optional[bytes] = None  # memoized chain head (r20): fixed at
+                                  # insert (the parent chain never
+                                  # changes), keeps the per-probe
+                                  # advertisement recency pass O(N)
 
 
 class PrefixCache:
@@ -463,6 +467,20 @@ class PrefixCache:
         # affinity advertisement also covers spilled-but-restorable
         # prefixes); pruned lazily in advertised_keys()
         self._tier_heads: set = set()
+        # disaggregated serving (r20): chain membership of spilled
+        # entries by head key. Eviction is leaf-first, so at spill time
+        # the parent path is still device-resident and the head is
+        # computable — this is what lets fetch_pages expand a head into
+        # its full chain even after parts of it left the device tier.
+        self._spilled_by_head: Dict[bytes, set] = {}
+        # keys whose tier blobs arrived over the WIRE (fetch_pages
+        # import) rather than from a local eviction — consumed by
+        # restore_from_spill to report the fetched-vs-restored split
+        self._fetched_keys: set = set()
+        # lifetime wire-handoff counters (r20)
+        self.exported_pages = 0      # blobs served to peers
+        self.imported_pages = 0      # blobs accepted from peers
+        self.import_corrupt = 0      # wire blobs failing re-verify
 
     # -- spill-tier plumbing ------------------------------------------------
 
@@ -588,6 +606,9 @@ class PrefixCache:
             blob = blob[:-1] + bytes([blob[-1] ^ 0xFF])
         self.tiers[0].put(ent.key, blob)
         self.spilled_pages += 1
+        head = self._head_of(ent.key)
+        if head is not None:
+            self._spilled_by_head.setdefault(head, set()).add(ent.key)
         if ent.parent is None:
             self._tier_heads.add(ent.key)
 
@@ -610,7 +631,7 @@ class PrefixCache:
         Caller acquires the full chain afterwards, exactly like device
         hits."""
         info: Dict[str, Any] = {t.name: 0 for t in self.tiers}
-        info.update(corrupt=0, ms=0.0)
+        info.update(corrupt=0, ms=0.0, fetched=0)
         if not self.spill_enabled or self._splice_page is None:
             return (), [], info
         chain = self._memo_chain(prompt, memo)
@@ -700,17 +721,192 @@ class PrefixCache:
                 self._entries[key] = _Entry(key, parent, page,
                                             np.array(block, np.int32),
                                             refcount=0,
-                                            last_used=self._tick)
+                                            last_used=self._tick,
+                                            head=self._memo_head(
+                                                key, parent))
                 if parent is not None:
                     self._entries[parent].children += 1
                 self.tier_hit_pages[tname] += 1
                 info[tname] += 1
+                if key in self._fetched_keys:
+                    # this page's blob arrived over the wire (r20
+                    # handoff) — the fetched-vs-restored split the
+                    # trace span and RequestStats report
+                    info["fetched"] += 1
+                    self._fetched_keys.discard(key)
         if new_keys or info["corrupt"]:
             ms = (time.perf_counter() - t0) * 1e3
             info["ms"] = ms
             self.last_restore_ms = ms
             self.restored_pages += len(new_keys)
         return tuple(new_keys), new_pages, info
+
+    # -- wire handoff (r20 disaggregated serving) ----------------------------
+
+    def _memo_head(self, key: bytes, parent: Optional[bytes]
+                   ) -> bytes:
+        """Chain head for a new entry: the parent's memoized head (the
+        parent is resident at insert — chains build root-first), else
+        this key IS the head."""
+        if parent is None:
+            return key
+        pent = self._entries.get(parent)
+        if pent is not None and pent.head is not None:
+            return pent.head
+        return self._walk_head(parent) or key
+
+    def _head_of(self, key: bytes) -> Optional[bytes]:
+        """Chain head of a resident entry — the insert-time memo, with
+        the parent walk as a defensive fallback."""
+        ent = self._entries.get(key)
+        if ent is None:
+            return None
+        if ent.head is not None:
+            return ent.head
+        return self._walk_head(key)
+
+    def _walk_head(self, key: bytes) -> Optional[bytes]:
+        """Walk parents to the chain head. Eviction is leaf-first, so
+        every device entry's parent path is fully resident — the walk
+        only returns None on a key the cache does not know."""
+        ent = self._entries.get(key)
+        if ent is None:
+            return None
+        while ent.parent is not None:
+            parent = self._entries.get(ent.parent)
+            if parent is None:
+                return None  # defensive: cannot happen leaf-first
+            ent = parent
+        return ent.key
+
+    def _tier_blob(self, key: bytes) -> Optional[bytes]:
+        """Read a tier blob WITHOUT touching the tier hit/miss stats
+        (those describe restore traffic; wire exports are a different
+        consumer). Recency is still refreshed — a chain being handed
+        off is hot by definition."""
+        for t in self.tiers:
+            if t.contains(key):
+                try:
+                    blob = t._load(key)
+                except OSError:
+                    continue
+                t.touch(key)
+                return blob
+        return None
+
+    def chain_keys_for(self, prompt) -> List[bytes]:
+        """The prompt's full chain keys (pure hashing, no state) — how
+        a decode-class replica names the pages it wants to fetch."""
+        return [k for k, _p, _b in self._chain_keys(prompt)]
+
+    def expand_heads(self, heads: Sequence[bytes]) -> List[bytes]:
+        """Every chain key reachable from ``heads``: the device-tier
+        subtree (via a reverse child index) plus members recorded at
+        spill time (``_spilled_by_head``). This is how ``fetch_pages``
+        serves a whole chain when the caller only knows the advertised
+        head (the drain-handoff path)."""
+        children: Dict[bytes, List[bytes]] = {}
+        for e in self._entries.values():
+            if e.parent is not None:
+                children.setdefault(e.parent, []).append(e.key)
+        out: List[bytes] = []
+        seen: set = set()
+        for head in heads:
+            stack = [head]
+            while stack:
+                k = stack.pop()
+                if k in seen:
+                    continue
+                seen.add(k)
+                out.append(k)
+                stack.extend(children.get(k, ()))
+            for k in sorted(self._spilled_by_head.get(head, ())):
+                if k not in seen:
+                    seen.add(k)
+                    out.append(k)
+        return out
+
+    def export_blobs(self, keys: Sequence[bytes]
+                     ) -> Tuple[Dict[bytes, bytes], List[bytes]]:
+        """Serve chain pages to a peer replica (the ``fetch_pages``
+        wire op, engine thread): tier blobs are returned as stored
+        (their crc travels with them), device-resident pages are
+        packed fresh through the same ``pack_page_blob`` format the
+        spill path writes. Returns (blobs by key, missing keys) —
+        a key this cache cannot produce is MISSING, never an error
+        (the peer's chained-prefill fallback covers it)."""
+        blobs: Dict[bytes, bytes] = {}
+        missing: List[bytes] = []
+        for key in keys:
+            blob = self._tier_blob(key) if self.tiers else None
+            if blob is None:
+                ent = self._entries.get(key)
+                if ent is not None and self._read_page is not None:
+                    try:
+                        blob = pack_page_blob(self._read_page(ent.page))
+                    except Exception:
+                        blob = None
+            if blob is None:
+                missing.append(key)
+            else:
+                blobs[key] = blob
+                self.exported_pages += 1
+        return blobs, missing
+
+    def import_blobs(self, blobs: Dict[bytes, bytes],
+                     heads: Sequence[bytes] = ()) -> Dict[str, int]:
+        """Accept fetched chain pages from a peer (decode-replica side
+        of the handoff, engine thread): every blob is crc RE-VERIFIED on
+        receipt (a torn wire transfer is a counted skip, never spliced
+        KV), keys already device-resident are skipped, and the rest
+        land in the first spill tier exactly like a local eviction —
+        the existing ``restore_from_spill`` splice path picks them up
+        at admission. ``heads`` marks chain heads for the affinity
+        advertisement. Returns {imported, corrupt, skipped, dropped,
+        bytes} — ``dropped`` counts blobs the byte-budgeted tiers
+        could not keep (they re-fetch or re-prefill on first use),
+        so the reply never claims pages that did not land."""
+        report = {"imported": 0, "corrupt": 0, "skipped": 0,
+                  "dropped": 0, "bytes": 0}
+        if not self.tiers:
+            report["skipped"] = len(blobs)
+            return report
+        # lazy bound on the fetched-key record: a wire blob the tier
+        # LRU has since evicted can never be restored, so its
+        # fetched-split marker is dead weight on a long-lived replica
+        if self._fetched_keys:
+            self._fetched_keys = {
+                k for k in self._fetched_keys
+                if any(t.contains(k) for t in self.tiers)}
+        landed = []
+        for key, blob in blobs.items():
+            if key in self._entries:
+                report["skipped"] += 1
+                continue
+            try:
+                unpack_page_blob(blob)
+            except SpillCorrupt:
+                self.import_corrupt += 1
+                report["corrupt"] += 1
+                continue
+            self.tiers[0].put(key, blob)
+            landed.append((key, len(blob)))
+        # count (and mark) only blobs resident AFTER the whole batch:
+        # put() may demote to a deeper tier or drop an oversize blob
+        # outright, and a LATER blob's put can LRU-evict an earlier
+        # import — the reply must never claim pages that did not land
+        for key, nbytes in landed:
+            if not any(t.contains(key) for t in self.tiers):
+                report["dropped"] += 1
+                continue
+            self._fetched_keys.add(key)
+            self.imported_pages += 1
+            report["imported"] += 1
+            report["bytes"] += nbytes
+        for h in heads:
+            if any(t.contains(h) for t in self.tiers):
+                self._tier_heads.add(h)
+        return report
 
     # -- insertion ---------------------------------------------------------
 
@@ -762,7 +958,8 @@ class PrefixCache:
             self._tick += 1
             self._entries[key] = _Entry(key, parent, page,
                                         np.array(block, np.int32),
-                                        refcount=1, last_used=self._tick)
+                                        refcount=1, last_used=self._tick,
+                                        head=self._memo_head(key, parent))
             if parent is not None:
                 self._entries[parent].children += 1
             self.inserted_pages += 1
@@ -842,6 +1039,8 @@ class PrefixCache:
         for t in self.tiers:
             t.clear()
         self._tier_heads.clear()
+        self._spilled_by_head.clear()
+        self._fetched_keys.clear()
 
     # -- audits ------------------------------------------------------------
 
@@ -871,27 +1070,52 @@ class PrefixCache:
         return out
 
     def advertised_keys(self, limit: int = 128) -> List[str]:
+        """Back-compat wrapper over :meth:`advertised_keys_info`."""
+        return self.advertised_keys_info(limit)["keys"]
+
+    def advertised_keys_info(self, limit: int = 128) -> Dict[str, Any]:
         """Chain-HEAD keys (hex) this cache can serve a prefix for —
         device-resident heads plus heads whose blob still sits in a
-        spill tier. This is the affinity advertisement the server's
-        health reply carries and the failover router steers on
-        (serving/supervisor.py); it is a routing HINT, so staleness is
-        benign and the list is recency-capped."""
-        heads = sorted((e for e in self._entries.values()
-                        if e.parent is None),
-                       key=lambda e: -e.last_used)
-        out = [e.key.hex() for e in heads[:limit]]
+        spill tier — ordered by the most recent touch ANYWHERE in the
+        head's chain (r20 fix: a head entry's own ``last_used`` goes
+        stale the moment traffic only touches deeper blocks, which
+        made a hot deep chain fall off a truncated advertisement
+        first). Returns ``{"keys": [...], "truncated": bool}`` so the
+        router can distinguish "not resident" from "not advertised"
+        on a replica holding more heads than ``limit``. This is the
+        affinity advertisement the server's health reply carries and
+        the failover router steers on (serving/supervisor.py); it is
+        a routing HINT, so staleness is benign."""
+        # recency of a head = max last_used over its chain: one parent
+        # walk per entry (leaf-first eviction keeps parent paths
+        # resident, so the walk always terminates at a head)
+        recency: Dict[bytes, int] = {}
+        for e in self._entries.values():
+            head = self._head_of(e.key)
+            if head is not None:
+                recency[head] = max(recency.get(head, 0), e.last_used)
+        ordered = sorted(recency, key=lambda k: -recency[k])
+        out = [k.hex() for k in ordered[:limit]]
         seen = set(out)
+        extra = 0
         for k in list(self._tier_heads):
             if k in self._entries:
                 continue  # already advertised (or will be) as device
             if any(t.contains(k) for t in self.tiers):
-                if len(out) < limit and k.hex() not in seen:
+                if k.hex() in seen:
+                    continue
+                if len(out) < limit:
                     out.append(k.hex())
                     seen.add(k.hex())
+                else:
+                    extra += 1
             else:
+                # the head's blob left every tier: drop it from the
+                # advertisement AND its spilled-chain membership record
                 self._tier_heads.discard(k)
-        return out
+                self._spilled_by_head.pop(k, None)
+        return {"keys": out,
+                "truncated": bool(len(ordered) > limit or extra)}
 
     def check_consistent(self, allocator) -> None:
         """Drained-engine audit: every page the allocator still sees as

@@ -91,6 +91,13 @@ class SLOConfig:
     # always admissible (the cap bounds concurrency, never locks a
     # class out).
     max_prefill_debt_tokens: Optional[int] = None
+    # disaggregated serving (r20): priority levels granted to a
+    # HANDOFF-BLOCKING prefill job (a prefill-class replica's
+    # prefill_only request — the router is mid-handoff and a decode
+    # replica is literally waiting on the chain, so it must not queue
+    # behind a BATCH backlog). Capped at INTERACTIVE like promotion;
+    # 0 restores the pre-r20 ordering.
+    handoff_boost: int = 1
 
 
 class SLOScheduler:
@@ -120,7 +127,12 @@ class SLOScheduler:
         waited = max(0.0, now - req.stats.submit_t)
         promo = int(waited / self.cfg.promote_after_s) \
             if self.cfg.promote_after_s > 0 else 0
-        return min(int(Priority.INTERACTIVE), req.priority + promo)
+        # handoff-blocking prefill jobs (r20) jump handoff_boost
+        # levels: a decode replica is stalled on this chain
+        boost = (self.cfg.handoff_boost
+                 if getattr(req, "handoff", False) else 0)
+        return min(int(Priority.INTERACTIVE),
+                   req.priority + promo + boost)
 
     def select(self, queue: List, fits: Callable[[object], bool],
                now: float) -> Optional[int]:
@@ -153,11 +165,14 @@ class SLOScheduler:
         often it was bypassed. Duck-typed: the engine attaches this to
         the queue span's close when the scheduler provides it."""
         eff = self.effective_priority(req, now)
-        return {"priority": int(req.priority),
-                "effective_priority": int(eff),
-                "promoted": bool(eff > req.priority),
-                "waited_ms": round(
-                    max(0.0, now - req.stats.submit_t) * 1e3, 3)}
+        out = {"priority": int(req.priority),
+               "effective_priority": int(eff),
+               "promoted": bool(eff > req.priority),
+               "waited_ms": round(
+                   max(0.0, now - req.stats.submit_t) * 1e3, 3)}
+        if getattr(req, "handoff", False):
+            out["handoff"] = True  # handoff-blocking prefill (r20)
+        return out
 
     def note_admitted(self, req, queue: List, now: float) -> None:
         """Called by the engine AFTER an admission COMMITS: charge one

@@ -39,6 +39,31 @@ Newline-JSON protocol (one JSON object per line, both directions):
     -> {"op": "drain"}     # stop admitting, finish in-flight, close
     -> {"op": "leak_check"}  # engine-thread page-accounting audit
                              # (+ page-ledger reconciliation, r18)
+    -> {"op": "fetch_pages"}  # disaggregated serving (r20): serve
+                              # chain-page KV blobs (base64, crc32
+                              # inside) to a peer replica by exact
+                              # chain key and/or chain head (heads
+                              # are expanded server-side); keys this
+                              # replica cannot produce come back in
+                              # "missing" — absence is never an error
+    -> {"op": "prefetch"}  # pull a PEER's chains into this replica's
+                           # spill tiers (the drain-handoff receiving
+                           # side): {"host","port","heads":[hex...]}
+                           # — fetch on the conn thread, crc-verified
+                           # import on the engine thread
+
+Disaggregated roles (r20): ``--role prefill`` serves prefill_only
+requests (admission + chunked prefill; the finished chain parks in
+its cache/tiers, the reply is a prefill-ack with the chain keys) and
+rejects plain generates typed (WrongRole); ``--role decode`` accepts
+a router-supplied ``"fetch_from": {"host", "port"}`` hint on generate
+— the conn thread pulls the prompt's chain blobs from that peer
+(fetch_pages), the engine imports them into the spill tiers, and
+admission SPLICES them in instead of re-prefilling (greedy outputs
+bit-identical handoff-vs-local; any fetch failure is a counted,
+typed-internal PageFetchFailed fall-back to local prefill, never a
+hang). ``--role mixed`` (default) is byte-for-byte the pre-r20
+replica.
 
 End-to-end tracing (r16): ``--trace-sample R`` samples a fraction R of
 requests into per-request span trees (serving/tracing.py) covering
@@ -134,7 +159,8 @@ from .prefix_cache import PrefixCache
 from .scheduler import Priority, ServerOverloaded, SLOScheduler
 from .tracing import SpanTracer, stderr_span_sink
 
-__all__ = ["ServingServer", "client_request"]
+__all__ = ["ServingServer", "client_request", "PageFetchFailed",
+           "fetch_page_blobs"]
 
 import os as _os
 
@@ -147,6 +173,61 @@ import os as _os
 
 _PRIORITIES = {"batch": Priority.BATCH, "normal": Priority.NORMAL,
                "interactive": Priority.INTERACTIVE}
+
+_ROLES = ("mixed", "prefill", "decode")
+
+
+class PageFetchFailed(ConnectionError):
+    """A cross-replica page fetch (the r20 ``fetch_pages`` wire op)
+    could not deliver usable blobs: peer dead, transport torn, typed
+    peer error, or a malformed payload. ALWAYS recoverable — the
+    caller falls back to local (chained) prefill, so the client sees
+    identical greedy tokens, never a hang; the socket timeout bounds
+    the wait and ``handoff_failures_total`` counts the fallback."""
+
+
+def fetch_page_blobs(host: str, port: int, keys=None, heads=None,
+                     timeout_s: float = 30.0):
+    """Client side of the ``fetch_pages`` wire op: pull chain-page
+    blobs from a peer replica. ``keys`` are exact chain keys (bytes or
+    hex); ``heads`` are chain heads the PEER expands to their full
+    chains (device subtree + spilled members — the drain-handoff
+    path). Returns ``(blobs: {key_bytes: blob_bytes}, missing_hex,
+    bytes_total)``; raises :class:`PageFetchFailed` on any transport
+    or protocol failure. Blob integrity is NOT checked here — the
+    importer re-verifies every crc32 before a blob can ever reach a
+    splice (serving/prefix_cache.py ``import_blobs``)."""
+    import base64
+
+    def hexes(ks):
+        return [k.hex() if isinstance(k, bytes) else str(k)
+                for k in ks]
+
+    payload: Dict[str, Any] = {"op": "fetch_pages"}
+    if keys:
+        payload["keys"] = hexes(keys)
+    if heads:
+        payload["heads"] = hexes(heads)
+    try:
+        reply = client_request(host, int(port), payload,
+                               timeout_s=timeout_s)
+    except Exception as e:
+        raise PageFetchFailed(f"{type(e).__name__}: {e}")
+    if not isinstance(reply, dict) or reply.get("error"):
+        raise PageFetchFailed(
+            f"{reply.get('error')}: {reply.get('reason')}"
+            if isinstance(reply, dict) else "non-object reply")
+    blobs: Dict[bytes, bytes] = {}
+    total = 0
+    try:
+        for khex, b64 in (reply.get("blobs") or {}).items():
+            blob = base64.b64decode(b64)
+            blobs[bytes.fromhex(khex)] = blob
+            total += len(blob)
+    except Exception as e:
+        raise PageFetchFailed(f"malformed blob payload: "
+                              f"{type(e).__name__}: {e}")
+    return blobs, list(reply.get("missing") or ()), total
 
 
 class _Pending:
@@ -188,8 +269,28 @@ class ServingServer:
                  slo_window_s: float = 120.0,
                  flight_dir: Optional[str] = None,
                  flight_budget_bytes: int = 64 << 20,
+                 role: str = "mixed",
+                 handoff_timeout_s: float = 30.0,
                  **engine_kwargs):
         from ..distributed.resilience import get_retry_policy
+
+        # disaggregated serving (r20): "mixed" (the default) is
+        # byte-for-byte the pre-r20 replica. "prefill" runs admission
+        # + (chunked) prefill only — plain generate ops get a typed
+        # WrongRole; finished chains park in its cache/tiers and are
+        # advertised for peers to fetch. "decode" serves streams and
+        # pulls advertised chains over fetch_pages instead of
+        # re-prefilling. Both non-mixed roles need a spill tier (the
+        # parking lot / wire landing zone), so one is defaulted when
+        # the caller configured none.
+        if role not in _ROLES:
+            raise ValueError(f"role must be one of {_ROLES}; got "
+                             f"{role!r}")
+        self.role = role
+        self.handoff_timeout_s = float(handoff_timeout_s)
+        if role != "mixed" and prefix_cache and spill_bytes is None \
+                and spill_dir is None:
+            spill_bytes = 64 << 20
 
         # end-to-end tracing (r16): one tracer shared by the server
         # and its (resurrected) engines so a request's span tree spans
@@ -630,6 +731,10 @@ class ServingServer:
                     replay_prompt, max_new_tokens=remaining,
                     eos_token=req.eos_token, priority=req.priority,
                     deadline_t=req.deadline_t, on_token=on_token,
+                    # a handoff-blocking prefill job keeps its boost
+                    # across resurrection — a decode replica is still
+                    # waiting on the chain (r20)
+                    handoff=getattr(req, "handoff", False),
                     # continue the original span tree on the rebuilt
                     # engine — queue/admit/prefill/decode spans of the
                     # replay append after the resurrect_replay marker
@@ -699,12 +804,48 @@ class ServingServer:
                 pending.outbox.put(self._leak_check())
                 pending.outbox.put(None)
                 continue
+            if payload.get("ctl") == "fetch_pages":
+                # r20 handoff serving side: pack/serve chain blobs ON
+                # the engine thread — device reads (pack_page_blob via
+                # _read_page) and tier index walks must not race a
+                # step's pool donation or LRU mutation
+                pending.outbox.put(self._serve_fetch_pages(payload))
+                pending.outbox.put(None)
+                continue
+            if payload.get("ctl") == "import_blobs":
+                # r20 handoff/prefetch receiving side: tier puts are
+                # engine-thread state (the conn thread already did the
+                # network pull; this is dict inserts + crc checks)
+                pending.outbox.put(self._import_blobs(payload))
+                pending.outbox.put(None)
+                continue
 
             def on_token(rid, tok, done, _p=pending):
                 if _p.stream:
                     _p.outbox.put({"rid": rid, "token": int(tok),
                                    "done": bool(done)})
 
+            # r20: a generate that rode a wire handoff carries the
+            # fetched blobs — import them into the cache tiers NOW so
+            # this request's admission restores+splices them instead
+            # of re-prefilling (corrupt blobs are dropped counted by
+            # the crc re-verify; missing ones fall to chained prefill)
+            handoff_info = None
+            ho = payload.pop("_handoff", None)
+            if ho is not None:
+                pc = self.prefix_cache
+                if pc is not None and getattr(pc, "tiers", None):
+                    rep = pc.import_blobs(ho["blobs"],
+                                          heads=ho.get("heads", ()))
+                    handoff_info = {"ms": ho["ms"], "bytes": ho["bytes"],
+                                    "imported": rep["imported"],
+                                    "corrupt": rep["corrupt"]}
+                    if rep["corrupt"] and not rep["imported"]:
+                        # every fetched blob failed its crc re-verify:
+                        # the handoff delivered nothing — a counted
+                        # fallback to local prefill
+                        self.metrics.counter(
+                            "handoff_failures_total").add()
             try:
                 rid = self.engine.submit(
                     np.asarray(payload["prompt"], np.int32),
@@ -713,6 +854,11 @@ class ServingServer:
                     priority=payload.get("priority", Priority.NORMAL),
                     deadline_t=payload.get("deadline_t"),
                     on_token=on_token,
+                    # a prefill_only job is handoff-blocking: the
+                    # router is mid-handoff and a decode replica waits
+                    # on this chain (scheduler boost, r20)
+                    handoff=bool(payload.get("handoff")),
+                    handoff_info=handoff_info,
                     # upstream trace context (the failover router's
                     # forward span) forces sampling and links this
                     # replica's tree under the router's; without it
@@ -1020,6 +1166,32 @@ class ServingServer:
             self._wake.set()
             self._await_outbox(pending, send)
             return
+        if op == "fetch_pages":
+            # disaggregated serving (r20): serve chain-page blobs to a
+            # peer replica. Keys/heads are hex chain keys; answered on
+            # the ENGINE thread (device reads + tier walks must not
+            # race a step).
+            keys = self._parse_hex_keys(msg.get("keys"))
+            heads = self._parse_hex_keys(msg.get("heads"))
+            if keys is None or heads is None or not (keys or heads):
+                send({"error": "BadRequest",
+                      "reason": "fetch_pages needs 'keys' and/or "
+                                "'heads' as lists of hex chain keys"})
+                return
+            pending = _Pending(stream=False)
+            self._inbox.put(({"ctl": "fetch_pages", "keys": keys,
+                              "heads": heads}, pending))
+            self._wake.set()
+            self._await_outbox(pending, send)
+            return
+        if op == "prefetch":
+            # disaggregated serving (r20): pull a PEER's chains into
+            # this replica's tiers — the drain-handoff receiving side.
+            # The network fetch runs on THIS conn thread (decode never
+            # waits on the wire); the tier import lands on the engine
+            # thread.
+            self._prefetch(msg, send)
+            return
         if op != "generate":
             send({"error": "BadRequest", "reason": f"unknown op {op!r}"})
             return
@@ -1040,7 +1212,27 @@ class ServingServer:
             send({"error": "BadRequest",
                   "reason": "prompt must be a non-empty token list"})
             return
+        prefill_only = bool(msg.get("prefill_only"))
+        if self.role == "prefill" and not prefill_only:
+            # prefill-class replicas run admission + chunked prefill
+            # only; decode streams belong on a decode/mixed replica
+            # (the role-aware router never sends them here)
+            send({"error": "WrongRole", "retryable": True,
+                  "reason": "replica role is 'prefill'; route decode "
+                            "streams through a role-aware router or "
+                            "send prefill_only requests"})
+            return
+        if prefill_only and self.prefix_cache is None:
+            send({"error": "BadRequest",
+                  "reason": "prefill_only needs a prefix cache to "
+                            "park the finished chain in"})
+            return
         mnt = int(msg.get("max_new_tokens", 16))
+        if prefill_only:
+            # the prefill IS the work: one generated token (the
+            # minimum submit) proves the chain complete; the reply is
+            # a prefill-ack carrying the chain keys, not a stream
+            mnt = 1
         if mnt < 1 or mnt > self.max_new_tokens_cap:
             send({"error": "BadRequest",
                   "reason": f"max_new_tokens must be in [1, "
@@ -1066,7 +1258,23 @@ class ServingServer:
             # the budget starts at ARRIVAL: queueing, prefill, decode
             # and any engine resurrection all spend from it
             deadline_t = time.monotonic() + float(dl) / 1e3
-        pending = _Pending(stream=bool(msg.get("stream", False)))
+        # disaggregated handoff (r20): a fetch_from hint names the
+        # peer holding this prompt's chain — pull its blobs on THIS
+        # conn thread before enqueueing (the engine never waits on the
+        # wire; a failed fetch is a counted fall-back to local prefill)
+        handoff = None
+        if not prefill_only and msg.get("fetch_from") is not None:
+            # advisory overload pre-check BEFORE the wire pull: a
+            # request the depth gate will shed must not first spend
+            # up to handoff_timeout_s of peer RPC and churn the spill
+            # tiers with an import it never uses. The authoritative
+            # gate still runs under the admission lock below.
+            check = getattr(self.scheduler, "check_admission", None)
+            if check is not None:
+                check(self.engine.num_queued + self._inbox.qsize())
+            handoff = self._handoff_fetch(msg.get("fetch_from"), prompt)
+        pending = _Pending(stream=bool(msg.get("stream", False))
+                           and not prefill_only)
         with self._admission_lock:
             # submit-time overload gate, atomic with the enqueue so
             # concurrent connections can't all slip under the depth
@@ -1078,22 +1286,30 @@ class ServingServer:
             if not (isinstance(tctx, dict) and
                     isinstance(tctx.get("id"), str)):
                 tctx = None  # malformed/absent: engine sampler decides
-            self._inbox.put(({"prompt": prompt, "max_new_tokens": mnt,
-                              "eos": msg.get("eos"),
-                              "priority": int(_PRIORITIES[prio]),
-                              "deadline_t": deadline_t,
-                              "trace_ctx": tctx},
-                             pending))
+            payload = {"prompt": prompt, "max_new_tokens": mnt,
+                       "eos": msg.get("eos"),
+                       "priority": int(_PRIORITIES[prio]),
+                       "deadline_t": deadline_t,
+                       "trace_ctx": tctx}
+            if prefill_only:
+                payload["handoff"] = True
+            if handoff is not None:
+                payload["_handoff"] = handoff
+            self._inbox.put((payload, pending))
         self._wake.set()
-        self._await_outbox(pending, send)
+        self._await_outbox(pending, send,
+                           transform=(self._prefill_ack(prompt)
+                                      if prefill_only else None))
 
-    def _await_outbox(self, pending: _Pending, send) -> None:
+    def _await_outbox(self, pending: _Pending, send,
+                      transform=None) -> None:
         """Relay one request's outbox to the client until the None
-        sentinel. Closes the submit-vs-shutdown race: if the engine
-        thread has fully EXITED (mere stop() intent is not enough —
-        graceful shutdown still finishes in-flight work and delivers
-        real results), the request can never complete, so answer a
-        typed ServerEvicted instead of hanging."""
+        sentinel (``transform``, when given, rewrites each message —
+        the prefill-ack path). Closes the submit-vs-shutdown race: if
+        the engine thread has fully EXITED (mere stop() intent is not
+        enough — graceful shutdown still finishes in-flight work and
+        delivers real results), the request can never complete, so
+        answer a typed ServerEvicted instead of hanging."""
         while True:
             try:
                 out = pending.outbox.get(timeout=1.0)
@@ -1105,7 +1321,145 @@ class ServingServer:
                 continue
             if out is None:
                 return
-            send(out)
+            send(out if transform is None else transform(out))
+
+    # -- disaggregated serving (r20) ----------------------------------------
+
+    @staticmethod
+    def _parse_hex_keys(val) -> Optional[list]:
+        """[] for absent, None for malformed, else decoded key bytes."""
+        if val is None:
+            return []
+        if not isinstance(val, list):
+            return None
+        out = []
+        for k in val:
+            if not isinstance(k, str):
+                return None
+            try:
+                out.append(bytes.fromhex(k))
+            except ValueError:
+                return None
+        return out
+
+    def _prefill_ack(self, prompt):
+        """Reply transform for prefill_only requests: the engine's
+        done-reply (tokens included) becomes a prefill-ack naming the
+        parked chain — the router hands the KEYS (well, the peer
+        address; the decode side re-derives keys from its own prompt)
+        to the decode hop. Typed errors pass through untouched."""
+        def transform(reply: Dict) -> Dict:
+            if not reply.get("done"):
+                return reply
+            pc = self.prefix_cache
+            chain = []
+            if pc is not None:
+                try:
+                    chain = [k.hex() for k in pc.chain_keys_for(
+                        np.asarray(prompt, np.int32))]
+                except Exception:
+                    chain = []
+            return {"rid": reply.get("rid"), "done": True,
+                    "prefilled": True, "keys": chain,
+                    "page_size": self._page_size, "role": self.role,
+                    "stats": reply.get("stats")}
+        return transform
+
+    def _handoff_fetch(self, ff, prompt) -> Optional[Dict]:
+        """Conn-thread wire pull for a generate carrying a
+        ``fetch_from`` hint: compute the prompt's chain keys (pure
+        hashing), fetch their blobs from the peer, and return the
+        bundle the engine thread imports before submit. ANY failure —
+        malformed hint, dead peer, typed peer error — is a counted
+        fall-back to local prefill (return None), never a hang (the
+        socket timeout bounds the wait) and never a client error."""
+        pc = self.prefix_cache
+        if pc is None or not getattr(pc, "tiers", None) \
+                or not isinstance(ff, dict):
+            return None
+        try:
+            host = str(ff.get("host") or self.host)
+            port = int(ff["port"])
+        except (KeyError, TypeError, ValueError):
+            return None
+        t0 = time.perf_counter()
+        try:
+            chain = pc.chain_keys_for(np.asarray(prompt, np.int32))
+            if not chain:
+                return None  # no full shareable block: nothing to pull
+            blobs, _missing, nbytes = fetch_page_blobs(
+                host, port, keys=chain,
+                timeout_s=self.handoff_timeout_s)
+        except PageFetchFailed as e:
+            self.metrics.counter("handoff_failures_total").add()
+            self.tracer.annotate("handoff_fetch_failed",
+                                 peer=f"{ff.get('host')}:{ff.get('port')}",
+                                 error=str(e)[:200])
+            return None
+        except Exception as e:
+            # chain hashing on a malformed prompt etc: the engine's
+            # BadRequest path owns the reply; no handoff
+            self.metrics.counter("handoff_failures_total").add()
+            self.tracer.annotate("handoff_fetch_failed",
+                                 error=f"{type(e).__name__}: {e}"[:200])
+            return None
+        if not blobs:
+            return None  # peer no longer holds the chain: local prefill
+        self.metrics.counter("handoff_bytes_total").add(nbytes)
+        return {"blobs": blobs, "heads": chain[:1],
+                "ms": (time.perf_counter() - t0) * 1e3,
+                "bytes": nbytes}
+
+    def _prefetch(self, msg: Dict, send) -> None:
+        """The ``prefetch`` op: fetch a peer's chains (by head) into
+        this replica's tiers — the drain-handoff receiving side. Fetch
+        on this conn thread, import on the engine thread; every
+        failure is a typed reply."""
+        keys = self._parse_hex_keys(msg.get("keys"))
+        heads = self._parse_hex_keys(msg.get("heads"))
+        if keys is None or heads is None or not (keys or heads):
+            send({"error": "BadRequest",
+                  "reason": "prefetch needs 'keys' and/or 'heads' as "
+                            "lists of hex chain keys, plus the peer's "
+                            "'host'/'port'"})
+            return
+        pc = self.prefix_cache
+        if pc is None or not getattr(pc, "tiers", None):
+            send({"error": "PageFetchFailed",
+                  "reason": "replica has no spill tier to land "
+                            "fetched pages in"})
+            return
+        try:
+            host = str(msg.get("host") or self.host)
+            port = int(msg["port"])
+        except (KeyError, TypeError, ValueError):
+            send({"error": "BadRequest",
+                  "reason": "prefetch needs the peer's 'port'"})
+            return
+        t0 = time.perf_counter()
+        try:
+            blobs, missing, nbytes = fetch_page_blobs(
+                host, port, keys=keys, heads=heads,
+                timeout_s=self.handoff_timeout_s)
+        except PageFetchFailed as e:
+            self.metrics.counter("handoff_failures_total").add()
+            send({"error": "PageFetchFailed", "reason": str(e)})
+            return
+        self.metrics.counter("handoff_bytes_total").add(nbytes)
+        ms = (time.perf_counter() - t0) * 1e3
+        pending = _Pending(stream=False)
+        self._inbox.put(({"ctl": "import_blobs", "blobs": blobs,
+                          "heads": heads}, pending))
+        self._wake.set()
+
+        def add_fetch_info(reply: Dict) -> Dict:
+            if reply.get("ok"):
+                reply = dict(reply)
+                reply["fetch_ms"] = round(ms, 3)
+                reply["missing"] = missing
+            return reply
+
+        self._await_outbox(pending, send, transform=add_fetch_info)
 
     # -- introspection -----------------------------------------------------
 
@@ -1129,16 +1483,25 @@ class ServingServer:
                     continue
             return fallback
 
+        adv = (racy(lambda: pc.advertised_keys_info(),
+                    {"keys": [], "truncated": False})
+               if pc is not None else {"keys": [], "truncated": False})
         return {"status": "draining" if self._draining else "ok",
                 "active": eng.num_active,
                 "queued": eng.num_queued,
+                # disaggregated serving (r20): the replica's class —
+                # the router's role-aware dispatch input
+                "role": self.role,
                 # cache-affinity routing (r15): the replica's page size
                 # plus the chain-head prefix keys it can serve (device
                 # entries AND spill-tier blobs) — the FailoverRouter
-                # steers keyed requests whose first-block hash matches
+                # steers keyed requests whose first-block hash matches.
+                # truncated=True tells the router "not advertised" may
+                # still be resident (r20 satellite: a capped list must
+                # not read as a miss)
                 "page_size": eng.page_size,
-                "prefix_keys": (racy(lambda: pc.advertised_keys(), [])
-                                if pc is not None else []),
+                "prefix_keys": adv["keys"],
+                "prefix_keys_truncated": adv["truncated"],
                 "free_pages": eng.free_pages,
                 "reserved_pages": racy(
                     lambda: eng.allocator.reserved_total),
@@ -1322,6 +1685,54 @@ class ServingServer:
             mseen = ml
         self._macro_seen = (mkey, mseen)
 
+    # max chain pages served per fetch_pages reply: bounds one reply's
+    # size (a page blob is small — page*H*D*2*itemsize per layer — but
+    # an unbounded key list would let one peer RPC occupy the engine
+    # thread arbitrarily long between steps)
+    FETCH_PAGES_CAP = 512
+
+    def _serve_fetch_pages(self, payload: Dict) -> Dict:
+        """Engine-thread half of the ``fetch_pages`` wire op (r20):
+        expand requested chain heads, pack device-resident pages /
+        read tier blobs, and base64 them for the reply. A key this
+        replica cannot produce is listed in ``missing`` — the peer's
+        chained-prefill fallback covers it, so this op never errors
+        on absence."""
+        import base64
+        pc = self.prefix_cache
+        if pc is None:
+            return {"error": "PageFetchFailed",
+                    "reason": "replica has no prefix cache"}
+        keys = list(payload.get("keys") or ())
+        heads = list(payload.get("heads") or ())
+        if heads:
+            seen = set(keys)
+            keys += [k for k in pc.expand_heads(heads)
+                     if k not in seen]
+        truncated = len(keys) > self.FETCH_PAGES_CAP
+        blobs, missing = pc.export_blobs(keys[:self.FETCH_PAGES_CAP])
+        return {"blobs": {k.hex(): base64.b64encode(b).decode("ascii")
+                          for k, b in blobs.items()},
+                "missing": [k.hex() for k in missing],
+                "count": len(blobs),
+                "bytes": sum(len(b) for b in blobs.values()),
+                "truncated": truncated,
+                "role": self.role}
+
+    def _import_blobs(self, payload: Dict) -> Dict:
+        """Engine-thread half of the ``prefetch`` op (r20 drain
+        handoff): land already-fetched blobs in the cache tiers (crc
+        re-verified per blob by ``import_blobs``)."""
+        pc = self.prefix_cache
+        if pc is None or not getattr(pc, "tiers", None):
+            return {"error": "PageFetchFailed",
+                    "reason": "replica has no spill tier to land "
+                              "fetched pages in"}
+        rep = pc.import_blobs(payload.get("blobs") or {},
+                              heads=payload.get("heads") or ())
+        rep["ok"] = True
+        return rep
+
     def _leak_check(self) -> Dict:
         """Engine-thread page audit: with no in-flight work, the
         allocator must balance (cache-less: everything free; cached:
@@ -1445,7 +1856,12 @@ class ServingServer:
                 "spilled_pages": pc.spilled_pages,
                 "restored_pages": pc.restored_pages,
                 "restore_corrupt": pc.restore_corrupt,
-                "spill_failed": pc.spill_failed}
+                "spill_failed": pc.spill_failed,
+                # disaggregated handoff (r20): blobs served to /
+                # accepted from peer replicas over fetch_pages
+                "exported_pages": getattr(pc, "exported_pages", 0),
+                "imported_pages": getattr(pc, "imported_pages", 0),
+                "import_corrupt": getattr(pc, "import_corrupt", 0)}
 
 
 def _json_stats(stats) -> Dict:
@@ -1500,6 +1916,26 @@ def main(argv=None) -> None:
     parser.add_argument("--num-pages", type=int, default=None)
     parser.add_argument("--max-seq-len", type=int, default=None)
     parser.add_argument("--no-prefix-cache", action="store_true")
+    parser.add_argument(
+        "--role", default="mixed", choices=list(_ROLES),
+        help="disaggregated serving (r20): 'mixed' (default) is the "
+             "full replica, byte-for-byte the pre-r20 behavior. "
+             "'prefill' runs admission + (chunked) prefill only — it "
+             "answers prefill_only requests, parks finished KV chains "
+             "in its cache/spill tiers, advertises them via health "
+             "prefix_keys, and serves them to peers over the "
+             "fetch_pages op (plain generates get a typed WrongRole). "
+             "'decode' serves token streams and, when the router "
+             "supplies a fetch_from hint, pulls the prompt's chain "
+             "from the prefill peer and splices it in instead of "
+             "re-prefilling (greedy outputs bit-identical either "
+             "way). Non-mixed roles default a 64 MB host spill tier "
+             "when none is configured")
+    parser.add_argument(
+        "--handoff-timeout-s", type=float, default=30.0, metavar="S",
+        help="socket timeout of cross-replica fetch_pages pulls; on "
+             "expiry the request falls back to local prefill typed "
+             "(PageFetchFailed is counted, never a hang)")
     parser.add_argument(
         "--spill-mb", type=int, default=None, metavar="MB",
         help="hierarchical prefix cache (r15): add a host-RAM spill "
@@ -1664,6 +2100,8 @@ def main(argv=None) -> None:
         mesh_desc = f"mesh model={mp}"
     server = ServingServer(model, host=args.host, port=args.port,
                            prefix_cache=not args.no_prefix_cache,
+                           role=args.role,
+                           handoff_timeout_s=args.handoff_timeout_s,
                            num_slots=args.num_slots,
                            page_size=args.page_size,
                            max_engine_errors=args.max_engine_errors,

@@ -61,7 +61,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["Replica", "Supervisor", "FailoverRouter",
-           "classify_probe_failure"]
+           "classify_probe_failure", "handoff_chains",
+           "rendezvous_owner"]
 
 
 def _free_port(host: str = "127.0.0.1") -> int:
@@ -140,6 +141,13 @@ class Replica:
         self.prefix_keys: frozenset = frozenset()
         self.page_size: Optional[int] = None
         self.load: int = 0
+        # disaggregated serving (r20): the replica's class (refreshed
+        # from health; the supervisor seeds it from its roles list so
+        # routing is correct from the first probe) and whether its
+        # prefix-key advertisement was recency-capped — a truncated
+        # list means "not advertised" is NOT "not resident"
+        self.role: str = "mixed"
+        self.prefix_truncated: bool = False
         # memory observatory (r18): the replica's latest capacity-op
         # reply (occupancy by owner class + exhaustion forecast),
         # refreshed each healthy probe cycle — fleet_capacity merges
@@ -183,7 +191,8 @@ class Supervisor:
                  ready_timeout_s: float = 300.0,
                  log_dir: Optional[str] = None,
                  collect_metrics: bool = True,
-                 fleet=None):
+                 fleet=None,
+                 roles: Optional[Sequence[str]] = None):
         self.model = model
         self.host = host
         self.server_args = list(server_args)
@@ -216,6 +225,21 @@ class Supervisor:
             os.makedirs(log_dir, exist_ok=True)
         self.replicas: List[Replica] = [Replica(i, host)
                                         for i in range(int(replicas))]
+        # disaggregated roles (r20): one role per replica ("mixed" /
+        # "prefill" / "decode"), threaded to each server as --role and
+        # seeded on the Replica records so the router's role-aware
+        # dispatch is correct from the first probe. A shorter list
+        # pads with "mixed".
+        self.roles: List[str] = []
+        roles = list(roles or ())
+        for i, rep in enumerate(self.replicas):
+            role = roles[i] if i < len(roles) else "mixed"
+            if role not in ("mixed", "prefill", "decode"):
+                raise ValueError(
+                    f"replica role must be mixed/prefill/decode; got "
+                    f"{role!r} for replica {i}")
+            rep.role = role
+            self.roles.append(role)
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -292,6 +316,42 @@ class Supervisor:
         if rep.alive():
             rep.proc.send_signal(sig)
 
+    def drain_replica(self, idx: int, handoff: bool = True,
+                      timeout_s: float = 30.0) -> Dict:
+        """Scale-down drain with prefix-affinity-aware handoff (r20,
+        the missing ROADMAP 3(a) drain): refresh the victim's
+        advertisement, hand its hot chains to the surviving
+        decode-capable replicas through the fetch_pages path (each
+        survivor pulls its rendezvous share DIRECTLY from the victim),
+        then drain the victim — stop admitting, finish in-flight,
+        return every page. The victim process is left alive for the
+        caller to reap (or the monitor to respawn); handoff failures
+        degrade to re-prefill-on-first-use, never block the drain."""
+        rep = self.replicas[idx]
+        report: Dict = {"victim": idx, "handoff": None,
+                        "drained": False}
+        if handoff and rep.alive():
+            heads: List[str] = list(rep.prefix_keys)
+            try:
+                h = _rpc(self.host, rep.port, {"op": "health"},
+                         timeout_s=timeout_s)
+                heads = list(h.get("prefix_keys") or heads)
+            except Exception:
+                pass  # stale advertisement is still worth handing off
+            survivors = [r for r in self.live()
+                         if r.idx != idx and r.role != "prefill"]
+            if heads and survivors:
+                report["handoff"] = handoff_chains(
+                    self.host, rep.port, heads, survivors,
+                    timeout_s=timeout_s)
+        try:
+            _rpc(self.host, rep.port, {"op": "drain"},
+                 timeout_s=timeout_s)
+            report["drained"] = True
+        except Exception as e:
+            report["drain_error"] = f"{type(e).__name__}: {e}"
+        return report
+
     @property
     def restarts_total(self) -> int:
         return sum(r.restarts for r in self.replicas)
@@ -316,6 +376,8 @@ class Supervisor:
         # while every replica shares one server_args list
         extra = [a.replace("{replica}", str(rep.idx))
                  if "{replica}" in a else a for a in self.server_args]
+        if rep.role != "mixed":
+            extra = ["--role", rep.role] + extra
         cmd = [sys.executable, "-m", "paddle_tpu.serving.server",
                "--model", self.model, "--host", self.host,
                "--port", str(rep.port)] + extra
@@ -360,6 +422,11 @@ class Supervisor:
                     try:
                         rep.prefix_keys = frozenset(
                             h.get("prefix_keys") or ())
+                        rep.prefix_truncated = bool(
+                            h.get("prefix_keys_truncated"))
+                        role = h.get("role")
+                        if role in ("mixed", "prefill", "decode"):
+                            rep.role = role
                         ps = h.get("page_size")
                         rep.page_size = int(ps) if ps else None
                         rep.load = (int(h.get("active") or 0)
@@ -491,6 +558,7 @@ class Supervisor:
             supervision[str(r.idx)] = {
                 "port": r.port, "ready": r.ready, "alive": r.alive(),
                 "load": r.load,
+                "role": getattr(r, "role", "mixed"),
                 "restarts": r.restarts,
                 "consec_deaths": r.consec_deaths,
                 "probe_failures": r.probe_failures,
@@ -522,6 +590,60 @@ class Supervisor:
             # drop the dead replica from fleet rollups immediately —
             # not after stale_after_s ages it out
             self.fleet.mark_stale(rep.idx)
+
+
+def rendezvous_owner(key_hex: str, candidates):
+    """Highest-random-weight owner of a chain key among ``candidates``
+    (objects with ``.idx``) — the SAME formula the router's affinity
+    rendezvous uses, so chains handed off at drain time land exactly
+    where future keyed requests will be steered."""
+    return max(candidates, key=lambda r: hashlib.blake2b(
+        f"{key_hex}:{r.idx}".encode(), digest_size=8).digest())
+
+
+def handoff_chains(host: str, victim_port: int,
+                   heads: Sequence[str], survivors,
+                   timeout_s: float = 30.0) -> Dict:
+    """Prefix-affinity-aware drain handoff (r20, ROADMAP 3(a)): ask
+    each survivor to ``prefetch`` its rendezvous share of the victim's
+    advertised chain heads straight from the victim (the blobs never
+    transit this process). ``survivors`` are objects with ``.idx`` and
+    ``.port``. Per-head failures are recorded, never raised — a failed
+    handoff just means the chain is re-prefilled on first use, the
+    same typed fallback as every other fetch path."""
+    report: Dict = {"heads": len(heads), "imported_pages": 0,
+                    "bytes": 0, "failures": [], "per_survivor": {}}
+    if not heads or not survivors:
+        return report
+    assign: Dict[int, List[str]] = {}
+    by_idx = {r.idx: r for r in survivors}
+    for head in heads:
+        assign.setdefault(rendezvous_owner(head, survivors).idx,
+                          []).append(head)
+    for idx, share in assign.items():
+        rep = by_idx[idx]
+        try:
+            reply = _rpc(host, rep.port,
+                         {"op": "prefetch", "host": host,
+                          "port": victim_port, "heads": share},
+                         timeout_s=timeout_s)
+        except Exception as e:
+            report["failures"].append(
+                f"survivor {idx}: {type(e).__name__}: {e}")
+            continue
+        if reply.get("error"):
+            report["failures"].append(
+                f"survivor {idx}: {reply['error']}: "
+                f"{reply.get('reason')}")
+            continue
+        report["imported_pages"] += int(reply.get("imported") or 0)
+        report["bytes"] += int(reply.get("bytes") or 0)
+        report["per_survivor"][str(idx)] = {
+            "heads": len(share),
+            "imported": int(reply.get("imported") or 0),
+            "corrupt": int(reply.get("corrupt") or 0),
+            "skipped": int(reply.get("skipped") or 0)}
+    return report
 
 
 class _BackendLost(ConnectionError):
@@ -566,7 +688,8 @@ class FailoverRouter:
                  no_replica_wait_s: float = 60.0,
                  affinity: bool = True,
                  trace_sample: float = 0.0, tracer=None,
-                 deprioritize_outliers: bool = False):
+                 deprioritize_outliers: bool = False,
+                 disaggregate: bool = True):
         self.sup = supervisor
         self.host = host
         self._requested_port = port
@@ -574,6 +697,18 @@ class FailoverRouter:
         self.backend_timeout_s = float(backend_timeout_s)
         self.no_replica_wait_s = float(no_replica_wait_s)
         self.affinity = bool(affinity)
+        # disaggregated prefill/decode (r20), default ON but inert on
+        # an all-mixed fleet (byte-for-byte the pre-r20 routing): with
+        # prefill-class AND decode-capable replicas live, a keyed
+        # request with a computable first-block key routes
+        # PREFILL-FIRST — the prompt runs as a prefill_only job on a
+        # prefill replica (rendezvous-stable so residency builds),
+        # then the request is dispatched to a decode-capable replica
+        # with a fetch_from hint naming the prefill peer; the decode
+        # side pulls the chain over fetch_pages and splices it instead
+        # of re-prefilling. Every handoff failure degrades to local
+        # prefill, never a hang.
+        self.disaggregate = bool(disaggregate)
         # fleet telemetry (r17), default OFF: steer UNKEYED traffic
         # away from replicas the outlier detector currently flags
         # (slow step-ms/TPOT or erroring vs the fleet median). A
@@ -606,6 +741,13 @@ class FailoverRouter:
         # concurrent connection threads.
         self.affinity_routed_total = 0
         self.affinity_hits_total = 0
+        # disaggregation accounting (r20): handoffs_total counts
+        # requests dispatched with a fetch_from hint (prefill hop run
+        # or chain already parked on a prefill replica);
+        # handoff_prefill_failures_total counts prefill hops that
+        # failed and fell back to plain dispatch (local prefill)
+        self.handoffs_total = 0
+        self.handoff_prefill_failures_total = 0
         # optional routing-event hook: trace({"t": ..., "ev": ...,
         # ...}) — the chaos harness uses it for postmortems
         self.trace = None
@@ -702,12 +844,20 @@ class FailoverRouter:
                   "failovers_total": self.failovers_total,
                   "affinity_routed_total": self.affinity_routed_total,
                   "affinity_hits_total": self.affinity_hits_total,
+                  "disaggregate": self.disaggregate,
+                  "handoffs_total": self.handoffs_total,
+                  "handoff_prefill_failures_total":
+                      self.handoff_prefill_failures_total,
                   "replicas": [{"idx": r.idx, "port": r.port,
                                 "ready": r.ready, "alive": r.alive(),
                                 "restarts": r.restarts,
+                                "role": getattr(r, "role", "mixed"),
                                 "load": getattr(r, "load", 0),
                                 "advertised_prefixes":
-                                    len(getattr(r, "prefix_keys", ()))}
+                                    len(getattr(r, "prefix_keys", ())),
+                                "prefix_keys_truncated":
+                                    getattr(r, "prefix_truncated",
+                                            False)}
                                for r in self.sup.replicas]})
             return
         if op == "trace":
@@ -738,6 +888,10 @@ class FailoverRouter:
                 "affinity_routed_total": self.affinity_routed_total,
                 "affinity_hits_total": self.affinity_hits_total,
                 "deprioritize_outliers": self.deprioritize_outliers,
+                "disaggregate": self.disaggregate,
+                "handoffs_total": self.handoffs_total,
+                "handoff_prefill_failures_total":
+                    self.handoff_prefill_failures_total,
             }
             send({"fleet": stats})
             return
@@ -809,7 +963,8 @@ class FailoverRouter:
             return None  # malformed prompt: backend answers BadRequest
 
     def _pick(self, exclude: set, affinity_key: Optional[str] = None,
-              keyed: bool = False) -> Optional[Replica]:
+              keyed: bool = False,
+              exclude_prefill: bool = False) -> Optional[Replica]:
         """Pick a live replica outside ``exclude``. With an
         ``affinity_key``: an ADVERTISING holder wins (ties:
         least-loaded), else a rendezvous hash over the live set picks
@@ -819,8 +974,13 @@ class FailoverRouter:
         least-loaded (round-robin among load ties); unkeyed requests
         keep the pre-r15 round-robin. Liveness/exclusion filter FIRST
         — affinity is a preference among survivors and can never block
-        failover."""
+        failover. ``exclude_prefill`` (r20 role-aware dispatch) keeps
+        decode streams off prefill-class replicas — they would answer
+        WrongRole."""
         live = [r for r in self.sup.live() if r.idx not in exclude]
+        if exclude_prefill:
+            live = [r for r in live
+                    if getattr(r, "role", "mixed") != "prefill"]
         if not live:
             return None
         if affinity_key is not None:
@@ -904,12 +1064,23 @@ class FailoverRouter:
                 except Exception:
                     pass
 
+        # disaggregated dispatch (r20): keyed requests with a
+        # computable first-block key route PREFILL-FIRST when the
+        # fleet has prefill-class replicas; the returned hint makes
+        # the decode-capable target fetch the chain instead of
+        # re-prefilling. None = plain dispatch (all-mixed fleet,
+        # chain already decode-resident, or the hop failed — counted).
+        handoff_hint = None
+        if self.disaggregate and keyed and affinity_key is not None:
+            handoff_hint = self._plan_handoff(msg, affinity_key, rtr,
+                                              trace, budget_ms, arrival)
         while True:
             # affinity=False restores the pre-r15 keyed routing wholly
             # (round-robin, no least-loaded filter) — the bisect
             # escape hatch MIGRATION.md documents
             rep = self._pick(tried, affinity_key=affinity_key,
-                             keyed=keyed and self.affinity)
+                             keyed=keyed and self.affinity,
+                             exclude_prefill=self.disaggregate)
             trace("pick", rep=None if rep is None else rep.idx,
                   attempts=attempts)
             if rep is None:
@@ -928,6 +1099,12 @@ class FailoverRouter:
                 time.sleep(0.2)
                 continue
             fwd = msg
+            if handoff_hint is not None:
+                # the hint survives failover: if the prefill peer died
+                # meanwhile, the decode side's fetch fails typed and
+                # falls back to local prefill — never a hang
+                fwd = dict(msg)
+                fwd["fetch_from"] = handoff_hint
             if budget_ms is not None and budget_ms > 0:
                 remaining = budget_ms \
                     - (time.monotonic() - arrival) * 1e3
@@ -939,7 +1116,7 @@ class FailoverRouter:
                                     "completion",
                           "tokens_out": progress["relayed"]})
                     return
-                fwd = dict(msg)
+                fwd = dict(fwd)  # preserve any fetch_from hint
                 fwd["deadline_ms"] = remaining
             fs = None
             if rtr is not None:
@@ -999,6 +1176,92 @@ class FailoverRouter:
                     # the next replica
                     rtr.event("failover", parent=rtr.anchor,
                               from_replica=rep.idx, attempt=attempts)
+
+    def _plan_handoff(self, msg: Dict, affinity_key: str, rtr,
+                      trace, budget_ms=None,
+                      arrival: float = 0.0) -> Optional[Dict]:
+        """Decide and (when needed) EXECUTE the prefill half of a
+        disaggregated dispatch (r20). Returns a ``fetch_from`` hint
+        for the decode forward, or None for plain dispatch:
+
+        - no prefill-class or no decode-capable replica live → None
+          (an all-mixed fleet is byte-for-byte pre-r20);
+        - a decode-capable replica already advertises the chain →
+          None (the affinity pick will land there; nothing to ship);
+        - a prefill replica advertises it → hint at that replica,
+          skipping the prefill hop entirely;
+        - otherwise run the prompt as a ``prefill_only`` job on the
+          rendezvous-stable prefill replica (so residency builds on
+          one peer) and hint at it. A failed/typed-error hop is
+          counted and degrades to plain dispatch — local prefill on
+          the decode side, bit-identical output, never a hang.
+
+        Truncation-awareness: a prefill replica advertising a
+        TRUNCATED key list may hold the chain unadvertised; the
+        rendezvous owner is exactly where earlier traffic parked it,
+        and its own prefix cache dedupes the prefill_only job into a
+        cache hit — so the hop is cheap precisely when the
+        advertisement lied by omission."""
+        live = self.sup.live()
+        prefills = [r for r in live
+                    if getattr(r, "role", "mixed") == "prefill"]
+        decodes = [r for r in live
+                   if getattr(r, "role", "mixed") != "prefill"]
+        if not prefills or not decodes:
+            return None
+        if any(affinity_key in getattr(r, "prefix_keys", ())
+               for r in decodes):
+            return None  # already resident where decode will run
+        holder = next((r for r in prefills
+                       if affinity_key in getattr(r, "prefix_keys",
+                                                  ())), None)
+        if holder is not None:
+            with self._lock:
+                self.handoffs_total += 1
+            trace("handoff_hint", rep=holder.idx, prefilled=False)
+            return {"host": self.sup.host, "port": holder.port}
+        target = rendezvous_owner(affinity_key, prefills)
+        pf = {"op": "generate", "prompt": msg.get("prompt"),
+              "max_new_tokens": 1, "prefill_only": True}
+        for k in ("eos", "priority", "key"):
+            if msg.get(k) is not None:
+                pf[k] = msg[k]
+        # the hop spends from the SAME deadline budget as the dispatch
+        # it precedes: forward the remaining ms (the prefill replica's
+        # own deadline gate sheds a hopeless job instead of queueing
+        # it) and bound the RPC wait by it — a request that cannot
+        # afford the hop goes straight to plain dispatch, so
+        # disaggregation never makes a deadline-feasible request fail
+        timeout_s = self.backend_timeout_s
+        if budget_ms is not None and budget_ms > 0:
+            remaining = budget_ms - (time.monotonic() - arrival) * 1e3
+            if remaining <= 0:
+                return None  # dispatch loop answers DeadlineExceeded
+            pf["deadline_ms"] = remaining
+            timeout_s = min(timeout_s, remaining / 1e3 + 1.0)
+        sp = (rtr.begin("prefill_handoff", parent=rtr.anchor,
+                        replica=target.idx)
+              if rtr is not None else None)
+        try:
+            reply = _rpc(self.sup.host, target.port, pf,
+                         timeout_s=timeout_s)
+        except Exception as e:
+            reply = {"error": f"{type(e).__name__}", "reason": str(e)}
+        if not reply.get("prefilled"):
+            with self._lock:
+                self.handoff_prefill_failures_total += 1
+            trace("handoff_prefill_failed", rep=target.idx,
+                  err=reply.get("error"))
+            if rtr is not None:
+                rtr.end(sp, error=str(reply.get("error"))[:120])
+            return None  # plain dispatch: local prefill, bit-identical
+        with self._lock:
+            self.handoffs_total += 1
+        trace("handoff_prefill", rep=target.idx,
+              pages=len(reply.get("keys") or ()))
+        if rtr is not None:
+            rtr.end(sp, pages=len(reply.get("keys") or ()))
+        return {"host": self.sup.host, "port": target.port}
 
     def _forward(self, rep: Replica, msg: Dict, send,
                  progress: Dict[str, int]) -> None:
@@ -1071,6 +1334,21 @@ def main(argv=None) -> None:
     parser.add_argument("--probe-interval-s", type=float, default=0.5)
     parser.add_argument("--backoff-base-s", type=float, default=0.5)
     parser.add_argument("--log-dir", default=None)
+    parser.add_argument(
+        "--roles", default=None, metavar="R0,R1,...",
+        help="disaggregated serving (r20): comma list assigning each "
+             "replica a role (mixed/prefill/decode; shorter lists pad "
+             "with mixed) — e.g. --replicas 3 --roles prefill,decode,"
+             "decode runs one prefill-class replica shipping finished "
+             "KV chains to two decode-class replicas through the "
+             "router's prefill-first dispatch. Omit for an all-mixed "
+             "fleet (byte-for-byte the pre-r20 behavior)")
+    parser.add_argument(
+        "--no-disaggregate", action="store_true",
+        help="disable the router's prefill-first dispatch even when "
+             "prefill-class replicas exist (keyed requests then route "
+             "by plain cache affinity; prefill replicas only serve "
+             "explicit prefill_only/fetch_pages traffic)")
     parser.add_argument(
         "--mesh", default=None, metavar="model=N",
         help="tensor-parallel mesh per replica, threaded to every "
@@ -1229,12 +1507,21 @@ def main(argv=None) -> None:
                                      "replica{replica}"),
                         "--flight-budget-mb",
                         str(args.flight_budget_mb)]
+    roles = None
+    if args.roles:
+        roles = [r.strip() for r in args.roles.split(",") if r.strip()]
+        bad = [r for r in roles
+               if r not in ("mixed", "prefill", "decode")]
+        if bad:
+            raise SystemExit(f"--roles: unknown role(s) {bad}; choose "
+                             f"from mixed/prefill/decode")
     sup = Supervisor(model=args.model, replicas=args.replicas,
                      host=args.host, server_args=server_args,
                      probe_interval_s=args.probe_interval_s,
                      backoff_base_s=args.backoff_base_s,
                      log_dir=args.log_dir,
-                     collect_metrics=not args.no_collect_metrics)
+                     collect_metrics=not args.no_collect_metrics,
+                     roles=roles)
     print(f"[paddle_tpu.supervisor] spawning {args.replicas} replicas "
           f"of {args.model} (logs: {sup.log_dir}) ...", flush=True)
     router = None
@@ -1243,7 +1530,8 @@ def main(argv=None) -> None:
         router = FailoverRouter(
             sup, host=args.host, port=args.port,
             trace_sample=args.trace_sample,
-            deprioritize_outliers=args.deprioritize_outliers)
+            deprioritize_outliers=args.deprioritize_outliers,
+            disaggregate=not args.no_disaggregate)
         port = router.start()
         print(f"[paddle_tpu.supervisor] router on {args.host}:{port}; "
               f"replicas "

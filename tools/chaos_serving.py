@@ -403,6 +403,196 @@ def run_chaos(replicas: int = 2, requests: int = 12, seed: int = 0,
     return report
 
 
+def run_disagg_chaos(requests: int = 8, seed: int = 0,
+                     model: str = "gpt_tiny", page_size: int = 8,
+                     max_seq_len: int = 96, num_slots: int = 2,
+                     max_new_tokens: int = 6,
+                     prompt_len_range=(18, 34),
+                     request_timeout_s: float = 300.0,
+                     drain_timeout_s: float = 120.0,
+                     platform: str = "cpu",
+                     log_dir: Optional[str] = None) -> ChaosReport:
+    """INVARIANT 6 (r20 disaggregated serving): SIGKILL the
+    prefill-class replica MID-HANDOFF. A 1-prefill + 1-decode fleet
+    serves keyed long-prompt requests through the router's
+    prefill-first dispatch while the prefill replica is killed once
+    traffic is flowing — so some requests are mid prefill-hop, some
+    mid fetch_pages pull, some already spliced. The contract:
+
+    - every request terminates in a full result or a TYPED error —
+      the decode side either completes the handoff, falls back to
+      local prefill (bit-identical greedy output), or surfaces a
+      typed reply; NEVER a hang;
+    - zero leaked pages and a clean page-ledger reconcile on every
+      survivor (and on the respawned prefill replica) after drain.
+
+    Reported through the same ChaosReport as the r9 harness; handoff
+    accounting lands in ``details``."""
+    import numpy as np
+
+    from paddle_tpu.serving.server import client_request
+    from paddle_tpu.serving.supervisor import (FailoverRouter,
+                                               Supervisor, _rpc)
+
+    t_start = time.monotonic()
+    rng = np.random.default_rng(seed)
+    lo, hi = prompt_len_range
+    # long keyed prompts: every one has shareable full pages, so every
+    # request is handoff-eligible (the path under test)
+    prompts = [np.asarray(rng.integers(1, 100,
+                                       size=int(rng.integers(lo, hi))),
+                          np.int32)
+               for _ in range(requests)]
+    max_new = [max_new_tokens] * requests
+    expected = _reference_outputs(model, prompts, max_new,
+                                  page_size, max_seq_len)
+
+    log_dir = log_dir or tempfile.mkdtemp(prefix="pt-chaos-disagg-")
+    replica_env = {
+        "JAX_PLATFORMS": platform,
+        "TPU_SKIP_MDS_QUERY": "true",
+        "PADDLE_TPU_COMPILE_CACHE": os.path.join(log_dir,
+                                                 "compile_cache"),
+    }
+    server_args = ["--page-size", str(page_size),
+                   "--max-seq-len", str(max_seq_len),
+                   "--num-slots", str(num_slots),
+                   "--stall-timeout-s", "120"]
+    sup = Supervisor(model=model, replicas=2,
+                     roles=["prefill", "decode"],
+                     server_args=server_args, replica_env=replica_env,
+                     probe_interval_s=0.3, backoff_base_s=0.5,
+                     log_dir=log_dir)
+    report = ChaosReport(requests=requests)
+    outcomes: List[Optional[Dict]] = [None] * requests
+    route_trace: List[Dict] = []
+    try:
+        sup.start(wait_ready=True)
+        router = FailoverRouter(sup, max_failover=4)
+        router.trace = route_trace.append
+        rport = router.start()
+
+        first_result = threading.Event()
+
+        def client(i: int) -> None:
+            payload = {"op": "generate",
+                       "prompt": [int(t) for t in prompts[i]],
+                       "max_new_tokens": max_new[i],
+                       "stream": bool(i % 2),
+                       "key": f"disagg-{seed}-{i}",
+                       "deadline_ms": int(request_timeout_s * 500)}
+            t0 = time.monotonic()
+            try:
+                outcomes[i] = client_request("127.0.0.1", rport, payload,
+                                             timeout_s=request_timeout_s)
+            except Exception as e:
+                outcomes[i] = {"_transport_error":
+                               f"{type(e).__name__}: {e}"}
+            outcomes[i]["_elapsed_s"] = round(time.monotonic() - t0, 2)
+            first_result.set()
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(requests)]
+        for t in threads:
+            t.start()
+        # SIGKILL the PREFILL replica MID-HANDOFF: the first wave of
+        # requests is inside its prefill hop / fetch_pages pull about
+        # one second in (not waiting for a completion — by then the
+        # whole wave can be past the handoff). In-flight prefill hops
+        # die (router counts a prefill failure -> plain dispatch),
+        # in-flight fetch_pages pulls die (decode counts
+        # handoff_failures_total -> local prefill) — both typed paths.
+        first_result.wait(timeout=1.0)
+        sup.kill_replica(0)
+        for t in threads:
+            t.join(timeout=request_timeout_s)
+
+        for i, out in enumerate(outcomes):
+            if isinstance(out, dict):
+                report.details.append(
+                    {"i": i, "elapsed_s": out.get("_elapsed_s"),
+                     "kind": out.get("error")
+                     or out.get("_transport_error", "ok")})
+            if out is None or not isinstance(out, dict):
+                report.hangs += 1
+                continue
+            if "_transport_error" in out:
+                report.hangs += 1
+                kind = out["_transport_error"].split(":")[0]
+                report.error_kinds[kind] = \
+                    report.error_kinds.get(kind, 0) + 1
+                continue
+            if out.get("error"):
+                report.typed_errors += 1
+                kind = out["error"]
+                report.error_kinds[kind] = \
+                    report.error_kinds.get(kind, 0) + 1
+                continue
+            report.completed += 1
+            if out.get("generated") != expected[i]:
+                report.mismatches += 1
+
+        # -- zero leaks + ledger reconcile on EVERY replica -----------
+        deadline = time.monotonic() + drain_timeout_s
+        # the killed prefill replica must be RESPAWNED and ready (not
+        # just still flagged ready because the monitor hasn't probed
+        # the corpse yet — sup.wait_ready alone races that window)
+        while time.monotonic() < deadline:
+            if sup.restarts_total >= 1 and \
+                    all(r.ready and r.alive() for r in sup.replicas):
+                break
+            time.sleep(0.3)
+        sup.wait_ready()
+        for rep in sup.replicas:
+            try:
+                _rpc(sup.host, rep.port, {"op": "drain"},
+                     timeout_s=10.0)
+            except Exception:
+                report.leak_failures += 1
+                continue
+            ok = False
+            chk: Dict = {}
+            while time.monotonic() < deadline:
+                try:
+                    chk = _rpc(sup.host, rep.port,
+                               {"op": "leak_check"}, timeout_s=10.0)
+                except Exception:
+                    time.sleep(0.5)
+                    continue
+                if chk.get("ok"):
+                    ok = True
+                    break
+                if not chk.get("busy"):
+                    break
+                time.sleep(0.5)
+            if ok:
+                report.replicas_checked += 1
+            else:
+                report.leak_failures += 1
+            led = chk.get("ledger")
+            if isinstance(led, dict) and not led.get("ok", True):
+                report.ledger_failures += 1
+                report.ledger_errors.extend(
+                    f"replica {rep.idx}: {m}"
+                    for m in (led.get("mismatches") or
+                              ["reconcile failed"])[:4])
+        report.supervisor_restarts = sup.restarts_total
+        report.router_failovers = router.failovers_total
+        report.details.append(
+            {"handoffs_total": router.handoffs_total,
+             "handoff_prefill_failures_total":
+                 router.handoff_prefill_failures_total})
+        router.stop()
+    finally:
+        sup.stop()
+    report.wall_s = round(time.monotonic() - t_start, 3)
+    if not report.ok:
+        report.details.append({"route_trace": route_trace,
+                               "log_dir": log_dir})
+    return report
+
+
 def main(argv=None) -> int:
     import argparse
     parser = argparse.ArgumentParser(
@@ -419,7 +609,22 @@ def main(argv=None) -> int:
                              "('' = none)")
     parser.add_argument("--platform", default="cpu")
     parser.add_argument("--log-dir", default=None)
+    parser.add_argument(
+        "--disagg", action="store_true",
+        help="run INVARIANT 6 instead (r20): 1 prefill + 1 decode "
+             "replica, keyed long-prompt handoff traffic, SIGKILL the "
+             "prefill replica mid-handoff — typed termination or "
+             "local-prefill fallback everywhere, zero leaks + clean "
+             "ledger reconcile on every survivor")
     args = parser.parse_args(argv)
+
+    if args.disagg:
+        report = run_disagg_chaos(requests=args.requests,
+                                  seed=args.seed, model=args.model,
+                                  platform=args.platform,
+                                  log_dir=args.log_dir)
+        print(json.dumps(report.to_dict(), indent=2))
+        return 0 if report.ok else 1
 
     report = run_chaos(replicas=args.replicas, requests=args.requests,
                        seed=args.seed, model=args.model,

@@ -190,7 +190,21 @@ def promoted_cases():
 
     multi_step_decode.op_name = "paged_attention_fused"
 
+    def page_fetch_splice():
+        # r20 disaggregated serving: the decode-side splice of a
+        # FETCHED chain run — a 4-page contiguous prefix pulled over
+        # fetch_pages scatters into the pool in one call (pool.at[
+        # pages].set, the same op the r15 restore uses page-at-a-time;
+        # the engine batches the whole run into one donate-in-place
+        # program). This latency plus the wire RPC is what a handoff
+        # costs against the chained prefill it replaces.
+        pages = np.asarray([3, 9, 27, 41], np.int32)
+        return (_f32(65, 16, 8, 64), _f32(4, 16, 8, 64), pages)
+
+    page_fetch_splice.op_name = "paged_page_splice"
+
     return {"paged_attention_head_sharded": _paged_case,
+            "page_fetch_splice": page_fetch_splice,
             "prefill_chunk_step": _prefill_chunk_case,
             "fused_decode_step": fused_decode_step,
             "fused_verify": fused_verify,
