@@ -1966,6 +1966,204 @@ def bench_fleet_goodput(on_tpu: bool) -> Dict:
                     "device assignment — chip pending."}
 
 
+def bench_autoscale_goodput(on_tpu: bool) -> Dict:
+    """Autoscaling actuator A/B (r21 tentpole artifact): the SAME
+    bursty trace — quiet, a hard arrival burst, quiet again — through
+    two fleets behind a real FailoverRouter:
+
+    - **static**: 2 replicas for the whole run (the operator's
+      overprovision-for-the-burst answer);
+    - **auto**: 1 replica + the Autoscaler (min 1 / max 3, short
+      cooldowns) consuming the live PressureMonitor verdict — spawns
+      into the burst, drains back down in the tail.
+
+    The comparison is normalized to REPLICA-SECONDS (live replica
+    count integrated over the wall clock, sampled at 10 Hz): goodput
+    per replica-second is what an operator pays for. The autoscaled
+    lane spends quiet-phase seconds at 1 replica, so equal goodput at
+    fewer replica-seconds — or more goodput at equal replica-seconds
+    — is the win the actuator claims.
+
+    Replicas are pinned to JAX_PLATFORMS=cpu in BOTH lanes (N
+    processes sharing one chip would measure contention, not the
+    actuator); the chip rerun rides ROADMAP 3(b) per-replica device
+    assignment — chip pending."""
+    import tempfile
+    import threading
+
+    from paddle_tpu.serving.autoscaler import (AutoscaleConfig,
+                                               Autoscaler)
+    from paddle_tpu.serving.fleet_metrics import (FleetMetrics,
+                                                  PressureMonitor)
+    from paddle_tpu.serving.server import client_request
+    from paddle_tpu.serving.supervisor import (FailoverRouter,
+                                               Supervisor)
+
+    page, slots, max_seq, new_toks = 8, 2, 128, 64
+    deadline_ms = 15000
+    lens = (22, 28, 34)
+    rng = np.random.default_rng(0)
+    vocab = 1000
+    # the bursty trace: quiet 0.8 rps, then a burst pinned ABOVE one
+    # replica's open-loop service rate (~20 rps for these 64-token
+    # requests on cpu — the burst must outrun a replica or no queue
+    # ever builds and the actuator correctly never fires), then a
+    # quiet tail for the drain-down
+    arrivals = []
+    t = 0.0
+    for n, rate in ((4, 0.8), (280, 45.0), (6, 0.5)):
+        for _ in range(n):
+            t += float(rng.exponential(1.0 / rate))
+            arrivals.append(t)
+    prompts = [rng.integers(1, vocab,
+                            (lens[i % len(lens)],)).astype(int).tolist()
+               for i in range(len(arrivals))]
+
+    bench_dir = tempfile.mkdtemp(prefix="pt-autoscale-goodput-")
+    replica_env = {"JAX_PLATFORMS": "cpu",
+                   "TPU_SKIP_MDS_QUERY": "true",
+                   # one cache for BOTH lanes: the auto lane's
+                   # mid-burst spawn must pay process start, not XLA
+                   "PADDLE_TPU_COMPILE_CACHE":
+                       os.path.join(bench_dir, "compile_cache")}
+    server_args = ["--page-size", str(page), "--num-slots", str(slots),
+                   "--max-seq-len", str(max_seq)]
+
+    def lane(auto: bool) -> Dict:
+        log_dir = os.path.join(bench_dir, "auto" if auto else "static")
+        fleet = FleetMetrics(
+            pressure=PressureMonitor(hysteresis=2, queue_high=3.0),
+            pressure_interval_s=0.5)
+        sup = Supervisor(model="gpt_tiny",
+                         replicas=1 if auto else 2,
+                         server_args=server_args,
+                         replica_env=replica_env,
+                         probe_interval_s=0.25, backoff_base_s=0.5,
+                         log_dir=log_dir, fleet=fleet)
+        asc = None
+        if auto:
+            asc = Autoscaler(sup, AutoscaleConfig(
+                min_replicas=1, max_replicas=3,
+                cooldown_up_s=2.0, cooldown_down_s=3.0,
+                interval_s=0.25))
+        outcomes: list = [None] * len(arrivals)
+
+        def client(i):
+            try:
+                outcomes[i] = client_request(
+                    "127.0.0.1", rport,
+                    {"op": "generate", "prompt": prompts[i],
+                     "max_new_tokens": new_toks,
+                     "deadline_ms": deadline_ms}, timeout_s=120.0)
+            except Exception as e:
+                outcomes[i] = {"error": f"{type(e).__name__}: {e}"}
+
+        replica_seconds = 0.0
+        peak = 0
+        sampling = threading.Event()
+
+        def sampler():
+            nonlocal replica_seconds, peak
+            last = time.monotonic()
+            while not sampling.is_set():
+                time.sleep(0.1)
+                now = time.monotonic()
+                n = len(sup.replicas)
+                replica_seconds += n * (now - last)
+                peak = max(peak, n)
+                last = now
+
+        router = None
+        try:
+            sup.start(wait_ready=True)
+            router = FailoverRouter(sup)
+            rport = router.start()
+            # warm every prompt bucket before the clock starts
+            for ln in lens:
+                client_request("127.0.0.1", rport,
+                               {"op": "generate",
+                                "prompt": prompts[
+                                    [len(p) for p in prompts]
+                                    .index(ln)],
+                                "max_new_tokens": 2}, timeout_s=300.0)
+            if asc is not None:
+                asc.start()
+            sth = threading.Thread(target=sampler, daemon=True)
+            sth.start()
+            start = time.monotonic()
+            threads = []
+            for i, at in enumerate(arrivals):
+                wait = at - (time.monotonic() - start)
+                if wait > 0:
+                    time.sleep(wait)
+                th = threading.Thread(target=client, args=(i,),
+                                      daemon=True)
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(timeout=120.0)
+            # let the auto lane's drain-down show up in the bill
+            tail_until = start + arrivals[-1] + 12.0
+            while time.monotonic() < tail_until:
+                time.sleep(0.2)
+            wall = time.monotonic() - start
+            sampling.set()
+            sth.join(timeout=5.0)
+            actions = None
+            if asc is not None:
+                st = asc.status()
+                actions = {k: v for k, v in
+                           st["actions_total"].items()
+                           if not k.split("|")[1]
+                           .startswith("refused_")}
+        finally:
+            if asc is not None:
+                asc.stop()
+            if router is not None:
+                router.stop()
+            sup.stop()
+        done = sum(1 for o in outcomes
+                   if isinstance(o, dict) and o.get("done"))
+        expired = sum(1 for o in outcomes
+                      if isinstance(o, dict)
+                      and o.get("error") == "DeadlineExceeded")
+        out = {"completed_in_deadline": done,
+               "expired": expired,
+               "other_failures": len(arrivals) - done - expired,
+               "wall_s": round(wall, 2),
+               "replica_seconds": round(replica_seconds, 1),
+               "peak_replicas": peak,
+               "goodput_per_replica_second": round(
+                   done / max(replica_seconds, 1e-9), 4)}
+        if actions is not None:
+            out["autoscale_actions"] = actions
+        return out
+
+    static = lane(auto=False)
+    auto = lane(auto=True)
+    return {"metric": "gpt_tiny_autoscale_goodput_cpu_smoke",
+            "unit": "requests completed in deadline per "
+                    "replica-second",
+            "requests": len(arrivals),
+            "deadline_ms": deadline_ms,
+            "trace": "bursty: ~5s @0.8rps, ~6s @45rps, ~12s @0.5rps",
+            "num_slots": slots, "page_size": page,
+            "static_2_replicas": static,
+            "autoscaled_1_to_3": auto,
+            "replica_second_savings_fraction": round(
+                1.0 - auto["replica_seconds"]
+                / max(static["replica_seconds"], 1e-9), 3),
+            "note": "same bursty open-loop trace through a static "
+                    "2-replica fleet vs a 1..3 autoscaled fleet "
+                    "(PressureMonitor verdict -> journaled spawn/"
+                    "drain); goodput normalized to sampled "
+                    "replica-seconds — the autoscaled lane buys its "
+                    "burst capacity only while the burst lasts. "
+                    "Replicas run JAX_PLATFORMS=cpu in both lanes; "
+                    "chip rerun pending ROADMAP 3(b) per-replica "
+                    "device assignment."}
+
+
 def bench_disaggregated_serving(on_tpu: bool) -> Dict:
     """Disaggregated prefill/decode A/B (r20 tentpole artifact): the
     SAME adversarial trace — steady short unkeyed token streams while
@@ -2706,6 +2904,7 @@ def run_staged(on_tpu: bool) -> Dict:
                       bench_disaggregated_serving),
                      ("serving_goodput", bench_serving_goodput),
                      ("fleet_goodput", bench_fleet_goodput),
+                     ("autoscale_goodput", bench_autoscale_goodput),
                      ("memory_observatory", bench_memory_observatory),
                      ("speculative_decode", bench_speculative_decode),
                      ("compile_cache", bench_compile_cache),

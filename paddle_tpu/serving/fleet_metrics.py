@@ -376,6 +376,10 @@ class FleetMetrics:
         self._pressure_t: Optional[float] = None
         self._eval_fresh_ids: tuple = ()
         self._last_eval: Optional[Dict] = None
+        # verdict→action latch (r21): the autoscaler consumes each
+        # pressure evaluation at most once — this remembers the
+        # _pressure_t it last handed out
+        self._consumed_pressure_t: Optional[float] = None
 
     # -- ingestion (monitor loop) ------------------------------------------
 
@@ -516,6 +520,23 @@ class FleetMetrics:
         with self._lock:
             return dict(self._evaluate_locked(
                 time.monotonic())["flagged"])
+
+    def consume_pressure(self) -> Optional[Dict]:
+        """Verdict→action latch (r21): the pressure dict when a NEW
+        pressure evaluation ran since the last consume, else None.
+        The autoscaler drives actions through this, so each fresh
+        evaluation can trigger at most ONE action — replayed reads
+        (poll storms, a fast actuator tick) and telemetry blackouts
+        (verdict held, nothing evaluated) return None and cause
+        nothing. ``fleet_snapshot``/``outliers`` reads never consume:
+        observation stays side-effect-free."""
+        with self._lock:
+            ev = self._evaluate_locked(time.monotonic())
+            if self._pressure_t is None or \
+                    self._pressure_t == self._consumed_pressure_t:
+                return None
+            self._consumed_pressure_t = self._pressure_t
+            return dict(ev["pressure"])
 
     # -- fleet surfaces ----------------------------------------------------
 

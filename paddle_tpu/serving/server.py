@@ -1487,6 +1487,10 @@ class ServingServer:
                     {"keys": [], "truncated": False})
                if pc is not None else {"keys": [], "truncated": False})
         return {"status": "draining" if self._draining else "ok",
+                # autoscaler adoption (r21): a restarted supervisor
+                # verifies a journal-recorded replica is really THIS
+                # process (not a recycled pid) by matching this
+                "pid": _os.getpid(),
                 "active": eng.num_active,
                 "queued": eng.num_queued,
                 # disaggregated serving (r20): the replica's class —

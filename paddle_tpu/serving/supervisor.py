@@ -154,9 +154,23 @@ class Replica:
         # the fresh ones
         self.capacity: Optional[Dict] = None
         self.capacity_t: float = 0.0
+        # autoscaling (r21): a draining victim is mid-scale-down or
+        # mid-rerole — the monitor must not respawn its deliberate
+        # kill and the router must not route to it
+        self.draining = False
 
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
+
+    def reset_backoff(self) -> None:
+        """A healthy probe clears the crash-loop state. One definition
+        for every probe path (monitor loop, autoscaler ready-checks):
+        before r21 only the monitor reset, so a replica that flapped
+        during a scale storm carried max backoff into its next
+        legitimate respawn."""
+        self.consec_deaths = 0
+        self.probe_failures = 0
+        self.next_spawn_t = None
 
     def close_log(self) -> None:
         if self._log_file is not None:
@@ -240,6 +254,14 @@ class Supervisor:
                     f"{role!r} for replica {i}")
             rep.role = role
             self.roles.append(role)
+        # autoscaling actuator (r21): `Autoscaler` attaches itself
+        # here and sets journal_path so _spawn can stamp the env
+        # markers recovery scans for; the router back-references
+        # itself for the shape planner's handoff-failure signal
+        self.autoscaler = None
+        self.journal_path: Optional[str] = None
+        self.router = None
+        self._next_idx = int(replicas)
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -247,8 +269,13 @@ class Supervisor:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self, wait_ready: bool = True) -> None:
+        # spawn-if-unspawned: after autoscaler recovery the list holds
+        # ADOPTED replicas (live process from the previous supervisor
+        # generation, proc already set) next to to-respawn records
+        # (proc None) — only the latter get a fresh process
         for rep in self.replicas:
-            self._spawn(rep)
+            if rep.proc is None:
+                self._spawn(rep)
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          daemon=True,
                                          name="pt-supervisor-monitor")
@@ -260,10 +287,14 @@ class Supervisor:
         """Block until ``min_ready`` replicas (default: all) answer a
         health probe; raises with the laggards' log paths on timeout
         (the logs hold the subprocess traceback)."""
-        want = len(self.replicas) if min_ready is None else min_ready
+        if min_ready is None:
+            want = len([r for r in self.replicas if not r.draining])
+        else:
+            want = min_ready
         deadline = time.monotonic() + self.ready_timeout_s
         while time.monotonic() < deadline:
-            if sum(r.ready for r in self.replicas) >= want:
+            if sum(r.ready for r in self.replicas
+                   if not r.draining) >= want:
                 return
             if self._stop.is_set():
                 raise RuntimeError("supervisor stopped while waiting")
@@ -277,18 +308,19 @@ class Supervisor:
         self._stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout=grace_s)
-        for rep in self.replicas:
+        reps = list(self.replicas)  # autoscaler churn: fixed snapshot
+        for rep in reps:
             if rep.alive() and drain:
                 try:
                     _rpc(self.host, rep.port, {"op": "drain"},
                          timeout_s=2.0)
                 except Exception:
                     pass
-        for rep in self.replicas:
+        for rep in reps:
             if rep.alive():
                 rep.proc.terminate()
         deadline = time.monotonic() + grace_s
-        for rep in self.replicas:
+        for rep in reps:
             if rep.proc is None:
                 continue
             left = max(0.1, deadline - time.monotonic())
@@ -312,12 +344,79 @@ class Supervisor:
                      sig: int = signal.SIGKILL) -> None:
         """Chaos entry: deliver ``sig`` to one replica process (the
         monitor notices the death and respawns it with backoff)."""
-        rep = self.replicas[idx]
+        rep = self._by_idx(idx)
         if rep.alive():
             rep.proc.send_signal(sig)
 
+    def _by_idx(self, idx: int) -> Replica:
+        """Replica by its idx FIELD — under autoscaling the list is no
+        longer position-indexed (scale-down leaves holes)."""
+        for r in self.replicas:
+            if r.idx == idx:
+                return r
+        raise KeyError(f"no replica with idx {idx}")
+
+    # -- autoscaling membership (r21) --------------------------------------
+
+    def add_replica(self, role: str = "mixed",
+                    spawn: bool = True) -> Replica:
+        """Allocate the next replica record. ``spawn=False`` leaves it
+        DETACHED (not in ``self.replicas``): the autoscaler journals
+        the intent, spawns, waits ready, and only then attaches — the
+        router never routes to a pending spawn."""
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"bad role {role!r}")
+        with self._lock:
+            rep = Replica(self._next_idx, self.host)
+            self._next_idx += 1
+        rep.role = role
+        if spawn:
+            self._spawn(rep)
+            self.attach_replica(rep)
+        return rep
+
+    def attach_replica(self, rep: Replica) -> None:
+        """Publish a replica to the router/monitor (idempotent). The
+        list is REBOUND, never mutated in place — readers iterate a
+        consistent snapshot without taking the lock."""
+        with self._lock:
+            if all(r.idx != rep.idx for r in self.replicas):
+                self.replicas = self.replicas + [rep]
+
+    def remove_replica(self, rep: Replica) -> None:
+        with self._lock:
+            self.replicas = [r for r in self.replicas
+                             if r.idx != rep.idx]
+        rep.close_log()
+        if self.fleet is not None:
+            self.fleet.mark_stale(rep.idx)
+
+    def scale_down_guard(self, idx: int,
+                         min_replicas: int = 1) -> Optional[str]:
+        """Why removing replica ``idx`` must be REFUSED, or None when
+        the removal is safe (satellite fix, r21): an empty survivor
+        set, a survivor set below the min-replica envelope, or — on a
+        disaggregated fleet — losing the last replica advertising a
+        role would strand traffic, so the refusal is typed here
+        instead of crashing or stranding downstream."""
+        try:
+            rep = self._by_idx(idx)
+        except KeyError:
+            return "no_such_replica"
+        survivors = [r for r in self.replicas
+                     if r.idx != idx and not r.draining]
+        if not survivors:
+            return "last_replica"
+        if len(survivors) < min_replicas:
+            return f"below_min_replicas({min_replicas})"
+        if rep.role in ("prefill", "decode") and \
+                not any(r.role == rep.role for r in survivors):
+            return f"last_{rep.role}_replica"
+        return None
+
     def drain_replica(self, idx: int, handoff: bool = True,
-                      timeout_s: float = 30.0) -> Dict:
+                      timeout_s: float = 30.0,
+                      min_replicas: int = 1) -> Dict:
         """Scale-down drain with prefix-affinity-aware handoff (r20,
         the missing ROADMAP 3(a) drain): refresh the victim's
         advertisement, hand its hot chains to the surviving
@@ -326,8 +425,22 @@ class Supervisor:
         then drain the victim — stop admitting, finish in-flight,
         return every page. The victim process is left alive for the
         caller to reap (or the monitor to respawn); handoff failures
-        degrade to re-prefill-on-first-use, never block the drain."""
-        rep = self.replicas[idx]
+        degrade to re-prefill-on-first-use, never block the drain.
+
+        Refuses TYPED (r21 satellite fix) when the guard says removal
+        would empty the fleet, drop below ``min_replicas``, or lose
+        the last replica of a role — ``{"refused": <reason>}`` instead
+        of a crash or a stranded fleet. A victim already mid-drain
+        (``rep.draining``) skips the guard: the autoscaler's recovery
+        path re-drains an adopted victim whose removal was already
+        committed to."""
+        rep = self._by_idx(idx)
+        if not rep.draining:
+            guard = self.scale_down_guard(idx,
+                                          min_replicas=min_replicas)
+            if guard is not None:
+                return {"victim": idx, "refused": guard,
+                        "handoff": None, "drained": False}
         report: Dict = {"victim": idx, "handoff": None,
                         "drained": False}
         if handoff and rep.alive():
@@ -357,7 +470,8 @@ class Supervisor:
         return sum(r.restarts for r in self.replicas)
 
     def live(self) -> List[Replica]:
-        return [r for r in self.replicas if r.ready and r.alive()]
+        return [r for r in self.replicas
+                if r.ready and r.alive() and not r.draining]
 
     # -- internals ---------------------------------------------------------
 
@@ -383,14 +497,30 @@ class Supervisor:
                "--port", str(rep.port)] + extra
         env = dict(os.environ)
         env.update(self.replica_env)
+        if self.journal_path:
+            # autoscaler fleet markers (r21): a restarted supervisor's
+            # recovery (and the conftest stray guard) attributes an
+            # orphaned server to its fleet by these even when the
+            # journal's pid snapshot is stale (monitor respawns change
+            # pids without a journal write)
+            from .autoscaler import JOURNAL_ENV, REPLICA_IDX_ENV
+            env[JOURNAL_ENV] = self.journal_path
+            env[REPLICA_IDX_ENV] = str(rep.idx)
         rep.proc = subprocess.Popen(cmd, stdout=rep._log_file,
                                     stderr=subprocess.STDOUT, env=env)
 
     def _monitor_loop(self) -> None:
         while not self._stop.is_set():
-            for rep in self.replicas:
+            # list(): the autoscaler rebinds self.replicas on attach/
+            # remove — iterate one consistent snapshot per sweep
+            for rep in list(self.replicas):
                 if self._stop.is_set():
                     return
+                if rep.draining:
+                    # deliberate scale-down/rerole victim: its death
+                    # is intended — respawning it (or charging
+                    # _mark_dead backoff) would fight the actuator
+                    continue
                 if rep.proc is None or rep.next_spawn_t is not None:
                     # awaiting backoffed respawn
                     if rep.next_spawn_t is not None and \
@@ -411,8 +541,7 @@ class Supervisor:
                     probe_exc = e
                 if ok:
                     rep.ready = True
-                    rep.probe_failures = 0
-                    rep.consec_deaths = 0
+                    rep.reset_backoff()
                     self._scrape_metrics(rep)
                     self._scrape_capacity(rep)
                     # cache-affinity advertisement (r15): best-effort —
@@ -559,6 +688,7 @@ class Supervisor:
                 "port": r.port, "ready": r.ready, "alive": r.alive(),
                 "load": r.load,
                 "role": getattr(r, "role", "mixed"),
+                "draining": r.draining,
                 "restarts": r.restarts,
                 "consec_deaths": r.consec_deaths,
                 "probe_failures": r.probe_failures,
@@ -576,6 +706,11 @@ class Supervisor:
         out["supervision"] = supervision
         out["restarts_total"] = self.restarts_total
         out["collect_metrics"] = self.collect_metrics
+        # actuator state (r21): envelope, cooldown-remaining, last
+        # action, journal health — fleet_stats is the one op an
+        # operator watches, so the autoscaler reports through it
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.status()
         return out
 
     def _mark_dead(self, rep: Replica) -> None:
@@ -691,6 +826,13 @@ class FailoverRouter:
                  deprioritize_outliers: bool = False,
                  disaggregate: bool = True):
         self.sup = supervisor
+        # back-reference (r21): the autoscaler's shape planner reads
+        # handoff_prefill_failures_total off the router; duck-typed —
+        # a frozen stub supervisor just doesn't get one
+        try:
+            supervisor.router = self
+        except AttributeError:
+            pass
         self.host = host
         self._requested_port = port
         self.max_failover = int(max_failover)
@@ -917,7 +1059,47 @@ class FailoverRouter:
                       "reason": "supervisor has no fleet telemetry "
                                 "plane"})
                 return
-            send({"text": fm.prometheus_text()})
+            text = fm.prometheus_text()
+            asc = getattr(self.sup, "autoscaler", None)
+            if asc is not None:
+                # r21 families: serving_autoscale_actions_total +
+                # serving_fleet_replicas ride the same exposition
+                text = (text.rstrip("\n") + "\n"
+                        + "\n".join(asc.prometheus_lines()) + "\n")
+            send({"text": text})
+            return
+        if op == "autoscale":
+            # actuator surface (r21): status, plus FORCED actions
+            # (cooldown bypassed, envelope/guards still enforced) —
+            # the chaos harness and operators drive deterministic
+            # scale events through the one client-facing port
+            asc = getattr(self.sup, "autoscaler", None)
+            if asc is None:
+                send({"error": "AutoscalerUnavailable",
+                      "reason": "supervisor started without "
+                                "--autoscale"})
+                return
+            action = msg.get("action")
+            if action in (None, "status"):
+                send({"autoscaler": asc.status()})
+            elif action == "scale_up":
+                send({"result": asc.scale_up(
+                    reason=msg.get("reason") or "forced",
+                    role=msg.get("role") or "mixed", force=True)})
+            elif action == "scale_down":
+                send({"result": asc.scale_down(
+                    reason=msg.get("reason") or "forced",
+                    force=True)})
+            elif action == "rerole":
+                send({"result": asc.rerole(
+                    int(msg.get("replica", -1)),
+                    msg.get("role") or "mixed",
+                    reason=msg.get("reason") or "forced",
+                    force=True)})
+            else:
+                send({"error": "BadRequest",
+                      "reason": f"unknown autoscale action "
+                                f"{action!r}"})
             return
         if op != "generate":
             # admin op: first live replica answers (replica-targeted
@@ -1436,6 +1618,38 @@ def main(argv=None) -> None:
              "vs the fleet median); default off — detection always "
              "runs, only the routing preference is gated")
     parser.add_argument(
+        "--autoscale", action="store_true",
+        help="autoscaling actuator (r21): a supervisor control loop "
+             "consumes the PressureMonitor verdict and spawns a "
+             "replica on scale_up / drains-then-kills one on "
+             "scale_down inside the --min/--max-replicas envelope, "
+             "and on disaggregated fleets drives the prefill:decode "
+             "ratio by RE-ROLING replicas (drain + restart with a "
+             "new --role). Every action is journaled to an atomic "
+             "crc-checked fleet-state file BEFORE the process "
+             "action; a restarted supervisor adopts the journal's "
+             "fleet and resumes or rolls back half-finished actions")
+    parser.add_argument("--min-replicas", type=int, default=1,
+                        help="autoscale floor (default 1)")
+    parser.add_argument("--max-replicas", type=int, default=4,
+                        help="autoscale ceiling (default 4)")
+    parser.add_argument(
+        "--cooldown-s", type=float, default=30.0,
+        help="seconds between scale actions per direction (scale-up "
+             "and scale-down/rerole each keep their own clock; "
+             "default 30)")
+    parser.add_argument(
+        "--autoscale-interval-s", type=float, default=1.0,
+        help="actuator tick interval (default 1.0)")
+    parser.add_argument(
+        "--journal", default=None, metavar="PATH",
+        help="fleet-state journal path (default: "
+             "<log-dir>/fleet-journal.json). Crash recovery adopts "
+             "the fleet recorded here — point a restarted supervisor "
+             "at the SAME journal (and --log-dir) to inherit the "
+             "previous generation's replicas instead of orphaning "
+             "them")
+    parser.add_argument(
         "server_args", nargs="*",
         help="extra args passed to every replica's "
              "`python -m paddle_tpu.serving.server` (e.g. "
@@ -1524,6 +1738,37 @@ def main(argv=None) -> None:
                      roles=roles)
     print(f"[paddle_tpu.supervisor] spawning {args.replicas} replicas "
           f"of {args.model} (logs: {sup.log_dir}) ...", flush=True)
+    asc = None
+    if args.autoscale:
+        from .autoscaler import AutoscaleConfig, Autoscaler
+        flight = None
+        if args.flight_dir is not None:
+            from .fleet_metrics import FlightRecorder
+            # min_interval_s=0: scale actions are rare and each one
+            # matters for the postmortem — never rate-limit them
+            flight = FlightRecorder(
+                os.path.join(args.flight_dir, "supervisor"),
+                budget_bytes=args.flight_budget_mb << 20,
+                min_interval_s=0.0)
+        asc = Autoscaler(
+            sup,
+            AutoscaleConfig(min_replicas=args.min_replicas,
+                            max_replicas=args.max_replicas,
+                            cooldown_up_s=args.cooldown_s,
+                            cooldown_down_s=args.cooldown_s,
+                            interval_s=args.autoscale_interval_s),
+            journal_path=args.journal, flight=flight)
+        # recovery BEFORE start(): adopt the previous generation's
+        # live replicas (journal + env-marker scan) so start() only
+        # spawns what recovery says is dead — never a double-spawn
+        rec = asc.recover()
+        print(f"[paddle_tpu.supervisor] autoscale journal "
+              f"{asc.journal.path}: adopted "
+              f"{[a['idx'] for a in rec['adopted']]}, respawning "
+              f"{[a['idx'] for a in rec['respawned']]}, reaped "
+              f"{len(rec['reaped'])}, resolved "
+              f"{len(rec['resolved'])}, resuming "
+              f"{len(rec['resumed'])} action(s)", flush=True)
     router = None
     try:
         sup.start(wait_ready=True)
@@ -1533,6 +1778,8 @@ def main(argv=None) -> None:
             deprioritize_outliers=args.deprioritize_outliers,
             disaggregate=not args.no_disaggregate)
         port = router.start()
+        if asc is not None:
+            asc.start()
         print(f"[paddle_tpu.supervisor] router on {args.host}:{port}; "
               f"replicas "
               f"{[(r.idx, r.port) for r in sup.replicas]}", flush=True)
@@ -1545,6 +1792,8 @@ def main(argv=None) -> None:
         # router.start), a replica that never came ready — must tear
         # down whatever was spawned; N orphaned replica processes are
         # never an acceptable residue
+        if asc is not None:
+            asc.stop()
         if router is not None:
             router.stop()
         sup.stop()

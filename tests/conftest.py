@@ -93,18 +93,46 @@ def _stray_serving_procs():
     return found
 
 
+def _adopted_by_live_supervisor(pid: int) -> bool:
+    """Autoscaler-managed replicas (r21) carry PT_SUPERVISOR_JOURNAL
+    in their environment. An orphaned (ppid==1) replica is NOT a leak
+    when the journal it points at names a LIVE supervisor_pid: its
+    original parent died, but a restarted supervisor ADOPTED it from
+    the journal — killing it would scale down someone's live fleet.
+    Any read/parse failure returns False (the pre-r21 kill rule)."""
+    import json
+    try:
+        with open(f"/proc/{pid}/environ", "rb") as f:
+            raw = f.read()
+        env = dict(p.split(b"=", 1) for p in raw.split(b"\0")
+                   if b"=" in p)
+        journal = env.get(b"PT_SUPERVISOR_JOURNAL")
+        if not journal:
+            return False
+        with open(journal.decode("utf-8", "replace"),
+                  encoding="utf-8") as f:
+            body = (json.load(f) or {}).get("body") or {}
+        sup_pid = body.get("supervisor_pid")
+        return isinstance(sup_pid, int) \
+            and os.path.isdir(f"/proc/{sup_pid}")
+    except (OSError, ValueError, AttributeError):
+        return False
+
+
 def _handle_stray_serving(kill: bool):
     """Detect stray serving processes; with ``kill=True`` reap the
     ORPHANED ones (ppid == 1: their spawning run is dead — a process
     with a live parent belongs to someone and is only reported).
-    Returns ``[(pid, ppid, cmdline, killed)]``. Split from the hook so
-    the guard's detection-only and orphans-only contracts are directly
+    Autoscaler-adopted replicas (orphaned by pid but owned by a live
+    supervisor through the fleet journal, r21) are spared. Returns
+    ``[(pid, ppid, cmdline, killed)]``. Split from the hook so the
+    guard's detection-only and orphans-only contracts are directly
     testable."""
     import signal
     out = []
     for pid, ppid, cmd in _stray_serving_procs():
         killed = False
-        if kill and ppid == 1:
+        if kill and ppid == 1 and not _adopted_by_live_supervisor(pid):
             try:
                 os.kill(pid, signal.SIGKILL)
                 killed = True
@@ -119,6 +147,9 @@ def pytest_sessionstart(session):
     for pid, ppid, cmd, killed in _handle_stray_serving(kill=kill):
         if killed:
             action = "killed (CI, orphaned)"
+        elif kill and ppid == 1:
+            action = "NOT killed (adopted by a live supervisor via " \
+                     "its fleet journal)"
         elif kill:
             action = f"NOT killed (live parent {ppid} — belongs to a " \
                      f"concurrent run)"

@@ -1055,3 +1055,41 @@ class TestFleetE2E:
             router.stop()
         finally:
             sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# Verdict -> action latch (r21): the autoscaler's consume contract
+# ---------------------------------------------------------------------------
+
+class TestConsumePressureLatch:
+    def test_each_evaluation_generation_consumed_once(self):
+        fm = FleetMetrics(pressure=PressureMonitor(hysteresis=1),
+                          pressure_interval_s=0.0)
+        for i in range(3):
+            fm.ingest(i, _mk_export(n=2, queued=50.0))  # overload
+        first = fm.consume_pressure()
+        assert first is not None and first["verdict"] == "scale_up"
+        # same generation: the actuator already acted on it — a
+        # faster-than-scrape tick must see None, not a re-fire
+        assert fm.consume_pressure() is None
+        # a new scrape generation re-arms the latch
+        for i in range(3):
+            fm.ingest(i, _mk_export(n=2, queued=50.0))
+        again = fm.consume_pressure()
+        assert again is not None and again["verdict"] == "scale_up"
+
+    def test_observation_reads_never_consume(self):
+        fm = FleetMetrics(pressure=PressureMonitor(hysteresis=1),
+                          pressure_interval_s=0.0)
+        for i in range(3):
+            fm.ingest(i, _mk_export(n=2, queued=50.0))
+        for _ in range(5):  # dashboards poll, routers pick
+            fm.fleet_snapshot()
+            fm.outliers()
+        got = fm.consume_pressure()
+        assert got is not None and got["verdict"] == "scale_up"
+
+    def test_no_telemetry_means_nothing_to_consume(self):
+        fm = FleetMetrics(pressure=PressureMonitor(hysteresis=1),
+                          pressure_interval_s=0.0)
+        assert fm.consume_pressure() is None

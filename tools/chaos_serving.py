@@ -83,6 +83,15 @@ class ChaosReport:
     # matching release/free), alongside the existing leak_check
     ledger_failures: int = 0
     ledger_errors: List[str] = dataclasses.field(default_factory=list)
+    # autoscaler crash-safety (r21, INVARIANT 7): after SIGKILLing the
+    # supervisor mid-scale-action and restarting it from the journal —
+    # no serving process left carrying our journal marker after the
+    # final graceful stop, and the fleet-state journal lints clean
+    # (crc, monotonic seqs, every begin resolved). Default 0 so pre-r21
+    # runs are unaffected.
+    stranded_processes: int = 0
+    journal_lint_failures: int = 0
+    recoveries: int = 0           # supervisor SIGKILL->restart cycles
     error_kinds: Dict[str, int] = dataclasses.field(default_factory=dict)
     details: List[Dict] = dataclasses.field(default_factory=list)
     engine_restarts: int = 0      # scraped from surviving replicas
@@ -98,6 +107,8 @@ class ChaosReport:
                 and self.leak_failures == 0
                 and self.flight_lint_failures == 0
                 and self.ledger_failures == 0
+                and self.stranded_processes == 0
+                and self.journal_lint_failures == 0
                 and self.completed + self.typed_errors == self.requests)
 
     def to_dict(self) -> Dict:
@@ -593,6 +604,365 @@ def run_disagg_chaos(requests: int = 8, seed: int = 0,
     return report
 
 
+def run_autoscale_chaos(requests: int = 8, seed: int = 0,
+                        model: str = "gpt_tiny", page_size: int = 8,
+                        max_seq_len: int = 96, num_slots: int = 2,
+                        max_new_tokens: int = 6,
+                        hold_s: float = 3.0,
+                        request_timeout_s: float = 300.0,
+                        drain_timeout_s: float = 120.0,
+                        platform: str = "cpu",
+                        log_dir: Optional[str] = None) -> ChaosReport:
+    """INVARIANT 7 (r21 autoscaling actuator): SIGKILL the SUPERVISOR
+    ITSELF mid-scale-action — once mid-SPAWN (journal ``begin`` +
+    process launched, not yet committed) and once mid-SCALE-DOWN
+    (victim marked draining, drain not yet run) — under keyed
+    traffic, restart it against the same journal, and assert the
+    crash-safety contract end to end:
+
+    - **no stranded replica**: after the final graceful stop, zero
+      serving processes carry our journal's env marker;
+    - **no lost chains**: every keyed request (including those whose
+      front door died mid-flight and retried) and a post-recovery
+      re-issue of EVERY key return bit-identical greedy tokens;
+    - **zero leaked pages**: drain + leak_check + ledger reconcile
+      clean on every fleet member at the end;
+    - **100% typed termination**: full result or typed error for
+      every request — transport retries are bounded by the deadline;
+    - the fleet journal lints STRICTLY clean after recovery (crc,
+      monotonic seqs, every ``begin`` resolved), and the supervisor's
+      autoscale flight bundles lint clean.
+
+    The deterministic kill window comes from ``PT_AUTOSCALE_HOLD_S``:
+    every scale action sleeps that long between its journal
+    begin/launch record and the commit path, so a kill issued half a
+    hold after forcing an action lands inside the
+    journaled-but-uncommitted span."""
+    import signal as sig
+    import subprocess
+
+    import numpy as np
+
+    import flight_inspect
+    from paddle_tpu.serving.autoscaler import scan_marked_replicas
+    from paddle_tpu.serving.server import client_request
+    from paddle_tpu.serving.supervisor import _free_port, _rpc
+
+    t_start = time.monotonic()
+    rng = np.random.default_rng(seed)
+    # long keyed prompts (>= 2 full pages): every chain has shareable
+    # pages, so the scale-down drain's handoff path actually carries
+    # state the "no lost chains" assertion depends on
+    prompts = [np.asarray(rng.integers(1, 100,
+                                       size=int(rng.integers(18, 34))),
+                          np.int32)
+               for _ in range(requests)]
+    max_new = [max_new_tokens] * requests
+    expected = _reference_outputs(model, prompts, max_new,
+                                  page_size, max_seq_len)
+
+    log_dir = log_dir or tempfile.mkdtemp(prefix="pt-chaos-autoscale-")
+    os.makedirs(log_dir, exist_ok=True)
+    journal = os.path.join(log_dir, "fleet-journal.json")
+    flight_root = os.path.join(log_dir, "flight")
+    rport = _free_port()
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": platform,
+        "TPU_SKIP_MDS_QUERY": "true",
+        # shared across replicas AND supervisor generations: spawns
+        # after the first replica reuse its compiled programs
+        "PADDLE_TPU_COMPILE_CACHE": os.path.join(log_dir,
+                                                 "compile_cache"),
+        "PT_AUTOSCALE_HOLD_S": str(hold_s),
+    })
+    cmd = [sys.executable, "-m", "paddle_tpu.serving.supervisor",
+           "--replicas", "1", "--model", model,
+           "--port", str(rport),
+           "--probe-interval-s", "0.3", "--backoff-base-s", "0.5",
+           "--log-dir", log_dir,
+           "--flight-dir", flight_root,
+           "--autoscale", "--min-replicas", "1",
+           "--max-replicas", "3", "--cooldown-s", "0.5",
+           "--autoscale-interval-s", "0.3", "--journal", journal,
+           "--",
+           "--page-size", str(page_size),
+           "--max-seq-len", str(max_seq_len),
+           "--num-slots", str(num_slots),
+           "--stall-timeout-s", "120"]
+    sup_log = open(os.path.join(log_dir, "supervisor-cli.log"), "ab")
+
+    report = ChaosReport(requests=requests)
+    outcomes: List[Optional[Dict]] = [None] * requests
+
+    def launch() -> subprocess.Popen:
+        return subprocess.Popen(cmd, stdout=sup_log,
+                                stderr=subprocess.STDOUT, env=env)
+
+    def op(payload: Dict, timeout_s: float = 10.0) -> Dict:
+        try:
+            return client_request("127.0.0.1", rport, payload,
+                                  timeout_s=timeout_s)
+        except Exception as e:
+            return {"_transport_error": f"{type(e).__name__}: {e}"}
+
+    def wait_router(min_live: int = 1, timeout_s: float = 300.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            h = op({"op": "health"}, timeout_s=5.0)
+            if h.get("live", 0) >= min_live:
+                return h
+            time.sleep(0.3)
+        raise RuntimeError(f"router not serving {min_live} live "
+                           f"replica(s) within {timeout_s}s "
+                           f"(logs: {log_dir})")
+
+    def client(i: int) -> None:
+        # the front door DIES when the supervisor is SIGKILLed:
+        # transport errors and retryable typed errors are retried
+        # (same key — greedy determinism makes that free) until the
+        # deadline; only the final outcome is judged
+        payload = {"op": "generate",
+                   "prompt": [int(t) for t in prompts[i]],
+                   "max_new_tokens": max_new[i],
+                   "stream": bool(i % 2),
+                   "key": f"autoscale-{seed}-{i}",
+                   "deadline_ms": int(request_timeout_s * 500)}
+        deadline = time.monotonic() + request_timeout_s
+        t0 = time.monotonic()
+        while True:
+            try:
+                out = client_request("127.0.0.1", rport, payload,
+                                     timeout_s=request_timeout_s)
+            except Exception as e:
+                out = {"_transport_error":
+                       f"{type(e).__name__}: {e}"}
+            if "_transport_error" in out or (
+                    out.get("error") and out.get("retryable")):
+                if time.monotonic() < deadline:
+                    time.sleep(0.5)
+                    continue
+            break
+        out["_elapsed_s"] = round(time.monotonic() - t0, 2)
+        outcomes[i] = out
+
+    proc = launch()
+    try:
+        wait_router(min_live=1)
+
+        # ---- phase A: SIGKILL mid-SPAWN under keyed traffic ----------
+        wave1 = [threading.Thread(target=client, args=(i,),
+                                  daemon=True)
+                 for i in range(requests // 2)]
+        for t in wave1:
+            t.start()
+        forcer = threading.Thread(
+            target=op, args=({"op": "autoscale",
+                              "action": "scale_up"},),
+            kwargs={"timeout_s": 60.0}, daemon=True)
+        forcer.start()
+        # half a hold after forcing: the journal holds begin+launched
+        # for the spawn, the commit has not happened
+        time.sleep(hold_s * 0.5)
+        proc.send_signal(sig.SIGKILL)
+        proc.wait(timeout=30)
+        report.recoveries += 1
+        proc = launch()
+        wait_router(min_live=1)
+        for t in wave1:
+            t.join(timeout=request_timeout_s)
+
+        # ensure >= 2 members before the scale-down phase (the phase-A
+        # spawn may have been adopted+committed OR rolled back; a
+        # refusal like at_max is fine as long as 2 end up live)
+        op({"op": "autoscale", "action": "scale_up"}, timeout_s=240.0)
+        wait_router(min_live=2)
+
+        # ---- phase B: SIGKILL mid-SCALE-DOWN under keyed traffic -----
+        wave2 = [threading.Thread(target=client, args=(i,),
+                                  daemon=True)
+                 for i in range(requests // 2, requests)]
+        for t in wave2:
+            t.start()
+        forcer = threading.Thread(
+            target=op, args=({"op": "autoscale",
+                              "action": "scale_down"},),
+            kwargs={"timeout_s": 60.0}, daemon=True)
+        forcer.start()
+        time.sleep(hold_s * 0.5)
+        proc.send_signal(sig.SIGKILL)
+        proc.wait(timeout=30)
+        report.recoveries += 1
+        proc = launch()
+        wait_router(min_live=1)
+        # wait for the RESUMED drain to resolve: recovery queues the
+        # half-finished action; done when nothing is pending/in flight
+        # and the journal has no open action left
+        deadline = time.monotonic() + drain_timeout_s
+        resolved = False
+        while time.monotonic() < deadline:
+            st = op({"op": "autoscale"}, timeout_s=10.0)
+            asc = st.get("autoscaler") or {}
+            if asc and asc.get("pending_resumes") == 0 \
+                    and not asc.get("action_in_flight"):
+                try:
+                    with open(journal, encoding="utf-8") as f:
+                        jobj = json.load(f)
+                    if not flight_inspect.lint_fleet_journal(
+                            jobj, allow_open_tail=0):
+                        resolved = True
+                        break
+                except OSError:
+                    pass
+            time.sleep(0.5)
+        if not resolved:
+            report.journal_lint_failures += 1
+            report.details.append(
+                {"journal": "open actions never resolved after "
+                            "recovery"})
+        for t in wave2:
+            t.join(timeout=request_timeout_s)
+
+        # ---- invariant: typed termination + bit-identical outputs ----
+        for i, out in enumerate(outcomes):
+            if isinstance(out, dict):
+                report.details.append(
+                    {"i": i, "elapsed_s": out.get("_elapsed_s"),
+                     "kind": out.get("error")
+                     or out.get("_transport_error", "ok")})
+            if out is None or not isinstance(out, dict):
+                report.hangs += 1
+                continue
+            if "_transport_error" in out:
+                report.hangs += 1
+                kind = out["_transport_error"].split(":")[0]
+                report.error_kinds[kind] = \
+                    report.error_kinds.get(kind, 0) + 1
+                continue
+            if out.get("error"):
+                report.typed_errors += 1
+                kind = out["error"]
+                report.error_kinds[kind] = \
+                    report.error_kinds.get(kind, 0) + 1
+                continue
+            report.completed += 1
+            if out.get("generated") != expected[i]:
+                report.mismatches += 1
+
+        # ---- no lost chains: re-issue EVERY key post-recovery --------
+        # chains handed to survivors during the resumed drain (or
+        # re-prefilled on first use) must still decode bit-identically
+        for i in range(requests):
+            rdl = time.monotonic() + request_timeout_s
+            while True:
+                out = op({"op": "generate",
+                          "prompt": [int(t) for t in prompts[i]],
+                          "max_new_tokens": max_new[i],
+                          "key": f"autoscale-{seed}-{i}"},
+                         timeout_s=request_timeout_s)
+                if ("_transport_error" in out or (
+                        out.get("error") and out.get("retryable"))) \
+                        and time.monotonic() < rdl:
+                    time.sleep(0.5)
+                    continue
+                break
+            if out.get("generated") != expected[i]:
+                report.mismatches += 1
+                report.details.append(
+                    {"reissue": i,
+                     "kind": out.get("error")
+                     or out.get("_transport_error", "mismatch")})
+
+        # ---- zero leaks + ledger reconcile on every member -----------
+        h = op({"op": "health"}, timeout_s=10.0)
+        deadline = time.monotonic() + drain_timeout_s
+        for rinfo in (h.get("replicas") or ()):
+            port = rinfo.get("port")
+            if port is None or not rinfo.get("alive"):
+                continue
+            try:
+                _rpc("127.0.0.1", port, {"op": "drain"},
+                     timeout_s=10.0)
+            except Exception:
+                report.leak_failures += 1
+                continue
+            ok = False
+            chk: Dict = {}
+            while time.monotonic() < deadline:
+                try:
+                    chk = _rpc("127.0.0.1", port,
+                               {"op": "leak_check"}, timeout_s=10.0)
+                except Exception:
+                    time.sleep(0.5)
+                    continue
+                if chk.get("ok"):
+                    ok = True
+                    break
+                if not chk.get("busy"):
+                    break
+                time.sleep(0.5)
+            if ok:
+                report.replicas_checked += 1
+            else:
+                report.leak_failures += 1
+            led = chk.get("ledger")
+            if isinstance(led, dict) and not led.get("ok", True):
+                report.ledger_failures += 1
+                report.ledger_errors.extend(
+                    f"replica {rinfo.get('idx')}: {m}"
+                    for m in (led.get("mismatches") or
+                              ["reconcile failed"])[:4])
+
+        # ---- autoscaler flight bundles + final journal lint ----------
+        asup_dir = os.path.join(flight_root, "supervisor")
+        if os.path.isdir(asup_dir):
+            bundles, errors = flight_inspect.lint_dir(asup_dir)
+            report.flight_bundles += len(bundles)
+            if errors:
+                report.flight_lint_failures += 1
+                report.flight_errors.extend(errors[:8])
+        try:
+            with open(journal, encoding="utf-8") as f:
+                jobj = json.load(f)
+            errs = flight_inspect.lint_fleet_journal(
+                jobj, name="fleet-journal", allow_open_tail=0)
+        except Exception as e:
+            errs = [f"journal unreadable: {type(e).__name__}: {e}"]
+        if errs:
+            report.journal_lint_failures += 1
+            report.details.append({"journal_lint": errs[:8]})
+
+        # ---- graceful stop, then the stranded-process scan -----------
+        proc.send_signal(sig.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            try:
+                proc.wait(timeout=30)
+            except Exception:
+                pass
+        sup_log.close()
+    time.sleep(1.0)  # let SIGTERMed replicas finish exiting
+    stranded = scan_marked_replicas(journal)
+    report.stranded_processes = len(stranded)
+    if stranded:
+        report.details.append({"stranded": stranded})
+        for info in stranded.values():  # never leave them behind
+            try:
+                os.kill(info["pid"], sig.SIGKILL)
+            except OSError:
+                pass
+    report.wall_s = round(time.monotonic() - t_start, 3)
+    if not report.ok:
+        report.details.append({"log_dir": log_dir})
+    return report
+
+
 def main(argv=None) -> int:
     import argparse
     parser = argparse.ArgumentParser(
@@ -616,7 +986,22 @@ def main(argv=None) -> int:
              "prefill replica mid-handoff — typed termination or "
              "local-prefill fallback everywhere, zero leaks + clean "
              "ledger reconcile on every survivor")
+    parser.add_argument(
+        "--autoscale-chaos", action="store_true",
+        help="run INVARIANT 7 instead (r21): SIGKILL the SUPERVISOR "
+             "mid-spawn and mid-scale-down under keyed traffic, "
+             "restart it from the fleet journal — no stranded "
+             "replicas, no lost chains, zero leaks, typed "
+             "termination, journal lints clean")
     args = parser.parse_args(argv)
+
+    if args.autoscale_chaos:
+        report = run_autoscale_chaos(requests=args.requests,
+                                     seed=args.seed, model=args.model,
+                                     platform=args.platform,
+                                     log_dir=args.log_dir)
+        print(json.dumps(report.to_dict(), indent=2))
+        return 0 if report.ok else 1
 
     if args.disagg:
         report = run_disagg_chaos(requests=args.requests,

@@ -39,6 +39,7 @@ import argparse
 import json
 import os
 import sys
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 _TOOLS = os.path.dirname(os.path.abspath(__file__))
@@ -55,7 +56,13 @@ REQUIRED_KEYS = ("v", "reason", "t_unix", "pid", "engine", "metrics",
 # page-ledger ring tail and a capacity snapshot; both are REQUIRED at
 # that version and linted below (v1 bundles predate them)
 REQUIRED_KEYS_V2 = ("page_ledger", "capacity")
-KNOWN_REASONS = ("resurrect", "engine_failed", "stall")
+KNOWN_REASONS = ("resurrect", "engine_failed", "stall", "autoscale")
+# autoscale bundles (r21) are written by the SUPERVISOR's recorder —
+# there is no engine/timeline/inflight to snapshot; instead they
+# carry the scale action, the fleet membership at commit time, and
+# the journal's recent action tail
+REQUIRED_KEYS_AUTOSCALE = ("v", "reason", "t_unix", "pid", "action",
+                           "fleet", "journal_tail")
 # the device-pool owner classes that must sum to the pool size
 OCCUPANCY_CLASSES = ("inflight", "prefix_device", "reserved", "free")
 
@@ -66,6 +73,8 @@ def lint_bundle(bundle: Any, name: str = "bundle") -> List[str]:
     errors: List[str] = []
     if not isinstance(bundle, dict):
         return [f"{name}: not a JSON object"]
+    if bundle.get("reason") == "autoscale":
+        return _lint_autoscale_bundle(bundle, name)
     required = REQUIRED_KEYS
     if isinstance(bundle.get("v"), int) and bundle["v"] >= 2:
         required = REQUIRED_KEYS + REQUIRED_KEYS_V2
@@ -177,6 +186,133 @@ def lint_bundle(bundle: Any, name: str = "bundle") -> List[str]:
     return errors
 
 
+def _lint_autoscale_bundle(bundle: Dict, name: str) -> List[str]:
+    """Supervisor-side autoscale bundles (r21): action + fleet +
+    journal tail instead of an engine snapshot."""
+    errors: List[str] = []
+    for k in REQUIRED_KEYS_AUTOSCALE:
+        if k not in bundle:
+            errors.append(f"{name}: missing key {k!r}")
+    if errors:
+        return errors
+    if not isinstance(bundle.get("t_unix"), (int, float)) \
+            or bundle["t_unix"] <= 0:
+        errors.append(f"{name}: bad t_unix {bundle.get('t_unix')!r}")
+    if not isinstance(bundle.get("pid"), int):
+        errors.append(f"{name}: bad pid {bundle.get('pid')!r}")
+    act = bundle.get("action")
+    if not isinstance(act, dict) or not all(
+            k in act for k in ("action", "reason", "ok")):
+        errors.append(f"{name}: action must carry action/reason/ok")
+    fleet = bundle.get("fleet")
+    if not isinstance(fleet, list):
+        errors.append(f"{name}: fleet must be a list")
+    else:
+        for i, e in enumerate(fleet):
+            if not isinstance(e, dict) \
+                    or not isinstance(e.get("idx"), int):
+                errors.append(f"{name}: fleet[{i}] missing int idx")
+    tail = bundle.get("journal_tail")
+    if not isinstance(tail, list):
+        errors.append(f"{name}: journal_tail must be a list")
+    else:
+        for i, e in enumerate(tail):
+            if not isinstance(e, dict) \
+                    or not isinstance(e.get("seq"), int) \
+                    or e.get("phase") not in JOURNAL_PHASES:
+                errors.append(f"{name}: journal_tail[{i}] not a "
+                              f"seq/phase entry")
+    return errors
+
+
+JOURNAL_PHASES = ("begin", "launched", "commit", "rollback")
+_JOURNAL_ROLES = ("mixed", "prefill", "decode")
+
+
+def lint_fleet_journal(obj: Any, name: str = "journal",
+                       allow_open_tail: int = 0) -> List[str]:
+    """Validate a parsed fleet-state journal (the autoscaler's atomic
+    crc-checked file); returns error strings (empty = clean).
+
+    Checks the r21 contract: crc over the canonical body (key-sorted,
+    separator-free JSON — recomputed here without importing
+    paddle_tpu), a seq counter covering every logged action, ``begin``
+    seqs strictly monotonic, every ``begin`` matched by a terminal
+    ``commit``/``rollback``, and typed fleet entries (int idx, known
+    role). ``allow_open_tail`` tolerates that many UNRESOLVED actions
+    at the end of the log — a supervisor crashed mid-action
+    legitimately leaves its in-flight action open (lint the debris
+    with 1), but after a recovery pass every action must be resolved
+    (the chaos harness lints with the default 0)."""
+    errors: List[str] = []
+    if not isinstance(obj, dict) or "body" not in obj \
+            or "crc" not in obj:
+        return [f"{name}: not a fleet journal (crc+body object)"]
+    body = obj["body"]
+    crc = zlib.crc32(json.dumps(
+        body, sort_keys=True, separators=(",", ":")).encode())
+    if obj.get("crc") != crc:
+        errors.append(f"{name}: crc mismatch "
+                      f"({obj.get('crc')} != {crc})")
+    if not isinstance(body, dict):
+        return errors + [f"{name}: body must be an object"]
+    if not isinstance(body.get("seq"), int) or body["seq"] < 0:
+        errors.append(f"{name}: bad seq counter {body.get('seq')!r}")
+    actions = body.get("actions")
+    begins: List[int] = []
+    resolved: set = set()
+    if not isinstance(actions, list):
+        errors.append(f"{name}: actions must be a list")
+        actions = []
+    for i, e in enumerate(actions):
+        if not isinstance(e, dict) \
+                or not isinstance(e.get("seq"), int) \
+                or e.get("phase") not in JOURNAL_PHASES:
+            errors.append(f"{name}: actions[{i}] not a seq/phase "
+                          f"entry")
+            continue
+        if e["phase"] == "begin":
+            if begins and e["seq"] <= begins[-1]:
+                errors.append(f"{name}: begin seq not monotonic at "
+                              f"actions[{i}] ({begins[-1]} -> "
+                              f"{e['seq']})")
+            begins.append(e["seq"])
+        elif e["phase"] in ("commit", "rollback"):
+            resolved.add(e["seq"])
+        if isinstance(body.get("seq"), int) \
+                and e["seq"] > body["seq"]:
+            errors.append(f"{name}: actions[{i}] seq {e['seq']} "
+                          f"beyond counter {body['seq']}")
+    open_seqs = [s for s in begins if s not in resolved]
+    if len(open_seqs) > max(0, int(allow_open_tail)):
+        errors.append(
+            f"{name}: {len(open_seqs)} begin(s) without commit/"
+            f"rollback (seqs {open_seqs}; {allow_open_tail} "
+            f"tolerated)")
+    fleet = body.get("fleet")
+    if not isinstance(fleet, list):
+        errors.append(f"{name}: fleet must be a list")
+    else:
+        seen_idx = set()
+        for i, e in enumerate(fleet):
+            if not isinstance(e, dict) \
+                    or not isinstance(e.get("idx"), int):
+                errors.append(f"{name}: fleet[{i}] missing int idx")
+                continue
+            if e["idx"] in seen_idx:
+                errors.append(f"{name}: fleet idx {e['idx']} "
+                              f"duplicated")
+            seen_idx.add(e["idx"])
+            if e.get("role") not in _JOURNAL_ROLES:
+                errors.append(f"{name}: fleet[{i}] bad role "
+                              f"{e.get('role')!r}")
+            if e.get("pid") is not None \
+                    and not isinstance(e.get("pid"), int):
+                errors.append(f"{name}: fleet[{i}] bad pid "
+                              f"{e.get('pid')!r}")
+    return errors
+
+
 def lint_dir(path: str, budget_bytes: Optional[int] = None
              ) -> Tuple[List[str], List[str]]:
     """Lint every committed bundle under ``path``; returns (bundle
@@ -217,6 +353,22 @@ def lint_dir(path: str, budget_bytes: Optional[int] = None
 
 def summarize(bundle: Dict) -> str:
     """Human-readable card for one bundle."""
+    if bundle.get("reason") == "autoscale":
+        act = bundle.get("action") or {}
+        fleet = bundle.get("fleet") or []
+        tail = bundle.get("journal_tail") or []
+        return "\n".join([
+            f"reason      : autoscale  (pid {bundle.get('pid')})",
+            f"action      : {act.get('action')} "
+            f"reason={act.get('reason')} ok={act.get('ok')} "
+            f"replica={act.get('replica')}",
+            f"fleet       : " + (" ".join(
+                f"{e.get('idx')}:{e.get('role')}@{e.get('port')}"
+                for e in fleet) or "(empty)"),
+            f"journal tail: {len(tail)} entr(ies)"
+            + (f", last seq {tail[-1].get('seq')} "
+               f"{tail[-1].get('phase')}" if tail else ""),
+        ])
     eng = bundle.get("engine") or {}
     met = (bundle.get("metrics") or {}).get("counters") or {}
     tl = bundle.get("step_timeline") or []
@@ -282,6 +434,11 @@ def main(argv=None) -> int:
     ap.add_argument("--budget-bytes", type=int, default=None,
                     help="also assert the directory's retention ring "
                          "held this byte budget")
+    ap.add_argument("--allow-open-tail", type=int, default=0,
+                    help="fleet-journal lint: tolerate this many "
+                         "unresolved actions (default 0 — use 1 when "
+                         "inspecting the debris of a supervisor that "
+                         "crashed mid-action, before recovery ran)")
     args = ap.parse_args(argv)
 
     if os.path.isdir(args.path):
@@ -300,10 +457,30 @@ def main(argv=None) -> int:
     else:
         with open(args.path, encoding="utf-8") as f:
             obj = json.load(f)
-        errors = lint_bundle(obj, name=os.path.basename(args.path))
-        bundles = [args.path]
-        if not args.lint_only:
-            print(summarize(obj))
+        if isinstance(obj, dict) and "crc" in obj and "body" in obj:
+            # a fleet-state journal (r21), not a flight bundle
+            errors = lint_fleet_journal(
+                obj, name=os.path.basename(args.path),
+                allow_open_tail=args.allow_open_tail)
+            bundles = [args.path]
+            if not args.lint_only:
+                body = obj.get("body") or {}
+                acts = body.get("actions") or []
+                print(f"fleet journal: seq {body.get('seq')}, "
+                      f"{len(body.get('fleet') or [])} replica(s), "
+                      f"{len(acts)} action entr(ies), supervisor pid "
+                      f"{body.get('supervisor_pid')}")
+                for e in acts[-8:]:
+                    print(f"  seq {e.get('seq')} {e.get('phase'):>8} "
+                          f"{e.get('action') or ''} "
+                          f"replica={e.get('replica')} "
+                          f"{e.get('reason') or ''}")
+        else:
+            errors = lint_bundle(obj,
+                                 name=os.path.basename(args.path))
+            bundles = [args.path]
+            if not args.lint_only:
+                print(summarize(obj))
     if errors:
         for e in errors:
             print(f"flight_inspect: {e}", file=sys.stderr)
