@@ -463,6 +463,7 @@ class ContinuousBatchingEngine:
                  prefill_chunk_tokens: Optional[int] = None,
                  fused_step: bool = True,
                  multi_step: int = 1,
+                 inprogram: bool = True,
                  tracer=None, timeline_steps: int = 256,
                  capture_costs: bool = False,
                  page_ledger: bool = True,
@@ -700,16 +701,21 @@ class ContinuousBatchingEngine:
         # they rewrite the launch's table/lens/cur inputs and donate
         # the pools, so they cannot run under an in-flight launch;
         # that gap is the N-vs-TTFT trade. multi_step=1 (the default)
-        # is byte-for-byte the per-token engine. Speculative engines
-        # compose AT the boundary: their verify step already amortizes
-        # k+1 tokens per launch, so the macro wrap applies only to the
-        # vanilla decode path.
+        # is byte-for-byte the per-token engine. r22 (in-program inner
+        # loop) moves speculative verify and chained prefill chunks
+        # INSIDE the macro program when eligible (see _spec_inprogram /
+        # _chunk_inprogram below); `inprogram=False` pins the r19
+        # boundary-interleaved behavior as the bisection rung.
         self.multi_step = int(multi_step)
         if self.multi_step < 1:
             raise ValueError(
                 f"multi_step must be >= 1 (1 = per-token decode); got "
                 f"{multi_step}")
-        self._multi_jit = None
+        self.inprogram = bool(inprogram)
+        # macro program variants keyed by has_chunk (a launch with a
+        # scheduled in-program chunk is a different traced program than
+        # a decode/verify-only one; both are built at most once)
+        self._multi_jits: Dict[bool, Any] = {}
         # in-flight macro launch: device handles + the slot->request
         # snapshot the drain folds back (None = nothing dispatched)
         self._pending_macro: Optional[Dict[str, Any]] = None
@@ -789,6 +795,30 @@ class ContinuousBatchingEngine:
             self._verify_retry = verify_retry
         else:
             self._verify_retry = None
+        # r22 in-program eligibility. Speculative verify moves inside
+        # the macro while_loop only when every piece has a device twin:
+        # multi_step > 1 (there IS a macro program), greedy verify
+        # (temperature 0 — the bit-identical serving mode; residual
+        # resampling stays at the boundary), and a draft source
+        # expressible as pure array math over the stored history
+        # (ngram/self — ModelDraft and CallableDraft run host code).
+        # Chunked prefill moves inside only when speculation either is
+        # off or also moved inside (a half-in half-out split would put
+        # the boundary back).
+        self._spec_inprogram = False
+        self._spec_device_draft = None
+        if (self.inprogram and self.multi_step > 1
+                and self._spec_cfg is not None
+                and float(self._spec_cfg.temperature) == 0.0):
+            from .speculative import device_draft_params
+            p = device_draft_params(self._spec_draft)
+            if p is not None:
+                self._spec_inprogram = True
+                self._spec_device_draft = p
+        self._chunk_inprogram = (
+            self.inprogram and self.multi_step > 1
+            and self.prefill_chunk_tokens is not None
+            and (self._spec_cfg is None or self._spec_inprogram))
 
     # -- request lifecycle -------------------------------------------------
 
@@ -1502,40 +1532,103 @@ class ContinuousBatchingEngine:
         # (On CPU donation is ignored with a warning — harmless.)
         return jax.jit(self._decode_body_fn(), donate_argnums=(1,))
 
-    def _build_multi_decode(self):
+    def _build_multi_decode(self, has_chunk: bool = False):
         """The r19 macro program: up to ``multi_step`` iterations of
         the EXACT single-token decode body wrapped in one on-device
         early-exit loop (models/gpt.py ``multi_step_decode``), with
         the per-slot stop/mask bookkeeping the host used to run
         between launches carried in-program. ONE compile serves the
-        engine lifetime (N is static; rem/eos/active are data)."""
+        engine lifetime (N is static; rem/eos/active are data).
+
+        r22 (in-program inner loop): when ``_spec_inprogram`` the
+        iteration body is the fused VERIFY step instead of the decode
+        step — draft (device ngram/self twin), verify k+1 positions,
+        and rewind via ``masked_run_advance`` carries, widening the
+        token ring to [B, N, k+1]. When ``has_chunk`` the program also
+        advances one half-prefilled slot's scheduled chained-prefill
+        chunks, one per iteration, under a ``lax.cond``. Spec/chunk
+        both off traces the byte-for-byte r19 program."""
         import jax
+        import jax.numpy as jnp
 
         from ..models.gpt import multi_step_decode
 
         body = self._decode_body_fn()
         n = self.multi_step
         scratch = self._scratch
+        spec_on = self._spec_inprogram
+        if not spec_on and not has_chunk:
+            def macro(state, pools, table, lens, tokens, active, rem,
+                      eos):
+                def step_fn(pl, tbl, ln, cur):
+                    return body(state, pl, tbl, ln, cur)
 
-        def macro(state, pools, table, lens, tokens, active, rem, eos):
+                with jax.named_scope("pt.multi_step"):
+                    return multi_step_decode(step_fn, pools, table,
+                                             lens, tokens, active,
+                                             rem, eos, n, scratch)
+
+            return jax.jit(macro, donate_argnums=(1,))
+
+        verify_body = self._verify_body_fn() if spec_on else None
+        prefill_body = self._prefill_body_fn(True) if has_chunk else None
+        dcfg = self._spec_device_draft
+        k = int(self._spec_cfg.k) if spec_on else 0
+        vocab = int(self.cfg.vocab_size)
+
+        def macro(state, pools, table, lens, tokens, active, rem, eos,
+                  *extra):
+            from ..nn.decode import ngram_draft_tokens
+            idx = 0
+            spec = chunk = None
+            if spec_on:
+                hist, hist_len = extra[0], extra[1]
+                idx = 2
+
+                def draft_fn(h, hl, cur):
+                    if dcfg["kind"] == "self":
+                        return jnp.broadcast_to(
+                            cur[:, None], (cur.shape[0], k))
+                    return ngram_draft_tokens(
+                        h, hl, k, dcfg["max_ngram"], dcfg["min_ngram"])
+
+                def verify_fn(pl, tbl, ln, toks, valid):
+                    key = jax.random.PRNGKey(0)  # greedy: unused
+                    return verify_body(state, pl, tbl, ln, toks,
+                                       valid, key)
+
+                spec = {"k": k, "vocab": vocab, "draft_fn": draft_fn,
+                        "verify_fn": verify_fn, "hist": hist,
+                        "hist_len": hist_len}
+            if has_chunk:
+                (c_ids, c_valid, c_start, c_final, c_count,
+                 c_slot) = extra[idx:idx + 6]
+
+                def prefill_fn(pl, trow, slens, plen, ids):
+                    return prefill_body(state, pl, trow, slens, plen,
+                                        ids)
+
+                chunk = {"prefill_fn": prefill_fn, "ids": c_ids,
+                         "valid": c_valid, "start": c_start,
+                         "final": c_final, "count": c_count,
+                         "slot": c_slot}
+
             def step_fn(pl, tbl, ln, cur):
                 return body(state, pl, tbl, ln, cur)
 
-            with jax.named_scope("pt.multi_step"):
+            with jax.named_scope("pt.multi_step_inner"):
                 return multi_step_decode(step_fn, pools, table, lens,
-                                         tokens, active, rem, eos,
-                                         n, scratch)
+                                         tokens, active, rem, eos, n,
+                                         scratch, spec=spec,
+                                         chunk=chunk)
 
         return jax.jit(macro, donate_argnums=(1,))
 
-    def _build_prefill(self, chained: bool):
-        """One jitted prefill; jax.jit's shape-keyed cache compiles it
-        once per prompt bucket (the bucket IS the ids shape). The
-        ``chained`` variant starts from a non-empty slot (seq_lens =
-        the prefix-cache hit length) and attends the stored prefix
-        through the paged-attention reference (models/gpt.py
-        prefill_chained); the fresh variant keeps the exact dense
-        chunk-attention program the bit-identical tests pin."""
+    def _prefill_body_fn(self, chained: bool):
+        """The unjitted prefill body — ``_build_prefill`` wraps it in
+        its own jit for boundary launches; the r22 macro builder
+        embeds it in the while_loop body so a chained chunk advances
+        INSIDE the macro program."""
         import jax
 
         from ..autograd.engine import no_grad
@@ -1583,24 +1676,31 @@ class ContinuousBatchingEngine:
             }
             return nxt, self._constrain_pools(new_pools)
 
-        return jax.jit(prefill, donate_argnums=(1,))
+        return prefill
+
+    def _build_prefill(self, chained: bool):
+        """One jitted prefill; jax.jit's shape-keyed cache compiles it
+        once per prompt bucket (the bucket IS the ids shape). The
+        ``chained`` variant starts from a non-empty slot (seq_lens =
+        the prefix-cache hit length) and attends the stored prefix
+        through the paged-attention reference (models/gpt.py
+        prefill_chained); the fresh variant keeps the exact dense
+        chunk-attention program the bit-identical tests pin."""
+        import jax
+
+        return jax.jit(self._prefill_body_fn(chained),
+                       donate_argnums=(1,))
 
     def _get_prefill(self, chained: bool):
         if self._prefill_jits.get(chained) is None:
             self._prefill_jits[chained] = self._build_prefill(chained)
         return self._prefill_jits[chained]
 
-    def _build_verify(self):
-        """ONE jitted speculative verify step for the engine's whole
-        lifetime (fixed [num_slots, k+1] shape): append the pending
-        token + k drafts through the page tables (ragged per-slot
-        valid counts park the tail on the scratch page), score all
-        k+1 positions via models/gpt.py ``verify_step`` (the chained-
-        prefill q_offsets paged-attention path), and compute the
-        accept/resample decisions with nn/decode.py's shared sampler
-        math. Lengths stay host-owned: the host rolls back past the
-        longest accepted prefix, so rejected positions are simply
-        never attended again."""
+    def _verify_body_fn(self):
+        """The unjitted speculative-verify body — ``_build_verify``
+        wraps it for boundary launches; the r22 macro builder embeds
+        it as the while_loop iteration body when speculation runs
+        in-program."""
         import jax
 
         from ..autograd.engine import no_grad
@@ -1652,7 +1752,22 @@ class ContinuousBatchingEngine:
             }
             return accept, resid, full, self._constrain_pools(new_pools)
 
-        return jax.jit(verify, donate_argnums=(1,))
+        return verify
+
+    def _build_verify(self):
+        """ONE jitted speculative verify step for the engine's whole
+        lifetime (fixed [num_slots, k+1] shape): append the pending
+        token + k drafts through the page tables (ragged per-slot
+        valid counts park the tail on the scratch page), score all
+        k+1 positions via models/gpt.py ``verify_step`` (the chained-
+        prefill q_offsets paged-attention path), and compute the
+        accept/resample decisions with nn/decode.py's shared sampler
+        math. Lengths stay host-owned: the host rolls back past the
+        longest accepted prefix, so rejected positions are simply
+        never attended again."""
+        import jax
+
+        return jax.jit(self._verify_body_fn(), donate_argnums=(1,))
 
     def _unwind_prefill_failure(self, slot: int, req: DecodeRequest
                                 ) -> None:
@@ -1833,7 +1948,8 @@ class ContinuousBatchingEngine:
         # the completion notification; callbacks run on the engine
         # thread and must not raise — the server's callback catches
         # its own socket errors
-        if self.multi_step > 1 and self._spec_cfg is None:
+        if self.multi_step > 1 and (self._spec_cfg is None
+                                    or self._spec_inprogram):
             # multi-step mode (r19): EVERY emission rides the pending
             # queue — boundary-time prefill first-tokens included —
             # so the stream keeps (step, slot) order: the drained
@@ -1926,12 +2042,15 @@ class ContinuousBatchingEngine:
             # and charging ema per token would shed feasible work)
             if self._spec_cfg is not None:
                 per_step = self._spec_cfg.k + 1
+                if self._spec_inprogram:
+                    # r22: one macro launch carries up to N verify
+                    # iterations, each emitting up to k+1 tokens
+                    per_step *= self.multi_step
             else:
                 per_step = self.multi_step
             steps = -(-need // per_step)
             est = steps * self.decode_ema_s
-            if self.prefill_chunk_tokens is not None and \
-                    self.prefill_chunk_ema_s is not None:
+            if self.prefill_chunk_tokens is not None:
                 cached = 0
                 if self._prefix_cache is not None:
                     _keys, shared = self._prefix_cache.match(req.prompt,
@@ -1939,7 +2058,16 @@ class ContinuousBatchingEngine:
                     cached = len(shared) * self.page_size
                 chunks = -(-(len(req.prompt) - cached)
                            // self.prefill_chunk_tokens)
-                est += chunks * self.prefill_chunk_ema_s
+                if self._chunk_inprogram:
+                    # r22 in-program units: chained chunks ride macro
+                    # launches (up to N per launch), so a queued
+                    # prompt's best case is ceil(chunks/N) whole
+                    # launches at the per-LAUNCH decode EMA — not
+                    # per-chunk boundary wall time
+                    est += (-(-chunks // self.multi_step)
+                            * self.decode_ema_s)
+                elif self.prefill_chunk_ema_s is not None:
+                    est += chunks * self.prefill_chunk_ema_s
             return now + est > req.deadline_t
         return False
 
@@ -2351,7 +2479,7 @@ class ContinuousBatchingEngine:
             return sel(partial, decoding, time.monotonic())
         return min(partial, key=lambda sr: sr[1].req_id)[0]
 
-    def _advance_prefill_chunk(self) -> bool:
+    def _advance_prefill_chunk(self, slot: Optional[int] = None) -> bool:
         """Spend this step's prefill budget: advance AT MOST ONE
         half-prefilled slot by one page-aligned chunk of
         ``prefill_chunk_tokens`` tokens through the chained-prefill jit
@@ -2362,12 +2490,18 @@ class ContinuousBatchingEngine:
         fixed chunk bucket, so the engine pays one prefill compile per
         chained-ness, not one per suffix length. The final chunk's
         logits produce the first generated token, exactly like a whole
-        prefill. Returns True when a chunk ran."""
+        prefill. Returns True when a chunk ran.
+
+        ``slot``: pre-selected target (the r22 in-program planner
+        already ran the scheduler's pick and routes the dense FRESH
+        first chunk back here) — skips re-selection so the
+        chunk-budget policy is consulted exactly once per boundary."""
         partial = [(i, r) for i, r in enumerate(self._slots)
                    if r is not None and r.state == "prefill_partial"]
         if not partial:
             return False
-        slot = self._select_chunk_slot(partial)
+        if slot is None:
+            slot = self._select_chunk_slot(partial)
         if slot is None:
             return False  # scheduler deferred: decode preempts
         jnp = self._jnp
@@ -2483,6 +2617,56 @@ class ContinuousBatchingEngine:
         self._maybe_finish(slot)
         return True
 
+    def _plan_inprogram_chunks(self) -> Optional[Dict[str, Any]]:
+        """r22: schedule up to ``multi_step`` CHAINED prefill chunks of
+        one half-prefilled slot as per-iteration work INSIDE the next
+        macro launch. Consults the same chunk-budget policy as the
+        boundary path (one scheduler pick per boundary), then builds
+        the chunk arrays the macro program indexes per iteration:
+        iteration j runs chunk j while the other slots decode/verify —
+        the launch never stalls for the prefill, which is the r22
+        answer to the N-vs-TTFT trade.
+
+        The dense FRESH first chunk of an uncached prompt stays at the
+        boundary (routed back through ``_advance_prefill_chunk``): the
+        bit-identical pins fix chunk 1 to the exact dense prefill
+        program, and it is also each prompt's only non-chained chunk.
+        Returns the plan dict (``None``: nothing to do this launch)."""
+        partial = [(i, r) for i, r in enumerate(self._slots)
+                   if r is not None and r.state == "prefill_partial"]
+        if not partial:
+            return None
+        slot = self._select_chunk_slot(partial)
+        if slot is None:
+            return None  # scheduler deferred: decode preempts
+        req = self._slots[slot]
+        if req.prefill_done_len == 0:
+            self._advance_prefill_chunk(slot=slot)
+            return None
+        n = self.multi_step
+        chunk = self.prefill_chunk_tokens
+        done = req.prefill_done_len
+        total = len(req.prompt)
+        count = min(n, -(-(total - done) // chunk))
+        ids = np.zeros((n, chunk), np.int32)
+        valid = np.zeros((n,), np.int32)
+        start = np.zeros((n,), np.int32)
+        final = np.zeros((n,), bool)
+        pos = done
+        for j in range(count):
+            suffix = req.prompt[pos:pos + chunk]
+            ids[j, :len(suffix)] = suffix
+            valid[j] = len(suffix)
+            start[j] = pos
+            pos += len(suffix)
+            final[j] = pos == total
+        return {"slot": slot, "req": req, "count": count,
+                "done0": done, "end": pos, "tokens": pos - done,
+                "has_final": bool(final[:count].any()),
+                "final_idx": int(np.argmax(final)) if final.any() else -1,
+                "ids": ids, "valid": valid, "start": start,
+                "final": final}
+
     def _finish_due(self, req: DecodeRequest) -> bool:
         hit_eos = (req.eos_token is not None and req.generated and
                    req.generated[-1] == req.eos_token)
@@ -2541,14 +2725,52 @@ class ContinuousBatchingEngine:
     # it matters, and _notify_complete streams a request's undelivered
     # ring tokens before its completion on every terminal path.
 
-    def _dispatch_macro(self) -> bool:
+    def _macro_hist(self, chunk_plan: Optional[Dict[str, Any]] = None):
+        """Token histories for the in-program draft source (r22): each
+        decoding slot's prompt+generated ids right-padded to
+        ``[num_slots, max_seq_len]`` (submit() guarantees prompt +
+        max_new fits, so the boundary draft and its device twin see
+        the SAME history — bit-identical drafts). The chunk-plan slot
+        uploads its full prompt so the history is ready the moment the
+        program activates it at the final chunk."""
+        hcap = int(self.max_seq_len)
+        hist = np.zeros((self.num_slots, hcap), np.int32)
+        hlen = np.zeros((self.num_slots,), np.int32)
+        for i, r in enumerate(self._slots):
+            if r is None:
+                continue
+            if r.state == "decoding":
+                t = np.asarray(r.tokens, np.int32)
+            elif chunk_plan is not None and i == chunk_plan["slot"]:
+                t = np.asarray(r.prompt, np.int32)
+            else:
+                continue
+            t = t[:hcap]
+            hist[i, :len(t)] = t
+            hlen[i] = len(t)
+        return hist, hlen
+
+    def _dispatch_macro(self,
+                        chunk_plan: Optional[Dict[str, Any]] = None
+                        ) -> bool:
         """Launch ONE macro program covering up to ``multi_step``
         decode steps for every decoding slot. Returns True when a
-        launch happened (False: nothing is decoding). Does NOT block:
-        the device handles land in ``_pending_macro`` for the next
-        boundary's drain."""
+        launch happened (False: nothing is decoding and no chunk is
+        scheduled). Does NOT block: the device handles land in
+        ``_pending_macro`` for the next boundary's drain.
+
+        r22: with in-program speculation each iteration is a verify
+        step emitting up to k+1 tokens, so the page pre-bind covers
+        ``lens + min(N·(k+1), rem)`` and the token histories ship with
+        the launch; with a ``chunk_plan`` the launch also carries one
+        half-prefilled slot's chained-chunk schedule (the slot enters
+        INACTIVE and the program activates it when its final chunk
+        lands, so its rem/eos stop bookkeeping rides the launch
+        too)."""
         jnp = self._jnp
         n = self.multi_step
+        spec_on = self._spec_inprogram
+        per_iter = (int(self._spec_cfg.k) + 1) if spec_on else 1
         reqs: Dict[int, DecodeRequest] = {}
         active = np.zeros((self.num_slots,), bool)
         rem = np.zeros((self.num_slots,), np.int32)
@@ -2564,24 +2786,52 @@ class ContinuousBatchingEngine:
             # pre-bind the launch's growth pages out of the admission
             # reservation (PR 4 contract: cannot fail) — the page
             # table is then a CONSTANT of the program and in-program
-            # appends are pure index writes through it
-            self._ensure_pages(i, r, int(self._lens[i]) + min(n, r_rem))
+            # appends are pure index writes through it. The budget
+            # clip inside the program (k_eff) bounds every append
+            # below lens + min(N·per_iter, rem), so this covers the
+            # speculative worst case exactly.
+            self._ensure_pages(
+                i, r, int(self._lens[i]) + min(n * per_iter, r_rem))
             reqs[i] = r
-        if not reqs:
+        if chunk_plan is not None:
+            ci, cr = chunk_plan["slot"], chunk_plan["req"]
+            rem[ci] = cr.max_new_tokens
+            if cr.eos_token is not None:
+                eos[ci] = int(cr.eos_token)
+            if chunk_plan["has_final"]:
+                # the slot may activate and decode inside THIS launch
+                self._ensure_pages(
+                    ci, cr, len(cr.prompt)
+                    + min(n * per_iter, cr.max_new_tokens))
+        if not reqs and chunk_plan is None:
             return False
-        if self._multi_jit is None:
-            self._multi_jit = self._build_multi_decode()
+        has_chunk = chunk_plan is not None
+        jit = self._multi_jits.get(has_chunk)
+        if jit is None:
+            jit = self._build_multi_decode(has_chunk)
+            self._multi_jits[has_chunk] = jit
         from ..dispatch import count_op_calls
-        args = (self._fresh_state(), self._pools,
+        args = [self._fresh_state(), self._pools,
                 jnp.asarray(self._table), jnp.asarray(self._lens),
                 jnp.asarray(self._cur), jnp.asarray(active),
-                jnp.asarray(rem), jnp.asarray(eos))
+                jnp.asarray(rem), jnp.asarray(eos)]
+        if spec_on:
+            hist, hlen = self._macro_hist(chunk_plan)
+            args += [jnp.asarray(hist), jnp.asarray(hlen)]
+        if has_chunk:
+            args += [jnp.asarray(chunk_plan["ids"]),
+                     jnp.asarray(chunk_plan["valid"]),
+                     jnp.asarray(chunk_plan["start"]),
+                     jnp.asarray(chunk_plan["final"]),
+                     jnp.asarray(np.int32(chunk_plan["count"])),
+                     jnp.asarray(np.int32(chunk_plan["slot"]))]
+        args = tuple(args)
         t0 = time.monotonic()
         with count_op_calls() as c:
-            ring, nsteps, cur, lens, act, pools = self._multi_jit(*args)
+            ring, nsteps, cur, lens, act, pools = jit(*args)
         self._record_programs("decode_multi", c.count)
         if c.count:
-            self._capture_cost("decode_multi", self._multi_jit, args)
+            self._capture_cost("decode_multi", jit, args)
         self._pools = pools
         self.macro_launches += 1
         self._pending_macro = {
@@ -2589,6 +2839,7 @@ class ContinuousBatchingEngine:
             "reqs": reqs, "t_dispatch": t0,
             "launch": self.macro_launches,
             "dispatch_ms": (time.monotonic() - t0) * 1e3,
+            "rem": rem, "chunk": chunk_plan,
         }
         return True
 
@@ -2629,20 +2880,112 @@ class ContinuousBatchingEngine:
                 else 0.8 * self.decode_ema_s + 0.2 * dt
         else:
             self._macro_warm = True
-        reqs = pend["reqs"]
+        reqs = dict(pend["reqs"])
+        plan = pend.get("chunk")
+        spec_mode = ring.ndim == 3
+        k = int(self._spec_cfg.k) if spec_mode else 0
+        # --- fold the in-program chunk plan (r22) -----------------------
+        # All of the plan's chunks ran (the program's cond keeps the
+        # loop alive through iteration count-1 even when every decode
+        # slot stopped), so the host bookkeeping is unconditional; the
+        # final chunk's first token, if any, sits in the ring at
+        # final_idx and the slot joins the generic fold below.
+        if plan is not None:
+            ci = plan["slot"]
+            creq = plan["req"]
+            if self._slots[ci] is creq and \
+                    creq.state == "prefill_partial":
+                creq.stats.prefill_chunks += plan["count"]
+                creq.prefill_done_len = plan["end"]
+                self._lens[ci] = plan["end"]
+                creq.last_emit_t = now
+                self._last_chunk_t = now
+                creq.chunk_deferrals = 0
+                if creq.trace is not None:
+                    creq.trace.add(
+                        "prefill_chunk_inprogram",
+                        pend["t_dispatch"] * 1e6, now * 1e6,
+                        parent=creq.span, chunks=plan["count"],
+                        tokens=plan["tokens"], launch=pend["launch"])
+                if creq.deadline_t is not None and \
+                        now >= creq.deadline_t:
+                    # expired mid-prefill: chunks are paid for, but a
+                    # token past the deadline breaks the contract —
+                    # same typed eviction as the boundary path
+                    self._evict_slot(ci, "deadline")
+                elif plan["has_final"]:
+                    # promote: the final chunk's logits produced the
+                    # first token inside the program — same shape as
+                    # the boundary promotion in _advance_prefill_chunk
+                    fj = plan["final_idx"]
+                    nxt0 = int(ring[ci, fj, 0] if spec_mode
+                               else ring[ci, fj])
+                    creq.stats.prefill_attempts += 1
+                    creq.stats.first_token_t = now
+                    creq.state = "decoding"
+                    if creq.trace is not None:
+                        self._tr_end(creq,
+                                     chunks=creq.stats.prefill_chunks)
+                        creq.trace.event("first_token",
+                                         parent=creq.trace.anchor,
+                                         token=nxt0)
+                        creq.span = creq.trace.begin(
+                            "decode", parent=creq.trace.anchor)
+                    if self._prefix_cache is not None:
+                        creq.cache_keys = self._prefix_cache.insert(
+                            creq.prompt, self._table[ci],
+                            self.allocator, creq.req_id,
+                            self.page_size, creq.cache_keys,
+                            device_hits=getattr(
+                                creq, "_pfx_device_hits", None))
+                    # join the generic ring/lens/finish fold: its
+                    # first token (and any decode tokens the program
+                    # ran after activation) stream in ring order
+                    reqs[ci] = creq
         emissions: List[Tuple] = []
         per_step_tokens: List[int] = []
+        emitted_ct = {i: 0 for i in reqs}
+        runs_tot = drafted_tot = accepted_tot = 0
+        rem0 = pend.get("rem")
         for j in range(nsteps):
             count = 0
             for i in sorted(reqs):
-                tok = int(ring[i, j])
-                if tok < 0:
-                    continue
                 req = reqs[i]
-                req.generated.append(tok)
-                req.stats.tokens_out = len(req.generated)
-                emissions.append((req, tok, self._finish_due(req)))
-                count += 1
+                if spec_mode:
+                    toks = []
+                    for t in ring[i, j]:
+                        t = int(t)
+                        if t < 0:
+                            break  # run entries are front-packed
+                        toks.append(t)
+                else:
+                    t = int(ring[i, j])
+                    toks = [t] if t >= 0 else []
+                if not toks:
+                    continue
+                if spec_mode and not (plan is not None
+                                      and i == plan["slot"]
+                                      and j == plan["final_idx"]):
+                    # reconstruct the per-verify-step stats the
+                    # boundary path records on the host: drafted =
+                    # the budget-clipped k_eff the program used,
+                    # accepted = run length minus the correction/
+                    # bonus token (an EOS inside an accepted run
+                    # truncates the recorded run — terminal, rare)
+                    k_eff = max(
+                        min(k, int(rem0[i]) - emitted_ct[i] - 1), 0)
+                    req.stats.spec_steps += 1
+                    req.stats.spec_drafted += k_eff
+                    req.stats.spec_accepted += max(len(toks) - 1, 0)
+                    runs_tot += 1
+                    drafted_tot += k_eff
+                    accepted_tot += max(len(toks) - 1, 0)
+                emitted_ct[i] += len(toks)
+                for tok in toks:
+                    req.generated.append(tok)
+                    req.stats.tokens_out = len(req.generated)
+                    emissions.append((req, tok, self._finish_due(req)))
+                count += len(toks)
             per_step_tokens.append(count)
         for i in sorted(reqs):
             req = reqs[i]
@@ -2656,13 +2999,20 @@ class ContinuousBatchingEngine:
                 # boundary's admission), notify at delivery — after
                 # the request's ring tokens have streamed
                 self._finish_slot(i, notify=False)
+            elif spec_mode:
+                # in-program rejection rollback (r22): the program
+                # rewound seq_lens past the rejected drafts; return
+                # the pages whose every position sits at or beyond
+                # the accepted length (rereserve — later growth still
+                # cannot fail). Finished slots freed everything above.
+                self._rollback_pages(i, req, int(lens_f[i]))
             if req.trace is not None:
                 req.trace.add("macro_step", pend["t_dispatch"] * 1e6,
                               now * 1e6, parent=req.span,
                               step=self.steps + nsteps,
                               launch=pend["launch"],
                               steps_run=nsteps,
-                              tokens=int((ring[i, :nsteps] >= 0).sum()))
+                              tokens=emitted_ct.get(i, 0))
         self.steps += nsteps
         # step-timeline macro record (r16 ring, r19 fields): the entry
         # committed for THIS boundary carries the drained launch's
@@ -2677,6 +3027,14 @@ class ContinuousBatchingEngine:
             "overlap_idle_ms": round(idle_s * 1e3, 4),
             "dispatch_ms": round(pend["dispatch_ms"], 4),
         }
+        if spec_mode:
+            # r22 additive keys: verify iterations broken out so the
+            # timeline can attribute macro time to speculation
+            self._tl_macro["spec"] = {
+                "runs": runs_tot, "drafted": drafted_tot,
+                "accepted": accepted_tot}
+        if plan is not None:
+            self._tl_macro["chunks"] = int(plan["count"])
         return emissions
 
     def _flush_macro(self) -> None:
@@ -2744,9 +3102,17 @@ class ContinuousBatchingEngine:
         if self.num_active == 0:
             self._deliver_pending()
             return 0
+        chunk_plan = None
         if self.prefill_chunk_tokens is not None:
-            self._advance_prefill_chunk()
-        self._dispatch_macro()
+            if self._chunk_inprogram:
+                # r22: chained chunks ride INSIDE the launch (up to N
+                # of one slot's chunks, one per iteration); only the
+                # dense fresh first chunk still runs here at the
+                # boundary (inside _plan_inprogram_chunks)
+                chunk_plan = self._plan_inprogram_chunks()
+            else:
+                self._advance_prefill_chunk()
+        self._dispatch_macro(chunk_plan)
         self._deliver_pending()
         return self.num_active
 
@@ -2957,12 +3323,16 @@ class ContinuousBatchingEngine:
             self._tl_commit(t_step)
 
     def _step_inner(self) -> int:
-        if self.multi_step > 1 and self._spec_cfg is None:
+        if self.multi_step > 1 and (self._spec_cfg is None
+                                    or self._spec_inprogram):
             # device-resident multi-step decode (r19): one boundary =
             # drain launch K−1, boundary scheduling, dispatch launch
-            # K, deliver K−1's ring. Speculative engines keep their
-            # per-step verify cadence (it already amortizes k+1
-            # tokens per launch — spec composes AT the boundary).
+            # K, deliver K−1's ring. r22: a greedy speculative engine
+            # with a device-implementable draft rides the SAME macro
+            # boundary — draft/verify/rewind run inside the launch
+            # (_spec_inprogram). Other speculative engines (sampled
+            # verify, host draft sources) keep their per-step verify
+            # cadence — spec composes AT the boundary for them.
             return self._macro_multi_step()
         self.expire_deadlines()
         self.evict_stalled()

@@ -84,7 +84,12 @@ class SLOConfig:
     # multi-step decode (r19, multi_step=N) each boundary covers up
     # to N generated tokens, so a deferral budget of 4 means up to
     # 4*N decode tokens of delay, not 4; TTFT-sensitive deployments
-    # running large N should shrink this accordingly.
+    # running large N should shrink this accordingly. With the r22
+    # in-program inner loop a GRANT costs decode nothing (the chunks
+    # ride inside the macro launch, one per iteration, instead of
+    # stalling the boundary) and each grant advances up to N chunks,
+    # so deferring is only worth it when the launch itself must stay
+    # small — the default budget is then an upper bound, not a tune.
     max_chunk_deferrals: int = 4
     # per-class cap on in-flight half-prefilled debt (tokens) at
     # admission; None = unbounded. A class with zero in-flight debt is
@@ -207,7 +212,16 @@ class SLOScheduler:
         nothing (the chunk runs at the boundary, outside the macro
         launch) — the deferral bound is a boundary count, exactly as
         the deadline gate's estimates are per-launch
-        (``decode_ema_s`` tracks one macro launch there)."""
+        (``decode_ema_s`` tracks one macro launch there).
+
+        In-program inner loop (r22): a grant now schedules up to N of
+        the slot's CHAINED chunks inside the macro launch itself — the
+        decode batch keeps decoding through the same iterations, so
+        preempting the chunk no longer protects interactive TPOT from
+        a launch stall; it only bounds the launch's extra chunk work.
+        The deadline gate mirrors this by charging ceil(chunks/N)
+        whole launches at ``decode_ema_s`` (in-program units) instead
+        of per-chunk boundary wall time."""
         if not partial:
             return None
         ranked = sorted(partial, key=lambda sr: (

@@ -1,0 +1,339 @@
+"""In-program serving inner loop (r22, ROADMAP item 3a/3b).
+
+The contracts this suite pins (ISSUE 17 acceptance):
+
+- speculative verify runs INSIDE the macro ``while_loop`` when the
+  draft has a device twin (ngram / self): greedy outputs are
+  BIT-IDENTICAL to the per-token vanilla engine, the boundary
+  ``verify`` program never launches, and launches per emitted token
+  strictly drop vs the boundary-interleaved spec engine;
+- an accepted k-token run costs zero extra launches and EOS landing
+  INSIDE an accepted run (or at any other in-macro position) stops the
+  stream exactly where the per-token engine would;
+- a rejection storm (a draft that never matches) rewinds ``seq_lens``
+  in-program and every exit path — drain, mid-flight close, deadline
+  eviction — returns reservations to zero with no page leaks;
+- chunked prefill advances chained chunks inside the macro program
+  (``prefill_chunk_inprogram`` trace events), composes with in-program
+  verify, and a request dumped MID-CHUNK replays bit-identically onto
+  a rebuilt in-program engine;
+- every escape hatch restores the prior engine: ``inprogram=False``
+  falls back to the boundary-interleaved r19/spec path, and a draft
+  without a device twin (ModelDraft/CallableDraft) falls back
+  automatically — outputs unchanged either way.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.inference import SpeculativeConfig, create_decode_engine
+from paddle_tpu.inference.speculative import CallableDraft
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM, gpt_tiny
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _compile_cache(module_compile_cache):
+    """Engine-heavy file: reuse XLA compiles across tests (see
+    conftest.module_compile_cache)."""
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def vmodel():
+    """vocab-16 twin: greedy decode revisits tokens fast enough that
+    ngram/self drafts get real accepted runs (the 1024-vocab tiny
+    model never repeats inside a test-sized stream, so acceptance
+    would be vacuously zero)."""
+    pt.seed(0)
+    m = GPTForCausalLM(GPTConfig(
+        vocab_size=16, hidden_size=128, num_layers=2, num_heads=4,
+        max_seq_len=128, dropout=0.0, attn_dropout=0.0))
+    m.eval()
+    return m
+
+
+def _engine(m, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_seq_len", 64)
+    return create_decode_engine(m, **kw)
+
+
+def _prompts(vocab=1024):
+    rng = np.random.default_rng(0)
+    return [rng.integers(0, vocab, n).astype(np.int32)
+            for n in (5, 9, 13, 7)]
+
+
+def _run_stream(m, mnt=8, eos=None, prompts=None, stats=None, **kw):
+    cb = None if stats is None else (lambda r: stats.append(r.stats))
+    eng = _engine(m, on_complete=cb, **kw)
+    ps = _prompts() if prompts is None else prompts
+    rids = [eng.submit(p, max_new_tokens=mnt, eos_token=eos)
+            for p in ps]
+    res = eng.run()
+    launches = dict(eng.programs_launched)
+    eng.close()
+    return [res[r].tolist() for r in rids], launches
+
+
+SPEC = dict(k=3, draft="ngram")
+
+
+# ---------------------------------------------------------------------------
+# In-program speculative verify: bit-identity + launch economics
+# ---------------------------------------------------------------------------
+
+class TestInProgramSpec:
+    def test_bit_identical_ngram_and_self(self, model):
+        base, _ = _run_stream(model, multi_step=1)
+        for draft in ("ngram", "self"):
+            got, _ = _run_stream(
+                model, multi_step=4,
+                speculative=SpeculativeConfig(k=3, draft=draft))
+            assert got == base, f"in-program {draft} draft diverged"
+
+    def test_verify_rides_inside_macro(self, model):
+        """The fused boundary ``verify`` program never launches: the
+        k+1-position verify is an iteration of ``decode_multi``. That
+        is the launch win — one macro launch covers up to N*(k+1)
+        positions."""
+        eng = _engine(model, multi_step=4,
+                      speculative=SpeculativeConfig(**SPEC))
+        assert eng._spec_inprogram
+        rids = [eng.submit(p, max_new_tokens=8) for p in _prompts()]
+        res = eng.run()
+        launches = dict(eng.programs_launched)
+        eng.close()
+        assert "verify" not in launches
+        assert "decode" not in launches
+        tokens = sum(len(res[r]) for r in rids) - sum(
+            len(p) for p in _prompts())
+        # boundary spec = one verify launch per step; in-program must
+        # use strictly fewer launches than tokens even at 0% acceptance
+        assert launches["decode_multi"] < tokens
+
+    def test_launches_strictly_reduced_vs_boundary(self, model):
+        spec = SpeculativeConfig(**SPEC)
+        base, lb = _run_stream(model, multi_step=4, speculative=spec,
+                               inprogram=False)
+        got, li = _run_stream(model, multi_step=4, speculative=spec)
+        assert got == base
+        assert lb["verify"] > 0  # boundary mode really interleaved
+        assert li["decode_multi"] < lb["verify"]
+
+    def test_accepted_runs_occur(self, vmodel):
+        """The in-program verify ACCEPTS on the small-vocab stream —
+        the acceptance math is exercised for real, not just the
+        all-rejected path — and stats survive ring reconstruction."""
+        for draft in ("ngram", "self"):
+            stats = []
+            got, _ = _run_stream(
+                vmodel, mnt=32, prompts=[np.array([3, 1, 4, 1, 5],
+                                                  np.int32)],
+                stats=stats, multi_step=4, max_seq_len=96,
+                speculative=SpeculativeConfig(k=3, draft=draft))
+            assert stats[0].spec_accepted > 0, f"{draft}: no accepts"
+            assert stats[0].spec_drafted >= stats[0].spec_accepted
+
+    def test_eos_inside_run_every_offset(self, vmodel):
+        """EOS sweep over every first-occurrence position of the
+        pinned small-vocab stream: each lands at a different in-macro
+        iteration / in-run offset (including inside the accepted
+        repeated-token run), and each stops bit-identically where the
+        per-token engine stops."""
+        prompts = [np.array([3, 1, 4, 1, 5], np.int32)]
+        kw = dict(mnt=16, prompts=prompts, max_seq_len=96)
+        base, _ = _run_stream(vmodel, multi_step=1, **kw)
+        gen = base[0][len(prompts[0]):]
+        offsets = [i for i, t in enumerate(gen) if t not in gen[:i]]
+        assert len(offsets) >= 4  # the sweep covers offsets 0..N-1
+        for off in offsets[:5]:  # 5 distinct cuts bound suite wall
+            eos = gen[off]
+            a, _ = _run_stream(vmodel, multi_step=1, eos=eos, **kw)
+            b, _ = _run_stream(
+                vmodel, multi_step=4, eos=eos,
+                speculative=SpeculativeConfig(**SPEC), **kw)
+            assert a == b, f"EOS at generated offset {off} diverged"
+            assert len(a[0]) == len(prompts[0]) + off + 1
+
+    def test_escape_hatch_inprogram_false(self, model):
+        """``inprogram=False`` is the r22 escape hatch: the engine
+        keeps the r19 boundary-interleaved spec path (the fused
+        ``verify`` program at every boundary), outputs unchanged."""
+        eng = _engine(model, multi_step=4, inprogram=False,
+                      speculative=SpeculativeConfig(**SPEC))
+        assert not eng._spec_inprogram
+        assert not eng._chunk_inprogram
+        eng.close()
+        base, _ = _run_stream(model, multi_step=1)
+        got, launches = _run_stream(model, multi_step=4,
+                                    speculative=SpeculativeConfig(
+                                        **SPEC), inprogram=False)
+        assert got == base
+        assert launches["verify"] > 0
+
+    def test_host_draft_falls_back_to_boundary(self, model):
+        """A draft with no device twin (arbitrary host code) cannot
+        move in-program; the engine falls back silently and outputs
+        still match."""
+        draft = CallableDraft(lambda h, k: [int(h[-1])] * k)
+        eng = _engine(model, multi_step=4,
+                      speculative=SpeculativeConfig(k=3, draft=draft))
+        assert not eng._spec_inprogram
+        eng.close()
+        base, _ = _run_stream(model, multi_step=1)
+        got, _ = _run_stream(model, multi_step=4,
+                             speculative=SpeculativeConfig(k=3,
+                                                           draft=draft))
+        assert got == base
+
+
+# ---------------------------------------------------------------------------
+# Rejection storms: in-program rewind, zero leaks on every exit path
+# ---------------------------------------------------------------------------
+
+class TestRejectionStorm:
+    """The 1024-vocab stream never repeats, so ngram drafts reject at
+    every verify — a natural all-rejection storm: every iteration
+    writes k speculative positions that the in-program rewind must
+    return."""
+
+    def test_storm_outputs_and_drain_leak_free(self, model):
+        stats = []
+        base, _ = _run_stream(model, multi_step=1)
+        eng = _engine(model, multi_step=4,
+                      on_complete=lambda r: stats.append(r.stats),
+                      speculative=SpeculativeConfig(**SPEC))
+        rids = [eng.submit(p, max_new_tokens=8) for p in _prompts()]
+        res = eng.run()
+        got = [res[r].tolist() for r in rids]
+        assert got == base  # storm costs speed, never tokens
+        assert sum(s.spec_accepted for s in stats) == 0  # pure storm
+        assert sum(s.spec_drafted for s in stats) > 0
+        assert eng.allocator.reserved_total == 0
+        eng.close()
+        eng.allocator.check_no_leak()
+
+    def test_mid_flight_close_during_storm(self, model):
+        eng = _engine(model, multi_step=4,
+                      speculative=SpeculativeConfig(**SPEC))
+        for p in _prompts():
+            eng.submit(p, max_new_tokens=16)
+        eng.step()
+        eng.step()  # a spec macro is in flight now
+        eng.close()
+        assert eng.allocator.reserved_total == 0
+        eng.allocator.check_no_leak()
+
+    def test_deadline_eviction_mid_storm(self, model):
+        import time
+        states = []
+        eng = _engine(model, multi_step=4,
+                      on_complete=lambda r: states.append(r.state),
+                      speculative=SpeculativeConfig(**SPEC))
+        eng.submit(_prompts()[0], max_new_tokens=32,
+                   deadline_t=time.monotonic() + 0.01)
+        eng.step()
+        time.sleep(0.02)
+        eng.step()  # boundary sweep evicts typed mid-storm
+        assert "deadline" in states
+        assert eng.allocator.reserved_total == 0
+        eng.close()
+        eng.allocator.check_no_leak()
+
+
+# ---------------------------------------------------------------------------
+# In-program chunked prefill
+# ---------------------------------------------------------------------------
+
+def _long_prompts():
+    rng = np.random.default_rng(1)
+    return [rng.integers(0, 1024, n).astype(np.int32)
+            for n in (41, 9)]
+
+
+class TestInProgramChunk:
+    def test_bit_identical_and_traced(self, model):
+        from paddle_tpu.serving import SpanTracer
+        base, _ = _run_stream(model, multi_step=1,
+                              prompts=_long_prompts())
+        tr = SpanTracer(sample_rate=1.0)
+        eng = _engine(model, multi_step=4, prefill_chunk_tokens=8,
+                      tracer=tr)
+        assert eng._chunk_inprogram
+        rids = [eng.submit(p, max_new_tokens=8)
+                for p in _long_prompts()]
+        res = eng.run()
+        got = [res[r].tolist() for r in rids]
+        eng.close()
+        assert got == base
+        spans = [s["name"] for t in tr.finished() for s in t["spans"]]
+        assert "prefill_chunk_inprogram" in spans, \
+            "no chunks advanced inside the macro program"
+        eng.allocator.check_no_leak()
+
+    def test_composes_with_inprogram_spec(self, model):
+        base, _ = _run_stream(model, multi_step=1,
+                              prompts=_long_prompts())
+        eng = _engine(model, multi_step=4, prefill_chunk_tokens=8,
+                      speculative=SpeculativeConfig(**SPEC))
+        assert eng._spec_inprogram and eng._chunk_inprogram
+        eng.close()
+        got, launches = _run_stream(
+            model, multi_step=4, prefill_chunk_tokens=8,
+            prompts=_long_prompts(),
+            speculative=SpeculativeConfig(**SPEC))
+        assert got == base
+        assert "verify" not in launches
+
+    def test_replay_mid_chunk_onto_rebuilt_engine(self, model):
+        """A request dumped with its prefill half-done (mid-chunk)
+        replays bit-identically onto a REBUILT in-program engine —
+        the resurrection contract extended to the r22 chunk path."""
+        base, _ = _run_stream(model, mnt=8, multi_step=1,
+                              prompts=_long_prompts())
+        eng = _engine(model, multi_step=4, prefill_chunk_tokens=8)
+        rids = [eng.submit(p, max_new_tokens=8)
+                for p in _long_prompts()]
+        mid = None
+        for _ in range(8):
+            eng.step()
+            mid = next(
+                (r for r in eng._slots if r is not None
+                 and r.state == "prefill_partial"
+                 and 0 < r.prefill_done_len < len(r.prompt)), None)
+            if mid is not None:
+                break
+        assert mid is not None, "never observed a mid-chunk request"
+        snap = eng.dump_inflight()
+        pre = {r.req_id: ([int(t) for t in r.prompt],
+                          [int(t) for t in r.generated],
+                          r.max_new_tokens) for r in snap}
+        eng.close()
+        eng.allocator.check_no_leak()
+        eng2 = _engine(model, multi_step=4, prefill_chunk_tokens=8)
+        new_rids = {}
+        for old_rid, (prompt, gen, mnt) in sorted(pre.items()):
+            new_rids[old_rid] = eng2.submit(
+                np.asarray(prompt + gen, np.int32),
+                max_new_tokens=mnt - len(gen))
+        res = eng2.run()
+        eng2.close()
+        eng2.allocator.check_no_leak()
+        for old_rid in sorted(pre):
+            prompt, gen, _mnt = pre[old_rid]
+            full = prompt + gen + [
+                int(t) for t in
+                res[new_rids[old_rid]][len(prompt) + len(gen):]]
+            assert full == base[old_rid], \
+                f"mid-chunk replay diverged for req {old_rid}"

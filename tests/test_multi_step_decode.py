@@ -131,9 +131,13 @@ class TestBitIdentity:
         assert a == b
 
     def test_speculative_composes_at_boundary(self, model):
-        """Spec engines keep their per-step verify cadence (it already
-        amortizes k+1 tokens per launch); multi_step rides along
-        without changing outputs."""
+        """Spec + multi_step never changes outputs. Since r22 this
+        config runs the verify INSIDE the macro program (the ngram
+        draft has a device twin, so ``_spec_inprogram`` engages by
+        default); the boundary-interleaved cadence this test was born
+        pinning is now the ``inprogram=False`` escape hatch — both
+        lanes are pinned bit-identical in
+        test_inprogram_inner_loop.py."""
         a, _ = _run_stream(model, multi_step=1)
         b, _ = _run_stream(model, multi_step=4,
                            speculative=SpeculativeConfig(k=2,

@@ -190,6 +190,32 @@ def promoted_cases():
 
     multi_step_decode.op_name = "paged_attention_fused"
 
+    def inprogram_verify():
+        # r22 in-program speculative verify: the macro while_loop's
+        # per-iteration hot op when speculation runs inside the launch
+        # — a k+1 = 5-position verify window per SLOT, batched over
+        # the whole slot set, appended at MID-MACRO lengths. Unlike
+        # fused_verify above (one slot, page-aligned done=128), the
+        # in-program iterations verify at whatever non-page-aligned
+        # lengths the accepted runs left behind (lens grow by 1..k+1
+        # per iteration), so this pins the ragged q_offsets page-walk
+        # + fused epilogue at exactly those offsets. The whole-loop
+        # program is model-shaped; this is its dominant inner op.
+        h, d = 8, 64
+        e = h * d
+        n_pages, page, s = 161, 16, 5
+        kp = _f32(n_pages, page, h, d)
+        vp = _f32(n_pages, page, h, d)
+        table = np.arange(8 * 9, dtype=np.int32).reshape(8, 9)
+        # the multi_step_decode mid-macro offsets, shifted by the
+        # ragged run lengths a speculative launch accumulates
+        done = np.asarray([131, 115, 99, 83, 67, 51, 35, 19], np.int32)
+        lens = done + s
+        return (_f32(8, s, h, d), kp, vp, table, lens,
+                _f32(e, e), _f32(e), None, None, None, done)
+
+    inprogram_verify.op_name = "paged_attention_fused"
+
     def page_fetch_splice():
         # r20 disaggregated serving: the decode-side splice of a
         # FETCHED chain run — a 4-page contiguous prefix pulled over
@@ -210,7 +236,8 @@ def promoted_cases():
             "fused_verify": fused_verify,
             "fused_sample": fused_sample,
             "prefix_restore": prefix_restore,
-            "multi_step_decode": multi_step_decode}
+            "multi_step_decode": multi_step_decode,
+            "inprogram_verify": inprogram_verify}
 
 
 def bench_op(name: str, make_args, repeat: int) -> dict:
