@@ -1576,6 +1576,159 @@ def bench_prefix_tiers(on_tpu: bool) -> Dict:
     return out
 
 
+def bench_kv_substrate(on_tpu: bool) -> Dict:
+    """KV byte substrate A/B (r23 tentpole artifact): the spill-heavy
+    shared-prefix stream of bench_prefix_tiers swept over the
+    blob-format x dedup grid, plus a paged-int8 lossless pair. The
+    three numbers the substrate exists to move:
+
+    - WIRE bytes per spilled KV token (spill/handoff blobs ride the
+      same ``pack_page_blob`` codecs): int8 blobs carry ~4x fewer
+      bytes than raw fp32, int4 ~8x — reported as
+      ``wire_bytes_per_token`` per format with the raw-equivalent
+      ``logical_bytes`` alongside;
+    - effective context tokens per HBM megabyte (cross-request page
+      dedup): two concurrent same-prefix admissions under chunked
+      prefill fold their duplicate FULL pages onto one physical copy;
+    - greedy bit-identity: every LOSSLESS config (raw anywhere, int8
+      blobs over a paged-int8 pool, dedup on or off) must report
+      ``bit_identical`` true vs the r22 escape hatch (raw +
+      dedup-off); lossy fp formats report ``codec_stats`` (pages,
+      max abs dequant error) instead — never silently."""
+    import paddle_tpu as pt
+    from paddle_tpu.inference import create_decode_engine
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.serving import PrefixCache
+
+    if on_tpu:
+        cfg = _decode_1p3b_cfg()
+        slots, page, max_seq = 4, 64, 1024
+        sys_len, tail, new_toks = 512, 16, 16
+        n_prefix, rounds = 6, 2
+        num_pages, dedup_pages = 24, 48
+        spill = 1 << 32
+    else:
+        # the beefed-up tiny config bench_prefix_tiers uses: enough KV
+        # bytes per page that codec ratios measure payload, not header
+        from paddle_tpu.models.gpt import GPTConfig
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                        num_heads=4, max_seq_len=256, dropout=0.0,
+                        attn_dropout=0.0)
+        slots, page, max_seq = 2, 16, 256
+        sys_len, tail, new_toks = 200, 8, 8
+        n_prefix, rounds = 4, 2
+        num_pages, dedup_pages = 20, 32
+        spill = 1 << 27
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        _to_bf16_except_norms(model)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompts = [np.concatenate([
+        rng.integers(0, cfg.vocab_size, (sys_len,)).astype(np.int32),
+        rng.integers(0, cfg.vocab_size, (tail,)).astype(np.int32)])
+        for _ in range(n_prefix)]
+    full_pages = (len(prompts[0]) - 1) // page
+    # fp32 KV page in HBM: K+V per layer, hidden floats per token
+    page_hbm_bytes = 2 * cfg.num_layers * page * cfg.hidden_size * 4
+
+    def run_mode(fmt: str, dedup: bool, kv_int8: bool = False) -> Dict:
+        # -- phase A: serial spill/restore stream (codec wire bytes) --
+        pc = PrefixCache(page, spill_bytes=spill, blob_format=fmt,
+                         dedup=dedup)
+        eng = create_decode_engine(
+            model, num_slots=slots, page_size=page, max_seq_len=max_seq,
+            num_pages=num_pages, prefix_cache=pc, kv_int8=kv_int8)
+        outputs = []
+        for p in (prompts[0], prompts[1], prompts[0]):  # warm compiles
+            eng.submit(p, max_new_tokens=2)
+            eng.run()
+        pc.evict_until(eng.allocator, eng.allocator.num_pages)
+        eng.submit(prompts[0], max_new_tokens=2)
+        eng.run()  # pays the splice-jit bucket compile
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            for p in prompts:
+                rid = eng.submit(p, max_new_tokens=new_toks)
+                res = eng.run()
+                outputs.append([int(t) for t in res[rid][len(p):]])
+        wall = time.perf_counter() - t0
+        tier = pc.tiers[0]
+        wire, logical = tier.occupancy_bytes, tier.logical_bytes
+        tokens_spilled = tier.blob_count * page
+        out = {"requests": len(outputs), "wall_s": round(wall, 3),
+               "outputs": outputs,
+               "wire_bytes": wire, "logical_bytes": logical,
+               "wire_bytes_per_token": (round(wire / tokens_spilled, 1)
+                                        if tokens_spilled else None),
+               "spilled_pages": pc.spilled_pages,
+               "restored_pages": pc.restored_pages,
+               "codec_stats": dict(pc.codec_stats)}
+        eng.close()
+
+        # -- phase B: concurrent same-prefix admissions (dedup HBM) ---
+        pc2 = PrefixCache(page, dedup=dedup)
+        eng2 = create_decode_engine(
+            model, num_slots=2, page_size=page, max_seq_len=max_seq,
+            num_pages=dedup_pages, prefix_cache=pc2, kv_int8=kv_int8,
+            prefill_chunk_tokens=page)
+        r1 = eng2.submit(prompts[0], max_new_tokens=new_toks)
+        r2 = eng2.submit(prompts[0], max_new_tokens=new_toks)
+        res2 = eng2.run()
+        out["outputs"] = out["outputs"] + [
+            [int(t) for t in res2[r][len(prompts[0]):]]
+            for r in (r1, r2)]
+        ctx_tokens = 2 * full_pages * page
+        pages_used = 2 * full_pages - pc2.dedup_hits
+        out["dedup_hits"] = pc2.dedup_hits
+        out["hbm_ctx_pages"] = pages_used
+        out["effective_ctx_tokens_per_hbm_mb"] = round(
+            ctx_tokens / (pages_used * page_hbm_bytes / (1 << 20)), 1)
+        eng2.close()
+        return out
+
+    grid: Dict = {}
+    for fmt in ("raw", "int8"):
+        for dedup in (False, True):
+            grid[f"{fmt}|dedup_{'on' if dedup else 'off'}"] = \
+                run_mode(fmt, dedup)
+    # paged-int8 pool: int8 blobs are a lossless passthrough of the
+    # pool layout — the codec rewrites them to raw framing, so wire
+    # bytes AND greedy outputs must match exactly
+    i8_raw = run_mode("raw", True, kv_int8=True)
+    i8_coded = run_mode("int8", True, kv_int8=True)
+
+    baseline = grid["raw|dedup_off"]["outputs"]
+    for mode in grid.values():
+        mode["bit_identical"] = mode.pop("outputs") == baseline
+    i8_pair = {"bit_identical":
+               i8_raw.pop("outputs") == i8_coded.pop("outputs"),
+               "wire_bytes_raw": i8_raw["wire_bytes"],
+               "wire_bytes_int8": i8_coded["wire_bytes"],
+               "codec_stats": i8_coded["codec_stats"]}
+
+    out: Dict = {"metric": "gpt1p3b_kv_substrate_ab_chip" if on_tpu
+                 else "gpt_tiny_kv_substrate_ab_cpu_smoke",
+                 "distinct_prefixes": n_prefix, "rounds": rounds,
+                 "system_prompt_len": sys_len, "page_size": page,
+                 "num_pages": num_pages, "grid": grid,
+                 "paged_int8": i8_pair}
+    raw_w = grid["raw|dedup_off"]["wire_bytes_per_token"]
+    i8_w = grid["int8|dedup_off"]["wire_bytes_per_token"]
+    if raw_w and i8_w:
+        out["wire_shrink_int8_vs_raw"] = round(raw_w / i8_w, 2)
+    out["effective_ctx_tokens_per_hbm_mb"] = {
+        "dedup_off": grid["raw|dedup_off"]
+        ["effective_ctx_tokens_per_hbm_mb"],
+        "dedup_on": grid["raw|dedup_on"]
+        ["effective_ctx_tokens_per_hbm_mb"]}
+    out["hbm_pages_saved_by_dedup"] = \
+        grid["raw|dedup_on"]["dedup_hits"]
+    return out
+
+
 def bench_memory_observatory(on_tpu: bool) -> Dict:
     """memory_observatory (r18): ledger-overhead A/B on a page-CHURN
     stream — a revisited shared-prefix workload over a pool smaller
@@ -3059,6 +3212,7 @@ def run_staged(on_tpu: bool) -> Dict:
                      ("mesh_decode", bench_mesh_decode),
                      ("serving_prefix", bench_serving_prefix),
                      ("prefix_tiers", bench_prefix_tiers),
+                     ("kv_substrate", bench_kv_substrate),
                      ("disaggregated_serving",
                       bench_disaggregated_serving),
                      ("serving_goodput", bench_serving_goodput),

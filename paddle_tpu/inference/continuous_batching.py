@@ -240,21 +240,25 @@ class PageAllocator:
     def occupancy(self) -> Dict[str, int]:
         """Pool breakdown by owner class (r18 capacity timeline):
         ``inflight`` (request-owned) / ``prefix_device`` (prefix-cache
-        chains) / ``reserved`` (speculative capacity) / ``free``.
+        chains) / ``dedup`` (cross-request content-shared pages, r23)
+        / ``reserved`` (speculative capacity) / ``free``.
         Sums to ``num_pages`` by construction — the invariant
         tools/flight_inspect.py lints. Scrape/conn threads read this
         while the engine thread mutates; retry the benign
         dict-iteration race (the health-op discipline) — a class
         count pinned between retries stays self-consistent because it
         is recomputed whole."""
-        infl = pfx = reserved = 0
+        infl = pfx = dedup = reserved = 0
         for attempt in range(3):
-            infl = pfx = reserved = 0
+            infl = pfx = dedup = reserved = 0
             try:
                 for owner, pages in list(self._owned.items()):
                     if isinstance(owner, tuple) and owner \
                             and owner[0] == "prefix":
                         pfx += len(pages)
+                    elif isinstance(owner, tuple) and owner \
+                            and owner[0] == "dedup":
+                        dedup += len(pages)
                     else:
                         infl += len(pages)
                 # inside the retry: summing _reserved.values() races
@@ -267,9 +271,9 @@ class PageAllocator:
         # engine-thread reads are exact either way, and a scrape-side
         # racy read then still satisfies sum-to-pool instead of
         # presenting classes torn across two snapshots
-        free = max(0, self.num_pages - infl - pfx - reserved)
+        free = max(0, self.num_pages - infl - pfx - dedup - reserved)
         return {"inflight": infl, "prefix_device": pfx,
-                "reserved": reserved, "free": free}
+                "dedup": dedup, "reserved": reserved, "free": free}
 
     def check_no_leak(self) -> None:
         if self._owned or self._reserved or \
@@ -467,7 +471,8 @@ class ContinuousBatchingEngine:
                  tracer=None, timeline_steps: int = 256,
                  capture_costs: bool = False,
                  page_ledger: bool = True,
-                 ledger_events: int = 1024):
+                 ledger_events: int = 1024,
+                 forecast_admission: bool = False):
         import jax.numpy as jnp
 
         from ..core.compile_cache import enable_compile_cache
@@ -570,6 +575,15 @@ class ContinuousBatchingEngine:
             self.ledger = None
         self.allocator = PageAllocator(self.num_pages,
                                        ledger=self.ledger)
+        # byte-planning admission (r23): when True, _fits also charges
+        # the forecast page-burn of the already-admitted fleet over
+        # this request's expected lifetime (the r18 exhaustion
+        # forecast over the step timeline) — a request is admitted
+        # only when the POOL'S FUTURE, not just its instant free
+        # count, accommodates it. Default False: byte-for-byte the
+        # instant-occupancy gate.
+        self.forecast_admission = bool(forecast_admission)
+        self.forecast_denials = 0
         self._scratch = self.num_pages  # reserved page index
         dt = functional_state(model)["params"]["gpt.wte.weight"].dtype
         nh, hd, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
@@ -1140,6 +1154,8 @@ class ContinuousBatchingEngine:
                 1.0 - occ["free"] / self.num_pages, 4)
             if self.num_pages else 0.0,
             "steps": int(self.steps),
+            "forecast_admission": bool(self.forecast_admission),
+            "forecast_denials": int(self.forecast_denials),
         }
         pc = self._prefix_cache
         evictable = 0
@@ -1848,6 +1864,26 @@ class ContinuousBatchingEngine:
             keys, shared = self._prefix_cache.match(req.prompt, memo=req)
             need -= len(shared)
             avail += self._prefix_cache.evictable_pages(excluding=keys)
+        if need <= avail and self.forecast_admission:
+            # byte planning (r23): also charge the fleet's forecast
+            # page burn over this request's expected lifetime. The
+            # r18 EWMA over the step-timeline's free_pages deltas
+            # gives pages/s; the horizon is how long this request
+            # will realistically hold its pages (max_new_tokens at
+            # the decode EMA). A positive burn rate shrinks avail by
+            # the pages the ALREADY-ADMITTED load will take in that
+            # window — landing a request the instant books accept but
+            # the forecast cannot carry is how pools thrash.
+            from .page_ledger import forecast_exhaustion
+            fc = forecast_exhaustion(self.step_timeline())
+            rate = fc.get("rate_pages_per_s")
+            if rate is not None and rate > 0 and \
+                    self.decode_ema_s is not None:
+                horizon_s = req.max_new_tokens * self.decode_ema_s
+                burn = int(rate * horizon_s)
+                if need > avail - burn:
+                    self.forecast_denials += 1
+                    return False
         return need <= avail
 
     def _partial_debt_by_class(self) -> Dict[int, int]:

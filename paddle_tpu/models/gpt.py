@@ -341,7 +341,14 @@ def paged_page_splice(pools, page, k_blocks, v_blocks,
     compile per batch bucket serves every restore — and pure, so the
     engine donates the pools for an in-place scatter exactly like the
     decode step's appends (inference/continuous_batching.py
-    ``_splice_page``)."""
+    ``_splice_page``).
+
+    Blocks always arrive in the POOL's layout: the r23 blob codecs
+    (serving/prefix_cache.py ``pack_page_blob``/``unpack_page_blob``)
+    decode wire formats (raw/int8/int4, quantization/quant.py
+    ``KV_QMAX_*`` scale math) back to pool dtype on the host before
+    this splice runs, so spill format never leaks into the jitted
+    program — one compile serves every blob format."""
     from ..ops.nn_functional import paged_page_splice as _splice_one
 
     def put(pool_list, blocks):

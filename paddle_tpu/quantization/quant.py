@@ -316,6 +316,16 @@ class Int8Conv2D(Layer):
                                 tuple(args), {})
 
 
+# symmetric-quantization ranges shared by the KV compute path (the
+# in-VMEM kernel dequant, ops/pallas/paged_attention.py), the paged
+# pool append (models/gpt.py paged_kv_append) and the r23 spill/wire
+# blob codecs (serving/prefix_cache.py pack_page_blob): ONE definition
+# so "deq = q * s / qmax" means the same thing in every tier a page
+# visits — device, host blob, disk blob, wire
+KV_QMAX_INT8 = 127.0
+KV_QMAX_INT4 = 7.0
+
+
 def quantize_kv(x, eps: float = 1e-8):
     """Symmetric int8 quantization for KV-cache tokens: per-(token,
     head) abs-max over the head_dim axis — the finest granularity that
@@ -329,8 +339,9 @@ def quantize_kv(x, eps: float = 1e-8):
     raw = x.value if isinstance(x, Tensor) else jnp.asarray(x)
     s = jnp.maximum(jnp.max(jnp.abs(raw.astype(jnp.float32)), axis=-1),
                     eps)
-    q = jnp.clip(jnp.round(raw.astype(jnp.float32) / s[..., None] * 127.0),
-                 -127, 127).astype(jnp.int8)
+    q = jnp.clip(jnp.round(raw.astype(jnp.float32) / s[..., None]
+                           * KV_QMAX_INT8),
+                 -KV_QMAX_INT8, KV_QMAX_INT8).astype(jnp.int8)
     return q, s.astype(jnp.float32)
 
 
@@ -339,7 +350,78 @@ def dequantize_kv(q, scale, dtype=jnp.float32):
     raw = q.value if isinstance(q, Tensor) else jnp.asarray(q)
     s = scale.value if isinstance(scale, Tensor) else jnp.asarray(scale)
     return (raw.astype(jnp.float32) *
-            (s.astype(jnp.float32) / 127.0)[..., None]).astype(dtype)
+            (s.astype(jnp.float32) / KV_QMAX_INT8)[..., None]
+            ).astype(dtype)
+
+
+# --------------------------------------------------------------------------
+# Host-lane KV blob codecs (r23, serving/prefix_cache.py pack_page_blob).
+# Pure numpy: these run on the engine's HOST thread against page blocks
+# already copied off-device (spill, fetch_pages, drain handoff), so
+# they must not touch jax. The math is PINNED to the device-side
+# convention above — quantize_kv_np(x) is bit-equal to quantize_kv(x)
+# on float32 input (tests/test_kv_substrate.py), and decode is exactly
+# deq = q * s / qmax, the same formula the Ragged Paged Attention
+# kernel applies in-VMEM. int4 packs two values per byte along
+# head_dim (low nibble first, ceil(D/2) bytes per row).
+# --------------------------------------------------------------------------
+
+def quantize_kv_np(x: np.ndarray, eps: float = 1e-8):
+    """Numpy twin of :func:`quantize_kv`: per-(token, head) abs-max
+    over the last axis, ``q = clip(round(x / s * 127))`` int8, scales
+    float32. Returns ``(q [..., H, D], s [..., H])``."""
+    raw = np.asarray(x, np.float32)
+    s = np.maximum(np.max(np.abs(raw), axis=-1), eps).astype(np.float32)
+    q = np.clip(np.round(raw / s[..., None] * KV_QMAX_INT8),
+                -KV_QMAX_INT8, KV_QMAX_INT8).astype(np.int8)
+    return q, s
+
+
+def dequantize_kv_np(q: np.ndarray, scale: np.ndarray,
+                     dtype=np.float32) -> np.ndarray:
+    """Numpy twin of :func:`dequantize_kv`: deq = q * s / 127."""
+    return (np.asarray(q, np.float32) *
+            (np.asarray(scale, np.float32) / KV_QMAX_INT8)[..., None]
+            ).astype(dtype)
+
+
+def quantize_kv_int4_np(x: np.ndarray, eps: float = 1e-8):
+    """Symmetric int4 KV quantization (host lane): per-(token, head)
+    abs-max scales like int8, ``q = clip(round(x / s * 7), -7, 7)``,
+    two nibbles packed per byte along head_dim (low nibble = even
+    index; odd head_dim zero-pads the final high nibble). Returns
+    ``(packed uint8 [..., H, ceil(D/2)], s float32 [..., H])``."""
+    raw = np.asarray(x, np.float32)
+    s = np.maximum(np.max(np.abs(raw), axis=-1), eps).astype(np.float32)
+    q = np.clip(np.round(raw / s[..., None] * KV_QMAX_INT4),
+                -KV_QMAX_INT4, KV_QMAX_INT4).astype(np.int8)
+    d = q.shape[-1]
+    if d % 2:
+        q = np.concatenate(
+            [q, np.zeros(q.shape[:-1] + (1,), np.int8)], axis=-1)
+    # two's-complement nibbles: q & 0xF maps [-7, 7] into [0, 15]
+    lo = (q[..., 0::2].astype(np.uint8)) & 0x0F
+    hi = (q[..., 1::2].astype(np.uint8)) & 0x0F
+    return (lo | (hi << 4)).astype(np.uint8), s
+
+
+def dequantize_kv_int4_np(packed: np.ndarray, scale: np.ndarray,
+                          head_dim: int, dtype=np.float32) -> np.ndarray:
+    """Inverse of :func:`quantize_kv_int4_np`: unpack nibbles
+    (sign-extended), deq = q * s / 7, truncated back to ``head_dim``."""
+    p = np.asarray(packed, np.uint8)
+    lo = (p & 0x0F).astype(np.int8)
+    hi = ((p >> 4) & 0x0F).astype(np.int8)
+    # sign-extend 4-bit two's complement
+    lo = np.where(lo > 7, lo - 16, lo)
+    hi = np.where(hi > 7, hi - 16, hi)
+    q = np.empty(p.shape[:-1] + (p.shape[-1] * 2,), np.int8)
+    q[..., 0::2] = lo
+    q[..., 1::2] = hi
+    q = q[..., :head_dim]
+    return (q.astype(np.float32) *
+            (np.asarray(scale, np.float32) / KV_QMAX_INT4)[..., None]
+            ).astype(dtype)
 
 
 class WeightOnlyInt8Linear(Layer):

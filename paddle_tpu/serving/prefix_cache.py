@@ -46,6 +46,26 @@ tier, the last tier drops. Blobs carry a crc32 — a corrupt blob is a
 typed, counted fallback to chained prefill, never wrong tokens
 (``cache.spill`` fault site, distributed/fault_inject.py).
 
+KV byte substrate (r23): the blob is now a CODEC boundary, not just a
+container. ``pack_page_blob`` gains per-format encodings — ``raw``
+(byte-for-byte the r22 layout), ``int8`` and ``int4`` — used by the
+spill tiers, ``fetch_pages`` exports and the drain handoff, so host
+RAM, disk and the wire move 2–4× fewer bytes per page. Blobs stay
+self-describing (the meta header names the POOL layout and the
+format), so ``unpack_page_blob`` always decodes back to exactly the
+pool's layout and the splice path is format-oblivious. An engine
+already on int8 pages packs its int8 bytes losslessly (bit-identical
+round trip); a float engine opting into ``int8``/``int4`` gets the
+pinned ``deq = q * s / qmax`` decode (quantization/quant.py — the
+same convention the attention kernel applies in-VMEM) with the
+encode error accumulated in ``codec_stats``, never silent. Identical
+FULL pages arriving from unrelated requests dedup against the
+resident entry (``dedup=True``): the chained blake2b key plus a
+token-block equality check prove content, the private duplicate page
+returns to the free list, and the shared page moves to a
+``("dedup", key)`` owner so the allocator books say which pages are
+cross-request shared (``occupancy()``'s ``dedup`` class).
+
 Reference analog: no fluid-era equivalent (the inference engine caches
 whole programs, not KV); this is the serving-layer capability the
 paged pool was built to unlock.
@@ -53,6 +73,7 @@ paged pool was built to unlock.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import os
@@ -66,7 +87,8 @@ from typing import (Any, Callable, Dict, Hashable, List, Optional,
 import numpy as np
 
 __all__ = ["PrefixCache", "HostSpillTier", "DiskSpillTier",
-           "SpillCorrupt", "pack_page_blob", "unpack_page_blob"]
+           "SpillCorrupt", "pack_page_blob", "unpack_page_blob",
+           "blob_logical_bytes", "BLOB_FORMATS"]
 
 
 def _block_hash(parent: Optional[bytes], block: np.ndarray) -> bytes:
@@ -81,6 +103,13 @@ def _block_hash(parent: Optional[bytes], block: np.ndarray) -> bytes:
 
 _BLOB_MAGIC = b"PTKV"
 
+# blob codec formats (r23). "raw" writes the r22 byte layout
+# UNCHANGED (4-field meta — the escape hatch an `--blob-format raw`
+# deployment pins); "int8"/"int4" write a 5-field meta whose first
+# four fields still name the POOL layout, so decode always returns
+# exactly what the splice path expects regardless of format.
+BLOB_FORMATS = ("raw", "int8", "int4")
+
 
 class SpillCorrupt(RuntimeError):
     """A spill blob failed its crc32 / structure check. Callers treat
@@ -88,36 +117,123 @@ class SpillCorrupt(RuntimeError):
     page) — corrupt KV must never be spliced into the pool."""
 
 
-def pack_page_blob(layers: Sequence[Tuple[np.ndarray, np.ndarray,
-                                          Optional[np.ndarray],
-                                          Optional[np.ndarray]]]
-                   ) -> bytes:
-    """Serialize one evicted page's per-layer (k, v, k_scale, v_scale)
-    blocks into a self-describing blob: magic + layout header + crc32
-    over the payload + raw array bytes. Scales are None for fp pages.
-    The layout header makes restore independent of caller bookkeeping
-    (and lets the audit tests verify byte-equality tier-side)."""
-    first_k = np.ascontiguousarray(layers[0][0])
-    int8 = layers[0][2] is not None
-    head = {
-        "nl": len(layers),
-        "shape": first_k.shape,            # [page, H, D]
-        "dtype": str(first_k.dtype),
-        "scale_dtype": (str(np.ascontiguousarray(layers[0][2]).dtype)
-                        if int8 else ""),
-    }
-    payload = bytearray()
-    for k, v, ks, vs in layers:
-        payload += np.ascontiguousarray(k).tobytes()
-        payload += np.ascontiguousarray(v).tobytes()
-        if int8:
-            payload += np.ascontiguousarray(ks).tobytes()
-            payload += np.ascontiguousarray(vs).tobytes()
-    payload = bytes(payload)
-    meta = (f"{head['nl']};{','.join(map(str, head['shape']))};"
-            f"{head['dtype']};{head['scale_dtype']}").encode("ascii")
+def _frame_blob(meta: bytes, payload: bytes) -> bytes:
     return (_BLOB_MAGIC + struct.pack("<HI", len(meta), len(payload))
             + meta + struct.pack("<I", zlib.crc32(payload)) + payload)
+
+
+def pack_page_blob(layers: Sequence[Tuple[np.ndarray, np.ndarray,
+                                          Optional[np.ndarray],
+                                          Optional[np.ndarray]]],
+                   fmt: str = "raw",
+                   stats: Optional[Dict[str, Any]] = None) -> bytes:
+    """Serialize one page's per-layer (k, v, k_scale, v_scale) blocks
+    into a self-describing blob: magic + layout header + crc32 over
+    the payload + array bytes. Scales are None for fp pages. The
+    layout header makes restore independent of caller bookkeeping
+    (and lets the audit tests verify byte-equality tier-side).
+
+    ``fmt`` (r23): the transport encoding. ``raw`` is byte-for-byte
+    the r22 blob. ``int8`` stores int8 values + float32 per-(token,
+    head) scales — a LOSSLESS passthrough when the pool is already
+    int8-paged, the pinned ``quantize_kv`` math when it is float.
+    ``int4`` stores packed nibbles + float32 scales (quant.py
+    ``quantize_kv_int4_np``). Lossy encodes accumulate their error
+    into ``stats`` ({"lossy_pages", "max_abs_err"}) — a deployment
+    that trades exactness for bytes sees the delta, never silence."""
+    if fmt not in BLOB_FORMATS:
+        raise ValueError(f"blob format must be one of {BLOB_FORMATS}; "
+                         f"got {fmt!r}")
+    first_k = np.ascontiguousarray(layers[0][0])
+    int8_pool = layers[0][2] is not None
+    nl = len(layers)
+    shape = first_k.shape                  # [page, H, D]
+    dtype = str(first_k.dtype)
+    scale_dtype = (str(np.ascontiguousarray(layers[0][2]).dtype)
+                   if int8_pool else "")
+    if fmt == "int8" and int8_pool:
+        fmt = "raw"  # int8 pages ARE the int8 encoding: pure passthrough
+    if fmt == "raw":
+        payload = bytearray()
+        for k, v, ks, vs in layers:
+            payload += np.ascontiguousarray(k).tobytes()
+            payload += np.ascontiguousarray(v).tobytes()
+            if int8_pool:
+                payload += np.ascontiguousarray(ks).tobytes()
+                payload += np.ascontiguousarray(vs).tobytes()
+        meta = (f"{nl};{','.join(map(str, shape))};"
+                f"{dtype};{scale_dtype}").encode("ascii")
+        return _frame_blob(meta, bytes(payload))
+    from ..quantization.quant import (dequantize_kv_np, quantize_kv_np,
+                                      quantize_kv_int4_np,
+                                      dequantize_kv_int4_np)
+    quant = quantize_kv_np if fmt == "int8" else quantize_kv_int4_np
+    max_err = 0.0
+    payload = bytearray()
+    for k, v, ks, vs in layers:
+        for block, sc in ((k, ks), (v, vs)):
+            x = np.asarray(block, np.float32) if not int8_pool else \
+                dequantize_kv_np(block, sc)
+            q, s = quant(x)
+            if fmt == "int8":
+                deq = dequantize_kv_np(q, s)
+            else:
+                deq = dequantize_kv_int4_np(q, s, x.shape[-1])
+            max_err = max(max_err, float(np.max(np.abs(x - deq)))
+                          if x.size else 0.0)
+            payload += np.ascontiguousarray(q).tobytes()
+            payload += np.ascontiguousarray(s).tobytes()
+    if stats is not None:
+        stats["lossy_pages"] = stats.get("lossy_pages", 0) + 1
+        stats["max_abs_err"] = max(stats.get("max_abs_err", 0.0),
+                                   max_err)
+    meta = (f"{nl};{','.join(map(str, shape))};"
+            f"{dtype};{scale_dtype};{fmt}").encode("ascii")
+    return _frame_blob(meta, bytes(payload))
+
+
+def _parse_blob_header(blob: bytes):
+    """(meta fields, payload) of a framed blob — crc-checked. Shared
+    by :func:`unpack_page_blob` and :func:`blob_logical_bytes`."""
+    if blob[:4] != _BLOB_MAGIC:
+        raise SpillCorrupt("bad spill-blob magic")
+    meta_len, payload_len = struct.unpack("<HI", blob[4:10])
+    meta = blob[10:10 + meta_len].decode("ascii")
+    off = 10 + meta_len
+    crc, = struct.unpack("<I", blob[off:off + 4])
+    payload = blob[off + 4:]
+    if len(payload) != payload_len:
+        raise SpillCorrupt("truncated spill blob")
+    if zlib.crc32(payload) != crc:
+        raise SpillCorrupt("spill blob crc32 mismatch")
+    fields = meta.split(";")
+    if len(fields) == 4:
+        fields.append("raw")  # r22 blobs: no format field
+    if len(fields) != 5 or fields[4] not in BLOB_FORMATS:
+        raise SpillCorrupt(f"bad spill-blob meta {meta!r}")
+    return fields, payload
+
+
+def blob_logical_bytes(blob: bytes) -> int:
+    """RAW-EQUIVALENT bytes of one blob — the pool-layout bytes its
+    page decodes to, independent of transport encoding. The honest
+    numerator for spill-tier capacity/hit-rate math after r23: a tier
+    holding int4 blobs restores 4× the KV bytes its physical
+    occupancy suggests. Falls back to the physical size on a blob it
+    cannot parse (the caller is accounting, not restoring — corrupt
+    blobs are caught typed at restore/import time)."""
+    try:
+        (nl_s, shape_s, dtype_s, scale_dtype_s, _fmt), _payload = \
+            _parse_blob_header(blob)
+        nl = int(nl_s)
+        shape = tuple(int(x) for x in shape_s.split(","))
+        out = nl * 2 * int(np.prod(shape)) * np.dtype(dtype_s).itemsize
+        if scale_dtype_s:
+            out += nl * 2 * int(np.prod(shape[:2])) * \
+                np.dtype(scale_dtype_s).itemsize
+        return out
+    except Exception:
+        return len(blob)
 
 
 def unpack_page_blob(blob: bytes
@@ -126,47 +242,89 @@ def unpack_page_blob(blob: bytes
                                      Optional[np.ndarray]]]:
     """Inverse of :func:`pack_page_blob`; raises :class:`SpillCorrupt`
     on any structural or crc32 mismatch (a torn write, a flipped bit,
-    a truncated file — all the same typed fallback)."""
+    a truncated file — all the same typed fallback). Decodes EVERY
+    format back to the pool layout the meta header names: the splice
+    path never sees what encoding a blob traveled in. Pinned decode
+    math per format (tests/test_kv_substrate.py): raw is a memcpy;
+    int8→float is ``q * s / 127``; int4 is nibble-unpack then
+    ``q * s / 7``; a coded blob whose pool is int8-paged re-quantizes
+    the decoded floats through ``quantize_kv_np`` (the declared,
+    deterministic round trip)."""
     try:
-        if blob[:4] != _BLOB_MAGIC:
-            raise SpillCorrupt("bad spill-blob magic")
-        meta_len, payload_len = struct.unpack("<HI", blob[4:10])
-        meta = blob[10:10 + meta_len].decode("ascii")
-        off = 10 + meta_len
-        crc, = struct.unpack("<I", blob[off:off + 4])
-        payload = blob[off + 4:]
-        if len(payload) != payload_len:
-            raise SpillCorrupt("truncated spill blob")
-        if zlib.crc32(payload) != crc:
-            raise SpillCorrupt("spill blob crc32 mismatch")
-        nl_s, shape_s, dtype_s, scale_dtype_s = meta.split(";")
+        (nl_s, shape_s, dtype_s, scale_dtype_s, fmt), payload = \
+            _parse_blob_header(blob)
         nl = int(nl_s)
         shape = tuple(int(x) for x in shape_s.split(","))
         dt = np.dtype(dtype_s)
-        int8 = bool(scale_dtype_s)
-        sdt = np.dtype(scale_dtype_s) if int8 else None
-        kv_bytes = int(np.prod(shape)) * dt.itemsize
-        sc_bytes = int(np.prod(shape[:2])) * sdt.itemsize if int8 else 0
-        out = []
+        int8_pool = bool(scale_dtype_s)
+        sdt = np.dtype(scale_dtype_s) if int8_pool else None
+        out: List[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray],
+                        Optional[np.ndarray]]] = []
         pos = 0
+        if fmt == "raw":
+            kv_bytes = int(np.prod(shape)) * dt.itemsize
+            sc_bytes = (int(np.prod(shape[:2])) * sdt.itemsize
+                        if int8_pool else 0)
+            for _ in range(nl):
+                k = np.frombuffer(payload, dt,
+                                  count=int(np.prod(shape)),
+                                  offset=pos).reshape(shape)
+                pos += kv_bytes
+                v = np.frombuffer(payload, dt,
+                                  count=int(np.prod(shape)),
+                                  offset=pos).reshape(shape)
+                pos += kv_bytes
+                ks = vs = None
+                if int8_pool:
+                    n_sc = int(np.prod(shape[:2]))
+                    ks = np.frombuffer(payload, sdt, count=n_sc,
+                                       offset=pos).reshape(shape[:2])
+                    pos += sc_bytes
+                    vs = np.frombuffer(payload, sdt, count=n_sc,
+                                       offset=pos).reshape(shape[:2])
+                    pos += sc_bytes
+                out.append((k, v, ks, vs))
+            if pos != len(payload):
+                raise SpillCorrupt("spill blob payload size mismatch")
+            return out
+        from ..quantization.quant import (dequantize_kv_np,
+                                          dequantize_kv_int4_np,
+                                          quantize_kv_np)
+        page, heads, head_dim = shape
+        if fmt == "int8":
+            q_shape, q_dt = shape, np.dtype(np.int8)
+        else:
+            q_shape = (page, heads, (head_dim + 1) // 2)
+            q_dt = np.dtype(np.uint8)
+        s_shape, s_dt = (page, heads), np.dtype(np.float32)
+        q_bytes = int(np.prod(q_shape)) * q_dt.itemsize
+        s_bytes = int(np.prod(s_shape)) * s_dt.itemsize
         for _ in range(nl):
-            k = np.frombuffer(payload, dt, count=int(np.prod(shape)),
-                              offset=pos).reshape(shape)
-            pos += kv_bytes
-            v = np.frombuffer(payload, dt, count=int(np.prod(shape)),
-                              offset=pos).reshape(shape)
-            pos += kv_bytes
-            ks = vs = None
-            if int8:
-                n_sc = int(np.prod(shape[:2]))
-                ks = np.frombuffer(payload, sdt, count=n_sc,
-                                   offset=pos).reshape(shape[:2])
-                pos += sc_bytes
-                vs = np.frombuffer(payload, sdt, count=n_sc,
-                                   offset=pos).reshape(shape[:2])
-                pos += sc_bytes
+            decoded = []
+            for _which in ("k", "v"):
+                q = np.frombuffer(payload, q_dt,
+                                  count=int(np.prod(q_shape)),
+                                  offset=pos).reshape(q_shape)
+                pos += q_bytes
+                s = np.frombuffer(payload, s_dt,
+                                  count=int(np.prod(s_shape)),
+                                  offset=pos).reshape(s_shape)
+                pos += s_bytes
+                if fmt == "int8":
+                    x = dequantize_kv_np(q, s)
+                else:
+                    x = dequantize_kv_int4_np(q, s, head_dim)
+                if int8_pool:
+                    # back to the int8 pool layout through the SAME
+                    # quantizer the append path uses — deterministic,
+                    # so the pinned decode math is testable end to end
+                    qq, ss = quantize_kv_np(x)
+                    decoded.append((qq, ss.astype(sdt)))
+                else:
+                    decoded.append((x.astype(dt), None))
+            (k, ks), (v, vs) = decoded
             out.append((k, v, ks, vs))
-        if pos != payload_len:
+        if pos != len(payload):
             raise SpillCorrupt("spill blob payload size mismatch")
         return out
     except SpillCorrupt:
@@ -193,6 +351,11 @@ class _SpillTier:
         self.next_tier = next_tier
         self._index: "OrderedDict[bytes, int]" = OrderedDict()
         self.occupancy_bytes = 0
+        # raw-equivalent bytes per blob (r23): with coded blobs the
+        # physical occupancy undersells what the tier can restore —
+        # capacity/hit-rate math wants the logical figure
+        self._logical: Dict[bytes, int] = {}
+        self.logical_bytes = 0
         self.hits = 0
         self.misses = 0
         self.stored_blobs = 0       # lifetime puts accepted
@@ -224,6 +387,7 @@ class _SpillTier:
         # pure drops, not reads
         key, size = self._index.popitem(last=False)
         self.occupancy_bytes -= size
+        self.logical_bytes -= self._logical.pop(key, size)
         if self.next_tier is None:
             self._delete(key)
             self.dropped_blobs += 1
@@ -258,6 +422,9 @@ class _SpillTier:
         self._store(key, blob)
         self._index[key] = len(blob)
         self.occupancy_bytes += len(blob)
+        logical = blob_logical_bytes(blob)
+        self._logical[key] = logical
+        self.logical_bytes += logical
         self.stored_blobs += 1
         return True
 
@@ -271,7 +438,9 @@ class _SpillTier:
             # a vanished/unreadable backing file is a miss, not a
             # crash: drop the index entry and let the chained-prefill
             # fallback recompute
-            self.occupancy_bytes -= self._index.pop(key)
+            size = self._index.pop(key)
+            self.occupancy_bytes -= size
+            self.logical_bytes -= self._logical.pop(key, size)
             self.misses += 1
             return None
         self.hits += 1
@@ -282,6 +451,7 @@ class _SpillTier:
         size = self._index.pop(key, None)
         if size is not None:
             self.occupancy_bytes -= size
+            self.logical_bytes -= self._logical.pop(key, size)
             self._delete(key)
 
     def clear(self) -> None:
@@ -300,6 +470,12 @@ class _SpillTier:
             raise RuntimeError(
                 f"{self.name} tier occupancy {self.occupancy_bytes} != "
                 f"indexed bytes {total}")
+        logical = sum(self._logical.get(k, s)
+                      for k, s in self._index.items())
+        if logical != self.logical_bytes:
+            raise RuntimeError(
+                f"{self.name} tier logical bytes {self.logical_bytes} "
+                f"!= indexed logical {logical}")
         for key, size in self._index.items():
             blob = self._load(key)
             if len(blob) != size:
@@ -310,6 +486,7 @@ class _SpillTier:
     def stats(self) -> Dict[str, Any]:
         return {"blobs": self.blob_count,
                 "occupancy_bytes": self.occupancy_bytes,
+                "logical_bytes": self.logical_bytes,
                 "capacity_bytes": self.capacity_bytes,
                 "hits": self.hits, "misses": self.misses,
                 "stored_blobs": self.stored_blobs,
@@ -405,6 +582,10 @@ class _Entry:
                                   # insert (the parent chain never
                                   # changes), keeps the per-probe
                                   # advertisement recency pass O(N)
+    dedup: bool = False           # r23: a second request proved this
+                                  # page's content and folded onto it —
+                                  # allocator owner is ("dedup", key),
+                                  # not ("prefix", key)
 
 
 class PrefixCache:
@@ -419,12 +600,33 @@ class PrefixCache:
     demotes into the disk tier. Tiers need device IO — the engine
     attaches its page reader/splicer via :meth:`attach_device_io` —
     and stay inert without it (a bare cache behaves exactly as
-    pre-r15)."""
+    pre-r15).
+
+    KV byte substrate (r23): ``blob_format`` picks the transport codec
+    every spill/export path packs with (``raw``/``int8``/``int4``;
+    decode is format-agnostic — unpack reads the blob's own header).
+    ``dedup`` folds content-identical FULL pages across unrelated
+    requests onto one physical page (the chained blake2b keys prove
+    content); ``blob_format="raw"`` plus ``dedup=False`` restores the
+    r22 byte layout exactly."""
 
     def __init__(self, page_size: int, max_pages: Optional[int] = None,
                  spill_bytes: Optional[int] = None,
                  spill_dir: Optional[str] = None,
-                 disk_bytes: Optional[int] = None):
+                 disk_bytes: Optional[int] = None,
+                 blob_format: str = "raw",
+                 dedup: bool = True):
+        if blob_format not in BLOB_FORMATS:
+            raise ValueError(
+                f"blob_format must be one of {BLOB_FORMATS}; "
+                f"got {blob_format!r}")
+        self.blob_format = blob_format
+        self.dedup = bool(dedup)
+        self.dedup_hits = 0          # pages folded onto an existing one
+        # lossy-codec accounting (pack_page_blob stats sink): nonzero
+        # max_abs_err is REPORTED through tier_stats/_cache_stats —
+        # a lossy deployment sees its error, never silence
+        self.codec_stats: Dict[str, Any] = {}
         self.page_size = int(page_size)
         # optional soft cap on cached pages; None = bounded only by
         # pool pressure (evict_until)
@@ -596,7 +798,9 @@ class PrefixCache:
             self.spill_failed += 1
             return
         try:
-            blob = pack_page_blob(self._read_page(ent.page))
+            blob = pack_page_blob(self._read_page(ent.page),
+                                  fmt=self.blob_format,
+                                  stats=self.codec_stats)
         except Exception:
             self.spill_failed += 1
             return
@@ -843,7 +1047,10 @@ class PrefixCache:
                 ent = self._entries.get(key)
                 if ent is not None and self._read_page is not None:
                     try:
-                        blob = pack_page_blob(self._read_page(ent.page))
+                        blob = pack_page_blob(
+                            self._read_page(ent.page),
+                            fmt=self.blob_format,
+                            stats=self.codec_stats)
                     except Exception:
                         blob = None
             if blob is None:
@@ -939,13 +1146,39 @@ class PrefixCache:
             key, parent, block = chain[i]
             ent = self._entries.get(key)
             if ent is not None and np.array_equal(ent.tokens, block):
-                # already cached (defensive: cannot happen on the
-                # single-threaded admission path, where match() ran
-                # moments ago) — take a reference, keep our private
-                # copy with the request (freed when it finishes)
+                # already cached: a sibling request with the same
+                # prefix prefilled concurrently (its insert landed
+                # between our match() and now) — take a reference.
                 ent.refcount += 1
                 ent.last_used = self._tick
                 keys.append(key)
+                if self.dedup:
+                    # r23 cross-request dedup: the chained key plus
+                    # the token-equality check above prove our private
+                    # page holds byte-identical KV (a FULL page is an
+                    # immutable function of the chain) — retarget the
+                    # table row at the shared page and return the
+                    # duplicate to the free list. The shared page
+                    # moves to a ("dedup", key) owner so occupancy()
+                    # reports cross-request shared pages as a class.
+                    page = int(row[i])
+                    owned = allocator.owners().get(owner, ())
+                    if page != ent.page and page in owned:
+                        led = getattr(allocator, "ledger", None)
+                        ctx = (led.why("dedup_hit",
+                                       owner if isinstance(owner, int)
+                                       else None)
+                               if led is not None
+                               else contextlib.nullcontext())
+                        with ctx:
+                            row[i] = ent.page  # row aliases _table[slot]
+                            allocator.release_pages(owner, [page])
+                            if not ent.dedup:
+                                allocator.transfer(
+                                    ("prefix", ent.key),
+                                    ("dedup", ent.key), [ent.page])
+                                ent.dedup = True
+                        self.dedup_hits += 1
                 continue
             if ent is not None:
                 break  # hash collision with different tokens: stop
@@ -995,6 +1228,13 @@ class PrefixCache:
                     k = self._entries[k].parent
         return len(self._entries) - len(pinned)
 
+    @staticmethod
+    def _owner_of(ent: _Entry) -> Tuple[str, bytes]:
+        """The allocator owner this entry's page sits under: dedup'd
+        pages moved to ("dedup", key) when a second request folded
+        onto them (r23); everything else stays ("prefix", key)."""
+        return ("dedup" if ent.dedup else "prefix", ent.key)
+
     def _evict_one(self, allocator) -> bool:
         cands = self._evictable()
         if not cands:
@@ -1003,7 +1243,7 @@ class PrefixCache:
         # r15: eviction spills before it frees — the page's content
         # survives as a host/disk blob a later match can restore
         self._spill_entry(victim)
-        allocator.free(("prefix", victim.key))
+        allocator.free(self._owner_of(victim))
         if victim.parent is not None:
             self._entries[victim.parent].children -= 1
         del self._entries[victim.key]
@@ -1031,7 +1271,7 @@ class PrefixCache:
                 f"{[e.refcount for e in busy[:8]]}) — release requests "
                 f"before close()")
         for ent in self._entries.values():
-            allocator.free(("prefix", ent.key))
+            allocator.free(self._owner_of(ent))
         self.evicted_pages += len(self._entries)
         self._entries.clear()
         # spill blobs die with the cache: every exit path must leave
@@ -1062,7 +1302,11 @@ class PrefixCache:
         out: Dict[str, Dict[str, Any]] = {
             "device": {"pages": len(self._entries),
                        "hit_pages": self.hit_pages,
-                       "miss_pages": self.miss_pages}}
+                       "miss_pages": self.miss_pages,
+                       "dedup_pages": sum(
+                           1 for e in self._entries.values()
+                           if e.dedup),
+                       "dedup_hits": self.dedup_hits}}
         for t in self.tiers:
             s = t.stats()
             s["hit_pages"] = self.tier_hit_pages.get(t.name, 0)
@@ -1126,7 +1370,7 @@ class PrefixCache:
         cache_owned = 0
         for owner, pages in owners.items():
             if not (isinstance(owner, tuple) and len(owner) == 2
-                    and owner[0] == "prefix"):
+                    and owner[0] in ("prefix", "dedup")):
                 raise RuntimeError(
                     f"page leak past drain: owner {owner!r} still holds "
                     f"{list(pages)}")
@@ -1136,6 +1380,10 @@ class PrefixCache:
                     f"prefix-cache books diverge from allocator for "
                     f"owner {owner!r}: allocator={list(pages)}, "
                     f"entry={ent}")
+            if (owner[0] == "dedup") != ent.dedup:
+                raise RuntimeError(
+                    f"dedup books diverge for {owner!r}: allocator "
+                    f"class {owner[0]!r} but entry.dedup={ent.dedup}")
             cache_owned += len(pages)
         if allocator.free_count + cache_owned != allocator.num_pages:
             raise RuntimeError(

@@ -196,38 +196,56 @@ def fetch_page_blobs(host: str, port: int, keys=None, heads=None,
     bytes_total)``; raises :class:`PageFetchFailed` on any transport
     or protocol failure. Blob integrity is NOT checked here — the
     importer re-verifies every crc32 before a blob can ever reach a
-    splice (serving/prefix_cache.py ``import_blobs``)."""
+    splice (serving/prefix_cache.py ``import_blobs``).
+
+    Chains longer than the peer's FETCH_PAGES_CAP page through the
+    reply's ``next_cursor`` (r23): this client keeps pulling bounded
+    windows until the peer stops returning one, so a long chain hands
+    off WHOLE. Each window is its own timeout-bounded RPC."""
     import base64
 
     def hexes(ks):
         return [k.hex() if isinstance(k, bytes) else str(k)
                 for k in ks]
 
-    payload: Dict[str, Any] = {"op": "fetch_pages"}
+    base: Dict[str, Any] = {"op": "fetch_pages"}
     if keys:
-        payload["keys"] = hexes(keys)
+        base["keys"] = hexes(keys)
     if heads:
-        payload["heads"] = hexes(heads)
-    try:
-        reply = client_request(host, int(port), payload,
-                               timeout_s=timeout_s)
-    except Exception as e:
-        raise PageFetchFailed(f"{type(e).__name__}: {e}")
-    if not isinstance(reply, dict) or reply.get("error"):
-        raise PageFetchFailed(
-            f"{reply.get('error')}: {reply.get('reason')}"
-            if isinstance(reply, dict) else "non-object reply")
+        base["heads"] = hexes(heads)
     blobs: Dict[bytes, bytes] = {}
+    missing: List[str] = []
     total = 0
-    try:
-        for khex, b64 in (reply.get("blobs") or {}).items():
-            blob = base64.b64decode(b64)
-            blobs[bytes.fromhex(khex)] = blob
-            total += len(blob)
-    except Exception as e:
-        raise PageFetchFailed(f"malformed blob payload: "
-                              f"{type(e).__name__}: {e}")
-    return blobs, list(reply.get("missing") or ()), total
+    cursor = 0
+    # hard bound on pagination rounds: a buggy/malicious peer echoing
+    # a never-advancing cursor must not spin this thread forever
+    for _round in range(256):
+        payload = dict(base)
+        if cursor:
+            payload["cursor"] = cursor
+        try:
+            reply = client_request(host, int(port), payload,
+                                   timeout_s=timeout_s)
+        except Exception as e:
+            raise PageFetchFailed(f"{type(e).__name__}: {e}")
+        if not isinstance(reply, dict) or reply.get("error"):
+            raise PageFetchFailed(
+                f"{reply.get('error')}: {reply.get('reason')}"
+                if isinstance(reply, dict) else "non-object reply")
+        try:
+            for khex, b64 in (reply.get("blobs") or {}).items():
+                blob = base64.b64decode(b64)
+                blobs[bytes.fromhex(khex)] = blob
+                total += len(blob)
+        except Exception as e:
+            raise PageFetchFailed(f"malformed blob payload: "
+                                  f"{type(e).__name__}: {e}")
+        missing.extend(reply.get("missing") or ())
+        nxt = reply.get("next_cursor")
+        if not isinstance(nxt, int) or nxt <= cursor:
+            break
+        cursor = nxt
+    return blobs, missing, total
 
 
 class _Pending:
@@ -271,6 +289,8 @@ class ServingServer:
                  flight_budget_bytes: int = 64 << 20,
                  role: str = "mixed",
                  handoff_timeout_s: float = 30.0,
+                 blob_format: str = "raw",
+                 dedup: bool = True,
                  **engine_kwargs):
         from ..distributed.resilience import get_retry_policy
 
@@ -345,6 +365,11 @@ class ServingServer:
         self._spill_bytes = spill_bytes
         self._spill_dir = spill_dir
         self._spill_disk_bytes = spill_disk_bytes
+        # KV byte substrate (r23): the blob transport codec and the
+        # cross-request dedup switch are resurrection-recipe state
+        # like the tiers — a rebuilt cache packs/folds identically
+        self._blob_format = str(blob_format)
+        self._dedup = bool(dedup)
         self._page_size = int(engine_kwargs.get("page_size", 64))
         if prefill_retry == "site":
             prefill_retry = get_retry_policy("serving.prefill")
@@ -422,7 +447,9 @@ class ServingServer:
             PrefixCache(self._page_size,
                         spill_bytes=self._spill_bytes,
                         spill_dir=self._spill_dir,
-                        disk_bytes=self._spill_disk_bytes)
+                        disk_bytes=self._spill_disk_bytes,
+                        blob_format=self._blob_format,
+                        dedup=self._dedup)
             if self._use_prefix_cache else None)
         return create_decode_engine(
             self._model, scheduler=self.scheduler,
@@ -1178,9 +1205,16 @@ class ServingServer:
                       "reason": "fetch_pages needs 'keys' and/or "
                                 "'heads' as lists of hex chain keys"})
                 return
+            try:
+                cursor = max(0, int(msg.get("cursor") or 0))
+            except (TypeError, ValueError):
+                send({"error": "BadRequest",
+                      "reason": "fetch_pages cursor must be an int"})
+                return
             pending = _Pending(stream=False)
             self._inbox.put(({"ctl": "fetch_pages", "keys": keys,
-                              "heads": heads}, pending))
+                              "heads": heads, "cursor": cursor},
+                             pending))
             self._wake.set()
             self._await_outbox(pending, send)
             return
@@ -1597,6 +1631,10 @@ class ServingServer:
         if pc is not None and getattr(pc, "tiers", None):
             for t in pc.tiers:
                 g[f"spill_{t.name}_bytes"] = t.occupancy_bytes
+                # r23: raw-equivalent bytes of the stored blobs — with
+                # a coded blob_format the physical figure undersells
+                # restorable KV, so capacity/hit-rate math reads this
+                g[f"spill_{t.name}_logical_bytes"] = t.logical_bytes
                 g[f"spill_{t.name}_blobs"] = t.blob_count
                 g[f"spill_{t.name}_capacity_bytes"] = t.capacity_bytes
         # fused decode (r13): ops traced into the decode-step program
@@ -1701,7 +1739,15 @@ class ServingServer:
         read tier blobs, and base64 them for the reply. A key this
         replica cannot produce is listed in ``missing`` — the peer's
         chained-prefill fallback covers it, so this op never errors
-        on absence."""
+        on absence.
+
+        Cursor pagination (r23): each reply serves at most
+        FETCH_PAGES_CAP keys starting at ``payload["cursor"]`` (an
+        offset into the deterministic expanded key list) and carries
+        ``next_cursor`` while more remain — so a chain longer than
+        one page's cap hands off WHOLE across several bounded RPCs
+        instead of silently degrading its tail to missing. The
+        legacy ``truncated`` flag stays for pre-r23 clients."""
         import base64
         pc = self.prefix_cache
         if pc is None:
@@ -1713,15 +1759,21 @@ class ServingServer:
             seen = set(keys)
             keys += [k for k in pc.expand_heads(heads)
                      if k not in seen]
+        cursor = max(0, int(payload.get("cursor") or 0))
+        window = keys[cursor:cursor + self.FETCH_PAGES_CAP]
+        remaining = len(keys) - (cursor + len(window))
         truncated = len(keys) > self.FETCH_PAGES_CAP
-        blobs, missing = pc.export_blobs(keys[:self.FETCH_PAGES_CAP])
-        return {"blobs": {k.hex(): base64.b64encode(b).decode("ascii")
-                          for k, b in blobs.items()},
-                "missing": [k.hex() for k in missing],
-                "count": len(blobs),
-                "bytes": sum(len(b) for b in blobs.values()),
-                "truncated": truncated,
-                "role": self.role}
+        blobs, missing = pc.export_blobs(window)
+        reply = {"blobs": {k.hex(): base64.b64encode(b).decode("ascii")
+                           for k, b in blobs.items()},
+                 "missing": [k.hex() for k in missing],
+                 "count": len(blobs),
+                 "bytes": sum(len(b) for b in blobs.values()),
+                 "truncated": truncated,
+                 "role": self.role}
+        if remaining > 0:
+            reply["next_cursor"] = cursor + len(window)
+        return reply
 
     def _import_blobs(self, payload: Dict) -> Dict:
         """Engine-thread half of the ``prefetch`` op (r20 drain
@@ -1865,7 +1917,15 @@ class ServingServer:
                 # accepted from peer replicas over fetch_pages
                 "exported_pages": getattr(pc, "exported_pages", 0),
                 "imported_pages": getattr(pc, "imported_pages", 0),
-                "import_corrupt": getattr(pc, "import_corrupt", 0)}
+                "import_corrupt": getattr(pc, "import_corrupt", 0),
+                # KV byte substrate (r23): transport codec + dedup
+                # accounting. codec_stats is non-empty only on a lossy
+                # blob_format — max_abs_err is the REPORTED accuracy
+                # delta, never silent
+                "blob_format": getattr(pc, "blob_format", "raw"),
+                "dedup": getattr(pc, "dedup", False),
+                "dedup_hits": getattr(pc, "dedup_hits", 0),
+                "codec_stats": dict(getattr(pc, "codec_stats", {}))}
 
 
 def _json_stats(stats) -> Dict:
@@ -2059,6 +2119,32 @@ def main(argv=None) -> None:
              "forensics, ledger reconciliation, capacity-op event "
              "tail). On by default at ~1.0x ms/step; greedy outputs "
              "are bit-identical either way")
+    parser.add_argument(
+        "--blob-format", default="raw", choices=["raw", "int8", "int4"],
+        help="KV byte substrate (r23): transport codec for spill/"
+             "handoff/prefetch page blobs. 'raw' (default) is the r22 "
+             "byte layout. 'int8' moves ~2x fewer bytes — LOSSLESS "
+             "(bit-identical greedy) when the engine already runs "
+             "int8 KV pages, the pinned quantize_kv round trip when "
+             "it runs float pages. 'int4' moves ~4x fewer bytes and "
+             "is always lossy (pinned nibble decode). Lossy formats "
+             "report their max_abs_err in cache_stats codec_stats — "
+             "the accuracy delta is never silent")
+    parser.add_argument(
+        "--no-dedup", action="store_true",
+        help="disable cross-request page dedup (r23: content-identical "
+             "FULL pages from unrelated requests fold onto one "
+             "physical page, proven by the chained blake2b keys; "
+             "greedy outputs are bit-identical on/off). "
+             "--blob-format raw plus --no-dedup restores the r22 "
+             "byte layout exactly")
+    parser.add_argument(
+        "--forecast-admission", action="store_true",
+        help="byte-planning admission (r23): _fits also charges the "
+             "fleet's forecast page burn (r18 EWMA exhaustion "
+             "forecast) over the request's expected lifetime, so a "
+             "request lands only when the pool's FUTURE accommodates "
+             "it (default: instant-occupancy gate only)")
     args = parser.parse_args(argv)
 
     model = _build_model(args.model)
@@ -2090,6 +2176,10 @@ def main(argv=None) -> None:
         engine_kwargs["multi_step"] = args.multi_step
     if args.no_page_ledger:
         engine_kwargs["page_ledger"] = False
+    if args.forecast_admission:
+        # rides in engine_kwargs, so a resurrected engine keeps the
+        # byte-planning admission gate
+        engine_kwargs["forecast_admission"] = True
     mesh_desc = "single-device"
     if args.mesh is not None:
         from ..distributed.topology import (make_serving_mesh,
@@ -2106,6 +2196,8 @@ def main(argv=None) -> None:
                            prefix_cache=not args.no_prefix_cache,
                            role=args.role,
                            handoff_timeout_s=args.handoff_timeout_s,
+                           blob_format=args.blob_format,
+                           dedup=not args.no_dedup,
                            num_slots=args.num_slots,
                            page_size=args.page_size,
                            max_engine_errors=args.max_engine_errors,

@@ -824,7 +824,9 @@ class FailoverRouter:
                  affinity: bool = True,
                  trace_sample: float = 0.0, tracer=None,
                  deprioritize_outliers: bool = False,
-                 disaggregate: bool = True):
+                 disaggregate: bool = True,
+                 fleet_cache: bool = True,
+                 forecast_placement: bool = False):
         self.sup = supervisor
         # back-reference (r21): the autoscaler's shape planner reads
         # handoff_prefill_failures_total off the router; duck-typed —
@@ -858,6 +860,20 @@ class FailoverRouter:
         # they are all that's live, keyed/affinity routing is
         # untouched, and failover exclusion always filters first.
         self.deprioritize_outliers = bool(deprioritize_outliers)
+        # fleet cache (r23), default ON and inert without advertised
+        # keys: when the picked replica does NOT advertise a keyed
+        # request's chain but some OTHER live replica does, attach a
+        # fetch_from hint naming that peer — any replica's tiers are
+        # the fleet's cache, not just the designated prefill owner's.
+        # A dead/evicted peer degrades exactly like the r20 handoff:
+        # typed PageFetchFailed, counted, local prefill, same tokens.
+        self.fleet_cache = bool(fleet_cache)
+        # byte-planning placement (r23), default OFF: prefer replicas
+        # whose capacity forecast (r18 exhaustion EWMA, scraped by the
+        # supervisor's capacity probe) is NOT about to exhaust. A
+        # PREFERENCE like deprioritize_outliers — never filters to
+        # empty, failover exclusion still applies first.
+        self.forecast_placement = bool(forecast_placement)
         # end-to-end tracing (r16): the router is the FIRST hop, so
         # its sampler decides for the whole request — a sampled
         # request's forward carries a trace context that forces the
@@ -890,6 +906,11 @@ class FailoverRouter:
         # failed and fell back to plain dispatch (local prefill)
         self.handoffs_total = 0
         self.handoff_prefill_failures_total = 0
+        # fleet-cache accounting (r23): picks where the hint named a
+        # non-owner peer advertising the chain (the any-replica lane)
+        self.fleet_cache_hints_total = 0
+        # byte-planning placement accounting (r23)
+        self.forecast_steers_total = 0
         # optional routing-event hook: trace({"t": ..., "ev": ...,
         # ...}) — the chaos harness uses it for postmortems
         self.trace = None
@@ -990,6 +1011,9 @@ class FailoverRouter:
                   "handoffs_total": self.handoffs_total,
                   "handoff_prefill_failures_total":
                       self.handoff_prefill_failures_total,
+                  "fleet_cache_hints_total":
+                      self.fleet_cache_hints_total,
+                  "forecast_steers_total": self.forecast_steers_total,
                   "replicas": [{"idx": r.idx, "port": r.port,
                                 "ready": r.ready, "alive": r.alive(),
                                 "restarts": r.restarts,
@@ -1034,6 +1058,8 @@ class FailoverRouter:
                 "handoffs_total": self.handoffs_total,
                 "handoff_prefill_failures_total":
                     self.handoff_prefill_failures_total,
+                "fleet_cache_hints_total": self.fleet_cache_hints_total,
+                "forecast_steers_total": self.forecast_steers_total,
             }
             send({"fleet": stats})
             return
@@ -1144,6 +1170,56 @@ class FailoverRouter:
         except (TypeError, ValueError, OverflowError):
             return None  # malformed prompt: backend answers BadRequest
 
+    # forecast pressure floor (r23): a replica whose fresh capacity
+    # forecast projects pool exhaustion within this many seconds is
+    # deprioritized by forecast_placement picks
+    FORECAST_TTE_FLOOR_S = 5.0
+
+    def _forecast_pressed(self, rep: Replica) -> bool:
+        """True when ``rep``'s capacity snapshot is FRESH (the r18
+        collector freshness rule) and its exhaustion forecast projects
+        the pool empty within FORECAST_TTE_FLOOR_S."""
+        cap = getattr(rep, "capacity", None)
+        if not isinstance(cap, dict):
+            return False
+        stale_after = max(10.0, 4 * getattr(self.sup,
+                                            "probe_interval_s", 2.5))
+        if time.monotonic() - getattr(rep, "capacity_t", 0.0) \
+                > stale_after:
+            return False
+        tte = (cap.get("forecast") or {}).get("tte_s")
+        return (isinstance(tte, (int, float))
+                and float(tte) < self.FORECAST_TTE_FLOOR_S)
+
+    def _fleet_cache_hint(self, rep: Replica,
+                          affinity_key: Optional[str],
+                          trace=None) -> Optional[Dict]:
+        """Fleet cache (r23): the pick did NOT land on a holder (none
+        live in the pickable set, or the holder died and is excluded)
+        — but ANY live peer advertising the chain can serve it over
+        fetch_pages, prefill-class or not: every replica's spill tiers
+        are one fleet-wide KV byte cache. Returns a fetch_from hint
+        naming the least-loaded advertising peer, or None (lane off,
+        unkeyed, the pick already holds the chain, or no peer
+        advertises it). If the peer dies before the pull, the decode
+        side's typed PageFetchFailed falls back to local prefill —
+        never a hang, never wrong tokens."""
+        if not self.fleet_cache or affinity_key is None:
+            return None
+        if affinity_key in getattr(rep, "prefix_keys", ()):
+            return None  # already resident where decode will run
+        peers = [r for r in self.sup.live()
+                 if r.idx != rep.idx
+                 and affinity_key in getattr(r, "prefix_keys", ())]
+        if not peers:
+            return None
+        peer = min(peers, key=lambda r: (getattr(r, "load", 0), r.idx))
+        with self._lock:
+            self.fleet_cache_hints_total += 1
+        if trace is not None:
+            trace("fleet_cache_hint", rep=rep.idx, peer=peer.idx)
+        return {"host": self.sup.host, "port": peer.port}
+
     def _pick(self, exclude: set, affinity_key: Optional[str] = None,
               keyed: bool = False,
               exclude_prefill: bool = False) -> Optional[Replica]:
@@ -1165,6 +1241,18 @@ class FailoverRouter:
                     if getattr(r, "role", "mixed") != "prefill"]
         if not live:
             return None
+        if self.forecast_placement and len(live) > 1:
+            # byte-planning placement (r23, default off): drop replicas
+            # whose FRESH capacity forecast says the pool exhausts
+            # within the pressure floor — a request landed there would
+            # thrash evictions the moment it started decoding. A
+            # preference, never a filter-to-empty; stale/absent
+            # forecasts count as healthy (advisory plane, r18 rules).
+            healthy = [r for r in live if not self._forecast_pressed(r)]
+            if healthy and len(healthy) < len(live):
+                with self._lock:
+                    self.forecast_steers_total += 1
+                live = healthy
         if affinity_key is not None:
             holders = [r for r in live
                        if affinity_key in getattr(r, "prefix_keys", ())]
@@ -1280,13 +1368,16 @@ class FailoverRouter:
                 tried.clear()
                 time.sleep(0.2)
                 continue
+            hint = handoff_hint
+            if hint is None:
+                hint = self._fleet_cache_hint(rep, affinity_key, trace)
             fwd = msg
-            if handoff_hint is not None:
-                # the hint survives failover: if the prefill peer died
-                # meanwhile, the decode side's fetch fails typed and
-                # falls back to local prefill — never a hang
+            if hint is not None:
+                # the hint survives failover: if the advertising peer
+                # died meanwhile, the decode side's fetch fails typed
+                # and falls back to local prefill — never a hang
                 fwd = dict(msg)
-                fwd["fetch_from"] = handoff_hint
+                fwd["fetch_from"] = hint
             if budget_ms is not None and budget_ms > 0:
                 remaining = budget_ms \
                     - (time.monotonic() - arrival) * 1e3
@@ -1650,6 +1741,21 @@ def main(argv=None) -> None:
              "previous generation's replicas instead of orphaning "
              "them")
     parser.add_argument(
+        "--no-fleet-cache", action="store_true",
+        help="disable the r23 fleet-cache lane: when the picked "
+             "replica does not advertise a keyed request's chain, the "
+             "router normally hints it to fetch the pages from "
+             "whichever live peer DOES advertise it (any replica's "
+             "spill tiers act as a fleet-wide KV cache); this flag "
+             "restores pick-then-local-prefill routing")
+    parser.add_argument(
+        "--forecast-placement", action="store_true",
+        help="byte-planning placement (r23): steer new requests away "
+             "from replicas whose exhaustion forecast (fleet_capacity "
+             "tte_s) is under the pressure floor; default off — the "
+             "forecast is always scraped, only the routing preference "
+             "is gated")
+    parser.add_argument(
         "server_args", nargs="*",
         help="extra args passed to every replica's "
              "`python -m paddle_tpu.serving.server` (e.g. "
@@ -1776,7 +1882,9 @@ def main(argv=None) -> None:
             sup, host=args.host, port=args.port,
             trace_sample=args.trace_sample,
             deprioritize_outliers=args.deprioritize_outliers,
-            disaggregate=not args.no_disaggregate)
+            disaggregate=not args.no_disaggregate,
+            fleet_cache=not args.no_fleet_cache,
+            forecast_placement=args.forecast_placement)
         port = router.start()
         if asc is not None:
             asc.start()

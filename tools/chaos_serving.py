@@ -91,6 +91,12 @@ class ChaosReport:
     # runs are unaffected.
     stranded_processes: int = 0
     journal_lint_failures: int = 0
+    # fleet-cache crash safety (r23, INVARIANT 8): the run must have
+    # actually exercised the lane under test (router fleet-cache hints
+    # observed before the SIGKILL) — a run where the fault never races
+    # the behaviour proves nothing and fails loudly instead of
+    # greenly. Default 0 so pre-r23 runs are unaffected.
+    arming_failures: int = 0
     recoveries: int = 0           # supervisor SIGKILL->restart cycles
     error_kinds: Dict[str, int] = dataclasses.field(default_factory=dict)
     details: List[Dict] = dataclasses.field(default_factory=list)
@@ -109,6 +115,7 @@ class ChaosReport:
                 and self.ledger_failures == 0
                 and self.stranded_processes == 0
                 and self.journal_lint_failures == 0
+                and self.arming_failures == 0
                 and self.completed + self.typed_errors == self.requests)
 
     def to_dict(self) -> Dict:
@@ -617,6 +624,253 @@ def run_disagg_chaos(requests: int = 8, seed: int = 0,
     return report
 
 
+def run_fleet_cache_chaos(requests: int = 8, seed: int = 0,
+                          model: str = "gpt_tiny", page_size: int = 8,
+                          max_seq_len: int = 96, num_slots: int = 2,
+                          max_new_tokens: int = 6,
+                          request_timeout_s: float = 300.0,
+                          drain_timeout_s: float = 120.0,
+                          platform: str = "cpu",
+                          log_dir: Optional[str] = None) -> ChaosReport:
+    """INVARIANT 8 (r23 fleet cache): SIGKILL the ADVERTISING PEER
+    mid-fleet-cache-fetch under keyed traffic.
+
+    An all-mixed 2-replica fleet (host spill tiers armed, chunked
+    prefill on so concurrent same-prefix admissions exercise the r23
+    dedup fold). Replica 0 is warmed with a shared-prefix chain and
+    advertises it; the harness router deterministically steers every
+    pick OFF replica 0 (a stand-in for a forecast-placement pressure
+    steer — the routing heuristic is not what's under test), so every
+    keyed request dispatches to replica 1 with a fleet-cache
+    ``fetch_from`` hint naming replica 0. Once hints are observed,
+    replica 0 is SIGKILLed: the first wave's fetch_pages pulls die
+    mid-pull, the second wave dispatches against a stale
+    advertisement. The contract:
+
+    - every request terminates in a full result or a TYPED error —
+      the fetching side's typed PageFetchFailed degrades to LOCAL
+      prefill with bit-identical greedy output; NEVER a hang;
+    - zero leaked pages and a clean DEDUP-AWARE page-ledger reconcile
+      on every survivor (and the respawned peer) after drain —
+      folded pages under ``dedup`` owners with ``dedup_hit`` ledger
+      reasons must reconcile exactly;
+    - the lane actually armed: fleet-cache hints observed before the
+      kill, else ``arming_failures`` fails the run loudly."""
+    import numpy as np
+
+    from paddle_tpu.serving.prefix_cache import _block_hash
+    from paddle_tpu.serving.server import client_request
+    from paddle_tpu.serving.supervisor import (FailoverRouter,
+                                               Supervisor, _rpc)
+
+    t_start = time.monotonic()
+    rng = np.random.default_rng(seed)
+    # every prompt shares a 2-full-page prefix (the chain the fleet
+    # cache ships) with a distinct random tail
+    base = rng.integers(1, 100, size=2 * page_size)
+    prompts = [np.asarray(np.concatenate(
+                   [base, rng.integers(1, 100,
+                                       size=int(rng.integers(2, 17)))]),
+               np.int32)
+               for _ in range(requests)]
+    max_new = [max_new_tokens] * requests
+    expected = _reference_outputs(model, prompts, max_new,
+                                  page_size, max_seq_len)
+
+    log_dir = log_dir or tempfile.mkdtemp(prefix="pt-chaos-fleet-")
+    replica_env = {
+        "JAX_PLATFORMS": platform,
+        "TPU_SKIP_MDS_QUERY": "true",
+        "PADDLE_TPU_COMPILE_CACHE": os.path.join(log_dir,
+                                                 "compile_cache"),
+    }
+    # --spill-mb: both sides of the lane need tiers (the peer exports
+    # blobs from them, the fetcher lands blobs into them);
+    # --prefill-chunk keeps concurrent same-prefix requests in flight
+    # past each other's admission match, forcing the dedup fold
+    server_args = ["--page-size", str(page_size),
+                   "--max-seq-len", str(max_seq_len),
+                   "--num-slots", str(num_slots),
+                   "--stall-timeout-s", "120",
+                   "--spill-mb", "64",
+                   "--prefill-chunk", str(page_size)]
+    sup = Supervisor(model=model, replicas=2,
+                     server_args=server_args, replica_env=replica_env,
+                     probe_interval_s=0.3, backoff_base_s=0.5,
+                     log_dir=log_dir)
+    report = ChaosReport(requests=requests)
+    outcomes: List[Optional[Dict]] = [None] * requests
+    route_trace: List[Dict] = []
+
+    class _SteeredRouter(FailoverRouter):
+        """Keep picks off the warmed holder (replica 0) so keyed
+        requests MUST take the fleet-cache lane to reuse its chain."""
+
+        def _pick(self, exclude, affinity_key=None, keyed=False,
+                  exclude_prefill=False):
+            return super()._pick(set(exclude) | {0}, affinity_key,
+                                 keyed, exclude_prefill)
+
+    try:
+        sup.start(wait_ready=True)
+        # warm the shared chain onto replica 0 DIRECTLY (the router is
+        # not up yet), then wait for its advertisement to reach the
+        # supervisor's probe loop — the hint source
+        warm = client_request(
+            sup.host, sup.replicas[0].port,
+            {"op": "generate", "prompt": [int(t) for t in prompts[0]],
+             "max_new_tokens": 2, "key": f"fleet-warm-{seed}"},
+            timeout_s=request_timeout_s)
+        key_hex = _block_hash(None, np.asarray(base[:page_size],
+                                               np.int32)).hex()
+        adv_deadline = time.monotonic() + 30.0
+        while time.monotonic() < adv_deadline and \
+                key_hex not in sup.replicas[0].prefix_keys:
+            time.sleep(0.2)
+        if warm.get("error") or \
+                key_hex not in sup.replicas[0].prefix_keys:
+            report.arming_failures += 1
+            report.details.append(
+                {"arming": "warm/advertisement failed",
+                 "warm_error": warm.get("error"),
+                 "advertised": sorted(sup.replicas[0].prefix_keys)[:4]})
+            return report
+
+        router = _SteeredRouter(sup, max_failover=4)
+        router.trace = route_trace.append
+        rport = router.start()
+
+        def client(i: int) -> None:
+            payload = {"op": "generate",
+                       "prompt": [int(t) for t in prompts[i]],
+                       "max_new_tokens": max_new[i],
+                       "stream": bool(i % 2),
+                       "key": f"fleet-{seed}-{i}",
+                       "deadline_ms": int(request_timeout_s * 500)}
+            t0 = time.monotonic()
+            try:
+                outcomes[i] = client_request(sup.host, rport, payload,
+                                             timeout_s=request_timeout_s)
+            except Exception as e:
+                outcomes[i] = {"_transport_error":
+                               f"{type(e).__name__}: {e}"}
+            outcomes[i]["_elapsed_s"] = round(time.monotonic() - t0, 2)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(requests)]
+        n1 = max(1, requests // 2)
+        for t in threads[:n1]:
+            t.start()
+        # arm check THEN kill: wait until the router has attached at
+        # least one fleet-cache hint (the first wave is inside its
+        # fetch_pages pull from replica 0 right about now), then
+        # SIGKILL the advertising peer mid-pull
+        arm_deadline = time.monotonic() + 10.0
+        while time.monotonic() < arm_deadline and \
+                router.fleet_cache_hints_total == 0:
+            time.sleep(0.05)
+        hints_pre_kill = router.fleet_cache_hints_total
+        if hints_pre_kill == 0:
+            report.arming_failures += 1
+        time.sleep(0.2)
+        sup.kill_replica(0)
+        # second wave: dispatched against a stale advertisement — the
+        # hint (if any) names a corpse; the typed fetch failure falls
+        # back to local prefill on replica 1
+        for t in threads[n1:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=request_timeout_s)
+
+        for i, out in enumerate(outcomes):
+            if isinstance(out, dict):
+                report.details.append(
+                    {"i": i, "elapsed_s": out.get("_elapsed_s"),
+                     "kind": out.get("error")
+                     or out.get("_transport_error", "ok")})
+            if out is None or not isinstance(out, dict):
+                report.hangs += 1
+                continue
+            if "_transport_error" in out:
+                report.hangs += 1
+                kind = out["_transport_error"].split(":")[0]
+                report.error_kinds[kind] = \
+                    report.error_kinds.get(kind, 0) + 1
+                continue
+            if out.get("error"):
+                report.typed_errors += 1
+                kind = out["error"]
+                report.error_kinds[kind] = \
+                    report.error_kinds.get(kind, 0) + 1
+                continue
+            report.completed += 1
+            if out.get("generated") != expected[i]:
+                report.mismatches += 1
+
+        # -- zero leaks + DEDUP-AWARE ledger reconcile everywhere -----
+        deadline = time.monotonic() + drain_timeout_s
+        while time.monotonic() < deadline:
+            if sup.restarts_total >= 1 and \
+                    all(r.ready and r.alive() for r in sup.replicas):
+                break
+            time.sleep(0.3)
+        sup.wait_ready()
+        for rep in sup.replicas:
+            try:
+                _rpc(sup.host, rep.port, {"op": "drain"},
+                     timeout_s=10.0)
+            except Exception:
+                report.leak_failures += 1
+                continue
+            ok = False
+            chk: Dict = {}
+            while time.monotonic() < deadline:
+                try:
+                    chk = _rpc(sup.host, rep.port,
+                               {"op": "leak_check"}, timeout_s=10.0)
+                except Exception:
+                    time.sleep(0.5)
+                    continue
+                if chk.get("ok"):
+                    ok = True
+                    break
+                if not chk.get("busy"):
+                    break
+                time.sleep(0.5)
+            if ok:
+                report.replicas_checked += 1
+            else:
+                report.leak_failures += 1
+            led = chk.get("ledger")
+            if isinstance(led, dict) and not led.get("ok", True):
+                report.ledger_failures += 1
+                report.ledger_errors.extend(
+                    f"replica {rep.idx}: {m}"
+                    for m in (led.get("mismatches") or
+                              ["reconcile failed"])[:4])
+        report.supervisor_restarts = sup.restarts_total
+        report.router_failovers = router.failovers_total
+        # survivor-side lane accounting: how the fetches actually
+        # ended (pulled vs typed-fallback) plus the dedup fold counts
+        surv = _scrape_counters(sup.host, sup.replicas[1].port)
+        report.details.append(
+            {"fleet_cache_hints_total": router.fleet_cache_hints_total,
+             "hints_pre_kill": hints_pre_kill,
+             "handoffs_total": router.handoffs_total,
+             "survivor_counters":
+                 {k: v for k, v in surv.items()
+                  if "handoff" in k or "dedup" in k}})
+        router.stop()
+    finally:
+        sup.stop()
+    report.wall_s = round(time.monotonic() - t_start, 3)
+    if not report.ok:
+        report.details.append({"route_trace": route_trace,
+                               "log_dir": log_dir})
+    return report
+
+
 def run_autoscale_chaos(requests: int = 8, seed: int = 0,
                         model: str = "gpt_tiny", page_size: int = 8,
                         max_seq_len: int = 96, num_slots: int = 2,
@@ -1014,6 +1268,13 @@ def main(argv=None) -> int:
              "local-prefill fallback everywhere, zero leaks + clean "
              "ledger reconcile on every survivor")
     parser.add_argument(
+        "--fleet-cache-chaos", action="store_true",
+        help="run INVARIANT 8 instead (r23): all-mixed fleet, keyed "
+             "shared-prefix traffic riding fleet-cache fetch_from "
+             "hints, SIGKILL the ADVERTISING PEER mid-fetch — typed "
+             "fallback to local prefill everywhere, zero leaks, "
+             "dedup-aware ledger reconcile clean on every survivor")
+    parser.add_argument(
         "--autoscale-chaos", action="store_true",
         help="run INVARIANT 7 instead (r21): SIGKILL the SUPERVISOR "
              "mid-spawn and mid-scale-down under keyed traffic, "
@@ -1021,6 +1282,15 @@ def main(argv=None) -> int:
              "replicas, no lost chains, zero leaks, typed "
              "termination, journal lints clean")
     args = parser.parse_args(argv)
+
+    if args.fleet_cache_chaos:
+        report = run_fleet_cache_chaos(requests=args.requests,
+                                       seed=args.seed,
+                                       model=args.model,
+                                       platform=args.platform,
+                                       log_dir=args.log_dir)
+        print(json.dumps(report.to_dict(), indent=2))
+        return 0 if report.ok else 1
 
     if args.autoscale_chaos:
         report = run_autoscale_chaos(requests=args.requests,

@@ -63,8 +63,12 @@ KNOWN_REASONS = ("resurrect", "engine_failed", "stall", "autoscale")
 # the journal's recent action tail
 REQUIRED_KEYS_AUTOSCALE = ("v", "reason", "t_unix", "pid", "action",
                            "fleet", "journal_tail")
-# the device-pool owner classes that must sum to the pool size
+# the device-pool owner classes that must sum to the pool size.
+# "dedup" (r23 cross-request shared pages) is OPTIONAL in the lint:
+# pre-r23 bundles never carry it, post-r23 bundles always do — the
+# sum includes it whenever present
 OCCUPANCY_CLASSES = ("inflight", "prefix_device", "reserved", "free")
+OPTIONAL_OCCUPANCY_CLASSES = ("dedup",)
 
 
 def lint_bundle(bundle: Any, name: str = "bundle") -> List[str]:
@@ -166,6 +170,8 @@ def lint_bundle(bundle: Any, name: str = "bundle") -> List[str]:
                               f"classes {missing}")
             else:
                 total = sum(int(occ[c]) for c in OCCUPANCY_CLASSES)
+                total += sum(int(occ.get(c, 0))
+                             for c in OPTIONAL_OCCUPANCY_CLASSES)
                 if total != cap["num_pages"]:
                     errors.append(
                         f"{name}: occupancy classes sum {total} != "

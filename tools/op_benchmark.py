@@ -229,7 +229,29 @@ def promoted_cases():
 
     page_fetch_splice.op_name = "paged_page_splice"
 
+    def blob_encode_decode():
+        # r23 KV byte substrate: host-lane codec cost of one fp page
+        # through pack(int8) + unpack — the work every spill, every
+        # fetch_pages reply and every prefetch import pays per page.
+        # A HOST case (host_fn below): the codecs are deliberately
+        # numpy-only (they run on the serving thread next to the
+        # socket, never inside a jit), so the harness times the plain
+        # python call instead of a scanned device launch.
+        rng = np.random.default_rng(0)
+        layers = [(rng.standard_normal((16, 8, 64)).astype(np.float32),
+                   rng.standard_normal((16, 8, 64)).astype(np.float32),
+                   None, None) for _ in range(4)]
+        return (layers, "int8")
+
+    def _blob_roundtrip(layers, fmt):
+        from paddle_tpu.serving.prefix_cache import (pack_page_blob,
+                                                     unpack_page_blob)
+        return unpack_page_blob(pack_page_blob(layers, fmt=fmt))
+
+    blob_encode_decode.host_fn = _blob_roundtrip
+
     return {"paged_attention_head_sharded": _paged_case,
+            "blob_encode_decode": blob_encode_decode,
             "page_fetch_splice": page_fetch_splice,
             "prefill_chunk_step": _prefill_chunk_case,
             "fused_decode_step": fused_decode_step,
@@ -241,6 +263,23 @@ def promoted_cases():
 
 
 def bench_op(name: str, make_args, repeat: int) -> dict:
+    # host cases (builder.host_fn, r23): pure-python/numpy hot paths
+    # with no device launch to scan — timed as direct calls. Same log
+    # schema, same gate.
+    host = getattr(make_args, "host_fn", None)
+    if host is not None:
+        full_args = make_args()
+        host(*full_args)  # warm (allocator pools, import caches)
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(repeat):
+                host(*full_args)
+            times.append((time.perf_counter() - t0) / repeat)
+        dt = sorted(times)[1]  # median window
+        return {"case": name, "avg_us": round(dt * 1e6, 2),
+                "repeat": repeat}
+
     import jax
 
     from paddle_tpu.ops.registry import get_op
