@@ -2474,6 +2474,177 @@ def bench_autoscale_goodput(on_tpu: bool) -> Dict:
                     "device assignment."}
 
 
+def bench_rolling_update(on_tpu: bool) -> Dict:
+    """Rolling weight upgrade A/B (r24 tentpole artifact): the SAME
+    steady open-loop trace through a 2-replica fleet behind a real
+    FailoverRouter while the fleet is upgraded to a new checkpoint
+    mid-trace, two ways:
+
+    - **hot_swap_roll**: `Supervisor.roll_fleet` — per replica, hand
+      hot chains to the survivor, pause admission while active slots
+      drain, apply the validated state through the engine's identity
+      cache, verify the health probe reports the new generation;
+    - **drain_respawn**: the pre-r24 operator answer — kill each
+      replica and respawn it on the new checkpoint (full process
+      boot + model build + warm compile per replica).
+
+    Reported per lane: requests completed within deadline (the hot
+    lane's claim is ZERO drops — every request completes, none
+    expires), the upgrade's wall time, the slowest in-flight request
+    while the upgrade ran, and the final fleet generation. Replicas
+    are pinned to JAX_PLATFORMS=cpu in both lanes; chip magnitudes
+    pending like every cpu_smoke entry."""
+    import tempfile
+    import threading
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.resilience import \
+        ResilientCheckpointManager
+    from paddle_tpu.models.gpt import (GPTForCausalLM, checkpoint_state,
+                                       gpt_tiny, perturbed_state)
+    from paddle_tpu.serving.server import client_request
+    from paddle_tpu.serving.supervisor import FailoverRouter, Supervisor
+
+    page, slots, max_seq, new_toks = 8, 2, 96, 32
+    deadline_ms = 30000
+    rate_rps, n_requests, upgrade_at_s = 4.0, 80, 5.0
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps,
+                                         n_requests)).tolist()
+    prompts = [rng.integers(1, 1000, (int(rng.integers(16, 30)),))
+               .astype(int).tolist() for _ in range(n_requests)]
+
+    bench_dir = tempfile.mkdtemp(prefix="pt-rolling-update-")
+    # the new generation's checkpoint: the boot weights perturbed —
+    # a real weight delta, saved through the crc-manifested manager
+    # exactly as a trainer would publish it
+    pt.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    ResilientCheckpointManager(os.path.join(bench_dir, "ckpt")).save(
+        1, perturbed_state(checkpoint_state(m), scale=1e-3, seed=1))
+    ckpt = os.path.join(bench_dir, "ckpt")
+    del m
+
+    replica_env = {"JAX_PLATFORMS": "cpu",
+                   "TPU_SKIP_MDS_QUERY": "true",
+                   "PADDLE_TPU_COMPILE_CACHE":
+                       os.path.join(bench_dir, "compile_cache")}
+    server_args = ["--page-size", str(page), "--num-slots", str(slots),
+                   "--max-seq-len", str(max_seq)]
+
+    def lane(hot: bool) -> Dict:
+        sup = Supervisor(model="gpt_tiny", replicas=2,
+                         server_args=server_args,
+                         replica_env=replica_env,
+                         probe_interval_s=0.25, backoff_base_s=0.5,
+                         log_dir=os.path.join(
+                             bench_dir, "hot" if hot else "respawn"))
+        outcomes: list = [None] * n_requests
+        elapsed: list = [None] * n_requests
+
+        def client(i):
+            t0 = time.monotonic()
+            try:
+                outcomes[i] = client_request(
+                    "127.0.0.1", rport,
+                    {"op": "generate", "prompt": prompts[i],
+                     "max_new_tokens": new_toks,
+                     "deadline_ms": deadline_ms}, timeout_s=120.0)
+            except Exception as e:
+                outcomes[i] = {"error": f"{type(e).__name__}: {e}"}
+            elapsed[i] = time.monotonic() - t0
+
+        upgrade: Dict = {}
+
+        def do_upgrade():
+            t0 = time.monotonic()
+            if hot:
+                roll = sup.roll_fleet(ckpt, generation=1,
+                                      canary_window_s=0.5)
+                upgrade["roll"] = {
+                    "ok": roll.get("ok"),
+                    "canary": roll.get("canary"),
+                    "swapped": len(roll.get("swapped") or ()),
+                    "respawned": len(roll.get("respawned") or ())}
+            else:
+                # the cold path: new committed config, then each
+                # replica pays a full process respawn sequentially
+                sup.checkpoint = ckpt
+                sup.weight_generation = 1
+                for rep in sorted(sup.live(), key=lambda r: r.idx):
+                    sup._respawn_with_config(rep)
+            upgrade["upgrade_s"] = round(time.monotonic() - t0, 2)
+
+        router = None
+        try:
+            sup.start(wait_ready=True)
+            router = FailoverRouter(sup)
+            rport = router.start()
+            client_request("127.0.0.1", rport,
+                           {"op": "generate", "prompt": prompts[0],
+                            "max_new_tokens": 2}, timeout_s=300.0)
+            start = time.monotonic()
+            threads, upth = [], None
+            for i, at in enumerate(arrivals):
+                if upth is None and at >= upgrade_at_s:
+                    upth = threading.Thread(target=do_upgrade,
+                                            daemon=True)
+                    upth.start()
+                wait = at - (time.monotonic() - start)
+                if wait > 0:
+                    time.sleep(wait)
+                th = threading.Thread(target=client, args=(i,),
+                                      daemon=True)
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(timeout=120.0)
+            if upth is not None:
+                upth.join(timeout=300.0)
+            final_gen = sup.weight_generation
+        finally:
+            if router is not None:
+                router.stop()
+            sup.stop()
+        done = sum(1 for o in outcomes
+                   if isinstance(o, dict) and o.get("done"))
+        expired = sum(1 for o in outcomes
+                      if isinstance(o, dict)
+                      and o.get("error") == "DeadlineExceeded")
+        out = {"completed_in_deadline": done,
+               "expired": expired,
+               "dropped_or_failed": n_requests - done - expired,
+               "slowest_request_s": round(
+                   max(e for e in elapsed if e is not None), 2),
+               "final_generation": final_gen}
+        out.update(upgrade)
+        return out
+
+    hot = lane(hot=True)
+    cold = lane(hot=False)
+    return {"metric": "gpt_tiny_rolling_update_cpu_smoke",
+            "unit": "requests completed in deadline during a live "
+                    "weight upgrade",
+            "requests": n_requests,
+            "deadline_ms": deadline_ms,
+            "trace": f"steady ~{rate_rps:.0f} rps, fleet upgraded to "
+                     f"a new checkpoint at t={upgrade_at_s:.0f}s",
+            "num_slots": slots, "page_size": page,
+            "hot_swap_roll": hot,
+            "drain_respawn": cold,
+            "note": "same steady open-loop trace through a 2-replica "
+                    "fleet upgraded mid-trace: roll_fleet hot-swap "
+                    "(handoff + admission pause + validated in-place "
+                    "apply) vs kill-and-respawn on the new "
+                    "checkpoint. The hot lane's contract is zero "
+                    "drops and zero expiries; the cold lane pays two "
+                    "full process boots and rides on router "
+                    "failover. Replicas run JAX_PLATFORMS=cpu in "
+                    "both lanes; chip rerun pending ROADMAP 3(b) "
+                    "per-replica device assignment."}
+
+
 def bench_disaggregated_serving(on_tpu: bool) -> Dict:
     """Disaggregated prefill/decode A/B (r20 tentpole artifact): the
     SAME adversarial trace — steady short unkeyed token streams while
@@ -3218,6 +3389,7 @@ def run_staged(on_tpu: bool) -> Dict:
                      ("serving_goodput", bench_serving_goodput),
                      ("fleet_goodput", bench_fleet_goodput),
                      ("autoscale_goodput", bench_autoscale_goodput),
+                     ("rolling_update", bench_rolling_update),
                      ("memory_observatory", bench_memory_observatory),
                      ("speculative_decode", bench_speculative_decode),
                      ("compile_cache", bench_compile_cache),

@@ -104,6 +104,14 @@ FAULT_SITES: Dict[str, str] = {
                    "'torn' — a corrupted blob the restore-side crc32 "
                    "must catch; either side degrades to a cache miss "
                    "and the chained-prefill fallback recomputes)",
+    "checkpoint.load": "hot-swap checkpoint load+validate (serving "
+                       "swap op, conn thread; transient IO faults "
+                       "retry via the builtin policy, a persistent/"
+                       "corrupt load fails as a typed SwapFailed "
+                       "with the old weights still serving)",
+    "swap.apply": "engine weight-swap apply (fires after validation, "
+                  "before the first tensor write — an abort here "
+                  "proves the all-or-nothing swap contract)",
 }
 
 # Fast-path gate: False whenever no injector exists or no site is armed,

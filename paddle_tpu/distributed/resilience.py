@@ -186,6 +186,11 @@ _BUILTIN_SITE_POLICIES: Dict[str, "RetryPolicy"] = {
     # NO_RETRY_SITES, never implicitly
     "checkpoint.write": DEFAULT_RETRY,
     "checkpoint.read": DEFAULT_RETRY,
+    # hot-swap checkpoint load (serving swap op, conn thread): same
+    # IO-bound regime as checkpoint.read — transient faults retry via
+    # the stock policy; a persistent or corrupt load exhausts retries
+    # and surfaces as a typed SwapFailed with the old weights pinned
+    "checkpoint.load": DEFAULT_RETRY,
     "membership.heartbeat": DEFAULT_RETRY,
     "ps.push": DEFAULT_RETRY,
     "ps.pull": DEFAULT_RETRY,
@@ -221,6 +226,12 @@ NO_RETRY_SITES: Dict[str, str] = {
                    "fallback recomputes the pages "
                    "(serving/prefix_cache.py); retrying the blob IO "
                    "in place would buy nothing the fallback doesn't",
+    "swap.apply": "the swap caller owns recovery: an abort here "
+                  "surfaces as a typed SwapFailed with the old "
+                  "generation still serving, and the supervisor's "
+                  "roll/rollback path decides whether to re-issue "
+                  "the swap — a blind in-place retry could "
+                  "double-apply against a live engine",
 }
 
 _site_policies: Dict[str, RetryPolicy] = {}

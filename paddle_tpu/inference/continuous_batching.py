@@ -87,7 +87,17 @@ from typing import (Any, Callable, Dict, Hashable, List, Optional,
 import numpy as np
 
 __all__ = ["PageAllocator", "DecodeRequest", "RequestStats",
-           "ContinuousBatchingEngine", "create_decode_engine"]
+           "ContinuousBatchingEngine", "create_decode_engine",
+           "SwapFailed"]
+
+
+class SwapFailed(RuntimeError):
+    """A weight hot-swap was refused or could not be applied (r24).
+
+    Raised BEFORE any live state is touched: a torn/corrupt/mismatched
+    checkpoint, or an engine that is not at a swappable boundary,
+    leaves the old weights serving and the old generation pinned —
+    never a half-applied state dict, never mixed tensors."""
 
 
 class PageAllocator:
@@ -472,7 +482,8 @@ class ContinuousBatchingEngine:
                  capture_costs: bool = False,
                  page_ledger: bool = True,
                  ledger_events: int = 1024,
-                 forecast_admission: bool = False):
+                 forecast_admission: bool = False,
+                 weight_generation: int = 0):
         import jax.numpy as jnp
 
         from ..core.compile_cache import enable_compile_cache
@@ -485,6 +496,17 @@ class ContinuousBatchingEngine:
         enable_compile_cache()
         self.model = model
         model.eval()
+        # weight hot-swap (r24): the generation of the weights this
+        # engine currently serves. swap_weights bumps it; the prefix
+        # cache salts chain roots with it so KV from different
+        # generations never splices.
+        self.weight_generation = int(weight_generation)
+        self.weight_swaps = 0
+        # swap drain gate: while True, _admit is a no-op — active
+        # slots finish and free, queued requests WAIT (nothing is
+        # dropped), and a pending swap can reach num_active == 0
+        # under continuous traffic. Owned by the serving layer.
+        self.pause_admission = False
         cfg = model.config
         self.cfg = cfg
         # tensor-parallel serving (mesh=None = single-device, the
@@ -1025,6 +1047,89 @@ class ContinuousBatchingEngine:
         for stale in [k for k in self._shard_cache if k not in live]:
             del self._shard_cache[stale]
         return out
+
+    def swap_weights(self, state_dict,
+                     generation: Optional[int] = None
+                     ) -> Dict[str, Any]:
+        """Weight hot-swap (r24): replace the model's weights between
+        steps with a fully-validated state dict, bump the weight
+        generation, and re-salt the prefix-cache chain keys so KV from
+        the old weights misses by construction.
+
+        Validate-then-swap is ATOMIC: the incoming tree is checked
+        against the model's own state dict (exact key set, exact
+        shapes, exact dtypes) BEFORE any tensor is touched —
+        ``set_state_dict`` raises mid-apply on a shape mismatch and
+        silently coerces dtypes, so the only safe swap is one that
+        cannot hit either path. Any validation failure, and any
+        in-flight work (active slots or an undrained macro launch), is
+        a typed :class:`SwapFailed` with the old weights still serving
+        and the old generation pinned. Queued-but-unadmitted requests
+        survive the swap: their memoized chain keys are invalidated so
+        their prefills insert under the NEW generation's keys.
+
+        Returns ``{"generation", "leaves", "swap_ms"}`` on success."""
+        from ..distributed.fault_inject import fault_point
+        t0 = time.monotonic()
+        gen = int(generation) if generation is not None \
+            else self.weight_generation + 1
+        if gen == self.weight_generation:
+            raise SwapFailed(
+                f"generation {gen} is already serving; a swap must "
+                f"move to a new weight generation")
+        # macro boundary (r19): a dispatched-but-undrained launch still
+        # reads the OLD weights — drain it so the swap lands between
+        # launches, never under one
+        self._flush_macro()
+        if self.num_active:
+            raise SwapFailed(
+                f"engine busy: {self.num_active} active slot(s) — "
+                f"drain in-flight requests before swapping (old "
+                f"requests finish on old weights)")
+        own = self.model.state_dict(include_non_persistable_buffer=True)
+        got = dict(state_dict)
+        missing = [k for k in own if k not in got]
+        extra = [k for k in got if k not in own]
+        if missing or extra:
+            raise SwapFailed(
+                f"state-dict structure mismatch: missing "
+                f"{sorted(missing)[:8]}, unexpected "
+                f"{sorted(extra)[:8]} — a partial apply would serve "
+                f"mixed tensors")
+        bad = []
+        for name, target in own.items():
+            arr = np.asarray(getattr(got[name], "value", got[name]))
+            if tuple(arr.shape) != tuple(target.shape):
+                bad.append(f"{name}: shape {tuple(arr.shape)} vs "
+                           f"{tuple(target.shape)}")
+            elif np.dtype(arr.dtype) != np.dtype(target.dtype):
+                bad.append(f"{name}: dtype {arr.dtype} vs "
+                           f"{target.dtype}")
+        if bad:
+            raise SwapFailed(
+                f"state-dict tree mismatch ({len(bad)} leaves): "
+                f"{bad[:4]}")
+        # the apply fault site fires AFTER validation and BEFORE the
+        # first tensor write: an injected abort here proves the
+        # all-or-nothing contract (no tensor touched yet)
+        fault_point("swap.apply")
+        self.model.set_state_dict(got)
+        # identity cache: only changed leaves re-transfer to the mesh
+        self._fresh_state(refresh=True)
+        self.weight_generation = gen
+        self.weight_swaps += 1
+        if self._prefix_cache is not None:
+            with self._led("swap"):
+                self._prefix_cache.set_generation(gen, self.allocator)
+        # queued requests memoized their chain keys under the OLD
+        # generation's salt (match() caches on the request); drop the
+        # memos so post-swap admission hashes fresh
+        for req in self._queue:
+            if hasattr(req, "_pfx_chain"):
+                del req._pfx_chain
+        return {"generation": gen,
+                "leaves": len(own),
+                "swap_ms": round((time.monotonic() - t0) * 1e3, 3)}
 
     def _head_ctx(self):
         """Trace-time mesh routing for the jitted programs: under a
@@ -2202,6 +2307,11 @@ class ContinuousBatchingEngine:
         return sorted(live + list(self._queue), key=lambda r: r.req_id)
 
     def _admit(self) -> None:
+        if self.pause_admission:
+            # swap drain gate (r24): hold the queue — a request
+            # admitted now would pin active slots and starve the
+            # pending weight swap of its num_active == 0 window
+            return
         self._shed_overloaded()
         for slot in range(self.num_slots):
             if self._slots[slot] is not None:

@@ -1557,3 +1557,37 @@ class GPTForCausalLM(Layer):
             nxt, key_raw = sample_j(last, key_raw)
             out.append(nxt[:, None])
         return Tensor(jnp.concatenate(out, axis=1))
+
+
+# -- checkpoint-state helpers (r24 weight hot-swap) -------------------------
+
+def checkpoint_state(model: Layer) -> dict:
+    """The model's full weight tree as plain host numpy arrays keyed by
+    structured name — the form ``ResilientCheckpointManager`` saves and
+    a swap/restore applies back through ``set_state_dict``. Buffers are
+    included (converted layers hold int8 weights there), so a restored
+    tree is the COMPLETE serving state, never a partial apply."""
+    import numpy as np
+    return {name: np.asarray(t.value)
+            for name, t in model.state_dict(
+                include_non_persistable_buffer=True).items()}
+
+
+def perturbed_state(state: dict, scale: float = 1e-3,
+                    seed: int = 0) -> dict:
+    """A deterministic variant of ``state`` with every float leaf
+    nudged by ``scale`` — how tests/benches/chaos manufacture a "new
+    checkpoint" that is structurally identical but produces different
+    logits (so a hot-swap's generation isolation is observable) without
+    training anything. Integer/bool leaves pass through untouched."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    out = {}
+    for name, arr in state.items():
+        arr = np.asarray(arr)
+        if np.issubdtype(arr.dtype, np.floating):
+            out[name] = (arr + scale * rng.standard_normal(
+                arr.shape).astype(arr.dtype)).astype(arr.dtype)
+        else:
+            out[name] = arr
+    return out

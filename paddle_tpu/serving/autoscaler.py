@@ -147,12 +147,15 @@ class FleetJournal:
 
     def adopt_body(self, body: Dict) -> None:
         """Continue a recovered journal: keep its seq counter (action
-        seqs stay monotonic ACROSS supervisor generations) and its
-        action log; the fleet snapshot and owner pid are ours now."""
+        seqs stay monotonic ACROSS supervisor generations), its
+        action log and its committed weight config; the fleet
+        snapshot and owner pid are ours now."""
         with self._lock:
             self._body["seq"] = int(body.get("seq") or 0)
             self._body["actions"] = list(body.get("actions") or ())
             self._body["supervisor_pid"] = os.getpid()
+            if isinstance(body.get("config"), dict):
+                self._body["config"] = dict(body["config"])
             self._write_locked()
 
     # -- mutation ----------------------------------------------------------
@@ -204,6 +207,24 @@ class FleetJournal:
         with self._lock:
             self._body["fleet"] = list(fleet)
             self._write_locked()
+
+    def record_config(self, checkpoint: Optional[str],
+                      generation: int) -> None:
+        """Persist the fleet's COMMITTED weight config (r24): the
+        checkpoint directory and weight generation every respawn
+        boots from. Written when a roll fully commits — recovery
+        restores it so a restarted supervisor spawns dead replicas
+        on the rolled weights, and an incomplete roll converges BACK
+        to exactly this config."""
+        with self._lock:
+            self._body["config"] = {
+                "checkpoint": checkpoint,
+                "generation": int(generation)}
+            self._write_locked()
+
+    def config(self) -> Dict:
+        with self._lock:
+            return dict(self._body.get("config") or {})
 
     # -- reads -------------------------------------------------------------
 
@@ -389,7 +410,16 @@ def plan_recovery(body: Optional[Dict], scan: Dict[int, Dict],
       allows the removal, else ROLLED BACK and the victim re-admitted
       as a full member;
     - an open ``rerole`` resumes against a live victim and completes
-      as a respawn-with-new-role against a dead one.
+      as a respawn-with-new-role against a dead one;
+    - an open ``roll`` (r24 weight upgrade) resumes FORWARD when the
+      swap was confirmed (``swapped`` recorded) or any sibling roll
+      action to the same target generation already committed (the
+      canary proved the checkpoint) — the fleet converges onto the
+      new generation; otherwise it converges BACK to the journal's
+      committed weight config and the action rolls back
+      (``roll_incomplete``). Either way the action stays OPEN until
+      the executor finishes converging, so a second crash mid-resume
+      resumes again instead of stranding a mixed-generation fleet.
 
     Adoption is keyed by replica idx, so the same process can never
     be adopted twice and a planned respawn never duplicates a live
@@ -415,6 +445,21 @@ def plan_recovery(body: Optional[Dict], scan: Dict[int, Dict],
 
     opens = open_actions(body) if body else []
     open_idxs = {a.get("replica") for a in opens}
+    # roll recovery (r24): a target generation is PROVEN when any
+    # roll action to it committed (the canary survived its window) —
+    # an open sibling then resumes forward instead of rolling back
+    roll_begins: Dict[int, Dict] = {}
+    committed_seqs: set = set()
+    for e in ((body or {}).get("actions") or ()):
+        if not isinstance(e, dict):
+            continue
+        if e.get("phase") == "begin" and e.get("action") == "roll":
+            roll_begins[e.get("seq")] = e
+        elif e.get("phase") == "commit":
+            committed_seqs.add(e.get("seq"))
+    proven_gens = {e.get("generation_to")
+                   for s, e in roll_begins.items()
+                   if s in committed_seqs and not e.get("rollback")}
     for idx, ent in sorted(fleet.items()):
         if idx in open_idxs:
             continue  # the action resolution below owns this replica
@@ -480,6 +525,31 @@ def plan_recovery(body: Optional[Dict], scan: Dict[int, Dict],
                 members[idx] = dict(ent, role=to_role)
                 plan["resolve"].append(
                     (seq, "commit", "respawned_with_new_role"))
+        elif kind == "roll":
+            # the victim is a normal fleet member either way (a swap
+            # never removes a process); which GENERATION the fleet
+            # converges to is the resume entry's job
+            if live_now:
+                plan["adopt"].append(ent)
+            else:
+                plan["respawn"].append(
+                    {"idx": idx, "role": ent.get("role", "mixed")})
+            members[idx] = ent
+            gen_to = act.get("generation_to")
+            if act.get("swapped") or gen_to in proven_gens:
+                plan["resume"].append(
+                    {"seq": seq, "action": "roll", "replica": idx,
+                     "checkpoint": act.get("checkpoint"),
+                     "generation": gen_to})
+            else:
+                cfg = (body or {}).get("config") or {}
+                plan["resume"].append(
+                    {"seq": seq, "action": "roll_back",
+                     "replica": idx,
+                     "checkpoint": cfg.get("checkpoint"),
+                     "generation": int(
+                         cfg.get("generation")
+                         or act.get("generation_from") or 0)})
         else:
             plan["resolve"].append(
                 (seq, "rollback", f"unknown_action_{kind}"))
@@ -606,6 +676,14 @@ class Autoscaler:
                              self.cfg.max_replicas)
         if body is not None:
             self.journal.adopt_body(body)
+            # r24: restore the committed weight config BEFORE any
+            # respawn — a dead replica must come back on the weights
+            # the previous supervisor generation had rolled to
+            cfg = body.get("config") or {}
+            if cfg:
+                self.sup.checkpoint = cfg.get("checkpoint")
+                self.sup.weight_generation = int(
+                    cfg.get("generation") or 0)
         replicas: List[Replica] = []
         for ent in plan["adopt"]:
             rep = Replica(int(ent["idx"]), self.sup.host)
@@ -701,6 +779,50 @@ class Autoscaler:
         elif resume["action"] == "rerole":
             self._finish_rerole(rep, resume.get("role", "mixed"),
                                 seq, reason="resume")
+        elif resume["action"] == "roll":
+            self._finish_roll(resume, forward=True)
+        elif resume["action"] == "roll_back":
+            self._finish_roll(resume, forward=False)
+
+    def _finish_roll(self, resume: Dict, forward: bool) -> None:
+        """Converge an interrupted r24 weight roll. Forward: the swap
+        was confirmed (or a sibling committed), so finish rolling the
+        WHOLE fleet onto the target generation — roll_fleet skips
+        already-converged replicas, making the resume idempotent.
+        Backward: the swap was never confirmed, so converge every
+        replica (including one whose swap landed just before the
+        kill) back to the journal's committed config. The journal
+        entry resolves only AFTER convergence — a crash mid-resume
+        leaves it open for the next recovery."""
+        seq = resume["seq"]
+        ckpt = resume.get("checkpoint")
+        gen = int(resume.get("generation") or 0)
+        if forward:
+            out = self.sup.roll_fleet(ckpt, generation=gen,
+                                      canary_window_s=0.0,
+                                      reason="resume")
+            if out.get("ok"):
+                self.journal.commit(seq, resumed="roll_resumed")
+                self.journal.record_config(ckpt, gen)
+                return
+            # forward convergence failed (checkpoint gone / every
+            # swap refused): fall back to the committed config so
+            # the fleet is at least UNIFORM
+            cfg = self.journal.config()
+            self.sup._rollback_generation(
+                cfg.get("checkpoint"),
+                int(cfg.get("generation") or 0), self.journal,
+                reason="roll_resume_failed")
+            self.sup.checkpoint = cfg.get("checkpoint")
+            self.sup.weight_generation = int(
+                cfg.get("generation") or 0)
+            self.journal.rollback(seq, reason="roll_resume_failed")
+            return
+        self.sup._rollback_generation(ckpt, gen, self.journal,
+                                      reason="roll_incomplete")
+        self.sup.checkpoint = ckpt
+        self.sup.weight_generation = gen
+        self.journal.rollback(seq, reason="roll_incomplete")
 
     def _refresh_fleet_record(self) -> None:
         """Keep the journal's fleet snapshot current with monitor
@@ -746,7 +868,7 @@ class Autoscaler:
         # re-fires every tick under sustained pressure and would
         # churn the flight ring's budget for nothing
         if self.flight is not None and action in ("spawn", "drain",
-                                                  "rerole") \
+                                                  "rerole", "roll") \
                 and not reason.startswith("refused_"):
             self.flight.record("autoscale", lambda: {
                 "action": dict(out),

@@ -382,7 +382,20 @@ class ServingMetrics:
                 # failures (each one a counted fall-back to local
                 # prefill, never a hang)
                 "handoff_pages_total", "handoff_bytes_total",
-                "handoff_failures_total")
+                "handoff_failures_total",
+                # weight hot-swap (r24): swap outcomes — three flat
+                # registry counters, rendered in the exposition as ONE
+                # labeled weight_swaps_total{outcome=...} family — plus
+                # cross-generation fetch/prefetch hints skipped typed
+                # (a generation-mismatched peer page is never spliced)
+                "weight_swaps_committed_total",
+                "weight_swaps_rolled_back_total",
+                "weight_swaps_failed_total",
+                "cross_generation_skips_total")
+
+    # outcome labels for the weight_swaps_total family; index-aligned
+    # with the weight_swaps_*_total counters above
+    SWAP_OUTCOMES = ("committed", "rolled_back", "failed")
 
     def __init__(self, registry: Optional[StatRegistry] = None,
                  prefix: str = "serving",
@@ -448,6 +461,10 @@ class ServingMetrics:
         # under the prefill it replaces, like restore_ms one wire hop
         # out)
         self.handoff_ms = Histogram(f"{prefix}.handoff_ms")
+        # weight hot-swap (r24): wall time of the engine-side apply
+        # (validate + set_state_dict + identity-cache refresh + cache
+        # re-salt) — the pause a roll's clients actually feel
+        self.swap_ms = Histogram(f"{prefix}.swap_ms")
 
     def counter(self, name: str):
         return self.registry.get(f"{self.prefix}.{name}")
@@ -481,6 +498,7 @@ class ServingMetrics:
         self.host_overlap_idle_ms = Histogram(
             f"{self.prefix}.host_overlap_idle_ms")
         self.handoff_ms = Histogram(f"{self.prefix}.handoff_ms")
+        self.swap_ms = Histogram(f"{self.prefix}.swap_ms")
 
     # -- ingestion ---------------------------------------------------------
 
@@ -624,6 +642,7 @@ class ServingMetrics:
             "step_ms": self.step_ms.snapshot(),
             "request_peak_pages": self.request_peak_pages.snapshot(),
             "handoff_ms": self.handoff_ms.snapshot(),
+            "swap_ms": self.swap_ms.snapshot(),
             # live SLO monitor (r17): targets + rolling attainment
             "slo": {"ttft_ms": self.slo.ttft_ms,
                     "tpot_ms": self.slo.tpot_ms,
@@ -646,7 +665,8 @@ class ServingMetrics:
                 "request_peak_pages": self.request_peak_pages,
                 "steps_per_launch": self.steps_per_launch,
                 "host_overlap_idle_ms": self.host_overlap_idle_ms,
-                "handoff_ms": self.handoff_ms}
+                "handoff_ms": self.handoff_ms,
+                "swap_ms": self.swap_ms}
 
     def export(self) -> Dict:
         """Fleet-telemetry wire form (r17): exact counters, sampled
@@ -686,6 +706,19 @@ class ServingMetrics:
                 lines.append(f"{gname} {target:g}")
         return lines
 
+    def _swap_lines(self) -> List[str]:
+        """The ``weight_swaps_total{outcome=...}`` labeled family
+        (r24): the three flat outcome counters rendered as one
+        counter family; the raw per-outcome registry names are
+        suppressed from the generic counter loop so strict parsers
+        see exactly one family."""
+        fam = f"{self.prefix}_weight_swaps_total"
+        lines = [f"# TYPE {fam} counter"]
+        for outcome in self.SWAP_OUTCOMES:
+            v = self.counter(f"weight_swaps_{outcome}_total").get()
+            lines.append(f'{fam}{{outcome="{outcome}"}} {v}')
+        return lines
+
     def prometheus_text(self) -> str:
         """Prometheus text exposition: serving histograms + every
         counter in the shared registry (``.`` → ``_``)."""
@@ -698,11 +731,18 @@ class ServingMetrics:
         for h in self._histograms().values():
             lines.extend(h.prometheus_lines())
         lines.extend(self._slo_lines())
+        lines.extend(self._swap_lines())
         for name, val in sorted(self.gauges().items()):
             gname = f"{self.prefix}_{name}".replace(".", "_")
             lines.append(f"# TYPE {gname} gauge")
             lines.append(f"{gname} {val:g}")
+        # the per-outcome swap counters are already exported above as
+        # the labeled weight_swaps_total family
+        labeled = {f"{self.prefix}.weight_swaps_{o}_total"
+                   for o in self.SWAP_OUTCOMES}
         for name, val in sorted(self.registry.snapshot().items()):
+            if name in labeled:
+                continue
             pname = name.replace(".", "_")
             lines.append(f"# TYPE {pname} counter")
             lines.append(f"{pname} {val}")

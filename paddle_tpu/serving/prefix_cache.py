@@ -91,10 +91,22 @@ __all__ = ["PrefixCache", "HostSpillTier", "DiskSpillTier",
            "blob_logical_bytes", "BLOB_FORMATS"]
 
 
-def _block_hash(parent: Optional[bytes], block: np.ndarray) -> bytes:
+def _block_hash(parent: Optional[bytes], block: np.ndarray,
+                generation: int = 0) -> bytes:
+    """Chained block key. ``generation`` (r24 weight hot-swap) salts
+    the CHAIN ROOT only: child keys inherit it through the parent
+    digest, so one root salt versions every key in the chain. KV bytes
+    are a function of the weights that produced them — pages from
+    different weight generations must never splice, and distinct root
+    salts make cross-generation lookups miss by construction.
+    generation=0 (the boot weights) is byte-identical to the pre-r24
+    hash, so existing deployments/advertisements are unchanged until
+    the first swap."""
     h = hashlib.blake2b(digest_size=16)
     if parent is not None:
         h.update(parent)
+    elif generation:
+        h.update(b"PTGEN" + struct.pack("<Q", int(generation)))
     h.update(np.ascontiguousarray(block, np.int32).tobytes())
     return h.digest()
 
@@ -615,12 +627,17 @@ class PrefixCache:
                  spill_dir: Optional[str] = None,
                  disk_bytes: Optional[int] = None,
                  blob_format: str = "raw",
-                 dedup: bool = True):
+                 dedup: bool = True,
+                 generation: int = 0):
         if blob_format not in BLOB_FORMATS:
             raise ValueError(
                 f"blob_format must be one of {BLOB_FORMATS}; "
                 f"got {blob_format!r}")
         self.blob_format = blob_format
+        # weight generation (r24 hot-swap): salted into every chain
+        # root so keys from different weight generations never
+        # collide/splice; 0 = boot weights, byte-identical pre-r24 keys
+        self.generation = int(generation)
         self.dedup = bool(dedup)
         self.dedup_hits = 0          # pages folded onto an existing one
         # lossy-codec accounting (pack_page_blob stats sink): nonzero
@@ -716,7 +733,8 @@ class PrefixCache:
         parent: Optional[bytes] = None
         for i in range(self._shareable_blocks(prompt)):
             block = prompt[i * self.page_size:(i + 1) * self.page_size]
-            key = _block_hash(parent, block)
+            key = _block_hash(parent, block,
+                              generation=self.generation)
             out.append((key, parent, block))
             parent = key
         return out
@@ -1281,6 +1299,22 @@ class PrefixCache:
         self._tier_heads.clear()
         self._spilled_by_head.clear()
         self._fetched_keys.clear()
+
+    def set_generation(self, generation: int, allocator) -> None:
+        """Weight hot-swap (r24): move the cache to a new weight
+        generation. Every resident page, spill blob, and dedup fold
+        was computed by the OLD weights, so the whole cache is cleared
+        (pages back to the allocator, tier blobs scrubbed) and future
+        chain roots are salted with the new generation — old-key
+        lookups miss by construction even against a peer that still
+        holds them. Requires a drained cache (refcount-0 everywhere):
+        the engine swaps weights only with no active requests, so a
+        busy entry here is a lifecycle bug and ``clear`` raises."""
+        generation = int(generation)
+        if generation == self.generation:
+            return
+        self.clear(allocator)
+        self.generation = generation
 
     # -- audits ------------------------------------------------------------
 

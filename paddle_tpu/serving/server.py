@@ -291,6 +291,8 @@ class ServingServer:
                  handoff_timeout_s: float = 30.0,
                  blob_format: str = "raw",
                  dedup: bool = True,
+                 checkpoint: Optional[str] = None,
+                 weight_generation: int = 0,
                  **engine_kwargs):
         from ..distributed.resilience import get_retry_policy
 
@@ -393,6 +395,24 @@ class ServingServer:
                       or model.config.max_seq_len)
             self._engine_kwargs["prompt_buckets"] = sorted(
                 set(int(x) for x in pb) | {msl})
+        # weight hot-swap (r24): the CURRENT generation is part of the
+        # resurrection recipe — a rebuilt engine and prefix cache come
+        # back salted to the generation that was serving, and replicas
+        # (re)spawned mid-roll join the fleet at the right generation
+        # via --checkpoint/--weight-generation. A boot checkpoint is
+        # applied to the model BEFORE the engine captures its
+        # functional state; a missing/corrupt boot checkpoint fails
+        # construction (the supervisor's ready probe owns recovery).
+        self._weight_generation = int(weight_generation)
+        self._checkpoint_dir = checkpoint
+        if checkpoint:
+            _step, state = self._load_checkpoint_state(checkpoint)
+            missing = model.set_state_dict(state)
+            if missing:
+                raise ValueError(
+                    f"boot checkpoint {checkpoint!r} is missing "
+                    f"{len(missing)} weight leaves (e.g. "
+                    f"{missing[0]!r})")
         self.prefix_cache: Optional[PrefixCache] = None
         self.engine = self._build_engine()
         self.max_new_tokens_cap = int(max_new_tokens_cap)
@@ -408,6 +428,11 @@ class ServingServer:
         self._replay: Dict[int, tuple] = {}
         self.metrics.set_gauge_fn(self._gauges)
 
+        # pending weight swap (engine thread): (ctl payload, _Pending,
+        # drain deadline). While set, engine admission is paused so
+        # active slots can drain to zero — queued and newly-arriving
+        # generates WAIT in the engine queue (zero drops, a TTFT dip)
+        self._swap_pending: Optional[tuple] = None
         self._inbox: "queue_mod.Queue[tuple]" = queue_mod.Queue()
         self._admission_lock = threading.Lock()
         self._pending: Dict[int, _Pending] = {}  # engine thread only
@@ -449,12 +474,14 @@ class ServingServer:
                         spill_dir=self._spill_dir,
                         disk_bytes=self._spill_disk_bytes,
                         blob_format=self._blob_format,
-                        dedup=self._dedup)
+                        dedup=self._dedup,
+                        generation=self._weight_generation)
             if self._use_prefix_cache else None)
         return create_decode_engine(
             self._model, scheduler=self.scheduler,
             prefix_cache=self.prefix_cache,
             prefill_retry=self._prefill_retry,
+            weight_generation=self._weight_generation,
             on_complete=self._on_complete,
             # the SAME tracer across resurrections: a replayed
             # request's spans land on its original tree. Program-cost
@@ -568,6 +595,7 @@ class ServingServer:
             # the instance mid-loop
             eng = self.engine
             self._drain_inbox()
+            self._maybe_apply_swap(eng)
             has_work = eng.num_queued or eng.num_active
             if has_work:
                 try:
@@ -621,6 +649,9 @@ class ServingServer:
                     time.sleep(self.poll_interval_s)
                 continue
             if self._stopping and self._inbox.empty():
+                self._resolve_swap_pending(
+                    {"error": "ServerEvicted",
+                     "reason": "server shutting down"})
                 try:
                     eng.close()
                 finally:
@@ -658,6 +689,9 @@ class ServingServer:
                                        lambda n: [])(256),
                 "capacity": self._capacity(),
                 "model": type(self._model).__name__,
+                # weight hot-swap (r24): which generation was serving
+                # when the bundle was cut (flight_inspect lints it)
+                "weight_generation": self._weight_generation,
                 "engine": getattr(eng, "flight_summary",
                                   lambda: {})(),
                 "recipe": dict(self._engine_kwargs),
@@ -733,6 +767,11 @@ class ServingServer:
             # are dropped wholesale either way — count it, don't die
             self.metrics.counter("engine_teardown_leaks_total").add()
         self.engine = self._build_engine()
+        if self._swap_pending is not None:
+            # a swap was draining when the engine died: the rebuilt
+            # engine must keep the admission gate down or the replays
+            # below pin slots forever against the pending swap
+            self.engine.pause_admission = True
         for req in snapshot:
             pending = self._pending.pop(req.req_id, None)
             # compose across repeated resurrections: the snapshot's
@@ -798,6 +837,10 @@ class ServingServer:
         (health keeps answering with status "draining")."""
         self._draining = True
         self._flight_record("engine_failed")
+        self._resolve_swap_pending(
+            {"error": "SwapFailed",
+             "reason": "engine failed terminally before the swap "
+                       "could apply"})
         err = {"error": "EngineFailed",
                "reason": f"decode engine failed "
                          f"{self._consec_errors} consecutive steps; "
@@ -845,6 +888,23 @@ class ServingServer:
                 # network pull; this is dict inserts + crc checks)
                 pending.outbox.put(self._import_blobs(payload))
                 pending.outbox.put(None)
+                continue
+            if payload.get("ctl") == "swap":
+                # weight hot-swap (r24): the conn thread already
+                # loaded + crc-validated the checkpoint; park the
+                # apply until active slots drain (admission pauses,
+                # nothing is dropped — _maybe_apply_swap finishes it)
+                if self._swap_pending is not None:
+                    pending.outbox.put(
+                        {"error": "SwapFailed",
+                         "reason": "another weight swap is already "
+                                   "pending on this replica"})
+                    pending.outbox.put(None)
+                    continue
+                self.engine.pause_admission = True
+                deadline = time.monotonic() + float(
+                    payload.get("timeout_s") or 120.0)
+                self._swap_pending = (payload, pending, deadline)
                 continue
 
             def on_token(rid, tok, done, _p=pending):
@@ -901,6 +961,157 @@ class ServingServer:
                 pending.outbox.put(None)
                 continue
             self._pending[rid] = pending
+
+    # -- weight hot-swap (r24) ----------------------------------------------
+
+    def _resolve_swap_pending(self, reply: Dict) -> None:
+        """Answer (and clear) a parked swap with ``reply`` — the
+        shutdown / terminal-failure escape so the swapping client can
+        never hang on its outbox (engine thread)."""
+        if self._swap_pending is None:
+            return
+        _payload, pending, _deadline = self._swap_pending
+        self._swap_pending = None
+        self.metrics.counter("weight_swaps_failed_total").add()
+        pending.outbox.put(dict(reply))
+        pending.outbox.put(None)
+
+    def _maybe_apply_swap(self, eng) -> None:
+        """Engine-thread gate of a parked swap: once active slots
+        drain to zero (admission is paused, so they only ever shrink),
+        apply it between steps; past the drain deadline, fail it typed
+        with the old weights still serving."""
+        if self._swap_pending is None:
+            return
+        payload, pending, deadline = self._swap_pending
+        if eng.num_active and time.monotonic() < deadline:
+            return  # active slots still finishing on the old weights
+        self._swap_pending = None
+        reply = self._apply_swap(eng, payload)
+        eng.pause_admission = False
+        self._wake.set()
+        pending.outbox.put(reply)
+        pending.outbox.put(None)
+
+    def _apply_swap(self, eng, payload: Dict) -> Dict:
+        """Apply a drained, pre-validated swap (engine thread). Any
+        failure is a typed SwapFailed reply — the engine refused
+        before touching live state, so the old generation keeps
+        serving, pinned."""
+        from ..inference.continuous_batching import SwapFailed
+        outcome = ("rolled_back" if payload.get("rollback")
+                   else "committed")
+        if eng.num_active:
+            self.metrics.counter("weight_swaps_failed_total").add()
+            return {"error": "SwapFailed",
+                    "reason": f"engine did not drain its "
+                              f"{eng.num_active} active slot(s) "
+                              f"within the swap timeout"}
+        try:
+            info = eng.swap_weights(payload["state"],
+                                    generation=payload.get("generation"))
+        except SwapFailed as e:
+            self.metrics.counter("weight_swaps_failed_total").add()
+            self._flight_record("swap_failed", swap_error=str(e))
+            return {"error": "SwapFailed", "reason": str(e)}
+        except Exception as e:
+            self.metrics.counter("weight_swaps_failed_total").add()
+            self._flight_record(
+                "swap_failed",
+                swap_error=f"{type(e).__name__}: {e}")
+            return {"error": "SwapFailed",
+                    "reason": f"{type(e).__name__}: {e}"}
+        self._weight_generation = int(info["generation"])
+        self.metrics.counter(f"weight_swaps_{outcome}_total").add()
+        self.metrics.swap_ms.observe(float(info["swap_ms"]))
+        self.tracer.annotate("weight_swap", outcome=outcome,
+                             generation=info["generation"],
+                             swap_ms=info["swap_ms"],
+                             checkpoint_step=payload.get("step"))
+        return {"ok": True, "outcome": outcome, **info}
+
+    @staticmethod
+    def _load_checkpoint_state(directory: str):
+        """Load + crc-validate the newest valid checkpoint under
+        ``directory`` (ResilientCheckpointManager manifest layout) on
+        the CALLING thread — the live engine is never touched. The
+        ``checkpoint.load`` fault site fires per attempt and transient
+        faults retry per its builtin policy; a directory with no valid
+        checkpoint raises a typed SwapFailed. Returns (step, state)."""
+        from ..distributed.fault_inject import fault_point
+        from ..distributed.resilience import (
+            ResilientCheckpointManager, get_retry_policy)
+        from ..inference.continuous_batching import SwapFailed
+
+        def load_once():
+            fault_point("checkpoint.load")
+            mgr = ResilientCheckpointManager(directory)
+            got = mgr.restore_latest_valid()
+            if got is None:
+                raise SwapFailed(
+                    f"no valid checkpoint under {directory!r} "
+                    f"(skipped corrupt/partial steps: "
+                    f"{mgr.last_skipped})")
+            return got
+
+        policy = get_retry_policy("checkpoint.load")
+        return policy.call(load_once, site="checkpoint.load")
+
+    def _swap(self, msg: Dict, send) -> None:
+        """The ``swap`` op (conn thread): load-and-validate the new
+        checkpoint fully BEFORE the engine hears about it — a torn or
+        corrupt checkpoint is a typed SwapFailed with the old weights
+        still serving — then hand the host-side state to the engine
+        thread, which drains active slots and applies it between
+        steps. Queued and newly-arriving generates wait (zero drops);
+        the reply carries the new generation and swap_ms."""
+        from ..distributed.resilience import RetryExhausted
+        from ..inference.continuous_batching import SwapFailed
+        ckpt = msg.get("checkpoint")
+        if not isinstance(ckpt, str) or not ckpt:
+            send({"error": "BadRequest",
+                  "reason": "swap needs 'checkpoint': a checkpoint-"
+                            "manager directory path"})
+            return
+        gen = msg.get("generation")
+        if gen is not None and (isinstance(gen, bool)
+                                or not isinstance(gen, int)
+                                or gen < 0):
+            send({"error": "BadRequest",
+                  "reason": "generation must be a non-negative int"})
+            return
+        timeout_s = msg.get("timeout_s")
+        if timeout_s is not None and (
+                isinstance(timeout_s, bool)
+                or not isinstance(timeout_s, (int, float))
+                or timeout_s <= 0):
+            send({"error": "BadRequest",
+                  "reason": "timeout_s must be a positive number of "
+                            "seconds"})
+            return
+        try:
+            step, state = self._load_checkpoint_state(ckpt)
+        except (SwapFailed, RetryExhausted) as e:
+            self.metrics.counter("weight_swaps_failed_total").add()
+            send({"error": "SwapFailed", "reason": str(e)})
+            return
+        except Exception as e:
+            self.metrics.counter("weight_swaps_failed_total").add()
+            send({"error": "SwapFailed",
+                  "reason": f"{type(e).__name__}: {e}"})
+            return
+        payload: Dict[str, Any] = {"ctl": "swap", "state": state,
+                                   "step": step}
+        if gen is not None:
+            payload["generation"] = gen
+        if msg.get("rollback"):
+            payload["rollback"] = True
+        if timeout_s is not None:
+            payload["timeout_s"] = float(timeout_s)
+        pending = _Pending(stream=False)
+        self._inbox.put((payload, pending))
+        self._wake.set()
+        self._await_outbox(pending, send)
 
     def _on_complete(self, req) -> None:
         """Engine callback: terminal state for a request (any state)."""
@@ -1090,7 +1301,10 @@ class ServingServer:
                       getattr(eng, "programs_launched", {}) or {}),
                   # multi-step decode (r19)
                   "multi_step": getattr(eng, "multi_step", 1),
-                  "macro_launches": getattr(eng, "macro_launches", 0)})
+                  "macro_launches": getattr(eng, "macro_launches", 0),
+                  # weight hot-swap (r24)
+                  "weight_generation": self._weight_generation,
+                  "weight_swaps": getattr(eng, "weight_swaps", 0)})
             return
         if op == "metrics":
             send({"text": self.metrics.prometheus_text()})
@@ -1225,6 +1439,13 @@ class ServingServer:
             # waits on the wire); the tier import lands on the engine
             # thread.
             self._prefetch(msg, send)
+            return
+        if op == "swap":
+            # weight hot-swap (r24): load/validate on THIS conn
+            # thread, apply on the engine thread between steps.
+            # Allowed while draining — the supervisor's roll path
+            # drains a replica, then swaps it.
+            self._swap(msg, send)
             return
         if op != "generate":
             send({"error": "BadRequest", "reason": f"unknown op {op!r}"})
@@ -1416,6 +1637,26 @@ class ServingServer:
             port = int(ff["port"])
         except (KeyError, TypeError, ValueError):
             return None
+        # cross-generation guard (r24): a hint stamped with a peer
+        # generation other than ours is skipped typed-and-counted
+        # BEFORE any wire traffic — the peer's pages were computed
+        # under different weights and must never splice (the
+        # generation-salted chain keys would miss anyway; this makes
+        # the skip explicit and free)
+        peer_gen = ff.get("generation")
+        if peer_gen is not None:
+            try:
+                peer_gen = int(peer_gen)
+            except (TypeError, ValueError):
+                return None
+            if peer_gen != self._weight_generation:
+                self.metrics.counter(
+                    "cross_generation_skips_total").add()
+                self.tracer.annotate(
+                    "handoff_skipped_cross_generation",
+                    peer_generation=peer_gen,
+                    generation=self._weight_generation)
+                return None
         t0 = time.perf_counter()
         try:
             chain = pc.chain_keys_for(np.asarray(prompt, np.int32))
@@ -1469,6 +1710,21 @@ class ServingServer:
         except (KeyError, TypeError, ValueError):
             send({"error": "BadRequest",
                   "reason": "prefetch needs the peer's 'port'"})
+            return
+        # cross-generation guard (r24): same rule as fetch_from hints
+        # — a prefetch stamped with a different weight generation is
+        # skipped typed-and-counted, never spliced
+        peer_gen = msg.get("generation")
+        if peer_gen is not None and not isinstance(peer_gen, bool) \
+                and isinstance(peer_gen, int) \
+                and peer_gen != self._weight_generation:
+            self.metrics.counter("cross_generation_skips_total").add()
+            send({"error": "StaleGeneration",
+                  "reason": f"prefetch stamped generation {peer_gen} "
+                            f"but this replica serves generation "
+                            f"{self._weight_generation}; "
+                            f"cross-generation pages never splice",
+                  "generation": self._weight_generation})
             return
         t0 = time.perf_counter()
         try:
@@ -1538,6 +1794,11 @@ class ServingServer:
                 # still be resident (r20 satellite: a capped list must
                 # not read as a miss)
                 "page_size": eng.page_size,
+                # weight hot-swap (r24): the generation this replica
+                # serves — the supervisor's roll ready-probe and the
+                # router's generation-aware affinity read it here
+                "weight_generation": self._weight_generation,
+                "weight_swaps": getattr(eng, "weight_swaps", 0),
                 "prefix_keys": adv["keys"],
                 "prefix_keys_truncated": adv["truncated"],
                 "free_pages": eng.free_pages,
@@ -1588,6 +1849,10 @@ class ServingServer:
         eng = self.engine
         pc = self.prefix_cache
         g = {"inflight_slots": eng.num_active,
+             # weight hot-swap (r24): the serving generation as a
+             # gauge (serving_weight_generation on the scrape page;
+             # the supervisor rolls it up per fleet)
+             "weight_generation": float(self._weight_generation),
              # num_slots rides along so the fleet plane can compute
              # occupancy (inflight/slots) for the pressure verdict
              "num_slots": eng.num_slots,
@@ -1770,7 +2035,11 @@ class ServingServer:
                  "count": len(blobs),
                  "bytes": sum(len(b) for b in blobs.values()),
                  "truncated": truncated,
-                 "role": self.role}
+                 "role": self.role,
+                 # r24: the generation these blobs were computed under
+                 # (cross-generation requests miss by key construction;
+                 # this makes the provenance explicit on the wire)
+                 "generation": self._weight_generation}
         if remaining > 0:
             reply["next_cursor"] = cursor + len(window)
         return reply
@@ -2139,6 +2408,21 @@ def main(argv=None) -> None:
              "--blob-format raw plus --no-dedup restores the r22 "
              "byte layout exactly")
     parser.add_argument(
+        "--checkpoint", default=None, metavar="DIR",
+        help="weight hot-swap (r24): boot from the newest valid "
+             "checkpoint under DIR (ResilientCheckpointManager "
+             "manifest layout, crc-validated) instead of the seeded "
+             "init — how replicas (re)spawned mid-roll join the fleet "
+             "on the rolled weights. Swap a LIVE replica via the "
+             "'swap' op; a corrupt/missing checkpoint fails startup "
+             "typed")
+    parser.add_argument(
+        "--weight-generation", type=int, default=0, metavar="N",
+        help="weight generation this replica serves (salts the KV "
+             "chain keys so pages from other generations miss by "
+             "construction; the supervisor threads it through "
+             "respawns so a re-role never reverts a rolled replica)")
+    parser.add_argument(
         "--forecast-admission", action="store_true",
         help="byte-planning admission (r23): _fits also charges the "
              "fleet's forecast page burn (r18 EWMA exhaustion "
@@ -2198,6 +2482,8 @@ def main(argv=None) -> None:
                            handoff_timeout_s=args.handoff_timeout_s,
                            blob_format=args.blob_format,
                            dedup=not args.no_dedup,
+                           checkpoint=args.checkpoint,
+                           weight_generation=args.weight_generation,
                            num_slots=args.num_slots,
                            page_size=args.page_size,
                            max_engine_errors=args.max_engine_errors,

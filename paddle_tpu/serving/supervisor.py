@@ -158,6 +158,11 @@ class Replica:
         # mid-rerole — the monitor must not respawn its deliberate
         # kill and the router must not route to it
         self.draining = False
+        # weight hot-swap (r24): the replica's serving weight
+        # generation, refreshed from every healthy probe — roll_fleet
+        # reads it to skip already-converged replicas, fleet_stats
+        # rolls it up so a mixed-generation fleet is visible
+        self.weight_generation: int = 0
 
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
@@ -206,10 +211,19 @@ class Supervisor:
                  log_dir: Optional[str] = None,
                  collect_metrics: bool = True,
                  fleet=None,
-                 roles: Optional[Sequence[str]] = None):
+                 roles: Optional[Sequence[str]] = None,
+                 checkpoint: Optional[str] = None,
+                 weight_generation: int = 0):
         self.model = model
         self.host = host
         self.server_args = list(server_args)
+        # weight hot-swap (r24): the fleet's COMMITTED weight source —
+        # every (re)spawn, monitor respawn and re-role boots from this
+        # checkpoint at this generation, so a replica that crashes
+        # after a roll comes back on the ROLLED weights, not the boot
+        # image. roll_fleet advances both once the canary commits.
+        self.checkpoint = checkpoint
+        self.weight_generation = int(weight_generation)
         self.replica_env = dict(replica_env or {})
         self.probe_interval_s = float(probe_interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
@@ -465,6 +479,364 @@ class Supervisor:
             report["drain_error"] = f"{type(e).__name__}: {e}"
         return report
 
+    # -- rolling weight upgrade (r24) --------------------------------------
+
+    def _probe_generation(self, rep: Replica) -> Optional[int]:
+        """The replica's CURRENT weight generation, probed live (the
+        scraped ``rep.weight_generation`` can lag a probe cycle).
+        None on a dead/unreachable replica."""
+        try:
+            h = _rpc(self.host, rep.port, {"op": "health"},
+                     timeout_s=self.probe_timeout_s)
+            g = h.get("weight_generation")
+            if isinstance(g, int) and not isinstance(g, bool):
+                return g
+        except Exception:
+            pass
+        return None
+
+    def _fleet_attainment(self) -> Optional[float]:
+        """Merged fleet SLO attainment (r17 monitor) as one fraction —
+        the canary window's regression baseline. None when the fleet
+        plane is off or no SLO targets are armed."""
+        if self.fleet is None:
+            return None
+        try:
+            snap = self.fleet.fleet_snapshot()
+            classes = (snap.get("slo") or {}).get("classes") or {}
+            met = total = 0
+            for c in classes.values():
+                met += (int(c.get("ttft_met") or 0)
+                        + int(c.get("tpot_met") or 0))
+                total += 2 * int(c.get("total") or 0)
+            return (met / total) if total else None
+        except Exception:
+            return None
+
+    def _watch_canary(self, canary: Replica, window_s: float,
+                      baseline: Optional[float], slo_regress: float,
+                      canary_check=None) -> Optional[str]:
+        """Observe the first swapped replica for ``window_s`` before
+        the roll proceeds. Returns a typed regression reason (the
+        auto-rollback trigger) or None:
+
+        - the canary dying or failing 3 consecutive probes — the
+          EngineFailed class the ISSUE names;
+        - the r17 outlier detector flagging it (erroring / slow vs
+          the fleet median — the error-rate signal);
+        - fleet SLO attainment dropping more than ``slo_regress``
+          below the pre-roll baseline;
+        - a truthy string from an injected ``canary_check()`` (the
+          operator/test hook), checked every probe interval."""
+        if window_s <= 0:
+            return None
+        deadline = time.monotonic() + window_s
+        bad_probes = 0
+        while time.monotonic() < deadline:
+            if not canary.alive():
+                return "canary_died"
+            try:
+                h = _rpc(self.host, canary.port, {"op": "health"},
+                         timeout_s=self.probe_timeout_s)
+                bad_probes = 0 if "status" in h else bad_probes + 1
+            except Exception:
+                bad_probes += 1
+            if bad_probes >= 3:
+                return "canary_unhealthy"
+            if self.fleet is not None:
+                try:
+                    if canary.idx in set(self.fleet.outliers()):
+                        return "canary_outlier"
+                except Exception:
+                    pass
+            att = self._fleet_attainment()
+            if baseline is not None and att is not None \
+                    and baseline - att > slo_regress:
+                return "slo_regression"
+            if canary_check is not None:
+                why = canary_check()
+                if why:
+                    return str(why)
+            time.sleep(min(self.probe_interval_s,
+                           max(0.05, deadline - time.monotonic())))
+        return None
+
+    def _swap_replica(self, rep: Replica, checkpoint: str,
+                      generation: int, timeout_s: float,
+                      rollback: bool = False) -> Optional[str]:
+        """One replica's hot swap over the wire; returns a typed error
+        string or None on a verified success (the replica answers its
+        health probe AT the target generation)."""
+        payload = {"op": "swap", "checkpoint": checkpoint,
+                   "generation": generation, "timeout_s": timeout_s}
+        if rollback:
+            payload["rollback"] = True
+        try:
+            reply = _rpc(self.host, rep.port, payload,
+                         timeout_s=timeout_s + 30.0)
+        except Exception as e:
+            return f"{type(e).__name__}: {e}"
+        if reply.get("error"):
+            return f"{reply['error']}: {reply.get('reason')}"
+        deadline = time.monotonic() + max(10.0,
+                                          2 * self.probe_timeout_s)
+        while time.monotonic() < deadline:
+            if self._probe_generation(rep) == generation:
+                rep.weight_generation = generation
+                # satellite fix (r24): a verified swap is proof of
+                # life — clear any crash-loop backoff the replica
+                # accumulated before the roll
+                rep.reset_backoff()
+                return None
+            time.sleep(0.1)
+        return "swap_unverified: health never showed the target " \
+               "generation"
+
+    def _respawn_with_config(self, rep: Replica,
+                             timeout_s: float = 60.0) -> bool:
+        """Forward-convergence fallback: kill + respawn ``rep`` from
+        the COMMITTED fleet config (self.checkpoint at
+        self.weight_generation) and wait for a healthy probe. False
+        hands the replica to the monitor's backoff/respawn path —
+        which also spawns from the committed config, so the fleet
+        still converges."""
+        if rep.proc is not None:
+            try:
+                rep.proc.kill()
+                rep.proc.wait(timeout=10.0)
+            except Exception:
+                pass
+        rep.restarts += 1
+        self._spawn(rep)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if not rep.alive():
+                break
+            try:
+                h = _rpc(self.host, rep.port, {"op": "health"},
+                         timeout_s=self.probe_timeout_s)
+                if "status" in h:
+                    rep.ready = True
+                    rep.reset_backoff()
+                    rep.weight_generation = self.weight_generation
+                    return True
+            except Exception:
+                pass
+            time.sleep(0.25)
+        self._mark_dead(rep)
+        return False
+
+    def _handoff_before_swap(self, rep: Replica,
+                             timeout_s: float) -> Optional[Dict]:
+        """Hand the victim's hot chains to survivors before its swap
+        invalidates them (the generation bump clears its cache). Same
+        degradation contract as the r20 drain handoff: failures mean
+        re-prefill-on-first-use, never a blocked roll."""
+        heads: List[str] = list(rep.prefix_keys)
+        try:
+            h = _rpc(self.host, rep.port, {"op": "health"},
+                     timeout_s=timeout_s)
+            heads = list(h.get("prefix_keys") or heads)
+        except Exception:
+            pass
+        survivors = [r for r in self.live()
+                     if r.idx != rep.idx and r.role != "prefill"]
+        if not heads or not survivors:
+            return None
+        return handoff_chains(self.host, rep.port, heads, survivors,
+                              timeout_s=timeout_s)
+
+    def _rollback_generation(self, checkpoint: Optional[str],
+                             generation: int, journal,
+                             reason: str,
+                             swap_timeout_s: float = 120.0) -> List:
+        """Converge every live replica BACK to ``generation`` (the
+        canary auto-rollback sweep, also recovery's roll_incomplete
+        convergence). Each rollback swap is its own journaled roll
+        action with ``rollback`` marked; a replica that refuses the
+        swap (or a fleet with no old checkpoint to reload) is
+        respawned from the committed config instead — the fleet never
+        stays mixed."""
+        out = []
+        for rep in sorted(self.live(), key=lambda r: r.idx):
+            cur = self._probe_generation(rep)
+            if cur == generation:
+                continue
+            seq = None
+            if journal is not None:
+                seq = journal.begin(
+                    "roll", replica=rep.idx, checkpoint=checkpoint,
+                    generation_from=(cur if cur is not None
+                                     else rep.weight_generation),
+                    generation_to=generation, rollback=True,
+                    pid=(rep.proc.pid if rep.proc else None),
+                    port=rep.port, role=rep.role, reason=reason)
+            err = ("no rollback checkpoint"
+                   if not checkpoint else
+                   self._swap_replica(rep, checkpoint, generation,
+                                      swap_timeout_s, rollback=True))
+            if err is None:
+                if journal is not None:
+                    journal.update(seq, phase="swapped", swapped=True)
+                    journal.commit(seq)
+                out.append({"replica": rep.idx, "how": "swap"})
+            else:
+                ok = self._respawn_with_config(rep)
+                if journal is not None:
+                    if ok:
+                        journal.commit(seq, respawned=True)
+                    else:
+                        journal.rollback(
+                            seq, reason="rollback_respawn_pending")
+                out.append({"replica": rep.idx,
+                            "how": "respawn" if ok else "pending",
+                            "swap_error": err})
+        return out
+
+    def roll_fleet(self, checkpoint: str,
+                   generation: Optional[int] = None,
+                   canary_window_s: float = 0.0,
+                   slo_regress: float = 0.1,
+                   canary_check=None,
+                   handoff: bool = True,
+                   swap_timeout_s: float = 120.0,
+                   reason: str = "roll") -> Dict:
+        """Rolling weight upgrade (r24 tentpole): converge the fleet,
+        replica by replica behind the router, onto ``checkpoint`` at
+        the next (or given) weight generation — hot-swapping live
+        engines, never dropping a request (the server-side swap holds
+        admission while active slots drain; queued work waits).
+
+        Per replica: journal a ``roll`` action (begin → swapped →
+        commit, the crash-recovery record), hand its hot chains to
+        survivors, issue the swap op, verify the health probe reports
+        the target generation. The FIRST swapped replica is the
+        canary: it is watched for ``canary_window_s`` against the
+        pre-roll SLO baseline / the r17 outlier detector /
+        ``canary_check`` before the rest follow — a regression swaps
+        everything back to the previous generation (journaled,
+        counted, flight-recorded) and the roll reports the typed
+        reason.
+
+        Failure containment: a canary whose swap fails TYPED (corrupt
+        checkpoint, validation refusal) aborts the roll with zero
+        replicas changed — old weights keep serving fleet-wide. A
+        mid-roll swap failure AFTER the canary proved the checkpoint
+        converges forward by respawning the replica from the new
+        committed config instead. The committed config
+        (self.checkpoint / self.weight_generation) advances when the
+        canary commits, so monitor respawns during the roll come up
+        on the NEW weights."""
+        targets = sorted(self.live(), key=lambda r: r.idx)
+        if not targets:
+            return {"ok": False, "refused": "no_live_replica"}
+        old_ckpt, old_gen = self.checkpoint, self.weight_generation
+        gen_to = (int(generation) if generation is not None
+                  else old_gen + 1)
+        asc = self.autoscaler
+        journal = getattr(asc, "journal", None)
+        baseline = self._fleet_attainment()
+        report: Dict = {"ok": False, "checkpoint": checkpoint,
+                        "generation_from": old_gen,
+                        "generation": gen_to, "canary": None,
+                        "swapped": [], "skipped": [],
+                        "respawned": [], "rolled_back": [],
+                        "regression": None}
+        canary_done = False
+        for rep in targets:
+            cur = self._probe_generation(rep)
+            if cur == gen_to:
+                # resume idempotency: a replica already converged (a
+                # crash-recovered half-roll) is skipped, not re-rolled
+                report["skipped"].append(rep.idx)
+                canary_done = True
+                continue
+            seq = None
+            if journal is not None:
+                seq = journal.begin(
+                    "roll", replica=rep.idx, checkpoint=checkpoint,
+                    generation_from=(cur if cur is not None
+                                     else rep.weight_generation),
+                    generation_to=gen_to,
+                    pid=(rep.proc.pid if rep.proc else None),
+                    port=rep.port, role=rep.role, reason=reason)
+            if handoff:
+                report.setdefault("handoff", {})[str(rep.idx)] = \
+                    self._handoff_before_swap(rep, swap_timeout_s)
+            if asc is not None:
+                asc._chaos_hold()
+            err = self._swap_replica(rep, checkpoint, gen_to,
+                                     swap_timeout_s)
+            if err is not None:
+                if not canary_done:
+                    # canary refusal: NOTHING changed — the corrupt/
+                    # mismatched checkpoint never reaches a second
+                    # replica and old weights keep serving everywhere
+                    if journal is not None:
+                        journal.rollback(seq,
+                                         reason="canary_swap_failed")
+                    report["failed"] = {"replica": rep.idx,
+                                        "error": err}
+                    report["refused"] = "canary_swap_failed"
+                    if asc is not None:
+                        asc._record("roll", "canary_swap_failed",
+                                    ok=False, replica=rep.idx,
+                                    generation=gen_to, seq=seq)
+                    return report
+                # the canary proved the checkpoint: converge forward
+                ok = self._respawn_with_config(rep)
+                if journal is not None:
+                    if ok:
+                        journal.update(seq, phase="swapped",
+                                       swapped=True, respawned=True)
+                        journal.commit(seq)
+                    else:
+                        journal.rollback(
+                            seq, reason="roll_respawn_pending")
+                report["respawned"].append(
+                    {"replica": rep.idx, "swap_error": err,
+                     "ready": ok})
+                continue
+            if journal is not None:
+                journal.update(seq, phase="swapped", swapped=True)
+                journal.commit(seq)
+            report["swapped"].append(rep.idx)
+            if not canary_done:
+                canary_done = True
+                report["canary"] = rep.idx
+                # commit the new config NOW: respawns during the rest
+                # of the roll must come up on the proven new weights
+                self.checkpoint = checkpoint
+                self.weight_generation = gen_to
+                why = self._watch_canary(rep, canary_window_s,
+                                         baseline, slo_regress,
+                                         canary_check)
+                if why is not None:
+                    self.checkpoint = old_ckpt
+                    self.weight_generation = old_gen
+                    report["regression"] = why
+                    report["rolled_back"] = \
+                        self._rollback_generation(
+                            old_ckpt, old_gen, journal,
+                            reason=f"canary_{why}",
+                            swap_timeout_s=swap_timeout_s)
+                    if asc is not None:
+                        asc._record("roll", f"canary_rollback_{why}",
+                                    ok=False, canary=rep.idx,
+                                    generation=gen_to)
+                    return report
+        self.checkpoint = checkpoint
+        self.weight_generation = gen_to
+        if journal is not None:
+            journal.record_config(checkpoint, gen_to)
+        if asc is not None:
+            asc._record("roll", reason, ok=True, generation=gen_to,
+                        swapped=len(report["swapped"]),
+                        skipped=len(report["skipped"]),
+                        respawned=len(report["respawned"]))
+        report["ok"] = True
+        return report
+
     @property
     def restarts_total(self) -> int:
         return sum(r.restarts for r in self.replicas)
@@ -492,6 +864,15 @@ class Supervisor:
                  if "{replica}" in a else a for a in self.server_args]
         if rep.role != "mixed":
             extra = ["--role", rep.role] + extra
+        # weight hot-swap (r24): spawn at the fleet's COMMITTED weight
+        # config — a monitor respawn or a --roles re-role restart after
+        # a roll boots the rolled checkpoint at the rolled generation
+        # instead of regressing to the boot image at generation 0
+        if self.weight_generation:
+            extra = ["--weight-generation",
+                     str(self.weight_generation)] + extra
+        if self.checkpoint:
+            extra = ["--checkpoint", self.checkpoint] + extra
         cmd = [sys.executable, "-m", "paddle_tpu.serving.server",
                "--model", self.model, "--host", self.host,
                "--port", str(rep.port)] + extra
@@ -560,6 +941,10 @@ class Supervisor:
                         rep.page_size = int(ps) if ps else None
                         rep.load = (int(h.get("active") or 0)
                                     + int(h.get("queued") or 0))
+                        g = h.get("weight_generation")
+                        if isinstance(g, int) and \
+                                not isinstance(g, bool):
+                            rep.weight_generation = g
                     except (TypeError, ValueError):
                         pass
                 else:
@@ -689,6 +1074,8 @@ class Supervisor:
                 "load": r.load,
                 "role": getattr(r, "role", "mixed"),
                 "draining": r.draining,
+                "weight_generation": getattr(r, "weight_generation",
+                                             0),
                 "restarts": r.restarts,
                 "consec_deaths": r.consec_deaths,
                 "probe_failures": r.probe_failures,
@@ -706,6 +1093,14 @@ class Supervisor:
         out["supervision"] = supervision
         out["restarts_total"] = self.restarts_total
         out["collect_metrics"] = self.collect_metrics
+        # weight hot-swap (r24): the committed fleet generation plus
+        # the set actually OBSERVED on live replicas — more than one
+        # entry means a roll is in flight (or went wrong); the chaos
+        # harness asserts this converges to exactly one
+        out["weight_generation"] = self.weight_generation
+        out["weight_generations"] = sorted(
+            {getattr(r, "weight_generation", 0)
+             for r in self.live()} or {self.weight_generation})
         # actuator state (r21): envelope, cooldown-remaining, last
         # action, journal health — fleet_stats is the one op an
         # operator watches, so the autoscaler reports through it
@@ -1127,6 +1522,31 @@ class FailoverRouter:
                       "reason": f"unknown autoscale action "
                                 f"{action!r}"})
             return
+        if op == "roll":
+            # rolling weight upgrade (r24): the one-port drive for
+            # Supervisor.roll_fleet — blocks this connection thread
+            # for the roll's duration (other connections keep
+            # routing). Duck-typed like the other fleet ops.
+            rf = getattr(self.sup, "roll_fleet", None)
+            if rf is None:
+                send({"error": "RollUnavailable",
+                      "reason": "supervisor has no roll_fleet"})
+                return
+            ckpt = msg.get("checkpoint")
+            if not isinstance(ckpt, str) or not ckpt:
+                send({"error": "BadRequest",
+                      "reason": "roll needs a 'checkpoint' directory"})
+                return
+            kwargs: Dict = {}
+            if msg.get("generation") is not None:
+                kwargs["generation"] = int(msg["generation"])
+            if msg.get("canary_window_s") is not None:
+                kwargs["canary_window_s"] = \
+                    float(msg["canary_window_s"])
+            if msg.get("slo_regress") is not None:
+                kwargs["slo_regress"] = float(msg["slo_regress"])
+            send({"roll": rf(ckpt, **kwargs)})
+            return
         if op != "generate":
             # admin op: first live replica answers (replica-targeted
             # audits talk to replica ports directly)
@@ -1165,8 +1585,17 @@ class FailoverRouter:
             return None
         from .prefix_cache import _block_hash
         try:
+            # generation-aware (r24): replicas salt their chain roots
+            # with their weight generation, so the router must hash
+            # with the fleet's COMMITTED generation or no advertised
+            # key would ever match after a roll. Mid-roll, replicas
+            # still on the old generation simply stop matching and
+            # degrade to rendezvous placement — the documented
+            # cold-cache cost of a rolling upgrade.
+            gen = getattr(self.sup, "weight_generation", 0) or 0
             return _block_hash(None, np.asarray(prompt[:ps],
-                                                np.int32)).hex()
+                                                np.int32),
+                               generation=gen).hex()
         except (TypeError, ValueError, OverflowError):
             return None  # malformed prompt: backend answers BadRequest
 
@@ -1623,6 +2052,12 @@ def main(argv=None) -> None:
              "by plain cache affinity; prefill replicas only serve "
              "explicit prefill_only/fetch_pages traffic)")
     parser.add_argument(
+        "--checkpoint", default=None, metavar="DIR",
+        help="boot every replica from the newest valid checkpoint in "
+             "DIR (r24); later, `{\"op\": \"roll\", \"checkpoint\": "
+             "...}` on the router hot-swaps the fleet onto a new "
+             "checkpoint replica-by-replica with canary auto-rollback")
+    parser.add_argument(
         "--mesh", default=None, metavar="model=N",
         help="tensor-parallel mesh per replica, threaded to every "
              "replica's server as its --mesh (each replica shards over "
@@ -1841,7 +2276,7 @@ def main(argv=None) -> None:
                      backoff_base_s=args.backoff_base_s,
                      log_dir=args.log_dir,
                      collect_metrics=not args.no_collect_metrics,
-                     roles=roles)
+                     roles=roles, checkpoint=args.checkpoint)
     print(f"[paddle_tpu.supervisor] spawning {args.replicas} replicas "
           f"of {args.model} (logs: {sup.log_dir}) ...", flush=True)
     asc = None

@@ -1,0 +1,483 @@
+"""Weight hot-swap & crash-safe rolling upgrades (r24).
+
+The contracts pinned here (ISSUE r24 acceptance):
+
+- `swap_weights` is validate-then-apply ATOMIC: a structure/shape/
+  dtype mismatch, a busy engine, or a same-generation request is a
+  typed `SwapFailed` with the old weights still serving and the old
+  generation pinned; a valid swap bumps the generation and serves the
+  new weights bit-identically to a model built from the same state;
+- chain keys are generation-salted at the ROOT only: generation 0 is
+  byte-identical to the pre-r24 hash (existing deployments
+  unchanged), children inherit the salt through the parent digest,
+  and cross-generation lookups miss by construction — a keyed request
+  re-issued after a swap serves the NEW weights, never spliced KV;
+- the server `swap` op loads + crc-validates the checkpoint on the
+  conn thread BEFORE the live engine hears about it: a torn shard is
+  a typed `SwapFailed`, the replica keeps serving, and the
+  weight_swaps_total{outcome} family + serving_weight_generation
+  gauge record exactly what happened;
+- `plan_recovery` roll semantics: a half-finished roll resumes
+  FORWARD iff the canary proved the checkpoint (a `swapped` record or
+  a committed sibling roll to the same generation), otherwise rolls
+  BACK to the journal's committed config — and the action stays open
+  either way, so a second crash mid-resume resumes again instead of
+  stranding a mixed fleet;
+- the journal's committed weight config (`record_config`) survives
+  adoption, and flight_inspect accepts the `swapped` phase on roll
+  actions only;
+- a supervisor spawn threads the COMMITTED weight config into the
+  replica command line, so monitor respawns and --roles re-role
+  restarts never regress to the boot image at generation 0.
+
+Integration (slow lane): chaos INVARIANT 9
+(tools/chaos_serving.py --roll-chaos) — SIGKILL the supervisor
+mid-roll and a replica mid-swap; one converged generation, typed
+termination, zero leaks, clean journal.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.monitor import StatRegistry
+from paddle_tpu.distributed.resilience import ResilientCheckpointManager
+from paddle_tpu.inference import create_decode_engine
+from paddle_tpu.inference.continuous_batching import SwapFailed
+from paddle_tpu.models.gpt import (GPTForCausalLM, checkpoint_state,
+                                   gpt_tiny, perturbed_state)
+from paddle_tpu.serving import ServingMetrics, ServingServer, client_request
+from paddle_tpu.serving.autoscaler import (FleetJournal, load_journal,
+                                           plan_recovery)
+from paddle_tpu.serving.prefix_cache import _block_hash
+from paddle_tpu.serving.supervisor import Supervisor
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _compile_cache(module_compile_cache):
+    """Engine-heavy file: reuse XLA compiles across tests."""
+    yield
+
+
+def _fresh_model():
+    """A private model per mutating test: swaps apply set_state_dict
+    to the instance, so a shared module fixture would leak the
+    perturbed weights into later tests."""
+    pt.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+def _engine(m, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("num_pages", 12)
+    return create_decode_engine(m, **kw)
+
+
+def _greedy(m, prompt, max_new=6):
+    eng = _engine(m)
+    rid = eng.submit(np.asarray(prompt, np.int32), max_new)
+    out = eng.run()[rid]
+    eng.close()
+    return [int(t) for t in out[len(prompt):]]
+
+
+PROMPT = list(range(1, 20))
+
+
+# ---------------------------------------------------------------------------
+# Generation-salted chain keys
+# ---------------------------------------------------------------------------
+
+class TestChainKeySalt:
+    def test_root_salt_versions_the_whole_chain(self):
+        blk = np.arange(8, dtype=np.int32)
+        base = _block_hash(None, blk)
+        # generation 0 is byte-identical to the pre-r24 hash: boot
+        # weights, existing spills and advertisements are unchanged
+        assert _block_hash(None, blk, generation=0) == base
+        g1 = _block_hash(None, blk, generation=1)
+        g2 = _block_hash(None, blk, generation=2)
+        assert len({base, g1, g2}) == 3
+        # children inherit the salt through the parent digest — and
+        # ONLY through it: a non-root hash ignores the generation arg
+        child = np.arange(8, 16, dtype=np.int32)
+        assert _block_hash(base, child) != _block_hash(g1, child)
+        assert _block_hash(g1, child, generation=7) == \
+            _block_hash(g1, child)
+
+
+# ---------------------------------------------------------------------------
+# Engine swap_weights: validate-then-apply, typed refusals
+# ---------------------------------------------------------------------------
+
+class TestEngineSwap:
+    def test_identity_swap_is_bit_identical_and_bumps_generation(self):
+        m = _fresh_model()
+        eng = _engine(m)
+        rid = eng.submit(np.asarray(PROMPT, np.int32), 6)
+        before = [int(t) for t in eng.run()[rid][len(PROMPT):]]
+        info = eng.swap_weights(checkpoint_state(m))
+        assert info["generation"] == 1 and info["leaves"] > 0
+        assert info["swap_ms"] >= 0
+        assert eng.weight_generation == 1 and eng.weight_swaps == 1
+        rid = eng.submit(np.asarray(PROMPT, np.int32), 6)
+        after = [int(t) for t in eng.run()[rid][len(PROMPT):]]
+        assert after == before
+        eng.close()
+
+    def test_perturbed_swap_serves_exactly_the_new_weights(self):
+        m = _fresh_model()
+        state_b = perturbed_state(checkpoint_state(m), scale=1e-2,
+                                  seed=1)
+        ref_m = _fresh_model()
+        ref_m.set_state_dict(state_b)
+        ref = _greedy(ref_m, PROMPT)
+        eng = _engine(m)
+        eng.swap_weights(state_b, generation=5)
+        assert eng.weight_generation == 5
+        rid = eng.submit(np.asarray(PROMPT, np.int32), 6)
+        got = [int(t) for t in eng.run()[rid][len(PROMPT):]]
+        assert got == ref
+        eng.close()
+
+    def test_structure_and_shape_mismatch_refused_typed(self):
+        m = _fresh_model()
+        eng = _engine(m)
+        rid = eng.submit(np.asarray(PROMPT, np.int32), 4)
+        before = [int(t) for t in eng.run()[rid][len(PROMPT):]]
+        good = checkpoint_state(m)
+        missing = dict(good)
+        dropped = sorted(missing)[0]
+        del missing[dropped]
+        with pytest.raises(SwapFailed, match="structure mismatch"):
+            eng.swap_weights(missing)
+        extra = dict(good)
+        extra["not_a_real_leaf"] = np.zeros(3, np.float32)
+        with pytest.raises(SwapFailed, match="structure mismatch"):
+            eng.swap_weights(extra)
+        torn = dict(good)
+        name = sorted(torn)[0]
+        leaf = np.asarray(getattr(torn[name], "value", torn[name]))
+        torn[name] = np.zeros(tuple(s + 1 for s in leaf.shape),
+                              leaf.dtype)
+        with pytest.raises(SwapFailed, match="tree mismatch"):
+            eng.swap_weights(torn)
+        # all-or-nothing: nothing was touched, old weights serve, the
+        # generation never moved
+        assert eng.weight_generation == 0 and eng.weight_swaps == 0
+        rid = eng.submit(np.asarray(PROMPT, np.int32), 4)
+        assert [int(t)
+                for t in eng.run()[rid][len(PROMPT):]] == before
+        eng.close()
+
+    def test_same_generation_and_busy_engine_refused(self):
+        m = _fresh_model()
+        eng = _engine(m)
+        with pytest.raises(SwapFailed, match="already serving"):
+            eng.swap_weights(checkpoint_state(m), generation=0)
+        eng.submit(np.asarray(PROMPT, np.int32), 4)
+        eng.step()  # admits: an active slot pins the old weights
+        assert eng.num_active > 0
+        with pytest.raises(SwapFailed, match="busy"):
+            eng.swap_weights(checkpoint_state(m))
+        eng.run()  # in-flight work finishes on the old weights
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Server swap op: conn-thread validation, keyed no-cross-splice
+# ---------------------------------------------------------------------------
+
+class TestServerSwapOp:
+    def _serve(self, m, **kw):
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("max_seq_len", 96)
+        kw.setdefault("num_pages", 12)
+        kw.setdefault("metrics",
+                      ServingMetrics(registry=StatRegistry()))
+        return ServingServer(m, **kw)
+
+    def test_swap_end_to_end_keyed_reissue_serves_new_weights(
+            self, tmp_path):
+        m = _fresh_model()
+        state_b = perturbed_state(checkpoint_state(m), scale=1e-2,
+                                  seed=2)
+        ref_m = _fresh_model()
+        ref_m.set_state_dict(state_b)
+        ref = _greedy(ref_m, PROMPT)
+        ResilientCheckpointManager(str(tmp_path / "ck")).save(
+            1, state_b)
+        srv = self._serve(m)
+        port = srv.start()
+        try:
+            req = {"op": "generate", "prompt": PROMPT,
+                   "max_new_tokens": 6, "key": "swap-k0"}
+            r0 = client_request("127.0.0.1", port, dict(req))
+            assert "error" not in r0, r0
+            rep = client_request("127.0.0.1", port,
+                                 {"op": "swap",
+                                  "checkpoint": str(tmp_path / "ck"),
+                                  "generation": 1})
+            assert rep.get("generation") == 1, rep
+            assert rep.get("swap_ms", -1) >= 0
+            st = client_request("127.0.0.1", port, {"op": "stats"})
+            assert st["weight_generation"] == 1
+            assert st["weight_swaps"] == 1
+            # the SAME key after the swap: generation-salted chain
+            # keys make the old cached prefix miss by construction —
+            # the reply is the new weights' reference, never a
+            # hybrid spliced from old-generation KV
+            r1 = client_request("127.0.0.1", port, dict(req))
+            assert r1.get("generated") == ref, r1
+            mx = client_request("127.0.0.1", port, {"op": "metrics"})
+            assert "serving_weight_generation 1" in mx["text"]
+            assert 'weight_swaps_total{outcome="committed"} 1' \
+                in mx["text"]
+        finally:
+            srv.stop()
+        srv.engine.allocator.check_no_leak()
+
+    def test_corrupt_checkpoint_refused_old_weights_keep_serving(
+            self, tmp_path):
+        m = _fresh_model()
+        ck = tmp_path / "ck-bad"
+        ResilientCheckpointManager(str(ck)).save(
+            1, perturbed_state(checkpoint_state(m), seed=3))
+        step_dir = ck / "step_00000001"
+        shard = sorted(f for f in os.listdir(step_dir)
+                       if f.endswith(".npy"))[0]
+        with open(step_dir / shard, "r+b") as f:
+            f.seek(os.path.getsize(step_dir / shard) // 2)
+            f.write(b"\xff" * 16)
+        srv = self._serve(m)
+        port = srv.start()
+        try:
+            req = {"op": "generate", "prompt": PROMPT,
+                   "max_new_tokens": 6}
+            before = client_request("127.0.0.1", port, dict(req))
+            rep = client_request("127.0.0.1", port,
+                                 {"op": "swap",
+                                  "checkpoint": str(ck)})
+            assert rep.get("error") == "SwapFailed", rep
+            assert "no valid checkpoint" in rep["reason"]
+            # a missing directory and a bad request are typed too
+            rep = client_request(
+                "127.0.0.1", port,
+                {"op": "swap",
+                 "checkpoint": str(tmp_path / "nope")})
+            assert rep.get("error") == "SwapFailed", rep
+            assert client_request(
+                "127.0.0.1", port,
+                {"op": "swap"}).get("error") == "BadRequest"
+            st = client_request("127.0.0.1", port, {"op": "stats"})
+            assert st["weight_generation"] == 0
+            after = client_request("127.0.0.1", port, dict(req))
+            assert after["generated"] == before["generated"]
+            assert st["stats"]["counters"][
+                "weight_swaps_failed_total"] >= 2
+        finally:
+            srv.stop()
+        srv.engine.allocator.check_no_leak()
+
+
+# ---------------------------------------------------------------------------
+# plan_recovery: roll resume direction (pure)
+# ---------------------------------------------------------------------------
+
+def _body(fleet=(), actions=(), config=None, seq=None):
+    seqs = [a["seq"] for a in actions] or [0]
+    body = {"seq": seq if seq is not None else max(seqs),
+            "supervisor_pid": 12345,
+            "fleet": list(fleet), "actions": list(actions)}
+    if config is not None:
+        body["config"] = dict(config)
+    return body
+
+
+def _roll_begin(seq, replica=1, gen_to=3, **extra):
+    e = {"seq": seq, "action": "roll", "phase": "begin",
+         "replica": replica, "checkpoint": "/ck/new",
+         "generation_from": 0, "generation_to": gen_to,
+         "pid": 300, "port": 8900, "role": "mixed"}
+    e.update(extra)
+    return e
+
+
+_FLEET = [{"idx": 0, "pid": 100, "port": 8800, "role": "mixed"},
+          {"idx": 1, "pid": 300, "port": 8900, "role": "mixed"}]
+_CFG = {"checkpoint": "/ck/old", "generation": 1}
+
+
+class TestPlanRecoveryRoll:
+    def test_unproven_roll_resumes_backward_to_committed_config(self):
+        body = _body(fleet=_FLEET, actions=[_roll_begin(7)],
+                     config=_CFG)
+        plan = plan_recovery(body, {}, 1, 4,
+                             alive=lambda pid, port: True)
+        (res,) = plan["resume"]
+        assert res["action"] == "roll_back" and res["seq"] == 7
+        # the direction's target is the JOURNAL's committed config,
+        # not the half-applied roll's
+        assert res["checkpoint"] == "/ck/old"
+        assert res["generation"] == 1
+        # the action stays OPEN: a second crash mid-resume resumes
+        # again — the journal never forgets a half-rolled fleet
+        assert all(seq != 7 for seq, _, _ in plan["resolve"])
+        # the victim is a normal member again (adopted while live)
+        assert any(e["idx"] == 1 for e in plan["adopt"])
+
+    def test_swapped_record_resumes_forward(self):
+        body = _body(fleet=_FLEET,
+                     actions=[_roll_begin(7),
+                              {"seq": 7, "phase": "swapped",
+                               "swapped": True}],
+                     config=_CFG)
+        plan = plan_recovery(body, {}, 1, 4,
+                             alive=lambda pid, port: True)
+        (res,) = plan["resume"]
+        assert res["action"] == "roll" and res["generation"] == 3
+        assert res["checkpoint"] == "/ck/new"
+        assert all(seq != 7 for seq, _, _ in plan["resolve"])
+
+    def test_committed_sibling_roll_proves_generation_forward(self):
+        # the canary's roll to generation 3 committed; replica 1's is
+        # open and unswapped — the checkpoint is PROVEN, converge
+        # forward instead of swapping the canary back
+        acts = [_roll_begin(6, replica=0),
+                {"seq": 6, "phase": "commit"},
+                _roll_begin(7, replica=1)]
+        body = _body(fleet=_FLEET, actions=acts, config=_CFG)
+        plan = plan_recovery(body, {}, 1, 4,
+                             alive=lambda pid, port: True)
+        (res,) = plan["resume"]
+        assert res["action"] == "roll" and res["generation"] == 3
+
+    def test_committed_rollback_sibling_proves_nothing(self):
+        # a committed ROLLBACK-marked roll to generation 3 is the
+        # auto-rollback sweep, not proof the new weights work
+        acts = [_roll_begin(6, replica=0, rollback=True),
+                {"seq": 6, "phase": "commit"},
+                _roll_begin(7, replica=1)]
+        body = _body(fleet=_FLEET, actions=acts, config=_CFG)
+        plan = plan_recovery(body, {}, 1, 4,
+                             alive=lambda pid, port: True)
+        (res,) = plan["resume"]
+        assert res["action"] == "roll_back"
+
+    def test_dead_roll_victim_respawned_not_stranded(self):
+        body = _body(fleet=_FLEET, actions=[_roll_begin(7)],
+                     config=_CFG)
+        plan = plan_recovery(body, {}, 1, 4,
+                             alive=lambda pid, port: pid == 100)
+        assert {"idx": 1, "role": "mixed"} in plan["respawn"]
+        assert plan["resume"][0]["action"] == "roll_back"
+
+
+# ---------------------------------------------------------------------------
+# Journal committed config + flight_inspect roll phases
+# ---------------------------------------------------------------------------
+
+class TestJournalConfigAndLint:
+    def test_record_config_roundtrip_and_adoption(self, tmp_path):
+        path = str(tmp_path / "j.json")
+        j = FleetJournal(path)
+        assert j.config() == {}
+        j.record_config("/ck/rolled", 4)
+        body, err = load_journal(path)
+        assert err is None
+        assert body["config"] == {"checkpoint": "/ck/rolled",
+                                  "generation": 4}
+        j2 = FleetJournal(path)  # the restarted supervisor
+        j2.adopt_body(body)
+        assert j2.config()["generation"] == 4
+        s = j2.begin("spawn", replica=0)
+        j2.commit(s)
+        body, _ = load_journal(path)  # config survives later writes
+        assert body["config"]["checkpoint"] == "/ck/rolled"
+
+    def test_swapped_phase_legal_on_roll_actions_only(self, tmp_path):
+        fin = _load_tool("flight_inspect")
+        path = str(tmp_path / "j.json")
+        j = FleetJournal(path)
+        seq = j.begin("roll", replica=0, checkpoint="/ck/new",
+                      generation_from=0, generation_to=1)
+        j.update(seq, phase="swapped", swapped=True)
+        j.commit(seq)
+        obj = json.loads(open(path).read())
+        assert fin.lint_fleet_journal(obj, allow_open_tail=0) == []
+        s2 = j.begin("spawn", replica=1, role="mixed")
+        j.update(s2, phase="swapped", swapped=True)
+        j.commit(s2)
+        obj = json.loads(open(path).read())
+        errs = fin.lint_fleet_journal(obj, allow_open_tail=0)
+        assert errs and any("roll" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: committed weight config threads into every spawn
+# ---------------------------------------------------------------------------
+
+class TestSupervisorWeightConfig:
+    def test_spawn_carries_committed_checkpoint_and_generation(
+            self, tmp_path, monkeypatch):
+        from paddle_tpu.serving import supervisor as sup_mod
+        sup = Supervisor(model="gpt_tiny", replicas=1,
+                         collect_metrics=False, log_dir=str(tmp_path),
+                         checkpoint="/ck/rolled", weight_generation=4)
+        captured = {}
+
+        class _FakeProc:
+            pid = 4242
+
+            def poll(self):
+                return None
+
+        monkeypatch.setattr(
+            sup_mod.subprocess, "Popen",
+            lambda cmd, **kw: captured.setdefault("cmd", cmd)
+            and _FakeProc() or _FakeProc())
+        rep = sup.replicas[0]
+        sup._spawn(rep)
+        rep.close_log()
+        cmd = captured["cmd"]
+        assert cmd[cmd.index("--checkpoint") + 1] == "/ck/rolled"
+        assert cmd[cmd.index("--weight-generation") + 1] == "4"
+
+    def test_roll_fleet_refuses_without_live_replicas(self, tmp_path):
+        sup = Supervisor(model="gpt_tiny", replicas=1,
+                         collect_metrics=False, log_dir=str(tmp_path))
+        out = sup.roll_fleet("/ck/new")
+        assert out == {"ok": False, "refused": "no_live_replica"}
+
+
+# ---------------------------------------------------------------------------
+# Integration (slow lane): chaos INVARIANT 9
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_invariant9_roll_chaos():
+    chaos = _load_tool("chaos_serving")
+    report = chaos.run_roll_chaos(requests=6)
+    assert report.ok, json.dumps(report.to_dict(), indent=2)

@@ -97,6 +97,14 @@ class ChaosReport:
     # the behaviour proves nothing and fails loudly instead of
     # greenly. Default 0 so pre-r23 runs are unaffected.
     arming_failures: int = 0
+    # rolling weight upgrade (r24, INVARIANT 9): after SIGKILLing the
+    # supervisor mid-roll and a replica mid-swap, the fleet must
+    # converge to EXACTLY ONE weight generation (never mixed, never
+    # weightless), a corrupt checkpoint must be refused typed with
+    # zero replicas changed, and post-convergence outputs must be
+    # bit-identical to the converged generation's reference. Default 0
+    # so pre-r24 runs are unaffected.
+    generation_failures: int = 0
     recoveries: int = 0           # supervisor SIGKILL->restart cycles
     error_kinds: Dict[str, int] = dataclasses.field(default_factory=dict)
     details: List[Dict] = dataclasses.field(default_factory=list)
@@ -116,6 +124,7 @@ class ChaosReport:
                 and self.stranded_processes == 0
                 and self.journal_lint_failures == 0
                 and self.arming_failures == 0
+                and self.generation_failures == 0
                 and self.completed + self.typed_errors == self.requests)
 
     def to_dict(self) -> Dict:
@@ -1230,6 +1239,453 @@ def run_autoscale_chaos(requests: int = 8, seed: int = 0,
     return report
 
 
+def run_roll_chaos(requests: int = 8, seed: int = 0,
+                   model: str = "gpt_tiny", page_size: int = 8,
+                   max_seq_len: int = 96, num_slots: int = 2,
+                   max_new_tokens: int = 6,
+                   hold_s: float = 4.0,
+                   request_timeout_s: float = 300.0,
+                   drain_timeout_s: float = 120.0,
+                   converge_timeout_s: float = 300.0,
+                   platform: str = "cpu",
+                   log_dir: Optional[str] = None) -> ChaosReport:
+    """INVARIANT 9 (r24 rolling weight upgrade): interrupt a live
+    rolling weight upgrade every way the journal must survive, under
+    keyed traffic, and assert the crash-safety contract end to end:
+
+    - **phase A — SIGKILL the SUPERVISOR mid-roll**: force
+      ``roll_fleet`` toward a new checkpoint, kill the supervisor
+      inside the journaled-but-uncommitted span (``PT_AUTOSCALE_HOLD_S``
+      holds every roll action between its journal begin and the swap),
+      restart it on the same journal, and require the recovered fleet
+      to converge to EXACTLY ONE weight generation — forward if the
+      canary proved the checkpoint (``swapped`` record or a committed
+      sibling roll), rolled back to the journal's committed config
+      otherwise. Never a mixed fleet, never a weightless replica.
+    - **phase B — corrupt checkpoint**: a roll whose checkpoint fails
+      its crc manifest must be refused TYPED (``canary_swap_failed``)
+      with ZERO replicas changed — old weights keep serving.
+    - **phase C — SIGKILL a REPLICA mid-swap**: roll again and kill a
+      non-canary replica during the roll window; the roll must still
+      converge the whole fleet (respawn from the new committed config)
+      and report ok.
+    - throughout: 100% typed termination; completed mid-roll outputs
+      bit-identical to SOME generation's reference (old or new, never
+      a cross-spliced hybrid); post-convergence re-issue of EVERY key
+      bit-identical to the CONVERGED generation's reference; zero
+      leaked pages + clean dedup-aware ledger reconcile on every
+      member; journal and flight bundles lint clean; no stranded
+      processes."""
+    import signal as sig
+    import subprocess
+
+    import numpy as np
+
+    import flight_inspect
+    from paddle_tpu.distributed.resilience import \
+        ResilientCheckpointManager
+    from paddle_tpu.inference import create_decode_engine
+    from paddle_tpu.models.gpt import checkpoint_state, perturbed_state
+    from paddle_tpu.serving.autoscaler import scan_marked_replicas
+    from paddle_tpu.serving.server import _build_model, client_request
+    from paddle_tpu.serving.supervisor import _free_port, _rpc
+
+    t_start = time.monotonic()
+    rng = np.random.default_rng(seed)
+    # long keyed prompts: every chain has shareable pages so the
+    # pre-swap handoff actually carries state, and generation-salted
+    # chain keys are exercised against real cached prefixes
+    prompts = [np.asarray(rng.integers(1, 100,
+                                       size=int(rng.integers(18, 34))),
+                          np.int32)
+               for _ in range(requests)]
+    max_new = [max_new_tokens] * requests
+
+    log_dir = log_dir or tempfile.mkdtemp(prefix="pt-chaos-roll-")
+    os.makedirs(log_dir, exist_ok=True)
+    journal = os.path.join(log_dir, "fleet-journal.json")
+    flight_root = os.path.join(log_dir, "flight")
+
+    # ---- two real weight generations + a torn third, on disk -------
+    # generation 0 == the deterministic boot build, so replicas
+    # spawned WITHOUT a checkpoint and replicas restored from ckpt_a
+    # serve bit-identical outputs
+    base = _build_model(model)
+    state_a = checkpoint_state(base)
+    state_b = perturbed_state(state_a, scale=1e-3, seed=seed + 1)
+    ckpt_a = os.path.join(log_dir, "ckpt-a")
+    ckpt_b = os.path.join(log_dir, "ckpt-b")
+    ckpt_bad = os.path.join(log_dir, "ckpt-bad")
+    ResilientCheckpointManager(ckpt_a).save(1, state_a)
+    ResilientCheckpointManager(ckpt_b).save(1, state_b)
+    ResilientCheckpointManager(ckpt_bad).save(1, state_b)
+    # tear one shard AFTER its crc was manifested: the swap's
+    # validate-before-apply must refuse this checkpoint typed
+    step_dir = os.path.join(ckpt_bad, "step_00000001")
+    shard = sorted(f for f in os.listdir(step_dir)
+                   if f.endswith(".npy"))[0]
+    with open(os.path.join(step_dir, shard), "r+b") as f:
+        f.seek(max(0, os.path.getsize(f.name) // 2))
+        f.write(b"\xff" * 16)
+
+    def ref_outputs(state) -> List[List[int]]:
+        mm = _build_model(model)
+        mm.set_state_dict(state)
+        eng = create_decode_engine(mm, num_slots=2,
+                                   page_size=page_size,
+                                   max_seq_len=max_seq_len)
+        rids = [eng.submit(p, mnt)
+                for p, mnt in zip(prompts, max_new)]
+        results = eng.run()
+        eng.close()
+        return [[int(t) for t in results[r][len(p):]]
+                for r, p in zip(rids, prompts)]
+
+    refs: Dict[int, List[List[int]]] = {0: ref_outputs(state_a),
+                                        1: ref_outputs(state_b)}
+
+    rport = _free_port()
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": platform,
+        "TPU_SKIP_MDS_QUERY": "true",
+        "PADDLE_TPU_COMPILE_CACHE": os.path.join(log_dir,
+                                                 "compile_cache"),
+        "PT_AUTOSCALE_HOLD_S": str(hold_s),
+    })
+    # cooldown parked high AND min == the boot size: a pressure-driven
+    # scale-down must not eat a fleet member mid-run (a 1-replica
+    # fleet converges to one generation trivially — proving nothing),
+    # so every journal entry in this run is recovery or a roll
+    cmd = [sys.executable, "-m", "paddle_tpu.serving.supervisor",
+           "--replicas", "2", "--model", model,
+           "--port", str(rport),
+           "--checkpoint", ckpt_a,
+           "--probe-interval-s", "0.3", "--backoff-base-s", "0.5",
+           "--log-dir", log_dir,
+           "--flight-dir", flight_root,
+           "--autoscale", "--min-replicas", "2",
+           "--max-replicas", "3", "--cooldown-s", "3600",
+           "--autoscale-interval-s", "0.3", "--journal", journal,
+           "--",
+           "--page-size", str(page_size),
+           "--max-seq-len", str(max_seq_len),
+           "--num-slots", str(num_slots),
+           "--stall-timeout-s", "120"]
+    sup_log = open(os.path.join(log_dir, "supervisor-cli.log"), "ab")
+
+    report = ChaosReport(requests=requests)
+    outcomes: List[Optional[Dict]] = [None] * requests
+
+    def launch() -> subprocess.Popen:
+        return subprocess.Popen(cmd, stdout=sup_log,
+                                stderr=subprocess.STDOUT, env=env)
+
+    def op(payload: Dict, timeout_s: float = 10.0) -> Dict:
+        try:
+            return client_request("127.0.0.1", rport, payload,
+                                  timeout_s=timeout_s)
+        except Exception as e:
+            return {"_transport_error": f"{type(e).__name__}: {e}"}
+
+    def wait_router(min_live: int = 1, timeout_s: float = 300.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            h = op({"op": "health"}, timeout_s=5.0)
+            if h.get("live", 0) >= min_live:
+                return h
+            time.sleep(0.3)
+        raise RuntimeError(f"router not serving {min_live} live "
+                           f"replica(s) within {timeout_s}s "
+                           f"(logs: {log_dir})")
+
+    def client(i: int) -> None:
+        payload = {"op": "generate",
+                   "prompt": [int(t) for t in prompts[i]],
+                   "max_new_tokens": max_new[i],
+                   "stream": bool(i % 2),
+                   "key": f"roll-{seed}-{i}",
+                   "deadline_ms": int(request_timeout_s * 500)}
+        deadline = time.monotonic() + request_timeout_s
+        t0 = time.monotonic()
+        while True:
+            try:
+                out = client_request("127.0.0.1", rport, payload,
+                                     timeout_s=request_timeout_s)
+            except Exception as e:
+                out = {"_transport_error":
+                       f"{type(e).__name__}: {e}"}
+            if "_transport_error" in out or (
+                    out.get("error") and out.get("retryable")):
+                if time.monotonic() < deadline:
+                    time.sleep(0.5)
+                    continue
+            break
+        out["_elapsed_s"] = round(time.monotonic() - t0, 2)
+        outcomes[i] = out
+
+    def wait_converged(label: str,
+                       timeout_s: float) -> Optional[int]:
+        """Poll until every live replica reports ONE generation, no
+        recovery resume is pending, and the journal lints with zero
+        open actions. Returns the converged generation, or None."""
+        deadline = time.monotonic() + timeout_s
+        last: Dict = {}
+        while time.monotonic() < deadline:
+            st = op({"op": "fleet_stats"}, timeout_s=10.0)
+            fl = st.get("fleet") or {}
+            gens = fl.get("weight_generations")
+            live = op({"op": "health"}, timeout_s=5.0).get("live", 0)
+            asc = (op({"op": "autoscale"},
+                      timeout_s=10.0).get("autoscaler") or {})
+            last = {"gens": gens, "live": live,
+                    "pending": asc.get("pending_resumes"),
+                    "in_flight": asc.get("action_in_flight")}
+            # >= 2 live: a one-member fleet is single-generation
+            # trivially — convergence must mean the whole fleet
+            if (isinstance(gens, list) and len(gens) == 1
+                    and live >= 2
+                    and asc.get("pending_resumes") == 0
+                    and not asc.get("action_in_flight")):
+                try:
+                    with open(journal, encoding="utf-8") as f:
+                        jobj = json.load(f)
+                    if not flight_inspect.lint_fleet_journal(
+                            jobj, allow_open_tail=0):
+                        return int(gens[0])
+                except OSError:
+                    pass
+            time.sleep(0.5)
+        report.generation_failures += 1
+        report.details.append({"converge": label, "state": last})
+        return None
+
+    proc = launch()
+    try:
+        wait_router(min_live=2)
+
+        # ---- phase A: SIGKILL the supervisor mid-roll ---------------
+        wave1 = [threading.Thread(target=client, args=(i,),
+                                  daemon=True)
+                 for i in range(requests // 2)]
+        for t in wave1:
+            t.start()
+        forcer = threading.Thread(
+            target=op, args=({"op": "roll", "checkpoint": ckpt_b,
+                              "generation": 1},),
+            kwargs={"timeout_s": 600.0}, daemon=True)
+        forcer.start()
+        # half a hold after forcing: the canary's roll action is
+        # journaled (begin, maybe handoff) but the swap has not run
+        time.sleep(hold_s * 0.5)
+        proc.send_signal(sig.SIGKILL)
+        proc.wait(timeout=30)
+        report.recoveries += 1
+        proc = launch()
+        wait_router(min_live=2)
+        for t in wave1:
+            t.join(timeout=request_timeout_s)
+        g1 = wait_converged("phase_a", converge_timeout_s)
+        if g1 is not None and g1 not in refs:
+            report.generation_failures += 1
+            report.details.append({"phase_a_generation": g1})
+            g1 = None
+
+        # ---- phase B: corrupt checkpoint refused typed --------------
+        if g1 is not None:
+            rr = (op({"op": "roll", "checkpoint": ckpt_bad,
+                      "generation": 9},
+                     timeout_s=600.0).get("roll") or {})
+            st = op({"op": "fleet_stats"}, timeout_s=10.0)
+            gens = (st.get("fleet") or {}).get("weight_generations")
+            if (rr.get("ok") is not False
+                    or rr.get("refused") != "canary_swap_failed"
+                    or gens != [g1]):
+                report.generation_failures += 1
+                report.details.append(
+                    {"corrupt_roll": {"report": rr, "gens": gens}})
+
+        # ---- phase C: SIGKILL a replica mid-swap --------------------
+        g2 = None
+        if g1 is not None:
+            ckpt_c = ckpt_b if g1 == 0 else ckpt_a
+            refs[2] = refs[1] if g1 == 0 else refs[0]
+            wave2 = [threading.Thread(target=client, args=(i,),
+                                      daemon=True)
+                     for i in range(requests // 2, requests)]
+            for t in wave2:
+                t.start()
+            roller = threading.Thread(
+                target=op, args=({"op": "roll", "checkpoint": ckpt_c,
+                                  "generation": 2},),
+                kwargs={"timeout_s": 600.0}, daemon=True)
+            roller.start()
+            # 1.5 holds in: the canary has (usually) committed and a
+            # follower sits in its journaled pre-swap window — kill
+            # the HIGHEST-idx marked replica (the canary is the
+            # lowest live idx), forcing the respawn-forward path
+            time.sleep(hold_s * 1.5)
+            marked = scan_marked_replicas(journal)
+            if marked:
+                victim = marked[max(marked)]
+                try:
+                    os.kill(victim["pid"], sig.SIGKILL)
+                except OSError:
+                    pass
+            roller.join(timeout=600.0)
+            for t in wave2:
+                t.join(timeout=request_timeout_s)
+            g2 = wait_converged("phase_c", converge_timeout_s)
+            if g2 is not None and g2 != 2:
+                report.generation_failures += 1
+                report.details.append({"phase_c_generation": g2})
+                g2 = None
+
+        # ---- typed termination + per-generation bit-identity --------
+        # a request completed mid-roll may carry EITHER generation's
+        # weights; what it must never carry is a cross-spliced hybrid
+        for i, out in enumerate(outcomes):
+            if isinstance(out, dict):
+                report.details.append(
+                    {"i": i, "elapsed_s": out.get("_elapsed_s"),
+                     "kind": out.get("error")
+                     or out.get("_transport_error", "ok")})
+            if out is None or not isinstance(out, dict):
+                report.hangs += 1
+                continue
+            if "_transport_error" in out:
+                report.hangs += 1
+                kind = out["_transport_error"].split(":")[0]
+                report.error_kinds[kind] = \
+                    report.error_kinds.get(kind, 0) + 1
+                continue
+            if out.get("error"):
+                report.typed_errors += 1
+                kind = out["error"]
+                report.error_kinds[kind] = \
+                    report.error_kinds.get(kind, 0) + 1
+                continue
+            report.completed += 1
+            got = out.get("generated")
+            if not any(got == r[i] for r in refs.values()):
+                report.mismatches += 1
+                report.details.append({"hybrid_output": i})
+
+        # ---- post-convergence: every key re-issued must be
+        # bit-identical to the CONVERGED generation (old-generation
+        # cached prefixes miss by construction, never splice) --------
+        if g2 is not None:
+            for i in range(requests):
+                rdl = time.monotonic() + request_timeout_s
+                while True:
+                    out = op({"op": "generate",
+                              "prompt": [int(t) for t in prompts[i]],
+                              "max_new_tokens": max_new[i],
+                              "key": f"roll-{seed}-{i}"},
+                             timeout_s=request_timeout_s)
+                    if ("_transport_error" in out or (
+                            out.get("error") and out.get("retryable"))
+                            ) and time.monotonic() < rdl:
+                        time.sleep(0.5)
+                        continue
+                    break
+                if out.get("generated") != refs[2][i]:
+                    report.mismatches += 1
+                    report.details.append(
+                        {"reissue": i,
+                         "kind": out.get("error")
+                         or out.get("_transport_error", "mismatch")})
+
+        # ---- zero leaks + ledger reconcile on every member ----------
+        h = op({"op": "health"}, timeout_s=10.0)
+        deadline = time.monotonic() + drain_timeout_s
+        for rinfo in (h.get("replicas") or ()):
+            port = rinfo.get("port")
+            if port is None or not rinfo.get("alive"):
+                continue
+            try:
+                _rpc("127.0.0.1", port, {"op": "drain"},
+                     timeout_s=10.0)
+            except Exception:
+                report.leak_failures += 1
+                continue
+            ok = False
+            chk: Dict = {}
+            while time.monotonic() < deadline:
+                try:
+                    chk = _rpc("127.0.0.1", port,
+                               {"op": "leak_check"}, timeout_s=10.0)
+                except Exception:
+                    time.sleep(0.5)
+                    continue
+                if chk.get("ok"):
+                    ok = True
+                    break
+                if not chk.get("busy"):
+                    break
+                time.sleep(0.5)
+            if ok:
+                report.replicas_checked += 1
+            else:
+                report.leak_failures += 1
+            led = chk.get("ledger")
+            if isinstance(led, dict) and not led.get("ok", True):
+                report.ledger_failures += 1
+                report.ledger_errors.extend(
+                    f"replica {rinfo.get('idx')}: {m}"
+                    for m in (led.get("mismatches") or
+                              ["reconcile failed"])[:4])
+
+        # ---- flight bundles + final journal lint --------------------
+        asup_dir = os.path.join(flight_root, "supervisor")
+        if os.path.isdir(asup_dir):
+            bundles, errors = flight_inspect.lint_dir(asup_dir)
+            report.flight_bundles += len(bundles)
+            if errors:
+                report.flight_lint_failures += 1
+                report.flight_errors.extend(errors[:8])
+        try:
+            with open(journal, encoding="utf-8") as f:
+                jobj = json.load(f)
+            errs = flight_inspect.lint_fleet_journal(
+                jobj, name="fleet-journal", allow_open_tail=0)
+        except Exception as e:
+            errs = [f"journal unreadable: {type(e).__name__}: {e}"]
+        if errs:
+            report.journal_lint_failures += 1
+            report.details.append({"journal_lint": errs[:8]})
+
+        # ---- graceful stop, then the stranded-process scan ----------
+        proc.send_signal(sig.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            try:
+                proc.wait(timeout=30)
+            except Exception:
+                pass
+        sup_log.close()
+    time.sleep(1.0)  # let SIGTERMed replicas finish exiting
+    stranded = scan_marked_replicas(journal)
+    report.stranded_processes = len(stranded)
+    if stranded:
+        report.details.append({"stranded": stranded})
+        for info in stranded.values():  # never leave them behind
+            try:
+                os.kill(info["pid"], sig.SIGKILL)
+            except OSError:
+                pass
+    report.wall_s = round(time.monotonic() - t_start, 3)
+    if not report.ok:
+        report.details.append({"log_dir": log_dir})
+    return report
+
+
 def main(argv=None) -> int:
     import argparse
     parser = argparse.ArgumentParser(
@@ -1275,6 +1731,14 @@ def main(argv=None) -> int:
              "fallback to local prefill everywhere, zero leaks, "
              "dedup-aware ledger reconcile clean on every survivor")
     parser.add_argument(
+        "--roll-chaos", action="store_true",
+        help="run INVARIANT 9 instead (r24): SIGKILL the supervisor "
+             "mid-rolling-weight-upgrade and a replica mid-swap "
+             "under keyed traffic, plus a corrupt-checkpoint roll — "
+             "the fleet converges to exactly one weight generation, "
+             "outputs stay bit-identical per generation, typed "
+             "termination, zero leaks, journal lints clean")
+    parser.add_argument(
         "--autoscale-chaos", action="store_true",
         help="run INVARIANT 7 instead (r21): SIGKILL the SUPERVISOR "
              "mid-spawn and mid-scale-down under keyed traffic, "
@@ -1289,6 +1753,14 @@ def main(argv=None) -> int:
                                        model=args.model,
                                        platform=args.platform,
                                        log_dir=args.log_dir)
+        print(json.dumps(report.to_dict(), indent=2))
+        return 0 if report.ok else 1
+
+    if args.roll_chaos:
+        report = run_roll_chaos(requests=args.requests,
+                                seed=args.seed, model=args.model,
+                                platform=args.platform,
+                                log_dir=args.log_dir)
         print(json.dumps(report.to_dict(), indent=2))
         return 0 if report.ok else 1
 

@@ -231,7 +231,11 @@ def _lint_autoscale_bundle(bundle: Dict, name: str) -> List[str]:
     return errors
 
 
-JOURNAL_PHASES = ("begin", "launched", "commit", "rollback")
+# "swapped" (r24) marks a roll action's confirmed weight swap — legal
+# ONLY between a ``roll`` begin and its terminal phase; recovery keys
+# its forward/backward convergence decision on it
+JOURNAL_PHASES = ("begin", "launched", "swapped", "commit",
+                  "rollback")
 _JOURNAL_ROLES = ("mixed", "prefill", "decode")
 
 
@@ -267,6 +271,7 @@ def lint_fleet_journal(obj: Any, name: str = "journal",
     actions = body.get("actions")
     begins: List[int] = []
     resolved: set = set()
+    begin_kind: Dict[int, Any] = {}
     if not isinstance(actions, list):
         errors.append(f"{name}: actions must be a list")
         actions = []
@@ -283,6 +288,18 @@ def lint_fleet_journal(obj: Any, name: str = "journal",
                               f"actions[{i}] ({begins[-1]} -> "
                               f"{e['seq']})")
             begins.append(e["seq"])
+            begin_kind[e["seq"]] = e.get("action")
+        elif e["phase"] == "swapped":
+            # r24: a confirmed weight swap belongs to a roll action
+            # and nothing else (a swapped spawn/drain/rerole would
+            # mean the supervisor wrote a nonsense recovery record).
+            # A seq whose begin was pruned from the bounded tail is
+            # tolerated — only a VISIBLE mismatch is an error.
+            kind = begin_kind.get(e["seq"])
+            if kind is not None and kind != "roll":
+                errors.append(f"{name}: actions[{i}] phase "
+                              f"'swapped' on a {kind!r} action "
+                              f"(only roll actions swap)")
         elif e["phase"] in ("commit", "rollback"):
             resolved.add(e["seq"])
         if isinstance(body.get("seq"), int) \
